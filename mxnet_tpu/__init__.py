@@ -1,0 +1,34 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with MXNet's capabilities.
+
+Brand-new implementation (not a port) of the capability surface of
+pengzhao-intel/incubator-mxnet, designed for TPU: JAX/XLA is the compute and
+scheduling substrate (the PJRT runtime replaces the threaded dependency
+engine; XLA fusion replaces the MKL-DNN subgraph backend), Pallas provides
+custom kernels, and jax.sharding/shard_map over device meshes replaces
+KVStore's NCCL/ps-lite paths.
+
+Usage mirrors the reference:
+
+    import mxnet_tpu as mx
+    x = mx.nd.ones((2, 3), ctx=mx.tpu())
+    with mx.autograd.record():
+        y = (x * 2).sum()
+    y.backward()
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .base import MXNetError, get_env
+from .context import (Context, cpu, cpu_pinned, current_context, gpu, num_gpus,
+                      num_tpus, tpu)
+from . import engine
+from . import random
+from . import ndarray
+from . import ndarray as nd
+from .ndarray.ndarray import NDArray
+from . import autograd
+
+# Subsystem imports are appended as each lands (package layout matches the
+# reference's python/mxnet/__init__.py).
+from . import test_utils  # noqa: E402

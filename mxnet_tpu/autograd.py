@@ -1,0 +1,403 @@
+"""Tape-based autograd (ref: python/mxnet/autograd.py + src/imperative/imperative.cc).
+
+The reference records nnvm nodes per op and builds a gradient graph with the
+nnvm Gradient pass (imperative.cc:278). Here recording builds a lightweight
+tape of (op, attrs, input-slots, outputs); ``backward`` replays the reachable
+subgraph as one pure JAX function and differentiates it with jax.vjp — the
+FGradient attribute table is replaced by JAX AD, and XLA compiles/fuses the
+whole backward. RNG keys drawn during forward are recorded as constants so the
+replay is bit-identical (dropout masks match between forward and backward).
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.train_mode = False
+        _state.tape = []
+    return _state
+
+
+class _Entry:
+    """One array value in the recorded graph (nnvm NodeEntry analogue)."""
+
+    __slots__ = ("node", "index", "nd_ref")
+
+    def __init__(self, node, index, nd=None):
+        self.node = node  # None for leaves (marked variables)
+        self.index = index
+        self.nd_ref = weakref.ref(nd) if nd is not None else None
+
+
+class _Node:
+    """One recorded op application (nnvm Node + AGInfo analogue)."""
+
+    __slots__ = ("op", "attrs", "slots", "out_entries", "n_out")
+
+    def __init__(self, op, attrs, slots, n_out):
+        self.op = op
+        self.attrs = attrs
+        self.slots = slots  # list of ("e", entry, snapshot) | ("c", value)
+        self.out_entries = []
+        self.n_out = n_out
+
+
+class _ClosureOp:
+    """Minimal OpDef protocol for ops captured as closures (getitem, custom
+    Function, grad-of-grad nodes)."""
+
+    needs_rng = False
+    _kwarg_names = ()
+
+    def __init__(self, name, fn):
+        self.name = name
+        self.fn = fn
+
+    def __call__(self, *a, **k):
+        return self.fn(*a, **k)
+
+
+# -- recording state ---------------------------------------------------------
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().train_mode
+
+
+def set_recording(is_record):
+    st = _st()
+    prev = st.recording
+    st.recording = bool(is_record)
+    return prev
+
+
+def set_training(train_mode):
+    st = _st()
+    prev = st.train_mode
+    st.train_mode = bool(train_mode)
+    return prev
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train_mode):
+        self._is_record = is_record
+        self._train_mode = train_mode
+        self._prev = None
+        self._prev_train = None
+
+    def __enter__(self):
+        if self._is_record is not None:
+            self._prev = set_recording(self._is_record)
+        if self._train_mode is not None:
+            self._prev_train = set_training(self._train_mode)
+        return self
+
+    def __exit__(self, *exc):
+        if self._prev is not None or self._is_record is not None:
+            set_recording(self._prev)
+        if self._prev_train is not None or self._train_mode is not None:
+            set_training(self._prev_train)
+        return False
+
+
+def record(train_mode=True):
+    """Scope: operations are recorded for differentiation."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+# -- tape construction -------------------------------------------------------
+
+
+def _mark_variable(nd):
+    nd._entry = _Entry(None, 0, nd)
+
+
+def mark_variables(variables, gradients=None, grad_reqs="write"):
+    """(ref: autograd.py mark_variables / MXAutogradMarkVariables)"""
+    if gradients is None:
+        gradients = [None] * len(variables)
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v.grad = g
+        v._grad_req = req
+        _mark_variable(v)
+
+
+def _slot_for(nd):
+    if nd._entry is not None:
+        return ("e", nd._entry, nd._data)
+    return ("c", nd._data)
+
+
+def _record_op(op, attrs, nd_inputs, nd_outputs, rng_consts=()):
+    st = _st()
+    slots = [("c", k) for k in rng_consts]
+    slots += [_slot_for(i) for i in nd_inputs]
+    node = _Node(op, attrs, slots, len(nd_outputs))
+    for idx, o in enumerate(nd_outputs):
+        e = _Entry(node, idx, o)
+        node.out_entries.append(e)
+        o._entry = e
+    st.tape.append(node)
+    return node
+
+
+def _record_getitem(nd, key):
+    from .ndarray.ndarray import NDArray
+
+    op = _ClosureOp("getitem", lambda x: x[key])
+    out_data = op.fn(nd._data)
+    out = NDArray(out_data)
+    _record_op(op, {}, [nd], [out])
+    return out
+
+
+def _record_closure(name, fn, nd_inputs, nd_outputs):
+    return _record_op(_ClosureOp(name, fn), {}, nd_inputs, nd_outputs)
+
+
+# -- backward ----------------------------------------------------------------
+
+
+def _collect(head_entries):
+    """Reachable subgraph in recorded (topological) order + ordered leaves."""
+    st = _st()
+    needed = set()
+    leaves = []
+    leaf_seen = set()
+    stack = [e for e in head_entries if e is not None]
+    while stack:
+        e = stack.pop()
+        if e.node is None:
+            if id(e) not in leaf_seen:
+                leaf_seen.add(id(e))
+                leaves.append(e)
+            continue
+        if id(e.node) in needed:
+            continue
+        needed.add(id(e.node))
+        for s in e.node.slots:
+            if s[0] == "e":
+                stack.append(s[1])
+    nodes = [n for n in st.tape if id(n) in needed]
+    # only leaves attached to live NDArrays that want grad
+    grad_leaves = [
+        e for e in leaves
+        if e.nd_ref is not None and e.nd_ref() is not None
+        and e.nd_ref()._grad_req != "null"
+    ]
+    return nodes, grad_leaves
+
+
+def _build_replay(nodes, grad_leaves, head_entries):
+    """Pure function leaf_values -> head_values replaying the tape."""
+
+    def f(*leaf_vals):
+        env = {id(e): v for e, v in zip(grad_leaves, leaf_vals)}
+        for node in nodes:
+            ins = []
+            for s in node.slots:
+                if s[0] == "e":
+                    ins.append(env.get(id(s[1]), s[2]))
+                else:
+                    ins.append(s[1])
+            raw = node.op.fn(*ins, **node.attrs)
+            raws = list(raw) if isinstance(raw, (tuple, list)) else [raw]
+            for e, v in zip(node.out_entries, raws):
+                env[id(e)] = v
+        outs = []
+        for e in head_entries:
+            if id(e) in env:
+                outs.append(env[id(e)])
+            else:
+                nd = e.nd_ref() if e.nd_ref else None
+                outs.append(nd._data if nd is not None else None)
+        return tuple(outs)
+
+    return f
+
+
+def _compute_gradients(heads, head_grads, create_graph=False):
+    from .ndarray.ndarray import NDArray
+
+    head_entries = []
+    tape_ids = {id(n) for n in _st().tape}
+    for h in heads:
+        if h._entry is None:
+            raise MXNetError(
+                "cannot differentiate: output is not part of a recorded "
+                "computational graph (did you forget autograd.record()?)")
+        if h._entry.node is not None and id(h._entry.node) not in tape_ids:
+            raise MXNetError(
+                "cannot differentiate: the computational graph has already "
+                "been freed (backward was called before); pass "
+                "retain_graph=True to keep it")
+        head_entries.append(h._entry)
+
+    nodes, grad_leaves = _collect(head_entries)
+    if not grad_leaves:
+        raise MXNetError("no variables with grad attached found in the graph")
+
+    f = _build_replay(nodes, grad_leaves, head_entries)
+    leaf_vals = [e.nd_ref()._data for e in grad_leaves]
+
+    if head_grads is None:
+        hg = [jnp.ones(h.shape, h._data.dtype) for h in heads]
+    else:
+        hg = [
+            g._data if g is not None else jnp.ones(h.shape, h._data.dtype)
+            for h, g in zip(heads, head_grads)
+        ]
+
+    def gradfn(*lv):
+        _, vjp_fn = jax.vjp(f, *lv)
+        return vjp_fn(tuple(hg))
+
+    grads = gradfn(*leaf_vals)
+    grad_nds = [NDArray(g) for g in grads]
+
+    if create_graph:
+        # record the grad computation itself so second-order grads work
+        leaf_nds = [e.nd_ref() for e in grad_leaves]
+        _record_closure("grad", gradfn, leaf_nds, grad_nds)
+
+    return grad_leaves, grad_nds
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of heads wrt all marked variables, accumulating into
+    their .grad per grad_req (ref: MXAutogradBackwardEx)."""
+    from .ndarray.ndarray import NDArray
+
+    grad_leaves, grads = _compute_gradients(heads, head_grads)
+    for e, g in zip(grad_leaves, grads):
+        nd = e.nd_ref()
+        if nd._grad_req == "add" and nd.grad is not None:
+            nd.grad._data = nd.grad._data + g._data
+        else:
+            if nd.grad is None:
+                nd.grad = NDArray(g._data)
+            else:
+                nd.grad._data = g._data
+    if not retain_graph:
+        _st().tape.clear()
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Functional gradient API (ref: autograd.py grad())."""
+    if retain_graph is None:
+        retain_graph = create_graph
+    prev_reqs = [(v, v._grad_req) for v in variables]
+    for v in variables:
+        if v._entry is None:
+            _mark_variable(v)
+        if v._grad_req == "null":
+            v._grad_req = "write"
+    try:
+        grad_leaves, grads = _compute_gradients(
+            heads, head_grads, create_graph=create_graph)
+    finally:
+        for v, req in prev_reqs:
+            v._grad_req = req
+    by_id = {id(e.nd_ref()): g for e, g in zip(grad_leaves, grads)}
+    out = []
+    for v in variables:
+        if id(v) not in by_id:
+            raise MXNetError("one of the requested variables does not "
+                             "contribute to the heads")
+        out.append(by_id[id(v)])
+    if not retain_graph:
+        _st().tape.clear()
+    return out
+
+
+def get_symbol(x):
+    raise MXNetError("get_symbol is not supported; use HybridBlock.export")
+
+
+class Function:
+    """Custom differentiable function (ref: autograd.py Function).
+
+    Subclass and implement forward(self, *inputs) / backward(self, *out_grads),
+    both operating on NDArrays. The pair is wrapped in a jax.custom_vjp over
+    the replay trace, so it composes with the rest of the tape.
+    """
+
+    def __init__(self):
+        self._saved = ()
+
+    def save_for_backward(self, *arrays):
+        self._saved = arrays
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+
+        func = self
+
+        def fwd_raw(*datas):
+            nds = [NDArray(d) for d in datas]
+            with pause():
+                outs = func.forward(*nds)
+            multi = isinstance(outs, (tuple, list))
+            outs = list(outs) if multi else [outs]
+            return tuple(o._data for o in outs)
+
+        @jax.custom_vjp
+        def wrapped(*datas):
+            return fwd_raw(*datas)
+
+        def wrapped_fwd(*datas):
+            out = fwd_raw(*datas)
+            return out, datas
+
+        def wrapped_bwd(datas, gs):
+            nds = [NDArray(d) for d in datas]
+            with pause():
+                func.forward(*nds)  # rebuild saved tensors for this trace
+                grads = func.backward(*[NDArray(g) for g in gs])
+            multi = isinstance(grads, (tuple, list))
+            grads = list(grads) if multi else [grads]
+            return tuple(g._data for g in grads)
+
+        wrapped.defvjp(wrapped_fwd, wrapped_bwd)
+
+        raw = wrapped(*[i._data for i in inputs])
+        from .ndarray.ndarray import NDArray as _ND
+
+        outs = [_ND(r) for r in raw]
+        if is_recording():
+            _record_closure("custom_function", wrapped, list(inputs), outs)
+        return outs if len(outs) > 1 else outs[0]

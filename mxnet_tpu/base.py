@@ -1,0 +1,86 @@
+"""Base utilities: errors, registries, env-var config.
+
+TPU-native analogue of the reference's dmlc-core base layer
+(ref: include/mxnet/base.h, python/mxnet/base.py). There is no C ABI
+boundary here: the "engine" under this framework is the JAX/PJRT runtime
+itself, so the base layer only carries errors, the op/class registries
+and the env-var config tier (ref: docs/faq/env_var.md).
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+
+class MXNetError(RuntimeError):
+    """Default error raised by the framework (ref: python/mxnet/base.py MXNetError)."""
+
+
+class NotSupportedForSparseNDArray(MXNetError):
+    pass
+
+
+def get_env(name, default=None, dtype=str):
+    """Read an env var the way the reference reads dmlc::GetEnv at point of use."""
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    if dtype is bool:
+        return val not in ("0", "false", "False", "")
+    return dtype(val)
+
+
+class _Registry:
+    """Generic name -> object registry (ref: python/mxnet/registry.py)."""
+
+    def __init__(self, kind):
+        self.kind = kind
+        self._entries = {}
+        self._lock = threading.Lock()
+
+    def register(self, obj, name=None, aliases=()):
+        name = name or getattr(obj, "__name__", None)
+        if name is None:
+            raise ValueError("cannot infer registry name")
+        with self._lock:
+            self._entries[name.lower()] = obj
+            for a in aliases:
+                self._entries[a.lower()] = obj
+        return obj
+
+    def get(self, name):
+        try:
+            return self._entries[name.lower()]
+        except KeyError:
+            raise MXNetError(
+                f"{self.kind} '{name}' is not registered. "
+                f"Known: {sorted(set(self._entries))}"
+            ) from None
+
+    def find(self, name):
+        return self._entries.get(name.lower())
+
+    def entries(self):
+        return dict(self._entries)
+
+
+_registries = {}
+
+
+def registry(kind):
+    if kind not in _registries:
+        _registries[kind] = _Registry(kind)
+    return _registries[kind]
+
+
+def classproperty(fn):
+    class _cp:
+        def __get__(self, obj, owner):
+            return fn(owner)
+
+    return _cp()
+
+
+# Numeric limits used by quantization (ref: src/operator/quantization/quantization_utils.h)
+INT8_MIN, INT8_MAX = -127, 127
+INT32_MIN, INT32_MAX = -(2 ** 31) + 1, 2 ** 31 - 1

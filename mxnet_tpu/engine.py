@@ -1,0 +1,67 @@
+"""Execution-engine facade.
+
+The reference's dependency engine (src/engine/threaded_engine_perdevice.cc)
+exists to overlap op execution with the Python thread and serialize writers
+per variable. On TPU the PJRT runtime already *is* that engine: op dispatch is
+async (returns futures immediately), per-device execution is stream-ordered,
+and data dependencies are tracked by buffer. This module keeps the reference's
+user-facing control points:
+
+  - MXNET_ENGINE_TYPE=NaiveEngine  -> synchronous execution after every op
+    (the determinism/debug switch, ref: src/engine/engine.cc:32-48)
+  - waitall()/wait_for_var         -> barriers on the async stream
+  - exception propagation          -> jax raises deferred errors at
+    block_until_ready, matching the reference's rethrow-at-WaitForVar
+    contract (ref: src/engine/threaded_engine.cc:472-479)
+"""
+from __future__ import annotations
+
+import jax
+
+from .base import get_env
+
+_naive = get_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice") == "NaiveEngine"
+_pending = []
+_PENDING_MAX = 64
+
+
+def is_naive():
+    return _naive
+
+
+def set_engine_type(name):
+    global _naive
+    _naive = name == "NaiveEngine"
+
+
+def on_op_executed(outputs):
+    """Called by the nd dispatch layer after each eager op."""
+    if _naive:
+        for o in outputs:
+            jax.block_until_ready(o)
+        return
+    # keep a small window of in-flight results so waitall() has handles to
+    # block on without retaining everything (stream ordering does the rest)
+    _pending.extend(outputs)
+    if len(_pending) > _PENDING_MAX:
+        del _pending[: len(_pending) - _PENDING_MAX]
+
+
+def waitall():
+    """Block until all pushed work completes (MXNDArrayWaitAll analogue).
+
+    Device streams execute in order, so blocking on the most recently
+    dispatched arrays implies completion of everything before them.
+    """
+    for o in _pending:
+        try:
+            jax.block_until_ready(o)
+        except Exception:
+            # waitall surfaces the first pending error, like WaitForAll
+            _pending.clear()
+            raise
+    _pending.clear()
+
+
+def wait_for_var(arr):
+    jax.block_until_ready(arr)

@@ -1,0 +1,105 @@
+"""mx.nd — imperative NDArray API (ref: python/mxnet/ndarray/).
+
+Module functions for every registered op are generated at import from the op
+registry (the reference does the same from the C registry via
+MXSymbolGetAtomicSymbolInfo).
+"""
+from __future__ import annotations
+
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import engine
+from ..context import Context, current_context
+from .ndarray import NDArray, array, concatenate, invoke
+from .register import populate
+from . import random  # noqa: F401
+from .utils import save, load
+
+populate(globals())
+
+
+# constructors shadow same-named registry wrappers (shape is positional here)
+def zeros(shape, ctx=None, dtype=None, **kwargs):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return array(np.zeros(shape, dtype or "float32"), ctx=ctx)
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return array(np.ones(shape, dtype or "float32"), ctx=ctx)
+
+
+def full(shape, val, ctx=None, dtype=None, out=None):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return array(np.full(shape, val, dtype or "float32"), ctx=ctx)
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
+    return invoke("_arange", [], {"start": float(start),
+                                  "stop": None if stop is None else float(stop),
+                                  "step": float(step), "repeat": int(repeat),
+                                  "dtype": dtype})
+
+
+def eye(N, M=0, k=0, ctx=None, dtype="float32"):
+    return invoke("_eye", [], {"N": int(N), "M": int(M), "k": int(k),
+                               "dtype": dtype})
+
+
+def zeros_like(data, **kwargs):
+    return invoke("zeros_like", [data], {})
+
+
+def ones_like(data, **kwargs):
+    return invoke("ones_like", [data], {})
+
+
+def moveaxis(tensor, source, destination):
+    axes = list(range(tensor.ndim))
+    axes.remove(source % tensor.ndim)
+    axes.insert(destination % tensor.ndim, source % tensor.ndim)
+    return transpose(tensor, axes=tuple(axes))  # noqa: F821
+
+
+def waitall():
+    engine.waitall()
+
+
+def from_numpy(a, zero_copy=False):
+    return array(a)
+
+
+def stack(*data, axis=0):
+    if len(data) == 1 and isinstance(data[0], (list, tuple)):
+        data = tuple(data[0])
+    return invoke("stack", list(data), {"axis": axis})
+
+
+def concat(*data, dim=1):
+    if len(data) == 1 and isinstance(data[0], (list, tuple)):
+        data = tuple(data[0])
+    return invoke("Concat", list(data), {"dim": dim})
+
+
+def add_n(*args):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return invoke("elemwise_sum", list(args), {})
+
+
+def split(data, num_outputs, axis=1, squeeze_axis=False):
+    out = invoke("SliceChannel", [data],
+                 {"num_outputs": num_outputs, "axis": axis,
+                  "squeeze_axis": squeeze_axis})
+    return out if isinstance(out, (tuple, list)) else [out]
+
+
+def onehot_encode(indices, out):
+    return invoke("one_hot", [indices], {"depth": out.shape[1]}, out=out)

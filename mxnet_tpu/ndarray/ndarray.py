@@ -1,0 +1,429 @@
+"""NDArray — async device array on top of jax.Array.
+
+TPU-native analogue of include/mxnet/ndarray.h + python/mxnet/ndarray/ndarray.py.
+Where the reference's NDArray holds a Storage chunk plus an engine variable and
+every op is pushed to the threaded engine, this NDArray holds a jax.Array whose
+PJRT buffer is *already* asynchronous: dispatch returns immediately, per-device
+execution is stream-ordered, and `wait_to_read` maps to block_until_ready
+(deferred errors surface there — the reference's rethrow-at-WaitForVar
+contract, src/engine/threaded_engine.cc:472-479). The MKL-DNN opaque-layout
+seam (ndarray.cc:389-744 Reorder2Default) corresponds to the device-resident
+tiled layout PJRT keeps; `asnumpy()` is the explicit relayout boundary.
+"""
+from __future__ import annotations
+
+import numbers
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import engine
+from .. import random as _random
+from ..base import MXNetError
+from ..context import Context, ctx_from_jax_device, current_context
+from ..ops import registry as _reg
+
+__all__ = ["NDArray", "array", "invoke", "concatenate"]
+
+_DTYPE_ALIAS = {None: jnp.float32}
+
+
+def _canon_attr(v):
+    """Normalize attr values: lists -> tuples (hashable for jit static args),
+    numpy scalars -> python scalars, MXNet string tuples '(1, 1)' -> tuples."""
+    if isinstance(v, list):
+        return tuple(_canon_attr(x) for x in v)
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, str) and v.startswith("(") and v.endswith(")"):
+        try:
+            return tuple(int(x) for x in v[1:-1].split(",") if x.strip())
+        except ValueError:
+            return v
+    return v
+
+
+class NDArray:
+    """n-dimensional device array with async semantics."""
+
+    __slots__ = ("_data", "grad", "_grad_req", "_entry", "__weakref__")
+
+    def __init__(self, data, ctx=None):
+        if isinstance(data, NDArray):
+            data = data._data
+        if not isinstance(data, jax.Array):
+            data = jnp.asarray(data)
+        if ctx is not None:
+            data = jax.device_put(data, Context(ctx).jax_device)
+        self._data = data
+        self.grad = None
+        self._grad_req = "null"
+        self._entry = None
+
+    # -- basic properties --------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(str(self._data.dtype))
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self):
+        try:
+            dev = list(self._data.devices())[0]
+        except Exception:
+            return current_context()
+        return ctx_from_jax_device(dev)
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def handle(self):
+        return self._data
+
+    # -- sync / conversion -------------------------------------------------
+    def asnumpy(self):
+        return np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("The current array is not a scalar")
+        return self.asnumpy().reshape(-1)[0]
+
+    def item(self):
+        return self.asscalar()
+
+    def wait_to_read(self):
+        jax.block_until_ready(self._data)
+
+    def wait_to_write(self):
+        jax.block_until_ready(self._data)
+
+    def as_in_context(self, ctx):
+        ctx = Context(ctx)
+        if ctx == self.context:
+            return self
+        return NDArray(jax.device_put(self._data, ctx.jax_device))
+
+    as_in_ctx = as_in_context
+
+    def copyto(self, other):
+        if isinstance(other, NDArray):
+            other._data = jax.device_put(self._data, other.context.jax_device)
+            return other
+        return NDArray(self._data, ctx=other)
+
+    def copy(self):
+        return NDArray(jnp.copy(self._data))
+
+    def astype(self, dtype, copy=True):
+        return invoke("Cast", [self], {"dtype": np.dtype(dtype).name})
+
+    def tostype(self, stype):
+        if stype != "default":
+            from . import sparse as _sp
+            return _sp.cast_storage(self, stype)
+        return self
+
+    def asnetype(self):
+        return self
+
+    def detach(self):
+        out = NDArray(self._data)
+        return out
+
+    # -- autograd ----------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        from .. import autograd
+        self.grad = NDArray(jnp.zeros_like(self._data))
+        self._grad_req = grad_req
+        autograd._mark_variable(self)
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # -- python protocol ---------------------------------------------------
+    def __repr__(self):
+        return f"\n{self.asnumpy()!r}\n<NDArray {'x'.join(map(str, self.shape))} @{self.context}>"
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise MXNetError("ambiguous truth value of multi-element NDArray")
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __index__(self):
+        return int(self.asscalar())
+
+    def __hash__(self):
+        return id(self)
+
+    def __getstate__(self):
+        return {"data": self.asnumpy(), "grad_req": self._grad_req}
+
+    def __setstate__(self, state):
+        self._data = jnp.asarray(state["data"])
+        self.grad = None
+        self._grad_req = state.get("grad_req", "null")
+        self._entry = None
+
+    # -- indexing ----------------------------------------------------------
+    def _key(self, key):
+        if isinstance(key, NDArray):
+            return key._data
+        if isinstance(key, tuple):
+            return tuple(k._data if isinstance(k, NDArray) else k for k in key)
+        return key
+
+    def __getitem__(self, key):
+        from .. import autograd
+        key = self._key(key)
+        if autograd.is_recording() and self._entry is not None:
+            return autograd._record_getitem(self, key)
+        out = self._data[key]
+        return NDArray(out)
+
+    def __setitem__(self, key, value):
+        from .. import autograd
+        if autograd.is_recording() and self._entry is not None:
+            raise MXNetError(
+                "in-place assignment to an array in the autograd graph is not "
+                "supported; use masked ops (where/boolean_mask_fill) instead")
+        key = self._key(key)
+        if isinstance(value, NDArray):
+            value = value._data
+        self._data = self._data.at[key].set(value)
+
+    # -- arithmetic --------------------------------------------------------
+    def _binary(self, other, op, scalar_op, rev=False):
+        if isinstance(other, NDArray):
+            a, b = (other, self) if rev else (self, other)
+            return invoke(op, [a, b], {})
+        if isinstance(other, numbers.Number):
+            return invoke(scalar_op, [self], {"scalar": float(other)})
+        return NotImplemented
+
+    def __add__(self, o):
+        return self._binary(o, "broadcast_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "broadcast_sub", "_minus_scalar")
+
+    def __rsub__(self, o):
+        return self._binary(o, "broadcast_sub", "_rminus_scalar", rev=True)
+
+    def __mul__(self, o):
+        return self._binary(o, "broadcast_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, "broadcast_div", "_div_scalar")
+
+    def __rtruediv__(self, o):
+        return self._binary(o, "broadcast_div", "_rdiv_scalar", rev=True)
+
+    def __mod__(self, o):
+        return self._binary(o, "broadcast_mod", "_mod_scalar")
+
+    def __rmod__(self, o):
+        return self._binary(o, "broadcast_mod", "_rmod_scalar", rev=True)
+
+    def __pow__(self, o):
+        return self._binary(o, "broadcast_power", "_power_scalar")
+
+    def __rpow__(self, o):
+        return self._binary(o, "broadcast_power", "_rpower_scalar", rev=True)
+
+    def __neg__(self):
+        return invoke("negative", [self], {})
+
+    def __abs__(self):
+        return invoke("abs", [self], {})
+
+    def __matmul__(self, o):
+        return invoke("dot", [self, o], {})
+
+    def __eq__(self, o):
+        return self._binary(o, "broadcast_equal", "_equal_scalar")
+
+    def __ne__(self, o):
+        return self._binary(o, "broadcast_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, o):
+        return self._binary(o, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._binary(o, "broadcast_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return self._binary(o, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._binary(o, "broadcast_lesser_equal", "_lesser_equal_scalar")
+
+    def _inplace(self, res):
+        self._data = res._data
+        self._entry = res._entry
+        return self
+
+    def __iadd__(self, o):
+        return self._inplace(self + o)
+
+    def __isub__(self, o):
+        return self._inplace(self - o)
+
+    def __imul__(self, o):
+        return self._inplace(self * o)
+
+    def __itruediv__(self, o):
+        return self._inplace(self / o)
+
+    # -- method forms of common ops ---------------------------------------
+    @property
+    def T(self):
+        return invoke("transpose", [self], {})
+
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        shape = kwargs.get("shape", shape)
+        return invoke("Reshape", [self], {"shape": tuple(shape),
+                                          "reverse": kwargs.get("reverse", False)})
+
+    def reshape_like(self, other):
+        return invoke("Reshape", [self], {"shape": other.shape})
+
+
+def _make_method(opname):
+    def method(self, *args, **kwargs):
+        attrs = {k: _canon_attr(v) for k, v in kwargs.items() if v is not None}
+        extra = [a for a in args if isinstance(a, NDArray)]
+        return invoke(opname, [self] + extra, attrs)
+
+    method.__name__ = opname
+    return method
+
+
+for _m in ["abs", "sign", "square", "sqrt", "rsqrt", "exp", "log", "log2",
+           "log10", "log1p", "sin", "cos", "tan", "tanh", "sigmoid", "relu",
+           "sum", "mean", "prod", "max", "min", "norm", "argmax", "argmin",
+           "flatten", "transpose", "expand_dims", "squeeze", "flip", "tile",
+           "repeat", "clip", "take", "pick", "one_hot", "topk", "sort",
+           "argsort", "zeros_like", "ones_like", "swapaxes", "slice_axis",
+           "slice_like", "broadcast_to", "broadcast_like", "diag",
+           "softmax", "log_softmax"]:
+    if not hasattr(NDArray, _m):
+        setattr(NDArray, _m, _make_method(_m))
+
+NDArray.split = _make_method("SliceChannel")
+NDArray.pad = _make_method("Pad")
+NDArray.dot = _make_method("dot")
+NDArray.batch_dot = _make_method("batch_dot")
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
+
+def invoke(op_name, inputs, attrs, out=None):
+    """Imperative op dispatch (MXImperativeInvoke analogue,
+    ref: src/imperative/imperative.cc:87). Unwraps NDArrays, injects RNG keys
+    and the autograd train-mode flag, executes on the PJRT stream, records on
+    the tape when autograd is active."""
+    from .. import autograd
+
+    op = _reg.get(op_name) if isinstance(op_name, str) else op_name
+    attrs = {k: _canon_attr(v) for k, v in attrs.items() if v is not None}
+    if "training" in op._kwarg_names and "training" not in attrs:
+        attrs["training"] = autograd.is_training()
+
+    arrays = []
+    consts = []
+    if op.needs_rng:
+        key = _random.next_key()
+        arrays.append(key)
+        consts.append(key)
+    nd_inputs = []
+    for i in inputs:
+        if i is None:
+            continue
+        if not isinstance(i, NDArray):
+            i = NDArray(i)
+        nd_inputs.append(i)
+        arrays.append(i._data)
+
+    raw = op(*arrays, **attrs)
+    multi = isinstance(raw, (tuple, list))
+    raws = list(raw) if multi else [raw]
+    outs = [NDArray(r) for r in raws]
+
+    if autograd.is_recording():
+        autograd._record_op(op, attrs, nd_inputs, outs, rng_consts=consts)
+
+    engine.on_op_executed(raws)
+
+    if out is not None:
+        targets = out if isinstance(out, (tuple, list)) else [out]
+        for t, o in zip(targets, outs):
+            t._data = o._data
+            t._entry = o._entry
+        return out
+    return tuple(outs) if multi else outs[0]
+
+
+def array(source_array, ctx=None, dtype=None):
+    """Create an NDArray from any array-like (ref: ndarray.py array())."""
+    if isinstance(source_array, NDArray):
+        data = source_array._data
+    elif isinstance(source_array, np.ndarray):
+        data = source_array if dtype is None else source_array.astype(dtype)
+        if dtype is None and data.dtype == np.float64:
+            data = data.astype(np.float32)
+        if dtype is None and data.dtype == np.int64:
+            data = data.astype(np.int32)
+    else:
+        # python lists default to float32, like the reference
+        data = np.asarray(source_array, dtype=dtype or np.float32)
+    out = NDArray(jnp.asarray(data, dtype=dtype and np.dtype(dtype)))
+    if ctx is not None:
+        out = out.as_in_context(ctx)
+    return out
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return invoke("Concat", list(arrays), {"dim": axis})

@@ -1,0 +1,86 @@
+"""mx.nd.random — sampling namespace (ref: python/mxnet/ndarray/random.py)."""
+from __future__ import annotations
+
+from .. import random as _rng
+from .ndarray import NDArray, invoke
+
+seed = _rng.seed
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    return tuple(shape) if isinstance(shape, (tuple, list)) else (shape,)
+
+
+def _sample(op, params_are_nd, nd_args, attrs):
+    return invoke(op, nd_args, attrs)
+
+
+def uniform(low=0, high=1, shape=None, dtype="float32", ctx=None, out=None):
+    if isinstance(low, NDArray):
+        return invoke("_sample_uniform", [low, high],
+                      {"shape": _shape(shape), "dtype": dtype}, out=out)
+    return invoke("_random_uniform", [],
+                  {"low": float(low), "high": float(high),
+                   "shape": _shape(shape), "dtype": dtype}, out=out)
+
+
+def normal(loc=0, scale=1, shape=None, dtype="float32", ctx=None, out=None):
+    if isinstance(loc, NDArray):
+        return invoke("_sample_normal", [loc, scale],
+                      {"shape": _shape(shape), "dtype": dtype}, out=out)
+    return invoke("_random_normal", [],
+                  {"loc": float(loc), "scale": float(scale),
+                   "shape": _shape(shape), "dtype": dtype}, out=out)
+
+
+def randn(*shape, dtype="float32", loc=0, scale=1, ctx=None):
+    return normal(loc, scale, shape, dtype=dtype)
+
+
+def randint(low, high, shape=None, dtype="int32", ctx=None, out=None):
+    return invoke("_random_randint", [],
+                  {"low": int(low), "high": int(high), "shape": _shape(shape),
+                   "dtype": dtype}, out=out)
+
+
+def gamma(alpha=1, beta=1, shape=None, dtype="float32", ctx=None, out=None):
+    return invoke("_random_gamma", [],
+                  {"alpha": float(alpha), "beta": float(beta),
+                   "shape": _shape(shape), "dtype": dtype}, out=out)
+
+
+def exponential(scale=1, shape=None, dtype="float32", ctx=None, out=None):
+    return invoke("_random_exponential", [],
+                  {"lam": 1.0 / float(scale), "shape": _shape(shape),
+                   "dtype": dtype}, out=out)
+
+
+def poisson(lam=1, shape=None, dtype="float32", ctx=None, out=None):
+    return invoke("_random_poisson", [],
+                  {"lam": float(lam), "shape": _shape(shape), "dtype": dtype},
+                  out=out)
+
+
+def negative_binomial(k=1, p=1, shape=None, dtype="float32", ctx=None, out=None):
+    return invoke("_random_negative_binomial", [],
+                  {"k": int(k), "p": float(p), "shape": _shape(shape),
+                   "dtype": dtype}, out=out)
+
+
+def generalized_negative_binomial(mu=1, alpha=1, shape=None, dtype="float32",
+                                  ctx=None, out=None):
+    return invoke("_random_generalized_negative_binomial", [],
+                  {"mu": float(mu), "alpha": float(alpha),
+                   "shape": _shape(shape), "dtype": dtype}, out=out)
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32", out=None):
+    return invoke("_sample_multinomial", [data],
+                  {"shape": _shape(shape), "get_prob": get_prob,
+                   "dtype": dtype}, out=out)
+
+
+def shuffle(data, out=None):
+    return invoke("_shuffle", [data], {}, out=out)

@@ -1,0 +1,58 @@
+"""Generate the mx.nd.* operator API from the op registry.
+
+The reference synthesizes Python functions at import time from
+MXSymbolGetAtomicSymbolInfo metadata (ref: python/mxnet/ndarray/register.py:
+30-60); here the registry is Python so codegen is direct: one wrapper per
+OpDef that routes NDArray arguments to the dispatch layer.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import registry as _reg
+from .ndarray import NDArray, invoke
+
+
+def _is_tensor(v):
+    return isinstance(v, (NDArray, np.ndarray)) or (
+        hasattr(v, "shape") and hasattr(v, "dtype") and not np.isscalar(v)
+    )
+
+
+def make_op_func(op):
+    def op_func(*args, out=None, name=None, **kwargs):
+        inputs = []
+        for a in args:
+            if a is None:
+                inputs.append(None)
+            elif _is_tensor(a):
+                inputs.append(a if isinstance(a, NDArray) else NDArray(a))
+            else:
+                # scalar positional: tolerate (maps onto first free attr slot
+                # only via kwargs in this implementation)
+                raise TypeError(
+                    f"{op.name}: positional argument {a!r} is not an array; "
+                    "pass operator parameters as keyword arguments")
+        # keyword tensor args in signature order after positionals
+        for pname in op.arg_names[len(inputs):]:
+            if pname in kwargs:
+                v = kwargs.pop(pname)
+                inputs.append(v if (v is None or isinstance(v, NDArray)) else NDArray(v))
+        kwargs.pop("num_args", None)
+        # drop any remaining tensor-valued kwargs into inputs (variadic ops)
+        return invoke(op, inputs, kwargs, out=out)
+
+    op_func.__name__ = op.name
+    op_func.__doc__ = (op.fn.__doc__ or "") + f"\n\n(op: {op.name})"
+    return op_func
+
+
+def populate(namespace, symbolic=False, maker=None):
+    """Install one function per registered op into `namespace` (a dict)."""
+    maker = maker or make_op_func
+    seen = {}
+    for name, op in _reg.alias_map().items():
+        if id(op) not in seen:
+            seen[id(op)] = maker(op)
+        namespace[name] = seen[id(op)]
+    return namespace

@@ -1,0 +1,211 @@
+"""Sparse NDArray: row_sparse + CSR (ref: python/mxnet/ndarray/sparse.py,
+include/mxnet/ndarray.h:61-82).
+
+TPU has no native sparse compute (SURVEY.md §7 hard part (d)); storage is kept
+genuinely sparse on host/HBM (indices + values), while compute lowers to
+gather/scatter + dense MXU ops with static bounds. The KVStore row_sparse
+push/pull path consumes these directly.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .ndarray import NDArray, array, invoke
+
+__all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
+           "cast_storage", "zeros"]
+
+
+class BaseSparseNDArray(NDArray):
+    __slots__ = ()
+
+
+class RowSparseNDArray:
+    """data: (nnz_rows, *row_shape); indices: (nnz_rows,) sorted unique."""
+
+    stype = "row_sparse"
+
+    def __init__(self, data, indices, shape):
+        self.data = data if isinstance(data, NDArray) else array(data)
+        self.indices = (indices if isinstance(indices, NDArray)
+                        else array(np.asarray(indices, dtype=np.int64).astype(np.int32)))
+        self._shape = tuple(shape)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def context(self):
+        return self.data.context
+
+    ctx = context
+
+    def asnumpy(self):
+        return self.tostype("default").asnumpy()
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype != "default":
+            raise MXNetError(f"cannot cast row_sparse to {stype}")
+        dense = jnp.zeros(self._shape, dtype=self.data._data.dtype)
+        dense = dense.at[self.indices._data].set(self.data._data)
+        return NDArray(dense)
+
+    def copyto(self, other):
+        if isinstance(other, RowSparseNDArray):
+            other.data = self.data.copy()
+            other.indices = self.indices.copy()
+            return other
+        return self.tostype("default").copyto(other)
+
+    def wait_to_read(self):
+        self.data.wait_to_read()
+
+    def __repr__(self):
+        return (f"\n<RowSparseNDArray {self._shape} nnz_rows="
+                f"{self.indices.shape[0]}>")
+
+    def retain(self, indices):
+        """Keep only the requested rows (ref: sparse_retain op)."""
+        want = indices._data.astype(jnp.int32) if isinstance(indices, NDArray) \
+            else jnp.asarray(indices, jnp.int32)
+        have = self.indices._data
+        # positions of `want` rows inside stored rows (missing -> zero row)
+        eq = want[:, None] == have[None, :]
+        pos = jnp.argmax(eq, axis=1)
+        found = jnp.any(eq, axis=1)
+        rows = self.data._data[pos]
+        rows = jnp.where(found.reshape((-1,) + (1,) * (rows.ndim - 1)), rows, 0)
+        return RowSparseNDArray(NDArray(rows), NDArray(want), self._shape)
+
+
+class CSRNDArray:
+    stype = "csr"
+
+    def __init__(self, data, indices, indptr, shape):
+        self.data = data if isinstance(data, NDArray) else array(data)
+        self.indices = (indices if isinstance(indices, NDArray)
+                        else array(np.asarray(indices, dtype=np.int64).astype(np.int32)))
+        self.indptr = (indptr if isinstance(indptr, NDArray)
+                       else array(np.asarray(indptr, dtype=np.int64).astype(np.int32)))
+        self._shape = tuple(shape)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def context(self):
+        return self.data.context
+
+    ctx = context
+
+    def asnumpy(self):
+        return self.tostype("default").asnumpy()
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype != "default":
+            raise MXNetError(f"cannot cast csr to {stype}")
+        m, n = self._shape
+        nnz = self.data.shape[0]
+        indptr = self.indptr._data
+        rows = jnp.searchsorted(indptr, jnp.arange(nnz), side="right") - 1
+        dense = jnp.zeros((m, n), dtype=self.data._data.dtype)
+        dense = dense.at[rows, self.indices._data].set(self.data._data)
+        return NDArray(dense)
+
+    def copyto(self, other):
+        return self.tostype("default").copyto(other)
+
+    def wait_to_read(self):
+        self.data.wait_to_read()
+
+    def __repr__(self):
+        return f"\n<CSRNDArray {self._shape} nnz={self.data.shape[0]}>"
+
+
+def row_sparse_array(arg, shape=None, ctx=None, dtype=None):
+    if isinstance(arg, tuple) and len(arg) == 2:
+        data, indices = arg
+        if not isinstance(indices, NDArray):
+            indices = array(np.asarray(indices, dtype=np.int64).astype(np.int32))
+        return RowSparseNDArray(array(data, dtype=dtype), indices, shape)
+    dense = np.asarray(arg.asnumpy() if isinstance(arg, NDArray) else arg,
+                       dtype=dtype or "float32")
+    nz = np.where(np.any(dense.reshape(dense.shape[0], -1) != 0, axis=1))[0]
+    return RowSparseNDArray(array(dense[nz]), array(nz.astype(np.int32)),
+                            dense.shape)
+
+
+def csr_matrix(arg, shape=None, ctx=None, dtype=None):
+    if isinstance(arg, tuple) and len(arg) == 3:
+        data, indices, indptr = arg
+        if not isinstance(indices, NDArray):
+            indices = array(np.asarray(indices, dtype=np.int64).astype(np.int32))
+        if not isinstance(indptr, NDArray):
+            indptr = array(np.asarray(indptr, dtype=np.int64).astype(np.int32))
+        return CSRNDArray(array(data, dtype=dtype), indices, indptr, shape)
+    dense = np.asarray(arg.asnumpy() if isinstance(arg, NDArray) else arg,
+                       dtype=dtype or "float32")
+    m, n = dense.shape
+    indptr = [0]
+    indices, data = [], []
+    for r in range(m):
+        cols = np.where(dense[r] != 0)[0]
+        indices.extend(cols.tolist())
+        data.extend(dense[r, cols].tolist())
+        indptr.append(len(indices))
+    return CSRNDArray(array(np.asarray(data, dtype=dense.dtype)),
+                      array(np.asarray(indices, dtype=np.int32)),
+                      array(np.asarray(indptr, dtype=np.int32)), (m, n))
+
+
+def cast_storage(arr, stype):
+    """dense <-> sparse conversion (ref: src/operator/tensor/cast_storage.cc)."""
+    if stype == "default":
+        return arr.tostype("default") if not isinstance(arr, NDArray) else arr
+    if stype == "row_sparse":
+        return row_sparse_array(arr)
+    if stype == "csr":
+        return csr_matrix(arr)
+    raise MXNetError(f"unknown storage type {stype}")
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    if stype == "row_sparse":
+        width = shape[1:]
+        return RowSparseNDArray(array(np.zeros((0,) + tuple(width))),
+                                array(np.zeros((0,), np.int32)), shape)
+    if stype == "csr":
+        return CSRNDArray(array(np.zeros((0,))), array(np.zeros((0,), np.int32)),
+                          array(np.zeros((shape[0] + 1,), np.int32)), shape)
+    from . import zeros as dzeros
+    return dzeros(shape, ctx=ctx, dtype=dtype)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """csr·dense / rsp·dense dot (ref: src/operator/tensor/dot-inl.h)."""
+    if isinstance(lhs, CSRNDArray):
+        dense = lhs.tostype("default")
+        return invoke("dot", [dense, rhs],
+                      {"transpose_a": transpose_a, "transpose_b": transpose_b})
+    if isinstance(lhs, RowSparseNDArray):
+        dense = lhs.tostype("default")
+        return invoke("dot", [dense, rhs],
+                      {"transpose_a": transpose_a, "transpose_b": transpose_b})
+    return invoke("dot", [lhs, rhs],
+                  {"transpose_a": transpose_a, "transpose_b": transpose_b})

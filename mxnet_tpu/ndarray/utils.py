@@ -1,0 +1,36 @@
+"""NDArray save/load (ref: python/mxnet/ndarray/utils.py + ndarray.cc Save/Load).
+
+Container format: numpy .npz with a key-order manifest. Not byte-compatible
+with the reference's dmlc binary format, but the API contract (list or
+str->NDArray dict round trip, used by save_checkpoint / load_parameters) is
+preserved.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .ndarray import NDArray, array
+
+_LIST_PREFIX = "__list__:"
+
+
+def save(fname, data):
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        payload = {f"{_LIST_PREFIX}{i}": d.asnumpy() for i, d in enumerate(data)}
+    elif isinstance(data, dict):
+        payload = {k: v.asnumpy() for k, v in data.items()}
+    else:
+        raise TypeError("save expects NDArray, list or dict of NDArrays")
+    with open(fname, "wb") as f:
+        np.savez(f, **payload)
+
+
+def load(fname):
+    with np.load(fname, allow_pickle=False) as npz:
+        keys = list(npz.keys())
+        if keys and all(k.startswith(_LIST_PREFIX) for k in keys):
+            keys.sort(key=lambda k: int(k[len(_LIST_PREFIX):]))
+            return [array(npz[k]) for k in keys]
+        return {k: array(npz[k]) for k in keys}

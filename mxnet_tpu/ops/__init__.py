@@ -1,0 +1,16 @@
+"""Operator library package — importing this module registers all ops.
+
+Structure mirrors the reference's src/operator/ split (§2.2 of SURVEY.md):
+elemwise/tensor/nn/random/linalg now; contrib (detection), quantized and RNN
+families register from their own modules.
+"""
+from . import registry
+from .registry import OpDef, register, register_op, get, find, list_ops, infer_output
+from . import elemwise  # noqa: F401
+from . import tensor  # noqa: F401
+from . import nn  # noqa: F401
+from . import random_ops  # noqa: F401
+from . import linalg  # noqa: F401
+from . import rnn  # noqa: F401
+from . import contrib  # noqa: F401
+from . import quantized  # noqa: F401

@@ -1,0 +1,172 @@
+"""Contrib ops — detection, transformer helpers, misc.
+
+Mirrors src/operator/contrib/. Detection ops (box_nms, MultiBox*, ROIAlign,
+Proposal) are the data-dependent-shape hard cases flagged in SURVEY.md §7(c):
+on TPU they are expressed with *bounded static shapes* — NMS keeps the full
+candidate set and masks suppressed entries instead of compacting, which is the
+standard XLA-friendly formulation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+@register("_contrib_div_sqrt_dim")
+def div_sqrt_dim(data):
+    return data / jnp.sqrt(jnp.float32(data.shape[-1])).astype(data.dtype)
+
+
+@register("_contrib_index_copy")
+def index_copy(old, index, new_tensor):
+    return old.at[index.astype(jnp.int32)].set(new_tensor)
+
+
+@register("_contrib_quadratic", aliases=("quadratic",))
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    # the reference's tutorial op (src/operator/contrib/quadratic_op.cc)
+    return a * data * data + b * data + c
+
+
+def _box_iou_corner(b1, b2):
+    """IoU between (..., N, 4) and (..., M, 4) corner boxes."""
+    tl = jnp.maximum(b1[..., :, None, :2], b2[..., None, :, :2])
+    br = jnp.minimum(b1[..., :, None, 2:4], b2[..., None, :, 2:4])
+    wh = jnp.maximum(br - tl, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    a1 = jnp.maximum(b1[..., 2] - b1[..., 0], 0) * jnp.maximum(b1[..., 3] - b1[..., 1], 0)
+    a2 = jnp.maximum(b2[..., 2] - b2[..., 0], 0) * jnp.maximum(b2[..., 3] - b2[..., 1], 0)
+    return inter / jnp.maximum(a1[..., :, None] + a2[..., None, :] - inter, 1e-12)
+
+
+@register("_contrib_box_iou", aliases=("box_iou",))
+def box_iou(lhs, rhs, format="corner"):
+    if format == "center":
+        def c2c(b):
+            xy = b[..., :2]
+            wh = b[..., 2:4] / 2
+            return jnp.concatenate([xy - wh, xy + wh], axis=-1)
+        lhs, rhs = c2c(lhs), c2c(rhs)
+    return _box_iou_corner(lhs, rhs)
+
+
+@register("_contrib_box_nms", aliases=("box_nms", "_contrib_nms"), wrap_jit=True)
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1, coord_start=2,
+            score_index=1, id_index=-1, background_id=-1, force_suppress=False,
+            in_format="corner", out_format="corner"):
+    """Static-shape NMS: output has the input's shape; suppressed boxes get
+    score -1 (the reference's convention for pruned entries)."""
+
+    def one(batch):
+        scores = batch[:, score_index]
+        boxes = batch[:, coord_start:coord_start + 4]
+        if in_format == "center":
+            xy, wh = boxes[:, :2], boxes[:, 2:4] / 2
+            boxes = jnp.concatenate([xy - wh, xy + wh], axis=-1)
+        valid = scores > valid_thresh
+        if id_index >= 0 and background_id >= 0:
+            valid &= batch[:, id_index] != background_id
+        order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+        sboxes = boxes[order]
+        sscores = scores[order]
+        svalid = valid[order]
+        n = sboxes.shape[0]
+        if topk > 0:
+            # reference semantics: only the topk highest-scoring candidates
+            # participate in suppression at all (bounding_box-inl.h)
+            svalid &= jnp.arange(n) < topk
+        iou = _box_iou_corner(sboxes, sboxes)
+        if not force_suppress and id_index >= 0:
+            ids = batch[order, id_index]
+            same = ids[:, None] == ids[None, :]
+            iou = jnp.where(same, iou, 0.0)
+
+        def body(i, keep):
+            live = keep[i] & svalid[i]
+            sup = (iou[i] > overlap_thresh) & (jnp.arange(n) > i) & live
+            return jnp.where(sup, False, keep)
+
+        keep = lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+        keep &= svalid
+        out = batch[order]
+        out = out.at[:, score_index].set(jnp.where(keep, sscores, -1.0))
+        return out
+
+    shape = data.shape
+    flat = data.reshape((-1,) + shape[-2:])
+    out = jax.vmap(one)(flat)
+    return out.reshape(shape)
+
+
+@register("ROIPooling")
+def roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0):
+    ph, pw = pooled_size
+    N, C, H, W = data.shape
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = (jnp.round(roi[1:5] * spatial_scale)).astype(jnp.int32)
+        img = data[jnp.clip(bidx, 0, N - 1)]
+        h = jnp.maximum(y2 - y1 + 1, 1)
+        w = jnp.maximum(x2 - x1 + 1, 1)
+        ys = jnp.arange(H)
+        xs = jnp.arange(W)
+        out = jnp.full((C, ph, pw), -jnp.inf, data.dtype)
+        for py in range(ph):
+            for px in range(pw):
+                ys0 = y1 + (py * h) // ph
+                ys1 = y1 + ((py + 1) * h + ph - 1) // ph
+                xs0 = x1 + (px * w) // pw
+                xs1 = x1 + ((px + 1) * w + pw - 1) // pw
+                m = ((ys >= ys0) & (ys < jnp.maximum(ys1, ys0 + 1)))[:, None] & \
+                    ((xs >= xs0) & (xs < jnp.maximum(xs1, xs0 + 1)))[None, :]
+                v = jnp.max(jnp.where(m[None], img, -jnp.inf), axis=(1, 2))
+                out = out.at[:, py, px].set(v)
+        return out
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("_contrib_ROIAlign")
+def roi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
+              sample_ratio=2, position_sensitive=False, aligned=False):
+    ph, pw = pooled_size
+    N, C, H, W = data.shape
+    sr = max(int(sample_ratio), 1)
+
+    def bilinear(img, y, x):
+        y0 = jnp.clip(jnp.floor(y), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(x), 0, W - 1)
+        y1 = jnp.clip(y0 + 1, 0, H - 1)
+        x1 = jnp.clip(x0 + 1, 0, W - 1)
+        ly, lx = y - y0, x - x0
+        y0i, x0i, y1i, x1i = (a.astype(jnp.int32) for a in (y0, x0, y1, x1))
+        v = (img[:, y0i, x0i] * (1 - ly) * (1 - lx)
+             + img[:, y0i, x1i] * (1 - ly) * lx
+             + img[:, y1i, x0i] * ly * (1 - lx)
+             + img[:, y1i, x1i] * ly * lx)
+        return v
+
+    off = 0.5 if aligned else 0.0
+
+    def one_roi(roi):
+        bidx = jnp.clip(roi[0].astype(jnp.int32), 0, N - 1)
+        img = data[bidx]
+        x1, y1, x2, y2 = roi[1] * spatial_scale - off, roi[2] * spatial_scale - off, \
+            roi[3] * spatial_scale - off, roi[4] * spatial_scale - off
+        rh = jnp.maximum(y2 - y1, 1e-6) / ph
+        rw = jnp.maximum(x2 - x1, 1e-6) / pw
+        py = jnp.arange(ph)[:, None, None, None]
+        px = jnp.arange(pw)[None, :, None, None]
+        iy = jnp.arange(sr)[None, None, :, None]
+        ix = jnp.arange(sr)[None, None, None, :]
+        ys = y1 + (py + (iy + 0.5) / sr) * rh
+        xs = x1 + (px + (ix + 0.5) / sr) * rw
+        vals = bilinear(img, ys.reshape(-1), xs.reshape(-1))
+        vals = vals.reshape(C, ph, pw, sr * sr)
+        return jnp.mean(vals, axis=-1)
+
+    return jax.vmap(one_roi)(rois)

@@ -1,0 +1,198 @@
+"""Elementwise unary / binary / scalar operator families.
+
+Mirrors the reference op surface in src/operator/tensor/elemwise_unary_op*.cc,
+elemwise_binary_op*.cc, elemwise_binary_broadcast_op*.cc and
+elemwise_binary_scalar_op*.cc (MXNet op names preserved). Each op is one jnp
+expression — XLA fuses chains of these into single VPU kernels, which is the
+TPU-native replacement for the reference's mshadow Kernel<Op,xpu>::Launch +
+operator-tuning machinery (src/operator/operator_tune.cc): no per-op tuning is
+needed when the compiler does the fusion.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import jax.scipy.special as jsp
+from jax import lax
+
+from .registry import register, register_op
+
+_F32EPS = 1e-20
+
+
+# ---------------------------------------------------------------------------
+# unary math family (ref: elemwise_unary_op_basic.cc / _trig.cc / _logexp.cc)
+# ---------------------------------------------------------------------------
+_UNARY = {
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "rint": jnp.rint,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "trunc": jnp.trunc,
+    "fix": jnp.trunc,
+    "square": jnp.square,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: lax.rsqrt(x),
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log2": jnp.log2,
+    "log1p": jnp.log1p,
+    "expm1": jnp.expm1,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+    "sigmoid": lambda x: jnp.reciprocal(1.0 + jnp.exp(-x)),
+    "softsign": lambda x: x / (1.0 + jnp.abs(x)),
+    "relu": lambda x: jnp.maximum(x, 0),
+    "erf": jsp.erf,
+    "erfinv": jsp.erfinv,
+    "gamma": lambda x: jnp.exp(jsp.gammaln(x)),
+    "gammaln": jsp.gammaln,
+    "reciprocal": jnp.reciprocal,
+    "negative": jnp.negative,
+    "logical_not": lambda x: (x == 0).astype(x.dtype),
+    "identity": lambda x: x,
+}
+
+for _name, _fn in _UNARY.items():
+    _aliases = ("_copy",) if _name == "identity" else ()
+    register_op(_name, (lambda f: lambda data: f(data))(_fn), aliases=_aliases)
+
+
+@register("zeros_like")
+def zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@register("ones_like")
+def ones_like(data):
+    return jnp.ones_like(data)
+
+
+@register("BlockGrad", aliases=("stop_gradient",))
+def block_grad(data):
+    return lax.stop_gradient(data)
+
+
+@register("make_loss")
+def make_loss(data):
+    return data
+
+
+# ---------------------------------------------------------------------------
+# binary (same-shape) + broadcast family
+# (ref: elemwise_binary_op_basic.cc, elemwise_binary_broadcast_op_*.cc)
+# ---------------------------------------------------------------------------
+_BINARY = {
+    "add": jnp.add,
+    "sub": jnp.subtract,
+    "mul": jnp.multiply,
+    "div": jnp.divide,
+    "mod": jnp.mod,
+    "power": jnp.power,
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    "hypot": jnp.hypot,
+    "equal": lambda a, b: (a == b),
+    "not_equal": lambda a, b: (a != b),
+    "greater": lambda a, b: (a > b),
+    "greater_equal": lambda a, b: (a >= b),
+    "lesser": lambda a, b: (a < b),
+    "lesser_equal": lambda a, b: (a <= b),
+    "logical_and": lambda a, b: (a.astype(bool) & b.astype(bool)),
+    "logical_or": lambda a, b: (a.astype(bool) | b.astype(bool)),
+    "logical_xor": lambda a, b: (a.astype(bool) ^ b.astype(bool)),
+}
+
+_BOOLEAN = {"equal", "not_equal", "greater", "greater_equal", "lesser",
+            "lesser_equal", "logical_and", "logical_or", "logical_xor"}
+
+
+def _as_f(name, fn):
+    if name in _BOOLEAN:
+        return lambda lhs, rhs: fn(lhs, rhs).astype(jnp.result_type(lhs))
+    return fn
+
+
+for _name, _fn in _BINARY.items():
+    _f = _as_f(_name, _fn)
+    register_op("broadcast_" + _name, (lambda f: lambda lhs, rhs: f(lhs, rhs))(_f))
+    if _name in ("add", "sub", "mul", "div", "mod"):
+        register_op(
+            "elemwise_" + _name,
+            (lambda f: lambda lhs, rhs: f(lhs, rhs))(_f),
+            aliases=("_" + _name,) if _name != "mod" else (),
+        )
+
+register_op("_equal", lambda lhs, rhs: _as_f("equal", _BINARY["equal"])(lhs, rhs))
+register_op("_maximum", lambda lhs, rhs: jnp.maximum(lhs, rhs))
+register_op("_minimum", lambda lhs, rhs: jnp.minimum(lhs, rhs))
+register_op("_power", lambda lhs, rhs: jnp.power(lhs, rhs))
+register_op("_hypot", lambda lhs, rhs: jnp.hypot(lhs, rhs))
+
+
+@register("elemwise_sum", aliases=("add_n", "ElementWiseSum"), num_inputs=None)
+def elemwise_sum(*args):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+# ---------------------------------------------------------------------------
+# scalar family (ref: elemwise_binary_scalar_op_basic.cc etc.)
+# scalar attr is static -> folded into the compiled kernel.
+# ---------------------------------------------------------------------------
+_SCALAR = {
+    "_plus_scalar": lambda x, s: x + s,
+    "_minus_scalar": lambda x, s: x - s,
+    "_rminus_scalar": lambda x, s: s - x,
+    "_mul_scalar": lambda x, s: x * s,
+    "_div_scalar": lambda x, s: x / s,
+    "_rdiv_scalar": lambda x, s: s / x,
+    "_mod_scalar": lambda x, s: jnp.mod(x, s),
+    "_rmod_scalar": lambda x, s: jnp.mod(jnp.full_like(x, s), x),
+    "_power_scalar": lambda x, s: jnp.power(x, s),
+    "_rpower_scalar": lambda x, s: jnp.power(s, x),
+    "_maximum_scalar": lambda x, s: jnp.maximum(x, s),
+    "_minimum_scalar": lambda x, s: jnp.minimum(x, s),
+    "_hypot_scalar": lambda x, s: jnp.hypot(x, jnp.asarray(s, x.dtype)),
+    "_equal_scalar": lambda x, s: (x == s).astype(x.dtype),
+    "_not_equal_scalar": lambda x, s: (x != s).astype(x.dtype),
+    "_greater_scalar": lambda x, s: (x > s).astype(x.dtype),
+    "_greater_equal_scalar": lambda x, s: (x >= s).astype(x.dtype),
+    "_lesser_scalar": lambda x, s: (x < s).astype(x.dtype),
+    "_lesser_equal_scalar": lambda x, s: (x <= s).astype(x.dtype),
+    "_logical_and_scalar": lambda x, s: (x.astype(bool) & bool(s)).astype(x.dtype),
+    "_logical_or_scalar": lambda x, s: (x.astype(bool) | bool(s)).astype(x.dtype),
+    "_logical_xor_scalar": lambda x, s: (x.astype(bool) ^ bool(s)).astype(x.dtype),
+}
+
+for _name, _fn in _SCALAR.items():
+    register_op(_name, (lambda f: lambda data, scalar=1.0: f(data, scalar))(_fn))
+
+
+@register("clip")
+def clip(data, a_min=0.0, a_max=1.0):
+    return jnp.clip(data, a_min, a_max)
+
+
+@register("smooth_l1")
+def smooth_l1(data, scalar=1.0):
+    s2 = scalar * scalar
+    absx = jnp.abs(data)
+    return jnp.where(absx < 1.0 / s2, 0.5 * s2 * data * data, absx - 0.5 / s2)

@@ -1,0 +1,117 @@
+"""Linear-algebra operator family (ref: src/operator/tensor/la_op.cc).
+
+The reference shims cuBLAS/LAPACK (src/operator/c_lapack_api.h); here each op
+is the corresponding jax.numpy / jax.scipy primitive, which XLA lowers to MXU
+matmuls or host LAPACK as appropriate.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import jax.scipy.linalg as jsl
+
+from .registry import register
+
+
+def _t(x, do):
+    return jnp.swapaxes(x, -1, -2) if do else x
+
+
+@register("_linalg_gemm", aliases=("linalg_gemm",))
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0, axis=-2):
+    return alpha * jnp.matmul(_t(A, transpose_a), _t(B, transpose_b)) + beta * C
+
+
+@register("_linalg_gemm2", aliases=("linalg_gemm2",))
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0,
+                 axis=-2):
+    return alpha * jnp.matmul(_t(A, transpose_a), _t(B, transpose_b))
+
+
+@register("_linalg_potrf", aliases=("linalg_potrf",))
+def linalg_potrf(A):
+    return jnp.linalg.cholesky(A)
+
+
+@register("_linalg_potri", aliases=("linalg_potri",))
+def linalg_potri(A):
+    # inverse of X where A = potrf(X): inv = L^-T L^-1
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    linv = jsl.solve_triangular(A, eye, lower=True)
+    return jnp.matmul(_t(linv, True), linv)
+
+
+@register("_linalg_trmm", aliases=("linalg_trmm",))
+def linalg_trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    a = _t(A, transpose)
+    return alpha * (jnp.matmul(B, a) if rightside else jnp.matmul(a, B))
+
+
+@register("_linalg_trsm", aliases=("linalg_trsm",))
+def linalg_trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    if rightside:
+        # X A = alpha B  ->  A^T X^T = alpha B^T
+        x = jsl.solve_triangular(_t(A, not transpose), _t(B, True),
+                                 lower=(lower != transpose))
+        return alpha * _t(x, True)
+    return alpha * jsl.solve_triangular(_t(A, transpose), B,
+                                        lower=(lower != transpose))
+
+
+@register("_linalg_sumlogdiag", aliases=("linalg_sumlogdiag",))
+def linalg_sumlogdiag(A):
+    d = jnp.diagonal(A, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(d), axis=-1)
+
+
+@register("_linalg_syrk", aliases=("linalg_syrk",))
+def linalg_syrk(A, transpose=False, alpha=1.0):
+    a = _t(A, transpose)
+    return alpha * jnp.matmul(a, _t(a, True))
+
+
+@register("_linalg_extractdiag", aliases=("linalg_extractdiag",))
+def linalg_extractdiag(A, offset=0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("_linalg_makediag", aliases=("linalg_makediag",))
+def linalg_makediag(A, offset=0):
+    n = A.shape[-1] + abs(offset)
+    out = jnp.zeros(A.shape[:-1] + (n, n), dtype=A.dtype)
+    idx = jnp.arange(A.shape[-1])
+    r = idx + max(-offset, 0)
+    c = idx + max(offset, 0)
+    return out.at[..., r, c].set(A)
+
+
+@register("_linalg_extracttrian", aliases=("linalg_extracttrian",))
+def linalg_extracttrian(A, offset=0, lower=True):
+    n = A.shape[-1]
+    rows, cols = jnp.tril_indices(n, k=offset) if lower else jnp.triu_indices(n, k=offset)
+    return A[..., rows, cols]
+
+
+@register("_linalg_inverse", aliases=("linalg_inverse",))
+def linalg_inverse(A):
+    return jnp.linalg.inv(A)
+
+
+@register("_linalg_det", aliases=("linalg_det",))
+def linalg_det(A):
+    return jnp.linalg.det(A)
+
+
+@register("_linalg_slogdet", aliases=("linalg_slogdet",))
+def linalg_slogdet(A):
+    sign, logdet = jnp.linalg.slogdet(A)
+    return sign, logdet
+
+
+@register("khatri_rao", num_inputs=None)
+def khatri_rao(*args):
+    # column-wise Kronecker product: (n, k) x (m, k) -> (n*m, k)
+    out = args[0]
+    for b in args[1:]:
+        out = (out[:, None, :] * b[None, :, :]).reshape(-1, out.shape[-1])
+    return out

@@ -1,0 +1,152 @@
+"""INT8 quantization ops (ref: src/operator/quantization/).
+
+The reference's int8 path targets MKL-DNN/cuDNN int8 primitives; here
+quantized compute lowers to lax.dot_general / conv_general_dilated with int8
+inputs and ``preferred_element_type=int32`` — the MXU's native int8 mode on
+TPU. Scale bookkeeping (min/max range propagation, requantize int32->int8)
+follows quantization_utils.h.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+INT8_RANGE = 127.0
+INT32_RANGE = float(2 ** 31 - 1)
+
+
+def _range_scale(mn, mx):
+    amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+    return jnp.where(amax > 0, INT8_RANGE / amax, 1.0), amax
+
+
+@register("_contrib_quantize_v2")
+def quantize_v2(data, out_type="int8", min_calib_range=None,
+                max_calib_range=None):
+    if min_calib_range is not None:
+        mn = jnp.asarray(min_calib_range, jnp.float32)
+        mx = jnp.asarray(max_calib_range, jnp.float32)
+    else:
+        mn = jnp.min(data).astype(jnp.float32)
+        mx = jnp.max(data).astype(jnp.float32)
+    scale, amax = _range_scale(mn, mx)
+    q = jnp.clip(jnp.rint(data * scale), -INT8_RANGE, INT8_RANGE).astype(jnp.int8)
+    return q, -amax, amax
+
+
+@register("_contrib_quantize")
+def quantize(data, min_range, max_range, out_type="int8"):
+    scale, amax = _range_scale(min_range, max_range)
+    q = jnp.clip(jnp.rint(data * scale), -INT8_RANGE, INT8_RANGE).astype(jnp.int8)
+    return q, -amax, amax
+
+
+@register("_contrib_dequantize")
+def dequantize(data, min_range, max_range, out_type="float32"):
+    _, amax = _range_scale(min_range, max_range)
+    return data.astype(jnp.float32) * (amax / INT8_RANGE)
+
+
+@register("_contrib_requantize")
+def requantize(data, min_range, max_range, min_calib_range=None,
+               max_calib_range=None):
+    """int32 accumulators -> int8 (ref: requantize-inl.h)."""
+    real_range = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    in_scale = real_range / INT32_RANGE  # fp value of one int32 ulp
+    if min_calib_range is not None:
+        out_max = jnp.maximum(abs(min_calib_range), abs(max_calib_range))
+    else:
+        data_absmax = jnp.max(jnp.abs(data)).astype(jnp.float32)
+        out_max = data_absmax * in_scale
+    out_scale = INT8_RANGE / jnp.maximum(out_max, 1e-30)
+    q = jnp.clip(jnp.rint(data.astype(jnp.float32) * in_scale * out_scale),
+                 -INT8_RANGE, INT8_RANGE).astype(jnp.int8)
+    return q, -out_max, out_max
+
+
+@register("_contrib_quantized_fully_connected")
+def quantized_fully_connected(data, weight, bias, min_data, max_data,
+                              min_weight, max_weight, min_bias, max_bias,
+                              num_hidden=0, no_bias=False, flatten=True):
+    x = data.reshape(data.shape[0], -1) if flatten else data
+    acc = lax.dot_general(
+        x, weight, dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    dmax = jnp.maximum(jnp.abs(min_data), jnp.abs(max_data))
+    wmax = jnp.maximum(jnp.abs(min_weight), jnp.abs(max_weight))
+    out_range = dmax * wmax / (INT8_RANGE * INT8_RANGE) * INT32_RANGE
+    if not no_bias and bias is not None:
+        bmax = jnp.maximum(jnp.abs(min_bias), jnp.abs(max_bias))
+        bias_scale = (dmax * wmax / (INT8_RANGE * INT8_RANGE)) / \
+            jnp.maximum(bmax / INT8_RANGE, 1e-30)
+        acc = acc + jnp.rint(bias.astype(jnp.float32) / jnp.maximum(bias_scale, 1e-30)).astype(jnp.int32)
+    return acc, -out_range, out_range
+
+
+@register("_contrib_quantized_conv")
+def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
+                   max_weight, min_bias, max_bias, kernel=(), stride=(),
+                   dilate=(), pad=(), num_filter=0, num_group=1, no_bias=False,
+                   layout="NCHW", workspace=1024, cudnn_tune=None,
+                   cudnn_off=False):
+    nd = len(kernel)
+    stride = tuple(stride) or (1,) * nd
+    dilate = tuple(dilate) or (1,) * nd
+    pad = tuple(pad) or (0,) * nd
+    dnums = {1: ("NCH", "OIH", "NCH"), 2: ("NCHW", "OIHW", "NCHW"),
+             3: ("NCDHW", "OIDHW", "NCDHW")}[nd]
+    acc = lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        window_strides=stride, padding=tuple((p, p) for p in pad),
+        rhs_dilation=dilate, dimension_numbers=dnums,
+        feature_group_count=num_group, preferred_element_type=jnp.int32)
+    dmax = jnp.maximum(jnp.abs(min_data), jnp.abs(max_data))
+    wmax = jnp.maximum(jnp.abs(min_weight), jnp.abs(max_weight))
+    out_range = dmax * wmax / (INT8_RANGE * INT8_RANGE) * INT32_RANGE
+    if not no_bias and bias is not None:
+        bmax = jnp.maximum(jnp.abs(min_bias), jnp.abs(max_bias))
+        bias_scale = (dmax * wmax / (INT8_RANGE * INT8_RANGE)) / \
+            jnp.maximum(bmax / INT8_RANGE, 1e-30)
+        b = jnp.rint(bias.astype(jnp.float32) / jnp.maximum(bias_scale, 1e-30)).astype(jnp.int32)
+        acc = acc + b.reshape((1, -1) + (1,) * nd)
+    return acc, -out_range, out_range
+
+
+@register("_contrib_quantized_pooling")
+def quantized_pooling(data, min_data, max_data, kernel=(), pool_type="max",
+                      stride=(), pad=(), global_pool=False,
+                      pooling_convention="valid", cudnn_off=False,
+                      p_value=2, count_include_pad=True):
+    from .nn import pooling
+    out = pooling(data.astype(jnp.float32), kernel=kernel, pool_type=pool_type,
+                  stride=stride, pad=pad, global_pool=global_pool,
+                  pooling_convention=pooling_convention,
+                  count_include_pad=count_include_pad)
+    return out.astype(data.dtype), min_data, max_data
+
+
+@register("_contrib_quantized_flatten")
+def quantized_flatten(data, min_data, max_data):
+    return data.reshape(data.shape[0], -1), min_data, max_data
+
+
+@register("_contrib_quantized_concat")
+def quantized_concat(*args, dim=1, num_args=None):
+    """Inputs: n data arrays then n (min, max) pairs. Every input is rescaled
+    to the widest range before concatenation (ref: mkldnn_quantized_concat)."""
+    n = len(args) // 3
+    datas = args[:n]
+    mins = [args[n + 2 * i] for i in range(n)]
+    maxs = [args[n + 2 * i + 1] for i in range(n)]
+    amaxs = [jnp.maximum(jnp.abs(a), jnp.abs(b)) for a, b in zip(mins, maxs)]
+    out_max = amaxs[0]
+    for a in amaxs[1:]:
+        out_max = jnp.maximum(out_max, a)
+    scaled = [
+        jnp.clip(jnp.rint(d.astype(jnp.float32) * (a / out_max)),
+                 -INT8_RANGE, INT8_RANGE).astype(jnp.int8)
+        for d, a in zip(datas, amaxs)
+    ]
+    return jnp.concatenate(scaled, axis=dim), -out_max, out_max

@@ -1,0 +1,162 @@
+"""Random sampling + init ops.
+
+Mirrors src/operator/random/sample_op.cc and src/operator/tensor/init_op.cc.
+RNG design: jax's counter-based PRNG replaces the reference's per-device
+mt19937/Philox state arrays (include/mxnet/random_generator.h) — a global
+seedable key chain lives in mxnet_tpu.random; each RNG op receives a fresh
+subkey as its first array argument (recorded on the autograd tape, so replay
+is bit-deterministic).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _dt(dtype):
+    return jnp.dtype(dtype if dtype not in (None, "None") else "float32")
+
+
+# -- init ops ---------------------------------------------------------------
+
+@register("_zeros", aliases=("zeros",))
+def _zeros(shape=(), dtype="float32"):
+    return jnp.zeros(tuple(shape), _dt(dtype))
+
+
+@register("_ones", aliases=("ones",))
+def _ones(shape=(), dtype="float32"):
+    return jnp.ones(tuple(shape), _dt(dtype))
+
+
+@register("_full", aliases=("full",))
+def _full(shape=(), value=0.0, dtype="float32"):
+    return jnp.full(tuple(shape), value, _dt(dtype))
+
+
+@register("_arange", aliases=("arange",))
+def _arange(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32"):
+    out = jnp.arange(start, stop, step, _dt(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("_eye", aliases=("eye",))
+def _eye(N=0, M=0, k=0, dtype="float32"):
+    return jnp.eye(N, M or None, k, dtype=_dt(dtype))
+
+
+# -- samplers ---------------------------------------------------------------
+
+@register("_random_uniform", aliases=("random_uniform", "uniform"), needs_rng=True)
+def random_uniform(key, low=0.0, high=1.0, shape=(), dtype="float32"):
+    return jax.random.uniform(key, tuple(shape), _dt(dtype), low, high)
+
+
+@register("_random_normal", aliases=("random_normal", "normal"), needs_rng=True)
+def random_normal(key, loc=0.0, scale=1.0, shape=(), dtype="float32"):
+    return loc + scale * jax.random.normal(key, tuple(shape), _dt(dtype))
+
+
+@register("_random_gamma", aliases=("random_gamma",), needs_rng=True)
+def random_gamma(key, alpha=1.0, beta=1.0, shape=(), dtype="float32"):
+    return jax.random.gamma(key, alpha, tuple(shape), _dt(dtype)) * beta
+
+
+@register("_random_exponential", aliases=("random_exponential",), needs_rng=True)
+def random_exponential(key, lam=1.0, shape=(), dtype="float32"):
+    return jax.random.exponential(key, tuple(shape), _dt(dtype)) / lam
+
+
+@register("_random_poisson", aliases=("random_poisson",), needs_rng=True)
+def random_poisson(key, lam=1.0, shape=(), dtype="float32"):
+    return jax.random.poisson(key, lam, tuple(shape)).astype(_dt(dtype))
+
+
+@register("_random_randint", aliases=("random_randint",), needs_rng=True)
+def random_randint(key, low=0, high=1, shape=(), dtype="int32"):
+    return jax.random.randint(key, tuple(shape), low, high, _dt(dtype))
+
+
+@register("_random_negative_binomial", aliases=("random_negative_binomial",),
+          needs_rng=True)
+def random_negative_binomial(key, k=1, p=0.5, shape=(), dtype="float32"):
+    # NB(k, p) == Poisson(Gamma(k, (1-p)/p))
+    kg, kp = jax.random.split(key)
+    lam = jax.random.gamma(kg, k, tuple(shape)) * ((1 - p) / p)
+    return jax.random.poisson(kp, lam, tuple(shape)).astype(_dt(dtype))
+
+
+@register("_random_generalized_negative_binomial",
+          aliases=("random_generalized_negative_binomial",), needs_rng=True)
+def random_gnb(key, mu=1.0, alpha=1.0, shape=(), dtype="float32"):
+    kg, kp = jax.random.split(key)
+    if alpha == 0:
+        return jax.random.poisson(kp, mu, tuple(shape)).astype(_dt(dtype))
+    lam = jax.random.gamma(kg, 1.0 / alpha, tuple(shape)) * (alpha * mu)
+    return jax.random.poisson(kp, lam, tuple(shape)).astype(_dt(dtype))
+
+
+# sample_* ops: per-element distribution parameters given as input arrays
+# (ref: src/operator/random/multisample_op.cc)
+
+@register("_sample_uniform", aliases=("sample_uniform",), needs_rng=True)
+def sample_uniform(key, low, high, shape=(), dtype="float32"):
+    s = tuple(low.shape) + tuple(shape)
+    u = jax.random.uniform(key, s, _dt(dtype))
+    ext = u.ndim - low.ndim
+    bl = low.reshape(low.shape + (1,) * ext)
+    bh = high.reshape(high.shape + (1,) * ext)
+    return bl + u * (bh - bl)
+
+
+@register("_sample_normal", aliases=("sample_normal",), needs_rng=True)
+def sample_normal(key, mu, sigma, shape=(), dtype="float32"):
+    s = tuple(mu.shape) + tuple(shape)
+    n = jax.random.normal(key, s, _dt(dtype))
+    ext = n.ndim - mu.ndim
+    return mu.reshape(mu.shape + (1,) * ext) + n * sigma.reshape(sigma.shape + (1,) * ext)
+
+
+@register("_sample_multinomial", aliases=("sample_multinomial",), needs_rng=True)
+def sample_multinomial(key, data, shape=(), get_prob=False, dtype="int32"):
+    n = 1
+    for s in tuple(shape):
+        n *= s
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    idx = jax.random.categorical(key, logits, axis=-1,
+                                 shape=(n,) + data.shape[:-1])
+    idx = jnp.moveaxis(idx, 0, -1)
+    out_shape = data.shape[:-1] + tuple(shape) if shape else data.shape[:-1]
+    idx = idx.reshape(out_shape).astype(_dt(dtype))
+    if get_prob:
+        logp = jnp.log(jnp.maximum(data, 1e-37))
+        picked = jnp.take_along_axis(
+            logp.reshape((-1, logp.shape[-1])),
+            idx.reshape((logp.shape[:-1] and -1 or 1, -1)).astype(jnp.int32).reshape(-1, n if shape else 1),
+            axis=-1,
+        ).reshape(out_shape)
+        return idx, picked
+    return idx
+
+
+@register("_shuffle", aliases=("shuffle",), needs_rng=True)
+def shuffle(key, data):
+    return jax.random.permutation(key, data, axis=0)
+
+
+@register("_sample_unique_zipfian", needs_rng=True)
+def sample_unique_zipfian(key, range_max=1, shape=()):
+    # approximate: log-uniform samples (used by sampled-softmax candidate sampling)
+    n = 1
+    for s in tuple(shape):
+        n *= s
+    u = jax.random.uniform(key, (n,))
+    out = jnp.minimum(
+        jnp.exp(u * jnp.log(float(range_max))).astype(jnp.int32), range_max - 1
+    )
+    cnt = jnp.ones((n,), dtype=jnp.float32)
+    return out.reshape(tuple(shape)), cnt

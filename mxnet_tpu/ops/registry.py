@@ -1,0 +1,152 @@
+"""Operator registry — the nnvm-registry analogue.
+
+The reference registers 232 ops via NNVM_REGISTER_OP with attribute functions
+(FInferShape/FCompute/FGradient..., ref: include/mxnet/op_attr_types.h:198-309)
+and generates the Python API from registry metadata at import
+(ref: python/mxnet/ndarray/register.py:30-60). Here an op is a *pure JAX
+function* over jax.Arrays plus static attrs:
+
+  - shape/dtype inference  -> jax.eval_shape on the same function (one source
+    of truth instead of separate FInferShape/FInferType),
+  - FCompute<cpu>/<gpu>    -> one XLA lowering, jit-cached per (shapes, attrs),
+  - FGradient              -> jax.vjp of the same function,
+  - codegen                -> ``generate_namespace`` builds mx.nd.* / mx.sym.*
+                              functions from this registry.
+
+Ops registered here are therefore device-portable by construction; the MXU/
+fusion work happens inside XLA (and Pallas kernels registered the same way).
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+
+import jax
+
+from ..base import MXNetError
+
+_OPS = {}
+
+
+class OpDef:
+    """A registered operator.
+
+    Parameters
+    ----------
+    name : canonical MXNet-compatible op name (e.g. "FullyConnected").
+    fn : pure function (*arrays, **attrs) -> array | tuple(arrays).
+    num_inputs : int or None (variadic).
+    wrap_jit : whether eager calls go through a cached jax.jit of fn.
+    """
+
+    def __init__(self, name, fn, aliases=(), num_inputs=None, wrap_jit=True,
+                 num_outputs=1, needs_rng=False):
+        self.name = name
+        self.fn = fn
+        self.aliases = tuple(aliases)
+        self.num_inputs = num_inputs
+        self.num_outputs = num_outputs
+        self.wrap_jit = wrap_jit
+        # RNG ops take a fresh jax PRNG key as their first array argument;
+        # the nd-layer injects it and the autograd tape records it so replay
+        # is deterministic (the counter-based analogue of the reference's
+        # per-device Philox states, ref: include/mxnet/random_generator.h).
+        self.needs_rng = needs_rng
+        sig = inspect.signature(fn)
+        params = [p for p in sig.parameters.values() if p.name != "key"]
+        # optional *array* params (default None) vs attrs with None defaults
+        _arrayish = {"bias", "gamma", "state_cell", "sequence_length", "weight"}
+        self.arg_names = tuple(
+            p.name for p in params
+            if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+            and (p.default is p.empty
+                 or (p.default is None and p.name in _arrayish))
+        )
+        self.has_varargs = any(
+            p.kind == p.VAR_POSITIONAL for p in sig.parameters.values())
+        self._kwarg_names = tuple(
+            p.name
+            for p in params
+            if p.kind in (p.KEYWORD_ONLY, p.POSITIONAL_OR_KEYWORD)
+            and p.default is not p.empty
+            and not (p.default is None and p.name in _arrayish)
+        )
+        self._jitted = None
+
+    def __repr__(self):
+        return f"<Op {self.name}>"
+
+    @property
+    def jitted(self):
+        """Cached jit wrapper; attrs are static so each (shape, attr) combo
+        compiles once and replays from the XLA executable cache."""
+        if self._jitted is None:
+            self._jitted = jax.jit(self.fn, static_argnames=self._kwarg_names)
+        return self._jitted
+
+    def __call__(self, *arrays, **attrs):
+        """Eager execute on jax.Arrays (dispatch is async on the PJRT stream —
+        the reference's threaded engine push, done by the runtime)."""
+        if self.wrap_jit:
+            try:
+                return self.jitted(*arrays, **attrs)
+            except TypeError:
+                # unhashable attr (e.g. list) — run un-jitted; jnp internals
+                # still hit the C++ fast path.
+                return self.fn(*arrays, **attrs)
+        return self.fn(*arrays, **attrs)
+
+
+def register_op(name, fn, aliases=(), num_inputs=None, wrap_jit=True,
+                num_outputs=1, needs_rng=False):
+    """Register a pure JAX function as a framework op (plain-function form)."""
+    op = OpDef(name, fn, aliases=aliases, num_inputs=num_inputs,
+               wrap_jit=wrap_jit, num_outputs=num_outputs, needs_rng=needs_rng)
+    for key in (name,) + tuple(aliases):
+        if key in _OPS:
+            raise MXNetError(f"op {key} registered twice")
+        _OPS[key] = op
+    return op
+
+
+def register(name=None, aliases=(), num_inputs=None, wrap_jit=True,
+             num_outputs=1, needs_rng=False):
+    """Decorator form of :func:`register_op`."""
+
+    def deco(fn):
+        register_op(name or fn.__name__, fn, aliases=aliases,
+                    num_inputs=num_inputs, wrap_jit=wrap_jit,
+                    num_outputs=num_outputs, needs_rng=needs_rng)
+        return fn
+
+    return deco
+
+
+def get(name):
+    try:
+        return _OPS[name]
+    except KeyError:
+        raise MXNetError(f"operator {name!r} is not registered") from None
+
+
+def find(name):
+    return _OPS.get(name)
+
+
+def list_ops():
+    return sorted(_OPS)
+
+
+def alias_map():
+    return dict(_OPS)
+
+
+@functools.lru_cache(maxsize=None)
+def infer_output(op_name, in_shapes_dtypes, attrs_items):
+    """Shape/dtype inference via abstract evaluation (FInferShape/FInferType
+    analogue; ref: src/executor/infer_graph_attr_pass.cc) — no FLOPs run."""
+    op = get(op_name)
+    attrs = dict(attrs_items)
+    specs = [jax.ShapeDtypeStruct(s, d) for s, d in in_shapes_dtypes]
+    out = jax.eval_shape(functools.partial(op.fn, **attrs), *specs)
+    return out

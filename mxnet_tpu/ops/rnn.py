@@ -1,0 +1,145 @@
+"""Fused multi-layer (bi)directional RNN op (ref: src/operator/rnn-inl.h:49).
+
+The reference hand-writes CPU kernels and wraps cudnnRNN on GPU. The
+TPU-native lowering is a lax.scan over time per layer/direction — XLA turns
+the per-step cell into a single fused MXU+VPU kernel and the scan into an
+on-device loop, which is the compiler-friendly replacement for cudnn's fused
+RNN. Gate orders match the reference (LSTM: i,f,g,o; GRU: r,z,n) so flattened
+parameter vectors are layout-compatible with gluon.rnn layers.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register
+
+_NGATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def _cell_step(mode, x_proj, h, c, h2h_w, h2h_b):
+    """One timestep given precomputed input projection x_proj."""
+    hp = jnp.dot(h, h2h_w.T) + h2h_b
+    if mode == "rnn_relu":
+        return jnp.maximum(x_proj + hp, 0), c
+    if mode == "rnn_tanh":
+        return jnp.tanh(x_proj + hp), c
+    if mode == "lstm":
+        i, f, g, o = jnp.split(x_proj + hp, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        return o * jnp.tanh(c_new), c_new
+    if mode == "gru":
+        xr, xz, xn = jnp.split(x_proj, 3, axis=-1)
+        hr, hz, hn = jnp.split(hp, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        return (1 - z) * n + z * h, c
+    raise MXNetError(f"RNN mode {mode!r} unsupported")
+
+
+def _layer_scan(mode, seq, h0, c0, i2h_w, i2h_b, h2h_w, h2h_b, reverse):
+    """Run one direction of one layer over the whole sequence.
+
+    The input projection for all timesteps is one big MXU matmul hoisted out
+    of the scan; only the recurrent matmul stays inside the loop.
+    """
+    x_proj = jnp.einsum("tbi,gi->tbg", seq, i2h_w) + i2h_b
+
+    def step(carry, xp):
+        h, c = carry
+        h_new, c_new = _cell_step(mode, xp, h, c, h2h_w, h2h_b)
+        return (h_new, c_new), h_new
+
+    (hT, cT), outs = lax.scan(step, (h0, c0), x_proj, reverse=reverse)
+    if reverse:
+        pass  # lax.scan(reverse=True) already emits outputs in forward order
+    return outs, hT, cT
+
+
+def _unpack_params(params, mode, num_layers, dirs, input_size, state_size):
+    """Slice the flat parameter vector using the reference's layout:
+    all weights (per layer, per direction: i2h then h2h), then all biases."""
+    ng = _NGATES[mode]
+    H = state_size
+    shapes_w, shapes_b = [], []
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else H * dirs
+        for _ in range(dirs):
+            shapes_w.append((ng * H, isz))
+            shapes_w.append((ng * H, H))
+    for _ in range(num_layers * dirs):
+        shapes_b.append((ng * H,))
+        shapes_b.append((ng * H,))
+    ws, pos = [], 0
+    for s in shapes_w + shapes_b:
+        n = 1
+        for d in s:
+            n *= d
+        ws.append(params[pos:pos + n].reshape(s))
+        pos += n
+    nw = len(shapes_w)
+    return ws[:nw], ws[nw:]
+
+
+def rnn_param_size(mode, num_layers, bidirectional, input_size, state_size):
+    ng = _NGATES[mode]
+    dirs = 2 if bidirectional else 1
+    H = state_size
+    total = 0
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else H * dirs
+        total += dirs * ng * H * (isz + H + 2)
+    return total
+
+
+@register("RNN", needs_rng=True)
+def rnn(key, data, parameters, state, state_cell=None, state_size=0,
+        num_layers=1, bidirectional=False, mode="lstm", p=0.0,
+        state_outputs=False, projection_size=None, lstm_state_clip_min=None,
+        lstm_state_clip_max=None, lstm_state_clip_nan=False,
+        use_sequence_length=False, training=False):
+    """data: (T, B, I); state: (L*dirs, B, H); returns output (T, B, H*dirs)
+    (+ final states when state_outputs)."""
+    dirs = 2 if bidirectional else 1
+    H = state_size
+    T, B, I = data.shape
+    weights, biases = _unpack_params(parameters, mode, num_layers, dirs, I, H)
+
+    if state_cell is None:
+        state_cell = jnp.zeros_like(state)
+
+    seq = data
+    hs_out, cs_out = [], []
+    for layer in range(num_layers):
+        outs_dirs = []
+        for d in range(dirs):
+            li = layer * dirs + d
+            i2h_w = weights[2 * li]
+            h2h_w = weights[2 * li + 1]
+            i2h_b = biases[2 * li]
+            h2h_b = biases[2 * li + 1]
+            outs, hT, cT = _layer_scan(
+                mode, seq, state[li], state_cell[li], i2h_w, i2h_b, h2h_w,
+                h2h_b, reverse=(d == 1))
+            if mode == "lstm" and lstm_state_clip_min is not None:
+                cT = jnp.clip(cT, lstm_state_clip_min, lstm_state_clip_max)
+            outs_dirs.append(outs)
+            hs_out.append(hT)
+            cs_out.append(cT)
+        seq = outs_dirs[0] if dirs == 1 else jnp.concatenate(outs_dirs, axis=-1)
+        if training and p > 0 and layer < num_layers - 1:
+            key, sub = jax.random.split(key)
+            mask = jax.random.bernoulli(sub, 1 - p, seq.shape).astype(seq.dtype)
+            seq = seq * mask / (1 - p)
+
+    if state_outputs:
+        hN = jnp.stack(hs_out)
+        if mode == "lstm":
+            return seq, hN, jnp.stack(cs_out)
+        return seq, hN
+    return seq

@@ -1,0 +1,498 @@
+"""Shape / reduction / indexing / linalg-entry ops.
+
+Mirrors src/operator/tensor/{matrix_op,broadcast_reduce_op,indexing_op,
+ordering_op,init_op,dot}*.cc. MXNet semantics preserved (reshape special codes,
+`exclude` reduction axes, `slice` with None-able begin/end, topk variants...)
+but each lowers to one XLA HLO expression; gathers/scatters use XLA
+gather/scatter which tile onto the TPU VPU — there is no scalar-loop fallback.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register
+
+# ---------------------------------------------------------------------------
+# shape manipulation (ref: src/operator/tensor/matrix_op.cc)
+# ---------------------------------------------------------------------------
+
+
+def _infer_reshape(data_shape, target):
+    """MXNet reshape special codes (ref: matrix_op-inl.h InferReshapeShape):
+    0 copy dim; -1 infer; -2 copy rest; -3 merge two dims; -4 split dim."""
+    out = []
+    src = list(data_shape)
+    i = 0  # index into src
+    k = 0  # index into target
+    target = list(target)
+    while k < len(target):
+        t = target[k]
+        if t == 0:
+            out.append(src[i]); i += 1
+        elif t == -1:
+            out.append(-1); i += 1
+        elif t == -2:
+            out.extend(src[i:]); i = len(src)
+        elif t == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif t == -4:
+            d1, d2 = target[k + 1], target[k + 2]
+            cur = src[i]; i += 1
+            if d1 == -1 and d2 == -1:
+                raise MXNetError("reshape -4: both split dims are -1")
+            if d1 == -1:
+                d1 = cur // d2
+            if d2 == -1:
+                d2 = cur // d1
+            out.extend([d1, d2]); k += 2
+        else:
+            out.append(t); i += 1
+        k += 1
+    n_infer = out.count(-1)
+    if n_infer > 1:
+        raise MXNetError("reshape: more than one -1 dim")
+    if n_infer == 1:
+        known = 1
+        for d in out:
+            if d != -1:
+                known *= d
+        total = 1
+        for d in data_shape:
+            total *= d
+        out[out.index(-1)] = total // max(known, 1)
+    return tuple(out)
+
+
+@register("Reshape", aliases=("reshape",))
+def reshape(data, shape=(), reverse=False):
+    tgt = tuple(shape)
+    if reverse:
+        rshape = _infer_reshape(data.shape[::-1], tgt[::-1])
+        return jnp.reshape(data, rshape[::-1])
+    return jnp.reshape(data, _infer_reshape(data.shape, tgt))
+
+
+@register("Flatten", aliases=("flatten",))
+def flatten(data):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register("transpose")
+def transpose(data, axes=()):
+    axes = tuple(axes) or None
+    return jnp.transpose(data, axes)
+
+
+@register("expand_dims")
+def expand_dims(data, axis=0):
+    return jnp.expand_dims(data, axis)
+
+
+@register("squeeze")
+def squeeze(data, axis=None):
+    return jnp.squeeze(data, axis)
+
+
+@register("swapaxes", aliases=("SwapAxis",))
+def swapaxes(data, dim1=0, dim2=0):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+@register("flip", aliases=("reverse",))
+def flip(data, axis=0):
+    ax = axis if isinstance(axis, (tuple, list)) else (axis,)
+    return jnp.flip(data, ax)
+
+
+@register("tile")
+def tile(data, reps=()):
+    return jnp.tile(data, tuple(reps))
+
+
+@register("repeat")
+def repeat(data, repeats=1, axis=None):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@register("Pad", aliases=("pad",))
+def pad(data, mode="constant", pad_width=(), constant_value=0.0):
+    pw = tuple(pad_width)
+    pairs = tuple((pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2))
+    if mode == "constant":
+        return jnp.pad(data, pairs, constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(data, pairs, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(data, pairs, mode="reflect")
+    raise MXNetError(f"pad mode {mode!r} unsupported")
+
+
+@register("slice", aliases=("crop",))
+def slice_op(data, begin=(), end=(), step=()):
+    sl = []
+    step = tuple(step) or (None,) * len(begin)
+    for b, e, s in zip(begin, end, step):
+        sl.append(slice(b, e, s))
+    return data[tuple(sl)]
+
+
+@register("slice_axis")
+def slice_axis(data, axis=0, begin=0, end=None):
+    sl = [slice(None)] * data.ndim
+    sl[axis] = slice(begin, end)
+    return data[tuple(sl)]
+
+
+@register("slice_like")
+def slice_like(data, shape_like, axes=()):
+    axes = tuple(axes) or tuple(range(min(data.ndim, shape_like.ndim)))
+    sl = [slice(None)] * data.ndim
+    for ax in axes:
+        sl[ax] = slice(0, shape_like.shape[ax])
+    return data[tuple(sl)]
+
+
+@register("Concat", aliases=("concat",), num_inputs=None)
+def concat(*args, dim=1, num_args=None):
+    return jnp.concatenate(args, axis=dim)
+
+
+@register("stack", num_inputs=None)
+def stack(*args, axis=0, num_args=None):
+    return jnp.stack(args, axis=axis)
+
+
+@register("SliceChannel", aliases=("split",), num_outputs=0)
+def split(data, num_outputs=1, axis=1, squeeze_axis=False):
+    parts = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if len(parts) > 1 else parts[0]
+
+
+@register("space_to_depth")
+def space_to_depth(data, block_size=1):
+    b, c, h, w = data.shape
+    bs = block_size
+    x = data.reshape(b, c, h // bs, bs, w // bs, bs)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(b, c * bs * bs, h // bs, w // bs)
+
+
+@register("depth_to_space")
+def depth_to_space(data, block_size=1):
+    b, c, h, w = data.shape
+    bs = block_size
+    x = data.reshape(b, bs, bs, c // (bs * bs), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(b, c // (bs * bs), h * bs, w * bs)
+
+
+@register("Cast", aliases=("cast",))
+def cast(data, dtype="float32"):
+    return data.astype(jnp.dtype(dtype))
+
+
+@register("amp_cast")
+def amp_cast(data, dtype="float16"):
+    return data.astype(jnp.dtype(dtype))
+
+
+@register("shape_array")
+def shape_array(data):
+    return jnp.asarray(data.shape, dtype=jnp.int64 if False else jnp.int32)
+
+
+@register("size_array")
+def size_array(data):
+    return jnp.asarray([data.size], dtype=jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# broadcast / reductions (ref: broadcast_reduce_op_value.cc)
+# ---------------------------------------------------------------------------
+
+
+def _norm_axis(axis, ndim, exclude=False):
+    if axis is None or axis == ():
+        ax = tuple(range(ndim))
+    elif isinstance(axis, int):
+        ax = (axis % ndim,)
+    else:
+        ax = tuple(a % ndim for a in axis)
+    if exclude:
+        ax = tuple(i for i in range(ndim) if i not in ax)
+    return ax
+
+
+def _make_reduce(jfn, name):
+    def red(data, axis=None, keepdims=False, exclude=False):
+        ax = _norm_axis(axis, data.ndim, exclude)
+        return jfn(data, axis=ax, keepdims=keepdims)
+
+    red.__name__ = name
+    return red
+
+
+for _n, _f in [("sum", jnp.sum), ("mean", jnp.mean), ("prod", jnp.prod),
+               ("nansum", jnp.nansum), ("nanprod", jnp.nanprod),
+               ("max", jnp.max), ("min", jnp.min)]:
+    register(_n, aliases=("sum_axis",) if _n == "sum" else ())(_make_reduce(_f, _n))
+
+
+@register("norm")
+def norm(data, ord=2, axis=None, keepdims=False):
+    ax = None if axis is None else (axis if isinstance(axis, tuple) else (axis,))
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=ax, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=keepdims))
+
+
+@register("argmax")
+def argmax(data, axis=None, keepdims=False):
+    out = jnp.argmax(data, axis=axis, keepdims=keepdims)
+    return out.astype(jnp.float32)
+
+
+@register("argmin")
+def argmin(data, axis=None, keepdims=False):
+    return jnp.argmin(data, axis=axis, keepdims=keepdims).astype(jnp.float32)
+
+
+@register("argmax_channel")
+def argmax_channel(data):
+    return jnp.argmax(data, axis=1).astype(jnp.float32)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def broadcast_axis(data, axis=(), size=()):
+    axes = axis if isinstance(axis, (tuple, list)) else (axis,)
+    sizes = size if isinstance(size, (tuple, list)) else (size,)
+    shape = list(data.shape)
+    for a, s in zip(axes, sizes):
+        shape[a] = s
+    return jnp.broadcast_to(data, tuple(shape))
+
+
+@register("broadcast_to")
+def broadcast_to(data, shape=()):
+    tgt = tuple(s if s != 0 else d for s, d in zip(shape, data.shape))
+    return jnp.broadcast_to(data, tgt)
+
+
+@register("broadcast_like")
+def broadcast_like(lhs, rhs):
+    return jnp.broadcast_to(lhs, rhs.shape)
+
+
+# ---------------------------------------------------------------------------
+# dot (ref: src/operator/tensor/dot-inl.h) — the MXU entry point
+# ---------------------------------------------------------------------------
+
+
+@register("dot")
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    a = lhs.T if transpose_a else lhs
+    b = rhs.T if transpose_b else rhs
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    # MXNet dot: collapse trailing axes of a with leading axes of b
+    return jnp.tensordot(a, b, axes=1)
+
+
+@register("batch_dot")
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+# ---------------------------------------------------------------------------
+# indexing (ref: src/operator/tensor/indexing_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("take")
+def take(a, indices, axis=0, mode="clip"):
+    idx = indices.astype(jnp.int32)
+    if mode == "wrap":
+        idx = jnp.mod(idx, a.shape[axis])
+    else:
+        idx = jnp.clip(idx, 0, a.shape[axis] - 1)
+    return jnp.take(a, idx, axis=axis)
+
+
+@register("batch_take")
+def batch_take(a, indices):
+    idx = jnp.clip(indices.astype(jnp.int32), 0, a.shape[1] - 1)
+    return jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
+
+
+@register("Embedding")
+def embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
+              sparse_grad=False):
+    idx = jnp.clip(data.astype(jnp.int32), 0, weight.shape[0] - 1)
+    return jnp.take(weight, idx, axis=0)
+
+
+@register("one_hot")
+def one_hot(indices, depth=0, on_value=1.0, off_value=0.0, dtype="float32"):
+    return jax.nn.one_hot(indices.astype(jnp.int32), depth,
+                          dtype=jnp.dtype(dtype)) * (on_value - off_value) + off_value
+
+
+@register("pick")
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    if mode == "wrap":
+        idx = jnp.mod(index.astype(jnp.int32), data.shape[axis])
+    else:
+        idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
+    out = jnp.take_along_axis(data, jnp.expand_dims(idx, axis), axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("where")
+def where(condition, x, y):
+    return jnp.where(condition.astype(bool), x, y)
+
+
+@register("gather_nd")
+def gather_nd(data, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register("scatter_nd")
+def scatter_nd(data, indices, shape=()):
+    idx = tuple(indices.astype(jnp.int32))
+    out = jnp.zeros(tuple(shape), dtype=data.dtype)
+    return out.at[idx].set(data)
+
+
+@register("_scatter_set_nd")
+def scatter_set_nd(lhs, rhs, indices, shape=()):
+    idx = tuple(indices.astype(jnp.int32))
+    return lhs.at[idx].set(rhs)
+
+
+@register("boolean_mask_fill")
+def boolean_mask_fill(data, mask, value=0.0):
+    """Static-shape-friendly masking (TPU replacement for data-dependent
+    boolean_mask, which XLA cannot express with dynamic output shapes)."""
+    return jnp.where(mask.astype(bool), data, value)
+
+
+# ---------------------------------------------------------------------------
+# ordering (ref: src/operator/tensor/ordering_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("sort")
+def sort(data, axis=-1, is_ascend=True):
+    out = jnp.sort(data, axis=axis)
+    return out if is_ascend else jnp.flip(out, axis=axis)
+
+
+@register("argsort")
+def argsort(data, axis=-1, is_ascend=True, dtype="float32"):
+    out = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(jnp.dtype(dtype))
+
+
+@register("topk")
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    src = -data if is_ascend else data
+    if axis != -1 and axis != data.ndim - 1:
+        src = jnp.moveaxis(src, axis, -1)
+    vals, idxs = lax.top_k(src, k)
+    if is_ascend:
+        vals = -vals
+    if axis != -1 and axis != data.ndim - 1:
+        vals = jnp.moveaxis(vals, -1, axis)
+        idxs = jnp.moveaxis(idxs, -1, axis)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idxs.astype(jnp.dtype(dtype))
+    if ret_typ == "mask":
+        mask = jnp.zeros(src.shape, dtype=jnp.dtype(dtype))
+        mask = mask.at[
+            tuple(jnp.indices(idxs.shape)[i] for i in range(idxs.ndim - 1))
+            + (idxs,)
+        ].set(1)
+        if axis != -1 and axis != data.ndim - 1:
+            mask = jnp.moveaxis(mask, -1, axis)
+        return mask
+    return idxs.astype(jnp.dtype(dtype))
+
+
+# ---------------------------------------------------------------------------
+# misc (ref: src/operator/tensor/{init_op,diag_op,histogram}.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("diag")
+def diag(data, k=0):
+    if data.ndim == 1:
+        return jnp.diag(data, k)
+    return jnp.diagonal(data, offset=k, axis1=0, axis2=1)
+
+
+@register("L2Normalization")
+def l2_normalization(data, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        ax = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        ax = (1,)
+    else:  # spatial
+        ax = tuple(range(2, data.ndim))
+    nrm = jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=True) + eps)
+    return data / nrm
+
+
+@register("SequenceMask")
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    maxlen = data.shape[axis]
+    steps = jnp.arange(maxlen)
+    mask = steps[:, None] < sequence_length[None, :].astype(jnp.int32)  # (T, B)
+    if axis == 1:
+        mask = mask.T
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, value)
+
+
+@register("SequenceLast")
+def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        idx = data.shape[axis] - 1
+        return lax.index_in_dim(data, idx, axis=axis, keepdims=False)
+    idx = (sequence_length.astype(jnp.int32) - 1)  # (B,)
+    moved = jnp.moveaxis(data, axis, 0)  # (T, B, ...)
+    return jnp.take_along_axis(
+        moved, idx.reshape((1, -1) + (1,) * (moved.ndim - 2)), axis=0
+    )[0]
+
+
+@register("SequenceReverse")
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    moved = jnp.moveaxis(data, axis, 0)
+    T = moved.shape[0]
+    lens = sequence_length.astype(jnp.int32)  # (B,)
+    t = jnp.arange(T)[:, None]
+    src = jnp.where(t < lens[None, :], lens[None, :] - 1 - t, t)  # (T,B)
+    out = jnp.take_along_axis(
+        moved, src.reshape(src.shape + (1,) * (moved.ndim - 2)), axis=0
+    )
+    return jnp.moveaxis(out, 0, axis)

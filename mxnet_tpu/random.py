@@ -1,0 +1,30 @@
+"""Global RNG key chain (ref: src/common/random_generator + mx.random.seed).
+
+A single seedable key is split per draw. Thread-local so engine-style worker
+threads don't contend; `seed()` matches python/mxnet/random.py's API.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+
+_state = threading.local()
+
+
+def _get_key():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(int(time.time() * 1e6) & 0x7FFFFFFF)
+    return _state.key
+
+
+def seed(seed_state, ctx="all"):
+    """Seed the global generator (ref: python/mxnet/random.py:seed)."""
+    _state.key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    key = _get_key()
+    _state.key, sub = jax.random.split(key)
+    return sub

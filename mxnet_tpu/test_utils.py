@@ -1,0 +1,95 @@
+"""Testing toolkit (ref: python/mxnet/test_utils.py).
+
+Same philosophy as the reference: NumPy is the reference implementation,
+finite differences validate gradients, and `check_consistency` runs the same
+computation on multiple contexts (cpu vs tpu here, cpu vs gpu vs fp16 there).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import autograd
+from .context import cpu, current_context
+from .ndarray import NDArray, array
+
+
+def default_context():
+    return current_context()
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=("a", "b")):
+    a = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, NDArray) else np.asarray(b)
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol,
+                               err_msg=f"{names[0]} != {names[1]}")
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20):
+    a = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, NDArray) else np.asarray(b)
+    return np.allclose(a, b, rtol=rtol, atol=atol)
+
+
+def rand_ndarray(shape, dtype="float32", scale=1.0):
+    return array(np.random.uniform(-scale, scale, shape).astype(dtype))
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=ndim))
+
+
+def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-4):
+    """Finite-difference gradient check of an NDArray->scalar function
+    against autograd (ref: test_utils.py check_numeric_gradient)."""
+    nds = [array(np.asarray(x, dtype=np.float64).astype(np.float32))
+           for x in inputs]
+    for x in nds:
+        x.attach_grad()
+    with autograd.record():
+        out = fn(*nds)
+        if out.size != 1:
+            out = out.sum()
+    out.backward()
+    analytic = [x.grad.asnumpy() for x in nds]
+
+    for i, x in enumerate(nds):
+        base = x.asnumpy().astype(np.float64)
+        num = np.zeros_like(base)
+        it = np.nditer(base, flags=["multi_index"])
+        while not it.finished:
+            idx = it.multi_index
+            for sgn in (+1, -1):
+                pert = base.copy()
+                pert[idx] += sgn * eps
+                vals = [array(pert.astype(np.float32)) if j == i else nds[j]
+                        for j in range(len(nds))]
+                v = fn(*vals)
+                v = v if v.size == 1 else v.sum()
+                num[idx] += sgn * float(v.asscalar())
+            num[idx] /= 2 * eps
+            it.iternext()
+        np.testing.assert_allclose(analytic[i], num, rtol=rtol, atol=atol,
+                                   err_msg=f"gradient mismatch on input {i}")
+
+
+def check_consistency(fn, inputs, ctx_list=None, rtol=1e-4, atol=1e-6):
+    """Run fn on each context and compare outputs pairwise
+    (ref: test_utils.py check_consistency for cpu/gpu)."""
+    from .context import tpu, num_tpus
+
+    if ctx_list is None:
+        ctx_list = [cpu()]
+        if num_tpus():
+            ctx_list.append(tpu())
+    outs = []
+    for ctx in ctx_list:
+        nds = [array(x, ctx=ctx) for x in inputs]
+        o = fn(*nds)
+        outs.append(o.asnumpy())
+    for o in outs[1:]:
+        np.testing.assert_allclose(outs[0], o, rtol=rtol, atol=atol)
+    return outs
+
+
+def simple_forward(sym_or_fn, **inputs):
+    raise NotImplementedError

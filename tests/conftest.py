@@ -1,0 +1,26 @@
+"""Test config: force an 8-device virtual CPU mesh BEFORE jax import.
+
+Mirrors the reference's strategy of testing distributed paths with local
+multi-process "clusters" (SURVEY.md §4): here the mesh is 8 virtual CPU
+devices so sharding/collective code paths compile and run without TPU
+hardware.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # hard override (axon env presets "axon")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_all():
+    """with_seed() analogue (ref: tests/python/unittest/common.py)."""
+    np.random.seed(0)
+    import mxnet_tpu as mx
+    mx.random.seed(0)
+    yield

@@ -1,0 +1,206 @@
+"""Autograd semantics (ref: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_basic_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, [2.0, 4.0, 6.0])
+
+
+def test_chain_and_broadcast():
+    x = nd.array(np.random.randn(3, 4).astype(np.float32))
+    w = nd.array(np.random.randn(4, 2).astype(np.float32))
+    x.attach_grad()
+    w.attach_grad()
+    with autograd.record():
+        y = nd.dot(x, w)
+        z = (nd.relu(y) * 2).sum()
+    z.backward()
+    mask = (x.asnumpy() @ w.asnumpy()) > 0
+    expect_w = x.asnumpy().T @ (2 * mask)
+    assert_almost_equal(w.grad, expect_w, rtol=1e-4, atol=1e-5)
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([10.0, 100.0]))
+    assert_almost_equal(x.grad, [30.0, 300.0])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+    assert_almost_equal(x.grad, [6.0, 12.0])
+
+
+def test_detach_blocks_grad():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+        z = y.detach() * x
+    z.backward()
+    assert_almost_equal(x.grad, [6.0])  # only d(6x)/dx; detached path constant
+
+
+def test_blockgrad_op():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.BlockGrad(x * 3) * x
+    y.backward()
+    assert_almost_equal(x.grad, [6.0])
+
+
+def test_pause_scope():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            c = x * 10  # not recorded
+        z = y + c.detach()
+    z.backward()
+    assert_almost_equal(x.grad, [2.0])
+
+
+def test_is_recording_training():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+
+
+def test_grad_function():
+    x = nd.array([3.0])
+    out = autograd.grad(
+        [_f(x)], [x]) if False else None
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+    g = autograd.grad([y], [x])
+    assert_almost_equal(g[0], [27.0])
+
+
+def _f(x):
+    return x * x
+
+
+def test_second_order_grad():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        g = autograd.grad([y], [x], create_graph=True, retain_graph=True)[0]
+        z = g.sum()
+    z.backward()
+    # d/dx (3x^2) = 6x = 12
+    assert_almost_equal(x.grad, [12.0])
+
+
+def test_getitem_grad():
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    x.attach_grad()
+    with autograd.record():
+        y = (x[0] * 2).sum()
+    y.backward()
+    assert_almost_equal(x.grad, [[2, 2, 2], [0, 0, 0]])
+
+
+def test_dropout_and_rng_determinism():
+    x = nd.ones((100,))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Dropout(x, p=0.5)
+        z = y.sum()
+    z.backward()
+    # grad equals the mask/keep_prob actually drawn in forward
+    yv = None
+    g = x.grad.asnumpy()
+    assert set(np.unique(g)).issubset({0.0, 2.0})
+
+
+def test_multi_output_heads():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y1 = x * 2
+        y2 = x * 3
+    autograd.backward([y1, y2])
+    assert_almost_equal(x.grad, [5.0, 5.0])
+
+
+def test_mark_variables():
+    x = nd.array([1.0, 2.0])
+    g = nd.zeros((2,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, [2.0, 4.0])
+
+
+def test_custom_function():
+    class Square(autograd.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x
+
+        def backward(self, dy):
+            x, = self.saved_tensors
+            return dy * x * 2
+
+    x = nd.array([3.0])
+    x.attach_grad()
+    sq = Square()
+    with autograd.record():
+        y = sq(x)
+    y.backward()
+    assert_almost_equal(x.grad, [6.0])
+
+
+def test_softmax_output_backward():
+    x = nd.array(np.random.randn(4, 3).astype(np.float32))
+    label = nd.array([0, 1, 2, 1])
+    x.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(x, label)
+    out.backward()
+    sm = np.exp(x.asnumpy() - x.asnumpy().max(-1, keepdims=True))
+    sm /= sm.sum(-1, keepdims=True)
+    onehot = np.eye(3, dtype=np.float32)[[0, 1, 2, 1]]
+    assert_almost_equal(x.grad, sm - onehot, rtol=1e-4, atol=1e-5)
+
+
+def test_training_flag_injection():
+    x = nd.ones((50,))
+    with autograd.record(train_mode=True):
+        y = nd.Dropout(x, p=0.9)
+    assert float(y.asnumpy().max()) > 1.5  # dropout active
+    with autograd.record(train_mode=False):
+        y = nd.Dropout(x, p=0.9)
+    assert_almost_equal(y, x.asnumpy())  # identity in predict mode
+    y = nd.Dropout(x, p=0.9)  # outside record: predict mode
+    assert_almost_equal(y, x.asnumpy())

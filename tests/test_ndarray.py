@@ -1,0 +1,153 @@
+"""NDArray semantics tests (ref: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_creation():
+    x = nd.zeros((2, 3))
+    assert x.shape == (2, 3)
+    assert x.dtype == np.float32
+    assert x.asnumpy().sum() == 0
+    y = nd.ones((4,), dtype="int32")
+    assert y.dtype == np.int32
+    z = nd.array([[1, 2], [3, 4]])
+    np.testing.assert_array_equal(z.asnumpy(), [[1, 2], [3, 4]])
+    assert z.dtype == np.float32  # MXNet default dtype
+    f = nd.full((2, 2), 7.0)
+    assert f.asnumpy().ravel().tolist() == [7, 7, 7, 7]
+
+
+def test_arithmetic():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[10.0, 20.0], [30.0, 40.0]])
+    np.testing.assert_allclose((a + b).asnumpy(), [[11, 22], [33, 44]])
+    np.testing.assert_allclose((b - a).asnumpy(), [[9, 18], [27, 36]])
+    np.testing.assert_allclose((a * 2).asnumpy(), [[2, 4], [6, 8]])
+    np.testing.assert_allclose((2 * a).asnumpy(), [[2, 4], [6, 8]])
+    np.testing.assert_allclose((1 / a).asnumpy(), 1 / a.asnumpy())
+    np.testing.assert_allclose((a ** 2).asnumpy(), [[1, 4], [9, 16]])
+    np.testing.assert_allclose((-a).asnumpy(), -a.asnumpy())
+    np.testing.assert_allclose(abs(nd.array([-1.0, 2.0])).asnumpy(), [1, 2])
+
+
+def test_inplace_arithmetic():
+    a = nd.ones((2, 2))
+    a += 1
+    np.testing.assert_allclose(a.asnumpy(), 2 * np.ones((2, 2)))
+    a *= 3
+    np.testing.assert_allclose(a.asnumpy(), 6 * np.ones((2, 2)))
+
+
+def test_comparison():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    np.testing.assert_array_equal((a > b).asnumpy(), [0, 0, 1])
+    np.testing.assert_array_equal((a == 2).asnumpy(), [0, 1, 0])
+    np.testing.assert_array_equal((a <= b).asnumpy(), [1, 1, 0])
+
+
+def test_indexing():
+    a = nd.array(np.arange(24).reshape(2, 3, 4))
+    np.testing.assert_array_equal(a[0].asnumpy(), np.arange(12).reshape(3, 4))
+    np.testing.assert_array_equal(a[1, 2].asnumpy(), [20, 21, 22, 23])
+    np.testing.assert_array_equal(a[:, 1:3].asnumpy(),
+                                  a.asnumpy()[:, 1:3])
+    a[0] = 0
+    assert a.asnumpy()[0].sum() == 0
+    a[1, 0, 0] = 99
+    assert a.asnumpy()[1, 0, 0] == 99
+
+
+def test_reshape_special_codes():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((6, 4)).shape == (6, 4)
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.reshape((-4, 1, 2, -2)).shape == (1, 2, 3, 4)
+    assert a.reshape((2, -4, 3, 1, 4)).shape == (2, 3, 1, 4)
+
+
+def test_scalar_conversion():
+    x = nd.array([3.5])
+    assert x.asscalar() == pytest.approx(3.5)
+    assert float(x) == pytest.approx(3.5)
+    assert int(nd.array([7])) == 7
+    with pytest.raises(mx.MXNetError):
+        nd.zeros((2,)).asscalar()
+
+
+def test_copy_and_context():
+    x = nd.ones((2, 2))
+    y = x.copy()
+    y += 1
+    assert x.asnumpy().sum() == 4
+    z = x.as_in_context(mx.cpu())
+    assert z.context.device_type == "cpu"
+    ctx = mx.tpu()
+    w = x.as_in_context(ctx)
+    assert w.shape == x.shape
+
+
+def test_astype():
+    x = nd.array([1.5, 2.5])
+    y = x.astype("int32")
+    assert y.dtype == np.int32
+    np.testing.assert_array_equal(y.asnumpy(), [1, 2])
+
+
+def test_wait_and_engine():
+    x = nd.ones((8, 8))
+    y = (x * 2).sum()
+    y.wait_to_read()
+    nd.waitall()
+    assert y.asscalar() == 128
+
+
+def test_concat_split_stack():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    parts = nd.split(nd.array(np.arange(12).reshape(4, 3)), 2, axis=0)
+    assert len(parts) == 2 and parts[0].shape == (2, 3)
+    s = nd.stack(a, b, axis=1)
+    assert s.shape == (2, 2, 3)
+
+
+def test_iter_len():
+    x = nd.array(np.arange(6).reshape(3, 2))
+    assert len(x) == 3
+    rows = [r.asnumpy() for r in x]
+    assert len(rows) == 3
+    np.testing.assert_array_equal(rows[1], [2, 3])
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrs")
+    arrs = {"w": nd.ones((2, 2)), "b": nd.zeros((3,))}
+    nd.save(fname, arrs)
+    loaded = nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    np.testing.assert_array_equal(loaded["w"].asnumpy(), np.ones((2, 2)))
+    lst = [nd.ones((1,)), nd.zeros((2,))]
+    nd.save(fname, lst)
+    loaded = nd.load(fname)
+    assert isinstance(loaded, list) and len(loaded) == 2
+
+
+def test_sparse_roundtrip():
+    from mxnet_tpu.ndarray import sparse
+    dense = np.array([[0, 1, 0], [2, 0, 3], [0, 0, 0]], dtype=np.float32)
+    rsp = sparse.row_sparse_array(dense)
+    assert rsp.indices.asnumpy().tolist() == [0, 1]
+    np.testing.assert_array_equal(rsp.asnumpy(), dense)
+    csr = sparse.csr_matrix(dense)
+    np.testing.assert_array_equal(csr.asnumpy(), dense)
+    retained = rsp.retain(nd.array([0, 2]))
+    np.testing.assert_array_equal(
+        retained.asnumpy(), np.array([[0, 1, 0], [0, 0, 0], [0, 0, 0]]))

@@ -1,0 +1,300 @@
+"""Per-op numeric checks against NumPy references
+(ref: tests/python/unittest/test_operator.py's technique)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_unary_math():
+    x = np.random.uniform(0.5, 2.0, (3, 4)).astype(np.float32)
+    a = nd.array(x)
+    assert_almost_equal(nd.sqrt(a), np.sqrt(x), rtol=1e-5)
+    assert_almost_equal(nd.exp(a), np.exp(x), rtol=1e-5)
+    assert_almost_equal(nd.log(a), np.log(x), rtol=1e-5)
+    assert_almost_equal(nd.sigmoid(a), 1 / (1 + np.exp(-x)), rtol=1e-5)
+    assert_almost_equal(nd.relu(nd.array(x - 1)), np.maximum(x - 1, 0))
+    assert_almost_equal(nd.square(a), x * x, rtol=1e-5)
+    assert_almost_equal(nd.rsqrt(a), 1 / np.sqrt(x), rtol=1e-5)
+
+
+def test_broadcast_ops():
+    a = np.random.randn(2, 3, 1).astype(np.float32)
+    b = np.random.randn(1, 3, 4).astype(np.float32)
+    assert_almost_equal(nd.broadcast_add(nd.array(a), nd.array(b)), a + b)
+    assert_almost_equal(nd.broadcast_mul(nd.array(a), nd.array(b)), a * b)
+    assert_almost_equal(nd.broadcast_maximum(nd.array(a), nd.array(b)),
+                        np.maximum(a, b))
+
+
+def test_reductions():
+    x = np.random.randn(2, 3, 4).astype(np.float32)
+    a = nd.array(x)
+    assert_almost_equal(nd.sum(a), x.sum(), rtol=1e-5)
+    assert_almost_equal(nd.sum(a, axis=1), x.sum(axis=1), rtol=1e-5)
+    assert_almost_equal(nd.sum(a, axis=(0, 2), keepdims=True),
+                        x.sum(axis=(0, 2), keepdims=True), rtol=1e-5)
+    assert_almost_equal(nd.mean(a, axis=1, exclude=True),
+                        x.mean(axis=(0, 2)), rtol=1e-5)
+    assert_almost_equal(nd.max(a, axis=2), x.max(axis=2))
+    assert_almost_equal(nd.argmax(a, axis=1), x.argmax(axis=1).astype(np.float32))
+    assert_almost_equal(nd.norm(a), np.sqrt((x * x).sum()), rtol=1e-5)
+
+
+def test_dot():
+    a = np.random.randn(3, 4).astype(np.float32)
+    b = np.random.randn(4, 5).astype(np.float32)
+    assert_almost_equal(nd.dot(nd.array(a), nd.array(b)), a @ b, rtol=1e-5)
+    assert_almost_equal(
+        nd.dot(nd.array(a), nd.array(b.T), transpose_b=True), a @ b, rtol=1e-5)
+    assert_almost_equal(
+        nd.dot(nd.array(a.T), nd.array(b), transpose_a=True), a @ b, rtol=1e-5)
+    x = np.random.randn(2, 3, 4).astype(np.float32)
+    y = np.random.randn(2, 4, 5).astype(np.float32)
+    assert_almost_equal(nd.batch_dot(nd.array(x), nd.array(y)), x @ y, rtol=1e-5)
+
+
+def test_fully_connected():
+    x = np.random.randn(2, 3, 4).astype(np.float32)
+    w = np.random.randn(5, 12).astype(np.float32)
+    b = np.random.randn(5).astype(np.float32)
+    out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b), num_hidden=5)
+    expect = x.reshape(2, -1) @ w.T + b
+    assert_almost_equal(out, expect, rtol=1e-4)
+    out2 = nd.FullyConnected(nd.array(x), nd.array(np.random.randn(5, 4).astype(np.float32)),
+                             no_bias=True, num_hidden=5, flatten=False)
+    assert out2.shape == (2, 3, 5)
+
+
+def _np_conv2d(x, w, stride, pad):
+    n, c, h, wd = x.shape
+    o, _, kh, kw = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (wd + 2 * pad - kw) // stride + 1
+    out = np.zeros((n, o, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride:i * stride + kh, j * stride:j * stride + kw]
+            out[:, :, i, j] = np.tensordot(patch, w, axes=([1, 2, 3], [1, 2, 3]))
+    return out
+
+
+def test_convolution():
+    x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+    w = np.random.randn(4, 3, 3, 3).astype(np.float32)
+    b = np.random.randn(4).astype(np.float32)
+    out = nd.Convolution(nd.array(x), nd.array(w), nd.array(b), kernel=(3, 3),
+                         stride=(2, 2), pad=(1, 1), num_filter=4)
+    expect = _np_conv2d(x, w, 2, 1) + b.reshape(1, -1, 1, 1)
+    assert_almost_equal(out, expect, rtol=1e-3, atol=1e-4)
+
+
+def test_grouped_and_1x1_convolution():
+    x = np.random.randn(1, 4, 5, 5).astype(np.float32)
+    w = np.random.randn(4, 1, 3, 3).astype(np.float32)
+    out = nd.Convolution(nd.array(x), nd.array(w), no_bias=True, kernel=(3, 3),
+                         pad=(1, 1), num_filter=4, num_group=4)
+    assert out.shape == (1, 4, 5, 5)
+    for g in range(4):
+        expect = _np_conv2d(x[:, g:g + 1], w[g:g + 1], 1, 1)
+        assert_almost_equal(out[:, g:g + 1], expect, rtol=1e-3, atol=1e-4)
+
+
+def test_deconvolution_shape():
+    x = np.random.randn(1, 3, 4, 4).astype(np.float32)
+    w = np.random.randn(3, 2, 2, 2).astype(np.float32)
+    out = nd.Deconvolution(nd.array(x), nd.array(w), kernel=(2, 2),
+                           stride=(2, 2), num_filter=2)
+    assert out.shape == (1, 2, 8, 8)
+
+
+def test_pooling():
+    x = np.random.randn(1, 2, 4, 4).astype(np.float32)
+    out = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="max")
+    expect = x.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+    assert_almost_equal(out, expect)
+    out = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    expect = x.reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5))
+    assert_almost_equal(out, expect, rtol=1e-5)
+    gout = nd.Pooling(nd.array(x), global_pool=True, pool_type="max", kernel=(1, 1))
+    assert gout.shape == (1, 2, 1, 1)
+    assert_almost_equal(gout, x.max(axis=(2, 3), keepdims=True))
+
+
+def test_batchnorm_inference():
+    x = np.random.randn(2, 3, 4, 4).astype(np.float32)
+    gamma = np.random.uniform(0.5, 1.5, 3).astype(np.float32)
+    beta = np.random.randn(3).astype(np.float32)
+    mean = np.random.randn(3).astype(np.float32)
+    var = np.random.uniform(0.5, 1.5, 3).astype(np.float32)
+    out = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                       nd.array(mean), nd.array(var), fix_gamma=False,
+                       use_global_stats=True, eps=1e-5)
+    expect = ((x - mean.reshape(1, -1, 1, 1))
+              / np.sqrt(var.reshape(1, -1, 1, 1) + 1e-5)
+              * gamma.reshape(1, -1, 1, 1) + beta.reshape(1, -1, 1, 1))
+    assert_almost_equal(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_softmax():
+    x = np.random.randn(2, 5).astype(np.float32)
+    out = nd.softmax(nd.array(x))
+    e = np.exp(x - x.max(-1, keepdims=True))
+    assert_almost_equal(out, e / e.sum(-1, keepdims=True), rtol=1e-5)
+    lout = nd.log_softmax(nd.array(x))
+    assert_almost_equal(lout, np.log(e / e.sum(-1, keepdims=True)),
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_activation_types():
+    x = np.random.randn(3, 4).astype(np.float32)
+    assert_almost_equal(nd.Activation(nd.array(x), act_type="tanh"), np.tanh(x),
+                        rtol=1e-5, atol=1e-6)
+    assert_almost_equal(nd.LeakyReLU(nd.array(x), act_type="leaky", slope=0.1),
+                        np.where(x >= 0, x, 0.1 * x))
+    elu = nd.LeakyReLU(nd.array(x), act_type="elu", slope=1.0)
+    assert_almost_equal(elu, np.where(x >= 0, x, np.expm1(x)), rtol=1e-5,
+                        atol=1e-6)
+
+
+def test_transpose_slice_ops():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    a = nd.array(x)
+    assert_almost_equal(nd.transpose(a, axes=(2, 0, 1)), x.transpose(2, 0, 1))
+    assert_almost_equal(nd.slice(a, begin=(0, 1), end=(2, 3)), x[0:2, 1:3])
+    assert_almost_equal(nd.slice_axis(a, axis=2, begin=1, end=3), x[:, :, 1:3])
+    assert_almost_equal(nd.flip(a, axis=1), x[:, ::-1, :])
+    assert_almost_equal(nd.tile(a, reps=(1, 2, 1)), np.tile(x, (1, 2, 1)))
+    assert_almost_equal(nd.expand_dims(a, axis=1), x[:, None])
+
+
+def test_take_embedding_onehot():
+    w = np.random.randn(10, 4).astype(np.float32)
+    idx = np.array([1, 3, 5], dtype=np.float32)
+    assert_almost_equal(nd.take(nd.array(w), nd.array(idx)), w[[1, 3, 5]])
+    emb = nd.Embedding(nd.array(idx), nd.array(w), input_dim=10, output_dim=4)
+    assert_almost_equal(emb, w[[1, 3, 5]])
+    oh = nd.one_hot(nd.array(idx), depth=10)
+    assert oh.shape == (3, 10)
+    assert oh.asnumpy()[0, 1] == 1 and oh.asnumpy().sum() == 3
+
+
+def test_ordering():
+    x = np.random.randn(3, 6).astype(np.float32)
+    a = nd.array(x)
+    assert_almost_equal(nd.sort(a), np.sort(x))
+    assert_almost_equal(nd.argsort(a), np.argsort(x).astype(np.float32))
+    tv = nd.topk(a, k=2, ret_typ="value")
+    assert_almost_equal(tv, -np.sort(-x)[:, :2])
+
+
+def test_where_clip():
+    x = np.random.randn(3, 4).astype(np.float32)
+    cond = (x > 0).astype(np.float32)
+    out = nd.where(nd.array(cond), nd.array(x), nd.array(-x))
+    assert_almost_equal(out, np.abs(x))
+    assert_almost_equal(nd.clip(nd.array(x), a_min=-0.5, a_max=0.5),
+                        np.clip(x, -0.5, 0.5))
+
+
+def test_rnn_op_shapes():
+    from mxnet_tpu.ops.rnn import rnn_param_size
+    T, B, I, H, L = 5, 2, 3, 4, 2
+    for mode in ("rnn_tanh", "lstm", "gru"):
+        psize = rnn_param_size(mode, L, True, I, H)
+        params = nd.array(np.random.uniform(-0.1, 0.1, psize).astype(np.float32))
+        state = nd.zeros((L * 2, B, H))
+        x = nd.array(np.random.randn(T, B, I).astype(np.float32))
+        if mode == "lstm":
+            out, hN, cN = nd.RNN(x, params, state, nd.zeros((L * 2, B, H)),
+                                 state_size=H, num_layers=L, bidirectional=True,
+                                 mode=mode, state_outputs=True)
+            assert cN.shape == (L * 2, B, H)
+        else:
+            out, hN = nd.RNN(x, params, state, state_size=H, num_layers=L,
+                             bidirectional=True, mode=mode, state_outputs=True)
+        assert out.shape == (T, B, 2 * H)
+        assert hN.shape == (L * 2, B, H)
+
+
+def test_lstm_against_manual():
+    """Single-layer unidirectional LSTM vs hand-rolled numpy reference."""
+    T, B, I, H = 3, 2, 4, 5
+    from mxnet_tpu.ops.rnn import rnn_param_size
+    psize = rnn_param_size("lstm", 1, False, I, H)
+    rng = np.random.RandomState(1)
+    params = rng.uniform(-0.5, 0.5, psize).astype(np.float32)
+    x = rng.randn(T, B, I).astype(np.float32)
+    i2h_w = params[:4 * H * I].reshape(4 * H, I)
+    h2h_w = params[4 * H * I:4 * H * I + 4 * H * H].reshape(4 * H, H)
+    i2h_b = params[4 * H * (I + H):4 * H * (I + H) + 4 * H]
+    h2h_b = params[4 * H * (I + H) + 4 * H:]
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+
+    h = np.zeros((B, H), np.float32)
+    c = np.zeros((B, H), np.float32)
+    outs = []
+    for t in range(T):
+        pre = x[t] @ i2h_w.T + i2h_b + h @ h2h_w.T + h2h_b
+        i, f, g, o = np.split(pre, 4, axis=1)
+        c = sig(f) * c + sig(i) * np.tanh(g)
+        h = sig(o) * np.tanh(c)
+        outs.append(h)
+    expect = np.stack(outs)
+
+    out = nd.RNN(nd.array(x), nd.array(params), nd.zeros((1, B, H)),
+                 nd.zeros((1, B, H)), state_size=H, num_layers=1, mode="lstm")
+    assert_almost_equal(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_gradient_finite_difference():
+    check_numeric_gradient(lambda x: (x * x).sum(), [np.random.randn(3, 3)])
+    check_numeric_gradient(
+        lambda a, b: nd.dot(a, b).sum(),
+        [np.random.randn(3, 4), np.random.randn(4, 2)])
+    check_numeric_gradient(
+        lambda x: nd.Activation(x, act_type="tanh").sum(),
+        [np.random.randn(4, 4)])
+
+
+def test_norm_ops():
+    x = np.random.randn(2, 3, 8).astype(np.float32)
+    g = np.random.uniform(0.5, 1.5, 8).astype(np.float32)
+    b = np.random.randn(8).astype(np.float32)
+    out = nd.LayerNorm(nd.array(x), nd.array(g), nd.array(b), eps=1e-5)
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    expect = (x - mu) / np.sqrt(var + 1e-5) * g + b
+    assert_almost_equal(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_random_ops_distribution():
+    u = nd.random.uniform(0, 1, shape=(2000,))
+    m = float(u.asnumpy().mean())
+    assert 0.45 < m < 0.55
+    n = nd.random.normal(2.0, 0.5, shape=(2000,))
+    assert 1.9 < float(n.asnumpy().mean()) < 2.1
+    mx.random.seed(42)
+    a = nd.random.uniform(shape=(5,)).asnumpy()
+    mx.random.seed(42)
+    b = nd.random.uniform(shape=(5,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_sequence_ops():
+    x = np.arange(24, dtype=np.float32).reshape(4, 3, 2)  # (T, B, D)
+    slen = np.array([2, 4, 3], dtype=np.float32)
+    out = nd.SequenceMask(nd.array(x), nd.array(slen), use_sequence_length=True,
+                          value=-1.0)
+    expect = x.copy()
+    for b, L in enumerate([2, 4, 3]):
+        expect[L:, b] = -1
+    assert_almost_equal(out, expect)
+    last = nd.SequenceLast(nd.array(x), nd.array(slen), use_sequence_length=True)
+    expect_last = np.stack([x[1, 0], x[3, 1], x[2, 2]])
+    assert_almost_equal(last, expect_last)
