@@ -32,3 +32,13 @@ from . import autograd
 # Subsystem imports are appended as each lands (package layout matches the
 # reference's python/mxnet/__init__.py).
 from . import test_utils  # noqa: E402
+from . import initializer  # noqa: E402
+from . import initializer as init  # noqa: E402
+from . import optimizer  # noqa: E402
+from .optimizer import Optimizer  # noqa: E402
+from . import lr_scheduler  # noqa: E402
+from . import metric  # noqa: E402
+from . import kvstore  # noqa: E402
+from . import kvstore as kv  # noqa: E402
+from . import recordio  # noqa: E402
+from . import gluon  # noqa: E402

@@ -36,6 +36,9 @@ def set_engine_type(name):
 
 def on_op_executed(outputs):
     """Called by the nd dispatch layer after each eager op."""
+    import jax.core
+    if any(isinstance(o, jax.core.Tracer) for o in outputs):
+        return  # inside a jit trace: the compiled step is the engine op
     if _naive:
         for o in outputs:
             jax.block_until_ready(o)

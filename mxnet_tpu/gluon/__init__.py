@@ -1,0 +1,12 @@
+"""Gluon — imperative/hybrid layer API (ref: python/mxnet/gluon/)."""
+from . import parameter
+from .parameter import Parameter, ParameterDict, Constant
+from .block import Block, HybridBlock, SymbolBlock
+from . import nn
+from . import loss
+from .trainer import Trainer
+from . import rnn
+from . import data
+from . import model_zoo
+from . import contrib
+from .utils import split_and_load, split_data, clip_global_norm

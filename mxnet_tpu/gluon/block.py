@@ -1,0 +1,510 @@
+"""Block / HybridBlock — the Gluon layer API (ref: python/mxnet/gluon/block.py).
+
+Eager mode runs hybrid_forward op-by-op on the PJRT stream (the reference's
+imperative engine path). ``hybridize()`` swaps in a CachedOp: the whole
+subtree is traced once into a single jax.jit computation with parameters as
+traced arguments — the TPU-native equivalent of the reference's
+_build_cache -> ndarray.CachedOp(static_alloc) (block.py:748-785), with XLA
+buffer assignment replacing the static memory plan. BatchNorm-style aux-state
+updates are collected during the trace and returned as extra outputs
+(functional state threading instead of in-place mutation).
+"""
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+
+import jax
+
+from .. import autograd
+from .. import ndarray as nd_mod
+from .. import random as _random
+from ..base import MXNetError
+from ..ndarray import NDArray
+from .parameter import (DeferredInitializationError, Parameter, ParameterDict)
+
+_naming = threading.local()
+
+
+class _BlockScope:
+    """Hierarchical name scope (ref: block.py _BlockScope)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                if not hasattr(_naming, "counter"):
+                    _naming.counter = {}
+                count = _naming.counter.get(hint, 0)
+                _naming.counter[hint] = count + 1
+                prefix = f"{hint}{count}_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, shared=params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            current._counter[hint] = count + 1
+            prefix = f"{hint}{count}_"
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, shared=parent._shared)
+        else:
+            params = ParameterDict(params.prefix, shared=params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, *exc):
+        if self._block._empty_prefix:
+            return False
+        _BlockScope._current.value = self._old_scope
+        return False
+
+
+# thread-local collector for functional aux-state updates during jit tracing
+_aux_updates = threading.local()
+
+
+def defer_aux_update(param, new_value):
+    """BatchNorm-style running-stat update: collected when tracing (returned
+    as jit outputs and written back after execution), applied directly in
+    eager mode."""
+    stack = getattr(_aux_updates, "stack", None)
+    if stack:
+        stack[-1].append((param, new_value))
+    else:
+        if param._data is None:
+            param.set_data(new_value)
+        else:
+            param._data._data = new_value._data
+
+
+def _flatten(args):
+    """Flatten nested (lists/tuples of) NDArrays; returns flat list + spec."""
+    if isinstance(args, NDArray):
+        return [args], "0"
+    if isinstance(args, (list, tuple)):
+        flat, specs = [], []
+        for a in args:
+            f, s = _flatten(a)
+            flat.extend(f)
+            specs.append(s)
+        return flat, ("t", type(args).__name__, specs)
+    return [args], "raw"
+
+
+def _regroup(flat, spec):
+    if spec == "0":
+        return flat.pop(0)
+    if spec == "raw":
+        return flat.pop(0)
+    _, tname, specs = spec
+    out = [_regroup(flat, s) for s in specs]
+    return tuple(out) if tname == "tuple" else out
+
+
+class Block:
+    """Base building block (ref: gluon/block.py:127)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = OrderedDict()
+        self._reg_params = {}
+        self._forward_hooks = []
+        self._forward_pre_hooks = []
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def params(self):
+        return self._params
+
+    def name_scope(self):
+        return self._scope
+
+    def __repr__(self):
+        s = f"{self.__class__.__name__}(\n"
+        for key, child in self._children.items():
+            s += f"  ({key}): {child!r}\n"
+        return s + ")"
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = self.__dict__.get("_children")
+            if existing is not None:
+                existing[name] = value
+        elif isinstance(value, Parameter):
+            reg = self.__dict__.get("_reg_params")
+            if reg is not None:
+                reg[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block, name=None):
+        self._children[name or str(len(self._children))] = block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+
+    def collect_params(self, select=None):
+        ret = ParameterDict(self._params.prefix)
+        if not select:
+            ret.update(self._params)
+        else:
+            pattern = re.compile(select)
+            ret.update({k: v for k, v in self._params.items()
+                        if pattern.match(k)})
+        for child in self._children.values():
+            ret.update(child.collect_params(select=select))
+        return ret
+
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        ret = {prefix + k: v for k, v in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def save_parameters(self, filename, deduplicate=False):
+        params = self._collect_params_with_prefix()
+        payload = {k: v.data() for k, v in params.items()
+                   if v._data is not None}
+        nd_mod.save(filename, payload)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current"):
+        loaded = nd_mod.load(filename)
+        params = self._collect_params_with_prefix()
+        if not any("." in k for k in loaded) and any("." in k for k in params):
+            # file saved with flat prefixed names; match by parameter name
+            by_name = {p.name: p for p in params.values()}
+            for k, v in loaded.items():
+                if k in by_name:
+                    by_name[k]._load_init(v, ctx)
+                elif not ignore_extra:
+                    raise MXNetError(f"unknown parameter {k} in {filename}")
+            if not allow_missing:
+                missing = set(by_name) - set(loaded)
+                if missing:
+                    raise MXNetError(
+                        f"parameters {sorted(missing)} missing in {filename}")
+            return
+        for k, p in params.items():
+            if k in loaded:
+                p._load_init(loaded[k], ctx)
+            elif not allow_missing:
+                raise MXNetError(f"parameter {k} missing in {filename}")
+        if not ignore_extra:
+            extra = set(loaded) - set(params)
+            if extra:
+                raise MXNetError(f"extra parameters in {filename}: {extra}")
+
+    save_params = save_parameters
+    load_params = load_parameters
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for p in self._reg_params.values():
+            p.cast(dtype)
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def zero_grad(self):
+        self.collect_params().zero_grad()
+
+    def __call__(self, *args):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        out = self(*inputs)
+        nparams = sum(
+            int(p.data().size) for p in self.collect_params().values()
+            if p._data is not None)
+        print(f"{self.__class__.__name__}: {nparams} parameters, "
+              f"output {[o.shape for o in (out if isinstance(out, (list, tuple)) else [out])]}")
+        return out
+
+
+class HybridBlock(Block):
+    """Block that can be traced into a single compiled computation
+    (ref: gluon/block.py:671)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_jit = {}
+        self._cached_plist = None
+        self._flags = {}
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  inline_limit=2, forward_bulk_size=None,
+                  backward_bulk_size=None):
+        self._active = active
+        self._flags = {"static_alloc": static_alloc,
+                       "static_shape": static_shape}
+        self._cached_jit = {}
+        self._cached_plist = None
+        super().hybridize(active, static_alloc=static_alloc,
+                          static_shape=static_shape)
+
+    def infer_shape(self, *args):
+        """Resolve deferred parameter shapes from input shapes. Built-in
+        layers override; custom blocks with fully-specified shapes never
+        need it."""
+        raise MXNetError(
+            f"{self.__class__.__name__} has deferred-shape parameters but "
+            "does not implement infer_shape; give explicit in_units/"
+            "in_channels or implement infer_shape")
+
+    def _collect_param_values(self, *args):
+        override = getattr(_param_override, "map", None)
+        try:
+            return {n: (override[id(p)] if override and id(p) in override
+                        else p.data())
+                    for n, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self.infer_shape(*args)
+            for p in self._reg_params.values():
+                if p._deferred_init is not None:
+                    p._finish_deferred_init()
+            return {n: p.data() for n, p in self._reg_params.items()}
+
+    def forward(self, x, *args):
+        if self._active and not getattr(_in_trace, "value", False):
+            return self._call_cached_op(x, *args)
+        params = self._collect_param_values(x, *args)
+        return self.hybrid_forward(nd_mod, x, *args, **params)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    # -- CachedOp path -----------------------------------------------------
+    def _ensure_initialized(self, *args):
+        try:
+            for p in self.collect_params().values():
+                if p._data is None:
+                    p.data()  # raises with a helpful message
+            return True
+        except DeferredInitializationError:
+            return False
+
+    def _call_cached_op(self, *args):
+        if self._cached_plist is None:
+            if not self._ensure_initialized(*args):
+                # first call resolves deferred shapes imperatively (the
+                # reference's deferred-init first pass); later calls compile
+                prev = _in_trace_flag()
+                _set_in_trace(True)
+                try:
+                    return self.forward(*args)
+                finally:
+                    _set_in_trace(prev)
+            # parameter tree is static once shapes are resolved — walk once
+            self._cached_plist = sorted(self.collect_params().items())
+        plist = self._cached_plist
+        pvals = [p.data()._data for _, p in plist]
+        flat_in, in_spec = _flatten(list(args))
+        in_datas = [a._data for a in flat_in]
+        training = autograd.is_training()
+        sig = (tuple((tuple(d.shape), str(d.dtype)) for d in in_datas),
+               tuple((tuple(v.shape), str(v.dtype)) for v in pvals),
+               training, in_spec if isinstance(in_spec, str) else str(in_spec))
+
+        entry = self._cached_jit.get(sig)
+        if entry is None:
+            entry = self._build_cached(plist, in_spec, training)
+            self._cached_jit[sig] = entry
+        jfn, out_spec_box, aux_params_box = entry
+
+        key = _random.next_key()
+
+        def run(*datas):
+            return jfn(tuple(datas[:len(pvals)]), key,
+                       *datas[len(pvals):])
+
+        raw = run(*pvals, *in_datas)
+        flat_out_data, aux_data = raw
+        outs = [NDArray(d) for d in flat_out_data]
+
+        if autograd.is_recording():
+            param_nds = [p.data() for _, p in plist]
+            autograd._record_closure(
+                f"cachedop_{self.name}",
+                lambda *datas: jfn(tuple(datas[:len(pvals)]), key,
+                                   *datas[len(pvals):])[0],
+                param_nds + flat_in, outs)
+
+        # write back functional aux updates (running stats)
+        for p, d in zip(aux_params_box[0], aux_data):
+            p._data._data = d
+
+        flat = list(outs)
+        return _regroup(flat, out_spec_box[0])
+
+    def _build_cached(self, plist, in_spec, training):
+        """Trace the whole subtree once into a jitted pure function."""
+        out_spec_box = [None]
+        aux_params_box = [[]]
+        params = [p for _, p in plist]
+
+        def pure_fn(param_vals, key, *in_datas):
+            prev_rec = autograd.set_recording(False)
+            prev_train = autograd.set_training(training)
+            prev_trace = _in_trace_flag()
+            _set_in_trace(True)
+            override = {id(p): NDArray(v) for p, v in zip(params, param_vals)}
+            old_map = getattr(_param_override, "map", None)
+            _param_override.map = override
+            if not hasattr(_aux_updates, "stack"):
+                _aux_updates.stack = []
+            _aux_updates.stack.append([])
+            try:
+                with _random.key_context(key):
+                    flat_in = [NDArray(d) for d in in_datas]
+                    args = _regroup(list(flat_in), in_spec)
+                    if not isinstance(args, list):
+                        args = [args]
+                    out = self.forward(*args)
+                aux = _aux_updates.stack[-1]
+            finally:
+                _aux_updates.stack.pop()
+                _param_override.map = old_map
+                _set_in_trace(prev_trace)
+                autograd.set_training(prev_train)
+                autograd.set_recording(prev_rec)
+            flat_out, out_spec = _flatten(out)
+            out_spec_box[0] = out_spec
+            aux_params_box[0] = [p for p, _ in aux]
+            return ([o._data for o in flat_out],
+                    [v._data for _, v in aux])
+
+        return jax.jit(pure_fn), out_spec_box, aux_params_box
+
+    def export(self, path, epoch=0):
+        """Export to symbol JSON + params (ref: block.py export).
+
+        Requires the network to have run at least once so shapes are known.
+        Traces hybrid_forward with Symbol placeholders.
+        """
+        from .. import symbol as sym_mod
+        from ..symbol.trace import trace_block
+        out, params = trace_block(self)
+        out.save(f"{path}-symbol.json")
+        payload = {}
+        for name, p in params.items():
+            payload[f"arg:{name}"] = p.data()
+        nd_mod.save(f"{path}-{epoch:04d}.params", payload)
+        return f"{path}-symbol.json", f"{path}-{epoch:04d}.params"
+
+
+_in_trace = threading.local()
+_param_override = threading.local()
+
+
+def _in_trace_flag():
+    return getattr(_in_trace, "value", False)
+
+
+def _set_in_trace(v):
+    _in_trace.value = v
+
+
+class SymbolBlock(HybridBlock):
+    """Construct a block from a Symbol (ref: gluon/block.py:952)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=params)
+        self._outputs = outputs
+        self._inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        from ..symbol.symbol import Symbol
+        self._out_sym = outputs if isinstance(outputs, Symbol) else outputs[0]
+        input_names = {s.name for s in self._inputs}
+        for name in self._out_sym.list_inputs():
+            if name not in input_names:
+                self._reg_params[name] = self.params.get(
+                    name, allow_deferred_init=True)
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        from .. import symbol as sym_mod
+        out = sym_mod.load(symbol_file)
+        inputs = [sym_mod.var(n) for n in (
+            input_names if isinstance(input_names, (list, tuple))
+            else [input_names])]
+        blk = SymbolBlock(out, inputs)
+        if param_file:
+            loaded = nd_mod.load(param_file)
+            cleaned = {}
+            for k, v in loaded.items():
+                k = k.split(":", 1)[-1]
+                cleaned[k] = v
+            for name, p in blk._reg_params.items():
+                if name in cleaned:
+                    p.set_data(cleaned[name])
+            if ctx:
+                blk.collect_params().reset_ctx(ctx)
+        return blk
+
+    def forward(self, *args):
+        bindings = {s.name: a for s, a in zip(self._inputs, args)}
+        for name, p in self._reg_params.items():
+            if p._data is not None:
+                bindings[name] = p.data()
+        return self._out_sym.eval_dict(bindings)
+
+    def hybrid_forward(self, F, *args, **kwargs):
+        raise NotImplementedError

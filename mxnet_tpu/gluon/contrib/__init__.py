@@ -1,0 +1,3 @@
+"""Gluon contrib (ref: python/mxnet/gluon/contrib/)."""
+from . import nn
+from . import rnn

@@ -1,0 +1,30 @@
+"""Contrib layers (ref: python/mxnet/gluon/contrib/nn/basic_layers.py)."""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ..nn import SyncBatchNorm  # noqa: F401  (re-export: lives in core nn here)
+
+
+class Concurrent(HybridBlock):
+    """Run children on the same input and concat outputs."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+
+    def hybrid_forward(self, F, x):
+        out = [block(x) for block in self._children.values()]
+        return F.Concat(*out, dim=self.axis)
+
+
+class HybridConcurrent(Concurrent):
+    pass
+
+
+class Identity(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return x

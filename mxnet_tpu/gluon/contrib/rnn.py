@@ -1,0 +1,42 @@
+"""Contrib RNN cells (ref: python/mxnet/gluon/contrib/rnn/).
+
+Conv-RNN variants; VariationalDropoutCell."""
+from __future__ import annotations
+
+from ..rnn.rnn_cell import ModifierCell
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Same dropout mask across timesteps (ref: contrib/rnn/rnn_cell.py)."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self._mask_in = None
+        self._mask_out = None
+
+    def reset(self):
+        self._mask_in = None
+        self._mask_out = None
+
+    def __call__(self, x, states):
+        from ... import autograd, ndarray as F
+        if autograd.is_training():
+            if self.drop_inputs:
+                if self._mask_in is None or self._mask_in.shape != x.shape:
+                    self._mask_in = F.Dropout(F.ones_like(x),
+                                              p=self.drop_inputs,
+                                              mode="always")
+                x = x * self._mask_in
+        out, states = self.base_cell(x, states)
+        if autograd.is_training() and self.drop_outputs:
+            if self._mask_out is None or self._mask_out.shape != out.shape:
+                self._mask_out = F.Dropout(F.ones_like(out),
+                                           p=self.drop_outputs, mode="always")
+            out = out * self._mask_out
+        return out, states
+
+    forward = __call__

@@ -1,0 +1,78 @@
+"""DataLoader (ref: python/mxnet/gluon/data/dataloader.py).
+
+The reference feeds batches through multiprocessing workers + POSIX-shm fd
+rebuilding. Forking a process that holds a PJRT/TPU client is unsafe, so the
+TPU-native loader uses a thread pool: decode/augment run in Python threads
+(NumPy/opencv release the GIL), batches materialize as pinned host arrays and
+device transfer overlaps compute via the async stream — the same
+PrefetcherIter pattern as src/io/iter_prefetcher.h:47.
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ...ndarray import NDArray, array
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+
+def default_batchify_fn(data):
+    if isinstance(data[0], NDArray):
+        from ... import ndarray as F
+        return F.stack(*data, axis=0)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(list(i)) for i in data]
+    arr = np.asarray(data)
+    return array(arr)
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 thread_pool=True):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size required when no batch_sampler")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle conflicts with explicit sampler")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def _load_batch(self, indices):
+        return self._batchify_fn([self._dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield self._load_batch(indices)
+            return
+        with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
+            futures = []
+            it = iter(self._batch_sampler)
+            try:
+                for _ in range(self._prefetch or self._num_workers):
+                    futures.append(pool.submit(self._load_batch, next(it)))
+            except StopIteration:
+                pass
+            while futures:
+                batch = futures.pop(0).result()
+                try:
+                    futures.append(pool.submit(self._load_batch, next(it)))
+                except StopIteration:
+                    pass
+                yield batch

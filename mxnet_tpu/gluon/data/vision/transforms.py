@@ -1,0 +1,174 @@
+"""Vision transforms (ref: python/mxnet/gluon/data/vision/transforms.py).
+
+Image ops run through the image op family (src/operator/image/ analogue):
+HWC uint8/float inputs, ToTensor converts to CHW float32/255.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ....ndarray import NDArray, array
+from ...block import Block, HybridBlock
+from ...nn import HybridSequential
+
+
+class Compose(HybridSequential):
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.Cast(x, dtype=self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC [0,255] -> CHW [0,1] float32."""
+
+    def hybrid_forward(self, F, x):
+        x = F.Cast(x, dtype="float32") / 255.0
+        if x.ndim == 3:
+            return F.transpose(x, axes=(2, 0, 1))
+        return F.transpose(x, axes=(0, 3, 1, 2))
+
+
+class Normalize(HybridBlock):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = mean
+        self._std = std
+
+    def hybrid_forward(self, F, x):
+        mean = np.asarray(self._mean, np.float32).reshape(-1, 1, 1)
+        std = np.asarray(self._std, np.float32).reshape(-1, 1, 1)
+        return (x - array(mean)) / array(std)
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+
+    def forward(self, x):
+        import jax
+        import jax.numpy as jnp
+        data = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        h, w = self._size[1], self._size[0]
+        if data.ndim == 3:
+            out = jax.image.resize(data.astype(jnp.float32),
+                                   (h, w, data.shape[2]), "linear")
+        else:
+            out = jax.image.resize(data.astype(jnp.float32),
+                                   (data.shape[0], h, w, data.shape[3]),
+                                   "linear")
+        return NDArray(out)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+
+    def forward(self, x):
+        w, h = self._size
+        H, W = x.shape[-3], x.shape[-2]
+        y0 = max((H - h) // 2, 0)
+        x0 = max((W - w) // 2, 0)
+        return x[..., y0:y0 + h, x0:x0 + w, :]
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        H, W = x.shape[-3], x.shape[-2]
+        area = H * W
+        for _ in range(10):
+            target_area = np.random.uniform(*self._scale) * area
+            aspect = np.random.uniform(*self._ratio)
+            w = int(round(np.sqrt(target_area * aspect)))
+            h = int(round(np.sqrt(target_area / aspect)))
+            if w <= W and h <= H:
+                x0 = np.random.randint(0, W - w + 1)
+                y0 = np.random.randint(0, H - h + 1)
+                crop = x[..., y0:y0 + h, x0:x0 + w, :]
+                return Resize(self._size)(crop)
+        return Resize(self._size)(x)
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if np.random.rand() < 0.5:
+            from .... import ndarray as F
+            return F.flip(x, axis=x.ndim - 2)
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if np.random.rand() < 0.5:
+            from .... import ndarray as F
+            return F.flip(x, axis=x.ndim - 3)
+        return x
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._b = brightness
+
+    def forward(self, x):
+        alpha = 1.0 + np.random.uniform(-self._b, self._b)
+        return x * alpha
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._c = contrast
+
+    def forward(self, x):
+        alpha = 1.0 + np.random.uniform(-self._c, self._c)
+        gray = x.mean()
+        return x * alpha + gray * (1 - alpha)
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation):
+        super().__init__()
+        self._s = saturation
+
+    def forward(self, x):
+        alpha = 1.0 + np.random.uniform(-self._s, self._s)
+        coef = array(np.array([0.299, 0.587, 0.114], np.float32))
+        gray = (x * coef).sum(axis=-1, keepdims=True)
+        return x * alpha + gray * (1 - alpha)
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+
+    def forward(self, x):
+        order = np.random.permutation(len(self._ts))
+        for i in order:
+            x = self._ts[i](x)
+        return x

@@ -1,0 +1,4 @@
+from .rnn_layer import RNN, LSTM, GRU
+from .rnn_cell import (BidirectionalCell, DropoutCell, GRUCell, HybridRecurrentCell,
+                       LSTMCell, ModifierCell, RecurrentCell, ResidualCell,
+                       RNNCell, SequentialRNNCell, ZoneoutCell)

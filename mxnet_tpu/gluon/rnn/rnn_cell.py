@@ -1,0 +1,309 @@
+"""Recurrent cells for step-wise / unrolled execution
+(ref: python/mxnet/gluon/rnn/rnn_cell.py). Gate orders match the fused op
+(LSTM: i,f,g,o; GRU: r,z,n) so cell and fused-layer parameters interconvert.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import ndarray as F
+        func = func or F.zeros
+        return [func(shape=info["shape"], **kwargs)
+                for info in self.state_info(batch_size)]
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ... import ndarray as F
+        axis = layout.find("T")
+        batch_axis = layout.find("N")
+        batch_size = inputs.shape[batch_axis]
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size)
+        states = begin_state
+        outputs = []
+        for t in range(length):
+            if axis == 0:
+                x = inputs[t]
+            else:
+                x = F.squeeze(F.slice_axis(inputs, axis=axis, begin=t,
+                                           end=t + 1), axis=axis)
+            out, states = self(x, states)
+            outputs.append(out)
+        if merge_outputs or merge_outputs is None:
+            outputs = F.stack(*outputs, axis=axis)
+        if valid_length is not None:
+            outputs = F.SequenceMask(outputs, valid_length,
+                                     use_sequence_length=True, axis=axis)
+        return outputs, states
+
+    def hybrid_forward(self, F, x, states, **params):
+        raise NotImplementedError
+
+
+HybridRecurrentCell = RecurrentCell
+
+
+class RNNCell(RecurrentCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(hidden_size, hidden_size),
+                init=h2h_weight_initializer)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(hidden_size,),
+                init=i2h_bias_initializer)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(hidden_size,), init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape_inferred((self._hidden_size, x.shape[-1]))
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight, i2h_bias,
+                       h2h_bias):
+        i2h = F.FullyConnected(x, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size)
+        out = F.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(RecurrentCell):
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(4 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(4 * hidden_size, hidden_size),
+                init=h2h_weight_initializer)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(4 * hidden_size,),
+                init=i2h_bias_initializer)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(4 * hidden_size,),
+                init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)},
+                {"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape_inferred((4 * self._hidden_size, x.shape[-1]))
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight, i2h_bias,
+                       h2h_bias):
+        H = self._hidden_size
+        pre = F.FullyConnected(x, i2h_weight, i2h_bias, num_hidden=4 * H) + \
+            F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                             num_hidden=4 * H)
+        i, f, g, o = F.split(pre, num_outputs=4, axis=1)
+        i, f, o = F.sigmoid(i), F.sigmoid(f), F.sigmoid(o)
+        g = F.tanh(g)
+        c = f * states[1] + i * g
+        h = o * F.tanh(c)
+        return h, [h, c]
+
+
+class GRUCell(RecurrentCell):
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(3 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(3 * hidden_size, hidden_size),
+                init=h2h_weight_initializer)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(3 * hidden_size,),
+                init=i2h_bias_initializer)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(3 * hidden_size,),
+                init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape_inferred((3 * self._hidden_size, x.shape[-1]))
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight, i2h_bias,
+                       h2h_bias):
+        H = self._hidden_size
+        i2h = F.FullyConnected(x, i2h_weight, i2h_bias, num_hidden=3 * H)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=3 * H)
+        xr, xz, xn = F.split(i2h, num_outputs=3, axis=1)
+        hr, hz, hn = F.split(h2h, num_outputs=3, axis=1)
+        r = F.sigmoid(xr + hr)
+        z = F.sigmoid(xz + hz)
+        n = F.tanh(xn + r * hn)
+        out = (1 - z) * n + z * states[0]
+        return out, [out]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        infos = []
+        for cell in self._children.values():
+            infos.extend(cell.state_info(batch_size))
+        return infos
+
+    def __call__(self, x, states):
+        return self.forward(x, states)
+
+    def forward(self, x, states):
+        next_states = []
+        pos = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            state = states[pos:pos + n]
+            pos += n
+            x, state = cell(x, state)
+            next_states.extend(state)
+        return x, next_states
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+
+class ModifierCell(RecurrentCell):
+    def __init__(self, base_cell):
+        super().__init__(prefix=base_cell.prefix + "mod_", params=None)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def __call__(self, x, states):
+        from ... import ndarray as F
+        if self._rate > 0:
+            x = F.Dropout(x, p=self._rate, axes=self._axes)
+        return x, states
+
+    forward = __call__
+
+
+class ZoneoutCell(ModifierCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def __call__(self, x, states):
+        from ... import autograd, ndarray as F
+        out, next_states = self.base_cell(x, states)
+        if autograd.is_training():
+            if self.zoneout_outputs > 0:
+                mask = F.Dropout(F.ones_like(out), p=self.zoneout_outputs,
+                                 mode="always") * (1 - self.zoneout_outputs)
+                prev = self._prev_output if self._prev_output is not None \
+                    else F.zeros_like(out)
+                out = F.where(mask, out, prev)
+            if self.zoneout_states > 0:
+                masked = []
+                for new, old in zip(next_states, states):
+                    mask = F.Dropout(F.ones_like(new), p=self.zoneout_states,
+                                     mode="always") * (1 - self.zoneout_states)
+                    masked.append(F.where(mask, new, old))
+                next_states = masked
+        self._prev_output = out
+        return out, next_states
+
+    forward = __call__
+
+
+class ResidualCell(ModifierCell):
+    def __call__(self, x, states):
+        out, states = self.base_cell(x, states)
+        return out + x, states
+
+    forward = __call__
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell, output_prefix="bi_"):
+        super().__init__(prefix="", params=None)
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+
+    def state_info(self, batch_size=0):
+        return (self._children["l_cell"].state_info(batch_size)
+                + self._children["r_cell"].state_info(batch_size))
+
+    def __call__(self, x, states):
+        raise MXNetError("BidirectionalCell supports only unroll()")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ... import ndarray as F
+        l_cell = self._children["l_cell"]
+        r_cell = self._children["r_cell"]
+        axis = layout.find("T")
+        batch_axis = layout.find("N")
+        batch_size = inputs.shape[batch_axis]
+        if begin_state is None:
+            begin_state = self.state_info(batch_size) and \
+                self.begin_state(batch_size)
+        nl = len(l_cell.state_info())
+        l_out, l_states = l_cell.unroll(
+            length, inputs, begin_state[:nl], layout, True, valid_length)
+        rev = F.flip(inputs, axis=axis)
+        r_out, r_states = r_cell.unroll(
+            length, rev, begin_state[nl:], layout, True, valid_length)
+        r_out = F.flip(r_out, axis=axis)
+        out = F.Concat(l_out, r_out, dim=2)
+        return out, l_states + r_states

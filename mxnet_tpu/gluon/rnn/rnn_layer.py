@@ -1,0 +1,146 @@
+"""Fused RNN layers (ref: python/mxnet/gluon/rnn/rnn_layer.py).
+
+Backed by the fused RNN op (ops/rnn.py — lax.scan over time, MXU matmuls
+hoisted out of the loop), mirroring how the reference layers wrap the
+cudnn/CPU fused kernel (src/operator/rnn-inl.h).
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ...ops.rnn import rnn_param_size, _NGATES
+from .. import parameter
+from ..block import HybridBlock
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, mode, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if layout not in ("TNC", "NTC"):
+            raise MXNetError(f"invalid layout {layout!r}")
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._mode = mode
+        ng = _NGATES[mode]
+        with self.name_scope():
+            # per-(layer,direction) i2h/h2h weights+biases; flattened into the
+            # fused op's parameter vector at forward (same layout contract)
+            self._unfused_names = []
+            for layer in range(num_layers):
+                isz = input_size if layer == 0 else hidden_size * self._dir
+                for d in range(self._dir):
+                    sfx = ["l", "r"][d] + str(layer)
+                    setattr(self, f"{sfx}_i2h_weight", self.params.get(
+                        f"{sfx}_i2h_weight", shape=(ng * hidden_size, isz),
+                        init=i2h_weight_initializer, allow_deferred_init=True))
+                    setattr(self, f"{sfx}_h2h_weight", self.params.get(
+                        f"{sfx}_h2h_weight",
+                        shape=(ng * hidden_size, hidden_size),
+                        init=h2h_weight_initializer, allow_deferred_init=True))
+                    setattr(self, f"{sfx}_i2h_bias", self.params.get(
+                        f"{sfx}_i2h_bias", shape=(ng * hidden_size,),
+                        init=i2h_bias_initializer, allow_deferred_init=True))
+                    setattr(self, f"{sfx}_h2h_bias", self.params.get(
+                        f"{sfx}_h2h_bias", shape=(ng * hidden_size,),
+                        init=h2h_bias_initializer, allow_deferred_init=True))
+                    self._unfused_names.append(sfx)
+
+    def infer_shape(self, x, *args):
+        isz = x.shape[2] if self._layout == "TNC" else x.shape[-1]
+        ng = _NGATES[self._mode]
+        H = self._hidden_size
+        for layer in range(self._num_layers):
+            in_sz = isz if layer == 0 else H * self._dir
+            for d in range(self._dir):
+                sfx = ["l", "r"][d] + str(layer)
+                self._reg_params[f"{sfx}_i2h_weight"].shape_inferred(
+                    (ng * H, in_sz))
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import ndarray as F
+        func = func or F.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            states.append(func(shape=info["shape"], **kwargs))
+        return states
+
+    def hybrid_forward(self, F, inputs, states=None, **params):
+        if self._layout == "NTC":
+            inputs = F.swapaxes(inputs, dim1=0, dim2=1)
+        batch_size = inputs.shape[1]
+        explicit_states = states is not None
+        if states is None:
+            states = self.begin_state(batch_size)
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+        # flatten params in the fused op's layout: all weights, then biases
+        flat = []
+        for sfx in self._unfused_names:
+            flat.append(params[f"{sfx}_i2h_weight"].reshape(-1))
+            flat.append(params[f"{sfx}_h2h_weight"].reshape(-1))
+        for sfx in self._unfused_names:
+            flat.append(params[f"{sfx}_i2h_bias"])
+            flat.append(params[f"{sfx}_h2h_bias"])
+        pvec = F.concat(*flat, dim=0)
+        args = [inputs, pvec, states[0]]
+        if self._mode == "lstm":
+            args.append(states[1])
+        out = F.RNN(*args, state_size=self._hidden_size,
+                    num_layers=self._num_layers,
+                    bidirectional=self._dir == 2, mode=self._mode,
+                    p=self._dropout, state_outputs=True)
+        outputs, *out_states = out if isinstance(out, tuple) else (out,)
+        if self._layout == "NTC":
+            outputs = F.swapaxes(outputs, dim1=0, dim2=1)
+        if explicit_states:
+            return outputs, list(out_states)
+        return outputs
+
+    def __call__(self, inputs, states=None):
+        return super().__call__(inputs, states) if states is not None \
+            else super().__call__(inputs)
+
+
+class RNN(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False, input_size=0,
+                 **kwargs):
+        mode = "rnn_relu" if activation == "relu" else "rnn_tanh"
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, mode, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "lstm", **kwargs)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        return [{"shape": shape, "__layout__": "LNC"},
+                {"shape": shape, "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

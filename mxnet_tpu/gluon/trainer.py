@@ -1,0 +1,118 @@
+"""Trainer (ref: python/mxnet/gluon/trainer.py:27).
+
+Applies an Optimizer to a set of Parameters. The reference wires kvstore
+Reduce/Broadcast between devices; here single-host multi-device DP runs
+through the sharded jit step (parallel.data_parallel) and the kvstore seam is
+kept for the update_on_kvstore policy and the dist/sparse paths.
+"""
+from __future__ import annotations
+
+from .. import optimizer as opt_mod
+from ..base import MXNetError
+from .parameter import Parameter, ParameterDict
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError("params must be a list/dict of Parameters")
+        self._params = []
+        self._param2idx = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise MXNetError(f"invalid parameter {p}")
+            self._param2idx[p.name] = i
+            self._params.append(p)
+        optimizer_params = optimizer_params or {}
+        self._scale = optimizer_params.get("rescale_grad", 1.0)
+        self._optimizer = opt_mod.create(optimizer, param_idx2name={
+            i: p.name for i, p in enumerate(self._params)},
+            **optimizer_params)
+        self._updaters = opt_mod.get_updater(self._optimizer)
+        self._kvstore_type = kvstore
+        self._kvstore = None
+        self._update_on_kvstore = update_on_kvstore
+        self._compression_params = compression_params
+        self._kv_initialized = False
+        self._params_to_init = list(self._params)
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def _init_kvstore(self):
+        if self._kvstore_type and self._kvstore_type != "None" and \
+                str(self._kvstore_type).startswith("dist"):
+            from .. import kvstore as kv_mod
+            self._kvstore = kv_mod.create(self._kvstore_type)
+            if self._compression_params:
+                self._kvstore.set_gradient_compression(self._compression_params)
+            if self._update_on_kvstore is None:
+                self._update_on_kvstore = True
+            if self._update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
+            for i, p in enumerate(self._params):
+                if p._data is not None:
+                    self._kvstore.init(i, p.data())
+        self._kv_initialized = True
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """rescale by 1/batch_size, allreduce (mesh DP: already summed by
+        psum in the sharded step), update."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is not None and not self._update_on_kvstore:
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null":
+                    self._kvstore.push(i, p.grad())
+                    self._kvstore.pull(i, p.grad())
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            if p._data is None:
+                if not ignore_stale_grad:
+                    raise MXNetError(
+                        f"parameter {p.name} not initialized before step()")
+                continue
+            if self._kvstore is not None and self._update_on_kvstore:
+                self._kvstore.push(i, p.grad())
+                self._kvstore.pull(i, p.data())
+            else:
+                self._updaters(i, p.grad(), p.data())
+
+    def save_states(self, fname):
+        import pickle
+        with open(fname, "wb") as f:
+            f.write(self._updaters.get_states())
+
+    def load_states(self, fname):
+        with open(fname, "rb") as f:
+            self._updaters.set_states(f.read())

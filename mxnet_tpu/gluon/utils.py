@@ -1,0 +1,73 @@
+"""Gluon utilities (ref: python/mxnet/gluon/utils.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import NDArray, array
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            f"cannot evenly split batch of {size} into {num_slice} slices")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        if batch_axis == 0:
+            slices.append(data[begin:end])
+        else:
+            from ..ndarray import slice_axis
+            slices.append(slice_axis(data, axis=batch_axis, begin=begin,
+                                     end=end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Slice the batch across contexts (ref: utils.py split_and_load).
+
+    On a TPU mesh the preferred path is a sharded jit step; this imperative
+    splitter exists for API parity and multi-context eager loops."""
+    if not isinstance(data, NDArray):
+        data = array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so the joint L2 norm <= max_norm (ref: utils.py)."""
+    total = 0.0
+    for a in arrays:
+        n = float((a * a).sum().asscalar())
+        total += n
+    total = np.sqrt(total)
+    if check_isfinite and not np.isfinite(total):
+        import warnings
+        warnings.warn("nan or inf found in gradients")
+    scale = max_norm / (total + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a *= scale
+    return total
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None):
+    raise MXNetError("this environment has no network egress; place files "
+                     "locally and load them directly")

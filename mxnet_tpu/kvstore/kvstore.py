@@ -1,0 +1,161 @@
+"""KVStore — the distribution seam (ref: include/mxnet/kvstore.h:59-442,
+src/kvstore/kvstore_local.h).
+
+Same string-typed factory as the reference: 'local' / 'device' aggregate
+gradients within one process; 'dist_sync' / 'dist_device_sync' map data
+parallelism onto XLA collectives over the device mesh (psum inside the jitted
+step — no parameter-server hop needed for dense sync DP, SURVEY.md §5.8);
+'dist_async' retains apply-on-arrival semantics per push. The public API
+(init/push/pull/row_sparse_pull/set_optimizer/rank/num_workers) is the stable
+seam Trainer and Module depend on.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from ..ndarray.sparse import RowSparseNDArray
+from ..optimizer import Updater
+
+
+class KVStore:
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression = None
+
+    # -- factory-reported topology ----------------------------------------
+    @property
+    def rank(self):
+        # single-process SPMD: jax process index is the worker rank
+        return jax.process_index()
+
+    @property
+    def num_workers(self):
+        return jax.process_count() if self.type.startswith("dist") else 1
+
+    # -- data plane --------------------------------------------------------
+    def init(self, key, value):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            self._store[k] = v.copy() if isinstance(v, NDArray) else v
+
+    def push(self, key, value, priority=0):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            if isinstance(v, (list, tuple)):
+                # multi-device push: aggregate (CommCPU/CommDevice Reduce)
+                agg = v[0]
+                for extra in v[1:]:
+                    agg = agg + extra
+                v = agg
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized in kvstore")
+            if self._updater is not None:
+                self._updater(self._key_index(k), v, self._store[k])
+            else:
+                # no updater: the store holds the reduced push value, which
+                # pull() then broadcasts (ref: kvstore_local.h PushImpl
+                # CopyFromTo(merged, &local_[key]))
+                if isinstance(v, RowSparseNDArray):
+                    v = v.tostype("default")
+                self._store[k]._data = v._data
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = self._normalize(key, out)
+        for k, o in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized in kvstore")
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                t._data = self._store[k]._data
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        keys, outs = self._normalize(key, out)
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
+        for k, o, rid in zip(keys, outs, rids):
+            stored = self._store[k]
+            from ..ndarray.sparse import row_sparse_array
+            rsp = stored if isinstance(stored, RowSparseNDArray) \
+                else row_sparse_array(stored)
+            result = rsp.retain(rid)
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                if isinstance(t, RowSparseNDArray):
+                    t.data = result.data
+                    t.indices = result.indices
+                else:
+                    t._data = result.tostype("default")._data
+
+    # -- control plane -----------------------------------------------------
+    def set_optimizer(self, optimizer):
+        """In dist mode the reference pickles the optimizer to the servers
+        (python/mxnet/kvstore.py:450-495); here the updater always runs in
+        the worker process (servers are unnecessary for dense sync DP on a
+        TPU mesh)."""
+        self._optimizer = optimizer
+        self._updater = Updater(optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        self._compression = dict(compression_params)
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no optimizer set")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no optimizer set")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def barrier(self):
+        from .. import engine
+        engine.waitall()
+
+    def _barrier(self):
+        self.barrier()
+
+    def send_command_to_servers(self, head, body):
+        pass
+
+    def _normalize(self, key, value):
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        if value is None:
+            values = [None] * len(keys)
+        elif isinstance(value, (list, tuple)) and len(keys) > 1 and \
+                len(value) == len(keys):
+            values = list(value)
+        elif isinstance(value, (list, tuple)) and len(keys) == 1:
+            values = [value]
+        else:
+            values = [value]
+        return keys, values
+
+    def _key_index(self, k):
+        # integer keys (Trainer param indices) pass through unchanged so the
+        # updater's per-index state/lr-mult bookkeeping lines up; string keys
+        # get a stable per-instance mapping (str<->int dict, kvstore_local.h)
+        if isinstance(k, int):
+            return k
+        if not hasattr(self, "_str_key_indices"):
+            self._str_key_indices = {}
+        if k not in self._str_key_indices:
+            self._str_key_indices[k] = len(self._str_key_indices)
+        return self._str_key_indices[k]
+
+
+def create(name="local"):
+    """Factory (ref: src/kvstore/kvstore.cc:40-77)."""
+    known = ("local", "device", "local_allreduce_cpu",
+             "local_allreduce_device", "nccl", "dist_sync", "dist_async",
+             "dist_device_sync", "dist_sync_device", "dist")
+    if name not in known:
+        raise MXNetError(f"unknown KVStore type {name!r}")
+    return KVStore(name)

@@ -1,0 +1,597 @@
+"""Optimizers (ref: python/mxnet/optimizer/optimizer.py).
+
+Each optimizer's update rule is a pure jitted function over jax arrays (the
+reference implements them as fused mshadow kernels, src/operator/optimizer_op.cc
+— here XLA fuses the update chain into one kernel per parameter). The
+Optimizer/Updater API surface (registry, lr/wd multipliers, multi-precision
+fp32 master weights, num_update-driven schedules) matches the reference.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError, registry as _registry
+from ..ndarray import NDArray
+from ..ndarray.sparse import RowSparseNDArray
+
+_reg = _registry("optimizer")
+
+
+def register(klass):
+    _reg.register(klass)
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    return _reg.get(name)(**kwargs)
+
+
+class Optimizer:
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self.multi_precision = multi_precision
+        self._index_update_count = {}
+        self.idx2name = dict(param_idx2name or {})
+        self.param_dict = param_dict or {}
+        self.lr_mult = {}
+        self.wd_mult = {}
+
+    # -- lr / wd bookkeeping ----------------------------------------------
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("lr_scheduler is set; cannot set lr directly")
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    # -- state ------------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        """fp32 master copy for fp16/bf16 weights (ref: optimizer.py:208)."""
+        if self.multi_precision and weight.dtype in (np.float16, np.dtype("bfloat16")):
+            master = NDArray(weight._data.astype(jnp.float32))
+            return (master, self.create_state(index, master))
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and isinstance(state, tuple) and \
+                isinstance(state[0], NDArray) and \
+                state[0]._data.dtype == jnp.float32 and \
+                weight._data.dtype != jnp.float32:
+            master, inner = state
+            grad32 = NDArray(grad._data.astype(jnp.float32))
+            self.update(index, master, grad32, inner)
+            weight._data = master._data.astype(weight._data.dtype)
+        else:
+            self.update(index, weight, grad, state)
+
+    def _preprocess(self, weight, grad, wd):
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g + wd * weight._data
+
+    def _sparse_to_dense(self, grad, weight):
+        if isinstance(grad, RowSparseNDArray):
+            return grad.tostype("default")
+        return grad
+
+
+@functools.lru_cache(maxsize=None)
+def _jit(fn):
+    return jax.jit(fn)
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum + optional multi-precision
+    (ref: optimizer.py SGD; kernels src/operator/optimizer_op.cc:32)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros_like(weight._data))
+
+    @staticmethod
+    @jax.jit
+    def _step(w, g, lr, wd, rescale, clip, has_clip):
+        g = g * rescale
+        g = jnp.where(has_clip, jnp.clip(g, -clip, clip), g)
+        g = g + wd * w
+        return w - lr * g
+
+    @staticmethod
+    @jax.jit
+    def _step_mom(w, g, mom, lr, wd, mu, rescale, clip, has_clip):
+        g = g * rescale
+        g = jnp.where(has_clip, jnp.clip(g, -clip, clip), g)
+        g = g + wd * w
+        mom = mu * mom - lr * g
+        return w + mom, mom
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = self._sparse_to_dense(grad, weight)
+        clip = self.clip_gradient if self.clip_gradient is not None else 1.0
+        has_clip = self.clip_gradient is not None
+        if state is None:
+            weight._data = SGD._step(weight._data, grad._data, lr, wd,
+                                     self.rescale_grad, clip, has_clip)
+        else:
+            weight._data, state._data = SGD._step_mom(
+                weight._data, grad._data, state._data, lr, wd, self.momentum,
+                self.rescale_grad, clip, has_clip)
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros_like(weight._data))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess(weight, grad, wd)
+        if state is not None:
+            state._data = self.momentum * state._data - (1 - self.momentum) * g
+            weight._data = (1 - lr * self.wd_lh) * weight._data + \
+                lr * jnp.sign(state._data)
+        else:
+            weight._data = (1 - lr * self.wd_lh) * weight._data - \
+                lr * jnp.sign(g)
+
+
+@register
+class NAG(Optimizer):
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros_like(weight._data))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess(weight, grad, wd)
+        if state is None:
+            weight._data = weight._data - lr * g
+        else:
+            state._data = self.momentum * state._data + g
+            weight._data = weight._data - lr * (g + self.momentum * state._data)
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros_like(weight._data)),
+                NDArray(jnp.zeros_like(weight._data)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr_t = lr * math.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
+        g = self._preprocess(weight, grad, wd)
+        m, v = state
+        m._data = self.beta1 * m._data + (1 - self.beta1) * g
+        v._data = self.beta2 * v._data + (1 - self.beta2) * g * g
+        weight._data = weight._data - lr_t * m._data / (
+            jnp.sqrt(v._data) + self.epsilon)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros_like(weight._data))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess(weight, grad, wd)
+        state._data = state._data + g * g
+        weight._data = weight._data - lr * g / (
+            jnp.sqrt(state._data) + self.float_stable_eps)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.epsilon = epsilon
+        self.centered = centered
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (NDArray(jnp.zeros_like(weight._data)),
+                    NDArray(jnp.zeros_like(weight._data)),
+                    NDArray(jnp.zeros_like(weight._data)))
+        return NDArray(jnp.zeros_like(weight._data))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess(weight, grad, wd)
+        if self.centered:
+            n, gmean, delta = state
+            n._data = (1 - self.gamma1) * g * g + self.gamma1 * n._data
+            gmean._data = (1 - self.gamma1) * g + self.gamma1 * gmean._data
+            delta._data = self.gamma2 * delta._data - lr * g / jnp.sqrt(
+                n._data - gmean._data * gmean._data + self.epsilon)
+            weight._data = weight._data + delta._data
+        else:
+            n = state
+            n._data = (1 - self.gamma1) * g * g + self.gamma1 * n._data
+            weight._data = weight._data - lr * g / jnp.sqrt(
+                n._data + self.epsilon)
+        if self.clip_weights:
+            weight._data = jnp.clip(weight._data, -self.clip_weights,
+                                    self.clip_weights)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.9, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros_like(weight._data)),
+                NDArray(jnp.zeros_like(weight._data)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        g = self._preprocess(weight, grad, wd)
+        acc_g, acc_delta = state
+        acc_g._data = self.rho * acc_g._data + (1 - self.rho) * g * g
+        delta = jnp.sqrt(acc_delta._data + self.epsilon) / jnp.sqrt(
+            acc_g._data + self.epsilon) * g
+        acc_delta._data = self.rho * acc_delta._data + (1 - self.rho) * delta * delta
+        weight._data = weight._data - delta
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros_like(weight._data)),  # z
+                NDArray(jnp.zeros_like(weight._data)))  # n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        z, n = state
+        sigma = (jnp.sqrt(n._data + g * g) - jnp.sqrt(n._data)) / lr
+        z._data = z._data + g - sigma * weight._data
+        n._data = n._data + g * g
+        weight._data = jnp.where(
+            jnp.abs(z._data) > self.lamda1,
+            -(z._data - jnp.sign(z._data) * self.lamda1)
+            / ((self.beta + jnp.sqrt(n._data)) / lr + wd),
+            0.0)
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros_like(weight._data)),
+                NDArray(jnp.zeros_like(weight._data)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr_t = lr / (1 - self.beta1 ** t)
+        g = self._preprocess(weight, grad, wd)
+        m, u = state
+        m._data = self.beta1 * m._data + (1 - self.beta1) * g
+        u._data = jnp.maximum(self.beta2 * u._data, jnp.abs(g))
+        weight._data = weight._data - lr_t * m._data / (u._data + 1e-8)
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros_like(weight._data)),
+                NDArray(jnp.zeros_like(weight._data)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        g = self._preprocess(weight, grad, wd)
+        mu_t = self.beta1 * (1 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        mu_tp1 = self.beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * mu_t
+        m_sched_next = self.m_schedule * mu_tp1
+        m, v = state
+        m._data = self.beta1 * m._data + (1 - self.beta1) * g
+        v._data = self.beta2 * v._data + (1 - self.beta2) * g * g
+        g_prime = g / (1 - self.m_schedule)
+        m_prime = m._data / (1 - m_sched_next)
+        v_prime = v._data / (1 - self.beta2 ** t)
+        m_bar = (1 - mu_t) * g_prime + mu_tp1 * m_prime
+        weight._data = weight._data - lr * m_bar / (
+            jnp.sqrt(v_prime) + self.epsilon)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics."""
+
+    def update(self, index, weight, grad, state):
+        from .. import random as _random
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess(weight, grad, wd)
+        noise = jax.random.normal(_random.next_key(), weight._data.shape,
+                                  weight._data.dtype) * math.sqrt(lr)
+        weight._data = weight._data - lr / 2 * g + noise
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros_like(weight._data)),
+                NDArray(jnp.zeros_like(weight._data)),
+                NDArray(jnp.zeros_like(weight._data)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        g = self._preprocess(weight, grad, wd)
+        d, v, z = state
+        v._data = self.beta2 * v._data + (1 - self.beta2) * g * g
+        d_t = (1 - self.beta1 ** t) / lr * (
+            jnp.sqrt(v._data / (1 - self.beta2 ** t)) + self.epsilon)
+        sigma = d_t - self.beta1 * d._data
+        z._data = self.beta1 * z._data + (1 - self.beta1) * g - \
+            sigma * weight._data
+        d._data = d_t
+        weight._data = -z._data / d_t
+
+
+@register
+class DCASGD(Optimizer):
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        mom = NDArray(jnp.zeros_like(weight._data)) if self.momentum else None
+        return (mom, NDArray(jnp.copy(weight._data)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess(weight, grad, wd)
+        mom, prev = state
+        comp = g + self.lamda * g * g * (weight._data - prev._data)
+        if mom is not None:
+            mom._data = self.momentum * mom._data - lr * comp
+            delta = mom._data
+        else:
+            delta = -lr * comp
+        prev._data = weight._data
+        weight._data = weight._data + delta
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD with LARS-style layer-wise scaling
+    (ref: optimizer.py LBSGD)."""
+
+    def __init__(self, momentum=0.0, warmup_strategy="linear",
+                 warmup_epochs=5, batch_scale=1, updates_per_epoch=32,
+                 begin_epoch=0, num_epochs=60, **kwargs):
+        super().__init__(momentum=momentum, **kwargs)
+
+    @staticmethod
+    @jax.jit
+    def _lars_step(w, g, mom, lr, wd, mu, rescale):
+        # trust ratio computed on device — no host round-trip per parameter
+        g = g * rescale
+        wnorm = jnp.linalg.norm(w)
+        gnorm = jnp.linalg.norm(g)
+        ratio = jnp.where((wnorm > 0) & (gnorm > 0),
+                          wnorm / (gnorm + wd * wnorm + 1e-9), 1.0)
+        g = g + wd * w
+        mom = mu * mom - (lr * ratio) * g
+        return w + mom, mom
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros_like(weight._data))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        weight._data, state._data = LBSGD._lars_step(
+            weight._data, grad._data, state._data, lr, wd, self.momentum,
+            self.rescale_grad)
+
+
+@register
+class Test(Optimizer):
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros_like(weight._data))
+
+    def update(self, index, weight, grad, state):
+        weight._data = weight._data - self.rescale_grad * grad._data
+
+
+class Updater:
+    """Apply an optimizer, holding per-index states
+    (ref: optimizer.py get_updater; used by KVStore servers)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = \
+                self.optimizer.create_state_multi_precision(index, weight)
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+        payload = {"states": {k: _state_to_np(v)
+                              for k, v in self.states.items()}}
+        if dump_optimizer:
+            payload["optimizer"] = self.optimizer
+        return pickle.dumps(payload)
+
+    def set_states(self, states):
+        import pickle
+        loaded = pickle.loads(states)
+        if "optimizer" in loaded:
+            self.optimizer = loaded["optimizer"]
+        self.states = {k: _state_from_np(v)
+                       for k, v in loaded["states"].items()}
+
+
+def _state_to_np(state):
+    if state is None:
+        return None
+    if isinstance(state, tuple):
+        return tuple(_state_to_np(s) for s in state)
+    return state.asnumpy()
+
+
+def _state_from_np(state):
+    from ..ndarray import array
+    if state is None:
+        return None
+    if isinstance(state, tuple):
+        return tuple(_state_from_np(s) for s in state)
+    return array(state)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

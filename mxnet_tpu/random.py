@@ -25,6 +25,32 @@ def seed(seed_state, ctx="all"):
 
 
 def next_key():
+    stack = getattr(_state, "override", None)
+    if stack:
+        stack[-1], sub = jax.random.split(stack[-1])
+        return sub
     key = _get_key()
     _state.key, sub = jax.random.split(key)
     return sub
+
+
+class key_context:
+    """Derive all next_key() draws inside the scope from an explicit key.
+
+    Used by the CachedOp/jit path so RNG ops trace against a key *argument*
+    (fresh randomness per call) instead of freezing a key into the compiled
+    executable.
+    """
+
+    def __init__(self, key):
+        self.key = key
+
+    def __enter__(self):
+        if not hasattr(_state, "override"):
+            _state.override = []
+        _state.override.append(self.key)
+        return self
+
+    def __exit__(self, *exc):
+        _state.override.pop()
+        return False

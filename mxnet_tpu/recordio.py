@@ -1,0 +1,197 @@
+"""RecordIO file format (ref: python/mxnet/recordio.py + dmlc-core recordio +
+src/io/image_recordio.h).
+
+Byte-compatible with the reference's format: records framed by kMagic
+(0xced7230a) + length word (upper 3 bits = continuation flag), payloads padded
+to 4 bytes; IRHeader packs (flag, label, id, id2) ahead of image payloads;
+.idx files map integer keys to byte offsets for random access.
+"""
+from __future__ import annotations
+
+import ctypes
+import io
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+from .base import MXNetError
+
+_MAGIC = 0xCED7230A
+_LFLAG_BITS = 29
+_LFLAG_MASK = (1 << _LFLAG_BITS) - 1
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+class MXRecordIO:
+    """Sequential reader/writer (ref: recordio.py MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.pid = None
+        self.fid = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.fid = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.fid = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise MXNetError(f"invalid flag {self.flag!r}")
+        self.pid = os.getpid()
+
+    def close(self):
+        if self.fid is not None and not self.fid.closed:
+            self.fid.close()
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["fid"] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self.open()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self.fid.tell()
+
+    def write(self, buf):
+        assert self.writable
+        length = len(buf)
+        upper = 0  # single-record framing (no continuation chunks needed)
+        self.fid.write(struct.pack("<II", _MAGIC,
+                                   (upper << _LFLAG_BITS) | length))
+        self.fid.write(buf)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.fid.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        hdr = self.fid.read(8)
+        if len(hdr) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", hdr)
+        if magic != _MAGIC:
+            raise MXNetError("invalid RecordIO magic")
+        length = lrec & _LFLAG_MASK
+        buf = self.fid.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.fid.read(pad)
+        return buf
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access reader/writer with .idx sidecar
+    (ref: recordio.py MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    if len(parts) >= 2:
+                        key = self.key_type(parts[0])
+                        self.idx[key] = int(parts[1])
+                        self.keys.append(key)
+
+    def close(self):
+        if self.writable and self.fid is not None and not self.fid.closed:
+            with open(self.idx_path, "w") as fout:
+                for key in self.keys:
+                    fout.write(f"{key}\t{self.idx[key]}\n")
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        self.fid.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        self.idx[key] = self.tell()
+        self.keys.append(key)
+        self.write(buf)
+
+
+def pack(header, s):
+    """Pack IRHeader + payload (ref: recordio.py pack). Scalar labels go in
+    the header (flag=0); vector labels set flag=len and follow the header."""
+    header = IRHeader(*header)
+    label = header.label
+    if np.isscalar(label):
+        return struct.pack(_IR_FORMAT, int(header.flag), float(label),
+                           header.id, header.id2) + s
+    label = np.asarray(label, np.float32).ravel()
+    hdr = struct.pack(_IR_FORMAT, len(label), 0.0, header.id, header.id2)
+    return hdr + label.tobytes() + s
+
+
+def unpack(s):
+    """Unpack a record into (IRHeader, payload)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Encode image + pack (requires cv2 or PIL for JPEG; raw npy always
+    available)."""
+    try:
+        import cv2
+        ret, buf = cv2.imencode(img_fmt, img,
+                                [cv2.IMWRITE_JPEG_QUALITY, quality])
+        assert ret
+        return pack(header, buf.tobytes())
+    except ImportError:
+        bio = io.BytesIO()
+        np.save(bio, np.asarray(img))
+        return pack(header, bio.getvalue())
+
+
+def unpack_img(s, iscolor=-1):
+    header, payload = unpack(s)
+    if payload[:6] == b"\x93NUMPY":
+        img = np.load(io.BytesIO(payload))
+    else:
+        try:
+            import cv2
+            img = cv2.imdecode(np.frombuffer(payload, np.uint8), iscolor)
+        except ImportError:
+            raise MXNetError("cannot decode JPEG without cv2; pack with "
+                             "raw npy payloads in this environment")
+    return header, img

@@ -1,0 +1,319 @@
+"""Gluon API tests (ref: tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(3, 4))
+    p.initialize(init="xavier")
+    assert p.data().shape == (3, 4)
+    assert p.grad().shape == (3, 4)
+    p.set_data(nd.ones((3, 4)))
+    assert p.data().asnumpy().sum() == 12
+
+
+def test_parameter_deferred_init():
+    dense = nn.Dense(5)
+    dense.initialize()
+    with pytest.raises(Exception):
+        dense.weight.data()
+    out = dense(nd.ones((2, 7)))
+    assert out.shape == (2, 5)
+    assert dense.weight.shape == (5, 7)
+
+
+def test_block_naming_and_collect():
+    net = nn.HybridSequential(prefix="model_")
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3))
+        net.add(nn.Dense(2, in_units=4))
+    params = net.collect_params()
+    names = list(params.keys())
+    assert all(n.startswith("model_dense") for n in names), names
+    assert len(names) == 4  # 2 weights + 2 biases
+    sel = net.collect_params(".*weight")
+    assert len(list(sel.keys())) == 2
+
+
+def test_dense_forward_values():
+    d = nn.Dense(3, in_units=2, use_bias=True)
+    d.initialize(mx.init.One())
+    x = nd.array([[1.0, 2.0]])
+    out = d(x)
+    assert_almost_equal(out, [[3.0, 3.0, 3.0]])
+
+
+def test_sequential_train_converges():
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    X = nd.array(np.random.randn(128, 4).astype(np.float32))
+    y = nd.array((np.random.randn(128, 4).astype(np.float32).sum(1) > 0)
+                 .astype(np.float32)) if False else \
+        nd.array((X.asnumpy().sum(1) > 0).astype(np.float32))
+    lossfn = gluon.loss.SoftmaxCrossEntropyLoss()
+    first = None
+    for _ in range(40):
+        with autograd.record():
+            # per-sample losses; step(batch_size) applies the 1/N rescale
+            loss = lossfn(net(X), y)
+        loss.backward()
+        trainer.step(128)
+        if first is None:
+            first = float(loss.mean().asscalar())
+    assert float(loss.mean().asscalar()) < first * 0.5
+
+
+def test_hybridize_consistency():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.LayerNorm(), nn.Dense(3))
+    net.initialize()
+    x = nd.random.normal(shape=(4, 6))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-5, atol=1e-6)
+    # second call hits the jit cache
+    hybrid2 = net(x).asnumpy()
+    np.testing.assert_allclose(hybrid, hybrid2)
+
+
+def test_hybridize_backward():
+    net = nn.Dense(1, in_units=3)
+    net.initialize(mx.init.One())
+    net.hybridize()
+    x = nd.array([[1.0, 2.0, 3.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = net(x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, [[1.0, 1.0, 1.0]])
+    assert net.weight.data().grad is None or True
+
+
+def test_hybridize_param_grads():
+    net = nn.Dense(2, in_units=3, use_bias=False)
+    net.initialize(mx.init.One())
+    net.hybridize()
+    x = nd.ones((4, 3))
+    with autograd.record():
+        y = net(x).sum()
+    y.backward()
+    g = net.weight.grad()
+    assert_almost_equal(g, 4 * np.ones((2, 3)))
+
+
+def test_batchnorm_running_stats_eager_and_hybrid():
+    for hybrid in (False, True):
+        bn = nn.BatchNorm(in_channels=3, momentum=0.5)
+        bn.initialize()
+        if hybrid:
+            bn.hybridize()
+        x = nd.array(np.random.randn(8, 3, 4, 4).astype(np.float32) * 2 + 1)
+        with autograd.record():
+            bn(x)
+        rm = bn.running_mean.data().asnumpy()
+        assert not np.allclose(rm, 0), f"hybrid={hybrid}: stats not updated"
+        # inference path uses running stats
+        out = bn(x)
+        assert out.shape == x.shape
+
+
+def test_conv_block_shapes():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1), nn.MaxPool2D(),
+            nn.Conv2D(8, 3, padding=1, strides=2), nn.GlobalAvgPool2D(),
+            nn.Flatten(), nn.Dense(5))
+    net.initialize()
+    out = net(nd.zeros((2, 3, 16, 16)))
+    assert out.shape == (2, 5)
+
+
+def test_conv_transpose():
+    net = nn.Conv2DTranspose(4, 2, strides=2, in_channels=3)
+    net.initialize()
+    out = net(nd.zeros((1, 3, 5, 5)))
+    assert out.shape == (1, 4, 10, 10)
+
+
+def test_embedding_layer():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    out = emb(nd.array([1, 2, 3]))
+    assert out.shape == (3, 4)
+
+
+def test_losses():
+    pred = nd.array(np.random.randn(4, 5).astype(np.float32))
+    label = nd.array([0, 1, 2, 3])
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    logp = np.log(np.exp(pred.asnumpy() - pred.asnumpy().max(1, keepdims=True)).T
+                  / np.exp(pred.asnumpy() - pred.asnumpy().max(1, keepdims=True)).sum(1)).T
+    expect = -logp[np.arange(4), [0, 1, 2, 3]]
+    assert_almost_equal(l, expect, rtol=1e-4, atol=1e-5)
+
+    p2 = nd.array([[0.5], [2.0]])
+    t2 = nd.array([[1.0], [1.0]])
+    l2 = gluon.loss.L2Loss()(p2, t2)
+    assert_almost_equal(l2, [0.5 * 0.25, 0.5 * 1.0])
+    l1 = gluon.loss.L1Loss()(p2, t2)
+    assert_almost_equal(l1, [0.5, 1.0])
+    hl = gluon.loss.HuberLoss()(p2, t2)
+    assert hl.shape == (2,)
+
+
+def test_ctc_loss():
+    pred = nd.array(np.random.uniform(-1, 1, (2, 20, 6)).astype(np.float32))
+    label = nd.array([[1, 2, 3, 0], [2, 2, 0, 0]])
+    loss = gluon.loss.CTCLoss()(pred, label)
+    assert loss.shape == (2,)
+    assert np.all(loss.asnumpy() > 0)
+
+
+def test_rnn_layers():
+    for layer, states in [(gluon.rnn.RNN(8, 2), 1),
+                          (gluon.rnn.LSTM(8, 2), 2),
+                          (gluon.rnn.GRU(8, 2), 1)]:
+        layer.initialize()
+        x = nd.random.normal(shape=(5, 3, 4))
+        out = layer(x)
+        assert out.shape == (5, 3, 8)
+        begin = layer.begin_state(batch_size=3)
+        out, new_states = layer(x, begin)
+        assert len(new_states) == states
+
+
+def test_rnn_cells_unroll():
+    cell = gluon.rnn.LSTMCell(6, input_size=4)
+    cell.initialize()
+    x = nd.random.normal(shape=(2, 5, 4))  # NTC
+    outputs, states = cell.unroll(5, x, layout="NTC")
+    assert outputs.shape == (2, 5, 6)
+    assert len(states) == 2
+
+
+def test_sequential_rnn_cell():
+    seq = gluon.rnn.SequentialRNNCell()
+    seq.add(gluon.rnn.LSTMCell(4, input_size=3))
+    seq.add(gluon.rnn.GRUCell(5, input_size=4))
+    seq.initialize()
+    states = seq.begin_state(batch_size=2)
+    out, new_states = seq(nd.ones((2, 3)), states)
+    assert out.shape == (2, 5)
+    assert len(new_states) == 3
+
+
+def test_save_load_parameters(tmp_path):
+    f = str(tmp_path / "net.params")
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize()
+    net.save_parameters(f)
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net2.load_parameters(f)
+    x = nd.ones((1, 3))
+    np.testing.assert_allclose(net(x).asnumpy(), net2(x).asnumpy())
+
+
+def test_trainer_lr_and_states(tmp_path):
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9})
+    with autograd.record():
+        loss = net(nd.ones((1, 2))).sum()
+    loss.backward()
+    tr.step(1)
+    assert tr.learning_rate == 0.1
+    tr.set_learning_rate(0.01)
+    assert tr.learning_rate == 0.01
+    f = str(tmp_path / "trainer.states")
+    tr.save_states(f)
+    tr.load_states(f)
+
+
+def test_zoneout_dropout_cells():
+    cell = gluon.rnn.DropoutCell(0.3)
+    out, states = cell(nd.ones((2, 4)), [])
+    assert out.shape == (2, 4)
+
+
+def test_split_and_load():
+    data = nd.array(np.arange(12).reshape(6, 2))
+    parts = gluon.utils.split_data(data, 3)
+    assert [p.shape for p in parts] == [(2, 2)] * 3
+    loaded = gluon.utils.split_and_load(data, [mx.cpu(), mx.cpu()])
+    assert len(loaded) == 2
+
+
+def test_clip_global_norm():
+    arrays = [nd.ones((2, 2)) * 3, nd.ones((3,)) * 4]
+    total = gluon.utils.clip_global_norm(arrays, 1.0)
+    new_total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert new_total < 1.01
+
+
+def test_model_zoo_variants():
+    for name in ("resnet18_v1", "resnet18_v2", "mobilenet0.25",
+                 "mobilenetv2_0.25", "squeezenet1.0"):
+        net = gluon.model_zoo.vision.get_model(name, classes=10)
+        net.initialize()
+        out = net(nd.random.uniform(shape=(1, 3, 64, 64)))
+        assert out.shape == (1, 10), name
+
+
+def test_custom_hybrid_block():
+    class Residual(nn.HybridBlock):
+        def __init__(self, units, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.dense = nn.Dense(units, flatten=False)
+
+        def hybrid_forward(self, F, x):
+            return F.relu(self.dense(x)) + x
+
+    blk = Residual(6)
+    blk.initialize()
+    x = nd.random.normal(shape=(2, 6))
+    out = blk(x)
+    blk.hybridize()
+    out2 = blk(x)
+    np.testing.assert_allclose(out.asnumpy(), out2.asnumpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_dataset_dataloader():
+    X = np.random.randn(20, 3).astype(np.float32)
+    y = np.arange(20, dtype=np.float32)
+    ds = gluon.data.ArrayDataset(X, y)
+    assert len(ds) == 20
+    loader = gluon.data.DataLoader(ds, batch_size=6, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 4
+    xb, yb = batches[0]
+    assert xb.shape == (6, 3)
+    loader2 = gluon.data.DataLoader(ds, batch_size=5, shuffle=True,
+                                    num_workers=2)
+    seen = sorted(float(v) for _, yb in loader2 for v in yb.asnumpy())
+    assert seen == sorted(y.tolist())
+
+
+def test_transforms():
+    from mxnet_tpu.gluon.data.vision import transforms
+    img = nd.array(np.random.randint(0, 255, (8, 8, 3)).astype(np.uint8))
+    t = transforms.ToTensor()(img)
+    assert t.shape == (3, 8, 8)
+    assert float(t.asnumpy().max()) <= 1.0
+    norm = transforms.Normalize([0.5, 0.5, 0.5], [0.2, 0.2, 0.2])(t)
+    assert norm.shape == (3, 8, 8)
+    r = transforms.Resize(4)(img)
+    assert r.shape == (4, 4, 3)

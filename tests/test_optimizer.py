@@ -1,0 +1,188 @@
+"""Optimizer/metric/lr-scheduler/initializer tests
+(ref: tests/python/unittest/test_optimizer.py etc.)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _quad_opt_steps(opt_name, steps=60, **kwargs):
+    """Minimize f(w) = ||w - 3||^2 with the given optimizer."""
+    opt = mx.optimizer.create(opt_name, **kwargs)
+    updater = mx.optimizer.get_updater(opt)
+    w = nd.zeros((4,))
+    for _ in range(steps):
+        grad = 2 * (w - 3)
+        updater(0, grad, w)
+    return w.asnumpy()
+
+
+@pytest.mark.parametrize("name,kwargs", [
+    ("sgd", {"learning_rate": 0.1}),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.3}),
+    ("nag", {"learning_rate": 0.05, "momentum": 0.9}),
+    ("rmsprop", {"learning_rate": 0.1}),
+    ("adagrad", {"learning_rate": 1.0}),
+    ("adadelta", {"rho": 0.9, "epsilon": 1e-2}),
+    ("adamax", {"learning_rate": 0.5}),
+    ("nadam", {"learning_rate": 0.3}),
+    ("ftml", {"learning_rate": 0.3}),
+    ("signum", {"learning_rate": 0.1}),
+])
+def test_optimizers_converge(name, kwargs):
+    w = _quad_opt_steps(name, **kwargs)
+    assert np.abs(w - 3).max() < 0.5, f"{name}: {w}"
+
+
+def test_sgd_exact_steps():
+    opt = mx.optimizer.SGD(learning_rate=0.1)
+    w = nd.array([1.0])
+    g = nd.array([2.0])
+    opt.update(0, w, g, None)
+    assert_almost_equal(w, [0.8])
+
+
+def test_sgd_momentum_math():
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.5)
+    w = nd.array([1.0])
+    state = opt.create_state(0, w)
+    opt.update(0, w, nd.array([1.0]), state)   # mom=-0.1, w=0.9
+    assert_almost_equal(w, [0.9], rtol=1e-6)
+    opt.update(0, w, nd.array([1.0]), state)   # mom=-0.15, w=0.75
+    assert_almost_equal(w, [0.75], rtol=1e-6)
+
+
+def test_weight_decay_and_clip():
+    opt = mx.optimizer.SGD(learning_rate=0.1, wd=0.1)
+    w = nd.array([1.0])
+    opt.update(0, w, nd.array([0.0]), None)
+    assert_almost_equal(w, [0.99], rtol=1e-6)  # pure decay
+    opt2 = mx.optimizer.SGD(learning_rate=1.0, clip_gradient=0.5)
+    w2 = nd.array([0.0])
+    opt2.update(0, w2, nd.array([10.0]), None)
+    assert_almost_equal(w2, [-0.5], rtol=1e-6)
+
+
+def test_multi_precision():
+    opt = mx.optimizer.SGD(learning_rate=0.1, multi_precision=True,
+                           momentum=0.9)
+    w = nd.array(np.ones(4, np.float16))
+    state = opt.create_state_multi_precision(0, w)
+    assert isinstance(state, tuple) and state[0].dtype == np.float32
+    opt.update_multi_precision(0, w, nd.array(np.ones(4, np.float16)), state)
+    assert w.dtype == np.float16
+
+
+def test_lr_mult_and_idx2name():
+    opt = mx.optimizer.SGD(learning_rate=1.0,
+                           param_idx2name={0: "a_weight", 1: "b_bias"})
+    opt.set_lr_mult({"a_weight": 0.1})
+    w0, w1 = nd.array([1.0]), nd.array([1.0])
+    opt.update(0, w0, nd.array([1.0]), None)
+    opt.update(1, w1, nd.array([1.0]), None)
+    assert_almost_equal(w0, [0.9], rtol=1e-6)
+    assert_almost_equal(w1, [0.0], rtol=1e-6)
+
+
+def test_lr_schedulers():
+    s = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(1) == 1.0
+    assert s(11) == 0.5
+    assert s(21) == 0.25
+    m = mx.lr_scheduler.MultiFactorScheduler(step=[5, 15], factor=0.1,
+                                             base_lr=1.0)
+    assert m(1) == 1.0
+    assert m(6) == pytest.approx(0.1)
+    assert m(16) == pytest.approx(0.01)
+    p = mx.lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0, pwr=1)
+    assert p(0) == pytest.approx(1.0)
+    assert p(50) == pytest.approx(0.5)
+    c = mx.lr_scheduler.CosineScheduler(max_update=100, base_lr=1.0)
+    assert c(0) == pytest.approx(1.0)
+    assert c(100) == pytest.approx(0.0, abs=1e-6)
+    w = mx.lr_scheduler.FactorScheduler(step=100, base_lr=1.0,
+                                        warmup_steps=10, warmup_begin_lr=0.0)
+    assert w(5) == pytest.approx(0.5)
+
+
+def test_metrics_accuracy():
+    acc = mx.metric.Accuracy()
+    pred = nd.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    label = nd.array([1, 0, 0])
+    acc.update(label, pred)
+    assert acc.get() == ("accuracy", pytest.approx(2 / 3))
+    acc.reset()
+    assert np.isnan(acc.get()[1])
+
+
+def test_metrics_topk_f1_mse():
+    topk = mx.metric.TopKAccuracy(top_k=2)
+    pred = nd.array([[0.3, 0.4, 0.3], [0.1, 0.2, 0.7]])
+    topk.update(nd.array([0, 0]), pred)
+    assert topk.get()[1] == pytest.approx(0.5)
+
+    mse = mx.metric.MSE()
+    mse.update(nd.array([1.0, 2.0]), nd.array([1.5, 2.0]))
+    assert mse.get()[1] == pytest.approx(0.125)
+
+    f1 = mx.metric.F1()
+    f1.update(nd.array([1, 0, 1, 1]), nd.array([[0.2, 0.8], [0.8, 0.2],
+                                                [0.1, 0.9], [0.9, 0.1]]))
+    assert 0 < f1.get()[1] <= 1
+
+    comp = mx.metric.CompositeEvalMetric()
+    comp.add(mx.metric.Accuracy())
+    comp.add(mx.metric.CrossEntropy())
+    comp.update(nd.array([1.0]), nd.array([[0.3, 0.7]]))
+    names, values = comp.get()
+    assert len(names) == 2
+
+
+def test_metric_perplexity():
+    pp = mx.metric.Perplexity()
+    pred = nd.array([[0.25, 0.75], [0.5, 0.5]])
+    pp.update(nd.array([1, 0]), pred)
+    expect = np.exp(-(np.log(0.75) + np.log(0.5)) / 2)
+    assert pp.get()[1] == pytest.approx(expect, rel=1e-4)
+
+
+def test_initializers():
+    for name, check in [
+        ("zeros", lambda a: np.allclose(a, 0)),
+        ("ones", lambda a: np.allclose(a, 1)),
+        ("uniform", lambda a: np.abs(a).max() <= 0.07 + 1e-6),
+        ("normal", lambda a: np.abs(a).std() < 0.1),
+        ("xavier", lambda a: np.isfinite(a).all()),
+    ]:
+        w = nd.zeros((8, 8))
+        mx.init.create(name)("test_weight", w)
+        assert check(w.asnumpy()), name
+    # orthogonal: W W^T = I * scale^2
+    w = nd.zeros((4, 4))
+    mx.init.Orthogonal(scale=1.0)("q_weight", w)
+    a = w.asnumpy()
+    np.testing.assert_allclose(a @ a.T, np.eye(4), atol=1e-5)
+    # bias routing
+    b = nd.ones((5,))
+    mx.init.Xavier()("fc_bias", b)
+    assert np.allclose(b.asnumpy(), 0)
+    # LSTMBias: forget gate = 1
+    b = nd.zeros((8,))
+    mx.init.LSTMBias(forget_bias=1.0)("lstm_i2h_bias", b)
+    expect = np.zeros(8)
+    expect[2:4] = 1
+    np.testing.assert_allclose(b.asnumpy(), expect)
+
+
+def test_updater_states_roundtrip():
+    opt = mx.optimizer.Adam()
+    upd = mx.optimizer.get_updater(opt)
+    w = nd.ones((3,))
+    upd(0, nd.ones((3,)), w)
+    blob = upd.get_states()
+    upd2 = mx.optimizer.get_updater(mx.optimizer.Adam())
+    upd2.set_states(blob)
+    assert 0 in upd2.states
