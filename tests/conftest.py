@@ -1,20 +1,20 @@
-"""Test config: force an 8-device virtual CPU mesh BEFORE jax import.
-
-Mirrors the reference's strategy of testing distributed paths with local
-multi-process "clusters" (SURVEY.md §4): here the mesh is 8 virtual CPU
-devices so sharding/collective code paths compile and run without TPU
-hardware.
+"""Test fixtures. The CPU-mesh bootstrap lives in tests_bootstrap.py
+(loaded via pytest.ini addopts) — it must run before pytest installs fd
+capture, which a conftest cannot. By the time this file imports, the
+process is already on the 8-device virtual CPU mesh.
 """
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # hard override (axon env presets "axon")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+import numpy as np
+import pytest
 
-import numpy as np  # noqa: E402
-import pytest  # noqa: E402
+# Defensive: if someone bypasses pytest.ini (e.g. `pytest -p no:cacheprovider
+# -c /dev/null`), fail loudly rather than running on the real chip where
+# bf16 matmul breaks fp32 tolerances.
+if os.environ.get("MXNET_TPU_TEST_CPU_MESH") != "1":
+    raise RuntimeError(
+        "tests must run through tests_bootstrap (pytest.ini addopts); "
+        "run `python -m pytest tests/` from the repo root")
 
 
 @pytest.fixture(autouse=True)

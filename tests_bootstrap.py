@@ -1,0 +1,34 @@
+"""pytest bootstrap plugin: re-exec onto an 8-device virtual CPU mesh.
+
+Loaded via ``addopts = -p tests_bootstrap`` (pytest.ini) so this import runs
+during early config parsing — BEFORE pytest installs fd-level capture and
+before any conftest import. That matters twice over:
+
+1. The axon sitecustomize (PYTHONPATH=/root/.axon_site) registers the TPU
+   PJRT plugin at interpreter startup, locking jax to the single real chip
+   no matter what JAX_PLATFORMS says afterwards. Only a fresh interpreter
+   with a cleaned environment can get the CPU backend.
+2. Re-execing any later (e.g. from a conftest) would hand the child the
+   already-redirected capture fds, silently eating all test output.
+
+Mirrors the reference's test strategy (SURVEY.md §4): distributed paths are
+exercised on a local virtual "cluster" — here 8 virtual CPU devices via
+--xla_force_host_platform_device_count so sharding/collective code compiles
+and runs without TPU hardware.
+"""
+import os
+import sys
+
+_SENTINEL = "MXNET_TPU_TEST_CPU_MESH"
+
+if os.environ.get(_SENTINEL) != "1":
+    env = dict(os.environ)
+    env[_SENTINEL] = "1"
+    env["PYTHONPATH"] = ""  # drop /root/.axon_site sitecustomize
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.execve(sys.executable,
+              [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
