@@ -1,0 +1,41 @@
+"""Root conftest: re-exec the test run onto an 8-device virtual CPU mesh.
+
+The axon sitecustomize (PYTHONPATH=/root/.axon_site) registers the TPU
+PJRT plugin at interpreter startup, locking jax to the single real chip
+no matter what JAX_PLATFORMS says afterwards — so env tweaks inside this
+process are too late, and only a fresh interpreter with a cleaned
+environment gets the CPU backend. The re-exec runs from pytest_configure
+(works for both `pytest` and `python -m pytest`), after asking the
+capture manager to restore the real stdout/stderr fds so the child's
+output is visible.
+
+Mirrors the reference's test strategy (SURVEY.md §4): distributed paths
+are exercised on a local virtual "cluster" — here 8 virtual CPU devices
+via --xla_force_host_platform_device_count so sharding/collective code
+compiles and runs without TPU hardware.
+"""
+import os
+import sys
+
+_SENTINEL = "MXNET_TPU_TEST_CPU_MESH"
+
+
+def pytest_configure(config):
+    if os.environ.get(_SENTINEL) == "1":
+        return
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        capman.stop_global_capturing()  # hand the child the real fds
+    env = dict(os.environ)
+    env[_SENTINEL] = "1"
+    # drop only the axon sitecustomize dir; keep the rest of PYTHONPATH
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon_site" not in p)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.execve(sys.executable,
+              [sys.executable, "-m", "pytest"] + sys.argv[1:], env)

@@ -16,6 +16,15 @@ class RecurrentCell(HybridBlock):
     def state_info(self, batch_size=0):
         raise NotImplementedError
 
+    def reset(self):
+        """Reset before re-use, e.g. at the start of each unroll
+        (ref: rnn_cell.py BaseRNNCell.reset). Clears per-sequence state in
+        modifier cells (zoneout prev-output, variational dropout masks)."""
+        self._modified = False
+        for child in self._children.values():
+            if isinstance(child, RecurrentCell):
+                child.reset()
+
     def begin_state(self, batch_size=0, func=None, **kwargs):
         from ... import ndarray as F
         func = func or F.zeros
@@ -25,6 +34,7 @@ class RecurrentCell(HybridBlock):
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None, valid_length=None):
         from ... import ndarray as F
+        self.reset()
         axis = layout.find("T")
         batch_axis = layout.find("N")
         batch_size = inputs.shape[batch_axis]
@@ -38,13 +48,27 @@ class RecurrentCell(HybridBlock):
             else:
                 x = F.squeeze(F.slice_axis(inputs, axis=axis, begin=t,
                                            end=t + 1), axis=axis)
-            out, states = self(x, states)
+            out, new_states = self(x, states)
+            if valid_length is not None:
+                # freeze each sequence's state at its last valid step
+                # (SequenceLast semantics, ref rnn_cell.py:?unroll)
+                still = valid_length > t  # (B,)
+                states = [F.where(F.reshape(still,
+                                            shape=(-1,) + (1,) * (new.ndim - 1)),
+                                  new, old)
+                          for new, old in zip(new_states, states)]
+            else:
+                states = new_states
             outputs.append(out)
-        if merge_outputs or merge_outputs is None:
-            outputs = F.stack(*outputs, axis=axis)
+        merged = F.stack(*outputs, axis=axis)
         if valid_length is not None:
-            outputs = F.SequenceMask(outputs, valid_length,
-                                     use_sequence_length=True, axis=axis)
+            merged = F.SequenceMask(merged, valid_length,
+                                    use_sequence_length=True, axis=axis)
+        if merge_outputs or merge_outputs is None:
+            return merged, states
+        outputs = [F.squeeze(s, axis=axis) for s in
+                   F.split(merged, num_outputs=length, axis=axis,
+                           squeeze_axis=False)]
         return outputs, states
 
     def hybrid_forward(self, F, x, states, **params):
@@ -217,6 +241,10 @@ class ModifierCell(RecurrentCell):
     def state_info(self, batch_size=0):
         return self.base_cell.state_info(batch_size)
 
+    def reset(self):
+        super().reset()
+        self.base_cell.reset()
+
 
 class DropoutCell(RecurrentCell):
     def __init__(self, rate, axes=(), prefix=None, params=None):
@@ -241,6 +269,10 @@ class ZoneoutCell(ModifierCell):
         super().__init__(base_cell)
         self.zoneout_outputs = zoneout_outputs
         self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def reset(self):
+        super().reset()
         self._prev_output = None
 
     def __call__(self, x, states):
@@ -290,6 +322,7 @@ class BidirectionalCell(RecurrentCell):
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None, valid_length=None):
         from ... import ndarray as F
+        self.reset()
         l_cell = self._children["l_cell"]
         r_cell = self._children["r_cell"]
         axis = layout.find("T")
@@ -301,9 +334,16 @@ class BidirectionalCell(RecurrentCell):
         nl = len(l_cell.state_info())
         l_out, l_states = l_cell.unroll(
             length, inputs, begin_state[:nl], layout, True, valid_length)
-        rev = F.flip(inputs, axis=axis)
+        # reverse only within each sequence's valid region so the backward
+        # pass never sees padding first (ref: SequenceReverse with
+        # use_sequence_length in BidirectionalCell.unroll)
+        rev = F.SequenceReverse(inputs, valid_length,
+                                use_sequence_length=valid_length is not None,
+                                axis=axis)
         r_out, r_states = r_cell.unroll(
             length, rev, begin_state[nl:], layout, True, valid_length)
-        r_out = F.flip(r_out, axis=axis)
+        r_out = F.SequenceReverse(r_out, valid_length,
+                                  use_sequence_length=valid_length is not None,
+                                  axis=axis)
         out = F.Concat(l_out, r_out, dim=2)
         return out, l_states + r_states

@@ -109,10 +109,23 @@ class Trainer:
                 self._updaters(i, p.grad(), p.data())
 
     def save_states(self, fname):
-        import pickle
-        with open(fname, "wb") as f:
-            f.write(self._updaters.get_states())
+        """Optimizer state checkpoint (ref: trainer.py save_states). When the
+        optimizer runs on the kvstore, the live state is the kvstore's
+        Updater, not the local one."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._kvstore is not None and self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=False)
+        else:
+            with open(fname, "wb") as f:
+                f.write(self._updaters.get_states())
 
     def load_states(self, fname):
-        with open(fname, "rb") as f:
-            self._updaters.set_states(f.read())
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._kvstore is not None and self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+            self._optimizer = self._kvstore._updater.optimizer
+        else:
+            with open(fname, "rb") as f:
+                self._updaters.set_states(f.read())

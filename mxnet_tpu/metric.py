@@ -124,17 +124,30 @@ class TopKAccuracy(EvalMetric):
             self._update(float(correct), len(label))
 
 
-@register
-class F1(EvalMetric):
-    def __init__(self, name="f1", output_names=None, label_names=None,
+class _ConfusionMatrixMetric(EvalMetric):
+    """Shared local/global binary confusion-matrix accumulation for F1/MCC.
+    average="macro": per-batch score averaged over batches (ref semantics);
+    average="micro": score of the pooled counts."""
+
+    def __init__(self, name, output_names=None, label_names=None,
                  average="macro"):
         super().__init__(name, output_names, label_names)
         self.average = average
-        self._tp = self._fp = self._fn = 0.0
+        self._local = np.zeros(4)   # tp, fp, fn, tn — local window
+        self._global = np.zeros(4)  # same, since last full reset()
 
     def reset(self):
         super().reset()
-        self._tp = self._fp = self._fn = 0.0
+        self._local = np.zeros(4)
+        self._global = np.zeros(4)
+
+    def reset_local(self):
+        super().reset_local()
+        self._local = np.zeros(4)
+
+    @staticmethod
+    def _score(c):
+        raise NotImplementedError
 
     def update(self, labels, preds):
         if isinstance(labels, NDArray):
@@ -142,58 +155,59 @@ class F1(EvalMetric):
         if isinstance(preds, NDArray):
             preds = [preds]
         for label, pred in zip(labels, preds):
-            pred = _as_np(pred)
-            label = _as_np(label).ravel().astype(np.int64)
-            if pred.ndim > 1:
-                pred = pred.argmax(axis=1)
-            pred = pred.ravel().astype(np.int64)
-            tp = float(((pred == 1) & (label == 1)).sum())
-            fp = float(((pred == 1) & (label == 0)).sum())
-            fn = float(((pred == 0) & (label == 1)).sum())
-            self._tp += tp
-            self._fp += fp
-            self._fn += fn
-            prec = self._tp / max(self._tp + self._fp, 1e-12)
-            rec = self._tp / max(self._tp + self._fn, 1e-12)
-            f1 = 2 * prec * rec / max(prec + rec, 1e-12)
-            self.sum_metric = f1
-            self.num_inst = 1
-            self.global_sum_metric = f1
-            self.global_num_inst = 1
+            batch = _binary_confusion(label, pred)
+            if self.average == "macro":
+                # per-batch score averaged over batches (ref semantics)
+                self._update(self._score(batch), 1)
+            else:  # micro: pooled confusion counts
+                self._local += batch
+                self._global += batch
+                self.sum_metric = self._score(self._local)
+                self.num_inst = 1
+                self.global_sum_metric = self._score(self._global)
+                self.global_num_inst = 1
+
+
+def _binary_confusion(label, pred):
+    """Return np.array([tp, fp, fn, tn]) for a binary batch."""
+    pred = _as_np(pred)
+    label = _as_np(label).ravel().astype(np.int64)
+    if pred.ndim > 1:
+        pred = pred.argmax(axis=1)
+    pred = pred.ravel().astype(np.int64)
+    return np.array([
+        float(((pred == 1) & (label == 1)).sum()),
+        float(((pred == 1) & (label == 0)).sum()),
+        float(((pred == 0) & (label == 1)).sum()),
+        float(((pred == 0) & (label == 0)).sum()),
+    ])
 
 
 @register
-class MCC(EvalMetric):
-    def __init__(self, name="mcc", output_names=None, label_names=None):
-        super().__init__(name, output_names, label_names)
-        self._tp = self._fp = self._fn = self._tn = 0.0
+class F1(_ConfusionMatrixMetric):
+    def __init__(self, name="f1", output_names=None, label_names=None,
+                 average="macro"):
+        super().__init__(name, output_names, label_names, average)
 
-    def reset(self):
-        super().reset()
-        self._tp = self._fp = self._fn = self._tn = 0.0
+    @staticmethod
+    def _score(c):
+        tp, fp, fn, _ = c
+        prec = tp / max(tp + fp, 1e-12)
+        rec = tp / max(tp + fn, 1e-12)
+        return 2 * prec * rec / max(prec + rec, 1e-12)
 
-    def update(self, labels, preds):
-        if isinstance(labels, NDArray):
-            labels = [labels]
-        if isinstance(preds, NDArray):
-            preds = [preds]
-        for label, pred in zip(labels, preds):
-            pred = _as_np(pred)
-            label = _as_np(label).ravel().astype(np.int64)
-            if pred.ndim > 1:
-                pred = pred.argmax(axis=1)
-            pred = pred.ravel().astype(np.int64)
-            self._tp += float(((pred == 1) & (label == 1)).sum())
-            self._fp += float(((pred == 1) & (label == 0)).sum())
-            self._fn += float(((pred == 0) & (label == 1)).sum())
-            self._tn += float(((pred == 0) & (label == 0)).sum())
-            denom = np.sqrt((self._tp + self._fp) * (self._tp + self._fn)
-                            * (self._tn + self._fp) * (self._tn + self._fn))
-            mcc = (self._tp * self._tn - self._fp * self._fn) / max(denom, 1e-12)
-            self.sum_metric = mcc
-            self.num_inst = 1
-            self.global_sum_metric = mcc
-            self.global_num_inst = 1
+
+@register
+class MCC(_ConfusionMatrixMetric):
+    def __init__(self, name="mcc", output_names=None, label_names=None,
+                 average="macro"):
+        super().__init__(name, output_names, label_names, average)
+
+    @staticmethod
+    def _score(c):
+        tp, fp, fn, tn = c
+        denom = np.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+        return (tp * tn - fp * fn) / max(denom, 1e-12)
 
 
 @register

@@ -8,7 +8,6 @@ fp32 master weights, num_update-driven schedules) matches the reference.
 """
 from __future__ import annotations
 
-import functools
 import math
 
 import jax
@@ -138,11 +137,6 @@ class Optimizer:
         if isinstance(grad, RowSparseNDArray):
             return grad.tostype("default")
         return grad
-
-
-@functools.lru_cache(maxsize=None)
-def _jit(fn):
-    return jax.jit(fn)
 
 
 @register
@@ -510,6 +504,29 @@ class LBSGD(SGD):
                  warmup_epochs=5, batch_scale=1, updates_per_epoch=32,
                  begin_epoch=0, num_epochs=60, **kwargs):
         super().__init__(momentum=momentum, **kwargs)
+        if warmup_strategy not in ("linear", "power2", "sqrt", "lars"):
+            raise ValueError(f"unknown warmup_strategy {warmup_strategy!r}")
+        self.warmup_strategy = warmup_strategy
+        self.warmup_updates = int(warmup_epochs * updates_per_epoch)
+        self.batch_scale = batch_scale
+        self.init_updates = int(begin_epoch * updates_per_epoch)
+
+    def _get_lr(self, index):
+        """Warm the lr up over the first warmup_epochs toward
+        batch_scale × base lr (ref: optimizer.py LBSGD._get_lr)."""
+        lr = super()._get_lr(index)
+        nup = max(self.num_update - self.init_updates, 0)
+        target = lr * self.batch_scale
+        if nup >= self.warmup_updates or self.warmup_updates == 0:
+            return target
+        frac = nup / self.warmup_updates
+        if self.warmup_strategy == "linear":
+            return lr + (target - lr) * frac
+        if self.warmup_strategy == "power2":
+            return lr + (target - lr) * frac * frac
+        if self.warmup_strategy == "sqrt":
+            return lr + (target - lr) * (frac ** 0.5)
+        return lr  # "lars": constant base lr during warmup
 
     @staticmethod
     @jax.jit

@@ -1,20 +1,9 @@
-"""Test fixtures. The CPU-mesh bootstrap lives in tests_bootstrap.py
-(loaded via pytest.ini addopts) — it must run before pytest installs fd
-capture, which a conftest cannot. By the time this file imports, the
-process is already on the 8-device virtual CPU mesh.
+"""Test fixtures. The CPU-mesh bootstrap lives in the root conftest.py
+(pytest_configure re-exec) — by test time the process is already on the
+8-device virtual CPU mesh.
 """
-import os
-
 import numpy as np
 import pytest
-
-# Defensive: if someone bypasses pytest.ini (e.g. `pytest -p no:cacheprovider
-# -c /dev/null`), fail loudly rather than running on the real chip where
-# bf16 matmul breaks fp32 tolerances.
-if os.environ.get("MXNET_TPU_TEST_CPU_MESH") != "1":
-    raise RuntimeError(
-        "tests must run through tests_bootstrap (pytest.ini addopts); "
-        "run `python -m pytest tests/` from the repo root")
 
 
 @pytest.fixture(autouse=True)

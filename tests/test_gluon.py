@@ -317,3 +317,123 @@ def test_transforms():
     assert norm.shape == (3, 8, 8)
     r = transforms.Resize(4)(img)
     assert r.shape == (4, 4, 3)
+
+
+def test_unroll_valid_length_states():
+    """States must freeze at each sequence's last valid step
+    (regression: padding used to contaminate returned states)."""
+    from mxnet_tpu.gluon import rnn
+    cell = rnn.RNNCell(4, input_size=3)
+    cell.initialize()
+    T, B = 6, 2
+    x = nd.array(np.random.randn(B, T, 3).astype(np.float32))
+    vl = nd.array([3.0, 6.0])
+    out, states = cell.unroll(T, x, layout="NTC", valid_length=vl)
+    # sequence 0: state after unrolling only its first 3 steps
+    _, states3 = cell.unroll(3, nd.array(x.asnumpy()[:, :3]), layout="NTC")
+    np.testing.assert_allclose(states[0].asnumpy()[0],
+                               states3[0].asnumpy()[0], rtol=1e-5, atol=1e-6)
+    # masked outputs are zero past valid_length
+    assert np.all(out.asnumpy()[0, 3:] == 0)
+
+
+def test_bidirectional_valid_length():
+    """Reverse direction must start at the last VALID step, not padding."""
+    from mxnet_tpu.gluon import rnn
+    l, r = rnn.RNNCell(4, input_size=3), rnn.RNNCell(4, input_size=3)
+    bi = rnn.BidirectionalCell(l, r)
+    bi.initialize()
+    T, B = 5, 2
+    x = np.random.randn(B, T, 3).astype(np.float32)
+    vl = nd.array([2.0, 5.0])
+    out, _ = bi.unroll(T, nd.array(x), layout="NTC", valid_length=vl)
+    # for seq 0 (len 2) the reverse pass over just the valid prefix must
+    # match a bidirectional unroll of the truncated sequence
+    bi2 = rnn.BidirectionalCell(l, r)
+    out2, _ = bi2.unroll(2, nd.array(x[:, :2]), layout="NTC")
+    np.testing.assert_allclose(out.asnumpy()[0, :2], out2.asnumpy()[0],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_zoneout_reset_between_unrolls():
+    from mxnet_tpu.gluon import rnn
+    cell = rnn.ZoneoutCell(rnn.RNNCell(4, input_size=3), zoneout_outputs=0.5)
+    cell.initialize()
+    x8 = nd.array(np.random.randn(8, 3, 3).astype(np.float32))
+    x2 = nd.array(np.random.randn(2, 3, 3).astype(np.float32))
+    with autograd.record():
+        cell.unroll(3, x8, layout="NTC")
+        # used to crash: stale _prev_output from the bs=8 batch
+        cell.unroll(3, x2, layout="NTC")
+
+
+def test_f1_mcc_local_global():
+    from mxnet_tpu import metric
+    m = metric.F1(average="micro")
+    labels = nd.array([1.0, 1.0, 0.0, 0.0])
+    preds = nd.array([[0.1, 0.9], [0.8, 0.2], [0.2, 0.8], [0.9, 0.1]])
+    m.update(labels, preds)
+    _, f1_a = m.get()
+    m.reset_local()
+    perfect_l = nd.array([1.0, 0.0])
+    perfect_p = nd.array([[0.0, 1.0], [1.0, 0.0]])
+    m.update(perfect_l, perfect_p)
+    _, f1_local = m.get()
+    assert f1_local == 1.0  # local window sees only the perfect batch
+    _, f1_global = m.get_global()
+    assert f1_local > f1_global > 0  # global still includes first batch
+    mc = metric.MCC(average="micro")
+    mc.update(perfect_l, perfect_p)
+    _, v = mc.get()
+    assert abs(v - 1.0) < 1e-9
+
+
+def test_trainer_save_load_states():
+    import tempfile, os
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 0.1})
+    x = nd.array(np.random.randn(4, 3).astype(np.float32))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    tr.step(4)
+    with tempfile.TemporaryDirectory() as d:
+        fname = os.path.join(d, "trainer.states")
+        tr.save_states(fname)
+        assert os.path.getsize(fname) > 0
+        tr2 = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.1})
+        tr2.load_states(fname)
+        s1 = tr._updaters.states
+        s2 = tr2._updaters.states
+        assert set(s1.keys()) == set(s2.keys()) and len(s1) > 0
+
+
+def test_lbsgd_warmup():
+    from mxnet_tpu import optimizer as opt
+    o = opt.create("lbsgd", learning_rate=0.1, warmup_strategy="linear",
+                   warmup_epochs=1, updates_per_epoch=10, batch_scale=4)
+    w = nd.array(np.ones(4, np.float32))
+    g = nd.array(np.ones(4, np.float32) * 0.1)
+    state = o.create_state(0, w)
+    lrs = []
+    for _ in range(12):
+        o.update(0, w, g, state)
+        lrs.append(o._get_lr(0))
+    assert lrs[-1] == pytest.approx(0.4)  # reaches batch_scale * lr
+    assert lrs[0] < lrs[5] < lrs[-1]      # monotone warmup
+
+
+def test_unroll_unmerged_valid_length():
+    """Regression: merge_outputs=False + valid_length used to crash."""
+    from mxnet_tpu.gluon import rnn
+    cell = rnn.RNNCell(4, input_size=3)
+    cell.initialize()
+    x = nd.array(np.random.randn(2, 5, 3).astype(np.float32))
+    outs, _ = cell.unroll(5, x, layout="NTC", merge_outputs=False,
+                          valid_length=nd.array([2.0, 5.0]))
+    assert isinstance(outs, list) and len(outs) == 5
+    assert outs[0].shape == (2, 4)
+    assert np.all(outs[3].asnumpy()[0] == 0)  # masked past valid_length
