@@ -37,5 +37,8 @@ def pytest_configure(config):
     if "xla_force_host_platform_device_count" not in flags:
         env["XLA_FLAGS"] = (
             flags + " --xla_force_host_platform_device_count=8").strip()
+    # rebuild from pytest's own parsed invocation args, not sys.argv —
+    # they differ when pytest is started via pytest.main([...])
+    args = list(config.invocation_params.args)
     os.execve(sys.executable,
-              [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
+              [sys.executable, "-m", "pytest"] + args, env)

@@ -20,7 +20,6 @@ class RecurrentCell(HybridBlock):
         """Reset before re-use, e.g. at the start of each unroll
         (ref: rnn_cell.py BaseRNNCell.reset). Clears per-sequence state in
         modifier cells (zoneout prev-output, variational dropout masks)."""
-        self._modified = False
         for child in self._children.values():
             if isinstance(child, RecurrentCell):
                 child.reset()
@@ -60,15 +59,18 @@ class RecurrentCell(HybridBlock):
             else:
                 states = new_states
             outputs.append(out)
+        if not merge_outputs and merge_outputs is not None \
+                and valid_length is None:
+            return outputs, states
         merged = F.stack(*outputs, axis=axis)
         if valid_length is not None:
             merged = F.SequenceMask(merged, valid_length,
                                     use_sequence_length=True, axis=axis)
         if merge_outputs or merge_outputs is None:
             return merged, states
-        outputs = [F.squeeze(s, axis=axis) for s in
-                   F.split(merged, num_outputs=length, axis=axis,
-                           squeeze_axis=False)]
+        outputs = list(F.split(merged, num_outputs=length, axis=axis,
+                               squeeze_axis=True)) if length > 1 else \
+            [F.squeeze(merged, axis=axis)]
         return outputs, states
 
     def hybrid_forward(self, F, x, states, **params):
@@ -235,15 +237,17 @@ class SequentialRNNCell(RecurrentCell):
 
 class ModifierCell(RecurrentCell):
     def __init__(self, base_cell):
+        if base_cell._modified:
+            raise MXNetError(
+                f"cell {base_cell.name} is already wrapped by a modifier "
+                "cell; double-wrapping (e.g. Zoneout(Zoneout(c))) is not "
+                "allowed")
+        base_cell._modified = True
         super().__init__(prefix=base_cell.prefix + "mod_", params=None)
         self.base_cell = base_cell
 
     def state_info(self, batch_size=0):
         return self.base_cell.state_info(batch_size)
-
-    def reset(self):
-        super().reset()
-        self.base_cell.reset()
 
 
 class DropoutCell(RecurrentCell):
@@ -346,4 +350,8 @@ class BidirectionalCell(RecurrentCell):
                                   use_sequence_length=valid_length is not None,
                                   axis=axis)
         out = F.Concat(l_out, r_out, dim=2)
+        if not merge_outputs and merge_outputs is not None:
+            out = list(F.split(out, num_outputs=length, axis=axis,
+                               squeeze_axis=True)) if length > 1 else \
+                [F.squeeze(out, axis=axis)]
         return out, l_states + r_states

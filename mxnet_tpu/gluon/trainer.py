@@ -129,3 +129,7 @@ class Trainer:
         else:
             with open(fname, "rb") as f:
                 self._updaters.set_states(f.read())
+            # set_states may swap in a pickled optimizer (states dumped
+            # with dump_optimizer=True); keep the trainer's handle — and
+            # with it set_learning_rate() — pointed at the live object
+            self._optimizer = self._updaters.optimizer

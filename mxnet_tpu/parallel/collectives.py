@@ -1,0 +1,55 @@
+"""Named collective wrappers (usable inside `shard_map`/`pmap` bodies).
+
+These are the TPU-native forms of the reference's communication
+primitives: `allreduce` is KVStoreNCCL's dense allreduce
+(ref: src/kvstore/kvstore_nccl.h) and CommDevice's
+Reduce+Broadcast pair (ref: src/kvstore/comm.h:451) as a single fused
+XLA collective over ICI; `reduce_scatter`/`allgather` are the
+decomposition CommDeviceTree hand-builds from link topology
+(ref: src/kvstore/comm_tree.h:50); `ppermute_next` is the ring step that
+tree never had but the torus wants.
+"""
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def axis_size(axis_name):
+    return lax.psum(1, axis_name)
+
+
+def axis_index(axis_name):
+    return lax.axis_index(axis_name)
+
+
+def allreduce(x, axis_name, op="sum"):
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "mean":
+        return lax.pmean(x, axis_name)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    raise ValueError(f"unknown allreduce op {op!r}")
+
+
+def allgather(x, axis_name, axis=0, tiled=True):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, axis=0):
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def alltoall(x, axis_name, split_axis, concat_axis):
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def ppermute_next(x, axis_name, offset=1):
+    """Rotate `x` to the next rank along `axis_name` (ring step)."""
+    size = lax.psum(1, axis_name)
+    perm = [(i, (i + offset) % size) for i in range(size)]
+    return lax.ppermute(x, axis_name, perm=perm)

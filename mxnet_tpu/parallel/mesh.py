@@ -1,0 +1,85 @@
+"""Device-mesh construction and sharding helpers.
+
+The mesh is the TPU analogue of the reference's device topology handling
+(src/kvstore/gpu_topology.h computes reduce trees from the PCIe/NVLink
+link matrix) — on TPU the ICI torus topology is XLA's problem; we only
+name the axes and choose their sizes.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def create_mesh(axes=None, devices=None):
+    """Build a `jax.sharding.Mesh`.
+
+    Parameters
+    ----------
+    axes : dict[str, int] | None
+        Ordered mapping of axis name -> size, e.g. ``{"dp": 2, "tp": 4}``.
+        ``-1`` for at most one axis means "all remaining devices".
+        Default: all devices on a single ``"dp"`` axis.
+    devices : sequence of jax devices, optional
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if axes is None:
+        axes = {"dp": n}
+    axes = dict(axes)
+    known = [s for s in axes.values() if s != -1]
+    wild = [k for k, s in axes.items() if s == -1]
+    if len(wild) > 1:
+        raise ValueError("at most one axis may be -1")
+    prod = math.prod(known) if known else 1
+    if wild:
+        if n % prod:
+            raise ValueError(f"{n} devices not divisible by {prod}")
+        axes[wild[0]] = n // prod
+        prod = n
+    if prod != n:
+        raise ValueError(f"mesh {axes} needs {prod} devices, have {n}")
+    arr = np.array(devices).reshape(tuple(axes.values()))
+    return Mesh(arr, tuple(axes.keys()))
+
+
+def auto_mesh_shape(n, axis_names=("dp", "tp", "sp")):
+    """Factor `n` devices over the given axes, biggest axis first.
+
+    Used by dry-run harnesses to get a non-trivial multi-axis mesh out of
+    any device count: 8 -> {"dp": 2, "tp": 2, "sp": 2}, 4 -> {"dp": 2,
+    "tp": 2, "sp": 1}, 6 -> {"dp": 3, "tp": 2, "sp": 1}.
+    """
+    shape = {a: 1 for a in axis_names}
+    names = list(axis_names)
+    i = 0
+    rem = n
+    while rem > 1:
+        # smallest prime factor of rem goes to the current axis
+        f = next((p for p in range(2, int(rem ** 0.5) + 1) if rem % p == 0),
+                 rem)
+        shape[names[i % len(names)]] *= f
+        rem //= f
+        i += 1
+    return shape
+
+
+def mesh_sharding(mesh, *spec):
+    """`NamedSharding(mesh, PartitionSpec(*spec))` shorthand."""
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_batch(batch, mesh, axis="dp"):
+    """Place a host batch onto the mesh, sharded along the leading dim.
+
+    The TPU equivalent of `DataParallelExecutorGroup.decide_slices`
+    (ref: python/mxnet/module/executor_group.py:281-310): instead of
+    slicing per-context copies, one `device_put` with a NamedSharding
+    splits the batch across the `dp` axis and replicates it over the
+    others.
+    """
+    sh = NamedSharding(mesh, P(axis))
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), batch)

@@ -1,0 +1,79 @@
+"""Expert parallelism: GShard-style top-1 routed mixture-of-experts with
+fixed capacity, experts sharded over an `ep` mesh axis and tokens moved
+by a pair of all-to-alls.
+
+New capability vs. the reference (SURVEY.md §2.3 item 7). The closest
+reference analogue is the sparse row_sparse parameter-server path
+(ref: src/kvstore/kvstore_dist.h:470 PullRowSparse) — sending only the
+needed rows; here the routing moves activations instead, over ICI.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def moe_dispatch(x, router_logits, expert_fn, axis_name="ep",
+                 capacity_factor=2.0):
+    """Top-1 routed MoE layer body (call inside `shard_map` over `ep`).
+
+    Parameters
+    ----------
+    x : [tokens_local, d_model] this device's tokens.
+    router_logits : [tokens_local, n_experts_total].
+    expert_fn : callable([n_local_experts, capacity_total, d], params-free)
+        Applies this device's experts; vmapped over its leading axis by
+        the caller's closure if needed.
+    capacity_factor : float
+        Per-expert buffer size multiplier; overflowing tokens are dropped
+        (standard GShard semantics) and pass through via the residual at
+        the call site.
+
+    Returns
+    -------
+    [tokens_local, d_model] combined expert outputs (zeros for dropped
+    tokens).
+    """
+    T, D = x.shape
+    E = router_logits.shape[-1]
+    size = lax.psum(1, axis_name)
+    assert E % size == 0, "n_experts must divide the ep axis"
+    cap = int(max(1, capacity_factor * T / E))
+
+    gates = jax.nn.softmax(router_logits, axis=-1)           # [T, E]
+    expert_idx = jnp.argmax(gates, axis=-1)                  # [T]
+    gate_val = jnp.take_along_axis(gates, expert_idx[:, None], 1)[:, 0]
+
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=x.dtype)    # [T, E]
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0          # slot per token
+    keep = (pos < cap) & (onehot > 0)                        # capacity mask
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=x.dtype)
+    dispatch = keep[..., None].astype(x.dtype) * pos_oh      # [T, E, C]
+    combine = dispatch * gate_val[:, None, None]             # [T, E, C]
+
+    # [T, E, C] x [T, D] -> [E, C, D]
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x)
+    # exchange: each device keeps its E/size experts, gathering the
+    # matching capacity slices from every peer -> [E/size, C*size, D]
+    expert_in = lax.all_to_all(expert_in, axis_name, split_axis=0,
+                               concat_axis=1, tiled=True)
+    expert_out = expert_fn(expert_in)
+    expert_out = lax.all_to_all(expert_out, axis_name, split_axis=1,
+                                concat_axis=0, tiled=True)   # [E, C, D]
+    return jnp.einsum("tec,ecd->td", combine, expert_out)
+
+
+def moe_ffn(x, router_w, w1, w2, axis_name="ep", capacity_factor=2.0,
+            act=jax.nn.gelu):
+    """Complete expert-parallel FFN: router + two-layer experts.
+
+    w1: [n_local_experts, d_model, d_hidden]; w2: [n_local_experts,
+    d_hidden, d_model]; router_w: [d_model, n_experts_total].
+    """
+    def experts(xs):  # [E_local, C_total, D]
+        h = act(jnp.einsum("ecd,edh->ech", xs, w1))
+        return jnp.einsum("ech,ehd->ecd", h, w2)
+
+    return moe_dispatch(x, x @ router_w, experts, axis_name=axis_name,
+                        capacity_factor=capacity_factor)

@@ -1,0 +1,81 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a `pp`
+mesh axis, activations circulating between stages via `ppermute` inside
+a `lax.scan`.
+
+New capability vs. the reference (SURVEY.md §2.3 item 7 — the reference
+has no pipeline parallelism; its closest analogue is manual group2ctx
+layer placement with cross-device copies).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(fn, stage_params, x, axis_name="pp",
+                   squeeze_stage_axis=True):
+    """Run a pipelined stack of stages over microbatches.
+
+    Must be called inside `shard_map` over `axis_name`; each device holds
+    the parameters of its own stage in `stage_params`.
+
+    Parameters
+    ----------
+    fn : callable(params, x_mb) -> y_mb
+        One pipeline stage; must be shape-preserving so activations can
+        circulate.
+    stage_params : pytree
+        This device's stage parameters (sharded over `axis_name` outside).
+    x : [n_micro, mb, ...] microbatched input, replicated over the axis.
+
+    Returns
+    -------
+    [n_micro, mb, ...] outputs of the final stage, replicated (the bubble
+    work on other ranks is masked out and psum-broadcast from the last
+    stage).
+    """
+    if squeeze_stage_axis:
+        # params arrive as this rank's shard of a ('pp', ...)-sharded
+        # stack (see stack_stage_params): local leading axis of size 1
+        stage_params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+    n_stage = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    n_micro = x.shape[0]
+    n_steps = n_micro + n_stage - 1
+    is_first = stage == 0
+    is_last = stage == n_stage - 1
+    perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+
+    state0 = jnp.zeros_like(x[0])
+    outs0 = jnp.zeros_like(x)
+
+    def step(carry, t):
+        state, outs = carry
+        # stage 0 ingests microbatch t; everyone else uses the activation
+        # received from the previous stage last step
+        mb = lax.dynamic_index_in_dim(x, jnp.clip(t, 0, n_micro - 1), 0,
+                                      keepdims=False)
+        inp = jnp.where(is_first, mb, state)
+        y = fn(stage_params, inp)
+        # the last stage emits microbatch t - (n_stage - 1)
+        out_idx = t - (n_stage - 1)
+        valid = jnp.logical_and(is_last, out_idx >= 0)
+        outs = lax.cond(
+            valid,
+            lambda o: lax.dynamic_update_index_in_dim(
+                o, y, jnp.clip(out_idx, 0, n_micro - 1), 0),
+            lambda o: o, outs)
+        state = lax.ppermute(y, axis_name, perm)
+        return (state, outs), None
+
+    (_, outs), _ = lax.scan(step, (state0, outs0), jnp.arange(n_steps))
+    # broadcast the final-stage outputs to every rank
+    return lax.psum(jnp.where(is_last, outs, 0.0), axis_name)
+
+
+def stack_stage_params(per_stage_params):
+    """Stack a list of per-stage pytrees along a new leading axis so the
+    result can be sharded over `pp` with PartitionSpec('pp', ...)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                  *per_stage_params)

@@ -1,0 +1,86 @@
+"""Ring attention: exact attention over sequences sharded across a mesh
+axis, with K/V blocks rotating around the ring via `ppermute` while each
+step folds one block into an online-softmax accumulator.
+
+This is a *new* capability relative to the reference, which handles long
+sequences only by bucketing + truncated BPTT (SURVEY.md §5.7); on TPU the
+ICI torus makes the ring schedule the natural sequence-parallel layout.
+Compute/communication overlap comes from XLA pipelining the ppermute with
+the block matmuls.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _online_block(s, v, m_prev, l_prev, o_prev):
+    """Fold one score block into the (m, l, o) online-softmax state."""
+    m_cur = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(s - m_new[..., None])
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = alpha * l_prev + p.sum(axis=-1)
+    o_new = alpha[..., None] * o_prev + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None):
+    """Exact attention with the sequence dim sharded on `axis_name`.
+
+    Must be called inside `shard_map` (or `pmap`) over `axis_name`.
+
+    Parameters
+    ----------
+    q, k, v : [batch, heads, seq_local, head_dim] local shards.
+    causal : apply a causal mask in *global* sequence positions.
+    """
+    B, H, T, D = q.shape
+    size = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    if scale is None:
+        scale = D ** -0.5
+    q = q * scale
+
+    q_pos = idx * T + jnp.arange(T)
+
+    m0 = jnp.full((B, H, T), NEG_INF, q.dtype)
+    l0 = jnp.zeros((B, H, T), q.dtype)
+    o0 = jnp.zeros_like(q)
+
+    def step(carry, s):
+        k_blk, v_blk, m, l, o = carry
+        # after s forward rotations, this device holds the block that
+        # originated on rank (idx - s) mod size
+        src = (idx - s) % size
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk)
+        if causal:
+            k_pos = src * T + jnp.arange(T)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(mask[None, None], scores, NEG_INF)
+        m, l, o = _online_block(scores, v_blk, m, l, o)
+        perm = [(i, (i + 1) % size) for i in range(size)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, m, l, o), None
+
+    (_, _, m, l, o), _ = lax.scan(step, (k, v, m0, l0, o0),
+                                  jnp.arange(size))
+    return o / jnp.maximum(l, 1e-20)[..., None]
+
+
+def ring_attention_sharded(q, k, v, mesh, axis="sp", causal=False,
+                           scale=None):
+    """Convenience wrapper: shard_map `ring_attention` over `axis`,
+    inputs laid out [batch, heads, seq, head_dim] with seq sharded."""
+    spec = P(None, None, axis, None)
+    fn = functools.partial(ring_attention, axis_name=axis, causal=causal,
+                           scale=scale)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)

@@ -1,0 +1,47 @@
+"""Tensor (model) parallelism primitives: Megatron-style column/row
+parallel linear layers as shard_map-level functions.
+
+The reference's only model parallelism is manual per-layer device
+placement with cross-device copies (group2ctx,
+ref: python/mxnet/symbol/symbol.py:1290, src/executor/graph_executor.cc:907);
+on a TPU mesh the idiomatic form is intra-layer sharding with one psum on
+the row-parallel output (SURVEY.md §2.3 item 7 — new capability).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def column_parallel_dense(x, w, b=None):
+    """y = x @ w with `w` sharded on its output (column) dim.
+
+    No communication: the output stays feature-sharded, feeding a
+    row-parallel layer.  x: [..., Din] replicated; w: [Din, Dout_local].
+    """
+    y = jnp.einsum("...d,df->...f", x, w)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def row_parallel_dense(x, w, b=None, axis_name="tp"):
+    """y = psum_tp(x @ w) with `w` sharded on its input (row) dim.
+
+    x: [..., Din_local] feature-sharded (as produced by a column-parallel
+    layer); w: [Din_local, Dout]. One allreduce restores the replicated
+    activation. Bias is added once, after the psum.
+    """
+    y = lax.psum(jnp.einsum("...d,df->...f", x, w), axis_name)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def tp_mlp(x, w1, b1, w2, b2, axis_name="tp", act=jax.nn.gelu):
+    """Two-layer MLP with the hidden dim sharded over `axis_name`:
+    column-parallel up-projection, nonlinearity, row-parallel
+    down-projection with a single psum."""
+    h = act(column_parallel_dense(x, w1, b1))
+    return row_parallel_dense(h, w2, b2, axis_name=axis_name)

@@ -1,0 +1,50 @@
+"""Ulysses (DeepSpeed-style) sequence parallelism: all-to-all swaps the
+sharded axis from sequence to heads, runs full-sequence attention on each
+head group, and swaps back. Complementary to ring attention — O(1)
+collective rounds instead of O(ring size), but requires heads % sp == 0.
+
+New capability vs. the reference (SURVEY.md §5.7 — bucketing only).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .ring_attention import NEG_INF
+
+
+def _dense_attention(q, k, v, causal, scale):
+    # q, k, v: [B, T, H, D]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
+    if causal:
+        T = q.shape[1]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def ulysses_attention(q, k, v, axis_name, causal=False, scale=None):
+    """Sequence-parallel attention via two all-to-alls.
+
+    Must be called inside `shard_map` over `axis_name`.
+
+    q, k, v: [batch, seq_local, heads, head_dim]; heads divisible by the
+    axis size.
+    """
+    B, Tl, H, D = q.shape
+    size = lax.psum(1, axis_name)
+    if scale is None:
+        scale = D ** -0.5
+
+    def seq2head(x):
+        # [B, Tl, H, D] -> [B, T, H/size, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qg, kg, vg = seq2head(q), seq2head(k), seq2head(v)
+    og = _dense_attention(qg, kg, vg, causal, scale)
+    # [B, T, H/size, D] -> [B, Tl, H, D]
+    return lax.all_to_all(og, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
