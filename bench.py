@@ -1,0 +1,99 @@
+"""Headline benchmark: ResNet-50 inference throughput on one TPU chip.
+
+Mirrors the reference's benchmark_score.py methodology
+(ref: example/image-classification/benchmark_score.py:69 `score`):
+time `num_batches` forward passes at a fixed batch size and report
+images/sec. Here the model is the Gluon model-zoo ResNet-50 hybridized
+into a single XLA program, activations in bfloat16 (the TPU-native
+inference dtype, the analogue of the reference's MKL-DNN int8/fp32
+split), parameters streamed in once and kept device-resident.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is measured against the driver target of 4000 img/s/chip
+(BASELINE.json north star; the reference's own best published ResNet-50
+number is 193.47 img/s on a 36-core Skylake, docs/faq/perf.md:49).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BATCH = 128
+WARMUP = 3
+ITERS = 20
+TARGET = 4000.0  # img/s/chip, BASELINE.json
+
+
+def build_forward(batch, dtype=jnp.bfloat16):
+    import mxnet_tpu as mx  # noqa: F401  (registers ops)
+    from mxnet_tpu.gluon import block as blk
+    from mxnet_tpu.gluon.block import _flatten
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.ndarray.ndarray import NDArray
+
+    net = vision.resnet50_v1()
+    net.initialize()
+
+    def _warm(d):
+        prev = blk._in_trace_flag()
+        blk._set_in_trace(True)
+        try:
+            return net.forward(NDArray(d))._data
+        finally:
+            blk._set_in_trace(prev)
+
+    jax.eval_shape(_warm, jax.ShapeDtypeStruct((batch, 3, 224, 224),
+                                               jnp.float32))
+    net.hybridize()
+
+    plist = sorted(net.collect_params().items())
+    pvals = tuple(p.data()._data for _, p in plist)
+    x = NDArray(jnp.zeros((batch, 3, 224, 224), jnp.float32))
+    _, in_spec = _flatten([x])
+    jfn, _o, _a = net._build_cached(plist, in_spec, training=False)
+    key = jax.random.PRNGKey(0)
+
+    if dtype == jnp.bfloat16:
+        # bf16 activations/weights; BN stats stay fp32 inside the layers
+        pvals = tuple(v.astype(jnp.bfloat16)
+                      if v.dtype == jnp.float32 else v for v in pvals)
+
+    def forward(param_vals, data):
+        outs, _aux = jfn(param_vals, key, data)
+        return outs[0]
+
+    return jax.jit(forward), pvals
+
+
+def main():
+    fwd, pvals = build_forward(BATCH)
+    pvals = jax.device_put(pvals)
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(rng.standard_normal((BATCH, 3, 224, 224),
+                                           dtype=np.float32),
+                       dtype=jnp.bfloat16)
+
+    for _ in range(WARMUP):
+        fwd(pvals, data).block_until_ready()
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(ITERS):
+        out = fwd(pvals, data)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    ips = BATCH * ITERS / dt
+    print(json.dumps({
+        "metric": "resnet50_inference_bf16_bs%d" % BATCH,
+        "value": round(ips, 2),
+        "unit": "img/s/chip",
+        "vs_baseline": round(ips / TARGET, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -41,4 +41,12 @@ from . import metric  # noqa: E402
 from . import kvstore  # noqa: E402
 from . import kvstore as kv  # noqa: E402
 from . import recordio  # noqa: E402
+from . import symbol  # noqa: E402
+from . import symbol as sym  # noqa: E402
+from .executor import Executor  # noqa: E402
+from . import io  # noqa: E402
+from . import callback  # noqa: E402
+from . import model  # noqa: E402
+from . import module  # noqa: E402
+from . import module as mod  # noqa: E402
 from . import gluon  # noqa: E402

@@ -1,0 +1,271 @@
+"""Graph executor: bind a Symbol to arrays and run it as one XLA program.
+
+The reference's GraphExecutor (ref: src/executor/graph_executor.cc:690)
+builds the fwd+bwd graph, plans memory, attaches per-node engine ops and
+runs them topo-ordered; here the whole graph lowers to a single jitted
+function — XLA buffer assignment replaces PlanMemory, XLA fusion replaces
+op bulking (InitOpSegs), and jax.vjp over the same function replaces the
+nnvm Gradient pass. Auxiliary states (BatchNorm moving stats) are carried
+functionally: the compiled step returns their updates and `forward`
+writes them back, mirroring the mutate-in-place contract of the
+reference (ref: src/operator/nn/batch_norm.cc) without impure ops.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError
+from .ops import registry as _reg
+from .ndarray.ndarray import NDArray
+from . import random as _random
+from .symbol.symbol import Symbol, is_aux_name
+
+
+class Executor:
+    """Bound computation (ref: python/mxnet/executor.py Executor)."""
+
+    def __init__(self, symbol, ctx=None, args=None, args_grad=None,
+                 grad_req="write", aux_states=None):
+        self._symbol = symbol
+        self._ctx = ctx
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.output_names = symbol.list_outputs()
+        dup = {n for n in self.arg_names if self.arg_names.count(n) > 1}
+        if dup:
+            raise MXNetError(
+                f"duplicate argument names in graph: {sorted(dup)}; "
+                "give each variable a unique name (as the reference "
+                "requires at bind)")
+
+        self.arg_dict = self._canon_args(args, self.arg_names, "args")
+        self.aux_dict = self._canon_args(aux_states, self.aux_names,
+                                         "aux_states", allow_missing=True)
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in self.arg_names}
+        else:
+            self._grad_req = {n: grad_req.get(n, "null")
+                              for n in self.arg_names}
+        self.grad_dict = {}
+        if args_grad is not None:
+            self.grad_dict = self._canon_args(args_grad, self.arg_names,
+                                              "args_grad",
+                                              allow_missing=True)
+        else:
+            for n in self.arg_names:
+                if self._grad_req.get(n, "null") != "null":
+                    a = self.arg_dict[n]
+                    self.grad_dict[n] = NDArray(jnp.zeros(a.shape,
+                                                          a._data.dtype))
+        self._monitor = None
+        self._fwd_cache = {}
+        self._vjp = None
+        self.outputs = []
+
+    def _canon_args(self, args, names, what, allow_missing=False):
+        out = {}
+        if args is None:
+            args = {}
+        if isinstance(args, (list, tuple)):
+            if len(args) != len(names):
+                raise MXNetError(
+                    f"{what}: expected {len(names)} arrays, got {len(args)}")
+            args = dict(zip(names, args))
+        for n in names:
+            if n not in args:
+                if allow_missing:
+                    continue
+                raise MXNetError(f"{what}: missing array for {n}")
+            v = args[n]
+            out[n] = v if isinstance(v, NDArray) else NDArray(v)
+        return out
+
+    # -- compiled graph evaluation ----------------------------------------
+    def _build(self, training):
+        """Lower the symbol into a pure jitted fn of (args, aux, key)."""
+        sym = self._symbol
+        order = sym._topo()
+
+        def run(arg_vals, aux_vals, key):
+            env = {}  # keyed by node identity — names may collide
+            aux_updates = {}
+            for node in order:
+                if node.op is None:
+                    src = (aux_vals if is_aux_name(node.name)
+                           else arg_vals)
+                    env[(id(node), 0)] = src[node.name]
+                    continue
+                opdef = _reg.get(node.op)
+                ins = [env[(id(c), k)] for c, k in node.inputs]
+                attrs = {k: v for k, v in node.attrs.items()
+                         if not k.startswith("__")}
+                if "training" in opdef._kwarg_names \
+                        and "training" not in attrs:
+                    attrs["training"] = training
+                if opdef.needs_rng:
+                    key, sub = jax.random.split(key)
+                    ins = [sub] + ins
+                if training and node.op == "BatchNorm" \
+                        and not attrs.get("use_global_stats"):
+                    out = self._bn_train(node, opdef, ins, attrs,
+                                         aux_updates)
+                else:
+                    out = opdef.fn(*ins, **attrs)
+                outs = (list(out) if isinstance(out, (tuple, list))
+                        else [out])
+                for k, o in enumerate(outs):
+                    env[(id(node), k)] = o
+            outputs = [env[(id(n), k)] for n, k in sym._outputs]
+            return outputs, aux_updates
+
+        return run
+
+    def _bn_train(self, node, opdef, ins, attrs, aux_updates):
+        """Training-mode BatchNorm with functional moving-stat updates
+        (the reference mutates aux states in-place during forward)."""
+        a = dict(attrs)
+        a["output_mean_var"] = True
+        a["training"] = True
+        out, mean, var = opdef.fn(*ins, **a)
+        momentum = attrs.get("momentum", 0.9)
+        mm_node, mv_node = node.inputs[3][0], node.inputs[4][0]
+        if mm_node.op is None:
+            aux_updates[mm_node.name] = (
+                momentum * ins[3] + (1 - momentum) * mean)
+        if mv_node.op is None:
+            aux_updates[mv_node.name] = (
+                momentum * ins[4] + (1 - momentum) * var)
+        return out
+
+    def _jitted_forward(self, training):
+        entry = self._fwd_cache.get(training)
+        if entry is None:
+            run = self._build(training)
+            entry = jax.jit(lambda a, x, k: run(a, x, k))
+            self._fwd_cache[training] = entry
+        return entry
+
+    def forward(self, is_train=False, **kwargs):
+        for n, v in kwargs.items():
+            if n not in self.arg_dict:
+                raise MXNetError(f"unknown argument {n}")
+            self.arg_dict[n]._data = (v._data if isinstance(v, NDArray)
+                                      else jnp.asarray(v))
+        arg_vals = {n: a._data for n, a in self.arg_dict.items()}
+        aux_vals = {n: a._data for n, a in self.aux_dict.items()}
+        key = _random.next_key()
+        outs, aux_updates = self._jitted_forward(bool(is_train))(
+            arg_vals, aux_vals, key)
+        if is_train:
+            self._last_state = (arg_vals, aux_vals, key)
+        for n, v in aux_updates.items():
+            self.aux_dict[n]._data = v
+        self.outputs = [NDArray(o) for o in outs]
+        if self._monitor is not None:
+            for name, arr in zip(self.output_names, self.outputs):
+                self._monitor(name, arr)
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        """Gradient of the bound graph wrt grad-requesting args
+        (ref: Executor::Backward; built with jax.vjp instead of the
+        nnvm Gradient pass)."""
+        if not hasattr(self, "_last_state"):
+            raise MXNetError("backward called before forward(is_train=True)")
+        arg_vals, aux_vals, key = self._last_state
+        grad_names = [n for n in self.arg_names
+                      if self._grad_req.get(n, "null") != "null"]
+        if not grad_names:
+            return
+
+        if self._vjp is None:
+            run = self._build(True)
+
+            @jax.jit
+            def vjp_fn(arg_vals, aux_vals, key, cotangents):
+                wanted = {n: arg_vals[n] for n in grad_names}
+                rest = {n: v for n, v in arg_vals.items()
+                        if n not in wanted}
+
+                def f(w):
+                    outs, _ = run({**rest, **w}, aux_vals, key)
+                    return outs
+
+                _, pull = jax.vjp(f, wanted)
+                return pull(cotangents)[0]
+
+            self._vjp = vjp_fn
+
+        if out_grads is None:
+            cotangents = [jnp.ones(o.shape, o._data.dtype)
+                          for o in self.outputs]
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cotangents = [g._data if isinstance(g, NDArray)
+                          else jnp.asarray(g) for g in out_grads]
+        grads = self._vjp(arg_vals, aux_vals, key, cotangents)
+        for n in grad_names:
+            req = self._grad_req[n]
+            g = self.grad_dict.get(n)
+            if g is None:
+                g = self.grad_dict[n] = NDArray(grads[n])
+            elif req == "add":
+                g._data = g._data + grads[n]
+            else:
+                g._data = grads[n]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self.arg_names]
+
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self.arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self.aux_names]
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor = callback
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for n, v in arg_params.items():
+            if n in self.arg_dict:
+                self.arg_dict[n]._data = jnp.asarray(
+                    v._data if isinstance(v, NDArray) else v)
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown arg {n}")
+        for n, v in (aux_params or {}).items():
+            if n in self.aux_dict:
+                self.aux_dict[n]._data = jnp.asarray(
+                    v._data if isinstance(v, NDArray) else v)
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown aux {n}")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False,
+                **kwargs):
+        """Rebind with new shapes; jit re-specializes per shape so the
+        executor machinery is reusable as-is (the reference rebuilds its
+        memory plan, graph_executor.cc:1367 Reshape)."""
+        from .ndarray import zeros
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        args = {}
+        for n, s in zip(self.arg_names, arg_shapes):
+            cur = self.arg_dict[n]
+            args[n] = (cur if tuple(cur.shape) == tuple(s)
+                       else zeros(s, dtype=cur.dtype))
+        aux = {}
+        for n, s in zip(self.aux_names, aux_shapes):
+            cur = self.aux_dict[n]
+            aux[n] = (cur if tuple(cur.shape) == tuple(s)
+                      else zeros(s, dtype=cur.dtype))
+        grad_req = dict(self._grad_req)
+        return Executor(self._symbol, self._ctx, args=args,
+                        grad_req=grad_req, aux_states=aux)

@@ -89,6 +89,10 @@ def defer_aux_update(param, new_value):
     if stack:
         stack[-1].append((param, new_value))
     else:
+        if not isinstance(new_value, NDArray):
+            # symbolic trace: aux updates are materialized by the
+            # executor's BatchNorm training hook, not recorded here
+            return
         if param._data is None:
             param.set_data(new_value)
         else:
@@ -324,6 +328,19 @@ class HybridBlock(Block):
             return {n: p.data() for n, p in self._reg_params.items()}
 
     def forward(self, x, *args):
+        from ..symbol.symbol import Symbol
+        if isinstance(x, Symbol):
+            # symbolic trace (gluon export / SymbolBlock composition):
+            # parameters become graph variables by their full names
+            from .. import symbol as sym_mod
+            for p in self._reg_params.values():
+                if p.shape is None or any(s == 0 for s in p.shape):
+                    raise MXNetError(
+                        f"{self.name}: cannot trace symbolically while "
+                        f"parameter {p.name} has unresolved shape "
+                        f"{p.shape}; run the block once on data first")
+            params = {n: p.var() for n, p in self._reg_params.items()}
+            return self.hybrid_forward(sym_mod, x, *args, **params)
         if self._active and not getattr(_in_trace, "value", False):
             return self._call_cached_op(x, *args)
         params = self._collect_param_values(x, *args)
@@ -444,9 +461,11 @@ class HybridBlock(Block):
         from ..symbol.trace import trace_block
         out, params = trace_block(self)
         out.save(f"{path}-symbol.json")
+        aux_names = set(out.list_auxiliary_states())
         payload = {}
         for name, p in params.items():
-            payload[f"arg:{name}"] = p.data()
+            prefix = "aux" if name in aux_names else "arg"
+            payload[f"{prefix}:{name}"] = p.data()
         nd_mod.save(f"{path}-{epoch:04d}.params", payload)
         return f"{path}-symbol.json", f"{path}-{epoch:04d}.params"
 

@@ -218,6 +218,16 @@ class BatchNorm(HybridBlock):
             p.shape_inferred((c,))
 
     def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        from ...symbol.symbol import Symbol
+        if isinstance(x, Symbol):
+            # symbolic trace: emit one neutral BatchNorm node — the
+            # executor decides training vs inference at run time and
+            # materializes the moving-stat updates itself
+            return F.BatchNorm(
+                x, gamma, beta, running_mean, running_var,
+                eps=self._epsilon, momentum=self._momentum,
+                fix_gamma=not self._scale,
+                use_global_stats=self._use_global_stats, axis=self._axis)
         training = autograd.is_training() and not self._use_global_stats
         if training:
             out, mean, var = F.BatchNorm(

@@ -1,0 +1,4 @@
+"""mx.io — data iterators (ref: python/mxnet/io/io.py, src/io/)."""
+from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
+                 PrefetchingIter, CSVIter, MNISTIter, LibSVMIter,
+                 ImageRecordIter)

@@ -1,0 +1,573 @@
+"""Data iterator framework (ref: python/mxnet/io/io.py + src/io/).
+
+The reference layers C++ parsers behind `IIterator<DataBatch>` decorators
+(parser -> BatchLoader -> normalize -> PrefetcherIter, ref:
+src/io/iter_batchloader.h:42, iter_prefetcher.h:47); here the batch
+assembly is numpy on the host feeding device arrays, and prefetching is
+a background thread overlapping host batch prep with device compute —
+the TPU equivalent of the dmlc ThreadedIter producer. A C++ RecordIO
+scan path plugs in underneath for the record-packed formats.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import struct
+import threading
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import NDArray, array
+
+
+class DataDesc:
+    """Named shape/dtype/layout of one input (ref: io.py DataDesc)."""
+
+    def __init__(self, name, shape, dtype="float32", layout="NCHW"):
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        self.layout = layout
+
+    def __repr__(self):
+        return (f"DataDesc[{self.name},{self.shape},{self.dtype},"
+                f"{self.layout}]")
+
+    def __iter__(self):  # tuple-compat: name, shape
+        return iter((self.name, self.shape))
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+
+class DataBatch:
+    """One batch: data/label lists + pad/index bookkeeping."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __repr__(self):
+        shapes = [getattr(d, "shape", None) for d in (self.data or [])]
+        return f"DataBatch: data shapes {shapes} pad {self.pad}"
+
+
+class DataIter:
+    """Iterator base (ref: io.py DataIter)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+def _init_data(data, allow_empty, default_name):
+    """Canonicalize data/label into an ordered [(name, ndarray)] list."""
+    if data is None:
+        if not allow_empty:
+            raise MXNetError("data must be provided")
+        return []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        if not allow_empty and len(data) == 0:
+            raise MXNetError("empty data")
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {f"_{i}_{default_name}": d for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise MXNetError(f"unsupported data type {type(data)}")
+    out = []
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        out.append((k, np.asarray(v)))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays with padding/shuffle
+    (ref: io.py NDArrayIter; sparse-aware variant in the reference)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False,
+                               default_name=data_name)
+        self.label = _init_data(label, allow_empty=True,
+                                default_name=label_name)
+        self.num_data = self.data[0][1].shape[0]
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.idx = np.arange(self.num_data)
+        if last_batch_handle == "discard":
+            self.num_batches = self.num_data // batch_size
+        else:
+            self.num_batches = (self.num_data + batch_size - 1) // batch_size
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:],
+                         v.dtype) for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:],
+                         v.dtype) for k, v in self.label]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+        if self.shuffle:
+            np.random.shuffle(self.idx)
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        if self.last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def _take(self, arrays):
+        end = self.cursor + self.batch_size
+        if end <= self.num_data:
+            sel = self.idx[self.cursor:end]
+            return [array(v[sel]) for _, v in arrays]
+        # pad by wrapping around (last_batch_handle="pad")
+        sel = np.concatenate([self.idx[self.cursor:],
+                              self.idx[:end - self.num_data]])
+        return [array(v[sel]) for _, v in arrays]
+
+    def getdata(self):
+        return self._take(self.data)
+
+    def getlabel(self):
+        return self._take(self.label)
+
+    def getpad(self):
+        end = self.cursor + self.batch_size
+        if self.last_batch_handle == "pad" and end > self.num_data:
+            return end - self.num_data
+        return 0
+
+    def getindex(self):
+        end = min(self.cursor + self.batch_size, self.num_data)
+        return self.idx[self.cursor:end]
+
+
+class ResizeIter(DataIter):
+    """Clip/extend an iterator to a fixed number of batches per epoch
+    (ref: io.py ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getpad(self):
+        return self.current_batch.pad
+
+    def getindex(self):
+        return self.current_batch.index
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch over one or more iterators
+    (ref: io.py PrefetchingIter; C++ PrefetcherIter
+    src/io/iter_prefetcher.h:47). Overlaps host-side batch assembly
+    with device compute."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth=2):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        super().__init__(iters[0].batch_size)
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self._depth = prefetch_depth
+        self._queue = None
+        self._thread = None
+        self._start()
+
+    def _start(self):
+        self._queue = queue.Queue(maxsize=self._depth)
+        self._stop = threading.Event()
+
+        def producer():
+            while not self._stop.is_set():
+                try:
+                    batches = [it.next() for it in self.iters]
+                except StopIteration:
+                    self._queue.put(None)
+                    return
+                self._queue.put(batches)
+
+        self._thread = threading.Thread(target=producer, daemon=True)
+        self._thread.start()
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum([[DataDesc(r.get(d.name, d.name), d.shape, d.dtype)
+                     for d in i.provide_data]
+                    for r, i in zip(self.rename_data, self.iters)], [])
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum([[DataDesc(r.get(d.name, d.name), d.shape, d.dtype)
+                     for d in i.provide_label]
+                    for r, i in zip(self.rename_label, self.iters)], [])
+
+    def reset(self):
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+        for it in self.iters:
+            it.reset()
+        self._start()
+
+    def next(self):
+        batches = self._queue.get()
+        if batches is None:
+            raise StopIteration
+        if len(batches) == 1:
+            return batches[0]
+        return DataBatch(
+            data=sum([b.data for b in batches], []),
+            label=sum([(b.label or []) for b in batches], []),
+            pad=max(b.pad or 0 for b in batches))
+
+    def iter_next(self):
+        raise NotImplementedError("use next()")
+
+
+
+class _WrapIter(DataIter):
+    """Delegate to an inner iterator with a one-batch lookahead cache so
+    both DataIter protocols work: `for b in it` and
+    `while it.iter_next(): b = it.next()` (the reference's C++ iterators
+    cache the parsed batch the same way)."""
+
+    _inner = None
+
+    def __init__(self, batch_size):
+        super().__init__(batch_size)
+        self._cache = None
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._cache = None
+        self._inner.reset()
+
+    def iter_next(self):
+        if self._cache is None:
+            try:
+                self._cache = self._inner.next()
+            except StopIteration:
+                return False
+        return True
+
+    def next(self):
+        if self._cache is not None:
+            b, self._cache = self._cache, None
+            return b
+        return self._inner.next()
+
+
+class CSVIter(_WrapIter):
+    """CSV file iterator (ref: src/io/iter_csv.cc:218)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None,
+                 label_shape=(1,), batch_size=1, round_batch=True,
+                 dtype="float32"):
+        super().__init__(batch_size)
+        data = np.loadtxt(data_csv, delimiter=",",
+                          dtype=dtype, ndmin=2)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=dtype,
+                               ndmin=2).reshape((-1,) + tuple(label_shape))
+        else:
+            label = np.zeros((data.shape[0], 1), dtype=dtype)
+        if tuple(label_shape) == (1,):
+            label = label.reshape(-1)   # (batch,) like the reference
+        self._inner = NDArrayIter(
+            {"data": data}, {"softmax_label": label},
+            batch_size=batch_size,
+            last_batch_handle="pad" if round_batch else "discard")
+
+
+class MNISTIter(_WrapIter):
+    """MNIST idx-format iterator (ref: src/io/iter_mnist.cc:260)."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True,
+                 flat=False, seed=0, silent=True, input_shape=None):
+        super().__init__(batch_size)
+        imgs = self._read_idx(image)
+        lbls = self._read_idx(label)
+        imgs = imgs.astype(np.float32) / 255.0
+        if flat:
+            imgs = imgs.reshape(imgs.shape[0], -1)
+        else:
+            imgs = imgs.reshape(imgs.shape[0], 1, *imgs.shape[1:])
+        if input_shape:
+            imgs = imgs.reshape((imgs.shape[0],) + tuple(input_shape))
+        self._inner = NDArrayIter({"data": imgs},
+                                  {"softmax_label":
+                                   lbls.astype(np.float32)},
+                                  batch_size=batch_size, shuffle=shuffle,
+                                  last_batch_handle="discard")
+
+    @staticmethod
+    def _read_idx(path):
+        import gzip
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            raw = f.read()
+        magic, = struct.unpack(">i", raw[:4])
+        ndim = magic & 0xFF
+        dims = struct.unpack(">" + "i" * ndim, raw[4:4 + 4 * ndim])
+        return np.frombuffer(raw, dtype=np.uint8,
+                             offset=4 + 4 * ndim).reshape(dims)
+
+
+class LibSVMIter(_WrapIter):
+    """LibSVM sparse text format (ref: src/io/iter_libsvm.cc:200);
+    batches densify on the host — TPU has no native sparse, SURVEY.md
+    §7 hard part (d)."""
+
+    def __init__(self, data_libsvm, data_shape, label_shape=(1,),
+                 batch_size=1, round_batch=True):
+        super().__init__(batch_size)
+        n_feat = int(np.prod(data_shape))
+        rows, labels = [], []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.strip().split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                row = np.zeros(n_feat, dtype=np.float32)
+                for tok in parts[1:]:
+                    i, v = tok.split(":")
+                    row[int(i)] = float(v)
+                rows.append(row)
+        data = np.stack(rows).reshape((-1,) + tuple(data_shape))
+        label = np.asarray(labels, np.float32).reshape((-1,) +
+                                                       tuple(label_shape))
+        if tuple(label_shape) == (1,):
+            label = label.reshape(-1)
+        self._inner = NDArrayIter(
+            {"data": data}, {"softmax_label": label},
+            batch_size=batch_size,
+            last_batch_handle="pad" if round_batch else "discard")
+
+
+class ImageRecordIter(DataIter):
+    """RecordIO-packed image iterator with augmentation
+    (ref: src/io/iter_image_recordio_2.cc:50 ImageRecordIOParser2).
+
+    Decodes record payloads (raw chw float or encoded images when PIL
+    is available), applies resize/crop/mirror augmentation, assembles
+    NCHW batches on a prefetch thread.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size=1,
+                 label_width=1, shuffle=False, rand_crop=False,
+                 rand_mirror=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 std_r=1.0, std_g=1.0, std_b=1.0, resize=-1,
+                 round_batch=True, preprocess_threads=4, prefetch_buffer=2,
+                 **kwargs):
+        super().__init__(batch_size)
+        from ..recordio import MXRecordIO, unpack, unpack_img
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.mean = np.array([mean_r, mean_g, mean_b],
+                             np.float32).reshape(3, 1, 1)
+        self.std = np.array([std_r, std_g, std_b],
+                            np.float32).reshape(3, 1, 1)
+        self.resize = resize
+        records = []
+        rio = MXRecordIO(path_imgrec, "r")
+        while True:
+            raw = rio.read()
+            if raw is None:
+                break
+            records.append(raw)
+        rio.close()
+        self.records = records
+        self.shuffle = shuffle
+        self.idx = np.arange(len(records))
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = ((self.batch_size,) if self.label_width == 1
+                 else (self.batch_size, self.label_width))
+        return [DataDesc("softmax_label", shape)]
+
+    def reset(self):
+        self.cursor = 0
+        self._peek = None
+        if self.shuffle:
+            np.random.shuffle(self.idx)
+
+    def _decode(self, raw):
+        from ..recordio import unpack, unpack_img
+        header, payload = unpack(raw)
+        c, h, w = self.data_shape
+        try:
+            _, img = unpack_img(raw)          # HWC uint8 (PIL/opencv path)
+            img = img.astype(np.float32)
+            if img.ndim == 2:
+                img = img[:, :, None].repeat(3, axis=2)
+            img = img.transpose(2, 0, 1)      # CHW
+        except Exception:
+            img = np.frombuffer(payload, np.float32)
+            img = img.reshape(self.data_shape)
+        # center/random crop to target
+        _, ih, iw = img.shape
+        if (ih, iw) != (h, w):
+            if ih < h or iw < w:
+                raise MXNetError(
+                    f"image {ih}x{iw} smaller than data_shape {h}x{w}")
+            if self.rand_crop:
+                top = np.random.randint(0, ih - h + 1)
+                left = np.random.randint(0, iw - w + 1)
+            else:
+                top, left = (ih - h) // 2, (iw - w) // 2
+            img = img[:, top:top + h, left:left + w]
+        if self.rand_mirror and np.random.rand() < 0.5:
+            img = img[:, :, ::-1]
+        img = (img - self.mean) / self.std
+        label = header.label
+        if isinstance(label, (int, float)):
+            label = np.array([label], np.float32)
+        return img, np.asarray(label, np.float32)
+
+    def next(self):
+        if getattr(self, "_peek", None) is not None:
+            b, self._peek = self._peek, None
+            return b
+        if self.cursor + self.batch_size > len(self.records):
+            raise StopIteration
+        sel = self.idx[self.cursor:self.cursor + self.batch_size]
+        self.cursor += self.batch_size
+        imgs, labels = [], []
+        for i in sel:
+            img, lab = self._decode(self.records[i])
+            imgs.append(img)
+            labels.append(lab[:self.label_width])
+        data = array(np.stack(imgs))
+        lab = np.stack(labels)
+        if self.label_width == 1:
+            lab = lab[:, 0]
+        return DataBatch(data=[data], label=[array(lab)], pad=0)
+
+    def iter_next(self):
+        if getattr(self, "_peek", None) is not None:
+            return True
+        try:
+            self._peek = self.next()
+            return True
+        except StopIteration:
+            return False

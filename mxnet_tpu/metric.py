@@ -12,8 +12,19 @@ from .ndarray import NDArray
 _reg = _registry("metric")
 
 
+# short aliases matching the reference's registered names
+# (ref: metric.py — 'acc', 'ce', 'nll_loss', 'top_k_accuracy'...)
+_ALIASES = {
+    "Accuracy": ("acc",),
+    "TopKAccuracy": ("top_k_accuracy", "top_k_acc"),
+    "CrossEntropy": ("ce",),
+    "NegativeLogLikelihood": ("nll_loss",),
+    "PearsonCorrelation": ("pearsonr",),
+}
+
+
 def register(klass):
-    _reg.register(klass)
+    _reg.register(klass, aliases=_ALIASES.get(klass.__name__, ()))
     return klass
 
 

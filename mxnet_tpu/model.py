@@ -1,0 +1,47 @@
+"""Model checkpointing helpers (ref: python/mxnet/model.py:383-450).
+
+Format matches the reference: ``prefix-symbol.json`` (graph JSON) +
+``prefix-####.params`` (NDArray map with ``arg:``/``aux:`` key
+prefixes), so checkpoints are structurally diffable against MXNet's.
+"""
+from __future__ import annotations
+
+import collections
+
+from . import ndarray as nd
+from . import symbol as sym_mod
+
+BatchEndParam = collections.namedtuple(
+    "BatchEndParams", ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """(ref: model.py:383 save_checkpoint)"""
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
+    save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
+    param_name = f"{prefix}-{epoch:04d}.params"
+    nd.save(param_name, save_dict)
+
+
+def load_params(prefix, epoch):
+    save_dict = nd.load(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        tp, _, name = k.partition(":")
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+        else:
+            arg_params[k] = v
+    return arg_params, aux_params
+
+
+def load_checkpoint(prefix, epoch):
+    """(ref: model.py:413 load_checkpoint)"""
+    symbol = sym_mod.load(f"{prefix}-symbol.json")
+    arg_params, aux_params = load_params(prefix, epoch)
+    return symbol, arg_params, aux_params

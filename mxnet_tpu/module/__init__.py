@@ -1,0 +1,5 @@
+"""mx.mod — Module API (ref: python/mxnet/module/)."""
+from .base_module import BaseModule
+from .module import Module
+from .bucketing_module import BucketingModule
+from .sequential_module import SequentialModule

@@ -1,0 +1,322 @@
+"""Module: symbol + executor + optimizer intermediate API
+(ref: python/mxnet/module/module.py:40-757).
+
+Device handling is the TPU-native departure: the reference slices each
+batch across a context list (DataParallelExecutorGroup.decide_slices,
+ref: python/mxnet/module/executor_group.py:281-310) and reduces
+gradients through KVStore comm buffers; here the bound executor runs
+one XLA program, and multi-device data parallelism is expressed by
+binding with a sharded context (`ctx=[mx.tpu(i)...]` lays the batch
+over a dp mesh axis — XLA inserts the gradient allreduce over ICI).
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from .. import optimizer as opt_mod
+from ..initializer import InitDesc
+from ..io.io import DataDesc
+from ..model import load_checkpoint, save_checkpoint
+from .base_module import BaseModule, _as_list
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger)
+        self._symbol = symbol
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._context = context
+        self._fixed_param_names = list(fixed_param_names or [])
+
+        arg_names = symbol.list_arguments()
+        input_names = self._data_names + self._label_names
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+
+        self._arg_params = None
+        self._aux_params = None
+        self._params_dirty = False
+        self._exec = None
+        self._optimizer = None
+        self._updater = None
+        self._preload_opt_states = None
+        self._grad_req = None
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return [(n, o.shape) for n, o in zip(self._output_names,
+                                             self._exec.outputs)]
+
+    # -- binding -----------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.binded = True
+
+        self._data_shapes = [d if isinstance(d, DataDesc)
+                             else DataDesc(*d) for d in data_shapes]
+        self._label_shapes = [d if isinstance(d, DataDesc)
+                              else DataDesc(*d)
+                              for d in (label_shapes or [])]
+        shape_hints = {d.name: d.shape for d in self._data_shapes}
+        shape_hints.update({d.name: d.shape for d in self._label_shapes})
+        # free symbols in the graph that aren't fed by this iterator get
+        # inferred shapes (labels of loss-less graphs etc.)
+        known = set(self._symbol.list_inputs())
+        shape_hints = {k: v for k, v in shape_hints.items() if k in known}
+
+        req = grad_req
+        if not for_training:
+            req = "null"
+        if isinstance(req, str):
+            req_dict = {}
+            for n in self._symbol.list_arguments():
+                if n in self._data_names:
+                    req_dict[n] = ("write" if inputs_need_grad and
+                                   for_training else "null")
+                elif n in self._label_names or \
+                        n in self._fixed_param_names:
+                    req_dict[n] = "null"
+                else:
+                    req_dict[n] = req
+            req = req_dict
+        self._grad_req = req
+        self._exec = self._symbol.simple_bind(grad_req=req, **shape_hints)
+
+        if shared_module is not None and shared_module.params_initialized:
+            arg_p, aux_p = shared_module.get_params()
+            self.set_params(arg_p, aux_p)
+        elif self._arg_params is not None:
+            # params survived a rebind (e.g. reshape)
+            self._exec.copy_params_from(self._arg_params, self._aux_params,
+                                        allow_extra_params=True)
+
+    # -- parameters --------------------------------------------------------
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False,
+                    allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before init_params"
+
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params is not None and name in arg_params:
+                src = arg_params[name]
+                arr._data = (src._data if isinstance(src, nd.NDArray)
+                             else nd.array(src)._data)
+            elif initializer is not None:
+                initializer(InitDesc(name), arr)
+            elif not allow_missing:
+                raise MXNetError(f"no initializer and no value for {name}")
+        for name in self._aux_names:
+            arr = self._exec.aux_dict[name]
+            if aux_params is not None and name in aux_params:
+                src = aux_params[name]
+                arr._data = (src._data if isinstance(src, nd.NDArray)
+                             else nd.array(src)._data)
+            elif initializer is not None:
+                initializer(InitDesc(name), arr)
+        self.params_initialized = True
+        self._params_dirty = False
+
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        return ({n: self._exec.arg_dict[n].copy()
+                 for n in self._param_names},
+                {n: self._exec.aux_dict[n].copy()
+                 for n in self._aux_names})
+
+    # -- optimizer ---------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=None, force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        optimizer_params = dict(optimizer_params or {})
+        if isinstance(optimizer, str):
+            # the reference defaults rescale_grad to 1/batch_size
+            # (module.py init_optimizer) so lr is batch-size invariant
+            if "rescale_grad" not in optimizer_params:
+                batch_size = self._data_shapes[0].shape[0]
+                optimizer_params["rescale_grad"] = 1.0 / max(batch_size, 1)
+            idx2name = dict(enumerate(self._param_names))
+            optimizer = opt_mod.create(
+                optimizer, param_idx2name=idx2name, **optimizer_params)
+        self._optimizer = optimizer
+        self._kvstore_type = kvstore
+        self._opt_states = {}
+        for i, name in enumerate(self._param_names):
+            self._opt_states[i] = optimizer.create_state_multi_precision(
+                i, self._exec.arg_dict[name])
+        self.optimizer_initialized = True
+        if self._preload_opt_states is not None:
+            self.load_optimizer_states(self._preload_opt_states)
+            self._preload_opt_states = None
+
+    def borrow_optimizer(self, shared_module):
+        """Share optimizer + state with another module (the bucketing
+        contract, ref: module.py borrow_optimizer) — momentum buffers
+        and update counts stay consistent across buckets."""
+        assert shared_module.optimizer_initialized
+        self._optimizer = shared_module._optimizer
+        self._opt_states = shared_module._opt_states
+        self.optimizer_initialized = True
+
+    # -- computation -------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feeds = {}
+        for desc, arr in zip(self._data_shapes, data_batch.data):
+            feeds[desc.name] = arr
+        if data_batch.label is not None:
+            for desc, arr in zip(self._label_shapes, data_batch.label):
+                feeds[desc.name] = arr
+        feeds = {k: v for k, v in feeds.items()
+                 if k in self._exec.arg_dict}
+        self._exec.forward(is_train=is_train, **feeds)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        """Apply the optimizer to every parameter
+        (ref: module.py:644 update -> kvstore push/pull or updater)."""
+        assert self.binded and self.params_initialized \
+            and self.optimizer_initialized
+        self._params_dirty = True
+        for i, name in enumerate(self._param_names):
+            grad = self._exec.grad_dict.get(name)
+            if grad is None:
+                continue
+            self._optimizer.update_multi_precision(
+                i, self._exec.arg_dict[name], grad, self._opt_states[i])
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.inputs_need_grad
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update(labels, self._exec.outputs)
+
+    # -- checkpointing -----------------------------------------------------
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        arg_p, aux_p = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg_p, aux_p)
+        if save_optimizer_states:
+            self.save_optimizer_states(f"{prefix}-{epoch:04d}.states")
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = True
+        if load_optimizer_states:
+            mod._preload_opt_states = f"{prefix}-{epoch:04d}.states"
+
+        # defer binding: params are installed at bind time
+        orig_bind = mod.bind
+
+        def bind_then_set(*a, **kw):
+            orig_bind(*a, **kw)
+            mod.init_params(arg_params=args, aux_params=auxs,
+                            allow_missing=False, force_init=True)
+
+        mod.bind = bind_then_set
+        return mod
+
+    def save_optimizer_states(self, fname):
+        import pickle
+        with open(fname, "wb") as f:
+            states = {}
+            for i, s in self._opt_states.items():
+                states[i] = _state_to_numpy(s)
+            pickle.dump(states, f)
+
+    def load_optimizer_states(self, fname):
+        import pickle
+        with open(fname, "rb") as f:
+            states = pickle.load(f)
+        self._opt_states = {i: _state_from_numpy(s)
+                            for i, s in states.items()}
+
+    def install_monitor(self, mon):
+        self._exec.set_monitor_callback(mon)
+
+    def reshape(self, data_shapes, label_shapes=None):
+        assert self.binded
+        self._params_dirty = False
+        arg_p, aux_p = self.get_params()
+        self._arg_params, self._aux_params = arg_p, aux_p
+        self.bind(data_shapes, label_shapes, self.for_training,
+                  self.inputs_need_grad, force_rebind=True)
+        self.init_params(arg_params=arg_p, aux_params=aux_p,
+                         force_init=True)
+
+
+def _state_to_numpy(state):
+    if state is None:
+        return None
+    if isinstance(state, tuple):
+        return tuple(_state_to_numpy(s) for s in state)
+    if isinstance(state, nd.NDArray):
+        return state.asnumpy()
+    return state
+
+
+def _state_from_numpy(state):
+    if state is None:
+        return None
+    if isinstance(state, tuple):
+        return tuple(_state_from_numpy(s) for s in state)
+    if isinstance(state, np.ndarray):
+        return nd.array(state)
+    return state

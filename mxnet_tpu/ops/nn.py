@@ -273,6 +273,9 @@ def _softmax_output_closure(grad_scale, ignore_label, use_ignore, multi_output,
 def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
                    use_ignore=False, multi_output=False, preserve_shape=False,
                    normalization="null", out_grad=False, smooth_alpha=0.0):
+    if not multi_output and label.ndim == data.ndim and \
+            label.shape[-1] == 1:
+        label = label.reshape(label.shape[:-1])  # (N,1) labels, as CSVIter
     f = _softmax_output_closure(grad_scale, ignore_label, use_ignore,
                                 multi_output, normalization, smooth_alpha)
     return f(data, label)

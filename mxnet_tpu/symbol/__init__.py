@@ -1,0 +1,20 @@
+"""mx.sym — symbolic graph API over the shared op registry
+(ref: python/mxnet/symbol/).
+"""
+from .symbol import (Symbol, Group, Variable, var, load, load_json,
+                     is_aux_name)
+from . import register as _register
+from . import op
+
+_register.populate(globals())
+_register.populate(op.__dict__)
+
+
+def zeros(shape, dtype="float32", **kwargs):
+    from .symbol import _apply
+    return _apply("_zeros", [], {"shape": tuple(shape), "dtype": dtype})
+
+
+def ones(shape, dtype="float32", **kwargs):
+    from .symbol import _apply
+    return _apply("_ones", [], {"shape": tuple(shape), "dtype": dtype})

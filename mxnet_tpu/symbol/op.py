@@ -1,0 +1,2 @@
+"""mx.sym.op — alias namespace populated from the registry
+(ref: python/mxnet/symbol/op.py)."""

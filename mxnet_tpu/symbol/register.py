@@ -1,0 +1,76 @@
+"""Generate mx.sym.* operator functions from the op registry
+(ref: python/mxnet/symbol/register.py — codegen from registry metadata).
+
+Missing parameter inputs are auto-created as variables named
+``{op_name}_{arg}`` (fc1_weight, bn0_gamma, bn0_moving_mean…), matching
+the reference's symbol composition semantics so simple_bind can allocate
+them from inferred shapes.
+"""
+from __future__ import annotations
+
+import inspect
+
+from ..base import MXNetError
+from ..ops import registry as _reg
+from .symbol import Symbol, _apply, _gen_name, _Node, var
+
+# optional tensor args never auto-created (only used when supplied)
+_NEVER_AUTO = {"state_cell", "sequence_length", "length"}
+
+
+def make_sym_func(op):
+    sig = inspect.signature(op.fn)
+    defaults = {p.name: p.default for p in sig.parameters.values()
+                if p.default is not p.empty}
+
+    def sym_func(*args, name=None, **kwargs):
+        inputs = []
+        for a in args:
+            if a is None:
+                continue
+            if not isinstance(a, Symbol):
+                raise TypeError(
+                    f"{op.name}: symbolic call takes Symbol inputs, got "
+                    f"{type(a).__name__}; pass operator parameters as "
+                    "keyword arguments")
+            inputs.append(a)
+        name = name or _gen_name(op.name.lower().lstrip("_"))
+        for pname in op.arg_names[len(inputs):]:
+            if pname in kwargs:
+                v = kwargs.pop(pname)
+                if v is None:
+                    continue
+                if not isinstance(v, Symbol):
+                    raise TypeError(f"{op.name}: {pname} must be a Symbol")
+                inputs.append(v)
+                continue
+            if pname in _NEVER_AUTO:
+                continue
+            if pname == "bias":
+                no_bias = kwargs.get("no_bias", defaults.get("no_bias",
+                                                             False))
+                if no_bias:
+                    continue
+            elif pname in defaults:
+                # optional tensor input: auto-create only where the
+                # reference does (PReLU/RReLU gamma)
+                if not (op.name == "LeakyReLU" and pname == "gamma"
+                        and kwargs.get("act_type") in ("prelu", "rrelu")):
+                    continue
+            inputs.append(var(f"{name}_{pname}"))
+        kwargs.pop("num_args", None)
+        attrs = {k: v for k, v in kwargs.items() if v is not None}
+        return _apply(op.name, inputs, attrs, name=name)
+
+    sym_func.__name__ = op.name
+    sym_func.__doc__ = (op.fn.__doc__ or "") + f"\n\n(op: {op.name}, symbolic)"
+    return sym_func
+
+
+def populate(namespace):
+    seen = {}
+    for name, op in _reg.alias_map().items():
+        if id(op) not in seen:
+            seen[id(op)] = make_sym_func(op)
+        namespace[name] = seen[id(op)]
+    return namespace

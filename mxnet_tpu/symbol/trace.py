@@ -1,0 +1,33 @@
+"""Trace a Gluon HybridBlock into a Symbol graph (the export seam —
+ref: gluon/block.py:748 _get_graph traces with symbolic placeholders).
+"""
+from __future__ import annotations
+
+from . import var
+from ..base import MXNetError
+
+
+def trace_block(block, inputs=None, input_names=("data",)):
+    """Run the block on Symbol placeholders; returns (out_sym, params).
+
+    The block must have been run on real data once (so deferred shapes
+    are resolved); parameters appear as variables named by their full
+    prefixed names, matching what save/load_parameters uses.
+    """
+    from ..gluon import block as blk
+
+    if inputs is None:
+        inputs = [var(n) for n in input_names]
+    elif not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    prev = blk._in_trace_flag()
+    blk._set_in_trace(True)
+    try:
+        out = block(*inputs)
+    finally:
+        blk._set_in_trace(prev)
+    if isinstance(out, (list, tuple)):
+        from . import Group
+        out = Group(list(out))
+    params = dict(block.collect_params())
+    return out, params

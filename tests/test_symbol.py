@@ -1,0 +1,152 @@
+"""Symbol composition / inference / executor tests
+(ref: tests/python/unittest/test_symbol.py, test_executor.py,
+test_infer_shape.py).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def test_compose_and_list_arguments():
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=16)
+    act = sym.Activation(fc1, act_type="relu")
+    fc2 = sym.FullyConnected(act, name="fc2", num_hidden=4)
+    assert fc2.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"]
+    assert fc2.list_outputs() == ["fc2_output"]
+
+
+def test_infer_shape_mlp():
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=16)
+    fc2 = sym.FullyConnected(fc1, name="fc2", num_hidden=4)
+    arg_shapes, out_shapes, aux_shapes = fc2.infer_shape(data=(5, 8))
+    assert arg_shapes == [(5, 8), (16, 8), (16,), (4, 16), (4,)]
+    assert out_shapes == [(5, 4)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_conv_bn():
+    data = sym.var("data")
+    c = sym.Convolution(data, name="conv0", kernel=(3, 3), num_filter=8,
+                        pad=(1, 1))
+    b = sym.BatchNorm(c, name="bn0")
+    r = sym.Activation(b, act_type="relu")
+    arg_shapes, out_shapes, aux_shapes = r.infer_shape(data=(2, 3, 8, 8))
+    assert out_shapes == [(2, 8, 8, 8)]
+    assert r.list_auxiliary_states() == ["bn0_moving_mean", "bn0_moving_var"]
+    assert aux_shapes == [(8,), (8,)]
+
+
+def test_infer_type():
+    data = sym.var("data")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=3)
+    arg_types, out_types, _ = fc.infer_type(data="float32")
+    assert all(t == np.float32 for t in out_types)
+
+
+def test_json_roundtrip():
+    data = sym.var("data")
+    c = sym.Convolution(data, name="conv0", kernel=(3, 3), num_filter=8)
+    b = sym.BatchNorm(c, name="bn0")
+    js = b.tojson()
+    s2 = sym.load_json(js)
+    assert s2.list_arguments() == b.list_arguments()
+    assert s2.list_auxiliary_states() == b.list_auxiliary_states()
+    a1, o1, x1 = b.infer_shape(data=(2, 3, 8, 8))
+    a2, o2, x2 = s2.infer_shape(data=(2, 3, 8, 8))
+    assert o1 == o2 and a1 == a2
+
+
+def test_executor_forward_backward_matches_numpy():
+    data = sym.var("data")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=3)
+    loss = sym.sum(fc * fc)
+    ex = loss.simple_bind(data=(4, 5))
+    x = np.random.rand(4, 5).astype("float32")
+    w = np.random.rand(3, 5).astype("float32")
+    b = np.random.rand(3).astype("float32")
+    ex.arg_dict["data"]._data = mx.nd.array(x)._data
+    ex.arg_dict["fc_weight"]._data = mx.nd.array(w)._data
+    ex.arg_dict["fc_bias"]._data = mx.nd.array(b)._data
+    (out,) = ex.forward(is_train=True)
+    y = x @ w.T + b
+    np.testing.assert_allclose(out.asnumpy(), (y * y).sum(), rtol=1e-5)
+    ex.backward()
+    # d(sum y^2)/dW = 2 y^T x
+    np.testing.assert_allclose(ex.grad_dict["fc_weight"].asnumpy(),
+                               2 * y.T @ x, rtol=1e-4)
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(),
+                               2 * y @ w, rtol=1e-4)
+
+
+def test_executor_bn_training_updates_aux():
+    data = sym.var("data")
+    b = sym.BatchNorm(data, name="bn0", momentum=0.5, fix_gamma=False)
+    ex = b.simple_bind(data=(4, 3))
+    x = np.random.rand(4, 3).astype("float32") + 2.0
+    ex.arg_dict["data"]._data = mx.nd.array(x)._data
+    ex.arg_dict["bn0_gamma"]._data = mx.nd.ones((3,))._data
+    ex.forward(is_train=True)
+    mm = ex.aux_dict["bn0_moving_mean"].asnumpy()
+    np.testing.assert_allclose(mm, 0.5 * x.mean(axis=0), rtol=1e-5)
+    # inference mode must NOT update moving stats
+    ex.forward(is_train=False)
+    np.testing.assert_allclose(ex.aux_dict["bn0_moving_mean"].asnumpy(), mm)
+
+
+def test_group_and_getitem():
+    a = sym.var("a")
+    b = sym.var("b")
+    g = sym.Group([a + b, a * b])
+    assert len(g) == 2
+    outs = g.list_outputs()
+    assert len(outs) == 2
+    first = g[0]
+    assert len(first) == 1
+
+
+def test_symbol_compose_call():
+    data = sym.var("data")
+    net1 = sym.FullyConnected(data, name="fc1", num_hidden=4)
+    data2 = sym.var("data2")
+    pre = sym.Activation(data2, act_type="tanh", name="pre")
+    composed = net1(data=pre)
+    args = composed.list_arguments()
+    assert "data2" in args and "data" not in args
+    a, o, _ = composed.infer_shape(data2=(2, 6))
+    assert o == [(2, 4)]
+
+
+def test_symbol_arithmetic_and_scalar_ops():
+    a = sym.var("a")
+    s = (a + 2.0) * 3.0 - a
+    ex = s.bind(args={"a": mx.nd.array(np.array([1.0, 2.0], np.float32))})
+    (out,) = ex.forward()
+    np.testing.assert_allclose(out.asnumpy(), [8.0, 10.0])
+
+
+def test_gluon_export_symbolblock_import(tmp_path):
+    from mxnet_tpu import gluon
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="relu"))
+    net.add(gluon.nn.Dense(3))
+    net.initialize()
+    x = mx.nd.array(np.random.rand(2, 5).astype("float32"))
+    y_ref = net(x).asnumpy()
+    path = str(tmp_path / "model")
+    sym_file, param_file = net.export(path)
+    blk = gluon.SymbolBlock.imports(sym_file, ["data"], param_file)
+    y2 = blk(x).asnumpy()
+    np.testing.assert_allclose(y2, y_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_eval_dict():
+    a = sym.var("a")
+    b = sym.var("b")
+    out = sym.broadcast_add(a, b)
+    r = out.eval_dict({"a": mx.nd.ones((2, 3)), "b": mx.nd.ones((1, 3))})
+    np.testing.assert_allclose(r.asnumpy(), 2 * np.ones((2, 3)))
