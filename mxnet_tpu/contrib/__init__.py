@@ -1,0 +1,3 @@
+"""mx.contrib — quantization, ONNX, text utilities
+(ref: python/mxnet/contrib/)."""
+from . import quantization

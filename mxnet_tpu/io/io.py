@@ -268,17 +268,20 @@ class PrefetchingIter(DataIter):
         self._start()
 
     def _start(self):
-        self._queue = queue.Queue(maxsize=self._depth)
-        self._stop = threading.Event()
+        q = queue.Queue(maxsize=self._depth)
+        stop = threading.Event()
+        self._queue, self._stop = q, stop
 
         def producer():
-            while not self._stop.is_set():
+            # closes over ITS OWN queue/stop — a lingering producer from
+            # a previous epoch can never push into the new queue
+            while not stop.is_set():
                 try:
                     batches = [it.next() for it in self.iters]
                 except StopIteration:
-                    self._queue.put(None)
+                    q.put(None)
                     return
-                self._queue.put(batches)
+                q.put(batches)
 
         self._thread = threading.Thread(target=producer, daemon=True)
         self._thread.start()
@@ -301,12 +304,14 @@ class PrefetchingIter(DataIter):
 
     def reset(self):
         self._stop.set()
-        try:
-            while True:
-                self._queue.get_nowait()
-        except queue.Empty:
-            pass
-        self._thread.join(timeout=5)
+        # drain until the producer exits — it may be blocked on put()
+        while self._thread.is_alive():
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.2)
         for it in self.iters:
             it.reset()
         self._start()

@@ -66,8 +66,7 @@ class SequentialModule(BaseModule):
             if not last:
                 out_shapes = module.output_shapes
                 if meta.get(self.META_AUTO_WIRING, False):
-                    names = module.data_names if False else \
-                        self._modules[i + 1].data_names
+                    names = self._modules[i + 1].data_names
                     cur_shapes = [DataDesc(n, s)
                                   for n, (_, s) in zip(names, out_shapes)]
                 else:

@@ -67,9 +67,16 @@ def requantize(data, min_range, max_range, min_calib_range=None,
 
 
 @register("_contrib_quantized_fully_connected")
-def quantized_fully_connected(data, weight, bias, min_data, max_data,
-                              min_weight, max_weight, min_bias, max_bias,
-                              num_hidden=0, no_bias=False, flatten=True):
+def quantized_fully_connected(*args, num_hidden=0, no_bias=False,
+                              flatten=True):
+    """Inputs: (data, weight[, bias], min/max pairs per input) — arity
+    follows no_bias as in the reference op."""
+    if no_bias:
+        data, weight, min_data, max_data, min_weight, max_weight = args
+        bias = min_bias = max_bias = None
+    else:
+        (data, weight, bias, min_data, max_data, min_weight, max_weight,
+         min_bias, max_bias) = args
     x = data.reshape(data.shape[0], -1) if flatten else data
     acc = lax.dot_general(
         x, weight, dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
@@ -86,11 +93,18 @@ def quantized_fully_connected(data, weight, bias, min_data, max_data,
 
 
 @register("_contrib_quantized_conv")
-def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
-                   max_weight, min_bias, max_bias, kernel=(), stride=(),
+def quantized_conv(*args, kernel=(), stride=(),
                    dilate=(), pad=(), num_filter=0, num_group=1, no_bias=False,
                    layout="NCHW", workspace=1024, cudnn_tune=None,
                    cudnn_off=False):
+    """Inputs follow the reference arity: (data, weight[, bias],
+    min/max pairs per input)."""
+    if no_bias:
+        data, weight, min_data, max_data, min_weight, max_weight = args
+        bias = min_bias = max_bias = None
+    else:
+        (data, weight, bias, min_data, max_data, min_weight, max_weight,
+         min_bias, max_bias) = args
     nd = len(kernel)
     stride = tuple(stride) or (1,) * nd
     dilate = tuple(dilate) or (1,) * nd

@@ -1,0 +1,7 @@
+"""Subgraph partitioning + backend fusion properties
+(ref: src/operator/subgraph/)."""
+from .partition import (SubgraphSelector, SubgraphProperty,
+                        register_subgraph_property, get_subgraph_property,
+                        partition_graph, list_backends)
+from . import xla_fuse  # registers the "XLA" property
+from . import default_property  # registers the "default" property

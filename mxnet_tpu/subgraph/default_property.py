@@ -1,0 +1,119 @@
+"""The "default" property: group selected ops into one node that
+executes its sub-symbol as a unit (ref:
+src/operator/subgraph/default_subgraph_property.cc:76 — subgraphs run
+as a CachedOp). Selection is by op-name set, the
+SubgraphPropertyOpNameSet contract used by test_subgraph_op.py.
+"""
+from __future__ import annotations
+
+import functools
+import json
+
+import jax
+
+from ..ops import registry as _reg
+from ..symbol.symbol import Symbol, _Node, var
+from .partition import (SubgraphProperty, SubgraphSelector,
+                        register_subgraph_property)
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_subgraph(subgraph_json, input_names):
+    """Lower a serialized sub-symbol to a callable over raw arrays."""
+    from ..symbol import load_json
+
+    sub = load_json(subgraph_json)
+    order = sub._topo()
+
+    def run(*arrays):
+        env = {}
+        bindings = dict(zip(input_names, arrays))
+        for node in order:
+            if node.op is None:
+                env[(id(node), 0)] = bindings[node.name]
+                continue
+            opdef = _reg.get(node.op)
+            ins = [env[(id(c), k)] for c, k in node.inputs]
+            attrs = {k: v for k, v in node.attrs.items()
+                     if not k.startswith("__")}
+            out = opdef.fn(*ins, **attrs)
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            for k, o in enumerate(outs):
+                env[(id(node), k)] = o
+        outs = [env[(id(n), k)] for n, k in sub._outputs]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    return run
+
+
+@_reg.register("_subgraph_exec", wrap_jit=False)
+def subgraph_exec(*arrays, subgraph_json="", input_names=()):
+    return _compiled_subgraph(subgraph_json, tuple(input_names))(*arrays)
+
+
+class OpNameSelector(SubgraphSelector):
+    """Greedy union of adjacent ops from a name whitelist
+    (ref: subgraph_property.h:198 SubgraphPropertyOpNameSet)."""
+
+    def __init__(self, op_names):
+        self.op_names = set(op_names)
+
+    def select(self, node):
+        return node.op in self.op_names
+
+    def select_input(self, node, input_node):
+        return input_node.op in self.op_names
+
+    def select_output(self, node, output_node):
+        return output_node.op in self.op_names
+
+
+class DefaultSubgraphProperty(SubgraphProperty):
+    op_name = "_subgraph_exec"
+
+    def __init__(self, op_names=()):
+        self.op_names = tuple(op_names)
+
+    def create_selector(self):
+        return OpNameSelector(self.op_names)
+
+    def create_subgraph_node(self, nodes, external_inputs, idx):
+        # rebuild the matched set as a standalone symbol whose free
+        # variables are the external inputs — one var PER USE, in the
+        # same positional order the partitioner wires node.inputs
+        in_group = {id(n) for n in nodes}
+        in_names = []
+        use_idx = [0]
+        memo = {}
+
+        def copy(node):
+            if id(node) in memo:
+                return memo[id(node)]
+            new = _Node(node.op, node.name, node.attrs)
+            memo[id(node)] = new
+            ins = []
+            for c, k in node.inputs:
+                if id(c) in in_group:
+                    ins.append((copy(c), k))
+                else:
+                    name = f"_in{use_idx[0]}"
+                    use_idx[0] += 1
+                    in_names.append(name)
+                    ins.append(var(name)._outputs[0])
+            new.inputs = ins
+            return new
+
+        # copy in the same topo order the partitioner used to collect
+        # external_inputs so positions line up
+        for n in nodes:
+            copy(n)
+        sink = memo[id(nodes[-1])]
+        n_out = nodes[-1].num_outputs()
+        sub = Symbol([(sink, k) for k in range(n_out)])
+        attrs = {"subgraph_json": sub.tojson(),
+                 "input_names": tuple(in_names),
+                 "__num_outputs__": n_out}
+        return _Node("_subgraph_exec", f"subgraph{idx}", attrs)
+
+
+register_subgraph_property("default", DefaultSubgraphProperty())

@@ -1,0 +1,254 @@
+"""Graph partitioner: the SubgraphSelector seed-grow protocol over the
+Symbol DAG (ref: src/operator/subgraph/partition_graph.cc +
+subgraph_property.h:54,93,155,201).
+
+A property supplies a selector; the partitioner seeds at each matching
+node, grows along input/output edges under the selector's control,
+filters the candidate set, checks convexity (no path in→out through
+external nodes — the reference's cycle check), and replaces each
+surviving set with one node built by the property. On TPU the payoff is
+different from MKL-DNN's: XLA already fuses elementwise chains, so
+properties here do *algebraic* rewrites the compiler can't — BN folding
+into conv weights, requantize collapsing — and hand the result to XLA
+as a single op.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..symbol.symbol import Symbol, _Node
+
+_PROPERTIES = {}
+
+
+class SubgraphSelector:
+    """Grow protocol (ref: subgraph_property.h:54 SubgraphSelector)."""
+
+    def select(self, node):
+        """Is `node` a seed?"""
+        return False
+
+    def select_input(self, node, input_node):
+        """Grow from `node` to its producer `input_node`?"""
+        return False
+
+    def select_output(self, node, output_node):
+        """Grow from `node` to its consumer `output_node`?"""
+        return False
+
+    def filter(self, candidates):
+        """Final say over the grown candidate list."""
+        return candidates
+
+
+class SubgraphProperty:
+    """Backend fusion policy (ref: subgraph_property.h:93)."""
+
+    op_name = "_subgraph"
+
+    def create_selector(self):
+        return SubgraphSelector()
+
+    def create_subgraph_node(self, nodes, external_inputs, idx):
+        """Build the replacement node.
+
+        Parameters
+        ----------
+        nodes : list[_Node] — the matched nodes, topo-ordered.
+        external_inputs : list[(node, k)] — inputs entering the set,
+            in first-use order.
+        idx : int — running subgraph index (for naming).
+
+        Returns the new _Node whose inputs are `external_inputs`.
+        """
+        raise NotImplementedError
+
+
+def register_subgraph_property(name, prop):
+    _PROPERTIES[name] = prop
+    return prop
+
+
+def get_subgraph_property(name):
+    try:
+        return _PROPERTIES[name]
+    except KeyError:
+        raise MXNetError(
+            f"subgraph backend {name!r} not registered; known: "
+            f"{sorted(_PROPERTIES)}") from None
+
+
+def list_backends():
+    return sorted(_PROPERTIES)
+
+
+def _consumers(order):
+    cons = {}
+    for node in order:
+        for child, k in node.inputs:
+            cons.setdefault(id(child), []).append(node)
+    return cons
+
+
+def partition_graph(symbol, prop_or_name):
+    """Apply one property over the whole graph
+    (ref: partition_graph.cc PartitionGraph pass)."""
+    prop = (get_subgraph_property(prop_or_name)
+            if isinstance(prop_or_name, str) else prop_or_name)
+    order = symbol._topo()
+    consumers = _consumers(order)
+    out_ids = {id(n) for n, _ in symbol._outputs}
+    claimed = set()
+    groups = []  # list[list[_Node]]
+
+    for seed in order:
+        if seed.op is None or id(seed) in claimed:
+            continue
+        selector = prop.create_selector()
+        if not selector.select(seed):
+            continue
+        # grow: BFS along input and output edges under selector control
+        group = [seed]
+        in_group = {id(seed)}
+        frontier = [seed]
+        while frontier:
+            node = frontier.pop(0)
+            for child, _ in node.inputs:
+                if id(child) in in_group or id(child) in claimed:
+                    continue
+                if selector.select_input(node, child):
+                    group.append(child)
+                    in_group.add(id(child))
+                    frontier.append(child)
+            for cons in consumers.get(id(node), ()):
+                if id(cons) in in_group or id(cons) in claimed:
+                    continue
+                if selector.select_output(node, cons):
+                    group.append(cons)
+                    in_group.add(id(cons))
+                    frontier.append(cons)
+        group = selector.filter(group)
+        if not group:
+            continue
+        in_group = {id(n) for n in group}
+        if not _is_convex(group, in_group, consumers):
+            continue
+        # intermediate outputs consumed outside the group (except the
+        # group's sink) make the rewrite invalid — reject (the branch
+        # negative case, ref: test_neg_conv_bn)
+        sink = _find_sink(group, in_group, consumers, out_ids)
+        if sink is None:
+            continue
+        ok = True
+        for n in group:
+            if n is sink:
+                continue
+            ext = [c for c in consumers.get(id(n), ())
+                   if id(c) not in in_group]
+            if ext or id(n) in out_ids:
+                ok = False
+                break
+        if not ok:
+            continue
+        claimed |= in_group
+        groups.append((group, sink))
+
+    if not groups:
+        return symbol
+
+    # rewrite: topo-copy the graph, splicing in subgraph nodes
+    group_of = {}     # id(original node) -> (group, sink)
+    for group, sink in groups:
+        for n in group:
+            group_of[id(n)] = (group, sink)
+
+    memo = {}
+
+    def copy(node):
+        if id(node) in memo:
+            return memo[id(node)]
+        if id(node) in group_of:
+            group, sink = group_of[id(node)]
+            new = _build_subgraph_node(prop, group, sink, memo, copy)
+            for n in group:
+                memo[id(n)] = new
+            return new
+        new = _Node(node.op, node.name, node.attrs)
+        memo[id(node)] = new
+        new.inputs = [(copy(c), k) for c, k in node.inputs]
+        return new
+
+    sub_idx = [0]
+
+    def _build_subgraph_node(prop, group, sink, memo, copy):
+        # external inputs in first-use positional order, one entry PER
+        # USE (no dedup): fused ops unpack inputs positionally, so a
+        # tensor feeding two group edges (e.g. x + conv(x)) must appear
+        # twice
+        in_group = {id(n) for n in group}
+        ext_inputs = []
+        for n in _topo_of(group, in_group):
+            for c, k in n.inputs:
+                if id(c) not in in_group:
+                    ext_inputs.append((c, k))
+        new = prop.create_subgraph_node(
+            _topo_of(group, in_group), ext_inputs, sub_idx[0])
+        sub_idx[0] += 1
+        new.inputs = [(copy(c), k) for c, k in ext_inputs]
+        return new
+
+    outs = [(copy(n), k) for n, k in symbol._outputs]
+    return Symbol(outs)
+
+
+def _topo_of(group, in_group):
+    """Topo-order the group's nodes (inputs before users)."""
+    order, seen = [], set()
+
+    def visit(n):
+        if id(n) in seen:
+            return
+        seen.add(id(n))
+        for c, _ in n.inputs:
+            if id(c) in in_group:
+                visit(c)
+        order.append(n)
+
+    for n in group:
+        visit(n)
+    return order
+
+
+def _find_sink(group, in_group, consumers, out_ids):
+    """The unique node whose outputs leave the group."""
+    sinks = []
+    for n in group:
+        ext = [c for c in consumers.get(id(n), ())
+               if id(c) not in in_group]
+        if ext or id(n) in out_ids or not consumers.get(id(n)):
+            sinks.append(n)
+    return sinks[0] if len(sinks) == 1 else None
+
+
+def _is_convex(group, in_group, consumers):
+    """No path from inside the group back in through external nodes
+    (would create a cycle after fusion — ref: partition_graph.cc cycle
+    detection)."""
+    # walk forward from external consumers of group nodes; if any
+    # external path re-enters the group, reject
+    start = []
+    for n in group:
+        for c in consumers.get(id(n), ()):
+            if id(c) not in in_group:
+                start.append(c)
+    seen = set()
+    frontier = list(start)
+    while frontier:
+        node = frontier.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        if id(node) in in_group:
+            return False
+        for c in consumers.get(id(node), ()):
+            frontier.append(c)
+    return True

@@ -1,0 +1,169 @@
+"""The "XLA" fusion backend: conv+BN(+add)+ReLU collapsed into one op
+(the TPU mirror of the MKL-DNN property — ref:
+src/operator/subgraph/mkldnn/mkldnn_conv_property.cc:30-140 state
+machine kStart→kBN→kSum→kSuccess, executed by SgMKLDNNConvOperator,
+mkldnn_conv.cc).
+
+Where MKL-DNN gains come from opaque layouts and post-ops, the TPU gain
+is algebraic: BatchNorm's affine transform folds into the convolution
+weights *before* the matmul (w' = w·γ/√(σ²+ε), b' = β+(b−μ)·γ/√(σ²+ε)),
+removing the BN entirely from the lowered HLO; the residual add and
+ReLU ride the conv's epilogue fusion on the MXU output.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops import registry as _reg
+from ..ops.nn import convolution
+from ..symbol.symbol import _Node
+from .partition import (SubgraphProperty, SubgraphSelector,
+                        register_subgraph_property)
+
+_K_START, _K_BN, _K_SUM, _K_SUCCESS, _K_FAIL = range(5)
+
+
+@_reg.register("_sg_xla_conv")
+def sg_xla_conv(data, weight, *rest, kernel=(), stride=(), dilate=(),
+                pad=(), num_filter=0, num_group=1, no_bias=False,
+                layout=None, with_bn=False, with_sum=False, with_act=False,
+                bn_eps=1e-3, bn_fix_gamma=True):
+    """Fused Convolution[+BatchNorm][+sum][+relu].
+
+    Input order after (data, weight): [bias], [gamma, beta, moving_mean,
+    moving_var], [sum_input] — presence controlled by attrs.
+    """
+    rest = list(rest)
+    bias = rest.pop(0) if not no_bias else None
+    if with_bn:
+        gamma, beta, mean, var = rest[:4]
+        rest = rest[4:]
+        g = jnp.ones_like(gamma) if bn_fix_gamma else gamma
+        scale = g * lax.rsqrt(var + bn_eps)
+        weight = weight * scale.reshape((-1,) + (1,) * (weight.ndim - 1))
+        fold_b = beta - mean * scale
+        bias = fold_b if bias is None else bias * scale + fold_b
+    out = convolution(data, weight, bias, kernel=kernel, stride=stride,
+                      dilate=dilate, pad=pad, num_filter=num_filter,
+                      num_group=num_group,
+                      no_bias=bias is None)
+    if with_sum:
+        out = out + rest.pop(0)
+    if with_act:
+        out = jnp.maximum(out, 0)
+    return out
+
+
+class XlaConvSelector(SubgraphSelector):
+    """conv → [BN] → [add] → [relu] along the consumer chain
+    (same state machine as SgMKLDNNConvSelector)."""
+
+    def __init__(self):
+        self.status = _K_FAIL
+        self.matched = []
+
+    def select(self, node):
+        if node.op == "Convolution":
+            self.status = _K_START
+            self.matched = [node]
+            return True
+        return False
+
+    def select_output(self, node, output_node):
+        if self.status in (_K_FAIL, _K_SUCCESS):
+            return False
+        if self.matched[-1] is not node:
+            # internal branch: truncate behind `node` and stop
+            while self.matched[-1] is not node:
+                self.matched.pop()
+            self.status = _K_SUCCESS
+            return False
+        op = output_node.op
+        if self.status == _K_START and op == "BatchNorm":
+            # the executor's training hook can't see through the fused
+            # node, so only global-stats (inference-semantics) BN or
+            # fix_gamma'd BN folds; training graphs keep BN separate
+            self.matched.append(output_node)
+            self.status = _K_BN
+            return True
+        if self.status in (_K_START, _K_BN) and \
+                op in ("elemwise_add", "broadcast_add", "_add"):
+            self.matched.append(output_node)
+            self.status = _K_SUM
+            return True
+        if op == "Activation" and \
+                output_node.attrs.get("act_type") == "relu":
+            self.matched.append(output_node)
+            # relu is always the last post-op: sg_xla_conv applies
+            # sum before act, so nothing may fuse after the relu
+            self.status = _K_SUCCESS
+            return True
+        self.status = _K_SUCCESS
+        return False
+
+    def filter(self, candidates):
+        if self.status == _K_FAIL:
+            return []
+        return [n for n in candidates if n in self.matched]
+
+
+class XlaConvProperty(SubgraphProperty):
+    op_name = "_sg_xla_conv"
+
+    def create_selector(self):
+        return XlaConvSelector()
+
+    def create_subgraph_node(self, nodes, external_inputs, idx):
+        conv = next(n for n in nodes if n.op == "Convolution")
+        bn = next((n for n in nodes if n.op == "BatchNorm"), None)
+        has_sum = any(n.op in ("elemwise_add", "broadcast_add", "_add")
+                      for n in nodes)
+        has_act = any(n.op == "Activation" for n in nodes)
+        keep = ("kernel", "stride", "dilate", "pad", "num_filter",
+                "num_group", "no_bias", "layout")
+        attrs = {k: v for k, v in conv.attrs.items() if k in keep}
+        attrs["with_bn"] = bn is not None
+        attrs["with_sum"] = has_sum
+        attrs["with_act"] = has_act
+        if bn is not None:
+            attrs["bn_eps"] = bn.attrs.get("eps", 1e-3)
+            attrs["bn_fix_gamma"] = bn.attrs.get("fix_gamma", True)
+        name = f"sg_xla_conv_{conv.name}_{idx}"
+        return _Node("_sg_xla_conv", name, attrs)
+
+
+def _sg_conv_shapes(ins, attrs):
+    """Back-infer parameter shapes for the fused node (weight/bias +
+    folded BN vectors + the sum input at conv-output shape)."""
+    from ..symbol import symbol as _sym
+    data = ins[0]
+    if data is None:
+        return None
+    kernel = tuple(attrs.get("kernel", ()))
+    stride = tuple(attrs.get("stride", ())) or (1,) * len(kernel)
+    dilate = tuple(attrs.get("dilate", ())) or (1,) * len(kernel)
+    pad = tuple(attrs.get("pad", ())) or (0,) * len(kernel)
+    nf = int(attrs.get("num_filter", 0))
+    ng = int(attrs.get("num_group", 1))
+    out = [None, (nf, int(data[1]) // ng) + kernel]
+    if not attrs.get("no_bias", False):
+        out.append((nf,))
+    if attrs.get("with_bn"):
+        out.extend([(nf,)] * 4)
+    if attrs.get("with_sum"):
+        spatial = tuple(
+            (data[2 + i] + 2 * pad[i] - (dilate[i] * (kernel[i] - 1) + 1))
+            // stride[i] + 1 for i in range(len(kernel)))
+        out.append((data[0], nf) + spatial)
+    return out
+
+
+def _register_shape_infer():
+    from ..symbol import symbol as _sym
+    _sym._PARAM_SHAPE_INFER["_sg_xla_conv"] = _sg_conv_shapes
+
+
+_register_shape_infer()
+register_subgraph_property("XLA", XlaConvProperty())

@@ -59,6 +59,8 @@ class _Node:
     def num_outputs(self):
         if self.op is None:
             return 1
+        if "__num_outputs__" in self.attrs:
+            return int(self.attrs["__num_outputs__"])
         opdef = _reg.get(self.op)
         n = opdef.num_outputs
         if self.attrs.get("output_mean_var"):
@@ -425,9 +427,19 @@ class Symbol:
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
                     shared_exec=None, group2ctx=None, **kwargs):
         """Allocate argument/grad/aux arrays from inferred shapes and bind
-        (ref: graph_executor.cc:1592 SimpleBind)."""
+        (ref: graph_executor.cc:1592 SimpleBind). Honors
+        MXNET_SUBGRAPH_BACKEND the way the reference does at bind
+        (ref: graph_executor.cc:46)."""
+        import os
         from ..executor import Executor
         from ..ndarray import zeros
+        req_all_null = (grad_req == "null" if isinstance(grad_req, str)
+                        else all(v == "null" for v in grad_req.values()))
+        if req_all_null:
+            # inference binds only: fused BN folds moving stats, which
+            # would silently freeze them under training
+            self = self._maybe_partition(os.environ.get(
+                "MXNET_SUBGRAPH_BACKEND"))
         arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
         type_dict = type_dict or {}
         arg_types, _, aux_types = self.infer_type(**{
@@ -448,6 +460,18 @@ class Symbol:
                          for n, a in args.items()}
         return Executor(self, ctx, args=args, args_grad=args_grad,
                         grad_req=grad_req, aux_states=aux)
+
+    def _maybe_partition(self, backend):
+        if not backend:
+            return self
+        from ..subgraph import partition_graph
+        return partition_graph(self, backend)
+
+    def get_backend_symbol(self, backend):
+        """Apply a registered subgraph backend (ref: c_api
+        MXGenBackendSubgraph / sym.get_backend_symbol)."""
+        from ..subgraph import partition_graph
+        return partition_graph(self, backend)
 
     # -- operators ---------------------------------------------------------
     def __add__(self, other):

@@ -1,0 +1,154 @@
+"""INT8 quantization tests
+(ref: tests/python/quantization/test_quantization.py — quantize/
+dequantize/requantize numerics, quantized ops vs FP32 within tolerance,
+quantize_model end-to-end, KL threshold unit tests).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.contrib.quantization import (
+    _get_optimal_threshold, _quantize_symbol, quantize_model)
+
+
+def test_quantize_dequantize_roundtrip():
+    x = np.random.uniform(-3, 3, (4, 8)).astype("float32")
+    q, mn, mx_ = mx.nd.invoke("_contrib_quantize_v2",
+                              [mx.nd.array(x)], {})
+    back = mx.nd.invoke("_contrib_dequantize", [q, mn, mx_], {})
+    scale = np.abs(x).max() / 127.0
+    np.testing.assert_allclose(back.asnumpy(), x, atol=scale * 0.51)
+
+
+def test_requantize_int32_to_int8():
+    acc = np.array([[1 << 20, -(1 << 22)]], np.int32)
+    in_range = 100.0  # int32 full-scale = |100.0|
+    out = mx.nd.invoke(
+        "_contrib_requantize",
+        [mx.nd.array(acc.astype("int32")),
+         mx.nd.array(np.float32(-in_range)),
+         mx.nd.array(np.float32(in_range))], {})
+    q, mn, mx_ = out
+    real = acc.astype(np.float64) * (in_range / (2 ** 31 - 1))
+    back = q.asnumpy().astype(np.float64) * (float(mx_.asnumpy()) / 127.0)
+    np.testing.assert_allclose(back, real, rtol=0.02)
+
+
+def test_quantized_fc_matches_fp32():
+    rng = np.random.default_rng(0)
+    x = rng.uniform(-1, 1, (4, 16)).astype("float32")
+    w = rng.uniform(-1, 1, (8, 16)).astype("float32")
+    b = rng.uniform(-1, 1, (8,)).astype("float32")
+    ref = x @ w.T + b
+
+    def q(a):
+        amax = np.abs(a).max()
+        return (np.clip(np.rint(a * 127.0 / amax), -127, 127)
+                .astype(np.int8), -amax, amax)
+
+    qx, xmn, xmx = q(x)
+    qw, wmn, wmx = q(w)
+    qb, bmn, bmx = q(b)
+    out, omn, omx = mx.nd.invoke(
+        "_contrib_quantized_fully_connected",
+        [mx.nd.array(qx), mx.nd.array(qw), mx.nd.array(qb),
+         mx.nd.array(np.float32(xmn)), mx.nd.array(np.float32(xmx)),
+         mx.nd.array(np.float32(wmn)), mx.nd.array(np.float32(wmx)),
+         mx.nd.array(np.float32(bmn)), mx.nd.array(np.float32(bmx))],
+        {"num_hidden": 8})
+    scale = float(omx.asnumpy()) / (2 ** 31 - 1)
+    approx = out.asnumpy().astype(np.float64) * scale
+    np.testing.assert_allclose(approx, ref, atol=0.05)
+
+
+def test_quantize_symbol_structure():
+    data = sym.var("data")
+    c = sym.Convolution(data, name="conv0", kernel=(3, 3), num_filter=8,
+                        pad=(1, 1))
+    r = sym.Activation(c, act_type="relu")
+    fc = sym.FullyConnected(sym.Flatten(r), name="fc", num_hidden=4)
+    qsym, calib = _quantize_symbol(fc)
+    ops = {}
+    for n in qsym._topo():
+        if n.op:
+            ops[n.op] = ops.get(n.op, 0) + 1
+    assert ops.get("_contrib_quantized_conv", 0) == 1
+    assert ops.get("_contrib_quantized_fully_connected", 0) == 1
+    assert ops.get("_contrib_requantize", 0) == 2
+    assert ops.get("Convolution", 0) == 0
+    assert ops.get("FullyConnected", 0) == 0
+    # relu stays fp32 -> dequantize before, quantize after
+    assert ops.get("Activation", 0) == 1
+    assert "conv0_output" in calib and "fc_output" in calib
+
+
+def test_excluded_ops_stay_fp32():
+    data = sym.var("data")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=4)
+    qsym, _ = _quantize_symbol(fc, excluded_sym_names=["fc"])
+    ops = [n.op for n in qsym._topo() if n.op]
+    assert "FullyConnected" in ops
+    assert "_contrib_quantized_fully_connected" not in ops
+
+
+def test_optimal_threshold_basic():
+    samples = [np.abs(np.random.normal(0, 1, 20000).astype("float32"))]
+    t = _get_optimal_threshold(samples)
+    assert 0.5 < t <= float(np.concatenate(samples).max())
+
+
+def test_optimal_threshold_adversarial_case():
+    """(ref: test_quantization.py:672) — tiny-magnitude data must not
+    produce a zero threshold."""
+    samples = [np.abs(np.random.normal(0, 1e-4, 1000).astype("float32"))]
+    t = _get_optimal_threshold(samples)
+    assert t > 0
+
+
+@pytest.mark.parametrize("calib_mode", ["naive", "entropy"])
+def test_quantize_model_end_to_end(calib_mode):
+    rng = np.random.default_rng(0)
+    x = rng.uniform(-1, 1, (64, 1, 8, 8)).astype("float32")
+    data = sym.var("data")
+    c = sym.Convolution(data, name="conv0", kernel=(3, 3), num_filter=4,
+                        pad=(1, 1))
+    r = sym.Activation(c, act_type="relu")
+    net = sym.FullyConnected(sym.Flatten(r), name="fc", num_hidden=3)
+
+    arg_shapes, _, _ = net.infer_shape(data=(64, 1, 8, 8))
+    arg_params = {}
+    for n, s in zip(net.list_arguments(), arg_shapes):
+        if n == "data":
+            continue
+        arg_params[n] = mx.nd.array(
+            rng.uniform(-0.5, 0.5, s).astype("float32"))
+
+    # fp32 reference
+    ex = net.bind(args={**arg_params, "data": mx.nd.array(x)},
+                  grad_req="null")
+    ref = ex.forward(is_train=False)[0].asnumpy()
+
+    calib = mx.io.NDArrayIter(x, np.zeros(64, "float32"), batch_size=16)
+    qsym, qargs, qaux = quantize_model(
+        net, arg_params, {}, calib_mode=calib_mode, calib_data=calib,
+        num_calib_examples=64)
+
+    # quantize nodes must be fully calibrated (static ranges)
+    for n in qsym._topo():
+        if n.op in ("_contrib_quantize_v2", "_contrib_requantize"):
+            assert "min_calib_range" in n.attrs, n.name
+    # offline weight quantization happened
+    assert any(k.endswith("_int8") for k in qargs)
+    assert not any(k in ("conv0_weight", "fc_weight") for k in qargs)
+
+    qex = qsym.bind(args={**qargs, "data": mx.nd.array(x)},
+                    grad_req="null")
+    out = qex.forward(is_train=False)[0].asnumpy()
+    # int8 vs fp32: relative output agreement (the accuracy-envelope
+    # analogue of the README table's ~0.2% top-1 drop)
+    err = np.abs(out - ref).mean() / (np.abs(ref).mean() + 1e-6)
+    assert err < 0.1, f"int8 deviates too much: {err}"
+    # argmax agreement on most rows
+    agree = (out.argmax(1) == ref.argmax(1)).mean()
+    assert agree > 0.9, f"class agreement too low: {agree}"

@@ -1,0 +1,234 @@
+"""Subgraph partitioner + XLA fusion tests
+(ref: tests/python/mkl/test_subgraph.py — positive cases check fused ==
+unfused outputs AND node counts; negative cases assert fusion does NOT
+fire across branches; tests/python/unittest/test_subgraph_op.py for the
+op-name-set default property).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.subgraph import partition_graph
+from mxnet_tpu.subgraph.default_property import DefaultSubgraphProperty
+
+
+def _op_counts(s):
+    counts = {}
+    for node in s._topo():
+        if node.op:
+            counts[node.op] = counts.get(node.op, 0) + 1
+    return counts
+
+
+def _rand_args(s, **shape_hints):
+    arg_shapes, _, aux_shapes = s.infer_shape(**shape_hints)
+    rng = np.random.default_rng(0)
+    args = {n: mx.nd.array(rng.standard_normal(sh).astype("float32"))
+            for n, sh in zip(s.list_arguments(), arg_shapes)}
+    aux = {}
+    for n, sh in zip(s.list_auxiliary_states(), aux_shapes):
+        if n.endswith("var"):
+            aux[n] = mx.nd.array(
+                rng.uniform(0.5, 1.5, sh).astype("float32"))
+        else:
+            aux[n] = mx.nd.array(rng.standard_normal(sh).astype("float32"))
+    return args, aux
+
+
+def _compare(net, data_shape, expect_fused_ops):
+    args, aux = _rand_args(net, data=data_shape)
+    ex = net.bind(args=args, aux_states=aux, grad_req="null")
+    (ref,) = ex.forward(is_train=False)
+
+    fused = partition_graph(net, "XLA")
+    counts = _op_counts(fused)
+    assert counts.get("_sg_xla_conv", 0) == expect_fused_ops["_sg_xla_conv"]
+    for op, n in expect_fused_ops.items():
+        assert counts.get(op, 0) == n, (op, counts)
+    ex2 = fused.bind(args=args, aux_states=aux, grad_req="null")
+    (out,) = ex2.forward(is_train=False)
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                               rtol=2e-4, atol=2e-5)
+    return counts
+
+
+def test_conv_bn_fuses():
+    data = sym.var("data")
+    c = sym.Convolution(data, name="conv0", kernel=(3, 3), num_filter=8,
+                        pad=(1, 1))
+    net = sym.BatchNorm(c, name="bn0", fix_gamma=False)
+    _compare(net, (2, 3, 8, 8),
+             {"_sg_xla_conv": 1, "Convolution": 0, "BatchNorm": 0})
+
+
+def test_conv_relu_fuses():
+    data = sym.var("data")
+    c = sym.Convolution(data, name="conv0", kernel=(3, 3), num_filter=8)
+    net = sym.Activation(c, act_type="relu")
+    _compare(net, (2, 3, 8, 8),
+             {"_sg_xla_conv": 1, "Convolution": 0, "Activation": 0})
+
+
+def test_conv_bn_relu_fuses():
+    data = sym.var("data")
+    c = sym.Convolution(data, name="conv0", kernel=(3, 3), num_filter=8,
+                        pad=(1, 1))
+    b = sym.BatchNorm(c, name="bn0", fix_gamma=False)
+    net = sym.Activation(b, act_type="relu")
+    _compare(net, (2, 3, 8, 8),
+             {"_sg_xla_conv": 1, "Convolution": 0, "BatchNorm": 0,
+              "Activation": 0})
+
+
+def test_conv_bn_sum_relu_fuses():
+    data = sym.var("data")
+    c = sym.Convolution(data, name="conv0", kernel=(3, 3), num_filter=8,
+                        pad=(1, 1))
+    b = sym.BatchNorm(c, name="bn0", fix_gamma=False)
+    shortcut = sym.Convolution(data, name="convs", kernel=(1, 1),
+                               num_filter=8)
+    s = sym.elemwise_add(b, shortcut)
+    net = sym.Activation(s, act_type="relu")
+    counts = _compare(net, (2, 3, 8, 8),
+                      {"_sg_xla_conv": 2, "Convolution": 0,
+                       "BatchNorm": 0, "Activation": 0})
+    assert counts.get("elemwise_add", 0) == 0
+
+
+def test_neg_conv_bn_branch():
+    """BN output also consumed elsewhere -> conv+BN must NOT fuse
+    (ref: test_subgraph.py test_neg_conv_bn)."""
+    data = sym.var("data")
+    c = sym.Convolution(data, name="conv0", kernel=(3, 3), num_filter=8,
+                        pad=(1, 1))
+    b = sym.BatchNorm(c, name="bn0", fix_gamma=False)
+    r = sym.Activation(b, act_type="relu")
+    pool = sym.Pooling(b, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    net = sym.Group([r, pool])
+    fused = partition_graph(net, "XLA")
+    counts = _op_counts(fused)
+    # conv alone may fuse (conv -> _sg_xla_conv) but BN must survive
+    assert counts.get("BatchNorm", 0) == 1
+    assert counts.get("Activation", 0) == 1
+
+
+def test_neg_conv_intermediate_consumed():
+    """Conv output consumed by two heads -> relu must not be folded in."""
+    data = sym.var("data")
+    c = sym.Convolution(data, name="conv0", kernel=(3, 3), num_filter=4)
+    r = sym.Activation(c, act_type="relu")
+    t = sym.tanh(c)
+    net = sym.Group([r, t])
+    fused = partition_graph(net, "XLA")
+    counts = _op_counts(fused)
+    assert counts.get("Activation", 0) == 1
+    assert counts.get("tanh", 0) == 1
+
+
+def test_fused_graph_json_roundtrip():
+    data = sym.var("data")
+    c = sym.Convolution(data, name="conv0", kernel=(3, 3), num_filter=8,
+                        pad=(1, 1))
+    net = sym.BatchNorm(c, name="bn0", fix_gamma=False)
+    fused = partition_graph(net, "XLA")
+    s2 = sym.load_json(fused.tojson())
+    assert _op_counts(s2) == _op_counts(fused)
+    args, aux = _rand_args(net, data=(1, 3, 6, 6))
+    o1 = fused.bind(args=args, aux_states=aux,
+                    grad_req="null").forward()[0]
+    o2 = s2.bind(args=args, aux_states=aux, grad_req="null").forward()[0]
+    np.testing.assert_allclose(o1.asnumpy(), o2.asnumpy(), rtol=1e-5)
+
+
+def test_env_var_backend(monkeypatch):
+    monkeypatch.setenv("MXNET_SUBGRAPH_BACKEND", "XLA")
+    data = sym.var("data")
+    c = sym.Convolution(data, name="conv0", kernel=(3, 3), num_filter=4,
+                        pad=(1, 1))
+    net = sym.Activation(c, act_type="relu")
+    ex = net.simple_bind(data=(1, 3, 6, 6), grad_req="null")
+    assert "sg_xla_conv" in " ".join(
+        n.name for n in ex._symbol._topo() if n.op)
+
+
+def test_default_property_op_name_set():
+    """Whitelist grouping (ref: test_subgraph_op.py with
+    SubgraphPropertyOpNameSet)."""
+    data = sym.var("data")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=4)
+    r = sym.Activation(fc, act_type="relu")
+    net = sym.FullyConnected(r, name="fc2", num_hidden=2)
+    prop = DefaultSubgraphProperty(["FullyConnected", "Activation"])
+    fused = partition_graph(net, prop)
+    counts = _op_counts(fused)
+    assert counts.get("_subgraph_exec", 0) == 1
+    assert counts.get("FullyConnected", 0) == 0
+    args, _ = _rand_args(net, data=(3, 5))
+    ref = net.bind(args=args, grad_req="null").forward()[0]
+    out = fused.bind(args=args, grad_req="null").forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), rtol=1e-5)
+
+
+def test_resnet_block_fusion_count():
+    """A ResNet-style residual block fuses to exactly two fused convs +
+    zero standalone BN/Activation."""
+    data = sym.var("data")
+    c1 = sym.Convolution(data, name="c1", kernel=(3, 3), num_filter=8,
+                         pad=(1, 1))
+    b1 = sym.BatchNorm(c1, name="b1", fix_gamma=False)
+    r1 = sym.Activation(b1, act_type="relu")
+    c2 = sym.Convolution(r1, name="c2", kernel=(3, 3), num_filter=8,
+                         pad=(1, 1))
+    b2 = sym.BatchNorm(c2, name="b2", fix_gamma=False)
+    s = sym.elemwise_add(b2, data)
+    net = sym.Activation(s, act_type="relu")
+    fused = partition_graph(net, "XLA")
+    counts = _op_counts(fused)
+    assert counts.get("_sg_xla_conv", 0) == 2
+    assert counts.get("BatchNorm", 0) == 0
+    assert counts.get("Activation", 0) == 0
+    args, aux = _rand_args(net, data=(2, 8, 8, 8))
+    ref = net.bind(args=args, aux_states=aux, grad_req="null").forward()[0]
+    out = fused.bind(args=args, aux_states=aux,
+                     grad_req="null").forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_residual_same_tensor_sum():
+    """x + conv(x): the shortcut and the conv data are the SAME tensor —
+    both uses must reach the fused op (regression: input dedup)."""
+    data = sym.var("data")
+    c = sym.Convolution(data, name="c0", kernel=(3, 3), num_filter=4,
+                        pad=(1, 1))
+    s = sym.elemwise_add(c, data)
+    net = sym.Activation(s, act_type="relu")
+    args, aux = _rand_args(net, data=(2, 4, 6, 6))
+    ref = net.bind(args=args, aux_states=aux, grad_req="null").forward()[0]
+    fused = partition_graph(net, "XLA")
+    out = fused.bind(args=args, aux_states=aux,
+                     grad_req="null").forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_relu_then_add_not_misfused():
+    """relu(conv(x)) + y must NOT fuse the add into the conv epilogue
+    (sg_xla_conv applies sum BEFORE relu — regression: post-relu add)."""
+    data = sym.var("data")
+    other = sym.var("other")
+    c = sym.Convolution(data, name="c0", kernel=(3, 3), num_filter=4,
+                        pad=(1, 1))
+    r = sym.Activation(c, act_type="relu")
+    net = sym.elemwise_add(r, other)
+    arg_shapes, _, _ = net.infer_shape(data=(2, 4, 6, 6),
+                                       other=(2, 4, 6, 6))
+    rng = np.random.default_rng(3)
+    args = {n: mx.nd.array(rng.standard_normal(sh).astype("float32"))
+            for n, sh in zip(net.list_arguments(), arg_shapes)}
+    ref = net.bind(args=args, grad_req="null").forward()[0]
+    fused = partition_graph(net, "XLA")
+    out = fused.bind(args=args, grad_req="null").forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                               rtol=2e-4, atol=2e-5)
