@@ -8,6 +8,7 @@ push/pull path consumes these directly.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -15,7 +16,7 @@ from ..base import MXNetError
 from .ndarray import NDArray, array, invoke
 
 __all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
-           "cast_storage", "zeros"]
+           "cast_storage", "zeros", "dot", "elemwise_add", "sparse_retain"]
 
 
 class BaseSparseNDArray(NDArray):
@@ -197,15 +198,76 @@ def zeros(stype, shape, ctx=None, dtype=None):
     return dzeros(shape, ctx=ctx, dtype=dtype)
 
 
+def _csr_rows(csr):
+    """Per-nnz row index from indptr (static nnz)."""
+    nnz = csr.data.shape[0]
+    return jnp.searchsorted(csr.indptr._data, jnp.arange(nnz),
+                            side="right") - 1
+
+
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
-    """csr·dense / rsp·dense dot (ref: src/operator/tensor/dot-inl.h)."""
+    """csr·dense / rsp·dense dot without densifying the sparse side
+    (ref: src/operator/tensor/dot-inl.h DotCsrDnsDns/DotCsrDnsRsp).
+
+    CSR·dense is a gather + segment-sum over nnz — static shapes, so
+    XLA compiles it once; the MXU sees only the dense gather/matmul.
+    """
+    rhs_nd = rhs if isinstance(rhs, NDArray) else NDArray(rhs)
     if isinstance(lhs, CSRNDArray):
-        dense = lhs.tostype("default")
-        return invoke("dot", [dense, rhs],
-                      {"transpose_a": transpose_a, "transpose_b": transpose_b})
+        m, n = lhs.shape
+        rows = _csr_rows(lhs)
+        cols = lhs.indices._data
+        vals = lhs.data._data
+        b = rhs_nd._data
+        if transpose_b:
+            b = b.T
+        if transpose_a:
+            # out[n, k] = sum_nnz val * B[row]  grouped by col
+            contrib = vals[:, None] * b[rows]
+            out = jax.ops.segment_sum(contrib, cols, num_segments=n)
+        else:
+            # out[m, k] = sum_nnz val * B[col]  grouped by row
+            contrib = vals[:, None] * b[cols]
+            out = jax.ops.segment_sum(contrib, rows, num_segments=m)
+        return NDArray(out)
     if isinstance(lhs, RowSparseNDArray):
-        dense = lhs.tostype("default")
-        return invoke("dot", [dense, rhs],
-                      {"transpose_a": transpose_a, "transpose_b": transpose_b})
+        m = lhs.shape[0]
+        b = rhs_nd._data
+        if transpose_b:
+            b = b.T
+        if transpose_a:
+            # out = A.T @ B: scatter-free — only stored rows contribute
+            out = jnp.einsum("rd,rk->dk", 0 + lhs.data._data,
+                             b[lhs.indices._data])
+            return NDArray(out)
+        rows_out = lhs.data._data @ b
+        out = jnp.zeros((m, rows_out.shape[1]), rows_out.dtype)
+        out = out.at[lhs.indices._data].set(rows_out)
+        return NDArray(out)
     return invoke("dot", [lhs, rhs],
                   {"transpose_a": transpose_a, "transpose_b": transpose_b})
+
+
+def elemwise_add(lhs, rhs):
+    """sparse+sparse keeps row_sparse when row sets align; otherwise
+    falls back to dense (TPU static shapes — a dynamic row-union would
+    force recompiles, SURVEY.md §7 hard part (d))."""
+    if isinstance(lhs, RowSparseNDArray) and             isinstance(rhs, RowSparseNDArray):
+        if lhs.indices.shape == rhs.indices.shape and bool(
+                jnp.all(lhs.indices._data == rhs.indices._data)):
+            return RowSparseNDArray(
+                NDArray(lhs.data._data + rhs.data._data),
+                lhs.indices, lhs.shape)
+        return NDArray(lhs.tostype("default")._data +
+                       rhs.tostype("default")._data)
+    a = lhs.tostype("default") if not isinstance(lhs, NDArray) else lhs
+    b = rhs.tostype("default") if not isinstance(rhs, NDArray) else rhs
+    return NDArray(a._data + b._data)
+
+
+def sparse_retain(arr, indices):
+    """Public wrapper over RowSparseNDArray.retain
+    (ref: src/operator/tensor/sparse_retain.cc)."""
+    if not isinstance(arr, RowSparseNDArray):
+        raise MXNetError("sparse_retain expects a RowSparseNDArray")
+    return arr.retain(indices)
