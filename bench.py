@@ -16,19 +16,74 @@ number is 193.47 img/s on a 36-core Skylake, docs/faq/perf.md:49).
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
-
-import jax
-import jax.numpy as jnp
-import numpy as np
 
 BATCH = 128
 WARMUP = 3
 ITERS = 20
 TARGET = 4000.0  # img/s/chip, BASELINE.json
+METRIC = "resnet50_inference_bf16_bs%d" % BATCH
+
+_CHILD_SENTINEL = "MXNET_TPU_BENCH_CHILD"
 
 
-def build_forward(batch, dtype=jnp.bfloat16):
+def _diag(msg):
+    print("[bench %s] %s" % (time.strftime("%H:%M:%S"), msg),
+          file=sys.stderr, flush=True)
+
+
+def _fail_json(err):
+    """Partial JSON so the driver captures *something* on failure."""
+    print(json.dumps({
+        "metric": METRIC, "value": 0.0, "unit": "img/s/chip",
+        "vs_baseline": 0.0, "error": str(err)[:500],
+    }), flush=True)
+
+
+def supervise():
+    """Run the real bench in a child process with retry + timeout.
+
+    Round 1 failed with 'Unable to initialize backend axon: UNAVAILABLE'
+    and produced no output at all (VERDICT.md Weak #1). A fresh process
+    per attempt sidesteps jax's cached backend-init failure, a per-attempt
+    timeout fails fast instead of hanging until the driver's kill, and a
+    retry after a delay rides out a slow-to-come-up TPU tunnel.
+    """
+    env = dict(os.environ)
+    env[_CHILD_SENTINEL] = "1"
+    attempts, delay = 3, 20
+    last_err = "unknown"
+    for i in range(attempts):
+        _diag("attempt %d/%d starting" % (i + 1, attempts))
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, stdout=subprocess.PIPE, timeout=600)
+        except subprocess.TimeoutExpired:
+            last_err = "bench child timed out after 600s"
+            _diag(last_err)
+            continue
+        out = proc.stdout.decode(errors="replace")
+        line = next((ln for ln in reversed(out.splitlines())
+                     if ln.startswith("{")), None)
+        if proc.returncode == 0 and line is not None:
+            print(line, flush=True)
+            return 0
+        last_err = ("child rc=%d, stdout tail: %r"
+                    % (proc.returncode, out[-300:]))
+        _diag(last_err)
+        if i + 1 < attempts:
+            time.sleep(delay)
+    _fail_json(last_err)
+    return 1
+
+
+def build_forward(batch, dtype=None):
+    import jax
+    import jax.numpy as jnp
     import mxnet_tpu as mx  # noqa: F401  (registers ops)
     from mxnet_tpu.gluon import block as blk
     from mxnet_tpu.gluon.block import _flatten
@@ -57,7 +112,7 @@ def build_forward(batch, dtype=jnp.bfloat16):
     jfn, _o, _a = net._build_cached(plist, in_spec, training=False)
     key = jax.random.PRNGKey(0)
 
-    if dtype == jnp.bfloat16:
+    if dtype is None or dtype == jnp.bfloat16:
         # bf16 activations/weights; BN stats stay fp32 inside the layers
         pvals = tuple(v.astype(jnp.bfloat16)
                       if v.dtype == jnp.float32 else v for v in pvals)
@@ -70,6 +125,25 @@ def build_forward(batch, dtype=jnp.bfloat16):
 
 
 def main():
+    import signal
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def _alarm(signum, frame):
+        raise TimeoutError("TPU backend init timed out after 150s")
+
+    _diag("initializing backend")
+    signal.signal(signal.SIGALRM, _alarm)
+    signal.alarm(150)  # fail fast: a healthy init takes seconds
+    try:
+        devs = jax.devices()
+    finally:
+        signal.alarm(0)
+    _diag("devices: %s" % (devs,))
+
+    _diag("building forward")
     fwd, pvals = build_forward(BATCH)
     pvals = jax.device_put(pvals)
     rng = np.random.default_rng(0)
@@ -77,8 +151,10 @@ def main():
                                            dtype=np.float32),
                        dtype=jnp.bfloat16)
 
+    _diag("compiling + warmup")
     for _ in range(WARMUP):
         fwd(pvals, data).block_until_ready()
+    _diag("timing %d iters" % ITERS)
     t0 = time.perf_counter()
     out = None
     for _ in range(ITERS):
@@ -87,13 +163,22 @@ def main():
     dt = time.perf_counter() - t0
 
     ips = BATCH * ITERS / dt
+    _diag("done: %.1f img/s" % ips)
     print(json.dumps({
-        "metric": "resnet50_inference_bf16_bs%d" % BATCH,
+        "metric": METRIC,
         "value": round(ips, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(ips / TARGET, 4),
-    }))
+    }), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get(_CHILD_SENTINEL) == "1":
+        try:
+            main()
+        except Exception as e:  # noqa: BLE001 — report, don't hang
+            _diag("bench failed: %r" % (e,))
+            _fail_json(e)
+            sys.exit(1)
+    else:
+        sys.exit(supervise())

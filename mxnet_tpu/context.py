@@ -79,6 +79,7 @@ class Context:
         # PJRT owns the device allocator; nothing to flush explicitly.
         return
 
+
     @classmethod
     def default_ctx(cls):
         if not hasattr(cls._default_ctx, "value"):
@@ -95,6 +96,20 @@ class Context:
     def __exit__(self, *exc):
         Context._default_ctx.value = _context_stack.stack.pop()
         return False
+
+
+def dp_mesh(ctx_list):
+    """A 1-axis 'dp' Mesh over a context list, or None when the entries
+    don't resolve to distinct jax devices (cpu(0) listed twice,
+    oversubscribed ids). Shared by Module binding and gluon
+    split_and_load so both agree on what forms a data-parallel mesh."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devices = [Context(c).jax_device for c in ctx_list]
+    if len(set(devices)) != len(devices):
+        return None
+    return Mesh(np.array(devices), ("dp",))
 
 
 def cpu(device_id=0):

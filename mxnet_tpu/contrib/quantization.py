@@ -101,8 +101,9 @@ def _quantize_symbol(symbol, excluded_sym_names=(), offline_params=()):
 
     for node in symbol._topo():
         if node.op is None:
+            # variable outputs keep their bare name in list_outputs
             memo[id(node)] = [_Entry(node, 0, False,
-                                     calib_key=f"{node.name}_output")]
+                                     calib_key=node.name)]
             continue
         if node.op in _QUANTIZED_OP and node.name not in excluded:
             qop = _QUANTIZED_OP[node.op]

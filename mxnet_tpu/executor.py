@@ -29,9 +29,25 @@ class Executor:
     """Bound computation (ref: python/mxnet/executor.py Executor)."""
 
     def __init__(self, symbol, ctx=None, args=None, args_grad=None,
-                 grad_req="write", aux_states=None):
+                 grad_req="write", aux_states=None, mesh=None,
+                 arg_specs=None):
         self._symbol = symbol
         self._ctx = ctx
+        # data-parallel execution over a device mesh: args are placed with
+        # NamedShardings (params replicated, data sharded over 'dp') and
+        # jit compiles one SPMD program — GSPMD inserts the gradient
+        # all-reduce that the reference's KVStoreLocal Reduce performs
+        # explicitly (ref: src/kvstore/kvstore_local.h:173-258,
+        # module/executor_group.py:281 decide_slices)
+        self._mesh = mesh
+        self._arg_specs = dict(arg_specs or {})
+        self._shardings = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            self._shardings = {
+                n: NamedSharding(mesh, self._arg_specs.get(n, P()))
+                for n in symbol.list_inputs()}
+            self._replicated = NamedSharding(mesh, P())
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
         self.output_names = symbol.list_outputs()
@@ -139,6 +155,8 @@ class Executor:
         if mv_node.op is None:
             aux_updates[mv_node.name] = (
                 momentum * ins[4] + (1 - momentum) * var)
+        if attrs.get("output_mean_var"):
+            return out, mean, var
         return out
 
     def _jitted_forward(self, training):
@@ -149,6 +167,45 @@ class Executor:
             self._fwd_cache[training] = entry
         return entry
 
+    def _serialize_steps(self):
+        # overlapping collective programs can deadlock XLA's in-process
+        # CPU communicator; the TPU runtime orders executions itself
+        return self._mesh is not None and jax.default_backend() == "cpu"
+
+    def _maybe_profile(self, name):
+        """Profiler region when running, else a falsy nullcontext."""
+        from . import profiler
+        if profiler.is_running():
+            return profiler.timed_region(name, "executor")
+        import contextlib
+        return contextlib.nullcontext()
+
+    def _place(self, arg_vals, aux_vals, key):
+        """Shard/replicate inputs onto the mesh (no-op when already
+        placed; computation then follows data under jit)."""
+        if self._mesh is None:
+            return arg_vals, aux_vals, key
+        ndev = self._mesh.devices.size
+        placed = {}
+        for n, v in arg_vals.items():
+            spec = self._arg_specs.get(n)
+            if spec and spec[0] == "dp" and v.shape \
+                    and v.shape[0] % ndev != 0:
+                raise MXNetError(
+                    f"batch axis of '{n}' has size {v.shape[0]}, not "
+                    f"divisible by the {ndev} devices in the context "
+                    "list; pad the iterator (last_batch_handle='pad') "
+                    "or pick a divisible batch size")
+            placed[n] = jax.device_put(v, self._shardings[n])
+            # make placement sticky: next forward's device_put is a no-op
+            # instead of a fresh full-model broadcast
+            self.arg_dict[n]._data = placed[n]
+        aux_placed = {}
+        for n, v in aux_vals.items():
+            aux_placed[n] = jax.device_put(v, self._replicated)
+            self.aux_dict[n]._data = aux_placed[n]
+        return placed, aux_placed, jax.device_put(key, self._replicated)
+
     def forward(self, is_train=False, **kwargs):
         for n, v in kwargs.items():
             if n not in self.arg_dict:
@@ -158,8 +215,13 @@ class Executor:
         arg_vals = {n: a._data for n, a in self.arg_dict.items()}
         aux_vals = {n: a._data for n, a in self.aux_dict.items()}
         key = _random.next_key()
-        outs, aux_updates = self._jitted_forward(bool(is_train))(
-            arg_vals, aux_vals, key)
+        arg_vals, aux_vals, key = self._place(arg_vals, aux_vals, key)
+        with self._maybe_profile("executor_forward") as prof:
+            outs, aux_updates = self._jitted_forward(bool(is_train))(
+                arg_vals, aux_vals, key)
+            if prof or self._serialize_steps():
+                (outs, aux_updates) = jax.block_until_ready(
+                    (outs, aux_updates))
         if is_train:
             self._last_state = (arg_vals, aux_vals, key)
         for n, v in aux_updates.items():
@@ -208,7 +270,10 @@ class Executor:
                 out_grads = [out_grads]
             cotangents = [g._data if isinstance(g, NDArray)
                           else jnp.asarray(g) for g in out_grads]
-        grads = self._vjp(arg_vals, aux_vals, key, cotangents)
+        with self._maybe_profile("executor_backward") as prof:
+            grads = self._vjp(arg_vals, aux_vals, key, cotangents)
+            if prof or self._serialize_steps():
+                grads = jax.block_until_ready(grads)
         for n in grad_names:
             req = self._grad_req[n]
             g = self.grad_dict.get(n)

@@ -27,16 +27,40 @@ def split_data(data, num_slice, batch_axis=0, even_split=True):
 
 
 def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
-    """Slice the batch across contexts (ref: utils.py split_and_load).
+    """Lay the batch out across the contexts (ref: utils.py
+    split_and_load).
 
-    On a TPU mesh the preferred path is a sharded jit step; this imperative
-    splitter exists for API parity and multi-context eager loops."""
+    The reference returns one slice per device and runs K separate
+    forward/backwards. The TPU-native equivalent is SPMD: the batch is
+    placed ONCE, sharded over a 'dp' mesh built from ``ctx_list``, and
+    returned as a single-element list — the usual
+    ``for x in split_and_load(...)`` loop then runs one XLA program over
+    all devices, with the gradient all-reduce inserted by the
+    partitioner instead of KVStore Reduce (SURVEY.md §7 design stance).
+    """
     if not isinstance(data, NDArray):
         data = array(data)
     if len(ctx_list) == 1:
         return [data.as_in_context(ctx_list[0])]
-    slices = split_data(data, len(ctx_list), batch_axis, even_split)
-    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+    from ..context import dp_mesh
+    uneven = data.shape[batch_axis] % len(ctx_list) != 0
+    if even_split and uneven:
+        raise MXNetError(
+            f"cannot evenly split batch of {data.shape[batch_axis]} "
+            f"across {len(ctx_list)} devices")
+    mesh = None if uneven else dp_mesh(ctx_list)
+    if mesh is None:
+        # repeated devices can't form a mesh, and GSPMD needs the batch
+        # axis divisible by the mesh — plain slicing for parity in both
+        # cases (the reference's uneven [3,3,2,2]-style slices)
+        slices = split_data(data, len(ctx_list), batch_axis, even_split)
+        return [s.as_in_context(ctx)
+                for s, ctx in zip(slices, ctx_list)]
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    spec = P(*([None] * batch_axis + ["dp"]))
+    return [NDArray(jax.device_put(data._data,
+                                   NamedSharding(mesh, spec)))]
 
 
 def clip_global_norm(arrays, max_norm, check_isfinite=True):

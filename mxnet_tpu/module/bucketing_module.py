@@ -87,18 +87,15 @@ class BucketingModule(BaseModule):
         mod = self._gen_module(bucket_key)
         if not mod.binded:
             mod.bind(data_shapes, label_shapes, **self._bind_args)
-            if self._curr_module.params_initialized:
-                arg_p, aux_p = self._curr_module.get_params()
-                mod.init_params(arg_params=arg_p, aux_params=aux_p,
-                                allow_missing=False, force_init=True)
             if self._curr_module.optimizer_initialized:
                 mod.borrow_optimizer(self._curr_module)
-        elif self._curr_module is not mod and \
-                self._curr_module.params_initialized:
-            # parameters follow the active bucket
-            arg_p, aux_p = self._curr_module.get_params()
-            mod.init_params(arg_params=arg_p, aux_params=aux_p,
-                            allow_missing=False, force_init=True)
+        if self._curr_module.params_initialized and \
+                not mod.params_initialized:
+            # share the actual arrays — no O(model) copy per switch; also
+            # catches buckets that were bound before init_params ran
+            mod.share_params_from(self._curr_module)
+        # once shared, all buckets see every update through the same
+        # NDArray objects — switching needs no copy at all
         self._curr_module = mod
         self._curr_bucket_key = bucket_key
 

@@ -120,7 +120,10 @@ class Module(BaseModule):
                     req_dict[n] = req
             req = req_dict
         self._grad_req = req
-        self._exec = self._symbol.simple_bind(grad_req=req, **shape_hints)
+        mesh, arg_specs = self._dp_mesh()
+        self._exec = self._symbol.simple_bind(grad_req=req, mesh=mesh,
+                                              arg_specs=arg_specs,
+                                              **shape_hints)
 
         if shared_module is not None and shared_module.params_initialized:
             arg_p, aux_p = shared_module.get_params()
@@ -129,6 +132,31 @@ class Module(BaseModule):
             # params survived a rebind (e.g. reshape)
             self._exec.copy_params_from(self._arg_params, self._aux_params,
                                         allow_extra_params=True)
+
+    def _dp_mesh(self):
+        """Multi-device context list -> a 1-axis 'dp' mesh + arg specs.
+
+        The reference slices each batch across its ctx list
+        (executor_group.py:281 decide_slices) and reduces grads through
+        KVStore comm; here the batch is laid out over a dp mesh axis and
+        XLA's partitioner emits the grad all-reduce inside the step.
+        """
+        ctxs = self._context
+        if not isinstance(ctxs, (list, tuple)) or len(ctxs) <= 1:
+            return None, None
+        from jax.sharding import PartitionSpec as P
+        from ..context import dp_mesh
+        mesh = dp_mesh(ctxs)
+        if mesh is None:
+            # entries resolving to one physical device can't form a mesh
+            self.logger.warning(
+                "context list %s does not map to distinct devices; "
+                "binding single-device", ctxs)
+            return None, None
+        io_names = set(self._data_names) | set(self._label_names)
+        arg_specs = {n: (P("dp") if n in io_names else P())
+                     for n in self._symbol.list_arguments()}
+        return mesh, arg_specs
 
     # -- parameters --------------------------------------------------------
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
@@ -158,6 +186,27 @@ class Module(BaseModule):
                 initializer(InitDesc(name), arr)
         self.params_initialized = True
         self._params_dirty = False
+
+    def share_params_from(self, src_module):
+        """Adopt ``src_module``'s parameter/aux NDArray objects so both
+        executors see every update without copies (the reference shares
+        parameter arrays across bucket executors via shared_group memory,
+        executor_group.py; optimizer updates mutate ``._data`` in place so
+        sharing the objects is sufficient)."""
+        assert self.binded and src_module.binded
+        missing = [n for n in self._param_names
+                   if n not in src_module._exec.arg_dict]
+        if missing:
+            raise MXNetError(
+                f"share_params_from: {missing} not present in the source "
+                "module; initialize them explicitly (bucket graphs must "
+                "share one parameter set)")
+        for n in self._param_names:
+            self._exec.arg_dict[n] = src_module._exec.arg_dict[n]
+        for n in self._aux_names:
+            if n in src_module._exec.aux_dict:
+                self._exec.aux_dict[n] = src_module._exec.aux_dict[n]
+        self.params_initialized = True
 
     def get_params(self):
         assert self.binded and self.params_initialized

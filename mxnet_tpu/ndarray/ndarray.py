@@ -387,7 +387,16 @@ def invoke(op_name, inputs, attrs, out=None):
         nd_inputs.append(i)
         arrays.append(i._data)
 
-    raw = op(*arrays, **attrs)
+    from .. import profiler
+    if profiler.is_running():
+        # engine-style per-op stamp (ref: threaded_engine.cc:481 stops
+        # the ProfileOperator timer at completion); dispatch is async so
+        # this times submission — the XLA-side kernel timeline comes
+        # from profiler.set_config(xla_trace_dir=...)
+        with profiler.timed_operator(op.name):
+            raw = op(*arrays, **attrs)
+    else:
+        raw = op(*arrays, **attrs)
     multi = isinstance(raw, (tuple, list))
     raws = list(raw) if multi else [raw]
     outs = [NDArray(r) for r in raws]

@@ -138,6 +138,12 @@ def quantized_pooling(data, min_data, max_data, kernel=(), pool_type="max",
                   stride=stride, pad=pad, global_pool=global_pool,
                   pooling_convention=pooling_convention,
                   count_include_pad=count_include_pad)
+    if jnp.issubdtype(data.dtype, jnp.integer):
+        # avg pooling divides in float; round-to-nearest and clip rather
+        # than truncate toward zero (matches the reference's rounded int8
+        # averaging)
+        info = jnp.iinfo(data.dtype)
+        out = jnp.clip(jnp.rint(out), info.min, info.max)
     return out.astype(data.dtype), min_data, max_data
 
 

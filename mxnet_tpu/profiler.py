@@ -1,0 +1,228 @@
+"""Profiler with chrome://tracing output (ref: src/profiler/profiler.h:
+87,256,304 Profiler/ProfileStat, python/mxnet/profiler.py).
+
+The reference's engine stamps every pushed operator with start/stop
+times and dumps a chrome-trace JSON plus an aggregate table
+(src/profiler/aggregate_stats.cc). Here the instrumented seams are the
+eager dispatch layer (``ndarray.invoke``), the graph executor
+(forward/backward), and any user code via the ProfileTask/Event/
+Counter/Frame objects — written into one ``traceEvents`` JSON that
+chrome://tracing and Perfetto load directly. XLA-internal per-kernel
+timing lives behind ``jax.profiler`` (TensorBoard format) and can be
+captured alongside via ``set_config(xla_trace_dir=...)``.
+
+Env: ``MXNET_PROFILER_AUTOSTART=1`` starts profiling at import
+(ref: docs/faq/env_var.md).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from .base import MXNetError
+
+_lock = threading.Lock()
+_events = []          # chrome trace event dicts
+_counters = {}
+_state = "stop"
+_config = {
+    "filename": "profile.json",
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": False,
+    "profile_api": False,
+    "aggregate_stats": False,
+    "xla_trace_dir": None,
+}
+_t0 = time.perf_counter()
+_xla_session = None
+
+
+def _now_us():
+    return (time.perf_counter() - _t0) * 1e6
+
+
+def set_config(**kwargs):
+    unknown = set(kwargs) - set(_config)
+    if unknown:
+        raise MXNetError(f"unknown profiler config keys {sorted(unknown)}")
+    _config.update(kwargs)
+
+
+def set_state(state="stop", profile_process="worker"):
+    """'run' starts collection, 'stop' ends it (ref: profiler.py
+    set_state; MXSetProcessProfilerState)."""
+    global _state, _xla_session
+    if state not in ("run", "stop"):
+        raise MXNetError("profiler state must be 'run' or 'stop'")
+    if state == "run" and _state != "run":
+        if _config["xla_trace_dir"]:
+            import jax
+            jax.profiler.start_trace(_config["xla_trace_dir"])
+            _xla_session = True
+    if state == "stop" and _state == "run" and _xla_session:
+        import jax
+        jax.profiler.stop_trace()
+        _xla_session = None
+    _state = state
+
+
+def state():
+    return _state
+
+
+def is_running():
+    return _state == "run"
+
+
+def pause(profile_process="worker"):
+    set_state("stop")
+
+
+def resume(profile_process="worker"):
+    set_state("run")
+
+
+def record_event(name, cat, start_us, dur_us, args=None, tid=None):
+    """Append one complete ('X') chrome trace event."""
+    if _state != "run":
+        return
+    ev = {"name": name, "cat": cat, "ph": "X",
+          "ts": start_us, "dur": dur_us, "pid": 0,
+          "tid": tid if tid is not None else threading.get_ident() % 1000}
+    if args:
+        ev["args"] = args
+    with _lock:
+        _events.append(ev)
+
+
+class _timed:
+    """Context manager timing a region into the trace."""
+
+    def __init__(self, name, cat):
+        self.name = name
+        self.cat = cat
+
+    def __enter__(self):
+        self.start = _now_us()
+        return self
+
+    def __exit__(self, *exc):
+        record_event(self.name, self.cat, self.start,
+                     _now_us() - self.start)
+        return False
+
+
+def timed_operator(name):
+    return _timed(name, "operator")
+
+
+def timed_region(name, cat="region"):
+    return _timed(name, cat)
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write the chrome-trace JSON to the configured filename."""
+    with _lock:
+        events = list(_events)
+        if finished:
+            _events.clear()
+    with open(_config["filename"], "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+
+
+def dumps(reset=False, format="table"):
+    """Aggregate stats string (ref: MXAggregateProfileStatsPrint)."""
+    with _lock:
+        events = list(_events)
+        if reset:
+            _events.clear()
+    agg = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue  # counters/markers carry no duration
+        name = ev["name"]
+        st = agg.setdefault(name, [0, 0.0, float("inf"), 0.0])
+        st[0] += 1
+        st[1] += ev["dur"]
+        st[2] = min(st[2], ev["dur"])
+        st[3] = max(st[3], ev["dur"])
+    lines = [f"{'Name':<40}{'Count':>8}{'Total(us)':>14}"
+             f"{'Min(us)':>12}{'Max(us)':>12}{'Avg(us)':>12}"]
+    for name, (cnt, tot, mn, mx) in sorted(
+            agg.items(), key=lambda kv: -kv[1][1]):
+        lines.append(f"{name:<40}{cnt:>8}{tot:>14.1f}{mn:>12.1f}"
+                     f"{mx:>12.1f}{tot / cnt:>12.1f}")
+    return "\n".join(lines)
+
+
+# -- user-defined instrumentation objects (ref: profiler.h:556-837) -------
+class Domain:
+    def __init__(self, name):
+        self.name = name
+
+
+class Task:
+    def __init__(self, domain, name):
+        self.name = name
+        self.domain = domain
+        self._start = None
+
+    def start(self):
+        self._start = _now_us()
+
+    def stop(self):
+        if self._start is not None:
+            record_event(self.name, f"task:{self.domain.name}",
+                         self._start, _now_us() - self._start)
+            self._start = None
+
+
+class Event(Task):
+    pass
+
+
+class Frame(Task):
+    pass
+
+
+class Counter:
+    def __init__(self, domain, name, value=0):
+        self.name = name
+        self.domain = domain
+        self._value = value
+
+    def set_value(self, value):
+        self._value = value
+        if _state == "run":
+            with _lock:
+                _events.append({"name": self.name, "ph": "C",
+                                "ts": _now_us(), "pid": 0,
+                                "args": {self.name: value}})
+
+    def increment(self, delta=1):
+        self.set_value(self._value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self._value - delta)
+
+    @property
+    def value(self):
+        return self._value
+
+
+def marker(name, scope="process"):
+    if _state == "run":
+        with _lock:
+            _events.append({"name": name, "ph": "i", "ts": _now_us(),
+                            "pid": 0, "s": scope[0]})
+
+
+# instant-marker alias used by the reference API
+mark = marker
+
+if os.environ.get("MXNET_PROFILER_AUTOSTART") == "1":
+    set_state("run")

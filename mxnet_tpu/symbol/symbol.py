@@ -140,7 +140,11 @@ class Symbol:
     def list_outputs(self):
         names = []
         for node, k in self._outputs:
-            if node.num_outputs() == 1:
+            if node.op is None:
+                # variables keep their bare name (nnvm ListOutputs does
+                # the same), so get_internals()['data'] works
+                names.append(node.name)
+            elif node.num_outputs() == 1:
                 names.append(f"{node.name}_output")
             else:
                 names.append(f"{node.name}_output{k}")
@@ -425,7 +429,8 @@ class Symbol:
                         grad_req=grad_req, aux_states=aux_states)
 
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
-                    shared_exec=None, group2ctx=None, **kwargs):
+                    shared_exec=None, group2ctx=None, mesh=None,
+                    arg_specs=None, **kwargs):
         """Allocate argument/grad/aux arrays from inferred shapes and bind
         (ref: graph_executor.cc:1592 SimpleBind). Honors
         MXNET_SUBGRAPH_BACKEND the way the reference does at bind
@@ -454,12 +459,11 @@ class Symbol:
         for name, shape, dt in zip(self.list_auxiliary_states(), aux_shapes,
                                    aux_types):
             aux[name] = zeros(shape, dtype=dt or "float32")
-        args_grad = None
-        if grad_req != "null":
-            args_grad = {n: zeros(a.shape, dtype=a.dtype)
-                         for n, a in args.items()}
-        return Executor(self, ctx, args=args, args_grad=args_grad,
-                        grad_req=grad_req, aux_states=aux)
+        # grads are allocated by Executor per-arg, only where the resolved
+        # per-name req != 'null' — handing it a dense args_grad here would
+        # make fixed/data args look trainable to Module.update
+        return Executor(self, ctx, args=args, grad_req=grad_req,
+                        aux_states=aux, mesh=mesh, arg_specs=arg_specs)
 
     def _maybe_partition(self, backend):
         if not backend:

@@ -1,0 +1,71 @@
+"""Profiler tests (ref: tests/python/unittest/test_profiler.py)."""
+import json
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler, sym
+
+
+def test_profiler_trace_events(tmp_path):
+    out = tmp_path / "profile.json"
+    profiler.set_config(filename=str(out), aggregate_stats=True)
+    profiler.set_state("run")
+    a = mx.nd.ones((32, 32))
+    b = mx.nd.ones((32, 32))
+    for _ in range(3):
+        c = mx.nd.dot(a, b)
+    c.wait_to_read()
+    profiler.set_state("stop")
+    profiler.dump()
+
+    trace = json.loads(out.read_text())
+    events = trace["traceEvents"]
+    assert events, "no trace events recorded"
+    named = {e["name"] for e in events}
+    assert "dot" in named
+    ev = next(e for e in events if e["name"] == "dot")
+    assert ev["ph"] == "X" and ev["dur"] >= 0 and "ts" in ev
+
+
+def test_profiler_aggregate_and_executor(tmp_path):
+    profiler.set_config(filename=str(tmp_path / "p.json"))
+    profiler.set_state("run")
+    data = sym.var("data")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=4)
+    ex = fc.simple_bind(data=(2, 8))
+    ex.forward(is_train=True)
+    ex.backward()
+    stats = profiler.dumps()
+    profiler.set_state("stop")
+    profiler.dump()
+    assert "executor_forward" in stats
+    assert "executor_backward" in stats
+
+
+def test_profiler_custom_objects(tmp_path):
+    out = tmp_path / "custom.json"
+    profiler.set_config(filename=str(out))
+    profiler.set_state("run")
+    domain = profiler.Domain("app")
+    task = profiler.Task(domain, "load_data")
+    task.start()
+    task.stop()
+    counter = profiler.Counter(domain, "batches", 0)
+    counter.increment(5)
+    profiler.marker("epoch_end")
+    profiler.set_state("stop")
+    profiler.dump()
+    events = json.loads(out.read_text())["traceEvents"]
+    names = {e["name"] for e in events}
+    assert {"load_data", "batches", "epoch_end"} <= names
+
+
+def test_profiler_off_by_default(tmp_path):
+    assert profiler.state() == "stop"
+    # no events recorded while stopped
+    profiler.set_config(filename=str(tmp_path / "x.json"))
+    mx.nd.ones((4,)).wait_to_read()
+    profiler.dump()
+    events = json.loads((tmp_path / "x.json").read_text())["traceEvents"]
+    assert events == []
