@@ -1,0 +1,109 @@
+"""Native runtime components (C++), built on demand with g++.
+
+The reference keeps its runtime native (ps-lite transport, dependency
+engine, decode pipeline); this package holds the TPU framework's C++
+pieces. Libraries are compiled lazily from the checked-in sources the
+first time they're needed (g++ is part of the toolchain contract) and
+cached next to the source; an flock serializes concurrent builders
+(e.g. the N processes of a launch.py job racing at import).
+"""
+from __future__ import annotations
+
+import ctypes
+import fcntl
+import os
+import subprocess
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _build(src, out, extra_flags=()):
+    lock_path = out + ".lock"
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            if (os.path.exists(out)
+                    and os.path.getmtime(out) >= os.path.getmtime(src)):
+                return out
+            cmd = ["g++", "-O2", "-shared", "-fPIC", "-pthread",
+                   *extra_flags, src, "-o", out + ".tmp"]
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+            os.replace(out + ".tmp", out)
+            return out
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
+
+
+_comm_lib = None
+
+
+def load_comm():
+    """The distributed KVStore transport (comm.cc)."""
+    global _comm_lib
+    if _comm_lib is not None:
+        return _comm_lib
+    src = os.path.join(_HERE, "comm.cc")
+    out = os.path.join(_HERE, "libmxtpu_comm.so")
+    _build(src, out)
+    lib = ctypes.CDLL(out)
+    lib.mxtpu_server_start.restype = ctypes.c_int
+    lib.mxtpu_server_start.argtypes = [ctypes.c_int, ctypes.c_int]
+    lib.mxtpu_server_poll.restype = ctypes.c_long
+    lib.mxtpu_server_poll.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                      ctypes.c_int]
+    lib.mxtpu_server_set_updater.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_client_connect.restype = ctypes.c_void_p
+    lib.mxtpu_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.mxtpu_client_rank.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_client_rank.restype = ctypes.c_int
+    lib.mxtpu_client_num_workers.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_client_num_workers.restype = ctypes.c_int
+    fptr = ctypes.POINTER(ctypes.c_float)
+    lib.mxtpu_client_init.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                                      fptr, ctypes.c_uint64]
+    lib.mxtpu_client_init.restype = ctypes.c_int
+    lib.mxtpu_client_push.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                                      fptr, ctypes.c_uint64]
+    lib.mxtpu_client_push.restype = ctypes.c_int
+    lib.mxtpu_client_push_2bit.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                                           ctypes.c_char_p, ctypes.c_uint64]
+    lib.mxtpu_client_push_2bit.restype = ctypes.c_int
+    lib.mxtpu_client_pull.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                                      fptr, ctypes.c_uint64]
+    lib.mxtpu_client_pull.restype = ctypes.c_int
+    lib.mxtpu_client_barrier.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_client_barrier.restype = ctypes.c_int
+    lib.mxtpu_client_command.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                                         ctypes.c_char_p, ctypes.c_uint64]
+    lib.mxtpu_client_command.restype = ctypes.c_int
+    lib.mxtpu_client_close.argtypes = [ctypes.c_void_p]
+    _comm_lib = lib
+    return lib
+
+
+# keeps the ctypes callback object alive for the lib's lifetime
+_updater_keepalive = []
+
+UPDATER_CFUNC = ctypes.CFUNCTYPE(
+    None, ctypes.c_uint32, ctypes.POINTER(ctypes.c_float), ctypes.c_uint64,
+    ctypes.POINTER(ctypes.c_float))
+
+
+def set_server_updater(py_fn):
+    """Install a Python updater on the native server.
+
+    ``py_fn(key, recved_np, stored_np)`` mutates ``stored_np`` in place
+    (the reference applies its pickled optimizer the same way,
+    kvstore_dist_server.h:346 ApplyUpdates).
+    """
+    import numpy as np
+    lib = load_comm()
+
+    def trampoline(key, recved, n, stored):
+        r = np.ctypeslib.as_array(recved, shape=(n,))
+        s = np.ctypeslib.as_array(stored, shape=(n,))
+        py_fn(int(key), r, s)
+
+    cb = UPDATER_CFUNC(trampoline)
+    _updater_keepalive.append(cb)
+    lib.mxtpu_server_set_updater(ctypes.cast(cb, ctypes.c_void_p))

@@ -1,0 +1,472 @@
+// Native distributed KVStore transport — the ps-lite equivalent.
+//
+// The reference's multi-process story is a ZMQ parameter server
+// (ref: src/kvstore/kvstore_dist.h:44-771 worker, kvstore_dist_server.h:
+// 155-798 server, ps-lite Van/Postoffice for rendezvous+transport).
+// This is the TPU framework's native answer: a small TCP server that
+// assigns worker ranks at connect (rendezvous), aggregates pushes per
+// key with BSP sync semantics (merge buffer + per-key round counting,
+// exactly DataHandleDefault's protocol), answers queued pulls when a
+// round completes, runs barriers, and optionally calls back into the
+// host language to apply an optimizer server-side (the reference ships
+// a pickled Python optimizer to its servers, python/mxnet/kvstore.py:
+// 450-495 — here the callback crosses the C/Python seam via ctypes).
+//
+// Wire protocol (little-endian):
+//   request:  u8 op | u32 key | u64 nbytes | payload
+//   response: u8 ok | u64 nbytes | payload
+// Ops: 1=INIT 2=PUSH 3=PULL 4=BARRIER 5=COMMAND 6=PUSH_2BIT
+// Commands (key field): 1=set_sync_mode(payload u8) 2=stop
+//   3=server_profiler(ignored) 4=set_optimizer(opaque blob, polled by the
+//   host-language server loop via mxtpu_server_poll)
+//
+// Build: g++ -O2 -shared -fPIC -pthread comm.cc -o libmxtpu_comm.so
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Header {
+  uint8_t op;
+  uint32_t key;
+  uint64_t nbytes;
+} __attribute__((packed));
+
+constexpr uint8_t kInit = 1, kPush = 2, kPull = 3, kBarrier = 4,
+                  kCommand = 5, kPush2Bit = 6;
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_response(int fd, uint8_t ok, const void* payload, uint64_t n) {
+  char hdr[9];
+  hdr[0] = static_cast<char>(ok);
+  std::memcpy(hdr + 1, &n, 8);
+  if (!write_full(fd, hdr, 9)) return false;
+  if (n > 0 && !write_full(fd, payload, n)) return false;
+  return true;
+}
+
+typedef void (*UpdaterFn)(uint32_t key, const float* recved, uint64_t n,
+                          float* stored);
+
+struct KeyState {
+  std::vector<float> store;
+  std::vector<float> merge;
+  int pushed = 0;              // workers reported this round
+  std::vector<int> pending_pulls;  // fds waiting for round completion
+};
+
+struct Server {
+  int listen_fd = -1;
+  int num_workers = 0;
+  bool sync_mode = false;
+  bool stop = false;
+  UpdaterFn updater = nullptr;
+  std::map<uint32_t, KeyState> keys;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::vector<char> opt_blob;
+  bool opt_blob_fresh = false;
+  int barrier_count = 0;
+  uint64_t barrier_gen = 0;
+  std::vector<int> barrier_fds;
+  std::vector<std::thread> threads;
+  std::thread accept_thread;
+  int next_rank = 0;
+};
+
+Server* g_server = nullptr;
+
+// 2-bit stochastic-quantization wire format (ref:
+// src/kvstore/gradient_compression.h:37-121): f32 threshold, u64
+// original length, then ceil(n/16) little-endian u32 words holding 16
+// 2-bit codes each: 0 -> 0, 1 -> +threshold, 2 -> -threshold.
+void accumulate_2bit(const char* payload, uint64_t nbytes,
+                     std::vector<float>* acc) {
+  if (nbytes < 12) return;
+  float threshold;
+  uint64_t n;
+  std::memcpy(&threshold, payload, 4);
+  std::memcpy(&n, payload + 4, 8);
+  const char* words = payload + 12;
+  uint64_t nwords = (nbytes - 12) / 4;
+  if (acc->size() < n) acc->resize(n, 0.f);
+  for (uint64_t w = 0; w < nwords; ++w) {
+    uint32_t word;
+    std::memcpy(&word, words + 4 * w, 4);
+    for (int j = 0; j < 16; ++j) {
+      uint64_t idx = w * 16 + static_cast<uint64_t>(j);
+      if (idx >= n) break;
+      uint32_t code = (word >> (2 * j)) & 0x3u;
+      if (code == 1u)
+        (*acc)[idx] += threshold;
+      else if (code == 2u)
+        (*acc)[idx] -= threshold;
+    }
+  }
+}
+
+void apply_round(Server* s, uint32_t key, KeyState* ks) {
+  // all workers reported: fold merge into store, answer queued pulls
+  if (s->updater) {
+    if (ks->store.size() < ks->merge.size())
+      ks->store.resize(ks->merge.size(), 0.f);
+    s->updater(key, ks->merge.data(), ks->merge.size(), ks->store.data());
+  } else {
+    ks->store = ks->merge;
+  }
+  ks->pushed = 0;
+  for (int fd : ks->pending_pulls) {
+    send_response(fd, 1, ks->store.data(), ks->store.size() * 4);
+  }
+  ks->pending_pulls.clear();
+}
+
+void handle_push(Server* s, int fd, uint32_t key, const char* payload,
+                 uint64_t nbytes, bool compressed) {
+  std::unique_lock<std::mutex> lk(s->mu);
+  KeyState& ks = s->keys[key];
+  bool first = ks.pushed == 0;
+  if (s->sync_mode) {
+    if (first) ks.merge.assign(ks.store.size(), 0.f);
+    if (compressed) {
+      accumulate_2bit(payload, nbytes, &ks.merge);
+    } else {
+      uint64_t n = nbytes / 4;
+      if (ks.merge.size() < n) ks.merge.resize(n, 0.f);
+      const float* src = reinterpret_cast<const float*>(payload);
+      for (uint64_t i = 0; i < n; ++i) ks.merge[i] += src[i];
+    }
+    if (++ks.pushed >= s->num_workers) apply_round(s, key, &ks);
+  } else {
+    // async: apply on arrival (ref: kvstore_dist_server.h async branch)
+    std::vector<float> recved;
+    if (compressed) {
+      accumulate_2bit(payload, nbytes, &recved);
+    } else {
+      recved.assign(reinterpret_cast<const float*>(payload),
+                    reinterpret_cast<const float*>(payload) + nbytes / 4);
+    }
+    if (recved.size() < ks.store.size()) recved.resize(ks.store.size(), 0.f);
+    if (s->updater) {
+      if (ks.store.size() < recved.size())
+        ks.store.resize(recved.size(), 0.f);
+      s->updater(key, recved.data(), recved.size(), ks.store.data());
+    } else {
+      if (ks.store.size() < recved.size()) ks.store.resize(recved.size());
+      for (uint64_t i = 0; i < recved.size(); ++i) ks.store[i] += recved[i];
+    }
+  }
+  lk.unlock();
+  send_response(fd, 1, nullptr, 0);
+}
+
+void handle_conn(Server* s, int fd, int rank) {
+  {  // HELLO: rank assignment (the rendezvous step)
+    uint32_t hello[2] = {static_cast<uint32_t>(rank),
+                         static_cast<uint32_t>(s->num_workers)};
+    if (!write_full(fd, hello, 8)) {
+      ::close(fd);
+      return;
+    }
+  }
+  std::vector<char> payload;
+  for (;;) {
+    Header h;
+    if (!read_full(fd, &h, sizeof(h))) break;
+    payload.resize(h.nbytes);
+    if (h.nbytes > 0 && !read_full(fd, payload.data(), h.nbytes)) break;
+    if (h.op == kInit) {
+      std::unique_lock<std::mutex> lk(s->mu);
+      KeyState& ks = s->keys[h.key];
+      if (ks.store.empty()) {
+        const float* src = reinterpret_cast<const float*>(payload.data());
+        ks.store.assign(src, src + h.nbytes / 4);
+      }
+      lk.unlock();
+      send_response(fd, 1, nullptr, 0);
+    } else if (h.op == kPush || h.op == kPush2Bit) {
+      handle_push(s, fd, h.key, payload.data(), h.nbytes,
+                  h.op == kPush2Bit);
+    } else if (h.op == kPull) {
+      std::unique_lock<std::mutex> lk(s->mu);
+      KeyState& ks = s->keys[h.key];
+      if (s->sync_mode && ks.pushed > 0) {
+        // round in flight: queue until the last worker pushes
+        ks.pending_pulls.push_back(fd);
+        lk.unlock();
+      } else {
+        std::vector<float> snapshot = ks.store;
+        lk.unlock();
+        send_response(fd, 1, snapshot.data(), snapshot.size() * 4);
+      }
+    } else if (h.op == kBarrier) {
+      std::unique_lock<std::mutex> lk(s->mu);
+      s->barrier_fds.push_back(fd);
+      if (static_cast<int>(s->barrier_fds.size()) >= s->num_workers) {
+        for (int bfd : s->barrier_fds) send_response(bfd, 1, nullptr, 0);
+        s->barrier_fds.clear();
+        ++s->barrier_gen;
+        s->cv.notify_all();
+      }
+      lk.unlock();
+    } else if (h.op == kCommand) {
+      if (h.key == 1) {
+        std::lock_guard<std::mutex> lk(s->mu);
+        s->sync_mode = h.nbytes > 0 && payload[0] != 0;
+      } else if (h.key == 2) {
+        std::lock_guard<std::mutex> lk(s->mu);
+        s->stop = true;
+        s->cv.notify_all();
+      } else if (h.key == 4) {
+        // ack only after the host loop picked the blob up and installed
+        // the updater — otherwise the next push round races the install
+        std::unique_lock<std::mutex> lk(s->mu);
+        s->opt_blob.assign(payload.begin(), payload.end());
+        s->opt_blob_fresh = true;
+        s->cv.notify_all();
+        s->cv.wait(lk, [s] { return s->updater != nullptr || s->stop; });
+      }
+      send_response(fd, 1, nullptr, 0);
+    } else {
+      send_response(fd, 0, nullptr, 0);
+    }
+  }
+  ::close(fd);
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------- server
+int mxtpu_server_start(int port, int num_workers) {
+  if (g_server) return -1;
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -2;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return -3;
+  }
+  if (::listen(fd, 64) != 0) {
+    ::close(fd);
+    return -4;
+  }
+  g_server = new Server();
+  g_server->listen_fd = fd;
+  g_server->num_workers = num_workers;
+  g_server->accept_thread = std::thread([s = g_server] {
+    for (;;) {
+      int cfd = ::accept(s->listen_fd, nullptr, nullptr);
+      if (cfd < 0) break;
+      int one = 1;
+      ::setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      int rank;
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        rank = s->next_rank++;
+      }
+      std::lock_guard<std::mutex> lk(s->mu);
+      s->threads.emplace_back(handle_conn, s, cfd, rank);
+    }
+  });
+  return 0;
+}
+
+void mxtpu_server_set_updater(UpdaterFn fn) {
+  if (!g_server) return;
+  std::lock_guard<std::mutex> lk(g_server->mu);
+  g_server->updater = fn;
+  g_server->cv.notify_all();
+}
+
+// blocks until a stop command arrives
+void mxtpu_server_run(void) {
+  if (!g_server) return;
+  std::unique_lock<std::mutex> lk(g_server->mu);
+  g_server->cv.wait(lk, [] { return g_server->stop; });
+}
+
+// host-language server loop: wait up to timeout_ms for an event.
+// Returns -1 on stop, >0 = size of a freshly received optimizer blob
+// (copied into buf if it fits, else truncated-to-0 and still cleared),
+// 0 on timeout with nothing new.
+long mxtpu_server_poll(char* buf, uint64_t cap, int timeout_ms) {
+  if (!g_server) return -1;
+  std::unique_lock<std::mutex> lk(g_server->mu);
+  g_server->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [] {
+    return g_server->stop || g_server->opt_blob_fresh;
+  });
+  if (g_server->opt_blob_fresh) {
+    g_server->opt_blob_fresh = false;
+    uint64_t n = g_server->opt_blob.size();
+    if (buf && n <= cap) {
+      std::memcpy(buf, g_server->opt_blob.data(), n);
+      return static_cast<long>(n);
+    }
+    return 0;
+  }
+  return g_server->stop ? -1 : 0;
+}
+
+void mxtpu_server_shutdown(void) {
+  if (!g_server) return;
+  Server* s = g_server;
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  std::vector<std::thread> workers;
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    workers.swap(s->threads);
+  }
+  for (auto& t : workers) t.detach();  // blocked on dead fds; reclaimed at exit
+  g_server = nullptr;
+}
+
+// ---------------------------------------------------------------- client
+struct Client {
+  int fd;
+  int rank;
+  int num_workers;
+  std::mutex mu;
+};
+
+void* mxtpu_client_connect(const char* host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return nullptr;
+  }
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  uint32_t hello[2];
+  if (!read_full(fd, hello, 8)) {
+    ::close(fd);
+    return nullptr;
+  }
+  Client* c = new Client();
+  c->fd = fd;
+  c->rank = static_cast<int>(hello[0]);
+  c->num_workers = static_cast<int>(hello[1]);
+  return c;
+}
+
+int mxtpu_client_rank(void* h) { return static_cast<Client*>(h)->rank; }
+int mxtpu_client_num_workers(void* h) {
+  return static_cast<Client*>(h)->num_workers;
+}
+
+static int request(Client* c, uint8_t op, uint32_t key, const void* payload,
+                   uint64_t nbytes, void* out, uint64_t out_cap,
+                   uint64_t* out_n) {
+  std::lock_guard<std::mutex> lk(c->mu);
+  Header h{op, key, nbytes};
+  if (!write_full(c->fd, &h, sizeof(h))) return -1;
+  if (nbytes > 0 && !write_full(c->fd, payload, nbytes)) return -1;
+  char rhdr[9];
+  if (!read_full(c->fd, rhdr, 9)) return -1;
+  uint64_t rn;
+  std::memcpy(&rn, rhdr + 1, 8);
+  if (out_n) *out_n = rn;
+  if (rn > 0) {
+    if (out == nullptr || rn > out_cap) {
+      // drain
+      std::vector<char> sink(rn);
+      read_full(c->fd, sink.data(), rn);
+      return -2;
+    }
+    if (!read_full(c->fd, out, rn)) return -1;
+  }
+  return rhdr[0] == 1 ? 0 : -3;
+}
+
+int mxtpu_client_init(void* h, uint32_t key, const float* data, uint64_t n) {
+  return request(static_cast<Client*>(h), kInit, key, data, n * 4, nullptr,
+                 0, nullptr);
+}
+
+int mxtpu_client_push(void* h, uint32_t key, const float* data, uint64_t n) {
+  return request(static_cast<Client*>(h), kPush, key, data, n * 4, nullptr,
+                 0, nullptr);
+}
+
+int mxtpu_client_push_2bit(void* h, uint32_t key, const void* buf,
+                           uint64_t nbytes) {
+  return request(static_cast<Client*>(h), kPush2Bit, key, buf, nbytes,
+                 nullptr, 0, nullptr);
+}
+
+int mxtpu_client_pull(void* h, uint32_t key, float* out, uint64_t n) {
+  uint64_t got = 0;
+  int rc = request(static_cast<Client*>(h), kPull, key, nullptr, 0, out,
+                   n * 4, &got);
+  if (rc != 0) return rc;
+  return static_cast<int>(got / 4);
+}
+
+int mxtpu_client_barrier(void* h) {
+  return request(static_cast<Client*>(h), kBarrier, 0, nullptr, 0, nullptr,
+                 0, nullptr);
+}
+
+int mxtpu_client_command(void* h, uint32_t cmd, const char* body,
+                         uint64_t n) {
+  return request(static_cast<Client*>(h), kCommand, cmd, body, n, nullptr,
+                 0, nullptr);
+}
+
+void mxtpu_client_close(void* h) {
+  Client* c = static_cast<Client*>(h);
+  ::close(c->fd);
+  delete c;
+}
+
+}  // extern "C"

@@ -1,0 +1,163 @@
+"""Multi-process KVStore worker/server glue over the native transport.
+
+Role assignment follows the reference's launcher contract (ref:
+tools/launch.py + dmlc-core tracker env): ``DMLC_ROLE`` is ``worker`` /
+``server`` / ``scheduler``, the server address comes from
+``DMLC_PS_ROOT_URI``/``DMLC_PS_ROOT_PORT``, worker count from
+``DMLC_NUM_WORKER``. The data plane is _native/comm.cc (the ps-lite
+equivalent): rank assignment at connect, BSP merge rounds, barriers,
+and an optional server-side optimizer shipped as a pickled blob
+(ref: python/mxnet/kvstore.py:450-495 set_optimizer).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import time
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import _native
+
+CMD_SYNC_MODE = 1
+CMD_STOP = 2
+CMD_SERVER_PROFILER = 3
+CMD_SET_OPTIMIZER = 4
+
+
+def role():
+    return os.environ.get("DMLC_ROLE", "worker")
+
+
+def server_address():
+    uri = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+    port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+    return uri, port
+
+
+def num_workers_env():
+    return int(os.environ.get("DMLC_NUM_WORKER", "1"))
+
+
+class WorkerConnection:
+    """One worker's connection to the parameter server."""
+
+    def __init__(self, host=None, port=None, timeout=30.0):
+        self._lib = _native.load_comm()
+        if host is None:
+            host, port = server_address()
+        deadline = time.monotonic() + timeout
+        handle = None
+        while time.monotonic() < deadline:
+            handle = self._lib.mxtpu_client_connect(
+                host.encode(), int(port))
+            if handle:
+                break
+            time.sleep(0.1)
+        if not handle:
+            raise MXNetError(
+                f"could not reach kvstore server at {host}:{port}")
+        self._h = ctypes.c_void_p(handle)
+        self.rank = self._lib.mxtpu_client_rank(self._h)
+        self.num_workers = self._lib.mxtpu_client_num_workers(self._h)
+
+    def _fptr(self, arr):
+        return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+    def init(self, key, value):
+        arr = np.ascontiguousarray(value, dtype=np.float32)
+        rc = self._lib.mxtpu_client_init(self._h, key, self._fptr(arr),
+                                         arr.size)
+        if rc != 0:
+            raise MXNetError(f"dist init failed for key {key} (rc={rc})")
+
+    def push(self, key, value):
+        arr = np.ascontiguousarray(value, dtype=np.float32)
+        rc = self._lib.mxtpu_client_push(self._h, key, self._fptr(arr),
+                                         arr.size)
+        if rc != 0:
+            raise MXNetError(f"dist push failed for key {key} (rc={rc})")
+
+    def push_compressed(self, key, payload):
+        rc = self._lib.mxtpu_client_push_2bit(self._h, key, payload,
+                                              len(payload))
+        if rc != 0:
+            raise MXNetError(
+                f"dist compressed push failed for key {key} (rc={rc})")
+
+    def pull(self, key, shape):
+        n = int(np.prod(shape)) if shape else 1
+        out = np.empty(n, dtype=np.float32)
+        got = self._lib.mxtpu_client_pull(self._h, key, self._fptr(out), n)
+        if got < 0:
+            raise MXNetError(f"dist pull failed for key {key} (rc={got})")
+        if got != n:
+            raise MXNetError(
+                f"dist pull size mismatch for key {key}: got {got}, "
+                f"want {n} (was the key initialized?)")
+        return out.reshape(shape)
+
+    def barrier(self):
+        rc = self._lib.mxtpu_client_barrier(self._h)
+        if rc != 0:
+            raise MXNetError(f"dist barrier failed (rc={rc})")
+
+    def command(self, cmd, body=b""):
+        rc = self._lib.mxtpu_client_command(self._h, cmd, body, len(body))
+        if rc != 0:
+            raise MXNetError(f"dist command {cmd} failed (rc={rc})")
+
+    def set_sync_mode(self, sync):
+        self.command(CMD_SYNC_MODE, b"\x01" if sync else b"\x00")
+
+    def send_optimizer(self, optimizer):
+        self.command(CMD_SET_OPTIMIZER, pickle.dumps(optimizer))
+
+    def stop_server(self):
+        self.command(CMD_STOP)
+
+    def close(self):
+        if self._h:
+            self._lib.mxtpu_client_close(self._h)
+            self._h = None
+
+
+def run_server(port=None, num_workers=None, poll_ms=200):
+    """Server process main loop (ref: python/mxnet/kvstore_server.py).
+
+    Starts the native transport, then waits for control events: a
+    pickled optimizer installs a Python updater applied per merge round;
+    a stop command ends the loop.
+    """
+    lib = _native.load_comm()
+    if port is None:
+        _, port = server_address()
+    if num_workers is None:
+        num_workers = num_workers_env()
+    rc = lib.mxtpu_server_start(int(port), int(num_workers))
+    if rc != 0:
+        raise MXNetError(f"kvstore server failed to start (rc={rc})")
+
+    buf = ctypes.create_string_buffer(64 << 20)
+    states = {}
+    while True:
+        got = lib.mxtpu_server_poll(buf, len(buf), poll_ms)
+        if got < 0:
+            break
+        if got > 0:
+            optimizer = pickle.loads(buf.raw[:got])
+
+            def updater(key, recved, stored, _opt=optimizer,
+                        _states=states):
+                from ..ndarray import NDArray
+                import jax.numpy as jnp
+                w = NDArray(jnp.asarray(stored))
+                g = NDArray(jnp.asarray(recved))
+                if key not in _states:
+                    _states[key] = _opt.create_state(key, w)
+                _opt.update(key, w, g, _states[key])
+                stored[:] = np.asarray(w._data, dtype=np.float32)
+
+            _native.set_server_updater(updater)

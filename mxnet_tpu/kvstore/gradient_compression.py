@@ -1,0 +1,79 @@
+"""2-bit gradient compression with error-feedback residual.
+
+Same algorithm as the reference (ref: src/kvstore/gradient_compression.h:
+37-121 GradientCompression, quantize_2bit kernel): each element of
+``grad + residual`` maps to one of three codes — +threshold if >=
+threshold, -threshold if <= -threshold, else 0 — and the quantization
+error is kept in ``residual`` for the next round. 16 two-bit codes pack
+into one little-endian u32 word (code 1 -> +threshold, 2 -> -threshold),
+the exact format `accumulate_2bit` in _native/comm.cc unpacks
+server-side, so compressed pushes stay compressed on the wire.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+
+
+class GradientCompression:
+    def __init__(self, type="2bit", threshold=0.5):
+        if type != "2bit":
+            raise MXNetError(f"unsupported compression type {type!r}")
+        if threshold <= 0:
+            raise MXNetError("threshold must be positive")
+        self.type = type
+        self.threshold = float(threshold)
+        self._residual = {}
+
+    def get_params(self):
+        return {"type": self.type, "threshold": self.threshold}
+
+    def quantize(self, key, grad):
+        """grad (np.float32 array) -> (codes_u32, new_residual applied).
+
+        Returns the packed u32 words; mutates the per-key residual.
+        """
+        t = self.threshold
+        res = self._residual.get(key)
+        if res is None or res.shape != grad.shape:
+            res = np.zeros_like(grad, dtype=np.float32)
+        g = grad.astype(np.float32) + res
+        codes = np.zeros(g.shape, dtype=np.uint32)
+        codes[g >= t] = 1
+        codes[g <= -t] = 2
+        decoded = np.where(codes == 1, t,
+                           np.where(codes == 2, -t, 0.0)).astype(np.float32)
+        self._residual[key] = g - decoded
+        return self._pack(codes.ravel()), decoded
+
+    @staticmethod
+    def _pack(codes):
+        n = codes.size
+        nwords = (n + 15) // 16
+        padded = np.zeros(nwords * 16, dtype=np.uint32)
+        padded[:n] = codes
+        padded = padded.reshape(nwords, 16)
+        shifts = (2 * np.arange(16, dtype=np.uint32))[None, :]
+        return (padded << shifts).sum(axis=1, dtype=np.uint32)
+
+    @staticmethod
+    def unpack(words, n, threshold):
+        """Inverse of _pack + decode (used by tests and local fallback)."""
+        words = np.asarray(words, dtype=np.uint32)
+        shifts = (2 * np.arange(16, dtype=np.uint32))[None, :]
+        codes = ((words[:, None] >> shifts) & 0x3).ravel()[:n]
+        return np.where(codes == 1, threshold,
+                        np.where(codes == 2, -threshold, 0.0)
+                        ).astype(np.float32)
+
+    def wire_payload(self, key, grad):
+        """Full wire message payload for a PUSH_2BIT: f32 threshold,
+        u64 n, packed words."""
+        words, _ = self.quantize(key, grad)
+        header = np.zeros(12, dtype=np.uint8)
+        header[0:4] = np.frombuffer(
+            np.float32(self.threshold).tobytes(), dtype=np.uint8)
+        header[4:12] = np.frombuffer(
+            np.uint64(grad.size).tobytes(), dtype=np.uint8)
+        return header.tobytes() + words.tobytes()

@@ -27,15 +27,31 @@ class KVStore:
         self._updater = None
         self._optimizer = None
         self._compression = None
+        self._conn = None
+        self._update_on_server = False
+        if kv_type.startswith("dist"):
+            import os
+            from . import dist
+            if dist.role() == "worker" and \
+                    os.environ.get("DMLC_PS_ROOT_URI"):
+                self._conn = dist.WorkerConnection()
+                sync = "async" not in kv_type
+                if self._conn.rank == 0:
+                    self._conn.set_sync_mode(sync)
+                self._conn.barrier()  # sync-mode visible to every push
 
     # -- factory-reported topology ----------------------------------------
     @property
     def rank(self):
+        if self._conn is not None:
+            return self._conn.rank
         # single-process SPMD: jax process index is the worker rank
         return jax.process_index()
 
     @property
     def num_workers(self):
+        if self._conn is not None:
+            return self._conn.num_workers
         return jax.process_count() if self.type.startswith("dist") else 1
 
     # -- data plane --------------------------------------------------------
@@ -43,6 +59,21 @@ class KVStore:
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
             self._store[k] = v.copy() if isinstance(v, NDArray) else v
+            if self._conn is not None:
+                # every rank assigns the str->int key index in init order
+                # so the map agrees across workers and with the server
+                self._key_index(k)
+            if self._conn is not None and self._conn.rank == 0:
+                # only rank 0 seeds the server, so every worker then
+                # pulls the same initial weights (kvstore_dist.h Init
+                # guards the push with get_rank() == 0)
+                import numpy as np
+                self._conn.init(self._key_index(k),
+                                np.asarray(v.asnumpy(), dtype=np.float32))
+        if self._conn is not None:
+            # reference workers barrier after init so no pull can race a
+            # not-yet-initialized server key (kvstore_dist.h Init)
+            self._conn.barrier()
 
     def push(self, key, value, priority=0):
         keys, values = self._normalize(key, value)
@@ -55,6 +86,18 @@ class KVStore:
                 v = agg
             if k not in self._store:
                 raise MXNetError(f"key {k} not initialized in kvstore")
+            if self._conn is not None:
+                import numpy as np
+                if isinstance(v, RowSparseNDArray):
+                    v = v.tostype("default")
+                grad = np.asarray(v.asnumpy(), dtype=np.float32)
+                if self._compression is not None:
+                    self._conn.push_compressed(
+                        self._key_index(k),
+                        self._compression.wire_payload(k, grad))
+                else:
+                    self._conn.push(self._key_index(k), grad)
+                continue
             if self._updater is not None:
                 self._updater(self._key_index(k), v, self._store[k])
             else:
@@ -71,6 +114,14 @@ class KVStore:
             if k not in self._store:
                 raise MXNetError(f"key {k} not initialized in kvstore")
             targets = o if isinstance(o, (list, tuple)) else [o]
+            if self._conn is not None:
+                val = self._conn.pull(self._key_index(k),
+                                      targets[0].shape)
+                for t in targets:
+                    # the wire is fp32; keep each target's own dtype so
+                    # mixed-precision params don't silently widen
+                    t._data = jnp.asarray(val, dtype=t._data.dtype)
+                continue
             for t in targets:
                 t._data = self._store[k]._data
 
@@ -78,6 +129,14 @@ class KVStore:
         keys, outs = self._normalize(key, out)
         rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
         for k, o, rid in zip(keys, outs, rids):
+            if self._conn is not None:
+                # refresh the local snapshot from the server before
+                # retaining rows (ref: kvstore_dist.h:470 PullRowSparse —
+                # row-granular wire pulls are a later optimization)
+                val = self._conn.pull(self._key_index(k),
+                                      self._store[k].shape)
+                self._store[k]._data = jnp.asarray(
+                    val, dtype=self._store[k]._data.dtype)
             stored = self._store[k]
             from ..ndarray.sparse import row_sparse_array
             rsp = stored if isinstance(stored, RowSparseNDArray) \
@@ -93,29 +152,54 @@ class KVStore:
 
     # -- control plane -----------------------------------------------------
     def set_optimizer(self, optimizer):
-        """In dist mode the reference pickles the optimizer to the servers
-        (python/mxnet/kvstore.py:450-495); here the updater always runs in
-        the worker process (servers are unnecessary for dense sync DP on a
-        TPU mesh)."""
+        """Single-process: run the updater locally. Dist: pickle the
+        optimizer to the server, matching the reference's contract
+        (python/mxnet/kvstore.py:450-495) — pushes then carry gradients
+        and pulls return server-updated weights."""
         self._optimizer = optimizer
-        self._updater = Updater(optimizer)
+        if self._conn is not None:
+            if self._conn.rank == 0:
+                self._conn.send_optimizer(optimizer)
+            self._conn.barrier()
+            self._update_on_server = True
+            self._updater = None
+        else:
+            self._updater = Updater(optimizer)
 
     def set_gradient_compression(self, compression_params):
-        self._compression = dict(compression_params)
+        from .gradient_compression import GradientCompression
+        self._compression = GradientCompression(**dict(compression_params))
+
+    @property
+    def gradient_compression(self):
+        return self._compression
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._update_on_server:
+            raise MXNetError(
+                "optimizer state lives on the kvstore server in "
+                f"{self.type} mode; checkpoint from the server process "
+                "or use update_on_kvstore=False")
         if self._updater is None:
             raise MXNetError("no optimizer set")
         with open(fname, "wb") as f:
             f.write(self._updater.get_states(dump_optimizer))
 
     def load_optimizer_states(self, fname):
+        if self._update_on_server:
+            raise MXNetError(
+                "optimizer state lives on the kvstore server in "
+                f"{self.type} mode; restore it in the server process "
+                "or use update_on_kvstore=False")
         if self._updater is None:
             raise MXNetError("no optimizer set")
         with open(fname, "rb") as f:
             self._updater.set_states(f.read())
 
     def barrier(self):
+        if self._conn is not None:
+            self._conn.barrier()
+            return
         from .. import engine
         engine.waitall()
 
@@ -123,7 +207,21 @@ class KVStore:
         self.barrier()
 
     def send_command_to_servers(self, head, body):
-        pass
+        if self._conn is not None:
+            body = body.encode() if isinstance(body, str) else bytes(body)
+            self._conn.command(int(head), body)
+
+    def close(self):
+        """Finalize: barrier all workers, rank 0 stops the server (the
+        ps-lite Finalize analogue)."""
+        if self._conn is not None:
+            try:
+                self._conn.barrier()
+                if self._conn.rank == 0:
+                    self._conn.stop_server()
+            finally:
+                self._conn.close()
+                self._conn = None
 
     def _normalize(self, key, value):
         keys = key if isinstance(key, (list, tuple)) else [key]

@@ -1,0 +1,103 @@
+"""Worker program for the multi-process KVStore test (ref:
+tests/nightly/dist_sync_kvstore.py). Run via tools/launch.py -n 4.
+
+Each worker checks, against exact expected values:
+  * rank/num_workers assignment
+  * sync push/pull: pull returns the sum of all workers' pushes
+  * repeated rounds keep BSP semantics
+  * server-side optimizer (pickled to the server, pulls return updated
+    weights)
+  * 2-bit gradient compression with error feedback
+  * barrier ordering
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def check(cond, msg):
+    if not cond:
+        print(f"[worker {os.environ.get('DMLC_WORKER_ID')}] FAIL: {msg}",
+              flush=True)
+        sys.exit(1)
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    nw = kv.num_workers
+    rank = kv.rank
+    check(nw == int(os.environ["DMLC_NUM_WORKER"]),
+          f"num_workers {nw}")
+    check(0 <= rank < nw, f"rank {rank}")
+
+    shape = (3, 4)
+    kv.init(3, mx.nd.ones(shape))
+    kv.barrier()
+
+    # --- sync rounds: pull returns sum over workers ---------------------
+    for rnd in range(1, 4):
+        kv.push(3, mx.nd.ones(shape) * (rank + 1) * rnd)
+        out = mx.nd.zeros(shape)
+        kv.pull(3, out=out)
+        expected = rnd * nw * (nw + 1) / 2.0
+        np.testing.assert_allclose(out.asnumpy(),
+                                   np.full(shape, expected), rtol=1e-6)
+        # BSP round edge: nobody pushes round n+1 before everyone
+        # pulled round n
+        kv.barrier()
+
+    # --- string keys ----------------------------------------------------
+    kv.init("weight_0", mx.nd.zeros((2, 2)))
+    kv.push("weight_0", mx.nd.ones((2, 2)))
+    out = mx.nd.zeros((2, 2))
+    kv.pull("weight_0", out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 2), nw),
+                               rtol=1e-6)
+    kv.barrier()
+
+    # --- server-side optimizer (update_on_kvstore path) ----------------
+    kv2_key = 7
+    kv.init(kv2_key, mx.nd.ones(shape))
+    kv.barrier()
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    kv.push(kv2_key, mx.nd.ones(shape))  # summed grad = nw
+    out = mx.nd.zeros(shape)
+    kv.pull(kv2_key, out=out)
+    # w <- w - lr * sum(grads) = 1 - 0.1 * nw
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.full(shape, 1.0 - 0.1 * nw), rtol=1e-5)
+    kv.barrier()
+
+    # --- 2-bit gradient compression with error feedback ----------------
+    # (ref: tests/nightly/dist_sync_kvstore.py compressed block +
+    #  gradient_compression.h expected values)
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init(9, mx.nd.zeros(shape))
+    kv.barrier()
+    # each element 2.0 quantizes to +0.5, summed then SGD-applied by the
+    # server updater: w = 0 - 0.1 * (0.5 * nw)
+    kv.push(9, mx.nd.ones(shape) * 2.0)
+    out = mx.nd.zeros(shape)
+    kv.pull(9, out=out)
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.full(shape, -0.05 * nw), rtol=1e-5)
+    kv.barrier()
+    # error feedback: residual 1.5 makes a zero push still send +0.5
+    kv.push(9, mx.nd.zeros(shape))
+    kv.pull(9, out=out)
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.full(shape, -0.1 * nw), rtol=1e-5)
+    kv.barrier()
+
+    print(f"[worker {rank}] OK", flush=True)
+    kv.close()
+
+
+if __name__ == "__main__":
+    main()

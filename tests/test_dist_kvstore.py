@@ -1,0 +1,53 @@
+"""Multi-process KVStore integration tests — launches a real 1-server +
+4-worker local job through tools/launch.py, the analogue of the
+reference's 7-process CI target (ref: ci/docker/runtime_functions.sh:978
+integrationtest_ubuntu_cpu_dist_kvstore running
+tests/nightly/dist_sync_kvstore.py via tools/launch.py --launcher local).
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dist_sync_kvstore_4_workers():
+    env = dict(os.environ)
+    # workers only exercise the socket transport — keep jax cheap
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "4", sys.executable,
+         os.path.join(REPO, "tests", "dist_sync_kvstore.py")],
+        env=env, capture_output=True, text=True, timeout=300)
+    sys.stdout.write(proc.stdout)
+    sys.stderr.write(proc.stderr)
+    assert proc.returncode == 0, "dist job failed"
+    for i in range(4):
+        assert f"[worker {i}] OK" in proc.stdout
+
+
+def test_gradient_compression_numerics():
+    """Worker-side 2-bit quantization expected values (ref:
+    tests/nightly/test_kvstore.py compute_expected_2bit_quantization)."""
+    from mxnet_tpu.kvstore.gradient_compression import GradientCompression
+    gc = GradientCompression(type="2bit", threshold=0.5)
+    grad = np.array([0.26, -0.6, 0.0, 2.0, -0.4, 0.51], dtype=np.float32)
+    words, decoded = gc.quantize("k", grad)
+    np.testing.assert_allclose(
+        decoded, [0.0, -0.5, 0.0, 0.5, 0.0, 0.5], rtol=1e-6)
+    # residual keeps the quantization error
+    np.testing.assert_allclose(
+        gc._residual["k"], grad - decoded, rtol=1e-6)
+    # round-trip through the wire format
+    np.testing.assert_allclose(
+        GradientCompression.unpack(words, grad.size, 0.5), decoded,
+        rtol=1e-6)
+    # error feedback: a second all-zero gradient still emits the carried
+    # residual where it crossed threshold
+    _, decoded2 = gc.quantize("k", np.zeros_like(grad))
+    np.testing.assert_allclose(
+        decoded2, [0.0, 0.0, 0.0, 0.5, 0.0, 0.0], atol=1e-6)

@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Local distributed job launcher (ref: tools/launch.py + the dmlc-core
+tracker).
+
+Keeps the reference's CLI contract: ``launch.py -n W [-s S] cmd...``
+forks the server process(es) and W worker processes on this host, wiring
+them together with the same env-var protocol the reference's tracker
+uses (DMLC_ROLE / DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT /
+DMLC_NUM_WORKER / DMLC_NUM_SERVER / DMLC_WORKER_ID). Only the
+``local`` launcher is implemented; ssh/mpi/yarn cluster modes are out
+of scope for a single-host image.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="launch a local multi-process training job")
+    parser.add_argument("-n", "--num-workers", type=int, required=True)
+    parser.add_argument("-s", "--num-servers", type=int, default=1,
+                        help="servers (the native transport uses one "
+                        "aggregation server; values > 1 are clamped)")
+    parser.add_argument("--launcher", default="local",
+                        choices=["local"])
+    parser.add_argument("--env-server", default="",
+                        help="extra KEY=VAL,... env for the server")
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.error("no command given")
+
+    port = _free_port()
+    base_env = dict(os.environ)
+    base_env.update({
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": str(args.num_workers),
+        "DMLC_NUM_SERVER": "1",
+    })
+
+    server_env = dict(base_env, DMLC_ROLE="server")
+    for kv in filter(None, args.env_server.split(",")):
+        k, _, v = kv.partition("=")
+        server_env[k] = v
+    server = subprocess.Popen(
+        [sys.executable, "-c",
+         "from mxnet_tpu.kvstore import dist; dist.run_server()"],
+        env=server_env)
+
+    workers = []
+    for i in range(args.num_workers):
+        env = dict(base_env, DMLC_ROLE="worker", DMLC_WORKER_ID=str(i))
+        workers.append(subprocess.Popen(args.command, env=env))
+
+    rc = 0
+    for w in workers:
+        rc = w.wait() or rc
+    try:
+        server.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        server.kill()
+    if rc != 0:
+        server.kill()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
