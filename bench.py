@@ -23,7 +23,7 @@ import time
 
 BATCH = 128
 WARMUP = 3
-ITERS = 20
+ITERS = 50
 TARGET = 4000.0  # img/s/chip, BASELINE.json
 METRIC = "resnet50_inference_bf16_bs%d" % BATCH
 
@@ -56,25 +56,40 @@ def supervise():
     env[_CHILD_SENTINEL] = "1"
     attempts, delay = 3, 20
     last_err = "unknown"
+
+    def _json_line(raw):
+        if not raw:
+            return None
+        out = raw.decode(errors="replace") if isinstance(raw, bytes) else raw
+        return next((ln for ln in reversed(out.splitlines())
+                     if ln.startswith("{")), None)
+
     for i in range(attempts):
         _diag("attempt %d/%d starting" % (i + 1, attempts))
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)],
-                env=env, stdout=subprocess.PIPE, timeout=600)
-        except subprocess.TimeoutExpired:
-            last_err = "bench child timed out after 600s"
+                env=env, stdout=subprocess.PIPE, timeout=900)
+            out, rc = proc.stdout, proc.returncode
+        except subprocess.TimeoutExpired as e:
+            # the child prints the headline metric as a partial JSON line
+            # the moment the bf16 number is in hand — a later hang in an
+            # auxiliary section (fp32/int8 can wedge in C++ where SIGALRM
+            # can't fire) must not discard it
+            out, rc = e.stdout, -1
+            last_err = "bench child timed out after 900s"
             _diag(last_err)
-            continue
-        out = proc.stdout.decode(errors="replace")
-        line = next((ln for ln in reversed(out.splitlines())
-                     if ln.startswith("{")), None)
-        if proc.returncode == 0 and line is not None:
+        line = _json_line(out)
+        # accept the line on clean exit, or (timeout/crash rescue) when it
+        # is a real measurement rather than the child's own _fail_json —
+        # error lines must still go through the retry loop
+        if line is not None and (rc == 0 or '"error"' not in line):
             print(line, flush=True)
             return 0
-        last_err = ("child rc=%d, stdout tail: %r"
-                    % (proc.returncode, out[-300:]))
-        _diag(last_err)
+        if rc >= 0:
+            last_err = ("child rc=%d, stdout tail: %r"
+                        % (rc, (out or b"")[-300:]))
+            _diag(last_err)
         if i + 1 < attempts:
             time.sleep(delay)
     _fail_json(last_err)
@@ -85,24 +100,13 @@ def build_forward(batch, dtype=None):
     import jax
     import jax.numpy as jnp
     import mxnet_tpu as mx  # noqa: F401  (registers ops)
-    from mxnet_tpu.gluon import block as blk
-    from mxnet_tpu.gluon.block import _flatten
+    from mxnet_tpu.gluon.block import _flatten, infer_shapes
     from mxnet_tpu.gluon.model_zoo import vision
     from mxnet_tpu.ndarray.ndarray import NDArray
 
     net = vision.resnet50_v1()
     net.initialize()
-
-    def _warm(d):
-        prev = blk._in_trace_flag()
-        blk._set_in_trace(True)
-        try:
-            return net.forward(NDArray(d))._data
-        finally:
-            blk._set_in_trace(prev)
-
-    jax.eval_shape(_warm, jax.ShapeDtypeStruct((batch, 3, 224, 224),
-                                               jnp.float32))
+    infer_shapes(net, (batch, 3, 224, 224))
     net.hybridize()
 
     plist = sorted(net.collect_params().items())
@@ -124,6 +128,30 @@ def build_forward(batch, dtype=None):
     return jax.jit(forward), pvals
 
 
+def measure(fwd, pvals, data, sync, iters=ITERS, warmup=WARMUP):
+    """Time `iters` queued forward passes ended by one real device sync.
+
+    `block_until_ready` is NOT a reliable fence on the tunneled axon
+    backend (round-3 finding: it returned after ~0.1 ms for 20 queued
+    ResNet-50 batches, reporting a physically impossible 1.16M img/s).
+    The honest fence is a device-side scalar reduce whose 4-byte result
+    is fetched to the host: the reduce depends on the last output, and
+    executions on one device stream are in-order, so the fetch bounds
+    the whole queued chain."""
+    for _ in range(warmup):
+        sync(fwd(pvals, data))
+    best = None
+    for _trial in range(3):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = fwd(pvals, data)
+        sync(out)
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return data.shape[0] * iters / best
+
+
 def main():
     import signal
 
@@ -143,33 +171,93 @@ def main():
         signal.alarm(0)
     _diag("devices: %s" % (devs,))
 
-    _diag("building forward")
+    reduce_fn = jax.jit(lambda t: jnp.sum(t.astype(jnp.float32)))
+
+    def sync(out):
+        return float(reduce_fn(out))
+
+    rng = np.random.default_rng(0)
+    host_data = rng.standard_normal((BATCH, 3, 224, 224), dtype=np.float32)
+
+    _diag("building bf16 forward")
     fwd, pvals = build_forward(BATCH)
     pvals = jax.device_put(pvals)
-    rng = np.random.default_rng(0)
-    data = jnp.asarray(rng.standard_normal((BATCH, 3, 224, 224),
-                                           dtype=np.float32),
-                       dtype=jnp.bfloat16)
-
-    _diag("compiling + warmup")
-    for _ in range(WARMUP):
-        fwd(pvals, data).block_until_ready()
-    _diag("timing %d iters" % ITERS)
-    t0 = time.perf_counter()
-    out = None
-    for _ in range(ITERS):
-        out = fwd(pvals, data)
-    out.block_until_ready()
-    dt = time.perf_counter() - t0
-
-    ips = BATCH * ITERS / dt
-    _diag("done: %.1f img/s" % ips)
+    data = jnp.asarray(host_data, dtype=jnp.bfloat16)
+    _diag("compiling + timing bf16")
+    ips_bf16 = measure(fwd, pvals, data, sync)
+    _diag("bf16: %.1f img/s" % ips_bf16)
+    # headline secured: emit it NOW so a hang in an aux section can never
+    # cost the round its one measured number (supervise() keeps the last
+    # JSON line it sees, including from a killed child)
     print(json.dumps({
         "metric": METRIC,
-        "value": round(ips, 2),
+        "value": round(ips_bf16, 2),
         "unit": "img/s/chip",
-        "vs_baseline": round(ips / TARGET, 4),
+        "vs_baseline": round(ips_bf16 / TARGET, 4),
+        "partial": True,
     }), flush=True)
+
+    def _aux_section(name, seconds, fn):
+        """Run an auxiliary metric under a hard SIGALRM deadline so it can
+        never eat the supervisor's whole child budget (the headline bf16
+        number is already in hand by the time these run)."""
+        def _t(signum, frame):
+            raise TimeoutError("%s timed out after %ds" % (name, seconds))
+        old = signal.signal(signal.SIGALRM, _t)
+        signal.alarm(seconds)
+        try:
+            v = fn()
+            _diag("%s: %.1f img/s" % (name, v))
+            return round(v, 2), None
+        except Exception as e:  # noqa: BLE001 — auxiliary metric
+            _diag("%s failed: %r" % (name, e))
+            # null, not 0.0: a skipped section must not read as a
+            # measured 0 img/s regression
+            return None, str(e)[:200]
+        finally:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old)
+
+    def _fp32():
+        fwd32, pvals32 = build_forward(BATCH, dtype=jnp.float32)
+        pvals32 = jax.device_put(pvals32)
+        return measure(fwd32, pvals32, jnp.asarray(host_data), sync)
+
+    extra = {}
+    for key, secs, fn in (
+            ("resnet50_inference_fp32_bs%d" % BATCH, 150, _fp32),
+            ("resnet50_inference_int8_bs%d" % BATCH, 240,
+             lambda: _bench_int8(host_data, sync))):
+        val, err = _aux_section(key.split("_")[2], secs, fn)
+        extra[key] = val
+        if err is not None:
+            extra[key + "_error"] = err
+
+    result = {
+        "metric": METRIC,
+        "value": round(ips_bf16, 2),
+        "unit": "img/s/chip",
+        "vs_baseline": round(ips_bf16 / TARGET, 4),
+    }
+    result.update(extra)
+    print(json.dumps(result), flush=True)
+
+
+def _bench_int8(host_data, sync):
+    """INT8 path: quantize the model-zoo ResNet-50 and time it.
+
+    Mirrors the reference quantization flow (example/quantization/
+    README.md): calibrate on a handful of batches, build the int8
+    inference function, time it with the same queued-chain fence."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.contrib.quantization import quantize_net
+
+    qfwd, qparams = quantize_net(
+        "resnet50_v1", batch=BATCH,
+        calib_data=host_data[:8], mode="naive")
+    data = jnp.asarray(host_data, dtype=jnp.float32)
+    return measure(qfwd, qparams, data, sync)
 
 
 if __name__ == "__main__":

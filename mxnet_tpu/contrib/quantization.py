@@ -367,3 +367,55 @@ def quantize_model(sym, arg_params, aux_params, ctx=None,
     used = set(qsym.list_inputs())
     qarg_params = {k: v for k, v in qarg_params.items() if k in used}
     return qsym, qarg_params, dict(aux_params)
+
+
+def quantize_net(model_name, batch, calib_data, mode="naive",
+                 excluded_sym_names=None):
+    """Quantize a Gluon model-zoo network end-to-end into a jitted int8
+    forward function (the example/quantization flow as one call:
+    ref example/quantization/imagenet_gen_qsym_mkldnn.py).
+
+    Traces the net to a Symbol, calibrates on ``calib_data`` (numpy
+    NCHW), runs the QuantizeGraph pass with offline weight quantization,
+    and compiles the quantized graph into one XLA program.
+
+    Returns ``(fwd, params)`` where ``fwd(params, data)`` is jitted and
+    ``params`` is a device-resident tuple.
+    """
+    import jax
+
+    from ..gluon.block import infer_shapes
+    from ..gluon.model_zoo import vision
+    from ..io import NDArrayIter
+    from ..ndarray.ndarray import NDArray
+    from ..symbol.trace import trace_block
+
+    net = getattr(vision, model_name)()
+    net.initialize()
+    infer_shapes(net, (batch,) + tuple(calib_data.shape[1:]))
+
+    sym_out, params = trace_block(net)
+    aux_names = set(sym_out.list_auxiliary_states())
+    arg_params = {k: p.data() for k, p in params.items()
+                  if k not in aux_names}
+    aux_params = {k: p.data() for k, p in params.items() if k in aux_names}
+
+    it = NDArrayIter(data=calib_data,
+                     batch_size=min(len(calib_data), 8))
+    qsym, qarg, qaux = quantize_model(
+        sym_out, arg_params, aux_params, calib_mode=mode,
+        excluded_sym_names=excluded_sym_names,
+        calib_data=it, num_calib_examples=len(calib_data))
+
+    names = sorted(qarg) + sorted(qaux)
+    vals = tuple(qarg[n]._data for n in sorted(qarg)) \
+        + tuple(qaux[n]._data for n in sorted(qaux))
+
+    def fwd(pvals, data):
+        bindings = {n: NDArray(v) for n, v in zip(names, pvals)}
+        bindings["data"] = NDArray(data)
+        out = qsym.eval_dict(bindings)
+        out = out[0] if isinstance(out, (list, tuple)) else out
+        return out._data
+
+    return jax.jit(fwd), jax.device_put(vals)

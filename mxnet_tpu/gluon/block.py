@@ -482,6 +482,34 @@ def _set_in_trace(v):
     _in_trace.value = v
 
 
+def infer_shapes(block, *input_shapes, dtype=None):
+    """Resolve a block's deferred parameter shapes with ONE abstract
+    forward pass — no op is compiled or executed on the device.
+
+    ``jax.eval_shape`` runs the eager path on shape tracers, so each
+    layer's shape inference fires and deferred initializers materialize
+    real (concrete — see ndarray._materialize) parameter arrays. This is
+    the shared warm-up used by bench.py, __graft_entry__.entry() and
+    contrib.quantization.quantize_net; the reference's analogue is the
+    deferred-init first pass of HybridBlock (gluon/block.py:860
+    infer_shape)."""
+    import jax.numpy as jnp
+    dtype = dtype or jnp.float32
+
+    def _warm(*datas):
+        prev = _in_trace_flag()
+        _set_in_trace(True)
+        try:
+            out = block.forward(*[NDArray(d) for d in datas])
+            flat, _spec = _flatten(out)
+            return [o._data for o in flat]
+        finally:
+            _set_in_trace(prev)
+
+    jax.eval_shape(_warm, *[jax.ShapeDtypeStruct(tuple(s), dtype)
+                            for s in input_shapes])
+
+
 class SymbolBlock(HybridBlock):
     """Construct a block from a Symbol (ref: gluon/block.py:952)."""
 

@@ -29,6 +29,23 @@ __all__ = ["NDArray", "array", "invoke", "concatenate"]
 _DTYPE_ALIAS = {None: jnp.float32}
 
 
+def _materialize(data, dtype=None):
+    """asarray that yields a *concrete* jax.Array for concrete input even
+    when called inside an ambient trace (jit / eval_shape); tracers pass
+    through as ordinary traced asarray.
+
+    Deferred parameter init can fire while a Gluon forward is being traced
+    for shape inference; without this escape the freshly created constant
+    would be a tracer of that trace, leak into ``Parameter._data``, and blow
+    up at the next real use (UnexpectedTracerError — the round-2 bench
+    failure). ``ensure_compile_time_eval`` runs the creation outside the
+    trace, so parameters/gradients are always real device arrays."""
+    if isinstance(data, jax.core.Tracer):
+        return jnp.asarray(data, dtype=dtype)
+    with jax.ensure_compile_time_eval():
+        return jnp.asarray(data, dtype=dtype)
+
+
 def _canon_attr(v):
     """Normalize attr values: lists -> tuples (hashable for jit static args),
     numpy scalars -> python scalars, MXNet string tuples '(1, 1)' -> tuples."""
@@ -53,7 +70,7 @@ class NDArray:
         if isinstance(data, NDArray):
             data = data._data
         if not isinstance(data, jax.Array):
-            data = jnp.asarray(data)
+            data = _materialize(data)
         if ctx is not None:
             data = jax.device_put(data, Context(ctx).jax_device)
         self._data = data
@@ -150,7 +167,10 @@ class NDArray:
     # -- autograd ----------------------------------------------------------
     def attach_grad(self, grad_req="write", stype=None):
         from .. import autograd
-        self.grad = NDArray(jnp.zeros_like(self._data))
+        self.grad = NDArray(_materialize(
+            np.zeros(self._data.shape, self._data.dtype)
+            if not isinstance(self._data, jax.core.Tracer)
+            else jnp.zeros_like(self._data)))
         self._grad_req = grad_req
         autograd._mark_variable(self)
 
@@ -428,7 +448,7 @@ def array(source_array, ctx=None, dtype=None):
     else:
         # python lists default to float32, like the reference
         data = np.asarray(source_array, dtype=dtype or np.float32)
-    out = NDArray(jnp.asarray(data, dtype=dtype and np.dtype(dtype)))
+    out = NDArray(_materialize(data, dtype=dtype and np.dtype(dtype)))
     if ctx is not None:
         out = out.as_in_context(ctx)
     return out
