@@ -16,6 +16,7 @@ from ..context import Context, current_context
 from .ndarray import NDArray, array, concatenate, invoke
 from .register import populate
 from . import random  # noqa: F401
+from . import contrib  # noqa: F401
 from .utils import save, load
 
 populate(globals())

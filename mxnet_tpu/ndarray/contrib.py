@@ -1,0 +1,301 @@
+"""ndarray.contrib — control flow over NDArrays.
+
+Mirrors python/mxnet/ndarray/contrib.py (foreach :135, while_loop :231,
+cond :399). The loop bodies run on jax tracers inside XLA structured
+control flow (lax.scan — see ops/control_flow.py), so a Gluon
+HybridBlock using these compiles into one fused program; the whole loop
+is recorded on the autograd tape as a single differentiable closure.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import autograd
+from ..base import MXNetError
+from ..ops import registry as _reg
+from .ndarray import NDArray
+
+__all__ = ["foreach", "while_loop", "cond", "isfinite", "isnan", "isinf"]
+
+
+def _flatten_nd(args):
+    """Flatten nested lists of NDArrays -> (flat list, spec)."""
+    if isinstance(args, NDArray):
+        return [args], None
+    if not isinstance(args, (list, tuple)):
+        raise MXNetError(f"expected NDArray or nested list, got {type(args)}")
+    flat, spec = [], []
+    for a in args:
+        f, s = _flatten_nd(a)
+        flat.extend(f)
+        spec.append((len(f), s))
+    return flat, spec
+
+
+def _take(flat, spec):
+    n, s = spec
+    if s is None:
+        return flat[0], flat[1:]
+    out = []
+    for sub in s:
+        item, flat = _take(flat, sub)
+        out.append(item)
+    return out, flat
+
+
+def _unflatten(flat, spec):
+    """Inverse of _flatten_nd given the same spec."""
+    if spec is None:
+        return flat[0], flat[1:]
+    out = []
+    for sub in spec:
+        item, flat = _take(flat, sub)
+        out.append(item)
+    return out, flat
+
+
+def _captured_nd(*fns):
+    """NDArrays captured in the closures of the loop-body callables that
+    participate in autograd (grad-attached leaves or tape outputs).
+
+    The whole loop is recorded as ONE tape closure; anything the body
+    closes over must become an explicit input of that closure or the
+    backward pass cannot reach it (e.g. a weight used inside a foreach
+    body — the reference's imperative loop records each op so captures
+    are implicit; here the scan is opaque to the tape)."""
+    seen, out, out_ids = set(), [], set()
+
+    def visit(v, depth):
+        if isinstance(v, NDArray):
+            if id(v) not in out_ids and (
+                    v.grad is not None or v._entry is not None):
+                out_ids.add(id(v))
+                out.append(v)
+        elif isinstance(v, (list, tuple)):
+            for x in v[:64]:
+                visit(x, depth)
+        elif isinstance(v, dict):
+            for x in list(v.values())[:64]:
+                visit(x, depth)
+        elif callable(v) and depth < 4:
+            walk(v, depth + 1)
+
+    def walk(f, depth=0):
+        if id(f) in seen:
+            return
+        seen.add(id(f))
+        for cell in getattr(f, "__closure__", None) or ():
+            try:
+                v = cell.cell_contents
+            except ValueError:
+                continue
+            visit(v, depth)
+        # module-level arrays referenced by name (no closure cell)
+        code = getattr(f, "__code__", None)
+        if code is not None:
+            g = getattr(f, "__globals__", {})
+            for name in code.co_names:
+                if name in g:
+                    visit(g[name], depth)
+        d = getattr(f, "__self__", None)
+        if d is not None:
+            visit(getattr(d, "__dict__", {}), depth)
+
+    for f in fns:
+        walk(f)
+    return out
+
+
+def _run_with_captured(op_call, n_explicit, captured):
+    """Wrap an op call so replay-substituted values for captured arrays
+    are installed into the live NDArray objects for the duration of the
+    call (the body reads ``obj._data`` at trace time)."""
+
+    def run(*datas):
+        saved = [(a, a._data) for a in captured]
+        try:
+            for a, d in zip(captured, datas[n_explicit:]):
+                a._data = d
+            return op_call(*datas[:n_explicit])
+        finally:
+            for a, d_old in saved:
+                a._data = d_old
+
+    return run
+
+
+def foreach(body, data, init_states):
+    """Scan ``body`` over dim 0 of ``data``
+    (ref: ndarray/contrib.py:135).
+
+    body(data_slice, states) -> (outputs, new_states). Returns
+    (outputs stacked over steps, final states).
+    """
+    flat_data, data_spec = _flatten_nd(data)
+    flat_states, state_spec = _flatten_nd(
+        init_states if isinstance(init_states, (list, tuple))
+        else [init_states])
+    out_spec_box = [None]
+
+    def raw_body(xs, carry):
+        xs_nd = [NDArray(x) for x in xs]
+        st_nd = [NDArray(c) for c in carry]
+        d, _ = _unflatten(xs_nd, data_spec)
+        s, _ = _unflatten(st_nd, state_spec)
+        prev = autograd.set_recording(False)
+        try:
+            outs, new_states = body(d, s)
+        finally:
+            autograd.set_recording(prev)
+        flat_out, ospec = _flatten_nd(
+            outs if isinstance(outs, (list, tuple)) else [outs])
+        out_spec_box[0] = (ospec,
+                          isinstance(outs, (list, tuple)))
+        flat_new, _ = _flatten_nd(
+            new_states if isinstance(new_states, (list, tuple))
+            else [new_states])
+        return [o._data for o in flat_out], [n._data for n in flat_new]
+
+    op = _reg.get("_foreach")
+    captured = _captured_nd(body)
+    explicit = flat_data + flat_states
+    inputs = explicit + captured
+    run = _run_with_captured(
+        lambda *d: op.fn(*d, body=raw_body, num_data=len(flat_data)),
+        len(explicit), captured)
+
+    raws = run(*[a._data for a in inputs])
+    outs = [NDArray(r) for r in raws]
+    if autograd.is_recording():
+        autograd._record_closure("_foreach", run, inputs, outs)
+
+    ospec, was_list = out_spec_box[0]
+    n_out = len(raws) - len(flat_states)
+    stacked, _ = _unflatten(outs[:n_out], ospec)
+    states, _ = _unflatten(outs[n_out:], state_spec)
+    if not was_list:
+        stacked = stacked[0]
+    if not isinstance(init_states, (list, tuple)):
+        states = states[0]
+    return stacked, states
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Bounded while loop (ref: ndarray/contrib.py:231).
+
+    Returns (stacked step outputs padded to ``max_iterations`` with
+    zeros, final loop_vars). Static trip count keeps shapes static for
+    XLA; steps after the predicate fails are masked no-ops.
+    """
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations")
+    single = isinstance(loop_vars, NDArray)
+    flat_vars, var_spec = _flatten_nd(
+        [loop_vars] if single else loop_vars)
+    out_spec_box = [None]
+
+    def raw_cond(sts):
+        st_nd = [NDArray(s) for s in sts]
+        vs, _ = _unflatten(st_nd, var_spec)
+        prev = autograd.set_recording(False)
+        try:
+            r = cond(*vs)
+        finally:
+            autograd.set_recording(prev)
+        return r._data if isinstance(r, NDArray) else r
+
+    def raw_func(sts):
+        st_nd = [NDArray(s) for s in sts]
+        vs, _ = _unflatten(st_nd, var_spec)
+        prev = autograd.set_recording(False)
+        try:
+            outs, new_vars = func(*vs)
+        finally:
+            autograd.set_recording(prev)
+        flat_out, ospec = _flatten_nd(
+            outs if isinstance(outs, (list, tuple)) else [outs])
+        out_spec_box[0] = (ospec, isinstance(outs, (list, tuple)))
+        flat_new, _ = _flatten_nd(
+            new_vars if isinstance(new_vars, (list, tuple))
+            else [new_vars])
+        return ([o._data for o in flat_out],
+                [n._data for n in flat_new])
+
+    op = _reg.get("_while_loop")
+    captured = _captured_nd(cond, func)
+    inputs = flat_vars + captured
+    run = _run_with_captured(
+        lambda *d: op.fn(*d, cond=raw_cond, func=raw_func,
+                         max_iterations=max_iterations),
+        len(flat_vars), captured)
+
+    raws = run(*[a._data for a in inputs])
+    outs = [NDArray(r) for r in raws[:-1]]  # last is the step counter
+    if autograd.is_recording():
+        autograd._record_closure("_while_loop",
+                                 lambda *d: run(*d)[:-1], inputs, outs)
+
+    ospec, was_list = out_spec_box[0]
+    n_out = len(outs) - len(flat_vars)
+    stacked, _ = _unflatten(outs[:n_out], ospec)
+    states, _ = _unflatten(outs[n_out:], var_spec)
+    if not was_list:
+        stacked = stacked[0]
+    if single:
+        states = states[0]
+    return stacked, states
+
+
+def cond(pred, then_func, else_func):
+    """If-then-else (ref: ndarray/contrib.py:399).
+
+    ``pred`` is a scalar NDArray; ``then_func()``/``else_func()`` take no
+    arguments and must produce outputs of matching shape/dtype. On
+    concrete values one branch runs eagerly (the reference's imperative
+    behaviour); on tracers (inside hybridize/jit) it lowers to lax.cond.
+    """
+    import jax
+    from jax import lax
+
+    p = pred._data if isinstance(pred, NDArray) else jnp.asarray(pred)
+    if not isinstance(p, jax.core.Tracer):
+        return then_func() if bool(p) else else_func()
+
+    def _branch(f):
+        def wrapped(_):
+            out = f()
+            flat, spec = _flatten_nd(
+                out if isinstance(out, (list, tuple)) else [out])
+            return [o._data for o in flat], spec, \
+                isinstance(out, (list, tuple))
+        return wrapped
+
+    spec_box = [None]
+
+    def then_branch(_):
+        datas, spec, was_list = _branch(then_func)(None)
+        spec_box[0] = (spec, was_list)
+        return tuple(datas)
+
+    def else_branch(_):
+        datas, _s, _w = _branch(else_func)(None)
+        return tuple(datas)
+
+    raws = lax.cond(p.astype(bool).reshape(()), then_branch, else_branch,
+                    None)
+    outs = [NDArray(r) for r in raws]
+    spec, was_list = spec_box[0]
+    grouped, _ = _unflatten(outs, spec)
+    return grouped if was_list else grouped[0]
+
+
+def isfinite(data):
+    return NDArray(jnp.isfinite(data._data).astype(jnp.float32))
+
+
+def isnan(data):
+    return NDArray(jnp.isnan(data._data).astype(jnp.float32))
+
+
+def isinf(data):
+    return NDArray(jnp.isinf(data._data).astype(jnp.float32))

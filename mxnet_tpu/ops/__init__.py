@@ -14,3 +14,4 @@ from . import linalg  # noqa: F401
 from . import rnn  # noqa: F401
 from . import contrib  # noqa: F401
 from . import quantized  # noqa: F401
+from . import control_flow  # noqa: F401

@@ -1,0 +1,93 @@
+"""Control flow operators — _foreach / _while_loop / _cond.
+
+Mirrors src/operator/control_flow.cc (:63 _foreach, :526 _while_loop,
+:899 _cond), where loop bodies are sub-symbols run via LoopState/CachedOp.
+Here the body is a Python callable over arrays and the op lowers directly
+onto XLA's structured control flow: ``lax.scan`` (foreach),
+``lax.scan`` with an active-mask (while_loop — static trip count
+``max_iterations`` keeps shapes static for the MXU; this is the standard
+XLA formulation of a bounded while), and ``lax.cond``.
+
+All three are differentiable through jax's autodiff of the structured
+primitives; the nd-layer wrappers in ``ndarray.contrib`` record them on
+the autograd tape as single closures.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+@register("_foreach", wrap_jit=False)
+def _foreach(*arrays, body=None, num_data=1, num_outputs=1):
+    """Scan ``body`` over the leading axis of the data arrays.
+
+    arrays = data_0..data_{num_data-1}, state_0..state_{S-1};
+    body(xs: tuple, states: list) -> (outputs: list, new_states: list).
+    Returns stacked per-step outputs followed by final states.
+    """
+    data = arrays[:num_data]
+    states = list(arrays[num_data:])
+
+    def step(carry, xs):
+        outs, new_states = body(xs, list(carry))
+        return tuple(new_states), tuple(outs)
+
+    final_states, stacked = lax.scan(step, tuple(states), tuple(data))
+    return tuple(stacked) + tuple(final_states)
+
+
+@register("_while_loop", wrap_jit=False)
+def _while_loop(*arrays, cond=None, func=None, max_iterations=None,
+                num_outputs=1):
+    """Bounded while: run up to ``max_iterations`` steps of ``func`` while
+    ``cond(*loop_vars)`` holds.
+
+    cond(states) -> scalar bool; func(states) -> (outputs, new_states).
+    Per-step outputs are stacked into [max_iterations, ...] arrays; steps
+    after the predicate fails keep the padding (zeros), matching the
+    reference's fixed-extent symbolic while (control_flow.cc:526 — the
+    graph executor also allocates max_iterations extents). Also returns
+    the final states and the number of executed steps.
+    """
+    if max_iterations is None:
+        raise ValueError("_while_loop requires max_iterations (static "
+                         "shapes on TPU)")
+    states = tuple(arrays)
+
+    def step(carry, _):
+        st, active, n = carry
+        outs, new_st = func(list(st))
+        ok = jnp.logical_and(
+            active, jnp.asarray(cond(list(st))).astype(bool).reshape(()))
+        merged = tuple(jnp.where(ok, n_, s_) for n_, s_ in zip(new_st, st))
+        outs = tuple(jnp.where(ok, o, jnp.zeros_like(o)) for o in outs)
+        return (merged, ok, n + ok.astype(jnp.int32)), outs
+
+    (final_states, _active, n_steps), stacked = lax.scan(
+        step, (states, jnp.asarray(True), jnp.asarray(0, jnp.int32)),
+        None, length=int(max_iterations))
+    return tuple(stacked) + tuple(final_states) + (n_steps,)
+
+
+@register("_cond", wrap_jit=False)
+def _cond(*arrays, pred=None, then_func=None, else_func=None, num_outputs=1):
+    """lax.cond over the branch callables; both branches must produce
+    outputs of identical shape/dtype (XLA requirement — the reference
+    checks the same, control_flow.cc:899)."""
+    inputs = tuple(arrays)
+    p = jnp.asarray(pred(list(inputs))).astype(bool) if callable(pred) \
+        else jnp.asarray(arrays[0]).astype(bool).reshape(())
+
+    def then_branch(ins):
+        out = then_func(list(ins))
+        return tuple(out) if isinstance(out, (list, tuple)) else (out,)
+
+    def else_branch(ins):
+        out = else_func(list(ins))
+        return tuple(out) if isinstance(out, (list, tuple)) else (out,)
+
+    outs = lax.cond(p, then_branch, else_branch, inputs)
+    return outs if len(outs) > 1 else outs[0]

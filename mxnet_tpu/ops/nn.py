@@ -410,6 +410,63 @@ def upsampling(*args, scale=1, sample_type="nearest", num_args=1,
     raise MXNetError(f"sample_type {sample_type!r} unsupported")
 
 
+@register("CTCLoss", aliases=("ctc_loss", "_contrib_CTCLoss"))
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             use_data_lengths=False, use_label_lengths=False,
+             blank_label="first"):
+    """Connectionist Temporal Classification loss
+    (ref: src/operator/nn/ctc_loss.cc:51 — warp-ctc semantics).
+
+    data: (seq_len, batch, alphabet) pre-softmax activations.
+    label: (batch, max_label_len) int indices. When blank_label is
+    "first" blank is id 0, labels use 1..alphabet-1 and padding is 0;
+    when "last" blank is alphabet-1 and padding is -1.
+
+    Lowering: optax.ctc_loss (the same log-semiring scan the reference's
+    warp-ctc computes), with MXNet's length flags mapped onto optax's
+    padding masks; differentiable through jax autodiff.
+    """
+    import optax
+
+    # dispatch quirk: optional array inputs bind positionally in
+    # signature order, so a call providing only label_lengths arrives
+    # in the data_lengths slot — rebind using the use_* flags
+    if (use_label_lengths and label_lengths is None
+            and data_lengths is not None and not use_data_lengths):
+        label_lengths, data_lengths = data_lengths, None
+
+    T, B, A = data.shape
+    L = label.shape[1]
+    logits = jnp.swapaxes(data.astype(jnp.float32), 0, 1)  # (B, T, A)
+    lab = label.astype(jnp.int32)
+    blank = 0 if blank_label == "first" else A - 1
+    pad_mask = (lab == 0) if blank_label == "first" else (lab < 0)
+
+    if use_data_lengths and data_lengths is not None:
+        steps = jnp.arange(T)[None, :]
+        logit_pad = (steps >= data_lengths.astype(jnp.int32)
+                     .reshape(B)[:, None]).astype(jnp.float32)
+    else:
+        logit_pad = jnp.zeros((B, T), jnp.float32)
+
+    if L == 0:
+        # empty label set: P = all-blank path over the unpadded frames
+        lp = jax.nn.log_softmax(logits, axis=-1)[:, :, blank]
+        return -jnp.sum(lp * (1.0 - logit_pad), axis=1)
+
+    if use_label_lengths and label_lengths is not None:
+        steps = jnp.arange(L)[None, :]
+        label_pad = (steps >= label_lengths.astype(jnp.int32)
+                     .reshape(B)[:, None]).astype(jnp.float32)
+    else:
+        label_pad = pad_mask.astype(jnp.float32)
+    # padded entries must hold a valid non-negative index; they are
+    # masked by label_pad, the value itself is irrelevant
+    lab = jnp.where(label_pad > 0, 0, lab)
+    return optax.ctc_loss(logits, logit_pad, lab, label_pad,
+                          blank_id=blank)
+
+
 @register("_contrib_BilinearResize2D")
 def bilinear_resize_2d(data, height=1, width=1, scale_height=None,
                        scale_width=None, mode="size"):

@@ -100,8 +100,64 @@ def random_gnb(key, mu=1.0, alpha=1.0, shape=(), dtype="float32"):
     return jax.random.poisson(kp, lam, tuple(shape)).astype(_dt(dtype))
 
 
+# *_like samplers: shape/dtype follow the input array
+# (ref: src/operator/random/sample_op.cc *_like registrations)
+
+@register("_random_uniform_like", aliases=("random_uniform_like",),
+          needs_rng=True)
+def random_uniform_like(key, data, low=0.0, high=1.0):
+    return jax.random.uniform(key, data.shape, data.dtype, low, high)
+
+
+@register("_random_normal_like", aliases=("random_normal_like",),
+          needs_rng=True)
+def random_normal_like(key, data, loc=0.0, scale=1.0):
+    return loc + scale * jax.random.normal(key, data.shape, data.dtype)
+
+
+@register("_random_gamma_like", aliases=("random_gamma_like",),
+          needs_rng=True)
+def random_gamma_like(key, data, alpha=1.0, beta=1.0):
+    return jax.random.gamma(key, alpha, data.shape, data.dtype) * beta
+
+
+@register("_random_exponential_like", aliases=("random_exponential_like",),
+          needs_rng=True)
+def random_exponential_like(key, data, lam=1.0):
+    return jax.random.exponential(key, data.shape, data.dtype) / lam
+
+
+@register("_random_poisson_like", aliases=("random_poisson_like",),
+          needs_rng=True)
+def random_poisson_like(key, data, lam=1.0):
+    return jax.random.poisson(key, lam, data.shape).astype(data.dtype)
+
+
+@register("_random_negative_binomial_like",
+          aliases=("random_negative_binomial_like",), needs_rng=True)
+def random_negative_binomial_like(key, data, k=1, p=0.5):
+    kg, kp = jax.random.split(key)
+    lam = jax.random.gamma(kg, k, data.shape) * ((1 - p) / p)
+    return jax.random.poisson(kp, lam, data.shape).astype(data.dtype)
+
+
+@register("_random_generalized_negative_binomial_like",
+          aliases=("random_generalized_negative_binomial_like",),
+          needs_rng=True)
+def random_gnb_like(key, data, mu=1.0, alpha=1.0):
+    kg, kp = jax.random.split(key)
+    if alpha == 0:
+        return jax.random.poisson(kp, mu, data.shape).astype(data.dtype)
+    lam = jax.random.gamma(kg, 1.0 / alpha, data.shape) * (alpha * mu)
+    return jax.random.poisson(kp, lam, data.shape).astype(data.dtype)
+
+
 # sample_* ops: per-element distribution parameters given as input arrays
 # (ref: src/operator/random/multisample_op.cc)
+
+
+def _bcast(param, extra_ndim):
+    return param.reshape(tuple(param.shape) + (1,) * extra_ndim)
 
 @register("_sample_uniform", aliases=("sample_uniform",), needs_rng=True)
 def sample_uniform(key, low, high, shape=(), dtype="float32"):
@@ -141,6 +197,82 @@ def sample_multinomial(key, data, shape=(), get_prob=False, dtype="int32"):
         ).reshape(out_shape)
         return idx, picked
     return idx
+
+
+@register("_sample_gamma", aliases=("sample_gamma",), needs_rng=True)
+def sample_gamma(key, alpha, beta, shape=(), dtype="float32"):
+    s = tuple(alpha.shape) + tuple(shape)
+    ext = len(s) - alpha.ndim
+    g = jax.random.gamma(key, _bcast(alpha, ext), s, _dt(dtype))
+    return g * _bcast(beta, ext)
+
+
+@register("_sample_exponential", aliases=("sample_exponential",),
+          needs_rng=True)
+def sample_exponential(key, lam, shape=(), dtype="float32"):
+    s = tuple(lam.shape) + tuple(shape)
+    e = jax.random.exponential(key, s, _dt(dtype))
+    return e / _bcast(lam, len(s) - lam.ndim)
+
+
+@register("_sample_poisson", aliases=("sample_poisson",), needs_rng=True)
+def sample_poisson(key, lam, shape=(), dtype="float32"):
+    s = tuple(lam.shape) + tuple(shape)
+    return jax.random.poisson(
+        key, _bcast(lam, len(s) - lam.ndim), s).astype(_dt(dtype))
+
+
+@register("_sample_negative_binomial", aliases=("sample_negative_binomial",),
+          needs_rng=True)
+def sample_negative_binomial(key, k, p, shape=(), dtype="float32"):
+    s = tuple(k.shape) + tuple(shape)
+    ext = len(s) - k.ndim
+    kg, kp = jax.random.split(key)
+    kb, pb = _bcast(k, ext), _bcast(p, ext)
+    lam = jax.random.gamma(kg, kb, s) * ((1 - pb) / pb)
+    return jax.random.poisson(kp, lam, s).astype(_dt(dtype))
+
+
+@register("_sample_generalized_negative_binomial",
+          aliases=("sample_generalized_negative_binomial",), needs_rng=True)
+def sample_gnb(key, mu, alpha, shape=(), dtype="float32"):
+    s = tuple(mu.shape) + tuple(shape)
+    ext = len(s) - mu.ndim
+    kg, kp = jax.random.split(key)
+    mub, ab = _bcast(mu, ext), _bcast(alpha, ext)
+    safe_a = jnp.maximum(ab, 1e-12)
+    lam = jax.random.gamma(kg, 1.0 / safe_a, s) * (safe_a * mub)
+    lam = jnp.where(ab == 0, jnp.broadcast_to(mub, s), lam)
+    return jax.random.poisson(kp, lam, s).astype(_dt(dtype))
+
+
+@register("_histogram", aliases=("histogram",), num_outputs=2)
+def _histogram(data, bins=None, bin_cnt=None, range=None):
+    """Histogram counts (ref: src/operator/tensor/histogram.cc).
+
+    Either ``bins`` is an array of monotonic bin edges, or ``bin_cnt`` +
+    ``range=(lo, hi)`` define uniform bins. Returns (counts, edges)."""
+    flat = data.reshape(-1)
+    if bins is not None:
+        edges = bins
+        # searchsorted: index of the bin each value falls in
+        idx = jnp.searchsorted(edges, flat, side="right") - 1
+        nbins = edges.shape[0] - 1
+        # right edge of the last bin is inclusive (numpy semantics)
+        idx = jnp.where(flat == edges[-1], nbins - 1, idx)
+        valid = (idx >= 0) & (idx < nbins)
+    else:
+        lo, hi = float(range[0]), float(range[1])
+        nbins = int(bin_cnt)
+        width = (hi - lo) / nbins
+        idx = jnp.floor((flat - lo) / width).astype(jnp.int32)
+        idx = jnp.where(flat == hi, nbins - 1, idx)
+        valid = (flat >= lo) & (flat <= hi)
+        edges = lo + width * jnp.arange(nbins + 1, dtype=jnp.float32)
+    counts = jnp.zeros((nbins,), jnp.int32)
+    counts = counts.at[jnp.where(valid, idx, 0)].add(
+        valid.astype(jnp.int32))
+    return counts, edges
 
 
 @register("_shuffle", aliases=("shuffle",), needs_rng=True)

@@ -55,7 +55,8 @@ class OpDef:
         sig = inspect.signature(fn)
         params = [p for p in sig.parameters.values() if p.name != "key"]
         # optional *array* params (default None) vs attrs with None defaults
-        _arrayish = {"bias", "gamma", "state_cell", "sequence_length", "weight"}
+        _arrayish = {"bias", "gamma", "state_cell", "sequence_length",
+                     "weight", "data_lengths", "label_lengths", "bins"}
         self.arg_names = tuple(
             p.name for p in params
             if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
@@ -72,6 +73,7 @@ class OpDef:
             and not (p.default is None and p.name in _arrayish)
         )
         self._jitted = None
+        self._warned_unjitted = False
 
     def __repr__(self):
         return f"<Op {self.name}>"
@@ -90,9 +92,20 @@ class OpDef:
         if self.wrap_jit:
             try:
                 return self.jitted(*arrays, **attrs)
-            except TypeError:
-                # unhashable attr (e.g. list) — run un-jitted; jnp internals
-                # still hit the C++ fast path.
+            except (TypeError, ValueError) as e:
+                if "hash" not in str(e):
+                    raise  # a genuine op error, not a static-attr problem
+                # unhashable attr (e.g. a list or an array passed for a
+                # static param) — run un-jitted; jnp internals still hit
+                # the C++ fast path. Logged once per op so a hot path
+                # silently bypassing the XLA executable cache is visible.
+                if not self._warned_unjitted:
+                    self._warned_unjitted = True
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "op %s called with unhashable attrs %s; running "
+                        "un-jitted (warned once)", self.name,
+                        sorted(attrs))
                 return self.fn(*arrays, **attrs)
         return self.fn(*arrays, **attrs)
 

@@ -1,0 +1,148 @@
+"""Control flow op tests
+(ref: tests/python/unittest/test_contrib_control_flow.py — foreach /
+while_loop / cond vs Python-loop references, plus gradients).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_foreach_cumsum():
+    data = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    init = nd.zeros((3,))
+
+    def body(x, states):
+        s = states[0] + x
+        return s, [s]
+
+    outs, final = nd.contrib.foreach(body, data, [init])
+    ref = np.cumsum(np.arange(12).reshape(4, 3), axis=0)
+    np.testing.assert_allclose(outs.asnumpy(), ref)
+    np.testing.assert_allclose(final[0].asnumpy(), ref[-1])
+
+
+def test_foreach_multiple_outputs_states():
+    data = nd.array(np.random.default_rng(0).normal(size=(5, 2))
+                    .astype(np.float32))
+    s0 = nd.ones((2,))
+
+    def body(x, states):
+        s = states[0] * 0.5 + x
+        return [s, s * 2], [s]
+
+    (o1, o2), final = nd.contrib.foreach(body, data, [s0])
+    s = np.ones(2, np.float32)
+    r1 = []
+    for x in data.asnumpy():
+        s = s * 0.5 + x
+        r1.append(s)
+    np.testing.assert_allclose(o1.asnumpy(), np.stack(r1), rtol=1e-6)
+    np.testing.assert_allclose(o2.asnumpy(), np.stack(r1) * 2, rtol=1e-6)
+    np.testing.assert_allclose(final[0].asnumpy(), r1[-1], rtol=1e-6)
+
+
+def test_foreach_gradient():
+    data = nd.array(np.ones((3, 2), np.float32))
+    data.attach_grad()
+    init = nd.array(np.array([1.0, 2.0], np.float32))
+    init.attach_grad()
+
+    def body(x, states):
+        s = states[0] * x
+        return s, [s]
+
+    with autograd.record():
+        outs, final = nd.contrib.foreach(body, data, [init])
+        loss = final[0].sum()
+    loss.backward()
+    # all data entries are 1 -> final = init, dL/dinit = 1
+    np.testing.assert_allclose(init.grad.asnumpy(), np.ones(2), rtol=1e-6)
+    assert data.grad.shape == (3, 2)
+
+
+def test_while_loop_sum_to_limit():
+    # sum i from 1 while total < 10, max 20 iterations
+    def cond_fn(i, total):
+        return total < 10
+
+    def func(i, total):
+        return i, (i + 1, total + i)
+
+    outs, (i_fin, total_fin) = nd.contrib.while_loop(
+        cond_fn, func, (nd.array([1.0]), nd.array([0.0])),
+        max_iterations=20)
+    # steps: i=1,2,3,4 (total 0,1,3,6 <10), stop when total=10
+    assert float(total_fin.asnumpy()) == 10.0
+    assert float(i_fin.asnumpy()) == 5.0
+    got = outs.asnumpy().ravel()
+    np.testing.assert_allclose(got[:4], [1, 2, 3, 4])
+    np.testing.assert_allclose(got[4:], 0)  # padded
+
+
+def test_while_loop_gradient():
+    x = nd.array([2.0])
+    x.attach_grad()
+
+    def cond_fn(v, n):
+        return n < 3
+
+    def func(v, n):
+        return v, (v * v, n + 1)
+
+    with autograd.record():
+        _, (v_fin, _n) = nd.contrib.while_loop(
+            cond_fn, func, (x, nd.array([0.0])), max_iterations=5)
+        loss = v_fin.sum()
+    loss.backward()
+    # v -> v^8 after 3 squarings; d/dx x^8 = 8 x^7 = 1024
+    np.testing.assert_allclose(float(loss.asnumpy()), 2.0 ** 8)
+    np.testing.assert_allclose(x.grad.asnumpy(), [8 * 2.0 ** 7], rtol=1e-5)
+
+
+def test_cond_eager_branches():
+    a, b = nd.array([1.0, 2.0]), nd.array([3.0, 4.0])
+    out = nd.contrib.cond(nd.array([1.0]), lambda: a + b, lambda: a - b)
+    np.testing.assert_allclose(out.asnumpy(), [4.0, 6.0])
+    out = nd.contrib.cond(nd.array([0.0]), lambda: a + b, lambda: a - b)
+    np.testing.assert_allclose(out.asnumpy(), [-2.0, -2.0])
+
+
+def test_cond_in_jit_trace():
+    import jax
+
+    a = nd.array([1.0, 2.0])
+
+    def f(pred_data):
+        out = nd.contrib.cond(mx.NDArray(pred_data),
+                              lambda: a * 2, lambda: a * 3)
+        return out._data
+
+    jf = jax.jit(f)
+    np.testing.assert_allclose(np.asarray(jf(np.float32(1.0))), [2.0, 4.0])
+    np.testing.assert_allclose(np.asarray(jf(np.float32(0.0))), [3.0, 6.0])
+
+
+def test_foreach_in_hybrid_block():
+    """Control flow inside a hybridized Gluon block compiles into one
+    XLA program (lax.scan in the traced path)."""
+    from mxnet_tpu.gluon import nn
+
+    class ScanNet(nn.HybridBlock):
+        def hybrid_forward(self, F, x):
+            def body(t, states):
+                return t, [states[0] + t]
+
+            outs, final = nd.contrib.foreach(
+                body, x, [x[0] * 0])
+            return final[0]
+
+    net = ScanNet()
+    net.initialize()
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(3, 2))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(eager, x.asnumpy().sum(0))
+    np.testing.assert_allclose(hybrid, eager)

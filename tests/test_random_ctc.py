@@ -1,0 +1,175 @@
+"""Tests for CTCLoss, histogram, and the per-element / _like samplers
+(ref: tests/python/unittest/test_operator.py test_ctc_loss, test_histogram;
+test_random.py sample distribution checks).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def _ctc_ref(logits, labels):
+    """Brute-force CTC: sum over all alignments (tiny T only)."""
+    T, A = logits.shape
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+
+    def collapse(path):
+        out = []
+        prev = -1
+        for p in path:
+            if p != prev and p != 0:
+                out.append(p)
+            prev = p
+        return tuple(out)
+
+    import itertools
+    total = 0.0
+    for path in itertools.product(range(A), repeat=T):
+        if collapse(path) == tuple(labels):
+            pr = 1.0
+            for t, p in enumerate(path):
+                pr *= probs[t, p]
+            total += pr
+    return -np.log(total)
+
+
+def test_ctc_loss_vs_bruteforce():
+    rng = np.random.default_rng(0)
+    T, B, A = 4, 2, 3
+    data = rng.normal(size=(T, B, A)).astype(np.float32)
+    # blank_label='first': blank=0, labels 1..A-1, padding 0
+    label = np.array([[1, 2], [2, 0]], np.float32)
+    loss = nd.invoke("CTCLoss", [nd.array(data), nd.array(label)], {})
+    ref0 = _ctc_ref(data[:, 0], [1, 2])
+    ref1 = _ctc_ref(data[:, 1], [2])
+    np.testing.assert_allclose(loss.asnumpy(), [ref0, ref1], rtol=1e-4)
+
+
+def test_ctc_loss_lengths_and_grad():
+    rng = np.random.default_rng(1)
+    T, B, A = 6, 2, 4
+    data = nd.array(rng.normal(size=(T, B, A)).astype(np.float32))
+    label = nd.array(np.array([[1, 2, 3], [3, 1, 0]], np.float32))
+    dlen = nd.array(np.array([4, 6], np.float32))
+    llen = nd.array(np.array([2, 2], np.float32))
+    data.attach_grad()
+    with autograd.record():
+        loss = nd.invoke("CTCLoss", [data, label, dlen, llen],
+                         {"use_data_lengths": True,
+                          "use_label_lengths": True})
+        total = loss.sum()
+    total.backward()
+    # sample 0 only uses the first 4 frames -> zero grad on frames 4,5
+    g = data.grad.asnumpy()
+    assert np.abs(g[4:, 0]).max() == 0
+    assert np.abs(g[:4, 0]).max() > 0
+    # compare with brute force on truncated/labeled sequences
+    ref0 = _ctc_ref(data.asnumpy()[:4, 0], [1, 2])
+    np.testing.assert_allclose(loss.asnumpy()[0], ref0, rtol=1e-4)
+
+
+def test_ctc_loss_blank_last():
+    rng = np.random.default_rng(2)
+    T, B, A = 4, 1, 3
+    data = rng.normal(size=(T, B, A)).astype(np.float32)
+    # blank_label='last': blank=A-1, padding -1
+    label = np.array([[0, 1]], np.float32)
+    loss = nd.invoke("CTCLoss", [nd.array(data), nd.array(label)],
+                     {"blank_label": "last"})
+    # brute force with blank moved to index 0: remap channels
+    remap = data[:, 0][:, [2, 0, 1]]
+    ref = _ctc_ref(remap, [1, 2])
+    np.testing.assert_allclose(loss.asnumpy(), [ref], rtol=1e-4)
+
+
+def test_ctc_loss_label_lengths_only():
+    """label_lengths without data_lengths — the common variable-label
+    pattern; regression for the positional-binding bug where the
+    label_lengths array landed in the data_lengths slot."""
+    rng = np.random.default_rng(3)
+    T, B, A = 5, 2, 4
+    data = rng.normal(size=(T, B, A)).astype(np.float32)
+    # labels longer than their declared lengths: extra entries ignored
+    label = np.array([[1, 3, 2], [2, 1, 3]], np.float32)
+    llen = np.array([1, 2], np.float32)
+    loss = nd.invoke("CTCLoss",
+                     [nd.array(data), nd.array(label), nd.array(llen)],
+                     {"use_label_lengths": True})
+    ref0 = _ctc_ref(data[:, 0], [1])
+    ref1 = _ctc_ref(data[:, 1], [2, 1])
+    np.testing.assert_allclose(loss.asnumpy(), [ref0, ref1], rtol=1e-4)
+
+
+def test_ctc_loss_empty_labels():
+    rng = np.random.default_rng(4)
+    T, B, A = 3, 2, 3
+    data = rng.normal(size=(T, B, A)).astype(np.float32)
+    label = np.zeros((B, 0), np.float32)
+    loss = nd.invoke("CTCLoss", [nd.array(data), nd.array(label)], {})
+    # only the all-blank path remains
+    p = np.exp(data - data.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = -np.log(p[:, :, 0]).sum(0)
+    np.testing.assert_allclose(loss.asnumpy(), ref, rtol=1e-4)
+
+
+def test_histogram_uniform_bins():
+    data = nd.array(np.array([0.1, 0.5, 2.5, 9.9, 10.0, -1.0], np.float32))
+    cnt, edges = nd.invoke("_histogram", [data],
+                           {"bin_cnt": 10, "range": (0.0, 10.0)})
+    ref, ref_edges = np.histogram(data.asnumpy(), bins=10, range=(0, 10))
+    np.testing.assert_array_equal(cnt.asnumpy(), ref)
+    np.testing.assert_allclose(edges.asnumpy(), ref_edges)
+
+
+def test_histogram_explicit_edges():
+    data = nd.array(np.array([1.0, 2.0, 3.0, 4.0, 5.0], np.float32))
+    bins = nd.array(np.array([0.0, 2.0, 4.0, 6.0], np.float32))
+    cnt, edges = nd.invoke("_histogram", [data, bins], {})
+    ref, _ = np.histogram(data.asnumpy(), bins=bins.asnumpy())
+    np.testing.assert_array_equal(cnt.asnumpy(), ref)
+
+
+def test_sample_gamma_moments():
+    mx.random.seed(0)
+    alpha = nd.array(np.array([2.0, 9.0], np.float32))
+    beta = nd.array(np.array([0.5, 2.0], np.float32))
+    s = nd.invoke("_sample_gamma", [alpha, beta], {"shape": (4000,)})
+    assert s.shape == (2, 4000)
+    m = s.asnumpy().mean(axis=1)
+    np.testing.assert_allclose(m, [2.0 * 0.5, 9.0 * 2.0], rtol=0.1)
+
+
+def test_sample_poisson_moments():
+    mx.random.seed(0)
+    lam = nd.array(np.array([1.0, 8.0], np.float32))
+    s = nd.invoke("_sample_poisson", [lam], {"shape": (4000,)})
+    m = s.asnumpy().mean(axis=1)
+    v = s.asnumpy().var(axis=1)
+    np.testing.assert_allclose(m, [1.0, 8.0], rtol=0.1)
+    np.testing.assert_allclose(v, [1.0, 8.0], rtol=0.15)
+
+
+def test_sample_negative_binomial_moments():
+    mx.random.seed(0)
+    k = nd.array(np.array([3.0], np.float32))
+    p = nd.array(np.array([0.4], np.float32))
+    s = nd.invoke("_sample_negative_binomial", [k, p], {"shape": (6000,)})
+    # mean = k(1-p)/p
+    np.testing.assert_allclose(s.asnumpy().mean(), 3 * 0.6 / 0.4, rtol=0.1)
+
+
+def test_random_like_ops():
+    mx.random.seed(0)
+    x = nd.zeros((50, 40))
+    for opname in ("_random_uniform_like", "_random_normal_like",
+                   "_random_gamma_like", "_random_exponential_like",
+                   "_random_poisson_like"):
+        out = nd.invoke(opname, [x], {})
+        assert out.shape == x.shape, opname
+    u = nd.invoke("_random_uniform_like", [x],
+                  {"low": 2.0, "high": 3.0}).asnumpy()
+    assert u.min() >= 2.0 and u.max() <= 3.0
+    np.testing.assert_allclose(u.mean(), 2.5, rtol=0.05)
