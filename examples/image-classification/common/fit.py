@@ -1,0 +1,78 @@
+"""Shared training harness for the image-classification examples
+(ref: example/image-classification/common/fit.py — argparse contract,
+kvstore creation, lr schedule, checkpointing, Speedometer).
+"""
+from __future__ import annotations
+
+import logging
+
+import mxnet_tpu as mx
+
+
+def add_fit_args(parser):
+    parser.add_argument("--network", type=str, default="mlp")
+    parser.add_argument("--num-epochs", type=int, default=10)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--lr-factor", type=float, default=0.1)
+    parser.add_argument("--lr-step-epochs", type=str, default="")
+    parser.add_argument("--optimizer", type=str, default="sgd")
+    parser.add_argument("--mom", type=float, default=0.9)
+    parser.add_argument("--wd", type=float, default=1e-4)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--disp-batches", type=int, default=50)
+    parser.add_argument("--model-prefix", type=str, default=None)
+    parser.add_argument("--load-epoch", type=int, default=None)
+    parser.add_argument("--kv-store", type=str, default="local")
+    parser.add_argument("--gpus", type=str, default=None,
+                        help="ignored: this framework targets TPU; kept "
+                             "so reference command lines run unmodified")
+    parser.add_argument("--monitor", type=int, default=0)
+    return parser
+
+
+def fit(args, network, data_loader, **kwargs):
+    """Mirror of common/fit.py:148 fit(): kvstore, resume, optimizer,
+    checkpoints, speedometer, then Module.fit."""
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)-15s %(message)s")
+    kv = mx.kv.create(args.kv_store)
+    train, val = data_loader(args, kv)
+
+    arg_params = aux_params = None
+    begin_epoch = 0
+    if args.model_prefix and args.load_epoch is not None:
+        network, arg_params, aux_params = mx.model.load_checkpoint(
+            args.model_prefix, args.load_epoch)
+        begin_epoch = args.load_epoch
+
+    steps = [int(s) for s in args.lr_step_epochs.split(",") if s]
+    lr_sched = None
+    if steps:
+        epoch_size = max(train.num_data // args.batch_size, 1) \
+            if hasattr(train, "num_data") else 100
+        lr_sched = mx.lr_scheduler.MultiFactorScheduler(
+            step=[epoch_size * s for s in steps], factor=args.lr_factor)
+
+    optimizer_params = {"learning_rate": args.lr, "wd": args.wd}
+    if args.optimizer in ("sgd", "nag"):
+        optimizer_params["momentum"] = args.mom
+    if lr_sched is not None:
+        optimizer_params["lr_scheduler"] = lr_sched
+
+    checkpoint = (mx.callback.do_checkpoint(args.model_prefix)
+                  if args.model_prefix else None)
+    monitor = (mx.monitor.Monitor(args.monitor, pattern=".*")
+               if args.monitor > 0 else None)
+
+    mod = mx.mod.Module(network, context=mx.tpu()
+                        if mx.num_tpus() else mx.cpu())
+    mod.fit(train, eval_data=val, eval_metric="acc",
+            kvstore=kv, optimizer=args.optimizer,
+            optimizer_params=optimizer_params,
+            arg_params=arg_params, aux_params=aux_params,
+            begin_epoch=begin_epoch, num_epoch=args.num_epochs,
+            initializer=mx.init.Xavier(magnitude=2.0),
+            batch_end_callback=mx.callback.Speedometer(
+                args.batch_size, args.disp_batches),
+            epoch_end_callback=checkpoint, monitor=monitor, **kwargs)
+    return mod

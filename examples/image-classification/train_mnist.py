@@ -1,0 +1,71 @@
+"""Train MLP/LeNet on MNIST with the Module API
+(ref: example/image-classification/train_mnist.py — same argparse
+surface and network definitions; the data comes from
+test_utils.get_mnist_ubyte, a deterministic offline stand-in since this
+environment has no download egress).
+
+    python train_mnist.py --network lenet --num-epochs 3
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import mxnet_tpu as mx
+from common import fit as common_fit
+
+
+def mlp():
+    data = mx.sym.var("data")
+    data = mx.sym.Flatten(data)
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=128)
+    act1 = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act1, name="fc2", num_hidden=64)
+    act2 = mx.sym.Activation(fc2, act_type="relu")
+    fc3 = mx.sym.FullyConnected(act2, name="fc3", num_hidden=10)
+    return mx.sym.SoftmaxOutput(fc3, name="softmax")
+
+
+def lenet():
+    data = mx.sym.var("data")
+    c1 = mx.sym.Convolution(data, name="conv1", kernel=(5, 5),
+                            num_filter=20)
+    a1 = mx.sym.Activation(c1, act_type="tanh")
+    p1 = mx.sym.Pooling(a1, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    c2 = mx.sym.Convolution(p1, name="conv2", kernel=(5, 5),
+                            num_filter=50)
+    a2 = mx.sym.Activation(c2, act_type="tanh")
+    p2 = mx.sym.Pooling(a2, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    fl = mx.sym.Flatten(p2)
+    f1 = mx.sym.FullyConnected(fl, name="fc1", num_hidden=500)
+    a3 = mx.sym.Activation(f1, act_type="tanh")
+    f2 = mx.sym.FullyConnected(a3, name="fc2", num_hidden=10)
+    return mx.sym.SoftmaxOutput(f2, name="softmax")
+
+
+def get_mnist_iter(args, kv):
+    shape = (784,) if args.network == "mlp" else (1, 28, 28)
+    train, val = mx.test_utils.get_mnist_iterator(
+        batch_size=args.batch_size, input_shape=shape,
+        data_dir=args.data_dir)
+    return train, val
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="train mnist")
+    parser.add_argument("--data-dir", type=str, default="data")
+    common_fit.add_fit_args(parser)
+    parser.set_defaults(network="mlp", num_epochs=3, batch_size=64,
+                        lr=0.1)
+    args = parser.parse_args()
+
+    net = mlp() if args.network == "mlp" else lenet()
+    mod = common_fit.fit(args, net, get_mnist_iter)
+
+    # final accuracy gate, mirroring the reference's train/ test asserts
+    _, val = get_mnist_iter(args, None)
+    score = mod.score(val, "acc")
+    print("final validation accuracy: %.4f" % score[0][1])

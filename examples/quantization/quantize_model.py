@@ -1,0 +1,83 @@
+"""INT8 quantization example
+(ref: example/quantization/imagenet_gen_qsym_mkldnn.py — same flow:
+fp32 model -> calibrate -> QuantizeGraph pass -> int8 inference, then
+compare fp32 vs int8 outputs and throughput).
+
+    python quantize_model.py --model resnet18_v1 --calib-mode naive
+"""
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib.quantization import quantize_net
+from mxnet_tpu.gluon.block import _flatten, infer_shapes
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+def build_net(model):
+    net = getattr(vision, model)()
+    net.initialize()
+    return net
+
+
+def build_fp32(net, batch):
+    infer_shapes(net, (batch, 3, 224, 224))
+    net.hybridize()
+    plist = sorted(net.collect_params().items())
+    pvals = jax.device_put(tuple(p.data()._data for _, p in plist))
+    x = mx.nd.zeros((batch, 3, 224, 224))
+    _, in_spec = _flatten([x])
+    jfn, _o, _a = net._build_cached(plist, in_spec, training=False)
+    key = jax.random.PRNGKey(0)
+    return jax.jit(lambda pv, d: jfn(pv, key, d)[0][0]), pvals
+
+
+def timed(fwd, params, data, iters=10):
+    reduce_fn = jax.jit(lambda t: jnp.sum(t.astype(jnp.float32)))
+    float(reduce_fn(fwd(params, data)))  # compile + warm
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = fwd(params, data)
+    float(reduce_fn(out))
+    return data.shape[0] * iters / (time.perf_counter() - t0)
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", type=str, default="resnet18_v1")
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--calib-mode", type=str, default="naive",
+                   choices=["naive", "entropy", "none"])
+    p.add_argument("--num-calib-batches", type=int, default=1)
+    p.add_argument("--iters", type=int, default=10)
+    args = p.parse_args()
+
+    rng = np.random.default_rng(0)
+    calib = rng.standard_normal(
+        (8 * args.num_calib_batches, 3, 224, 224), dtype=np.float32)
+    data = jnp.asarray(rng.standard_normal(
+        (args.batch_size, 3, 224, 224), dtype=np.float32))
+
+    print("building fp32 %s..." % args.model)
+    net = build_net(args.model)  # ONE net: fp32 and int8 share weights
+    fwd32, p32 = build_fp32(net, args.batch_size)
+    print("quantizing (calib_mode=%s)..." % args.calib_mode)
+    qfwd, qp = quantize_net(net, batch=args.batch_size,
+                            calib_data=calib, mode=args.calib_mode)
+
+    o32 = np.asarray(fwd32(p32, data))
+    o8 = np.asarray(qfwd(qp, data))
+    agree = float((o32.argmax(1) == o8.argmax(1)).mean())
+    print("top-1 agreement fp32 vs int8: %.3f" % agree)
+
+    ips32 = timed(fwd32, p32, data, args.iters)
+    ips8 = timed(qfwd, qp, data, args.iters)
+    print("fp32: %.1f img/s   int8: %.1f img/s   speedup: %.2fx"
+          % (ips32, ips8, ips8 / ips32))

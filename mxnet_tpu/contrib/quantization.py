@@ -369,15 +369,17 @@ def quantize_model(sym, arg_params, aux_params, ctx=None,
     return qsym, qarg_params, dict(aux_params)
 
 
-def quantize_net(model_name, batch, calib_data, mode="naive",
+def quantize_net(net, batch, calib_data, mode="naive",
                  excluded_sym_names=None):
-    """Quantize a Gluon model-zoo network end-to-end into a jitted int8
-    forward function (the example/quantization flow as one call:
+    """Quantize a Gluon network end-to-end into a jitted int8 forward
+    function (the example/quantization flow as one call:
     ref example/quantization/imagenet_gen_qsym_mkldnn.py).
 
-    Traces the net to a Symbol, calibrates on ``calib_data`` (numpy
-    NCHW), runs the QuantizeGraph pass with offline weight quantization,
-    and compiles the quantized graph into one XLA program.
+    ``net`` is a HybridBlock instance or a model-zoo name (a fresh,
+    randomly initialized instance is built for a name). Traces the net
+    to a Symbol, calibrates on ``calib_data`` (numpy NCHW), runs the
+    QuantizeGraph pass with offline weight quantization, and compiles
+    the quantized graph into one XLA program.
 
     Returns ``(fwd, params)`` where ``fwd(params, data)`` is jitted and
     ``params`` is a device-resident tuple.
@@ -390,8 +392,9 @@ def quantize_net(model_name, batch, calib_data, mode="naive",
     from ..ndarray.ndarray import NDArray
     from ..symbol.trace import trace_block
 
-    net = getattr(vision, model_name)()
-    net.initialize()
+    if isinstance(net, str):
+        net = getattr(vision, net)()
+        net.initialize()
     infer_shapes(net, (batch,) + tuple(calib_data.shape[1:]))
 
     sym_out, params = trace_block(net)

@@ -399,10 +399,14 @@ class MNISTIter(_WrapIter):
     """MNIST idx-format iterator (ref: src/io/iter_mnist.cc:260)."""
 
     def __init__(self, image, label, batch_size=128, shuffle=True,
-                 flat=False, seed=0, silent=True, input_shape=None):
+                 flat=False, seed=0, silent=True, input_shape=None,
+                 num_parts=1, part_index=0):
         super().__init__(batch_size)
         imgs = self._read_idx(image)
         lbls = self._read_idx(label)
+        if num_parts > 1:  # distributed shard (ref: iter_mnist.cc kv split)
+            imgs = imgs[part_index::num_parts]
+            lbls = lbls[part_index::num_parts]
         imgs = imgs.astype(np.float32) / 255.0
         if flat:
             imgs = imgs.reshape(imgs.shape[0], -1)

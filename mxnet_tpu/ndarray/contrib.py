@@ -47,11 +47,7 @@ def _unflatten(flat, spec):
     """Inverse of _flatten_nd given the same spec."""
     if spec is None:
         return flat[0], flat[1:]
-    out = []
-    for sub in spec:
-        item, flat = _take(flat, sub)
-        out.append(item)
-    return out, flat
+    return _take(flat, (len(spec), spec))
 
 
 def _captured_nd(*fns):
@@ -64,21 +60,32 @@ def _captured_nd(*fns):
     body — the reference's imperative loop records each op so captures
     are implicit; here the scan is opaque to the tape)."""
     seen, out, out_ids = set(), [], set()
+    budget = [20000]  # hard cap on visited objects, not a silent slice
 
     def visit(v, depth):
+        if budget[0] <= 0:
+            return
+        budget[0] -= 1
         if isinstance(v, NDArray):
             if id(v) not in out_ids and (
                     v.grad is not None or v._entry is not None):
                 out_ids.add(id(v))
                 out.append(v)
         elif isinstance(v, (list, tuple)):
-            for x in v[:64]:
+            for x in v:
                 visit(x, depth)
         elif isinstance(v, dict):
-            for x in list(v.values())[:64]:
+            for x in v.values():
                 visit(x, depth)
         elif callable(v) and depth < 4:
             walk(v, depth + 1)
+        elif depth < 4 and hasattr(v, "__dict__") \
+                and not isinstance(v, type) \
+                and id(v) not in seen:
+            # closed-over objects (Parameter, Block, ...) — one hop
+            # through their attributes finds held arrays
+            seen.add(id(v))
+            visit(vars(v), depth + 1)
 
     def walk(f, depth=0):
         if id(f) in seen:

@@ -15,3 +15,4 @@ from . import rnn  # noqa: F401
 from . import contrib  # noqa: F401
 from . import quantized  # noqa: F401
 from . import control_flow  # noqa: F401
+from . import detection  # noqa: F401

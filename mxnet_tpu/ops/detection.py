@@ -1,0 +1,542 @@
+"""Detection + spatial sampling operators.
+
+Mirrors src/operator/contrib/{multibox_prior,multibox_target,
+multibox_detection,proposal,multi_proposal,psroi_pooling,
+deformable_convolution}.cc and src/operator/{spatial_transformer,
+grid_generator,bilinear_sampler}.cc.
+
+TPU formulation notes: everything is static-shape. Greedy bipartite
+anchor matching runs as a bounded fori_loop of argmax rounds over the
+IoU matrix (identical semantics to the reference's while-loop, bounded
+by the gt count); NMS keeps the candidate set and masks; Proposal
+returns exactly rpn_post_nms_top_n rois per image (short lists pad by
+repeating the best roi, the reference pads likewise).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+# ---------------------------------------------------------------------------
+# shared bilinear sampling (absolute pixel coordinates)
+# ---------------------------------------------------------------------------
+
+def _bilinear_gather(img, ys, xs):
+    """img (C, H, W); ys/xs arbitrary equal shapes of float pixel coords.
+    Out-of-range samples contribute 0 (the reference's border handling
+    for bilinear_sampler/deformable conv)."""
+    C, H, W = img.shape
+    y0f = jnp.floor(ys)
+    x0f = jnp.floor(xs)
+    ly = ys - y0f
+    lx = xs - x0f
+
+    def tap(yi, xi, w):
+        inb = (yi >= 0) & (yi <= H - 1) & (xi >= 0) & (xi <= W - 1)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        v = img[:, yc, xc]
+        return v * (w * inb.astype(img.dtype))
+
+    return (tap(y0f, x0f, (1 - ly) * (1 - lx))
+            + tap(y0f, x0f + 1, (1 - ly) * lx)
+            + tap(y0f + 1, x0f, ly * (1 - lx))
+            + tap(y0f + 1, x0f + 1, ly * lx))
+
+
+# ---------------------------------------------------------------------------
+# MultiBox family (SSD)
+# ---------------------------------------------------------------------------
+
+@register("_contrib_MultiBoxPrior", aliases=("MultiBoxPrior",))
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor boxes per feature-map pixel
+    (ref: contrib/multibox_prior.cc MultiBoxPriorForward)."""
+    H, W = data.shape[2], data.shape[3]
+    sizes = tuple(sizes)
+    ratios = tuple(ratios)
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(W, dtype=jnp.float32) + offsets[1]) * step_x
+    cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), -1)  # (H, W, 2)
+
+    whs = []
+    for s in sizes:  # ratio = 1, all sizes
+        whs.append((s * H / W / 2.0, s / 2.0))
+    for r in ratios[1:]:  # size = sizes[0], remaining ratios
+        sr = float(r) ** 0.5
+        whs.append((sizes[0] * H / W * sr / 2.0, sizes[0] / sr / 2.0))
+    wh = jnp.asarray(whs, jnp.float32)  # (A, 2) half-extents (w, h)
+
+    ctr = cyx[:, :, None, :]  # (H, W, 1, 2) as (y, x)
+    xmin = ctr[..., 1] - wh[None, None, :, 0]
+    ymin = ctr[..., 0] - wh[None, None, :, 1]
+    xmax = ctr[..., 1] + wh[None, None, :, 0]
+    ymax = ctr[..., 0] + wh[None, None, :, 1]
+    out = jnp.stack([xmin, ymin, xmax, ymax], -1).reshape(1, -1, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+def _iou_matrix(anchors, gt):
+    """anchors (N, 4), gt (M, 4) corner boxes -> (N, M) IoU."""
+    tl = jnp.maximum(anchors[:, None, :2], gt[None, :, :2])
+    br = jnp.minimum(anchors[:, None, 2:4], gt[None, :, 2:4])
+    wh = jnp.maximum(br - tl, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    a = ((anchors[:, 2] - anchors[:, 0])
+         * (anchors[:, 3] - anchors[:, 1]))[:, None]
+    b = ((gt[:, 2] - gt[:, 0]) * (gt[:, 3] - gt[:, 1]))[None, :]
+    union = a + b - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register("_contrib_MultiBoxTarget", aliases=("MultiBoxTarget",))
+def multibox_target(anchors, labels, cls_preds, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5,
+                    minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """Assign anchors to ground truths
+    (ref: contrib/multibox_target.cc MultiBoxTargetForward).
+
+    anchors (1, N, 4); labels (B, M, 5) rows [cls, x1, y1, x2, y2] with
+    cls=-1 padding; cls_preds (B, num_classes, N).
+    Returns loc_target (B, N*4), loc_mask (B, N*4), cls_target (B, N).
+    """
+    anc = anchors.reshape(-1, 4)
+    N = anc.shape[0]
+    M = labels.shape[1]
+    vx, vy, vw, vh = variances
+
+    def one(lab, cls_pred):
+        valid_gt = lab[:, 0] >= 0  # (M,)
+        iou = _iou_matrix(anc, lab[:, 1:5])  # (N, M)
+        iou = jnp.where(valid_gt[None, :], iou, -1.0)
+
+        # stage 1: greedy bipartite matching — each round picks the
+        # globally best still-unmatched (anchor, gt) pair
+        def round_fn(_, st):
+            match, amask, gmask = st
+            m = jnp.where(amask[:, None] & gmask[None, :], iou, -1.0)
+            flat = jnp.argmax(m)
+            bi, bk = flat // M, flat % M
+            ok = m[bi, bk] > 1e-6
+            match = jnp.where(ok, match.at[bi].set(bk), match)
+            amask = jnp.where(ok, amask.at[bi].set(False), amask)
+            gmask = jnp.where(ok, gmask.at[bk].set(False), gmask)
+            return match, amask, gmask
+
+        match0 = jnp.full((N,), -1, jnp.int32)
+        match, amask, _ = lax.fori_loop(
+            0, M, round_fn,
+            (match0, jnp.ones((N,), bool), jnp.ones((M,), bool)))
+
+        # stage 2: remaining anchors match their best gt above threshold
+        best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)
+        best_iou = jnp.max(iou, axis=1)
+        thr_ok = amask & (best_iou > overlap_threshold) \
+            & (overlap_threshold > 0)
+        match = jnp.where(thr_ok, best_gt, match)
+
+        pos = match >= 0
+        mg = jnp.clip(match, 0, M - 1)
+        gt = lab[mg]  # (N, 5)
+
+        # location targets in variance-normalized center form
+        aw = anc[:, 2] - anc[:, 0]
+        ah = anc[:, 3] - anc[:, 1]
+        ax = (anc[:, 0] + anc[:, 2]) / 2
+        ay = (anc[:, 1] + anc[:, 3]) / 2
+        gw = jnp.maximum(gt[:, 3] - gt[:, 1], 1e-12)
+        gh = jnp.maximum(gt[:, 4] - gt[:, 2], 1e-12)
+        gx = (gt[:, 1] + gt[:, 3]) / 2
+        gy = (gt[:, 2] + gt[:, 4]) / 2
+        tx = (gx - ax) / jnp.maximum(aw, 1e-12) / vx
+        ty = (gy - ay) / jnp.maximum(ah, 1e-12) / vy
+        tw = jnp.log(gw / jnp.maximum(aw, 1e-12)) / vw
+        th = jnp.log(gh / jnp.maximum(ah, 1e-12)) / vh
+        loc_t = jnp.stack([tx, ty, tw, th], -1) * pos[:, None]
+        loc_m = jnp.tile(pos[:, None], (1, 4)).astype(jnp.float32)
+
+        cls_t = jnp.where(pos, gt[:, 0].astype(jnp.int32) + 1, 0)
+        if negative_mining_ratio > 0:
+            # hard negative mining: keep the highest-scoring negatives
+            # (max non-background prob), rest become ignore_label
+            neg_ok = (~pos) & (best_iou < negative_mining_thresh)
+            max_p = jnp.max(cls_pred[1:], axis=0)  # skip background row
+            order = jnp.argsort(-jnp.where(neg_ok, max_p, -jnp.inf))
+            rank = jnp.zeros((N,), jnp.int32).at[order].set(
+                jnp.arange(N, dtype=jnp.int32))
+            n_pos = jnp.sum(pos.astype(jnp.int32))
+            n_neg = jnp.minimum(
+                jnp.maximum(
+                    (n_pos * negative_mining_ratio).astype(jnp.int32),
+                    int(minimum_negative_samples)),
+                N - n_pos)
+            keep_neg = neg_ok & (rank < n_neg)
+            cls_t = jnp.where(pos, cls_t,
+                              jnp.where(keep_neg, 0,
+                                        jnp.int32(ignore_label)))
+        return loc_t.reshape(-1), loc_m.reshape(-1), \
+            cls_t.astype(jnp.float32)
+
+    loc_t, loc_m, cls_t = jax.vmap(one)(labels, cls_preds)
+    return loc_t, loc_m, cls_t
+
+
+@register("_contrib_MultiBoxDetection", aliases=("MultiBoxDetection",))
+def multibox_detection(cls_prob, loc_pred, anchors, clip=True,
+                       threshold=0.01, background_id=0,
+                       nms_threshold=0.5, force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode predictions into detections + NMS
+    (ref: contrib/multibox_detection.cc). Output (B, N, 6) rows
+    [cls_id, score, x1, y1, x2, y2]; pruned entries have cls_id=-1."""
+    from .contrib import box_nms
+
+    anc = anchors.reshape(-1, 4)
+    N = anc.shape[0]
+    vx, vy, vw, vh = variances
+    aw = anc[:, 2] - anc[:, 0]
+    ah = anc[:, 3] - anc[:, 1]
+    ax = (anc[:, 0] + anc[:, 2]) / 2
+    ay = (anc[:, 1] + anc[:, 3]) / 2
+
+    def one(cp, lp):
+        # cp (num_classes, N), lp (N*4,)
+        p = lp.reshape(N, 4)
+        ox = p[:, 0] * vx * aw + ax
+        oy = p[:, 1] * vy * ah + ay
+        ow = jnp.exp(p[:, 2] * vw) * aw / 2
+        oh = jnp.exp(p[:, 3] * vh) * ah / 2
+        boxes = jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], -1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class per anchor
+        masked = cp.at[background_id].set(-jnp.inf) \
+            if 0 <= background_id < cp.shape[0] else cp
+        cls = jnp.argmax(masked, axis=0)
+        score = jnp.max(masked, axis=0)
+        # class ids shift down past background (reference convention)
+        out_id = jnp.where(cls > background_id, cls - 1, cls) \
+            .astype(jnp.float32)
+        keep = score > threshold
+        out_id = jnp.where(keep, out_id, -1.0)
+        score = jnp.where(keep, score, -1.0)
+        return jnp.concatenate(
+            [out_id[:, None], score[:, None], boxes], -1)
+
+    dets = jax.vmap(one)(cls_prob, loc_pred.reshape(cls_prob.shape[0], -1))
+    out = box_nms(dets, overlap_thresh=nms_threshold, valid_thresh=0.0,
+                  topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+                  background_id=-1, force_suppress=force_suppress)
+    # reference marks suppressed rows by cls_id = -1
+    # (multibox_detection-inl.h NMS loop)
+    return out.at[..., 0].set(
+        jnp.where(out[..., 1] < 0, -1.0, out[..., 0]))
+
+
+# ---------------------------------------------------------------------------
+# Proposal (Faster-RCNN RPN)
+# ---------------------------------------------------------------------------
+
+def _mkanchors(base_size, scales, ratios):
+    """Base anchors centered at (base/2-0.5, ...) like the reference's
+    GenerateAnchors (contrib/proposal-inl.h)."""
+    import numpy as np
+    base = np.array([0, 0, base_size - 1, base_size - 1], np.float32)
+    w = base[2] - base[0] + 1
+    h = base[3] - base[1] + 1
+    cx = base[0] + 0.5 * (w - 1)
+    cy = base[1] + 0.5 * (h - 1)
+    out = []
+    size = w * h
+    for r in ratios:
+        ws = np.round(np.sqrt(size / r))
+        hs = np.round(ws * r)
+        for s in scales:
+            wss, hss = ws * s, hs * s
+            out.append([cx - 0.5 * (wss - 1), cy - 0.5 * (hss - 1),
+                        cx + 0.5 * (wss - 1), cy + 0.5 * (hss - 1)])
+    return np.array(out, np.float32)
+
+
+@register("_contrib_Proposal", aliases=("Proposal", "_contrib_MultiProposal",
+                                        "MultiProposal"))
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+             feature_stride=16, output_score=False, iou_loss=False):
+    """RPN proposal generation (ref: contrib/proposal.cc /
+    multi_proposal.cc — one kernel serves both; this version is batched
+    over images like MultiProposal). Output rois (B*post_nms, 5) rows
+    [batch_idx, x1, y1, x2, y2] (+ scores when output_score)."""
+    B, A2, H, W = cls_prob.shape
+    A = A2 // 2
+    base = jnp.asarray(_mkanchors(feature_stride, list(scales),
+                                  list(ratios)))  # (A, 4)
+    sx = jnp.arange(W, dtype=jnp.float32) * feature_stride
+    sy = jnp.arange(H, dtype=jnp.float32) * feature_stride
+    shift = jnp.stack(
+        [jnp.tile(sx[None, :], (H, 1)), jnp.tile(sy[:, None], (1, W)),
+         jnp.tile(sx[None, :], (H, 1)), jnp.tile(sy[:, None], (1, W))],
+        -1)  # (H, W, 4)
+    anchors = (shift[:, :, None, :] + base[None, None]).reshape(-1, 4)
+    K = anchors.shape[0]  # H*W*A
+
+    def one(cp, bp, info):
+        # scores: foreground half of cls_prob, layout (A..., H, W)
+        score = cp[A:].transpose(1, 2, 0).reshape(-1)  # (H*W*A,)
+        deltas = bp.reshape(A, 4, H, W).transpose(2, 3, 0, 1) \
+            .reshape(-1, 4)
+        ah = anchors[:, 3] - anchors[:, 1] + 1
+        aw = anchors[:, 2] - anchors[:, 0] + 1
+        ax = anchors[:, 0] + 0.5 * (aw - 1)
+        ay = anchors[:, 1] + 0.5 * (ah - 1)
+        cx = deltas[:, 0] * aw + ax
+        cy = deltas[:, 1] * ah + ay
+        pw = jnp.exp(deltas[:, 2]) * aw
+        ph = jnp.exp(deltas[:, 3]) * ah
+        boxes = jnp.stack([cx - 0.5 * (pw - 1), cy - 0.5 * (ph - 1),
+                           cx + 0.5 * (pw - 1), cy + 0.5 * (ph - 1)], -1)
+        boxes = jnp.stack(
+            [jnp.clip(boxes[:, 0], 0, info[1] - 1),
+             jnp.clip(boxes[:, 1], 0, info[0] - 1),
+             jnp.clip(boxes[:, 2], 0, info[1] - 1),
+             jnp.clip(boxes[:, 3], 0, info[0] - 1)], -1)
+        ms = rpn_min_size * info[2]
+        keep = ((boxes[:, 2] - boxes[:, 0] + 1) >= ms) \
+            & ((boxes[:, 3] - boxes[:, 1] + 1) >= ms)
+        score_k = jnp.where(keep, score, -1.0)
+
+        pre = min(int(rpn_pre_nms_top_n), K) if rpn_pre_nms_top_n > 0 else K
+        order = jnp.argsort(-score_k)[:pre]
+        b = boxes[order]
+        s = score_k[order]
+
+        # masked greedy NMS over the pre-nms list
+        tl = jnp.maximum(b[:, None, :2], b[None, :, :2])
+        br = jnp.minimum(b[:, None, 2:4], b[None, :, 2:4])
+        wh = jnp.maximum(br - tl + 1, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        area = (b[:, 2] - b[:, 0] + 1) * (b[:, 3] - b[:, 1] + 1)
+        iou = inter / jnp.maximum(area[:, None] + area[None, :] - inter,
+                                  1e-12)
+
+        def body(i, kp):
+            live = kp[i] & (s[i] > -1)
+            sup = (iou[i] > threshold) & (jnp.arange(pre) > i) & live
+            return jnp.where(sup, False, kp)
+
+        kp = lax.fori_loop(0, pre, body, jnp.ones((pre,), bool))
+        kp &= s > -1
+        # stable-compact the kept rois to the front, pad with roi 0
+        # (the reference pads short lists by repeating proposals)
+        rank = jnp.cumsum(kp.astype(jnp.int32)) - 1
+        post = int(rpn_post_nms_top_n)
+        tgt = jnp.where(kp & (rank < post), rank, post)  # post = dropped
+        out_b = jnp.zeros((post + 1, 4), b.dtype).at[tgt].set(b)[:post]
+        out_s = jnp.zeros((post + 1,), s.dtype).at[tgt].set(s)[:post]
+        n_kept = jnp.minimum(jnp.sum(kp.astype(jnp.int32)), post)
+        idx = jnp.arange(post)
+        fill = jnp.maximum(n_kept, 1)
+        out_b = jnp.where((idx < n_kept)[:, None], out_b,
+                          out_b[idx % fill])
+        out_s = jnp.where(idx < n_kept, out_s, out_s[idx % fill])
+        return out_b, out_s
+
+    ob, os_ = jax.vmap(one)(cls_prob, bbox_pred, im_info)
+    bidx = jnp.repeat(jnp.arange(B, dtype=cls_prob.dtype),
+                      int(rpn_post_nms_top_n))
+    rois = jnp.concatenate([bidx[:, None], ob.reshape(-1, 4)], -1)
+    if output_score:
+        return rois, os_.reshape(-1, 1)
+    return rois
+
+
+# ---------------------------------------------------------------------------
+# PSROIPooling / DeformableConvolution (R-FCN family)
+# ---------------------------------------------------------------------------
+
+@register("_contrib_PSROIPooling", aliases=("PSROIPooling",))
+def psroi_pooling(data, rois, spatial_scale=1.0, output_dim=1,
+                  pooled_size=7, group_size=0):
+    """Position-sensitive ROI pooling
+    (ref: contrib/psroi_pooling.cc): input channel o*ps*ps + py*ps + px
+    feeds output channel o at bin (py, px); average over bin pixels."""
+    ps = int(pooled_size)
+    gs = int(group_size) or ps
+    N, C, H, W = data.shape
+
+    def one(roi):
+        bidx = jnp.clip(roi[0].astype(jnp.int32), 0, N - 1)
+        img = data[bidx]
+        x1 = jnp.round(roi[1]) * spatial_scale
+        y1 = jnp.round(roi[2]) * spatial_scale
+        x2 = jnp.round(roi[3] + 1.0) * spatial_scale
+        y2 = jnp.round(roi[4] + 1.0) * spatial_scale
+        rh = jnp.maximum(y2 - y1, 0.1) / ps
+        rw = jnp.maximum(x2 - x1, 0.1) / ps
+        ys = jnp.arange(H, dtype=jnp.float32)
+        xs = jnp.arange(W, dtype=jnp.float32)
+        out = jnp.zeros((output_dim, ps, ps), data.dtype)
+        for py in range(ps):
+            for px in range(ps):
+                hs = jnp.floor(y1 + py * rh)
+                he = jnp.ceil(y1 + (py + 1) * rh)
+                wss = jnp.floor(x1 + px * rw)
+                we = jnp.ceil(x1 + (px + 1) * rw)
+                m = ((ys >= hs) & (ys < he))[:, None] \
+                    & ((xs >= wss) & (xs < we))[None, :]
+                cnt = jnp.maximum(jnp.sum(m), 1)
+                gy = min(py * gs // ps, gs - 1)
+                gx = min(px * gs // ps, gs - 1)
+                chans = (jnp.arange(output_dim) * gs + gy) * gs + gx
+                v = jnp.sum(jnp.where(m[None], img[chans], 0.0),
+                            axis=(1, 2)) / cnt
+                out = out.at[:, py, px].set(v)
+        return out
+
+    return jax.vmap(one)(rois)
+
+
+@register("_contrib_DeformableConvolution",
+          aliases=("DeformableConvolution",))
+def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                           stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                           num_filter=0, num_group=1,
+                           num_deformable_group=1, no_bias=False):
+    """Deformable conv v1 (ref: contrib/deformable_convolution.cc):
+    each kernel tap samples the input at its position + a learned
+    per-location offset via bilinear interpolation, then an ordinary
+    conv accumulates the sampled values (expressed as an einsum so the
+    MXU still does the contraction)."""
+    kh, kw = kernel
+    sh, sw = stride
+    dh, dw = dilate
+    ph, pw = pad
+    N, C, H, W = data.shape
+    OH = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    OW = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    G = int(num_deformable_group)
+
+    oy = jnp.arange(OH, dtype=jnp.float32) * sh - ph
+    ox = jnp.arange(OW, dtype=jnp.float32) * sw - pw
+    base_y = oy[:, None, None] + jnp.arange(kh, dtype=jnp.float32)[None, :, None] * dh
+    base_x = ox[:, None, None] + jnp.arange(kw, dtype=jnp.float32)[None, None, :] * dw
+    # base_y (OH, kh, 1), base_x (OW, 1, kw)
+
+    def one(img, off):
+        # off (2*G*kh*kw, OH, OW) layout [g, kh, kw, {y,x}] per reference
+        off = off.reshape(G, kh, kw, 2, OH, OW)
+
+        def sample_group(img_g, off_g):
+            # build (OH, OW, kh, kw) sampling grids
+            yy = (base_y[:, None, :, :]  # (OH, 1, kh, 1)
+                  + jnp.zeros((1, OW, 1, kw), jnp.float32))
+            xx = (base_x[None, :, :, :].reshape(1, OW, 1, kw)
+                  + jnp.zeros((OH, 1, kh, 1), jnp.float32))
+            yy = yy + off_g[:, :, 0].transpose(2, 3, 0, 1)
+            xx = xx + off_g[:, :, 1].transpose(2, 3, 0, 1)
+            flat_y = yy.reshape(-1)
+            flat_x = xx.reshape(-1)
+            v = _bilinear_gather(img_g, flat_y, flat_x)
+            return v.reshape(img_g.shape[0], OH, OW, kh, kw)
+
+        cpg = C // G
+        cols = []
+        for g in range(G):
+            # off_g indexed [kh, kw, 2, OH, OW]
+            off_g = off[g]
+            cols.append(sample_group(img[g * cpg:(g + 1) * cpg], off_g))
+        return jnp.concatenate(cols, axis=0)  # (C, OH, OW, kh, kw)
+
+    sampled = jax.vmap(one)(data, offset)  # (N, C, OH, OW, kh, kw)
+    O = weight.shape[0]
+    cg = int(num_group)
+    if cg == 1:
+        out = jnp.einsum("nchwij,ocij->nohw", sampled, weight)
+    else:
+        outs = []
+        opg, cpg = O // cg, C // cg
+        for g in range(cg):
+            outs.append(jnp.einsum(
+                "nchwij,ocij->nohw",
+                sampled[:, g * cpg:(g + 1) * cpg],
+                weight[g * opg:(g + 1) * opg]))
+        out = jnp.concatenate(outs, axis=1)
+    if bias is not None and not no_bias:
+        out = out + bias[None, :, None, None]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SpatialTransformer family
+# ---------------------------------------------------------------------------
+
+@register("GridGenerator")
+def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """Generate sampling grids (ref: src/operator/grid_generator-inl.h).
+
+    affine: data (B, 6) -> grid (B, 2, H, W) of normalized (x, y) in
+    [-1, 1]; warp: data (B, 2, H, W) flow field added to the identity
+    grid and normalized."""
+    if transform_type == "affine":
+        H, W = target_shape
+        B = data.shape[0]
+        # endpoint convention: dst grid = linspace(-1, 1, n)
+        # (ref: spatial_transformer-inl.h:99-101)
+        ys = -1.0 + jnp.arange(H, dtype=jnp.float32) * 2.0 / max(H - 1, 1)
+        xs = -1.0 + jnp.arange(W, dtype=jnp.float32) * 2.0 / max(W - 1, 1)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        src = jnp.stack([gx, gy, ones], 0).reshape(3, -1)  # (3, H*W)
+        theta = data.reshape(B, 2, 3)
+        out = jnp.einsum("bij,jk->bik", theta, src)  # (B, 2, H*W)
+        return out.reshape(B, 2, H, W)
+    # warp: flow field (B, 2, H, W) in pixels
+    B, _, H, W = data.shape
+    xs = jnp.arange(W, dtype=jnp.float32)
+    ys = jnp.arange(H, dtype=jnp.float32)
+    gx = jnp.broadcast_to(xs[None, :], (H, W))
+    gy = jnp.broadcast_to(ys[:, None], (H, W))
+    fx = (data[:, 0] + gx[None])
+    fy = (data[:, 1] + gy[None])
+    nx = fx * 2 / jnp.maximum(W - 1, 1) - 1
+    ny = fy * 2 / jnp.maximum(H - 1, 1) - 1
+    return jnp.stack([nx, ny], 1)
+
+
+@register("BilinearSampler")
+def bilinear_sampler(data, grid, cudnn_off=None):
+    """Sample data at normalized grid locations
+    (ref: src/operator/bilinear_sampler.cc). grid (B, 2, H', W') holds
+    (x, y) in [-1, 1]; out-of-range taps read 0."""
+    B, C, H, W = data.shape
+    xs = (grid[:, 0] + 1) * (W - 1) / 2
+    ys = (grid[:, 1] + 1) * (H - 1) / 2
+
+    def one(img, y, x):
+        return _bilinear_gather(img, y.reshape(-1), x.reshape(-1)) \
+            .reshape(C, *y.shape)
+
+    return jax.vmap(one)(data, ys, xs)
+
+
+@register("SpatialTransformer")
+def spatial_transformer(data, loc, target_shape=(0, 0),
+                        transform_type="affine",
+                        sampler_type="bilinear", cudnn_off=None):
+    """Affine spatial transformer = GridGenerator + BilinearSampler
+    (ref: src/operator/spatial_transformer.cc)."""
+    grid = grid_generator(loc, transform_type="affine",
+                          target_shape=tuple(target_shape))
+    return bilinear_sampler(data, grid)

@@ -410,7 +410,8 @@ def upsampling(*args, scale=1, sample_type="nearest", num_args=1,
     raise MXNetError(f"sample_type {sample_type!r} unsupported")
 
 
-@register("CTCLoss", aliases=("ctc_loss", "_contrib_CTCLoss"))
+@register("CTCLoss", aliases=("ctc_loss", "_contrib_CTCLoss"),
+          optional_arrays=("data_lengths", "label_lengths"))
 def ctc_loss(data, label, data_lengths=None, label_lengths=None,
              use_data_lengths=False, use_label_lengths=False,
              blank_label="first"):
@@ -434,6 +435,13 @@ def ctc_loss(data, label, data_lengths=None, label_lengths=None,
     if (use_label_lengths and label_lengths is None
             and data_lengths is not None and not use_data_lengths):
         label_lengths, data_lengths = data_lengths, None
+    if use_data_lengths and data_lengths is None:
+        raise ValueError("CTCLoss: use_data_lengths=True but no "
+                         "data_lengths array was provided")
+    if use_label_lengths and label_lengths is None:
+        raise ValueError("CTCLoss: use_label_lengths=True but no "
+                         "label_lengths array was provided (when both "
+                         "use_* flags are set, both arrays are required)")
 
     T, B, A = data.shape
     L = label.shape[1]

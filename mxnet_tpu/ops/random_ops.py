@@ -246,7 +246,8 @@ def sample_gnb(key, mu, alpha, shape=(), dtype="float32"):
     return jax.random.poisson(kp, lam, s).astype(_dt(dtype))
 
 
-@register("_histogram", aliases=("histogram",), num_outputs=2)
+@register("_histogram", aliases=("histogram",), num_outputs=2,
+          optional_arrays=("bins",))
 def _histogram(data, bins=None, bin_cnt=None, range=None):
     """Histogram counts (ref: src/operator/tensor/histogram.cc).
 
@@ -263,6 +264,8 @@ def _histogram(data, bins=None, bin_cnt=None, range=None):
         valid = (idx >= 0) & (idx < nbins)
     else:
         lo, hi = float(range[0]), float(range[1])
+        if lo == hi:  # numpy's degenerate-range expansion
+            lo, hi = lo - 0.5, hi + 0.5
         nbins = int(bin_cnt)
         width = (hi - lo) / nbins
         idx = jnp.floor((flat - lo) / width).astype(jnp.int32)

@@ -40,7 +40,7 @@ class OpDef:
     """
 
     def __init__(self, name, fn, aliases=(), num_inputs=None, wrap_jit=True,
-                 num_outputs=1, needs_rng=False):
+                 num_outputs=1, needs_rng=False, optional_arrays=()):
         self.name = name
         self.fn = fn
         self.aliases = tuple(aliases)
@@ -54,9 +54,11 @@ class OpDef:
         self.needs_rng = needs_rng
         sig = inspect.signature(fn)
         params = [p for p in sig.parameters.values() if p.name != "key"]
-        # optional *array* params (default None) vs attrs with None defaults
+        # optional *array* params (default None) vs attrs with None
+        # defaults: per-op via register(optional_arrays=...), plus names
+        # that are always arrays across the op set
         _arrayish = {"bias", "gamma", "state_cell", "sequence_length",
-                     "weight", "data_lengths", "label_lengths", "bins"}
+                     "weight"} | set(optional_arrays)
         self.arg_names = tuple(
             p.name for p in params
             if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
@@ -93,7 +95,12 @@ class OpDef:
             try:
                 return self.jitted(*arrays, **attrs)
             except (TypeError, ValueError) as e:
-                if "hash" not in str(e):
+                try:  # classify by actually hashing the static attrs —
+                    hash(tuple(sorted(attrs.items())))  # not by message
+                    unhashable = False
+                except TypeError:
+                    unhashable = True
+                if not unhashable:
                     raise  # a genuine op error, not a static-attr problem
                 # unhashable attr (e.g. a list or an array passed for a
                 # static param) — run un-jitted; jnp internals still hit
@@ -111,10 +118,11 @@ class OpDef:
 
 
 def register_op(name, fn, aliases=(), num_inputs=None, wrap_jit=True,
-                num_outputs=1, needs_rng=False):
+                num_outputs=1, needs_rng=False, optional_arrays=()):
     """Register a pure JAX function as a framework op (plain-function form)."""
     op = OpDef(name, fn, aliases=aliases, num_inputs=num_inputs,
-               wrap_jit=wrap_jit, num_outputs=num_outputs, needs_rng=needs_rng)
+               wrap_jit=wrap_jit, num_outputs=num_outputs, needs_rng=needs_rng,
+               optional_arrays=optional_arrays)
     for key in (name,) + tuple(aliases):
         if key in _OPS:
             raise MXNetError(f"op {key} registered twice")
@@ -123,13 +131,14 @@ def register_op(name, fn, aliases=(), num_inputs=None, wrap_jit=True,
 
 
 def register(name=None, aliases=(), num_inputs=None, wrap_jit=True,
-             num_outputs=1, needs_rng=False):
+             num_outputs=1, needs_rng=False, optional_arrays=()):
     """Decorator form of :func:`register_op`."""
 
     def deco(fn):
         register_op(name or fn.__name__, fn, aliases=aliases,
                     num_inputs=num_inputs, wrap_jit=wrap_jit,
-                    num_outputs=num_outputs, needs_rng=needs_rng)
+                    num_outputs=num_outputs, needs_rng=needs_rng,
+                    optional_arrays=optional_arrays)
         return fn
 
     return deco

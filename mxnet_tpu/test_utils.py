@@ -91,5 +91,171 @@ def check_consistency(fn, inputs, ctx_list=None, rtol=1e-4, atol=1e-6):
     return outs
 
 
-def simple_forward(sym_or_fn, **inputs):
-    raise NotImplementedError
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    """Bind a symbol with the given input arrays, run one forward, and
+    return the outputs as numpy (single array when there is one output)
+    (ref: test_utils.py simple_forward)."""
+    args = {k: (v if isinstance(v, NDArray) else array(v))
+            for k, v in inputs.items()}
+    ex = sym.bind(ctx, args=args, grad_req="null")
+    outs = ex.forward(is_train=is_train)
+    outs = [o.asnumpy() for o in outs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-4, atol=1e-6,
+                           aux_states=None, ctx=None):
+    """Execute sym and compare outputs against numpy expectations
+    (ref: test_utils.py check_symbolic_forward)."""
+    names = sym.list_arguments()
+    if isinstance(location, dict):
+        args = {k: array(v) for k, v in location.items()}
+    else:
+        args = {n: array(v) for n, v in zip(names, location)}
+    aux = {k: array(v) for k, v in (aux_states or {}).items()}
+    ex = sym.bind(ctx, args=args, aux_states=aux, grad_req="null")
+    outs = ex.forward(is_train=False)
+    for o, e in zip(outs, expected):
+        np.testing.assert_allclose(o.asnumpy(), e, rtol=rtol, atol=atol)
+    return [o.asnumpy() for o in outs]
+
+
+def check_symbolic_backward(sym, location, out_grads, expected,
+                            rtol=1e-4, atol=1e-6, grad_req="write",
+                            ctx=None):
+    """Execute forward+backward and compare input gradients against
+    numpy expectations (ref: test_utils.py check_symbolic_backward)."""
+    names = sym.list_arguments()
+    if isinstance(location, dict):
+        args = {k: array(v) for k, v in location.items()}
+    else:
+        args = {n: array(v) for n, v in zip(names, location)}
+    if isinstance(expected, (list, tuple)):
+        expected = dict(zip(names, expected))
+    reqs = ({n: (grad_req if n in expected else "null") for n in names}
+            if isinstance(grad_req, str) else grad_req)
+    ex = sym.bind(ctx, args=args, grad_req=reqs)
+    ex.forward(is_train=True)
+    ex.backward([array(g) for g in out_grads])
+    got = {}
+    for n in names:
+        if reqs.get(n, "null") != "null" and n in ex.grad_dict \
+                and ex.grad_dict[n] is not None:
+            got[n] = ex.grad_dict[n].asnumpy()
+    for n, e in expected.items():
+        np.testing.assert_allclose(got[n], e, rtol=rtol, atol=atol,
+                                   err_msg=f"gradient mismatch for {n}")
+    return got
+
+
+def rand_sparse_ndarray(shape, stype, density=0.1, dtype="float32"):
+    """Random sparse NDArray plus its dense numpy equivalent
+    (ref: test_utils.py rand_sparse_ndarray)."""
+    from .ndarray import sparse
+
+    dense = np.zeros(shape, dtype=dtype)
+    if stype == "row_sparse":
+        nrows = max(int(shape[0] * density), 1)
+        rows = np.sort(np.random.choice(shape[0], nrows, replace=False))
+        vals = np.random.uniform(-1, 1,
+                                 (nrows,) + tuple(shape[1:])).astype(dtype)
+        dense[rows] = vals
+        return sparse.row_sparse_array((vals, rows), shape=shape), dense
+    if stype == "csr":
+        assert len(shape) == 2
+        mask = np.random.rand(*shape) < density
+        dense = np.where(mask,
+                         np.random.uniform(-1, 1, shape), 0).astype(dtype)
+        return sparse.csr_matrix(dense), dense
+    raise ValueError(f"unknown stype {stype}")
+
+
+# ---------------------------------------------------------------------------
+# dataset helpers — offline synthetic MNIST
+# ---------------------------------------------------------------------------
+
+def _synthetic_mnist(n, seed):
+    """Deterministic MNIST-shaped dataset: each class is a fixed random
+    28x28 prototype plus noise. The reference's get_mnist() downloads
+    the real set (test_utils.py get_mnist); this environment has no
+    egress, so examples/tests train on this learnable stand-in."""
+    rng = np.random.RandomState(42)  # prototypes shared by every split
+    protos = (rng.rand(10, 28, 28) > 0.75).astype(np.float32)
+    rs = np.random.RandomState(seed)
+    labels = rs.randint(0, 10, n).astype(np.float32)
+    imgs = protos[labels.astype(int)]
+    noise = rs.rand(n, 28, 28).astype(np.float32)
+    imgs = np.clip(imgs * 0.8 + noise * 0.2, 0, 1)
+    return imgs.reshape(n, 1, 28, 28), labels
+
+
+def get_mnist(n_train=8000, n_test=2000):
+    """dict with train_data/train_label/test_data/test_label
+    (same keys as the reference's test_utils.get_mnist)."""
+    tr_x, tr_y = _synthetic_mnist(n_train, seed=1)
+    te_x, te_y = _synthetic_mnist(n_test, seed=2)
+    return {"train_data": tr_x, "train_label": tr_y,
+            "test_data": te_x, "test_label": te_y}
+
+
+def get_mnist_ubyte(data_dir="data"):
+    """Write the synthetic MNIST in idx/ubyte format so MNISTIter and
+    the reference's example scripts find the expected files
+    (ref: test_utils.py get_mnist_ubyte)."""
+    import os
+    import struct
+
+    os.makedirs(data_dir, exist_ok=True)
+    paths = {
+        "train-images-idx3-ubyte": None, "train-labels-idx1-ubyte": None,
+        "t10k-images-idx3-ubyte": None, "t10k-labels-idx1-ubyte": None,
+    }
+    if all(os.path.exists(os.path.join(data_dir, p)) for p in paths):
+        return {k: os.path.join(data_dir, k) for k in paths}
+    mnist = get_mnist()
+
+    def write_idx(path, arr, is_img):
+        arr = (arr * 255).astype(np.uint8) if is_img \
+            else arr.astype(np.uint8)
+        with open(path, "wb") as f:
+            if is_img:
+                n = arr.shape[0]
+                f.write(struct.pack(">iiii", 0x00000803, n, 28, 28))
+                f.write(arr.reshape(n, 28, 28).tobytes())
+            else:
+                f.write(struct.pack(">ii", 0x00000801, arr.shape[0]))
+                f.write(arr.tobytes())
+
+    write_idx(os.path.join(data_dir, "train-images-idx3-ubyte"),
+              mnist["train_data"], True)
+    write_idx(os.path.join(data_dir, "train-labels-idx1-ubyte"),
+              mnist["train_label"], False)
+    write_idx(os.path.join(data_dir, "t10k-images-idx3-ubyte"),
+              mnist["test_data"], True)
+    write_idx(os.path.join(data_dir, "t10k-labels-idx1-ubyte"),
+              mnist["test_label"], False)
+    return {k: os.path.join(data_dir, k) for k in paths}
+
+
+def get_mnist_iterator(batch_size, input_shape=(784,), num_parts=1,
+                       part_index=0, data_dir="data"):
+    """(train_iter, val_iter) over the idx files, flat or NCHW depending
+    on input_shape (ref: test_utils.py get_mnist_iterator)."""
+    import os
+
+    from .io import MNISTIter
+
+    get_mnist_ubyte(data_dir)
+    flat = len(input_shape) == 1
+    train = MNISTIter(
+        image=os.path.join(data_dir, "train-images-idx3-ubyte"),
+        label=os.path.join(data_dir, "train-labels-idx1-ubyte"),
+        input_shape=input_shape, batch_size=batch_size,
+        shuffle=True, flat=flat, num_parts=num_parts,
+        part_index=part_index)
+    val = MNISTIter(
+        image=os.path.join(data_dir, "t10k-images-idx3-ubyte"),
+        label=os.path.join(data_dir, "t10k-labels-idx1-ubyte"),
+        input_shape=input_shape, batch_size=batch_size,
+        shuffle=False, flat=flat)
+    return train, val

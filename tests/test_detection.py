@@ -1,0 +1,235 @@
+"""Detection + spatial transformer op tests
+(ref: tests/python/unittest/test_operator.py test_multibox_*,
+test_proposal, test_psroipooling, test_deformable_convolution,
+test_spatial_transformer / test_bilinear_sampler — numpy references).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_multibox_prior_values():
+    H = W = 4
+    data = nd.zeros((1, 3, H, W))
+    sizes, ratios = (0.5, 0.25), (1.0, 2.0)
+    out = nd.invoke("_contrib_MultiBoxPrior", [data],
+                    {"sizes": sizes, "ratios": ratios}).asnumpy()
+    A = len(sizes) + len(ratios) - 1
+    assert out.shape == (1, H * W * A, 4)
+    # first pixel center is ((0+0.5)/W, (0+0.5)/H)
+    cx, cy = 0.5 / W, 0.5 / H
+    # anchor 0: size 0.5, ratio 1 -> half w = 0.5*H/W/2 = 0.25
+    np.testing.assert_allclose(
+        out[0, 0], [cx - 0.25, cy - 0.25, cx + 0.25, cy + 0.25],
+        atol=1e-6)
+    # anchor 2: size 0.5, ratio 2 -> w half = .5*sqrt2/2, h half = .5/sqrt2/2
+    s2 = np.sqrt(2.0)
+    np.testing.assert_allclose(
+        out[0, 2], [cx - 0.25 * s2, cy - 0.25 / s2,
+                    cx + 0.25 * s2, cy + 0.25 / s2], atol=1e-6)
+
+
+def _np_iou(a, b):
+    tl = np.maximum(a[:2], b[:2])
+    br = np.minimum(a[2:4], b[2:4])
+    wh = np.maximum(br - tl, 0)
+    inter = wh[0] * wh[1]
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+def test_multibox_target_assignment():
+    anchors = np.array([[[0.0, 0.0, 0.4, 0.4],
+                         [0.5, 0.5, 1.0, 1.0],
+                         [0.0, 0.6, 0.3, 1.0]]], np.float32)
+    # one gt box overlapping anchor 1, class 2
+    labels = np.array([[[2, 0.55, 0.55, 0.95, 0.95],
+                        [-1, -1, -1, -1, -1]]], np.float32)
+    cls_preds = np.zeros((1, 4, 3), np.float32)
+    loc_t, loc_m, cls_t = nd.invoke(
+        "_contrib_MultiBoxTarget",
+        [nd.array(anchors), nd.array(labels), nd.array(cls_preds)], {})
+    cls_t = cls_t.asnumpy()
+    np.testing.assert_array_equal(cls_t[0], [0, 3, 0])  # class+1 on match
+    m = loc_m.asnumpy().reshape(3, 4)
+    np.testing.assert_array_equal(m[1], 1)
+    np.testing.assert_array_equal(m[0], 0)
+    # check encoded loc target for the matched anchor
+    a = anchors[0, 1]
+    g = labels[0, 0, 1:]
+    aw, ah = a[2] - a[0], a[3] - a[1]
+    ax, ay = (a[0] + a[2]) / 2, (a[1] + a[3]) / 2
+    gw, gh = g[2] - g[0], g[3] - g[1]
+    gx, gy = (g[0] + g[2]) / 2, (g[1] + g[3]) / 2
+    ref = [(gx - ax) / aw / 0.1, (gy - ay) / ah / 0.1,
+           np.log(gw / aw) / 0.2, np.log(gh / ah) / 0.2]
+    np.testing.assert_allclose(loc_t.asnumpy().reshape(3, 4)[1], ref,
+                               rtol=1e-5)
+
+
+def test_multibox_detection_decode():
+    anchors = np.array([[[0.1, 0.1, 0.3, 0.3],
+                         [0.6, 0.6, 0.9, 0.9]]], np.float32)
+    # zero deltas -> boxes == anchors
+    loc_pred = np.zeros((1, 8), np.float32)
+    cls_prob = np.array([[[0.1, 0.8],    # background
+                          [0.8, 0.1],    # class 0
+                          [0.1, 0.1]]], np.float32)  # class 1
+    out = nd.invoke("_contrib_MultiBoxDetection",
+                    [nd.array(cls_prob), nd.array(loc_pred),
+                     nd.array(anchors)], {}).asnumpy()
+    assert out.shape == (1, 2, 6)
+    # anchor 0 -> class 0 @ 0.8 with box == anchor
+    kept = [r for r in out[0] if r[0] >= 0]
+    assert any(np.allclose(r[2:], [0.1, 0.1, 0.3, 0.3], atol=1e-5)
+               for r in kept)
+
+
+def test_multibox_detection_nms_suppresses():
+    anchors = np.array([[[0.1, 0.1, 0.4, 0.4],
+                         [0.12, 0.12, 0.42, 0.42]]], np.float32)
+    loc_pred = np.zeros((1, 8), np.float32)
+    cls_prob = np.array([[[0.1, 0.2],
+                          [0.9, 0.8]]], np.float32)
+    out = nd.invoke("_contrib_MultiBoxDetection",
+                    [nd.array(cls_prob), nd.array(loc_pred),
+                     nd.array(anchors)],
+                    {"nms_threshold": 0.5}).asnumpy()
+    kept = [r for r in out[0] if r[0] >= 0]
+    assert len(kept) == 1 and abs(kept[0][1] - 0.9) < 1e-6
+
+
+def test_proposal_shapes_and_clip():
+    rng = np.random.default_rng(0)
+    B, A, H, W = 2, 3, 4, 4  # ratios (0.5,1,2) x scales (8,) -> A=3
+    cls_prob = nd.array(rng.uniform(0.1, 1, (B, 2 * A, H, W))
+                        .astype(np.float32))
+    bbox_pred = nd.array((rng.normal(size=(B, 4 * A, H, W)) * 0.1)
+                         .astype(np.float32))
+    im_info = nd.array(np.array([[64, 64, 1.0], [64, 64, 1.0]],
+                                np.float32))
+    rois = nd.invoke("_contrib_Proposal",
+                     [cls_prob, bbox_pred, im_info],
+                     {"scales": (8,), "ratios": (0.5, 1.0, 2.0),
+                      "feature_stride": 16, "rpn_pre_nms_top_n": 40,
+                      "rpn_post_nms_top_n": 10, "threshold": 0.7,
+                      "rpn_min_size": 4}).asnumpy()
+    assert rois.shape == (B * 10, 5)
+    np.testing.assert_array_equal(np.unique(rois[:, 0]), [0, 1])
+    assert rois[:, 1].min() >= 0 and rois[:, 3].max() <= 63
+    assert rois[:, 2].min() >= 0 and rois[:, 4].max() <= 63
+    # rois valid: x2>=x1, y2>=y1
+    assert (rois[:, 3] >= rois[:, 1]).all()
+    assert (rois[:, 4] >= rois[:, 2]).all()
+
+
+def test_psroi_pooling_uniform():
+    # constant per-channel input: each output bin = that channel's value
+    ps, od = 2, 2
+    C = od * ps * ps
+    data = np.zeros((1, C, 8, 8), np.float32)
+    for c in range(C):
+        data[0, c] = c
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = nd.invoke("_contrib_PSROIPooling",
+                    [nd.array(data), nd.array(rois)],
+                    {"spatial_scale": 1.0, "output_dim": od,
+                     "pooled_size": ps}).asnumpy()
+    assert out.shape == (1, od, ps, ps)
+    for o in range(od):
+        for py in range(ps):
+            for px in range(ps):
+                expect = (o * ps + py) * ps + px
+                np.testing.assert_allclose(out[0, o, py, px], expect)
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 3, 7, 7)).astype(np.float32)
+    w = rng.normal(size=(4, 3, 3, 3)).astype(np.float32)
+    off = np.zeros((2, 2 * 9, 5, 5), np.float32)
+    out = nd.invoke("_contrib_DeformableConvolution",
+                    [nd.array(x), nd.array(off), nd.array(w)],
+                    {"kernel": (3, 3), "num_filter": 4,
+                     "no_bias": True}).asnumpy()
+    ref = nd.invoke("Convolution",
+                    [nd.array(x), nd.array(w)],
+                    {"kernel": (3, 3), "num_filter": 4,
+                     "no_bias": True}).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_integer_shift():
+    # offset of exactly (0, 1) shifts sampling one pixel right
+    x = np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6)
+    w = np.ones((1, 1, 1, 1), np.float32)
+    off = np.zeros((1, 2, 6, 6), np.float32)
+    off[0, 1] = 1.0  # x-offset
+    out = nd.invoke("_contrib_DeformableConvolution",
+                    [nd.array(x), nd.array(off), nd.array(w)],
+                    {"kernel": (1, 1), "num_filter": 1,
+                     "no_bias": True}).asnumpy()
+    ref = np.pad(x[0, 0][:, 1:], ((0, 0), (0, 1)))[None, None]
+    np.testing.assert_allclose(out, ref)
+
+
+def test_bilinear_sampler_identity():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(1, 2, 5, 5)).astype(np.float32)
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 5), np.linspace(-1, 1, 5),
+                         indexing="ij")
+    grid = np.stack([xs, ys], 0)[None].astype(np.float32)
+    out = nd.invoke("BilinearSampler",
+                    [nd.array(x), nd.array(grid)], {}).asnumpy()
+    np.testing.assert_allclose(out, x, rtol=1e-5, atol=1e-6)
+
+
+def test_spatial_transformer_identity_and_shift():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(1, 1, 6, 6)).astype(np.float32)
+    theta_id = np.array([[1, 0, 0, 0, 1, 0]], np.float32)
+    out = nd.invoke("SpatialTransformer",
+                    [nd.array(x), nd.array(theta_id)],
+                    {"target_shape": (6, 6),
+                     "transform_type": "affine",
+                     "sampler_type": "bilinear"}).asnumpy()
+    np.testing.assert_allclose(out, x, rtol=1e-4, atol=1e-5)
+    # scale by 0.5: output samples the central half (zoom in)
+    theta_zoom = np.array([[0.5, 0, 0, 0, 0.5, 0]], np.float32)
+    out2 = nd.invoke("SpatialTransformer",
+                     [nd.array(x), nd.array(theta_zoom)],
+                     {"target_shape": (6, 6),
+                      "transform_type": "affine",
+                      "sampler_type": "bilinear"}).asnumpy()
+    assert not np.allclose(out2, x)
+    assert np.isfinite(out2).all()
+
+
+def test_grid_generator_warp():
+    flow = np.zeros((1, 2, 4, 4), np.float32)
+    grid = nd.invoke("GridGenerator", [nd.array(flow)],
+                     {"transform_type": "warp"}).asnumpy()
+    # zero flow -> identity grid in [-1, 1]
+    np.testing.assert_allclose(grid[0, 0, 0], np.linspace(-1, 1, 4),
+                               atol=1e-6)
+    np.testing.assert_allclose(grid[0, 1, :, 0], np.linspace(-1, 1, 4),
+                               atol=1e-6)
+
+
+def test_spatial_transformer_gradient():
+    rng = np.random.default_rng(2)
+    x = nd.array(rng.normal(size=(1, 1, 4, 4)).astype(np.float32))
+    theta = nd.array(np.array([[1, 0, 0.1, 0, 1, -0.1]], np.float32))
+    x.attach_grad()
+    theta.attach_grad()
+    with autograd.record():
+        out = nd.invoke("SpatialTransformer", [x, theta],
+                        {"target_shape": (4, 4),
+                         "transform_type": "affine",
+                         "sampler_type": "bilinear"})
+        loss = (out * out).sum()
+    loss.backward()
+    assert np.abs(x.grad.asnumpy()).sum() > 0
+    assert np.abs(theta.grad.asnumpy()).sum() > 0

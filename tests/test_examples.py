@@ -1,0 +1,76 @@
+"""North-star gate: the example scripts and packer tooling actually run
+(BASELINE config 1/3 flows as scripts, not just unit tests;
+ref: example/image-classification/train_mnist.py, tools/im2rec.py).
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(cmd, cwd=None, timeout=420):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    r = subprocess.run(cmd, cwd=cwd or REPO, env=env, timeout=timeout,
+                       stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    out = r.stdout.decode(errors="replace")
+    assert r.returncode == 0, out[-2000:]
+    return out
+
+
+def test_train_mnist_script(tmp_path):
+    out = _run([sys.executable, "train_mnist.py", "--network", "mlp",
+                "--num-epochs", "1", "--batch-size", "128",
+                "--data-dir", str(tmp_path / "data"),
+                "--model-prefix", str(tmp_path / "ck")],
+               cwd=os.path.join(REPO, "examples/image-classification"))
+    assert "final validation accuracy" in out
+    acc = float(out.strip().rsplit(" ", 1)[-1])
+    assert acc > 0.9, out[-500:]
+    assert (tmp_path / "ck-symbol.json").exists()
+    assert (tmp_path / "ck-0001.params").exists()
+
+
+def test_im2rec_and_record_iter(tmp_path):
+    from PIL import Image
+
+    # build a tiny labeled image tree
+    rng = np.random.default_rng(0)
+    for cls in ("cat", "dog"):
+        d = tmp_path / "imgs" / cls
+        d.mkdir(parents=True)
+        for i in range(6):
+            arr = rng.integers(0, 255, (40, 40, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(d / f"{cls}{i}.jpg")
+
+    prefix = str(tmp_path / "train")
+    _run([sys.executable, os.path.join(REPO, "tools/im2rec.py"),
+          prefix, str(tmp_path / "imgs"), "--recursive",
+          "--resize", "32", "--center-crop", "--num-thread", "2"])
+    assert os.path.exists(prefix + ".rec")
+    assert os.path.exists(prefix + ".idx")
+
+    import mxnet_tpu as mx
+    it = mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                               data_shape=(3, 32, 32), batch_size=4)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (4, 3, 32, 32)
+    labels = set()
+    it.reset()
+    for b in it:
+        labels.update(b.label[0].asnumpy().tolist())
+    assert labels == {0.0, 1.0}
+
+
+def test_quantize_model_script():
+    out = _run([sys.executable, "quantize_model.py", "--model",
+                "resnet18_v1", "--batch-size", "4", "--iters", "2"],
+               cwd=os.path.join(REPO, "examples/quantization"))
+    assert "top-1 agreement" in out
+    agree = float(out.split("top-1 agreement fp32 vs int8:")[1]
+                  .split()[0])
+    assert agree >= 0.5, out[-500:]

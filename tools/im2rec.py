@@ -1,0 +1,166 @@
+"""Pack an image directory (or .lst file) into RecordIO
+(ref: tools/im2rec.py — same CLI contract: list generation with
+--list, then packing with optional --resize/--quality; multithreaded
+encode like the C++ tools/im2rec.cc).
+
+    python tools/im2rec.py --list data/train data/images/
+    python tools/im2rec.py data/train data/images/ --resize 256
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import queue
+import random
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from mxnet_tpu.recordio import IRHeader, MXIndexedRecordIO, pack, pack_img
+
+_EXTS = {".jpg", ".jpeg", ".png", ".bmp"}
+
+
+def list_images(root, recursive=False):
+    cat = {}
+    items = []
+    i = 0
+    if recursive:
+        for path, _dirs, files in sorted(os.walk(root)):
+            for f in sorted(files):
+                if os.path.splitext(f)[1].lower() not in _EXTS:
+                    continue
+                label_dir = os.path.relpath(path, root)
+                if label_dir not in cat:
+                    cat[label_dir] = len(cat)
+                items.append((i, os.path.relpath(
+                    os.path.join(path, f), root), cat[label_dir]))
+                i += 1
+    else:
+        for f in sorted(os.listdir(root)):
+            if os.path.splitext(f)[1].lower() in _EXTS:
+                items.append((i, f, 0))
+                i += 1
+    return items
+
+
+def write_list(path_out, items):
+    with open(path_out, "w") as f:
+        for idx, fname, label in items:
+            f.write("%d\t%f\t%s\n" % (idx, float(label), fname))
+
+
+def read_list(path_in):
+    items = []
+    with open(path_in) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            items.append((int(parts[0]), parts[-1],
+                          [float(x) for x in parts[1:-1]]))
+    return items
+
+
+def _load_and_encode(fullpath, resize, quality, center_crop):
+    from PIL import Image
+    import numpy as np
+
+    img = Image.open(fullpath).convert("RGB")
+    if resize > 0:
+        w, h = img.size
+        if w < h:
+            img = img.resize((resize, int(h * resize / w)))
+        else:
+            img = img.resize((int(w * resize / h), resize))
+    if center_crop:
+        w, h = img.size
+        s = min(w, h)
+        img = img.crop(((w - s) // 2, (h - s) // 2,
+                        (w + s) // 2, (h + s) // 2))
+    return np.asarray(img)
+
+
+def make_record(args, path_lst, root):
+    items = read_list(path_lst)
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(items)
+    prefix = os.path.splitext(path_lst)[0]
+    rec = MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+
+    in_q = queue.Queue(1024)
+    out = {}
+    lock = threading.Lock()
+
+    def worker():
+        while True:
+            task = in_q.get()
+            if task is None:
+                return
+            seq, (idx, fname, label) = task
+            try:
+                img = _load_and_encode(os.path.join(root, fname),
+                                       args.resize, args.quality,
+                                       args.center_crop)
+                lab = label[0] if len(label) == 1 else label
+                payload = pack_img(IRHeader(0, lab, idx, 0), img,
+                                   quality=args.quality,
+                                   img_fmt=args.encoding)
+            except Exception as e:  # noqa: BLE001 — skip bad images
+                print("skipping %s: %r" % (fname, e), file=sys.stderr)
+                payload = None
+            with lock:
+                out[seq] = (idx, payload)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(max(args.num_thread, 1))]
+    for t in threads:
+        t.start()
+    for seq, item in enumerate(items):
+        in_q.put((seq, item))
+    for _ in threads:
+        in_q.put(None)
+    for t in threads:
+        t.join()
+
+    count = 0
+    for seq in range(len(items)):
+        idx, payload = out[seq]
+        if payload is None:
+            continue
+        rec.write_idx(idx, payload)
+        count += 1
+    rec.close()
+    print("wrote %d records to %s.rec" % (count, prefix))
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser(
+        description="create an image list or RecordIO file")
+    p.add_argument("prefix", help="prefix of the .lst/.rec files")
+    p.add_argument("root", help="image root directory")
+    p.add_argument("--list", action="store_true",
+                   help="generate the .lst file instead of packing")
+    p.add_argument("--recursive", action="store_true")
+    p.add_argument("--shuffle", type=bool, default=True)
+    p.add_argument("--resize", type=int, default=0)
+    p.add_argument("--center-crop", action="store_true")
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--encoding", type=str, default=".jpg")
+    p.add_argument("--num-thread", type=int, default=4)
+    args = p.parse_args()
+
+    if args.list:
+        items = list_images(args.root, args.recursive)
+        write_list(args.prefix + ".lst", items)
+        print("wrote %d entries to %s.lst" % (len(items), args.prefix))
+    else:
+        lst = args.prefix if args.prefix.endswith(".lst") \
+            else args.prefix + ".lst"
+        if not os.path.exists(lst):
+            items = list_images(args.root, args.recursive)
+            write_list(lst, items)
+        make_record(args, lst, args.root)
