@@ -25,8 +25,9 @@ def _build(src, out, extra_flags=()):
             if (os.path.exists(out)
                     and os.path.getmtime(out) >= os.path.getmtime(src)):
                 return out
+            # -l link flags must follow the source file (link order)
             cmd = ["g++", "-O2", "-shared", "-fPIC", "-pthread",
-                   *extra_flags, src, "-o", out + ".tmp"]
+                   src, "-o", out + ".tmp", *extra_flags]
             subprocess.run(cmd, check=True, capture_output=True, text=True)
             os.replace(out + ".tmp", out)
             return out
@@ -78,6 +79,39 @@ def load_comm():
     lib.mxtpu_client_command.restype = ctypes.c_int
     lib.mxtpu_client_close.argtypes = [ctypes.c_void_p]
     _comm_lib = lib
+    return lib
+
+
+_imgdec_lib = None
+
+
+def load_imgdec():
+    """The threaded JPEG batch decoder (imgdec.cc); None when libjpeg
+    is unavailable on this host (callers fall back to PIL)."""
+    global _imgdec_lib
+    if _imgdec_lib is not None:
+        return _imgdec_lib
+    src = os.path.join(_HERE, "imgdec.cc")
+    out = os.path.join(_HERE, "libmxtpu_imgdec.so")
+    try:
+        _build(src, out, extra_flags=("-ljpeg",))
+        lib = ctypes.CDLL(out)
+    except (subprocess.CalledProcessError, OSError):
+        return None
+    lib.mxtpu_decode_batch.restype = ctypes.c_int
+    lib.mxtpu_decode_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p),            # bufs
+        ctypes.POINTER(ctypes.c_int64),             # lens
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,   # n, th, tw
+        ctypes.POINTER(ctypes.c_float),             # rand_uv
+        ctypes.POINTER(ctypes.c_uint8),             # mirror
+        ctypes.POINTER(ctypes.c_float),             # mean
+        ctypes.POINTER(ctypes.c_float),             # std
+        ctypes.POINTER(ctypes.c_float),             # out
+        ctypes.c_int,                               # nthreads
+        ctypes.c_char_p, ctypes.c_int,              # errbuf
+    ]
+    _imgdec_lib = lib
     return lib
 
 

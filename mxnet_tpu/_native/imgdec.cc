@@ -1,0 +1,145 @@
+// Native JPEG batch decoder — the TPU framework's analogue of the
+// reference's OMP decode pipeline (src/io/iter_image_recordio_2.cc:445
+// TJimdecode / opencv decode inside #pragma omp parallel for).
+//
+// Python threads cannot parallelize PIL (GIL-bound in this image), so
+// ImageRecordIter calls this instead: a std::thread pool decodes a whole
+// batch of JPEG buffers with libjpeg, applies crop/mirror/normalize, and
+// writes float32 CHW directly into the caller's batch buffer.
+//
+// C ABI (ctypes):
+//   mxtpu_decode_batch(bufs, lens, n, th, tw,
+//                      rand_uv,        // n*2 floats in [0,1); <0 = center
+//                      mirror,         // n bytes (0/1)
+//                      mean, std,      // 3 floats each (RGB)
+//                      out,            // n*3*th*tw float32
+//                      nthreads, errbuf, errbuf_len) -> 0 ok / -1 error
+#include <atomic>
+#include <csetjmp>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <jpeglib.h>
+
+namespace {
+
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jb;
+  char msg[JMSG_LENGTH_MAX];
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  JpegErr* err = reinterpret_cast<JpegErr*>(cinfo->err);
+  (*cinfo->err->format_message)(cinfo, err->msg);
+  longjmp(err->jb, 1);
+}
+
+// Decode one JPEG into RGB HWC uint8; returns empty on failure.
+bool decode_rgb(const uint8_t* buf, size_t len, std::vector<uint8_t>* px,
+                int* h, int* w, std::string* err) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jb)) {
+    *err = jerr.msg;
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, buf, len);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  *h = cinfo.output_height;
+  *w = cinfo.output_width;
+  px->resize(size_t(*h) * *w * 3);
+  const size_t stride = size_t(*w) * 3;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = px->data() + size_t(cinfo.output_scanline) * stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+}  // namespace
+
+extern "C" int mxtpu_decode_batch(
+    const uint8_t* const* bufs, const int64_t* lens, int n,
+    int th, int tw, const float* rand_uv, const uint8_t* mirror,
+    const float* mean, const float* stdv, float* out, int nthreads,
+    char* errbuf, int errbuf_len) {
+  std::atomic<int> next(0);
+  std::atomic<bool> failed(false);
+  std::string first_err;
+  std::mutex err_mu;
+
+  auto worker = [&]() {
+    std::vector<uint8_t> px;
+    while (true) {
+      int i = next.fetch_add(1);
+      if (i >= n || failed.load()) return;
+      int ih = 0, iw = 0;
+      std::string err;
+      if (!decode_rgb(bufs[i], size_t(lens[i]), &px, &ih, &iw, &err)) {
+        std::lock_guard<std::mutex> g(err_mu);
+        if (!failed.exchange(true))
+          first_err = "record " + std::to_string(i) + ": " + err;
+        return;
+      }
+      if (ih < th || iw < tw) {
+        std::lock_guard<std::mutex> g(err_mu);
+        if (!failed.exchange(true))
+          first_err = "record " + std::to_string(i) + ": image " +
+                      std::to_string(ih) + "x" + std::to_string(iw) +
+                      " smaller than data_shape " + std::to_string(th) +
+                      "x" + std::to_string(tw);
+        return;
+      }
+      float u = rand_uv[2 * i], v = rand_uv[2 * i + 1];
+      int top = u < 0 ? (ih - th) / 2 : int(u * float(ih - th + 1));
+      int left = v < 0 ? (iw - tw) / 2 : int(v * float(iw - tw + 1));
+      if (top > ih - th) top = ih - th;
+      if (left > iw - tw) left = iw - tw;
+      const bool mir = mirror[i] != 0;
+      float* dst = out + size_t(i) * 3 * th * tw;
+      for (int c = 0; c < 3; ++c) {
+        const float mu = mean[c], sd = stdv[c];
+        float* plane = dst + size_t(c) * th * tw;
+        for (int y = 0; y < th; ++y) {
+          const uint8_t* src =
+              px.data() + (size_t(top + y) * iw + left) * 3 + c;
+          float* row = plane + size_t(y) * tw;
+          if (!mir) {
+            for (int x = 0; x < tw; ++x)
+              row[x] = (float(src[size_t(x) * 3]) - mu) / sd;
+          } else {
+            for (int x = 0; x < tw; ++x)
+              row[tw - 1 - x] = (float(src[size_t(x) * 3]) - mu) / sd;
+          }
+        }
+      }
+    }
+  };
+
+  int nt = nthreads < 1 ? 1 : nthreads;
+  if (nt > n) nt = n;
+  std::vector<std::thread> pool;
+  pool.reserve(nt);
+  for (int t = 0; t < nt; ++t) pool.emplace_back(worker);
+  for (auto& t : pool) t.join();
+
+  if (failed.load()) {
+    snprintf(errbuf, errbuf_len, "%s", first_err.c_str());
+    return -1;
+  }
+  return 0;
+}

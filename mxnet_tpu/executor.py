@@ -78,7 +78,9 @@ class Executor:
                     self.grad_dict[n] = NDArray(jnp.zeros(a.shape,
                                                           a._data.dtype))
         self._monitor = None
+        self._monitor_all = False
         self._fwd_cache = {}
+        self._mon_cache = {}
         self._vjp = None
         self.outputs = []
 
@@ -227,10 +229,55 @@ class Executor:
         for n, v in aux_updates.items():
             self.aux_dict[n]._data = v
         self.outputs = [NDArray(o) for o in outs]
-        if self._monitor is not None:
-            for name, arr in zip(self.output_names, self.outputs):
-                self._monitor(name, arr)
+        if self._monitor is not None and self._monitor_active():
+            # tap every op's outputs, as the reference's
+            # ExecuteMonCallback does (graph_executor.cc:1294) — a
+            # separate jitted pass returns all internal tensors
+            names, vals = self._monitor_internals(bool(is_train))(
+                arg_vals, aux_vals, key)
+            for name, v in zip(names, vals):
+                self._monitor(name, NDArray(v))
+            if self._monitor_all:
+                # monitor_all additionally taps graph inputs
+                # (the reference's input-tensor callbacks)
+                for n, v in arg_vals.items():
+                    self._monitor(n + "_input", NDArray(v))
+                for n, v in aux_vals.items():
+                    self._monitor(n + "_input", NDArray(v))
         return self.outputs
+
+    def _monitor_active(self):
+        """Skip the (whole-graph) internals pass on batches where the
+        monitor is not collecting — Monitor exposes ``activated``;
+        plain callbacks always collect."""
+        owner = getattr(self._monitor, "__self__", None)
+        return owner is None or getattr(owner, "activated", True)
+
+    def _monitor_internals(self, training):
+        entry = self._mon_cache.get(training)
+        if entry is None:
+            internals = self._symbol.get_internals()
+            irun = self._build_for(internals, training)
+            names = []
+            for node, k in internals._outputs:
+                suffix = "_output" if k == 0 else f"_output{k}"
+                names.append(node.name + suffix)
+            jit_run = jax.jit(lambda a, x, kk: irun(a, x, kk)[0])
+
+            def call(a, x, kk):
+                return names, jit_run(a, x, kk)
+
+            entry = call
+            self._mon_cache[training] = entry
+        return entry
+
+    def _build_for(self, sym, training):
+        saved = self._symbol
+        self._symbol = sym
+        try:
+            return self._build(training)
+        finally:
+            self._symbol = saved
 
     def backward(self, out_grads=None):
         """Gradient of the bound graph wrt grad-requesting args
@@ -298,6 +345,7 @@ class Executor:
 
     def set_monitor_callback(self, callback, monitor_all=False):
         self._monitor = callback
+        self._monitor_all = monitor_all
 
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):

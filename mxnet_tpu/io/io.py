@@ -469,19 +469,23 @@ class ImageRecordIter(DataIter):
     """RecordIO-packed image iterator with augmentation
     (ref: src/io/iter_image_recordio_2.cc:50 ImageRecordIOParser2).
 
-    Decodes record payloads (raw chw float or encoded images when PIL
-    is available), applies resize/crop/mirror augmentation, assembles
-    NCHW batches on a prefetch thread.
+    TPU-native pipeline with the reference's shape: the .rec file is
+    indexed once (offsets only — records stream from disk, the file is
+    never loaded into memory), a producer thread reads raw records and
+    decodes them on a ``preprocess_threads``-wide thread pool (PIL JPEG
+    decode releases the GIL), and assembled NCHW batches are
+    double-buffered in a bounded queue of ``prefetch_buffer`` batches.
     """
+
+    _SENTINEL = object()
 
     def __init__(self, path_imgrec, data_shape, batch_size=1,
                  label_width=1, shuffle=False, rand_crop=False,
                  rand_mirror=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
                  std_r=1.0, std_g=1.0, std_b=1.0, resize=-1,
                  round_batch=True, preprocess_threads=4, prefetch_buffer=2,
-                 **kwargs):
+                 seed=0, **kwargs):
         super().__init__(batch_size)
-        from ..recordio import MXRecordIO, unpack, unpack_img
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
         self.rand_crop = rand_crop
@@ -491,18 +495,64 @@ class ImageRecordIter(DataIter):
         self.std = np.array([std_r, std_g, std_b],
                             np.float32).reshape(3, 1, 1)
         self.resize = resize
-        records = []
-        rio = MXRecordIO(path_imgrec, "r")
-        while True:
-            raw = rio.read()
-            if raw is None:
-                break
-            records.append(raw)
-        rio.close()
-        self.records = records
         self.shuffle = shuffle
-        self.idx = np.arange(len(records))
+        self._nthreads = max(int(preprocess_threads), 1)
+        self._nbuffer = max(int(prefetch_buffer), 1)
+        self._epoch_rng = np.random.RandomState(seed)
+        self._aug_seed = seed
+
+        self._file = open(path_imgrec, "rb")
+        self._io_lock = threading.Lock()
+        self._offsets = self._load_offsets(path_imgrec)
+        self._pool = None
+        self._producer = None
+        self._gen = 0
+        # native threaded libjpeg decoder (the reference's OMP decode,
+        # iter_image_recordio_2.cc:445); PIL is the fallback for
+        # non-JPEG payloads or hosts without libjpeg
+        self._native = None
+        if self.data_shape[0] == 3:
+            from .._native import load_imgdec
+            self._native = load_imgdec()
         self.reset()
+
+    def _load_offsets(self, path):
+        """Record offsets from the .idx sidecar when present, else one
+        framing scan (seeks only — no payloads are retained)."""
+        idx_path = os.path.splitext(path)[0] + ".idx"
+        if os.path.isfile(idx_path):
+            offs = []
+            with open(idx_path) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) >= 2:
+                        offs.append(int(parts[1]))
+            if offs:
+                return offs
+        from ..recordio import _LFLAG_MASK, _MAGIC
+        offs = []
+        f = self._file
+        f.seek(0, 2)
+        end = f.tell()
+        pos = 0
+        while pos + 8 <= end:
+            f.seek(pos)
+            magic, lrec = struct.unpack("<II", f.read(8))
+            if magic != _MAGIC:
+                raise MXNetError(f"invalid RecordIO magic at {pos}")
+            offs.append(pos)
+            length = lrec & _LFLAG_MASK
+            pos += 8 + length + (4 - length % 4) % 4
+        return offs
+
+    def _read_at(self, off):
+        from ..recordio import _LFLAG_MASK, _MAGIC
+        with self._io_lock:
+            self._file.seek(off)
+            magic, lrec = struct.unpack("<II", self._file.read(8))
+            if magic != _MAGIC:
+                raise MXNetError(f"invalid RecordIO magic at {off}")
+            return self._file.read(lrec & _LFLAG_MASK)
 
     @property
     def provide_data(self):
@@ -515,24 +565,176 @@ class ImageRecordIter(DataIter):
         return [DataDesc("softmax_label", shape)]
 
     def reset(self):
-        self.cursor = 0
+        self._gen += 1
+        gen = self._gen
+        if self._producer is not None:
+            self._producer.join(timeout=5)
         self._peek = None
+        order = np.arange(len(self._offsets))
         if self.shuffle:
-            np.random.shuffle(self.idx)
+            self._epoch_rng.shuffle(order)
+        self._queue = queue.Queue(self._nbuffer)
+        self._producer = threading.Thread(
+            target=self._produce, args=(gen, order, self._queue),
+            daemon=True)
+        self._producer.start()
+
+    def _produce(self, gen, order, q):
+        """Producer: stream raw records, decode on the pool, emit
+        batches; exits promptly when reset() bumps the generation."""
+        try:
+            n = (len(order) // self.batch_size) * self.batch_size
+            for start in range(0, n, self.batch_size):
+                if self._gen != gen:
+                    return
+                sel = order[start:start + self.batch_size]
+                raws = [self._read_at(self._offsets[i]) for i in sel]
+                native = self._try_native_batch(raws)
+                if native is not None:
+                    imgs, labels = native
+                else:
+                    if self._pool is None and self._nthreads > 1:
+                        from multiprocessing.pool import ThreadPool
+                        self._pool = ThreadPool(self._nthreads)
+                    if self._pool is not None:
+                        results = self._pool.map(self._decode, raws)
+                    else:
+                        results = [self._decode(r) for r in raws]
+                    imgs = np.stack([r[0] for r in results])
+                    labels = np.stack([r[1][:self.label_width]
+                                       for r in results])
+                if self.label_width == 1:
+                    labels = labels[:, 0]
+                batch = DataBatch(data=[array(imgs)],
+                                  label=[array(labels)], pad=0)
+                self._put(gen, q, batch)
+        except Exception as e:  # noqa: BLE001 — surface in next()
+            self._put(gen, q, e)
+            return
+        self._put(gen, q, self._SENTINEL)
+
+    def _put(self, gen, q, item):
+        while self._gen == gen:
+            try:
+                q.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    def close(self):
+        self._gen += 1  # stops the producer at its next put/check
+        if self._producer is not None:
+            self._producer.join(timeout=5)
+            self._producer = None
+        if self._pool is not None:
+            self._pool.terminate()
+            self._pool = None
+        if self._file is not None and not self._file.closed:
+            self._file.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter shutdown
+            pass
+
+    def _try_native_batch(self, raws):
+        """Decode a whole batch through the C++ libjpeg pool; None when
+        the native lib is absent or any payload is not a JPEG."""
+        if self._native is None or self.resize > 0:
+            # shorter-side resize runs in the PIL path (the native
+            # decoder crops/normalizes only)
+            return None
+        import ctypes
+
+        from ..recordio import unpack
+        c, h, w = self.data_shape
+        n = len(raws)
+        payloads, labels = [], []
+        for raw in raws:
+            header, payload = unpack(raw)
+            if payload[:2] != b"\xff\xd8":  # not JPEG
+                return None
+            payloads.append(payload)
+            label = header.label
+            if isinstance(label, (int, float)):
+                label = np.array([label], np.float32)
+            labels.append(np.asarray(label, np.float32)
+                          [:self.label_width])
+
+        rng = self._rng()
+        if self.rand_crop:
+            uv = rng.rand(n, 2).astype(np.float32)
+        else:
+            uv = np.full((n, 2), -1.0, np.float32)
+        mirror = ((rng.rand(n) < 0.5) if self.rand_mirror
+                  else np.zeros(n)).astype(np.uint8)
+        out = np.empty((n, 3, h, w), np.float32)
+        bufs = (ctypes.c_char_p * n)(*payloads)
+        lens = (ctypes.c_int64 * n)(*[len(p) for p in payloads])
+        errbuf = ctypes.create_string_buffer(512)
+        fptr = ctypes.POINTER(ctypes.c_float)
+        rc = self._native.mxtpu_decode_batch(
+            ctypes.cast(bufs, ctypes.POINTER(ctypes.c_char_p)),
+            ctypes.cast(lens, ctypes.POINTER(ctypes.c_int64)),
+            n, h, w,
+            uv.ctypes.data_as(fptr),
+            mirror.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            self.mean.ravel().ctypes.data_as(fptr),
+            self.std.ravel().ctypes.data_as(fptr),
+            out.ctypes.data_as(fptr),
+            self._nthreads, errbuf, len(errbuf))
+        if rc != 0:
+            raise MXNetError("native decode failed: %s"
+                             % errbuf.value.decode(errors="replace"))
+        return out, np.stack(labels)
+
+    @staticmethod
+    def _cv2_decoder():
+        """unpack_img decodes through cv2 (BGR) when it is installed."""
+        import importlib.util
+        return importlib.util.find_spec("cv2") is not None
+
+    @staticmethod
+    def _resize_shorter(img, size):
+        """Resize so the shorter side equals ``size`` (the reference's
+        resize= augmentation, image_aug_default.cc)."""
+        from PIL import Image
+        ih, iw = img.shape[:2]
+        if ih < iw:
+            nh, nw = size, max(int(round(iw * size / ih)), size)
+        else:
+            nh, nw = max(int(round(ih * size / iw)), size), size
+        return np.asarray(Image.fromarray(img.astype(np.uint8))
+                          .resize((nw, nh), Image.BILINEAR))
+
+    _aug_local = threading.local()
+
+    def _rng(self):
+        rng = getattr(self._aug_local, "rng", None)
+        if rng is None:
+            rng = np.random.RandomState(
+                (self._aug_seed + threading.get_ident()) % (2 ** 31))
+            self._aug_local.rng = rng
+        return rng
 
     def _decode(self, raw):
         from ..recordio import unpack, unpack_img
         header, payload = unpack(raw)
         c, h, w = self.data_shape
         try:
-            _, img = unpack_img(raw)          # HWC uint8 (PIL/opencv path)
-            img = img.astype(np.float32)
+            _, img = unpack_img(raw)          # HWC uint8
             if img.ndim == 2:
                 img = img[:, :, None].repeat(3, axis=2)
-            img = img.transpose(2, 0, 1)      # CHW
+            if self._cv2_decoder():
+                img = img[:, :, ::-1]  # cv2 decodes BGR; pipeline is RGB
+            if self.resize > 0:
+                img = self._resize_shorter(img, self.resize)
+            img = img.astype(np.float32).transpose(2, 0, 1)  # CHW
         except Exception:
             img = np.frombuffer(payload, np.float32)
             img = img.reshape(self.data_shape)
+        rng = self._rng()
         # center/random crop to target
         _, ih, iw = img.shape
         if (ih, iw) != (h, w):
@@ -540,12 +742,12 @@ class ImageRecordIter(DataIter):
                 raise MXNetError(
                     f"image {ih}x{iw} smaller than data_shape {h}x{w}")
             if self.rand_crop:
-                top = np.random.randint(0, ih - h + 1)
-                left = np.random.randint(0, iw - w + 1)
+                top = rng.randint(0, ih - h + 1)
+                left = rng.randint(0, iw - w + 1)
             else:
                 top, left = (ih - h) // 2, (iw - w) // 2
             img = img[:, top:top + h, left:left + w]
-        if self.rand_mirror and np.random.rand() < 0.5:
+        if self.rand_mirror and rng.rand() < 0.5:
             img = img[:, :, ::-1]
         img = (img - self.mean) / self.std
         label = header.label
@@ -554,23 +756,16 @@ class ImageRecordIter(DataIter):
         return img, np.asarray(label, np.float32)
 
     def next(self):
-        if getattr(self, "_peek", None) is not None:
-            b, self._peek = self._peek, None
-            return b
-        if self.cursor + self.batch_size > len(self.records):
+        peek = getattr(self, "_peek", None)
+        if peek is not None:
+            self._peek = None
+            return peek
+        item = self._queue.get()
+        if item is self._SENTINEL:
             raise StopIteration
-        sel = self.idx[self.cursor:self.cursor + self.batch_size]
-        self.cursor += self.batch_size
-        imgs, labels = [], []
-        for i in sel:
-            img, lab = self._decode(self.records[i])
-            imgs.append(img)
-            labels.append(lab[:self.label_width])
-        data = array(np.stack(imgs))
-        lab = np.stack(labels)
-        if self.label_width == 1:
-            lab = lab[:, 0]
-        return DataBatch(data=[data], label=[array(lab)], pad=0)
+        if isinstance(item, Exception):
+            raise item
+        return item
 
     def iter_next(self):
         if getattr(self, "_peek", None) is not None:

@@ -338,7 +338,10 @@ class Module(BaseModule):
                             for i, s in states.items()}
 
     def install_monitor(self, mon):
-        self._exec.set_monitor_callback(mon)
+        if hasattr(mon, "install"):
+            mon.install(self._exec)
+        else:
+            self._exec.set_monitor_callback(mon)
 
     def reshape(self, data_shapes, label_shapes=None):
         assert self.binded

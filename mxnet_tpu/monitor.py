@@ -1,0 +1,68 @@
+"""Monitor — per-op output statistics during training
+(ref: python/mxnet/monitor.py Monitor).
+
+Taps every operator output through the executor's monitor callback
+(Executor.forward runs a second jitted pass returning all internals —
+the reference's ExecuteMonCallback, graph_executor.cc:1294) and
+aggregates a statistic per tensor every ``interval`` batches.
+"""
+from __future__ import annotations
+
+import logging
+import re
+
+from .ndarray import NDArray
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False,
+                 monitor_all=False):
+        if stat_func is None:
+            def stat_func(x):  # noqa: ANN001
+                return x.abs().mean()  # the reference's default |x|.mean()
+        self.stat_func = stat_func
+        self.interval = interval
+        self.queue = []
+        self.step = 0
+        self.activated = False
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+        self.monitor_all = monitor_all
+        self.logger = logging.getLogger(__name__)
+
+    def install(self, exe, monitor_all=None):
+        """Attach to an executor (ref: monitor.py install)."""
+        if monitor_all is None:
+            monitor_all = self.monitor_all
+        exe.set_monitor_callback(self.stat_helper, monitor_all)
+
+    def stat_helper(self, name, arr):
+        if not self.activated or not self.re_prog.match(name):
+            return
+        arr = arr if isinstance(arr, NDArray) else NDArray(arr)
+        self.queue.append((self.step, name, self.stat_func(arr)))
+
+    def tic(self):
+        """Start collecting for this batch if the interval has elapsed."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Finish the batch; returns [(step, tensor_name, stat_str)]."""
+        if not self.activated:
+            return []
+        self.activated = False
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for step, name, stat in self.queue:
+            arr = stat if isinstance(stat, NDArray) else NDArray(stat)
+            res.append((step, name, str(arr.asnumpy().ravel())))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        for step, name, stat in self.toc():
+            self.logger.info("Batch: %7d %30s %s", step, name, stat)

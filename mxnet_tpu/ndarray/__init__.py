@@ -19,6 +19,15 @@ from . import random  # noqa: F401
 from . import contrib  # noqa: F401
 from .utils import save, load
 
+
+def Custom(*inputs, op_type=None, **kwargs):
+    """Run a registered custom Python operator
+    (ref: the generated mx.nd.Custom, src/operator/custom/custom.cc)."""
+    from ..operator import invoke_custom
+    if op_type is None:
+        raise ValueError("Custom requires op_type=")
+    return invoke_custom(list(inputs), op_type, **kwargs)
+
 populate(globals())
 
 

@@ -169,14 +169,26 @@ def unpack(s):
 
 
 def pack_img(header, img, quality=95, img_fmt=".jpg"):
-    """Encode image + pack (requires cv2 or PIL for JPEG; raw npy always
-    available)."""
+    """Encode image + pack (cv2, then PIL, then raw npy; decoded arrays
+    are RGB HWC in the PIL path)."""
     try:
         import cv2
         ret, buf = cv2.imencode(img_fmt, img,
                                 [cv2.IMWRITE_JPEG_QUALITY, quality])
         assert ret
         return pack(header, buf.tobytes())
+    except ImportError:
+        pass
+    try:
+        from PIL import Image
+        bio = io.BytesIO()
+        fmt = {"jpg": "JPEG", "jpeg": "JPEG", "png": "PNG"}.get(
+            img_fmt.lstrip("."), "JPEG")
+        arr = np.asarray(img)
+        if arr.dtype != np.uint8:
+            arr = np.clip(arr, 0, 255).astype(np.uint8)
+        Image.fromarray(arr).save(bio, fmt, quality=quality)
+        return pack(header, bio.getvalue())
     except ImportError:
         bio = io.BytesIO()
         np.save(bio, np.asarray(img))
@@ -192,6 +204,12 @@ def unpack_img(s, iscolor=-1):
             import cv2
             img = cv2.imdecode(np.frombuffer(payload, np.uint8), iscolor)
         except ImportError:
-            raise MXNetError("cannot decode JPEG without cv2; pack with "
-                             "raw npy payloads in this environment")
+            try:
+                from PIL import Image
+                img = np.asarray(Image.open(io.BytesIO(payload))
+                                 .convert("RGB"))
+            except ImportError:
+                raise MXNetError(
+                    "cannot decode image without cv2 or PIL; pack with "
+                    "raw npy payloads in this environment")
     return header, img

@@ -1,0 +1,97 @@
+"""Network visualization — print_summary / plot_network
+(ref: python/mxnet/visualization.py).
+"""
+from __future__ import annotations
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    """Layer-table summary with output shapes and parameter counts
+    (ref: visualization.py print_summary)."""
+    positions = positions or [0.44, 0.64, 0.74, 1.0]
+    shape_map = {}
+    if shape is not None:
+        internals = symbol.get_internals()
+        _, int_shapes, _ = internals.infer_shape_partial(**shape)
+        shape_map = dict(zip(internals.list_outputs(), int_shapes))
+        arg_shapes, _, aux_shapes = symbol.infer_shape_partial(**shape)
+        shape_map.update(zip(symbol.list_arguments(), arg_shapes))
+        shape_map.update(zip(symbol.list_auxiliary_states(), aux_shapes))
+
+    positions = [int(line_length * p) for p in positions]
+    header = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(fields):
+        line = ""
+        for i, f in enumerate(fields):
+            line += str(f)
+            line = line.ljust(positions[i])
+        print(line)
+
+    print("=" * line_length)
+    print_row(header)
+    print("=" * line_length)
+
+    arg_names = set(symbol.list_arguments())
+    data_like = {"data"} | {n for n in arg_names if n.endswith("label")}
+    total = 0
+    for node in symbol._topo():
+        if node.op is None:
+            continue
+        out_shape = shape_map.get(node.name + "_output", "")
+        params = 0
+        prevs = []
+        for c, _k in node.inputs:
+            if c.op is None:
+                if c.name in arg_names and c.name not in data_like:
+                    s = shape_map.get(c.name)
+                    if s:
+                        n = 1
+                        for d in s:
+                            n *= d
+                        params += n
+            else:
+                prevs.append(c.name)
+        total += params
+        print_row([f"{node.name} ({node.op})", out_shape, params,
+                   ",".join(prevs)])
+    print("=" * line_length)
+    print(f"Total params: {total}")
+    print("=" * line_length)
+    return total
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Graphviz plot of the network (ref: visualization.py plot_network).
+    Requires the optional graphviz package; raises a clear error
+    otherwise (it is not part of this image)."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise ImportError(
+            "plot_network requires the 'graphviz' python package; "
+            "use print_summary for a text rendering") from e
+
+    dot = Digraph(name=title)
+    arg_names = set(symbol.list_arguments())
+
+    def hidden(n):
+        return hide_weights and n.op is None and n.name in arg_names \
+            and n.name != "data"
+
+    for node in symbol._topo():
+        if node.op is None:
+            if hidden(node):
+                continue
+            dot.node(str(id(node)), label=node.name, shape="oval")
+        else:
+            dot.node(str(id(node)),
+                     label=f"{node.name}\n{node.op}", shape="box")
+    for node in symbol._topo():
+        if node.op is None:
+            continue
+        for c, _k in node.inputs:
+            if hidden(c):
+                continue
+            dot.edge(str(id(c)), str(id(node)))
+    return dot

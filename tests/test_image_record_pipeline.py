@@ -1,0 +1,132 @@
+"""ImageRecordIter pipeline tests (VERDICT r2 #3 done-criteria: iterate
+a generated 1k-image recfile, multi-thread decode measurably engaged,
+bounded memory — offsets only, no whole-file list; ref:
+src/io/iter_image_recordio_2.cc:50,445).
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.recordio import IRHeader, MXIndexedRecordIO, pack_img
+
+
+@pytest.fixture(scope="module")
+def recfile(tmp_path_factory):
+    d = tmp_path_factory.mktemp("rec")
+    prefix = str(d / "train")
+    rec = MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    rng = np.random.default_rng(0)
+    for i in range(1000):
+        img = rng.integers(0, 255, (36, 36, 3), dtype=np.uint8)
+        rec.write_idx(i, pack_img(IRHeader(0, float(i % 10), i, 0), img))
+    rec.close()
+    return prefix + ".rec"
+
+
+def test_streams_without_loading_file(recfile):
+    it = mx.io.ImageRecordIter(path_imgrec=recfile,
+                               data_shape=(3, 32, 32), batch_size=50,
+                               preprocess_threads=2)
+    # bounded state: offsets only, no payload list
+    assert not hasattr(it, "records")
+    assert len(it._offsets) == 1000
+    total = 0
+    labels = []
+    for b in it:
+        assert b.data[0].shape == (50, 3, 32, 32)
+        labels.append(b.label[0].asnumpy())
+        total += 50
+    assert total == 1000
+    np.testing.assert_allclose(np.concatenate(labels),
+                               np.arange(1000) % 10)
+
+
+def test_shuffle_and_reset(recfile):
+    it = mx.io.ImageRecordIter(path_imgrec=recfile,
+                               data_shape=(3, 32, 32), batch_size=100,
+                               shuffle=True, preprocess_threads=2)
+    first = next(iter(it)).label[0].asnumpy().copy()
+    it.reset()
+    second = next(iter(it)).label[0].asnumpy().copy()
+    # different epoch order (astronomically unlikely to match)
+    assert not np.array_equal(first, second)
+    # epoch still covers everything exactly once
+    it.reset()
+    seen = []
+    for b in it:
+        seen.append(b.label[0].asnumpy())
+    assert sorted(np.concatenate(seen).tolist()) == \
+        sorted((np.arange(1000) % 10).tolist())
+
+
+def test_multithread_decode_faster(tmp_path):
+    # decode must dominate for threading to show: use 256x256 JPEGs
+    prefix = str(tmp_path / "big")
+    rec = MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    rng = np.random.default_rng(1)
+    for i in range(160):
+        img = rng.integers(0, 255, (256, 256, 3), dtype=np.uint8)
+        rec.write_idx(i, pack_img(IRHeader(0, float(i % 10), i, 0), img))
+    rec.close()
+
+    def epoch_time(threads, force_pil=False):
+        it = mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                                   data_shape=(3, 224, 224),
+                                   batch_size=20,
+                                   preprocess_threads=threads,
+                                   prefetch_buffer=2)
+        if force_pil:
+            it._native = None
+            it.reset()
+        t0 = time.perf_counter()
+        n = 0
+        for b in it:
+            n += 1
+        assert n == 8
+        return time.perf_counter() - t0
+
+    from mxnet_tpu._native import load_imgdec
+    if load_imgdec() is not None:
+        # the C++ libjpeg pool must beat the GIL-bound PIL fallback
+        # (best-of-3 each; modest margin — the CI host has 1 core and
+        # runs the rest of the suite's teardown threads)
+        t_native = min(epoch_time(2) for _ in range(3))
+        t_pil = min(epoch_time(2, force_pil=True) for _ in range(3))
+        assert t_native < t_pil / 1.1, (t_native, t_pil)
+
+    if (os.cpu_count() or 1) >= 2:
+        # thread scaling only observable with >1 core (CI hosts vary)
+        t1 = min(epoch_time(1) for _ in range(2))
+        t4 = min(epoch_time(4) for _ in range(2))
+        assert t4 < t1 / 1.15, (t1, t4)
+
+
+def test_prefetch_overlaps(recfile):
+    it = mx.io.ImageRecordIter(path_imgrec=recfile,
+                               data_shape=(3, 32, 32), batch_size=100,
+                               preprocess_threads=2, prefetch_buffer=2)
+    time.sleep(0.5)  # give the producer a head start
+    t0 = time.perf_counter()
+    next(iter(it))
+    assert time.perf_counter() - t0 < 0.25  # batch was already buffered
+
+
+def test_augmentation_and_errors(recfile):
+    it = mx.io.ImageRecordIter(path_imgrec=recfile,
+                               data_shape=(3, 32, 32), batch_size=10,
+                               rand_crop=True, rand_mirror=True,
+                               mean_r=128, mean_g=128, mean_b=128,
+                               std_r=64, std_g=64, std_b=64,
+                               preprocess_threads=2)
+    b = next(iter(it))
+    x = b.data[0].asnumpy()
+    assert np.abs(x).max() < 4  # normalized
+    # oversized target shape errors surface in next(), not a hang
+    bad = mx.io.ImageRecordIter(path_imgrec=recfile,
+                                data_shape=(3, 64, 64), batch_size=10,
+                                preprocess_threads=2)
+    with pytest.raises(Exception, match="smaller than data_shape"):
+        next(iter(bad))

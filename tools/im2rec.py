@@ -84,6 +84,11 @@ def _load_and_encode(fullpath, resize, quality, center_crop):
 
 
 def make_record(args, path_lst, root):
+    """Threaded encode with an in-order streaming writer: completed
+    payloads drain to disk as their sequence number comes up, so memory
+    stays bounded at roughly queue-depth payloads regardless of dataset
+    size (the reference's read/write-worker pipeline, tools/im2rec.py).
+    """
     items = read_list(path_lst)
     if args.shuffle:
         random.seed(100)
@@ -91,9 +96,9 @@ def make_record(args, path_lst, root):
     prefix = os.path.splitext(path_lst)[0]
     rec = MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
 
-    in_q = queue.Queue(1024)
+    in_q = queue.Queue(256)
     out = {}
-    lock = threading.Lock()
+    cond = threading.Condition()
 
     def worker():
         while True:
@@ -112,27 +117,36 @@ def make_record(args, path_lst, root):
             except Exception as e:  # noqa: BLE001 — skip bad images
                 print("skipping %s: %r" % (fname, e), file=sys.stderr)
                 payload = None
-            with lock:
+            with cond:
                 out[seq] = (idx, payload)
+                cond.notify_all()
+
+    def feeder():
+        for seq, item in enumerate(items):
+            in_q.put((seq, item))
+        for _ in range(max(args.num_thread, 1)):
+            in_q.put(None)
 
     threads = [threading.Thread(target=worker, daemon=True)
                for _ in range(max(args.num_thread, 1))]
     for t in threads:
         t.start()
-    for seq, item in enumerate(items):
-        in_q.put((seq, item))
-    for _ in threads:
-        in_q.put(None)
-    for t in threads:
-        t.join()
+    feed = threading.Thread(target=feeder, daemon=True)
+    feed.start()
 
     count = 0
     for seq in range(len(items)):
-        idx, payload = out[seq]
-        if payload is None:
-            continue
-        rec.write_idx(idx, payload)
-        count += 1
+        with cond:
+            cond.wait_for(lambda: seq in out)
+            idx, payload = out.pop(seq)
+        if payload is not None:
+            rec.write_idx(idx, payload)
+            count += 1
+        if count and count % 1000 == 0:
+            print("packed %d/%d" % (count, len(items)))
+    feed.join()
+    for t in threads:
+        t.join()
     rec.close()
     print("wrote %d records to %s.rec" % (count, prefix))
 
@@ -145,7 +159,10 @@ if __name__ == "__main__":
     p.add_argument("--list", action="store_true",
                    help="generate the .lst file instead of packing")
     p.add_argument("--recursive", action="store_true")
-    p.add_argument("--shuffle", type=bool, default=True)
+    p.add_argument("--shuffle",
+                   type=lambda v: v.lower() in ("1", "true", "yes"),
+                   default=True,
+                   help="shuffle the pack order (true/false)")
     p.add_argument("--resize", type=int, default=0)
     p.add_argument("--center-crop", action="store_true")
     p.add_argument("--quality", type=int, default=95)
