@@ -78,6 +78,7 @@ def load_comm():
                                          ctypes.c_char_p, ctypes.c_uint64]
     lib.mxtpu_client_command.restype = ctypes.c_int
     lib.mxtpu_client_close.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_client_set_timeout.argtypes = [ctypes.c_void_p, ctypes.c_int]
     _comm_lib = lib
     return lib
 

@@ -82,6 +82,11 @@ bool send_response(int fd, uint8_t ok, const void* payload, uint64_t n) {
 typedef void (*UpdaterFn)(uint32_t key, const float* recved, uint64_t n,
                           float* stored);
 
+struct Server;
+bool sync_unhealthy_locked(Server* s);
+void mark_degraded_locked(Server* s);
+void worker_disconnected(Server* s, int rank);
+
 struct KeyState {
   std::vector<float> store;
   std::vector<float> merge;
@@ -94,6 +99,11 @@ struct Server {
   int num_workers = 0;
   bool sync_mode = false;
   bool stop = false;
+  // a ranked worker disconnected while a round / barrier / pull was in
+  // flight: the job cannot complete — fail fast instead of hanging
+  // (the reference's dead-node detection, kvstore_dist.h:118-123)
+  bool degraded = false;
+  int active_workers = 0;
   UpdaterFn updater = nullptr;
   std::map<uint32_t, KeyState> keys;
   std::mutex mu;
@@ -158,6 +168,11 @@ void apply_round(Server* s, uint32_t key, KeyState* ks) {
 void handle_push(Server* s, int fd, uint32_t key, const char* payload,
                  uint64_t nbytes, bool compressed) {
   std::unique_lock<std::mutex> lk(s->mu);
+  if (s->sync_mode && sync_unhealthy_locked(s)) {
+    lk.unlock();
+    send_response(fd, 0, nullptr, 0);
+    return;
+  }
   KeyState& ks = s->keys[key];
   bool first = ks.pushed == 0;
   if (s->sync_mode) {
@@ -194,11 +209,71 @@ void handle_push(Server* s, int fd, uint32_t key, const char* payload,
   send_response(fd, 1, nullptr, 0);
 }
 
-void handle_conn(Server* s, int fd, int rank) {
-  {  // HELLO: rank assignment (the rendezvous step)
+void mark_degraded_locked(Server* s) {
+  s->degraded = true;
+  for (auto& kv : s->keys) {
+    for (int pfd : kv.second.pending_pulls)
+      send_response(pfd, 0, nullptr, 0);
+    kv.second.pending_pulls.clear();
+  }
+  for (int bfd : s->barrier_fds) send_response(bfd, 0, nullptr, 0);
+  s->barrier_fds.clear();
+  s->cv.notify_all();
+}
+
+// sync-mode health gate: once the full worker set has connected
+// (next_rank reached num_workers), any missing worker means BSP rounds
+// can never complete — new sync ops must fail instead of queueing
+bool sync_unhealthy_locked(Server* s) {
+  if (s->degraded) return true;
+  if (s->stop) return false;
+  if (s->next_rank >= s->num_workers &&
+      s->active_workers < s->num_workers) {
+    mark_degraded_locked(s);
+    return true;
+  }
+  return false;
+}
+
+void worker_disconnected(Server* s, int rank) {
+  if (rank < 0) return;
+  std::lock_guard<std::mutex> lk(s->mu);
+  --s->active_workers;
+  if (s->sync_mode && !s->stop && !s->degraded) {
+    bool pending = !s->barrier_fds.empty();
+    for (auto& kv : s->keys)
+      if (kv.second.pushed > 0 || !kv.second.pending_pulls.empty())
+        pending = true;
+    if (pending) mark_degraded_locked(s);
+  }
+}
+
+void handle_conn(Server* s, int fd) {
+  int rank = -1;
+  {
+    // rendezvous: the client first identifies itself ("MXTWw" worker /
+    // "MXTWp" probe); stray TCP connects never consume a worker rank
+    // (a 5s deadline bounds the wait)
+    timeval tv{5, 0};
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    char magic[5];
+    if (!read_full(fd, magic, 5) || std::memcmp(magic, "MXTW", 4) != 0) {
+      ::close(fd);
+      return;
+    }
+    timeval off{0, 0};
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &off, sizeof(off));
+    {
+      std::lock_guard<std::mutex> lk(s->mu);
+      if (magic[4] == 'w') {
+        rank = s->next_rank++;
+        ++s->active_workers;
+      }
+    }
     uint32_t hello[2] = {static_cast<uint32_t>(rank),
                          static_cast<uint32_t>(s->num_workers)};
     if (!write_full(fd, hello, 8)) {
+      worker_disconnected(s, rank);  // rank was consumed — account it
       ::close(fd);
       return;
     }
@@ -223,6 +298,11 @@ void handle_conn(Server* s, int fd, int rank) {
                   h.op == kPush2Bit);
     } else if (h.op == kPull) {
       std::unique_lock<std::mutex> lk(s->mu);
+      if (s->sync_mode && sync_unhealthy_locked(s)) {
+        lk.unlock();
+        send_response(fd, 0, nullptr, 0);
+        continue;
+      }
       KeyState& ks = s->keys[h.key];
       if (s->sync_mode && ks.pushed > 0) {
         // round in flight: queue until the last worker pushes
@@ -235,6 +315,11 @@ void handle_conn(Server* s, int fd, int rank) {
       }
     } else if (h.op == kBarrier) {
       std::unique_lock<std::mutex> lk(s->mu);
+      if (s->sync_mode && sync_unhealthy_locked(s)) {
+        lk.unlock();
+        send_response(fd, 0, nullptr, 0);
+        continue;
+      }
       s->barrier_fds.push_back(fd);
       if (static_cast<int>(s->barrier_fds.size()) >= s->num_workers) {
         for (int bfd : s->barrier_fds) send_response(bfd, 1, nullptr, 0);
@@ -253,18 +338,28 @@ void handle_conn(Server* s, int fd, int rank) {
         s->cv.notify_all();
       } else if (h.key == 4) {
         // ack only after the host loop picked the blob up and installed
-        // the updater — otherwise the next push round races the install
+        // the updater — otherwise the next push round races the install.
+        // Bounded wait: a server started without run_server's poll loop
+        // must reject instead of deadlocking this connection thread.
         std::unique_lock<std::mutex> lk(s->mu);
         s->opt_blob.assign(payload.begin(), payload.end());
         s->opt_blob_fresh = true;
         s->cv.notify_all();
-        s->cv.wait(lk, [s] { return s->updater != nullptr || s->stop; });
+        bool ok = s->cv.wait_for(
+            lk, std::chrono::seconds(60),
+            [s] { return s->updater != nullptr || s->stop; });
+        if (!ok) {
+          lk.unlock();
+          send_response(fd, 0, nullptr, 0);
+          continue;
+        }
       }
       send_response(fd, 1, nullptr, 0);
     } else {
       send_response(fd, 0, nullptr, 0);
     }
   }
+  worker_disconnected(s, rank);
   ::close(fd);
 }
 
@@ -300,13 +395,8 @@ int mxtpu_server_start(int port, int num_workers) {
       if (cfd < 0) break;
       int one = 1;
       ::setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-      int rank;
-      {
-        std::lock_guard<std::mutex> lk(s->mu);
-        rank = s->next_rank++;
-      }
       std::lock_guard<std::mutex> lk(s->mu);
-      s->threads.emplace_back(handle_conn, s, cfd, rank);
+      s->threads.emplace_back(handle_conn, s, cfd);
     }
   });
   return 0;
@@ -368,6 +458,10 @@ struct Client {
   int fd;
   int rank;
   int num_workers;
+  // a timed-out/failed request leaves the stream desynchronized (a late
+  // response would be parsed as the NEXT request's reply) — poison the
+  // connection instead
+  bool broken = false;
   std::mutex mu;
 };
 
@@ -387,6 +481,10 @@ void* mxtpu_client_connect(const char* host, int port) {
   }
   int one = 1;
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  if (!write_full(fd, "MXTWw", 5)) {  // identify as a worker
+    ::close(fd);
+    return nullptr;
+  }
   uint32_t hello[2];
   if (!read_full(fd, hello, 8)) {
     ::close(fd);
@@ -399,6 +497,15 @@ void* mxtpu_client_connect(const char* host, int port) {
   return c;
 }
 
+// per-request deadline: a request outliving this fails with rc -1
+// instead of hanging forever on a dead server/worker set
+void mxtpu_client_set_timeout(void* h, int ms) {
+  Client* c = static_cast<Client*>(h);
+  timeval tv{ms / 1000, (ms % 1000) * 1000};
+  ::setsockopt(c->fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  ::setsockopt(c->fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+}
+
 int mxtpu_client_rank(void* h) { return static_cast<Client*>(h)->rank; }
 int mxtpu_client_num_workers(void* h) {
   return static_cast<Client*>(h)->num_workers;
@@ -408,11 +515,15 @@ static int request(Client* c, uint8_t op, uint32_t key, const void* payload,
                    uint64_t nbytes, void* out, uint64_t out_cap,
                    uint64_t* out_n) {
   std::lock_guard<std::mutex> lk(c->mu);
+  if (c->broken) return -1;
   Header h{op, key, nbytes};
-  if (!write_full(c->fd, &h, sizeof(h))) return -1;
-  if (nbytes > 0 && !write_full(c->fd, payload, nbytes)) return -1;
+  if (!write_full(c->fd, &h, sizeof(h))) { c->broken = true; return -1; }
+  if (nbytes > 0 && !write_full(c->fd, payload, nbytes)) {
+    c->broken = true;
+    return -1;
+  }
   char rhdr[9];
-  if (!read_full(c->fd, rhdr, 9)) return -1;
+  if (!read_full(c->fd, rhdr, 9)) { c->broken = true; return -1; }
   uint64_t rn;
   std::memcpy(&rn, rhdr + 1, 8);
   if (out_n) *out_n = rn;
@@ -420,10 +531,10 @@ static int request(Client* c, uint8_t op, uint32_t key, const void* payload,
     if (out == nullptr || rn > out_cap) {
       // drain
       std::vector<char> sink(rn);
-      read_full(c->fd, sink.data(), rn);
+      if (!read_full(c->fd, sink.data(), rn)) c->broken = true;
       return -2;
     }
-    if (!read_full(c->fd, out, rn)) return -1;
+    if (!read_full(c->fd, out, rn)) { c->broken = true; return -1; }
   }
   return rhdr[0] == 1 ? 0 : -3;
 }

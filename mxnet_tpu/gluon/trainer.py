@@ -69,11 +69,28 @@ class Trainer:
     def step(self, batch_size, ignore_stale_grad=False):
         """rescale by 1/batch_size, allreduce (mesh DP: already summed by
         psum in the sharded step), update."""
+        # rescale BEFORE kvstore init: update_on_kvstore pickles the
+        # optimizer to the server on first step, and the server must see
+        # the batch scaling or dist updates explode by batch_size
+        self._optimizer.rescale_grad = self._scale / batch_size
         if not self._kv_initialized:
             self._init_kvstore()
-        self._optimizer.rescale_grad = self._scale / batch_size
+        self._sync_server_rescale()
         self._allreduce_grads()
         self._update(ignore_stale_grad)
+
+    def _sync_server_rescale(self):
+        """Re-ship the optimizer when the batch scale changes after the
+        first step (e.g. a short final batch) — the server-side updater
+        would otherwise keep applying the stale rescale_grad."""
+        if self._kvstore is None or not self._update_on_kvstore:
+            return
+        shipped = getattr(self, "_shipped_rescale", None)
+        if shipped is None:
+            self._shipped_rescale = self._optimizer.rescale_grad
+        elif shipped != self._optimizer.rescale_grad:
+            self._kvstore.set_optimizer(self._optimizer)
+            self._shipped_rescale = self._optimizer.rescale_grad
 
     def allreduce_grads(self):
         if not self._kv_initialized:
@@ -88,9 +105,9 @@ class Trainer:
                     self._kvstore.pull(i, p.grad())
 
     def update(self, batch_size, ignore_stale_grad=False):
+        self._optimizer.rescale_grad = self._scale / batch_size
         if not self._kv_initialized:
             self._init_kvstore()
-        self._optimizer.rescale_grad = self._scale / batch_size
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):

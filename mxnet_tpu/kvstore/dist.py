@@ -41,6 +41,22 @@ def num_workers_env():
     return int(os.environ.get("DMLC_NUM_WORKER", "1"))
 
 
+def num_servers_env():
+    return int(os.environ.get("DMLC_NUM_SERVER", "1"))
+
+
+def server_ports():
+    """Every server's port: root_port + server_index (the launcher's
+    contract; multi-server key sharding dials them all)."""
+    uri, root = server_address()
+    return uri, [root + i for i in range(num_servers_env())]
+
+
+def request_timeout_ms():
+    return int(os.environ.get("MXNET_KVSTORE_REQUEST_TIMEOUT_MS",
+                              "120000"))
+
+
 class WorkerConnection:
     """One worker's connection to the parameter server."""
 
@@ -62,37 +78,53 @@ class WorkerConnection:
         self._h = ctypes.c_void_p(handle)
         self.rank = self._lib.mxtpu_client_rank(self._h)
         self.num_workers = self._lib.mxtpu_client_num_workers(self._h)
+        # bounded requests: a dead server/worker set fails the job
+        # instead of hanging it (ref: kvstore_dist.h:118-123)
+        self._lib.mxtpu_client_set_timeout(self._h, request_timeout_ms())
 
     def _fptr(self, arr):
         return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+    @staticmethod
+    def _explain(rc):
+        if rc == -1:
+            return ("request timed out or connection lost — server or a "
+                    "peer worker may have died")
+        if rc == -3:
+            return ("server rejected the request (degraded: a worker "
+                    "died mid-round, or the command was refused)")
+        return f"rc={rc}"
 
     def init(self, key, value):
         arr = np.ascontiguousarray(value, dtype=np.float32)
         rc = self._lib.mxtpu_client_init(self._h, key, self._fptr(arr),
                                          arr.size)
         if rc != 0:
-            raise MXNetError(f"dist init failed for key {key} (rc={rc})")
+            raise MXNetError(f"dist init failed for key {key}: "
+                             f"{self._explain(rc)}")
 
     def push(self, key, value):
         arr = np.ascontiguousarray(value, dtype=np.float32)
         rc = self._lib.mxtpu_client_push(self._h, key, self._fptr(arr),
                                          arr.size)
         if rc != 0:
-            raise MXNetError(f"dist push failed for key {key} (rc={rc})")
+            raise MXNetError(f"dist push failed for key {key}: "
+                             f"{self._explain(rc)}")
 
     def push_compressed(self, key, payload):
         rc = self._lib.mxtpu_client_push_2bit(self._h, key, payload,
                                               len(payload))
         if rc != 0:
-            raise MXNetError(
-                f"dist compressed push failed for key {key} (rc={rc})")
+            raise MXNetError(f"dist compressed push failed for key "
+                             f"{key}: {self._explain(rc)}")
 
     def pull(self, key, shape):
         n = int(np.prod(shape)) if shape else 1
         out = np.empty(n, dtype=np.float32)
         got = self._lib.mxtpu_client_pull(self._h, key, self._fptr(out), n)
         if got < 0:
-            raise MXNetError(f"dist pull failed for key {key} (rc={got})")
+            raise MXNetError(f"dist pull failed for key {key}: "
+                             f"{self._explain(got)}")
         if got != n:
             raise MXNetError(
                 f"dist pull size mismatch for key {key}: got {got}, "
@@ -102,12 +134,13 @@ class WorkerConnection:
     def barrier(self):
         rc = self._lib.mxtpu_client_barrier(self._h)
         if rc != 0:
-            raise MXNetError(f"dist barrier failed (rc={rc})")
+            raise MXNetError(f"dist barrier failed: {self._explain(rc)}")
 
     def command(self, cmd, body=b""):
         rc = self._lib.mxtpu_client_command(self._h, cmd, body, len(body))
         if rc != 0:
-            raise MXNetError(f"dist command {cmd} failed (rc={rc})")
+            raise MXNetError(f"dist command {cmd} failed: "
+                             f"{self._explain(rc)}")
 
     def set_sync_mode(self, sync):
         self.command(CMD_SYNC_MODE, b"\x01" if sync else b"\x00")
@@ -124,6 +157,134 @@ class WorkerConnection:
             self._h = None
 
 
+class ShardedConnection:
+    """Worker connections to S servers with key sharding
+    (ref: kvstore_dist.h:532 EncodeDefaultKey — small keys round-robin
+    across servers; arrays above MXNET_KVSTORE_BIGARRAY_BOUND bytes are
+    split into S contiguous slices, one per server, so the push/pull
+    bandwidth of a big tensor rides every server at once).
+
+    Derived slice keys live at 1_000_000 + key * 64 + slice; user keys
+    must stay below 1e6 (the reference packs keys similarly).
+    """
+
+    _SHARD_BASE = 1_000_000
+
+    def __init__(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        host, ports = server_ports()
+        self._conns = [WorkerConnection(host, p) for p in ports]
+        self.rank = self._conns[0].rank
+        self.num_workers = self._conns[0].num_workers
+        # element count, matching the reference's semantics
+        # (kvstore_dist.h bigarray_bound_, default 1e6 elements)
+        self._big = int(float(os.environ.get(
+            "MXNET_KVSTORE_BIGARRAY_BOUND", "1000000")))
+        self._sizes = {}
+        # per-server socket IO releases the GIL inside ctypes — slice
+        # requests genuinely overlap across servers
+        self._pool = ThreadPoolExecutor(max_workers=len(self._conns))
+
+    @property
+    def num_servers(self):
+        return len(self._conns)
+
+    def _srv(self, key):
+        return self._conns[key % len(self._conns)]
+
+    def _slices(self, key, n):
+        """[(server, derived_key, start, stop)] covering [0, n)."""
+        S = len(self._conns)
+        if key >= self._SHARD_BASE:
+            raise MXNetError(f"kvstore key {key} out of range (<1e6)")
+        if n < self._big or S == 1:
+            return None
+        per = (n + S - 1) // S
+        out = []
+        for i in range(S):
+            start, stop = i * per, min((i + 1) * per, n)
+            if start >= stop:
+                break
+            out.append((self._conns[i],
+                        self._SHARD_BASE + key * 64 + i, start, stop))
+        return out
+
+    def init(self, key, value):
+        flat = np.ascontiguousarray(value, dtype=np.float32).ravel()
+        self._sizes[key] = flat.size
+        sl = self._slices(key, flat.size)
+        if sl is None:
+            self._srv(key).init(key, flat)
+            return
+        for conn, dk, start, stop in sl:
+            conn.init(dk, flat[start:stop])
+
+    def push(self, key, value):
+        flat = np.ascontiguousarray(value, dtype=np.float32).ravel()
+        sl = self._slices(key, flat.size)
+        if sl is None:
+            self._srv(key).push(key, flat)
+            return
+        futs = [self._pool.submit(conn.push, dk, flat[start:stop])
+                for conn, dk, start, stop in sl]
+        for f in futs:
+            f.result()
+
+    def push_compressed(self, key, payload):
+        if self._slices(key, self._sizes.get(key, 0)) is not None:
+            raise MXNetError(
+                "gradient compression cannot be combined with "
+                f"multi-server big-array sharding (key {key}, "
+                f"{self._sizes[key]} elements >= bound {self._big}); "
+                "raise MXNET_KVSTORE_BIGARRAY_BOUND or use one server")
+        self._srv(key).push_compressed(key, payload)
+
+    def pull(self, key, shape):
+        n = int(np.prod(shape)) if shape else 1
+        sl = self._slices(key, n)
+        if sl is None:
+            return self._srv(key).pull(key, shape)
+        out = np.empty(n, np.float32)
+
+        def one(conn, dk, start, stop):
+            out[start:stop] = conn.pull(dk, (stop - start,))
+
+        futs = [self._pool.submit(one, *args) for args in sl]
+        for f in futs:
+            f.result()
+        return out.reshape(shape)
+
+    def barrier(self):
+        self._conns[0].barrier()
+
+    def command(self, cmd, body=b""):
+        for c in self._conns:
+            c.command(cmd, body)
+
+    def set_sync_mode(self, sync):
+        self.command(CMD_SYNC_MODE, b"\x01" if sync else b"\x00")
+
+    def send_optimizer(self, optimizer):
+        self.command(CMD_SET_OPTIMIZER, pickle.dumps(optimizer))
+
+    def stop_server(self):
+        self.command(CMD_STOP)
+
+    def close(self):
+        for c in self._conns:
+            c.close()
+        self._conns = []
+        self._pool.shutdown(wait=False)
+
+
+def connect_workers():
+    """Factory: one server -> plain connection; several -> sharded."""
+    if num_servers_env() > 1:
+        return ShardedConnection()
+    return WorkerConnection()
+
+
 def run_server(port=None, num_workers=None, poll_ms=200):
     """Server process main loop (ref: python/mxnet/kvstore_server.py).
 
@@ -134,6 +295,7 @@ def run_server(port=None, num_workers=None, poll_ms=200):
     lib = _native.load_comm()
     if port is None:
         _, port = server_address()
+        port += int(os.environ.get("DMLC_SERVER_ID", "0"))
     if num_workers is None:
         num_workers = num_workers_env()
     rc = lib.mxtpu_server_start(int(port), int(num_workers))

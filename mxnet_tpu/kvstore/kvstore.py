@@ -34,7 +34,7 @@ class KVStore:
             from . import dist
             if dist.role() == "worker" and \
                     os.environ.get("DMLC_PS_ROOT_URI"):
-                self._conn = dist.WorkerConnection()
+                self._conn = dist.connect_workers()
                 sync = "async" not in kv_type
                 if self._conn.rank == 0:
                     self._conn.set_sync_mode(sync)

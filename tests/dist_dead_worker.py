@@ -1,0 +1,48 @@
+"""Dead-worker detection: one worker dies mid-round; the survivors'
+queued pulls must FAIL FAST with a clear error instead of hanging
+forever (VERDICT r2 weak #5; ref: ps-lite dead-node detection used at
+kvstore_dist.h:118-123). Run via tools/launch.py -n 4.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.kvstore import dist
+
+
+def main():
+    rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+    os.environ["MXNET_KVSTORE_REQUEST_TIMEOUT_MS"] = "30000"
+    conn = dist.WorkerConnection()
+    if conn.rank == 0:
+        conn.set_sync_mode(True)
+    conn.barrier()
+    if conn.rank == 0:
+        conn.init(0, np.zeros(8, np.float32))
+    conn.barrier()
+
+    if conn.rank == 3:
+        # die abruptly without pushing — the other three will be queued
+        # on the incomplete round
+        os._exit(0)
+
+    conn.push(0, np.ones(8, np.float32))
+    t0 = time.monotonic()
+    try:
+        conn.pull(0, (8,))
+    except MXNetError as e:
+        dt = time.monotonic() - t0
+        assert dt < 20, f"took {dt:.1f}s — should fail fast, not by timeout"
+        print(f"[worker {rank}] DEGRADED OK ({dt:.2f}s): {e}", flush=True)
+        return
+    raise AssertionError("pull succeeded despite a dead worker")
+
+
+if __name__ == "__main__":
+    main()

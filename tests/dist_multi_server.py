@@ -1,0 +1,53 @@
+"""Multi-server key sharding: small keys round-robin across servers,
+big arrays split into per-server slices (ref: kvstore_dist.h:532
+EncodeDefaultKey + MXNET_KVSTORE_BIGARRAY_BOUND). Run via
+tools/launch.py -n 2 -s 2.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from mxnet_tpu.kvstore import dist
+
+
+def main():
+    rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+    os.environ["MXNET_KVSTORE_BIGARRAY_BOUND"] = "1024"  # elements
+    conn = dist.connect_workers()
+    assert isinstance(conn, dist.ShardedConnection), type(conn)
+    assert conn.num_servers == 2
+    conn.set_sync_mode(True)
+    conn.barrier()
+
+    # small keys land on different servers (key % S) and still work
+    if conn.rank == 0:
+        conn.init(0, np.zeros(4, np.float32))
+        conn.init(1, np.zeros(4, np.float32))
+        # big array: 4096 elements > 1024 bound -> 2 slices
+        conn.init(2, np.zeros(4096, np.float32))
+    conn.barrier()
+
+    conn.push(0, np.full(4, 1.0 + conn.rank, np.float32))
+    conn.push(1, np.full(4, 10.0, np.float32))
+    big = np.arange(4096, dtype=np.float32)
+    conn.push(2, big)
+
+    got0 = conn.pull(0, (4,))
+    got1 = conn.pull(1, (4,))
+    got2 = conn.pull(2, (4096,))
+    np.testing.assert_allclose(got0, np.full(4, 3.0))   # 1 + 2
+    np.testing.assert_allclose(got1, np.full(4, 20.0))  # 10 * 2
+    np.testing.assert_allclose(got2, big * 2)
+    conn.barrier()
+    if conn.rank == 0:
+        conn.stop_server()
+    conn.close()
+    print(f"[worker {rank}] SHARDED OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

