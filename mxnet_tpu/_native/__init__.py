@@ -72,6 +72,11 @@ def load_comm():
     lib.mxtpu_client_pull.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
                                       fptr, ctypes.c_uint64]
     lib.mxtpu_client_pull.restype = ctypes.c_int
+    lib.mxtpu_client_pull_rows.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_uint64, ctypes.c_uint64,
+        fptr]
+    lib.mxtpu_client_pull_rows.restype = ctypes.c_long
     lib.mxtpu_client_barrier.argtypes = [ctypes.c_void_p]
     lib.mxtpu_client_barrier.restype = ctypes.c_int
     lib.mxtpu_client_command.argtypes = [ctypes.c_void_p, ctypes.c_uint32,

@@ -46,7 +46,7 @@ struct Header {
 } __attribute__((packed));
 
 constexpr uint8_t kInit = 1, kPush = 2, kPull = 3, kBarrier = 4,
-                  kCommand = 5, kPush2Bit = 6;
+                  kCommand = 5, kPush2Bit = 6, kPullRows = 7;
 
 bool read_full(int fd, void* buf, size_t n) {
   char* p = static_cast<char*>(buf);
@@ -92,7 +92,36 @@ struct KeyState {
   std::vector<float> merge;
   int pushed = 0;              // workers reported this round
   std::vector<int> pending_pulls;  // fds waiting for round completion
+  // row-granular pulls queued on the in-flight round: fd + request body
+  std::vector<std::pair<int, std::vector<char>>> pending_row_pulls;
 };
+
+// answer one row-granular pull from the committed store; ok=0 when the
+// key is uninitialized or any row id is out of range (silent zeros
+// would read as valid embeddings)
+void answer_row_pull(const KeyState& ks, int fd,
+                     const std::vector<char>& body) {
+  uint64_t row_len = 0;
+  if (body.size() >= 8) std::memcpy(&row_len, body.data(), 8);
+  uint64_t n_rows = row_len ? (body.size() - 8) / 4 : 0;
+  if (row_len == 0 || ks.store.empty()) {
+    send_response(fd, 0, nullptr, 0);
+    return;
+  }
+  const int32_t* ids = reinterpret_cast<const int32_t*>(body.data() + 8);
+  std::vector<float> out(n_rows * row_len);
+  for (uint64_t r = 0; r < n_rows; ++r) {
+    if (ids[r] < 0 ||
+        (static_cast<uint64_t>(ids[r]) + 1) * row_len > ks.store.size()) {
+      send_response(fd, 0, nullptr, 0);
+      return;
+    }
+    std::memcpy(out.data() + r * row_len,
+                ks.store.data() + static_cast<uint64_t>(ids[r]) * row_len,
+                row_len * 4);
+  }
+  send_response(fd, 1, out.data(), out.size() * 4);
+}
 
 struct Server {
   int listen_fd = -1;
@@ -163,6 +192,10 @@ void apply_round(Server* s, uint32_t key, KeyState* ks) {
     send_response(fd, 1, ks->store.data(), ks->store.size() * 4);
   }
   ks->pending_pulls.clear();
+  for (auto& rp : ks->pending_row_pulls) {
+    answer_row_pull(*ks, rp.first, rp.second);
+  }
+  ks->pending_row_pulls.clear();
 }
 
 void handle_push(Server* s, int fd, uint32_t key, const char* payload,
@@ -215,6 +248,9 @@ void mark_degraded_locked(Server* s) {
     for (int pfd : kv.second.pending_pulls)
       send_response(pfd, 0, nullptr, 0);
     kv.second.pending_pulls.clear();
+    for (auto& rp : kv.second.pending_row_pulls)
+      send_response(rp.first, 0, nullptr, 0);
+    kv.second.pending_row_pulls.clear();
   }
   for (int bfd : s->barrier_fds) send_response(bfd, 0, nullptr, 0);
   s->barrier_fds.clear();
@@ -312,6 +348,26 @@ void handle_conn(Server* s, int fd) {
         std::vector<float> snapshot = ks.store;
         lk.unlock();
         send_response(fd, 1, snapshot.data(), snapshot.size() * 4);
+      }
+    } else if (h.op == kPullRows) {
+      // row-granular sparse pull (ref: kvstore_dist.h:470 PullRowSparse):
+      // payload = u64 row_len | i32 row_ids...; response = rows matrix
+      std::unique_lock<std::mutex> lk(s->mu);
+      if (s->sync_mode && sync_unhealthy_locked(s)) {
+        lk.unlock();
+        send_response(fd, 0, nullptr, 0);
+        continue;
+      }
+      KeyState& ks = s->keys[h.key];
+      if (s->sync_mode && ks.pushed > 0) {
+        // round in flight: queue like kPull so every worker sees the
+        // same post-round rows
+        ks.pending_row_pulls.emplace_back(fd, payload);
+        lk.unlock();
+      } else {
+        KeyState snapshot = ks;
+        lk.unlock();
+        answer_row_pull(snapshot, fd, payload);
       }
     } else if (h.op == kBarrier) {
       std::unique_lock<std::mutex> lk(s->mu);
@@ -561,6 +617,21 @@ int mxtpu_client_pull(void* h, uint32_t key, float* out, uint64_t n) {
                    n * 4, &got);
   if (rc != 0) return rc;
   return static_cast<int>(got / 4);
+}
+
+// row-granular sparse pull: out must hold n_rows*row_len floats;
+// returns number of floats received or <0 on error
+long mxtpu_client_pull_rows(void* h, uint32_t key, const int32_t* row_ids,
+                            uint64_t n_rows, uint64_t row_len,
+                            float* out) {
+  std::vector<char> body(8 + n_rows * 4);
+  std::memcpy(body.data(), &row_len, 8);
+  std::memcpy(body.data() + 8, row_ids, n_rows * 4);
+  uint64_t got = 0;
+  int rc = request(static_cast<Client*>(h), kPullRows, key, body.data(),
+                   body.size(), out, n_rows * row_len * 4, &got);
+  if (rc != 0) return rc;
+  return static_cast<long>(got / 4);
 }
 
 int mxtpu_client_barrier(void* h) {

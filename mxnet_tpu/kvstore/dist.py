@@ -131,6 +131,24 @@ class WorkerConnection:
                 f"want {n} (was the key initialized?)")
         return out.reshape(shape)
 
+    def pull_rows(self, key, row_ids, row_len, total_elems=None):
+        """Row-granular sparse pull: only the requested rows cross the
+        wire (ref: kvstore_dist.h:470 PullRowSparse). ``total_elems``
+        is accepted for signature parity with ShardedConnection."""
+        ids = np.ascontiguousarray(row_ids, dtype=np.int32)
+        out = np.empty((ids.size, int(row_len)), np.float32)
+        got = self._lib.mxtpu_client_pull_rows(
+            self._h, key,
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ids.size, int(row_len), self._fptr(out))
+        if got < 0:
+            raise MXNetError(f"dist pull_rows failed for key {key}: "
+                             f"{self._explain(got)}")
+        if got != out.size:
+            raise MXNetError(
+                f"dist pull_rows size mismatch for key {key}")
+        return out
+
     def barrier(self):
         rc = self._lib.mxtpu_client_barrier(self._h)
         if rc != 0:
@@ -254,6 +272,18 @@ class ShardedConnection:
         for f in futs:
             f.result()
         return out.reshape(shape)
+
+    def pull_rows(self, key, row_ids, row_len, total_elems=None):
+        # decide sharding from the caller-supplied size — _sizes is
+        # only populated on the rank that called init()
+        n = total_elems if total_elems is not None \
+            else self._sizes.get(key, 0)
+        if self._slices(key, n) is not None:
+            # sliced keys: rows straddle server boundaries — pull full
+            # and select (row-granularity is a single-server feature)
+            full = self.pull(key, (n // int(row_len), int(row_len)))
+            return full[np.asarray(row_ids, np.int32)]
+        return self._srv(key).pull_rows(key, row_ids, row_len)
 
     def barrier(self):
         self._conns[0].barrier()

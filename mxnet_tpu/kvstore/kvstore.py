@@ -129,19 +129,31 @@ class KVStore:
         keys, outs = self._normalize(key, out)
         rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
         for k, o, rid in zip(keys, outs, rids):
-            if self._conn is not None:
-                # refresh the local snapshot from the server before
-                # retaining rows (ref: kvstore_dist.h:470 PullRowSparse —
-                # row-granular wire pulls are a later optimization)
-                val = self._conn.pull(self._key_index(k),
-                                      self._store[k].shape)
-                self._store[k]._data = jnp.asarray(
-                    val, dtype=self._store[k]._data.dtype)
-            stored = self._store[k]
             from ..ndarray.sparse import row_sparse_array
-            rsp = stored if isinstance(stored, RowSparseNDArray) \
-                else row_sparse_array(stored)
-            result = rsp.retain(rid)
+            if self._conn is not None:
+                # row-granular wire pull: only the requested rows cross
+                # the network (ref: kvstore_dist.h:470 PullRowSparse)
+                import numpy as np
+                stored = self._store[k]
+                shape = stored.shape
+                row_len = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+                ids = (rid.asnumpy() if isinstance(rid, NDArray)
+                       else np.asarray(rid)).astype(np.int32).ravel()
+                rows = self._conn.pull_rows(
+                    self._key_index(k), ids, row_len,
+                    total_elems=int(np.prod(shape)))
+                rows = rows.reshape((ids.size,) + tuple(shape[1:]))
+                result = RowSparseNDArray(
+                    # wire is fp32; keep the stored dtype so
+                    # mixed-precision params don't silently widen
+                    NDArray(jnp.asarray(rows,
+                                        dtype=stored._data.dtype)),
+                    NDArray(jnp.asarray(ids)), shape)
+            else:
+                stored = self._store[k]
+                rsp = stored if isinstance(stored, RowSparseNDArray) \
+                    else row_sparse_array(stored)
+                result = rsp.retain(rid)
             targets = o if isinstance(o, (list, tuple)) else [o]
             for t in targets:
                 if isinstance(t, RowSparseNDArray):

@@ -30,8 +30,12 @@ class RowSparseNDArray:
 
     def __init__(self, data, indices, shape):
         self.data = data if isinstance(data, NDArray) else array(data)
-        self.indices = (indices if isinstance(indices, NDArray)
-                        else array(np.asarray(indices, dtype=np.int64).astype(np.int32)))
+        if not isinstance(indices, NDArray):
+            indices = array(np.asarray(indices, np.int64)
+                            .astype(np.int32))
+        if indices._data.dtype not in (jnp.int32, jnp.int64):
+            indices = NDArray(indices._data.astype(jnp.int32))
+        self.indices = indices
         self._shape = tuple(shape)
 
     @property

@@ -171,12 +171,45 @@ class SGD(Optimizer):
         mom = mu * mom - lr * g
         return w + mom, mom
 
+    @staticmethod
+    @jax.jit
+    def _step_rows(w, g, rows, lr, wd, rescale, clip, has_clip):
+        """Row-sparse lazy update: touch only the gradient's rows
+        (ref: src/operator/optimizer_op.cc:32 sgd_update rsp kernel —
+        scatter on HBM instead of a full-matrix write)."""
+        g = g * rescale
+        g = jnp.where(has_clip, jnp.clip(g, -clip, clip), g)
+        g = g + wd * w[rows]
+        return w.at[rows].add(-lr * g)
+
+    @staticmethod
+    @jax.jit
+    def _step_mom_rows(w, g, mom, rows, lr, wd, mu, rescale, clip,
+                       has_clip):
+        g = g * rescale
+        g = jnp.where(has_clip, jnp.clip(g, -clip, clip), g)
+        g = g + wd * w[rows]
+        new_mom_rows = mu * mom[rows] - lr * g
+        mom = mom.at[rows].set(new_mom_rows)
+        return w.at[rows].add(new_mom_rows), mom
+
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
-        grad = self._sparse_to_dense(grad, weight)
         clip = self.clip_gradient if self.clip_gradient is not None else 1.0
         has_clip = self.clip_gradient is not None
+        if isinstance(grad, RowSparseNDArray) and self.lazy_update:
+            rows = grad.indices._data
+            if state is None:
+                weight._data = SGD._step_rows(
+                    weight._data, grad.data._data, rows, lr, wd,
+                    self.rescale_grad, clip, has_clip)
+            else:
+                weight._data, state._data = SGD._step_mom_rows(
+                    weight._data, grad.data._data, state._data, rows, lr,
+                    wd, self.momentum, self.rescale_grad, clip, has_clip)
+            return
+        grad = self._sparse_to_dense(grad, weight)
         if state is None:
             weight._data = SGD._step(weight._data, grad._data, lr, wd,
                                      self.rescale_grad, clip, has_clip)
@@ -271,6 +304,20 @@ class AdaGrad(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
+        if isinstance(grad, RowSparseNDArray):
+            # row-sparse AdaGrad: only the touched rows accumulate
+            # history (ref: optimizer_op.cc adagrad rsp kernel — the
+            # wide_deep path's standard optimizer)
+            rows = grad.indices._data
+            g = grad.data._data * self.rescale_grad
+            if self.clip_gradient is not None:
+                g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+            g = g + wd * weight._data[rows]
+            hist_rows = state._data[rows] + g * g
+            state._data = state._data.at[rows].set(hist_rows)
+            weight._data = weight._data.at[rows].add(
+                -lr * g / (jnp.sqrt(hist_rows) + self.float_stable_eps))
+            return
         g = self._preprocess(weight, grad, wd)
         state._data = state._data + g * g
         weight._data = weight._data - lr * g / (

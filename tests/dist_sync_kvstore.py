@@ -95,6 +95,22 @@ def main():
                                np.full(shape, -0.1 * nw), rtol=1e-5)
     kv.barrier()
 
+    # --- row-granular sparse pulls (ref: kvstore_dist.h:470) -----------
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+    emb_key = 11
+    emb = np.arange(24, dtype=np.float32).reshape(8, 3)
+    kv.init(emb_key, mx.nd.array(emb))
+    kv.barrier()
+    rows = mx.nd.array(np.array([1, 5, 6], np.float32))
+    out_rsp = RowSparseNDArray(mx.nd.zeros((3, 3)),
+                               mx.nd.array(np.zeros(3, np.float32)),
+                               (8, 3))
+    kv.row_sparse_pull(emb_key, out=out_rsp, row_ids=rows)
+    np.testing.assert_allclose(out_rsp.data.asnumpy(), emb[[1, 5, 6]],
+                               rtol=1e-6)
+    np.testing.assert_array_equal(out_rsp.indices.asnumpy(), [1, 5, 6])
+    kv.barrier()
+
     print(f"[worker {rank}] OK", flush=True)
     kv.close()
 

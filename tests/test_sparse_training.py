@@ -1,0 +1,137 @@
+"""Sparse training tests (VERDICT r2 #9 / BASELINE config 5;
+ref: src/operator/optimizer_op.cc:32-41 rsp kernels,
+example/sparse/wide_deep, tests/python/unittest/test_optimizer.py
+sparse sections).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+
+def _rsp(rows, vals, shape):
+    return RowSparseNDArray(nd.array(np.asarray(vals, np.float32)),
+                            nd.array(np.asarray(rows, np.float32)),
+                            shape)
+
+
+def test_sgd_row_sparse_matches_dense():
+    rng = np.random.default_rng(0)
+    w0 = rng.normal(size=(6, 4)).astype(np.float32)
+    gval = rng.normal(size=(2, 4)).astype(np.float32)
+    rows = [1, 4]
+
+    opt_s = mx.optimizer.SGD(learning_rate=0.1, wd=0.0)
+    w_s = nd.array(w0)
+    opt_s.update(0, w_s, _rsp(rows, gval, (6, 4)),
+                 opt_s.create_state(0, w_s))
+
+    dense = np.zeros((6, 4), np.float32)
+    dense[rows] = gval
+    opt_d = mx.optimizer.SGD(learning_rate=0.1, wd=0.0)
+    w_d = nd.array(w0)
+    opt_d.update(0, w_d, nd.array(dense), opt_d.create_state(0, w_d))
+
+    np.testing.assert_allclose(w_s.asnumpy(), w_d.asnumpy(), rtol=1e-6)
+    # untouched rows bit-identical to the original
+    np.testing.assert_array_equal(w_s.asnumpy()[[0, 2, 3, 5]],
+                                  w0[[0, 2, 3, 5]])
+
+
+def test_sgd_momentum_lazy_update_only_touches_rows():
+    """lazy_update: momentum decays ONLY on rows present in the grad
+    (the reference's lazy rsp semantics) — differs from dense."""
+    w0 = np.ones((4, 2), np.float32)
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.0,
+                           lazy_update=True)
+    w = nd.array(w0)
+    st = opt.create_state(0, w)
+    opt.update(0, w, _rsp([0], [[1.0, 1.0]], (4, 2)), st)
+    opt.update(0, w, _rsp([1], [[1.0, 1.0]], (4, 2)), st)
+    # row 0 momentum was NOT decayed by the second (row-1) update
+    np.testing.assert_allclose(st.asnumpy()[0], [-0.1, -0.1], rtol=1e-6)
+    np.testing.assert_allclose(st.asnumpy()[1], [-0.1, -0.1], rtol=1e-6)
+    np.testing.assert_allclose(w.asnumpy()[2:], 1.0)
+
+
+def test_adagrad_row_sparse_matches_dense_on_touched_rows():
+    rng = np.random.default_rng(1)
+    w0 = rng.normal(size=(5, 3)).astype(np.float32)
+    gval = rng.normal(size=(2, 3)).astype(np.float32)
+    rows = [0, 3]
+
+    opt_s = mx.optimizer.AdaGrad(learning_rate=0.5, wd=0.0)
+    w_s = nd.array(w0)
+    st_s = opt_s.create_state(0, w_s)
+    for _ in range(3):
+        opt_s.update(0, w_s, _rsp(rows, gval, (5, 3)), st_s)
+
+    dense = np.zeros((5, 3), np.float32)
+    dense[rows] = gval
+    opt_d = mx.optimizer.AdaGrad(learning_rate=0.5, wd=0.0)
+    w_d = nd.array(w0)
+    st_d = opt_d.create_state(0, w_d)
+    for _ in range(3):
+        opt_d.update(0, w_d, nd.array(dense), st_d)
+
+    np.testing.assert_allclose(w_s.asnumpy()[rows], w_d.asnumpy()[rows],
+                               rtol=1e-5)
+    np.testing.assert_array_equal(w_s.asnumpy()[[1, 2, 4]],
+                                  w0[[1, 2, 4]])
+
+
+def test_wide_deep_style_sparse_convergence():
+    """wide_deep-style model: sparse categorical embedding + dense MLP.
+
+    The embedding table trains through row_sparse grads and the sparse
+    AdaGrad path; rows never referenced by the data must stay at their
+    initial values (the whole point of sparse training).
+    """
+    rng = np.random.default_rng(2)
+    vocab, dim, n = 50, 4, 256
+    # only even ids occur in the data
+    ids = rng.choice(np.arange(0, vocab, 2), size=(n,))
+    dense_x = rng.normal(size=(n, 3)).astype(np.float32)
+    w_true = rng.normal(size=(3,)).astype(np.float32)
+    emb_true = rng.normal(size=(vocab,)).astype(np.float32)
+    y = (emb_true[ids] + dense_x @ w_true).astype(np.float32)
+
+    table = nd.array(rng.normal(size=(vocab, dim)).astype(np.float32)
+                     * 0.1)
+    table0 = table.asnumpy().copy()
+    out_w = nd.array(rng.normal(size=(dim + 3,)).astype(np.float32)
+                     * 0.1)
+
+    opt = mx.optimizer.AdaGrad(learning_rate=0.5, wd=0.0)
+    st_table = opt.create_state(0, table)
+    st_out = opt.create_state(1, out_w)
+
+    import jax
+    import jax.numpy as jnp
+
+    def loss_fn(tbl, ow, batch_ids, bx, by):
+        e = tbl[batch_ids]                      # (B, dim) gather
+        feat = jnp.concatenate([e, bx], axis=1)
+        pred = feat @ ow
+        return jnp.mean((pred - by) ** 2)
+
+    grad_fn = jax.jit(jax.grad(loss_fn, argnums=(0, 1)))
+
+    losses = []
+    for step in range(60):
+        sel = rng.integers(0, n, size=64)
+        bids, bx, by = ids[sel], dense_x[sel], y[sel]
+        g_tbl, g_ow = grad_fn(table._data, out_w._data, bids, bx, by)
+        # sparse gradient: only the batch's unique rows
+        urows = np.unique(bids)
+        g_rows = np.asarray(g_tbl)[urows]
+        opt.update(0, table, _rsp(urows, g_rows, (vocab, dim)), st_table)
+        opt.update(1, out_w, mx.NDArray(g_ow), st_out)
+        losses.append(float(loss_fn(table._data, out_w._data, bids, bx,
+                                    by)))
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+    # odd-id rows never appeared -> untouched
+    odd = np.arange(1, vocab, 2)
+    np.testing.assert_array_equal(table.asnumpy()[odd], table0[odd])
