@@ -54,6 +54,7 @@ from . import profiler  # noqa: E402
 from . import contrib  # noqa: E402
 from . import gluon  # noqa: E402
 from . import operator  # noqa: E402
+from . import image  # noqa: E402
 from . import monitor  # noqa: E402
 from .monitor import Monitor  # noqa: E402
 from . import visualization  # noqa: E402

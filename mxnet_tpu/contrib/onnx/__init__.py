@@ -1,0 +1,5 @@
+"""ONNX export/import (ref: python/mxnet/contrib/onnx/ — mx2onnx
+export_model and onnx2mx import_model over per-op translation tables).
+"""
+from .export_model import export_model
+from .import_model import import_model, import_to_gluon

@@ -1,0 +1,281 @@
+"""Symbol -> ONNX export
+(ref: python/mxnet/contrib/onnx/mx2onnx/export_model.py + the per-op
+convert functions in _op_translations.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from ...ndarray import NDArray
+from ...symbol.symbol import is_aux_name
+from . import proto as P
+
+# onnx enums
+TF_FLOAT, TF_INT64 = 1, 7
+AT_FLOAT, AT_INT, AT_STRING, AT_TENSOR = 1, 2, 3, 4
+AT_FLOATS, AT_INTS, AT_STRINGS = 6, 7, 8
+OPSET = 13
+
+
+def _attr(name, value):
+    a = bytearray()
+    P.w_bytes(a, 1, name)
+    if isinstance(value, bool):
+        P.w_int(a, 3, int(value))
+        P.w_int(a, 20, AT_INT)
+    elif isinstance(value, int):
+        P.w_int(a, 3, value)
+        P.w_int(a, 20, AT_INT)
+    elif isinstance(value, float):
+        P.w_float(a, 2, value)
+        P.w_int(a, 20, AT_FLOAT)
+    elif isinstance(value, str):
+        P.w_bytes(a, 4, value)
+        P.w_int(a, 20, AT_STRING)
+    elif isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], float):
+            P.w_packed_floats(a, 7, list(value))
+            P.w_int(a, 20, AT_FLOATS)
+        else:
+            P.w_packed_ints(a, 8, [int(v) for v in value])
+            P.w_int(a, 20, AT_INTS)
+    else:
+        raise MXNetError(f"unsupported attribute value {value!r}")
+    return bytes(a)
+
+
+def _node(op_type, inputs, outputs, name, attrs=None):
+    n = bytearray()
+    for i in inputs:
+        P.w_bytes(n, 1, i)
+    for o in outputs:
+        P.w_bytes(n, 2, o)
+    P.w_bytes(n, 3, name)
+    P.w_bytes(n, 4, op_type)
+    for k, v in (attrs or {}).items():
+        P.w_msg(n, 5, _attr(k, v))
+    return bytes(n)
+
+
+def _tensor(name, arr):
+    arr = np.ascontiguousarray(arr)
+    t = bytearray()
+    P.w_packed_ints(t, 1, arr.shape)
+    if arr.dtype == np.int64 or arr.dtype == np.int32:
+        P.w_int(t, 2, TF_INT64)
+        arr = arr.astype(np.int64)
+    else:
+        P.w_int(t, 2, TF_FLOAT)
+        arr = arr.astype(np.float32)
+    P.w_bytes(t, 8, name)
+    P.w_bytes(t, 9, arr.tobytes())
+    return bytes(t)
+
+
+def _value_info(name, shape, elem_type=TF_FLOAT):
+    tt = bytearray()
+    P.w_int(tt, 1, elem_type)
+    if shape:  # omit the shape field entirely when unknown — an empty
+        # TensorShapeProto would declare a rank-0 scalar
+        sh = bytearray()
+        for d in shape:
+            dim = bytearray()
+            P.w_int(dim, 1, int(d))
+            P.w_msg(sh, 1, dim)
+        P.w_msg(tt, 2, sh)
+    tp = bytearray()
+    P.w_msg(tp, 1, tt)
+    vi = bytearray()
+    P.w_bytes(vi, 1, name)
+    P.w_msg(vi, 2, tp)
+    return bytes(vi)
+
+
+def _pads(pad):
+    p = tuple(pad) if pad else (0, 0)
+    return list(p) + list(p)  # begin then end, symmetric
+
+
+class _Ctx:
+    def __init__(self):
+        self.nodes = []
+        self.counter = 0
+
+    def emit(self, op_type, inputs, outputs, name=None, attrs=None):
+        self.counter += 1
+        self.nodes.append(_node(op_type, inputs, outputs,
+                                name or f"{op_type}_{self.counter}",
+                                attrs))
+
+    def tmp(self, hint):
+        self.counter += 1
+        return f"_{hint}{self.counter}"
+
+
+def _conv(ctx, node, ins, out, a):
+    attrs = {"kernel_shape": a.get("kernel", (1, 1)),
+             "strides": a.get("stride", (1, 1)) or (1, 1),
+             "dilations": a.get("dilate", (1, 1)) or (1, 1),
+             "pads": _pads(a.get("pad")),
+             "group": int(a.get("num_group", 1))}
+    ctx.emit("Conv", ins, [out], node.name, attrs)
+
+
+def _fc(ctx, node, ins, out, a):
+    flat = ctx.tmp("flat")
+    ctx.emit("Flatten", [ins[0]], [flat], attrs={"axis": 1})
+    ctx.emit("Gemm", [flat] + ins[1:], [out], node.name,
+             {"alpha": 1.0, "beta": 1.0, "transA": 0, "transB": 1})
+
+
+def _bn(ctx, node, ins, out, a):
+    ctx.emit("BatchNormalization", ins, [out], node.name,
+             {"epsilon": float(a.get("eps", 1e-3)),
+              "momentum": float(a.get("momentum", 0.9))})
+
+
+def _act(ctx, node, ins, out, a):
+    m = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+         "softrelu": "Softplus", "softsign": "Softsign"}
+    t = a.get("act_type", "relu")
+    if t not in m:
+        raise MXNetError(f"cannot export activation {t}")
+    ctx.emit(m[t], ins, [out], node.name)
+
+
+def _pool(ctx, node, ins, out, a):
+    ptype = a.get("pool_type", "max")
+    if a.get("global_pool"):
+        ctx.emit("GlobalMaxPool" if ptype == "max"
+                 else "GlobalAveragePool", ins, [out], node.name)
+        return
+    attrs = {"kernel_shape": a.get("kernel", (1, 1)),
+             "strides": a.get("stride") or (1, 1),
+             "pads": _pads(a.get("pad"))}
+    if ptype == "avg":
+        attrs["count_include_pad"] = int(
+            a.get("count_include_pad", True))
+    ctx.emit("MaxPool" if ptype == "max" else "AveragePool",
+             ins, [out], node.name, attrs)
+
+
+def _softmax_output(ctx, node, ins, out, a):
+    # label input is dropped; inference graph exports the softmax only
+    ctx.emit("Softmax", [ins[0]], [out], node.name, {"axis": 1})
+
+
+_EXPORTERS = {
+    "Convolution": _conv,
+    "FullyConnected": _fc,
+    "BatchNorm": _bn,
+    "Activation": _act,
+    "Pooling": _pool,
+    "SoftmaxOutput": _softmax_output,
+    "softmax": lambda c, n, i, o, a: c.emit(
+        "Softmax", i, [o], n.name, {"axis": int(a.get("axis", -1))}),
+    "Flatten": lambda c, n, i, o, a: c.emit(
+        "Flatten", i, [o], n.name, {"axis": 1}),
+    "elemwise_add": lambda c, n, i, o, a: c.emit("Add", i, [o], n.name),
+    "_plus": lambda c, n, i, o, a: c.emit("Add", i, [o], n.name),
+    "broadcast_add": lambda c, n, i, o, a: c.emit("Add", i, [o], n.name),
+    "elemwise_mul": lambda c, n, i, o, a: c.emit("Mul", i, [o], n.name),
+    "broadcast_mul": lambda c, n, i, o, a: c.emit("Mul", i, [o], n.name),
+    "elemwise_sub": lambda c, n, i, o, a: c.emit("Sub", i, [o], n.name),
+    "Concat": lambda c, n, i, o, a: c.emit(
+        "Concat", i, [o], n.name, {"axis": int(a.get("dim", 1))}),
+    "Dropout": lambda c, n, i, o, a: c.emit(
+        "Identity", i, [o], n.name),  # inference export
+    "LeakyReLU": lambda c, n, i, o, a: c.emit(
+        "LeakyRelu", i, [o], n.name,
+        {"alpha": float(a.get("slope", 0.25))}),
+    "transpose": lambda c, n, i, o, a: c.emit(
+        "Transpose", i, [o], n.name,
+        {"perm": list(a.get("axes", ()))}),
+    "relu": lambda c, n, i, o, a: c.emit("Relu", i, [o], n.name),
+    "sigmoid": lambda c, n, i, o, a: c.emit("Sigmoid", i, [o], n.name),
+    "tanh": lambda c, n, i, o, a: c.emit("Tanh", i, [o], n.name),
+}
+
+
+def export_model(sym, params, input_shapes, input_types=None,
+                 onnx_file_path="model.onnx", verbose=False):
+    """Export a symbol + params dict to an ONNX file
+    (ref: mx2onnx/export_model.py export_model).
+
+    ``params`` maps name -> NDArray (both arg and aux); ``input_shapes``
+    is a list of shapes for the graph inputs in list_inputs order
+    (params excluded).
+    """
+    params = {k.split(":", 1)[-1]: v for k, v in params.items()}
+    ctx = _Ctx()
+    out_names = {}  # (node id, k) -> onnx tensor name
+    graph_inputs = []
+    initializers = []
+
+    data_inputs = [n for n in sym.list_inputs() if n not in params]
+    if len(data_inputs) != len(input_shapes):
+        # drop label inputs not fed at inference
+        data_inputs = [n for n in data_inputs if "label" not in n]
+    if len(data_inputs) != len(input_shapes):
+        raise MXNetError(
+            f"expected shapes for inputs {data_inputs}, got "
+            f"{len(input_shapes)}")
+    for n, s in zip(data_inputs, input_shapes):
+        graph_inputs.append(_value_info(n, s))
+
+    for name, arr in params.items():
+        a = arr.asnumpy() if isinstance(arr, NDArray) else np.asarray(arr)
+        initializers.append(_tensor(name, a))
+
+    label_vars = set()
+    for node in sym._topo():
+        if node.op is None:
+            out_names[(id(node), 0)] = node.name
+            if node.name not in params and "label" in node.name:
+                label_vars.add(node.name)
+            continue
+        ins = [out_names[(id(c), k)] for c, k in node.inputs]
+        ins = [i for i in ins if i not in label_vars]
+        out = node.name + "_out"
+        attrs = {k: v for k, v in node.attrs.items()
+                 if not k.startswith("__")}
+        fn = _EXPORTERS.get(node.op)
+        if fn is None:
+            raise MXNetError(
+                f"op {node.op} has no ONNX exporter "
+                "(contrib.onnx covers the model-zoo op set)")
+        fn(ctx, node, ins, out, attrs)
+        for k in range(8):
+            out_names[(id(node), k)] = out
+
+    outputs = []
+    for n, k in sym._outputs:
+        nm = out_names[(id(n), k)]
+        outputs.append(_value_info(nm, ()))
+
+    g = bytearray()
+    for nd_ in ctx.nodes:
+        P.w_msg(g, 1, nd_)
+    P.w_bytes(g, 2, "mxnet_tpu_graph")
+    for t in initializers:
+        P.w_msg(g, 5, t)
+    for vi in graph_inputs:
+        P.w_msg(g, 11, vi)
+    for vi in outputs:
+        P.w_msg(g, 12, vi)
+
+    opset = bytearray()
+    P.w_bytes(opset, 1, "")
+    P.w_int(opset, 2, OPSET)
+
+    m = bytearray()
+    P.w_int(m, 1, 8)  # ir_version
+    P.w_bytes(m, 2, "mxnet_tpu")
+    P.w_bytes(m, 3, "0.1")
+    P.w_msg(m, 7, g)
+    P.w_msg(m, 8, opset)
+
+    with open(onnx_file_path, "wb") as f:
+        f.write(bytes(m))
+    return onnx_file_path

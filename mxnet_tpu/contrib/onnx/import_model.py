@@ -1,0 +1,252 @@
+"""ONNX -> Symbol import
+(ref: python/mxnet/contrib/onnx/onnx2mx/import_model.py + the
+_op_translations tables).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from ...ndarray import NDArray, array
+from ... import symbol as sym_mod
+from . import proto as P
+
+TF_FLOAT, TF_INT64, TF_INT32 = 1, 7, 6
+
+
+def _read_tensor(buf):
+    dims = []
+    for f, wt, v in P.parse(buf):
+        if f == 1:
+            dims.extend(P.unpack_ints(v) if wt == 2 else [v])
+    dtype = P.first(buf, 2, TF_FLOAT)
+    name = P.first(buf, 8, b"").decode()
+    raw = P.first(buf, 9)
+    if raw is not None:
+        if dtype == TF_FLOAT:
+            a = np.frombuffer(raw, np.float32)
+        elif dtype == TF_INT64:
+            a = np.frombuffer(raw, np.int64)
+        elif dtype == TF_INT32:
+            a = np.frombuffer(raw, np.int32)
+        else:
+            raise MXNetError(f"unsupported tensor dtype {dtype}")
+    else:
+        fd = b"".join(x for f, _w, x in P.parse(buf) if f == 4
+                      and isinstance(x, bytes))
+        if fd:
+            a = np.frombuffer(fd, np.float32)
+        else:
+            i64 = []
+            for f, wt, v in P.parse(buf):
+                if f == 7:
+                    i64.extend(P.unpack_ints(v) if wt == 2 else [v])
+            a = np.asarray(i64, np.int64)
+    return name, a.reshape([int(d) for d in dims])
+
+
+def _read_attrs(node_buf):
+    attrs = {}
+    for f, _w, v in P.parse(node_buf):
+        if f != 5:
+            continue
+        name = P.first(v, 1, b"").decode()
+        at = P.first(v, 20, 0)
+        if at == 1:
+            attrs[name] = P.first(v, 2, 0.0)
+        elif at == 2:
+            attrs[name] = P.signed(P.first(v, 3, 0))
+        elif at == 3:
+            attrs[name] = P.first(v, 4, b"").decode()
+        elif at == 6:
+            floats = []
+            for f2, w2, v2 in P.parse(v):
+                if f2 == 7:
+                    floats.extend(P.unpack_floats(v2)
+                                  if w2 == 2 else [v2])
+            attrs[name] = floats
+        elif at == 7:
+            ints = []
+            for f2, w2, v2 in P.parse(v):
+                if f2 == 8:
+                    ints.extend(P.unpack_ints(v2) if w2 == 2 else [v2])
+            attrs[name] = [P.signed(x) for x in ints]
+        elif at == 4:
+            attrs[name] = _read_tensor(P.first(v, 5))
+    return attrs
+
+
+def _pads_to_mx(pads):
+    if not pads:
+        return (0, 0)
+    half = len(pads) // 2
+    begin, end = pads[:half], pads[half:]
+    if list(begin) != list(end):
+        raise MXNetError(f"asymmetric pads {pads} not supported")
+    return tuple(int(p) for p in begin)
+
+
+def _conv(ins, attrs, params, name, names):
+    if attrs.get("auto_pad", "NOTSET") not in ("", "NOTSET"):
+        raise MXNetError(
+            f"Conv auto_pad={attrs['auto_pad']!r} not supported; "
+            "export with explicit pads")
+    no_bias = len(ins) < 3
+    w = params[names[id(ins[1])]]
+    return sym_mod.Convolution(
+        *ins, name=name, kernel=tuple(attrs.get("kernel_shape", (1, 1))),
+        stride=tuple(attrs.get("strides", (1, 1))),
+        dilate=tuple(attrs.get("dilations", (1, 1))),
+        pad=_pads_to_mx(attrs.get("pads")),
+        num_filter=int(w.shape[0]),
+        num_group=int(attrs.get("group", 1)), no_bias=no_bias)
+
+
+def _gemm(ins, attrs, params, name, names):
+    if attrs.get("transB", 0) != 1 or attrs.get("transA", 0) != 0:
+        raise MXNetError("only Gemm(transA=0, transB=1) imports to "
+                         "FullyConnected")
+    if attrs.get("alpha", 1.0) != 1.0 or attrs.get("beta", 1.0) != 1.0:
+        raise MXNetError(
+            "Gemm with alpha/beta != 1 has no FullyConnected "
+            "equivalent; refusing a silently-wrong import")
+    w = params[names[id(ins[1])]]
+    return sym_mod.FullyConnected(*ins, name=name,
+                                  num_hidden=int(w.shape[0]),
+                                  no_bias=len(ins) < 3)
+
+
+def _pool(op):
+    def make(ins, attrs, params, name, names):
+        kwargs = {"pool_type": "max" if "Max" in op else "avg"}
+        if op.startswith("Global"):
+            kwargs["global_pool"] = True
+            kwargs["kernel"] = (1, 1)
+        else:
+            kwargs["kernel"] = tuple(attrs.get("kernel_shape", (1, 1)))
+            kwargs["stride"] = tuple(attrs.get("strides", (1, 1)))
+            kwargs["pad"] = _pads_to_mx(attrs.get("pads"))
+            if "Average" in op:
+                # ONNX default excludes pad pixels from the average
+                kwargs["count_include_pad"] = bool(
+                    attrs.get("count_include_pad", 0))
+        return sym_mod.Pooling(ins[0], name=name, **kwargs)
+    return make
+
+
+def _act(t):
+    def make(ins, attrs, params, name, names):
+        return sym_mod.Activation(ins[0], act_type=t, name=name)
+    return make
+
+
+_IMPORTERS = {
+    "Conv": _conv,
+    "Gemm": _gemm,
+    "BatchNormalization": lambda i, a, p, n, nm: sym_mod.BatchNorm(
+        *i, name=n, eps=float(a.get("epsilon", 1e-5)),
+        momentum=float(a.get("momentum", 0.9))),
+    "Relu": _act("relu"),
+    "Sigmoid": _act("sigmoid"),
+    "Tanh": _act("tanh"),
+    "Softplus": _act("softrelu"),
+    "MaxPool": _pool("MaxPool"),
+    "AveragePool": _pool("AveragePool"),
+    "GlobalMaxPool": _pool("GlobalMaxPool"),
+    "GlobalAveragePool": _pool("GlobalAveragePool"),
+    "Flatten": lambda i, a, p, n, nm: sym_mod.Flatten(i[0], name=n),
+    "Softmax": lambda i, a, p, n, nm: sym_mod.softmax(
+        i[0], axis=int(a.get("axis", -1)), name=n),
+    "Add": lambda i, a, p, n, nm: sym_mod.broadcast_add(*i, name=n),
+    "Mul": lambda i, a, p, n, nm: sym_mod.broadcast_mul(*i, name=n),
+    "Sub": lambda i, a, p, n, nm: sym_mod.broadcast_sub(*i, name=n),
+    "Concat": lambda i, a, p, n, nm: sym_mod.Concat(
+        *i, dim=int(a.get("axis", 1)), name=n),
+    "Identity": lambda i, a, p, n, nm: i[0],
+    "Dropout": lambda i, a, p, n, nm: i[0],  # inference import
+    "LeakyRelu": lambda i, a, p, n, nm: sym_mod.LeakyReLU(
+        i[0], slope=float(a.get("alpha", 0.01)), name=n),
+    "Transpose": lambda i, a, p, n, nm: sym_mod.transpose(
+        i[0], axes=tuple(a.get("perm", ())), name=n),
+    "Reshape": lambda i, a, p, n, nm: sym_mod.Reshape(
+        i[0], shape=tuple(int(x) for x in
+                          p[nm[id(i[1])]].ravel()), name=n),
+}
+
+def import_model(onnx_file):
+    """-> (sym, arg_params, aux_params)
+    (ref: onnx2mx/import_model.py import_model)."""
+    with open(onnx_file, "rb") as f:
+        model = f.read()
+    graph = P.first(model, 7)
+    if graph is None:
+        raise MXNetError(f"{onnx_file}: no graph in model")
+
+    params = {}
+    for t in P.fields(graph, 5):
+        name, arr = _read_tensor(t)
+        params[name] = arr
+
+    env = {}
+    name_map = {}  # id(Symbol) -> onnx tensor name, per-call state
+
+    def get(name):
+        if name not in env:
+            v = sym_mod.var(name)
+            env[name] = v
+            name_map[id(v)] = name
+        return env[name]
+
+    last = None
+    for nbuf in P.fields(graph, 1):
+        ins_names = [v.decode() for f, _w, v in P.parse(nbuf) if f == 1]
+        out_names = [v.decode() for f, _w, v in P.parse(nbuf) if f == 2]
+        op_type = P.first(nbuf, 4, b"").decode()
+        name = P.first(nbuf, 3, b"").decode() or None
+        attrs = _read_attrs(nbuf)
+        fn = _IMPORTERS.get(op_type)
+        if fn is None:
+            raise MXNetError(
+                f"ONNX op {op_type} has no importer")
+        ins = [get(n) for n in ins_names]
+        out = fn(ins, attrs, params, name, name_map)
+        for on in out_names:
+            env[on] = out
+        last = out
+
+    out_specs = [P.first(vi, 1, b"").decode()
+                 for vi in P.fields(graph, 12)]
+    outs = [env[o] for o in out_specs if o in env] or [last]
+    out = outs[0] if len(outs) == 1 else sym_mod.Group(outs)
+
+    from ...symbol.symbol import is_aux_name
+    used = set(out.list_inputs())
+    arg_params, aux_params = {}, {}
+    for name, arr in params.items():
+        if name not in used:
+            continue
+        nd = array(arr.astype(np.float32) if arr.dtype != np.int64
+                   else arr.astype(np.int32))
+        if is_aux_name(name):
+            aux_params[name] = nd
+        else:
+            arg_params[name] = nd
+    return out, arg_params, aux_params
+
+
+def import_to_gluon(onnx_file, ctx=None):
+    """-> SymbolBlock with loaded parameters
+    (ref: onnx2mx/import_to_gluon.py)."""
+    from ...gluon.block import SymbolBlock
+
+    out, arg_params, aux_params = import_model(onnx_file)
+    data_names = [n for n in out.list_inputs()
+                  if n not in arg_params and n not in aux_params]
+    inputs = [sym_mod.var(n) for n in data_names]
+    blk = SymbolBlock(out, inputs)
+    for name, p in blk._reg_params.items():
+        if name in arg_params:
+            p.set_data(arg_params[name])
+        elif name in aux_params:
+            p.set_data(aux_params[name])
+    return blk

@@ -1,0 +1,128 @@
+"""Minimal protobuf wire codec for the ONNX subset this package uses
+(ModelProto/GraphProto/NodeProto/TensorProto/AttributeProto/
+ValueInfoProto). The environment has no `onnx` package and no egress,
+so the wire format (varint tags + length-delimited submessages — the
+stable protobuf encoding) is written/parsed directly. Field numbers
+follow onnx.proto3.
+"""
+from __future__ import annotations
+
+import struct
+
+# wire types
+_VARINT, _I64, _LEN, _I32 = 0, 1, 2, 5
+
+
+def _w_varint(out, v):
+    v &= (1 << 64) - 1
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _w_tag(out, field, wt):
+    _w_varint(out, (field << 3) | wt)
+
+
+def w_int(out, field, v):
+    _w_tag(out, field, _VARINT)
+    _w_varint(out, int(v))
+
+
+def w_bytes(out, field, b):
+    if isinstance(b, str):
+        b = b.encode()
+    _w_tag(out, field, _LEN)
+    _w_varint(out, len(b))
+    out.extend(b)
+
+
+def w_float(out, field, v):
+    _w_tag(out, field, _I32)
+    out.extend(struct.pack("<f", float(v)))
+
+
+def w_packed_ints(out, field, vals):
+    body = bytearray()
+    for v in vals:
+        _w_varint(body, int(v))
+    w_bytes(out, field, bytes(body))
+
+
+def w_packed_floats(out, field, vals):
+    w_bytes(out, field, struct.pack("<%df" % len(vals), *vals))
+
+
+def w_msg(out, field, body):
+    w_bytes(out, field, bytes(body))
+
+
+def r_varint(buf, pos):
+    v = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        v |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return v, pos
+        shift += 7
+
+
+def parse(buf):
+    """-> list of (field, wire_type, value); LEN values are bytes."""
+    out = []
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        tag, pos = r_varint(buf, pos)
+        field, wt = tag >> 3, tag & 7
+        if wt == _VARINT:
+            v, pos = r_varint(buf, pos)
+        elif wt == _I64:
+            v = struct.unpack_from("<q", buf, pos)[0]
+            pos += 8
+        elif wt == _LEN:
+            ln, pos = r_varint(buf, pos)
+            v = bytes(buf[pos:pos + ln])
+            pos += ln
+        elif wt == _I32:
+            v = struct.unpack_from("<f", buf, pos)[0]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        out.append((field, wt, v))
+    return out
+
+
+def fields(buf, field):
+    return [v for f, _w, v in parse(buf) if f == field]
+
+
+def first(buf, field, default=None):
+    got = fields(buf, field)
+    return got[0] if got else default
+
+
+def unpack_ints(v):
+    """Packed repeated varint payload -> list of ints."""
+    out = []
+    pos = 0
+    while pos < len(v):
+        x, pos = r_varint(v, pos)
+        out.append(x)
+    return out
+
+
+def unpack_floats(v):
+    return list(struct.unpack("<%df" % (len(v) // 4), v))
+
+
+def signed(v):
+    """Reinterpret an unsigned varint as int64 two's complement
+    (protobuf int64 encoding of negatives)."""
+    return v - (1 << 64) if v >= (1 << 63) else v

@@ -1,0 +1,14 @@
+"""mxnet_tpu.image — pure-Python image loading + augmenter zoo
+(ref: python/mxnet/image/ package)."""
+from .image import (Augmenter, BrightnessJitterAug, CastAug,
+                    CenterCropAug, ColorJitterAug, ContrastJitterAug,
+                    CreateAugmenter, ForceResizeAug, HorizontalFlipAug,
+                    HueJitterAug, ImageIter, LightingAug, RandomCropAug,
+                    RandomGrayAug, RandomOrderAug, RandomRotateAug,
+                    RandomShearAug, RandomSizedCropAug, ResizeAug,
+                    SaturationJitterAug, SequentialAug, color_normalize,
+                    imdecode, imread, imresize, random_crop,
+                    center_crop, fixed_crop, scale_down)
+from .detection import (CreateDetAugmenter, DetBorderAug,
+                        DetHorizontalFlipAug, DetRandomCropAug,
+                        ImageDetIter)

@@ -1,0 +1,146 @@
+"""image package tests (ref: tests/python/unittest/test_image.py —
+augmenter correctness, ImageIter epoch coverage, detection label
+consistency under flip/crop).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image
+
+
+@pytest.fixture(scope="module")
+def img_tree(tmp_path_factory):
+    from PIL import Image
+
+    d = tmp_path_factory.mktemp("imgs")
+    rng = np.random.default_rng(0)
+    entries = []
+    for i in range(12):
+        arr = rng.integers(0, 255, (48, 64, 3), dtype=np.uint8)
+        name = f"im{i}.png"  # lossless so pixel checks are exact
+        Image.fromarray(arr).save(d / name)
+        entries.append((i, name, float(i % 3), arr))
+    lst = d / "data.lst"
+    with open(lst, "w") as f:
+        for i, name, lab, _ in entries:
+            f.write(f"{i}\t{lab}\t{name}\n")
+    return d, lst, entries
+
+
+def test_imread_imdecode_imresize(img_tree):
+    d, _lst, entries = img_tree
+    i, name, _lab, arr = entries[0]
+    got = image.imread(str(d / name))
+    np.testing.assert_array_equal(got.asnumpy(), arr)
+    buf = open(d / name, "rb").read()
+    np.testing.assert_array_equal(image.imdecode(buf).asnumpy(), arr)
+    small = image.imresize(got, 32, 24)
+    assert small.shape == (24, 32, 3)
+
+
+def test_augmenter_shapes_and_math():
+    src = np.full((40, 40, 3), 100.0, np.float32)
+    out = image.CenterCropAug((24, 24))(src)
+    assert out.shape == (24, 24, 3)
+    out = image.BrightnessJitterAug(0.0)(src)
+    np.testing.assert_allclose(out, src)
+    # hue=0 is identity up to the YIQ matrices' rounding (~0.3%)
+    np.testing.assert_allclose(image.HueJitterAug(0.0)(src), src,
+                               rtol=5e-3)
+    g = image.RandomGrayAug(1.0)(np.dstack([
+        np.full((4, 4), 10.0), np.full((4, 4), 20.0),
+        np.full((4, 4), 30.0)]).astype(np.float32))
+    assert np.allclose(g[..., 0], g[..., 1])
+    r = image.RandomRotateAug(45)(src)
+    assert r.shape == src.shape
+    s = image.RandomShearAug(0.2)(src)
+    assert s.shape == src.shape
+
+
+def test_image_iter_lst_epoch(img_tree):
+    d, lst, entries = img_tree
+    it = image.ImageIter(batch_size=4, data_shape=(3, 32, 32),
+                         path_imglist=str(lst), path_root=str(d))
+    seen = []
+    for batch in it:
+        assert batch.data[0].shape == (4, 3, 32, 32)
+        seen.extend(batch.label[0].asnumpy().tolist())
+    assert len(seen) == 12
+    assert sorted(seen) == sorted([e[2] for e in entries])
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_image_iter_rec(img_tree, tmp_path):
+    from mxnet_tpu.recordio import IRHeader, MXIndexedRecordIO, pack_img
+
+    d, _lst, entries = img_tree
+    prefix = str(tmp_path / "rec")
+    rec = MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    for i, _name, lab, arr in entries:
+        rec.write_idx(i, pack_img(IRHeader(0, lab, i, 0), arr))
+    rec.close()
+    it = image.ImageIter(batch_size=3, data_shape=(3, 32, 32),
+                         path_imgrec=prefix + ".rec", shuffle=True,
+                         rand_mirror=True, brightness=0.1)
+    n = sum(1 for _ in it)
+    assert n == 4
+
+
+def test_image_iter_partial_tail_batch(img_tree):
+    d, lst, entries = img_tree
+    it = image.ImageIter(batch_size=5, data_shape=(3, 32, 32),
+                         path_imglist=str(lst), path_root=str(d))
+    batches = list(it)
+    # 12 images at batch 5 -> 3 batches, last padded by 3
+    assert len(batches) == 3
+    assert [b.pad for b in batches] == [0, 0, 3]
+    seen = []
+    for b in batches:
+        labels = b.label[0].asnumpy()
+        seen.extend(labels[:len(labels) - b.pad].tolist())
+    assert sorted(seen) == sorted(e[2] for e in entries)
+
+
+def test_det_flip_keeps_boxes_consistent():
+    src = np.zeros((20, 20, 3), np.float32)
+    src[4:10, 2:8, 0] = 1.0  # object pixels
+    label = np.array([[1, 0.1, 0.2, 0.4, 0.5],
+                      [-1, -1, -1, -1, -1]], np.float32)
+    aug = image.DetHorizontalFlipAug(p=1.0)
+    out, lab = aug(src, label)
+    np.testing.assert_allclose(lab[0, 1:5], [0.6, 0.2, 0.9, 0.5],
+                               rtol=1e-6)
+    # pixels actually flipped
+    assert out[4, 19 - 2, 0] == 1.0
+    # padding row untouched
+    np.testing.assert_array_equal(lab[1], label[1])
+
+
+def test_det_border_pad(img_tree):
+    src = np.zeros((20, 40, 3), np.float32)
+    label = np.array([[0, 0.0, 0.0, 1.0, 1.0]], np.float32)
+    out, lab = image.DetBorderAug(fill=7)(src, label)
+    assert out.shape == (40, 40, 3)
+    # y range shrinks to the padded band
+    np.testing.assert_allclose(lab[0, 1:5], [0.0, 0.25, 1.0, 0.75],
+                               rtol=1e-6)
+    assert out[0, 0, 0] == 7
+
+
+def test_image_det_iter(img_tree, tmp_path):
+    d, _lst, entries = img_tree
+    imglist = [([float(i % 2), 0.1, 0.1, 0.6, 0.7], e[1])
+               for i, e in enumerate(entries[:6])]
+    it = image.ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                            imglist=imglist, path_root=str(d),
+                            rand_mirror=True, max_objects=4)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (2, 3, 32, 32)
+    assert batch.label[0].shape == (2, 4, 5)
+    lab = batch.label[0].asnumpy()
+    assert (lab[:, 0, 0] >= 0).all()
+    assert (lab[:, 1:, 0] == -1).all()
