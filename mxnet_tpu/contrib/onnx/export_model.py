@@ -8,7 +8,6 @@ import numpy as np
 
 from ...base import MXNetError
 from ...ndarray import NDArray
-from ...symbol.symbol import is_aux_name
 from . import proto as P
 
 # onnx enums

@@ -127,7 +127,8 @@ class Executor:
                 if opdef.needs_rng:
                     key, sub = jax.random.split(key)
                     ins = [sub] + ins
-                if training and node.op == "BatchNorm" \
+                if training and opdef.name in ("BatchNorm",
+                                               "_contrib_SyncBatchNorm") \
                         and not attrs.get("use_global_stats"):
                     out = self._bn_train(node, opdef, ins, attrs,
                                          aux_updates)

@@ -97,7 +97,9 @@ def center_crop(src, size, interp=1):
 
 def color_normalize(src, mean, std=None):
     a = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
-    a = a.astype(np.float32) - np.asarray(mean, np.float32)
+    a = a.astype(np.float32)
+    if mean is not None:
+        a = a - np.asarray(mean, np.float32)
     if std is not None:
         a = a / np.asarray(std, np.float32)
     return array(a) if isinstance(src, NDArray) else a
@@ -421,7 +423,7 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False,
         mean = np.array([123.68, 116.28, 103.53])
     if std is True:
         std = np.array([58.395, 57.12, 57.375])
-    if mean is not None and np.any(np.asarray(mean) > 0):
+    if mean is not None or std is not None:
         class _Norm(Augmenter):
             def __call__(self, src):
                 return color_normalize(src, mean, std)
@@ -445,6 +447,8 @@ class ImageIter(DataIter):
         if len(data_shape) != 3 or data_shape[0] not in (1, 3):
             raise MXNetError(f"data_shape {data_shape} must be CHW")
         self.data_shape = tuple(data_shape)
+        # c=1 -> decode grayscale (imdecode flag=0), c=3 -> color RGB
+        self._color_flag = 1 if data_shape[0] == 3 else 0
         self.label_width = label_width
         self.data_name = data_name
         self.label_name = label_name
@@ -510,7 +514,7 @@ class ImageIter(DataIter):
             self._rec.reset()
 
     def next_sample(self):
-        from ..recordio import unpack, unpack_img
+        from ..recordio import unpack
         if self._rec is not None:
             if self.seq is not None:
                 if self.cur >= len(self.seq):
@@ -521,18 +525,26 @@ class ImageIter(DataIter):
                 raw = self._rec.read()
                 if raw is None:
                     raise StopIteration
-            header, img = unpack_img(raw)
+            header, payload = unpack(raw)
+            # decode via imdecode so both the .rec and .lst paths yield
+            # RGB (raw cv2 unpack_img would hand back BGR); npy payloads
+            # (cv2/PIL-less packing) pass through as stored
+            if payload[:6] == b"\x93NUMPY":
+                import io as _io
+                img = np.load(_io.BytesIO(payload)).astype(np.float32)
+            else:
+                img = imdecode(payload, flag=self._color_flag) \
+                    .asnumpy().astype(np.float32)
             label = header.label
             if np.isscalar(label):
                 label = np.array([label], np.float32)
-            return np.asarray(label, np.float32), \
-                img.astype(np.float32)
+            return np.asarray(label, np.float32), img
         if self.cur >= len(self.seq):
             raise StopIteration
         label, fname = self.imglist[self.seq[self.cur]]
         self.cur += 1
-        img = imread(os.path.join(self.path_root, fname)).asnumpy() \
-            .astype(np.float32)
+        img = imread(os.path.join(self.path_root, fname),
+                     flag=self._color_flag).asnumpy().astype(np.float32)
         return label, img
 
     @staticmethod
@@ -559,7 +571,14 @@ class ImageIter(DataIter):
                 pad = self._pad_tail(imgs, labels, self.batch_size)
                 break
             if img.ndim == 2:
-                img = img[:, :, None].repeat(3, axis=2)
+                img = img[:, :, None]
+            if img.shape[2] != c:
+                if c == 3 and img.shape[2] == 1:
+                    img = img.repeat(3, axis=2)
+                elif c == 1 and img.shape[2] == 3:
+                    # ITU-R BT.601 luma, matching cv2/PIL grayscale
+                    img = (img @ np.array([0.299, 0.587, 0.114],
+                                          np.float32))[:, :, None]
             for aug in self.auglist:
                 img = aug(img)
             if img.shape[:2] != (h, w):

@@ -296,6 +296,24 @@ def cond(pred, then_func, else_func):
     return grouped if was_list else grouped[0]
 
 
+def __getattr__(name):
+    """Resolve ``nd.contrib.<op>`` to the registered ``_contrib_<op>``
+    (the reference generates these at import from the C registry,
+    python/mxnet/ndarray/register.py:30-60; we resolve lazily)."""
+    for cand in ("_contrib_" + name, name):
+        if cand in _reg._OPS:
+            def fn(*args, _cand=cand, **kwargs):
+                # positional args are all array inputs (invoke converts
+                # raw numpy/jax values to NDArray); attrs go by keyword,
+                # matching the reference's generated contrib API
+                from .ndarray import invoke
+                return invoke(_cand, list(args), kwargs)
+            fn.__name__ = name
+            return fn
+    raise AttributeError(f"module 'mxnet_tpu.ndarray.contrib' has no "
+                         f"attribute {name!r}")
+
+
 def isfinite(data):
     return NDArray(jnp.isfinite(data._data).astype(jnp.float32))
 

@@ -170,3 +170,95 @@ def roi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
         return jnp.mean(vals, axis=-1)
 
     return jax.vmap(one_roi)(rois)
+
+
+# ---------------------------------------------------------------------------
+# FFT (ref: src/operator/contrib/fft-inl.h, ifft-inl.h). The reference runs
+# cuFFT C2C over the last axis with real input and interleaved re/im output;
+# ifft is the UNNORMALIZED inverse (fft-inl.h's `out /= dim_` is commented
+# out at ifft-inl.h:136). compute_size is a cuFFT batching knob — XLA batches
+# natively, so it is accepted and ignored.
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_fft", aliases=("fft",))
+def contrib_fft(data, compute_size=128):
+    spec = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    out = jnp.stack([spec.real, spec.imag], axis=-1)
+    return out.reshape(data.shape[:-1] + (2 * data.shape[-1],)).astype(data.dtype)
+
+
+@register("_contrib_ifft", aliases=("ifft",))
+def contrib_ifft(data, compute_size=128):
+    d = data.shape[-1] // 2
+    inter = data.astype(jnp.float32).reshape(data.shape[:-1] + (d, 2))
+    spec = lax.complex(inter[..., 0], inter[..., 1])
+    # unnormalized inverse: numpy's ifft divides by d, the reference does not
+    return (jnp.fft.ifft(spec, axis=-1).real * d).astype(data.dtype)
+
+
+@register("_contrib_count_sketch", aliases=("count_sketch",))
+def count_sketch(data, h, s, out_dim=0, processing_batch_size=32):
+    """Count-sketch random projection (ref: src/operator/contrib/
+    count_sketch-inl.h): out[i, h[j]] += s[j] * data[i, j]. A scatter-add
+    over the hash indices; processing_batch_size is a CUDA grid-size knob,
+    ignored under XLA."""
+    hh = h.reshape(-1).astype(jnp.int32)
+    ss = s.reshape(-1).astype(data.dtype)
+    flat = data.reshape(-1, data.shape[-1])
+    out = jnp.zeros((flat.shape[0], int(out_dim)), data.dtype)
+    out = out.at[:, hh].add(flat * ss[None, :])
+    return out.reshape(data.shape[:-1] + (int(out_dim),))
+
+
+@register("_contrib_boolean_mask", aliases=("boolean_mask",), num_outputs=2)
+def contrib_boolean_mask(data, index, axis=0):
+    """Select rows where index != 0 (ref: src/operator/contrib/
+    boolean_mask.cc). The reference produces a data-dependent output shape;
+    under XLA the kept rows are compacted to the front of a full-size,
+    zero-padded buffer and the true count is returned as a second output —
+    the bounded-shape formulation SURVEY.md §7(c) prescribes."""
+    ax = axis % data.ndim
+    keep = (index.reshape(-1) != 0)
+    n = data.shape[ax]
+    # stable compaction: position of each kept row in the packed output
+    pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    dest = jnp.where(keep, pos, n)  # dropped rows scatter to a discard slot
+    moved = jnp.moveaxis(data, ax, 0)
+    packed = jnp.zeros((n + 1,) + moved.shape[1:], data.dtype)
+    packed = packed.at[dest].set(moved)[:n]
+    return jnp.moveaxis(packed, 0, ax), jnp.sum(keep.astype(jnp.int32))
+
+
+@register("_contrib_SyncBatchNorm", aliases=("SyncBatchNorm",))
+def sync_batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                    momentum=0.9, fix_gamma=True, use_global_stats=False,
+                    output_mean_var=False, ndev=1, key="", axis_name=None,
+                    training=False):
+    """Cross-device BatchNorm (ref: src/operator/contrib/sync_batch_norm.cc).
+
+    The reference synchronizes batch statistics across GPUs with a
+    shared-buffer barrier keyed by `key`/`ndev`. TPU-natively the op is SPMD:
+    when traced inside shard_map/pjit with a mapped `axis_name`, the batch
+    moments are jointly reduced with lax.pmean over that axis — the mean of
+    per-device means/second-moments IS the global moment since shards are
+    equal-sized. Outside a mapped trace it degrades to plain BatchNorm.
+    """
+    reduce_axes = tuple(i for i in range(data.ndim) if i != 1)
+    bshape = tuple(data.shape[1] if i == 1 else 1 for i in range(data.ndim))
+    if training and not use_global_stats:
+        mean = jnp.mean(data, axis=reduce_axes)
+        sq = jnp.mean(jnp.square(data), axis=reduce_axes)
+        if axis_name:
+            mean = lax.pmean(mean, axis_name)
+            sq = lax.pmean(sq, axis_name)
+        var = sq - jnp.square(mean)
+    else:
+        mean, var = moving_mean, moving_var
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    inv = lax.rsqrt(var + eps)
+    out = (data - mean.reshape(bshape)) * (inv * g).reshape(bshape) \
+        + beta.reshape(bshape)
+    if output_mean_var:
+        return out, mean, var
+    return out

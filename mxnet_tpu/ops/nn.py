@@ -506,3 +506,99 @@ def adaptive_avg_pooling(data, output_size=(1, 1)):
     rw = win_matrix(h, os[0])
     cw = win_matrix(w, os[1])
     return jnp.einsum("oh,nchw,pw->ncop", rw, data, cw)
+
+
+# ---------------------------------------------------------------------------
+# Correlation (ref: src/operator/correlation-inl.h:80-130) — FlowNet-style
+# cost volume between two feature maps.
+# ---------------------------------------------------------------------------
+
+
+@register("Correlation")
+def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True):
+    """Patch correlation of data1 against displaced data2 neighborhoods.
+
+    The reference launches one CUDA block per displacement; here the D*D
+    displacement grid is a static Python loop of shifted elementwise
+    products, each reduced over the kernel window with reduce_window and
+    over channels — every step is an XLA-fusable dense op, and the MXU sees
+    the surrounding convs, not this (it is bandwidth-bound by design).
+    Normalization matches the reference: sumelems = K*K*C.
+    """
+    n, c, h, w = data1.shape
+    pb_h, pb_w = h + 2 * pad_size, w + 2 * pad_size
+    kr = (kernel_size - 1) // 2
+    border = max_displacement + kr
+    top_h = -(-(pb_h - 2 * border) // stride1)
+    top_w = -(-(pb_w - 2 * border) // stride1)
+    ngr = max_displacement // stride2     # neighborhood grid radius
+    pad = ((0, 0), (0, 0), (pad_size, pad_size), (pad_size, pad_size))
+    p1 = jnp.pad(data1, pad)
+    p2 = jnp.pad(data2, pad)
+    sumelems = kernel_size * kernel_size * c
+    planes = []
+    for dy in range(-ngr, ngr + 1):
+        for dx in range(-ngr, ngr + 1):
+            sy, sx = dy * stride2, dx * stride2
+            shifted = jnp.roll(p2, (-sy, -sx), axis=(2, 3))
+            prod = p1 * shifted if is_multiply else jnp.abs(p1 - shifted)
+            summed = jnp.sum(prod, axis=1)  # over channels -> (n, pbh, pbw)
+            if kernel_size > 1:
+                summed = lax.reduce_window(
+                    summed, 0.0, lax.add, (1, kernel_size, kernel_size),
+                    (1, 1, 1), "SAME")
+            # top-left output sample sits at the border offset
+            win = lax.dynamic_slice(
+                summed, (0, border, border),
+                (n, pb_h - 2 * border, pb_w - 2 * border))
+            planes.append(win[:, ::stride1, ::stride1][:, :top_h, :top_w])
+    out = jnp.stack(planes, axis=1) / sumelems
+    return out.astype(data1.dtype)
+
+
+# ---------------------------------------------------------------------------
+# SVMOutput (ref: src/operator/svm_output.cc:31-66) — hinge-loss output
+# layer: forward is identity, backward replaces the head gradient with the
+# L1/L2 SVM subgradient (the same "loss layer defines its own gradient"
+# contract as SoftmaxOutput).
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _svm_output_closure(margin, regularization_coefficient, use_linear):
+    reg = regularization_coefficient
+
+    @jax.custom_vjp
+    def f(data, label):
+        return data
+
+    def f_fwd(data, label):
+        return data, (data, label)
+
+    def f_bwd(res, g):
+        out, label = res
+        k = label.astype(jnp.int32)
+        onehot = jax.nn.one_hot(k, out.shape[1], dtype=out.dtype)
+        if use_linear:  # L1_SVM (svm_output.cc:31-46)
+            g_true = -(margin > out).astype(out.dtype) * reg
+            g_other = (margin > -out).astype(out.dtype) * reg
+        else:           # L2_SVM (svm_output.cc:49-66)
+            g_true = -reg * jnp.where(margin > out, 2 * (margin - out), 0.0)
+            g_other = -reg * jnp.where(margin > -out, -2 * (margin + out), 0.0)
+        grad = jnp.where(onehot > 0, g_true, g_other).astype(out.dtype)
+        return grad, jnp.zeros_like(label)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+@register("SVMOutput")
+def svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False):
+    if label.ndim == data.ndim and label.shape[-1] == 1:
+        label = label.reshape(label.shape[:-1])
+    flat = data.reshape(data.shape[0], -1)
+    f = _svm_output_closure(float(margin), float(regularization_coefficient),
+                            bool(use_linear))
+    return f(flat, label).reshape(data.shape)

@@ -5,6 +5,7 @@ from .symbol import (Symbol, Group, Variable, var, load, load_json,
                      is_aux_name)
 from . import register as _register
 from . import op
+from . import contrib  # noqa: F401
 
 _register.populate(globals())
 _register.populate(op.__dict__)

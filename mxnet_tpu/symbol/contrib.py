@@ -1,0 +1,19 @@
+"""sym.contrib — contrib ops in symbolic form plus control flow.
+
+Mirrors python/mxnet/symbol/contrib.py: the reference generates
+``sym.contrib.<op>`` wrappers for every ``_contrib_*`` registry entry
+(symbol/register.py codegen); control flow (foreach/while_loop/cond) is
+shared with the ndarray implementation since both trace through lax.
+"""
+from __future__ import annotations
+
+from ..ops import registry as _reg
+from .register import make_sym_func
+
+
+def __getattr__(name):
+    for cand in ("_contrib_" + name, name):
+        if cand in _reg._OPS:
+            return make_sym_func(_reg._OPS[cand])
+    raise AttributeError(f"module 'mxnet_tpu.symbol.contrib' has no "
+                         f"attribute {name!r}")

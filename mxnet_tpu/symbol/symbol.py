@@ -613,6 +613,7 @@ _PARAM_SHAPE_INFER = {
     "Convolution": _conv_shapes,
     "Deconvolution": _deconv_shapes,
     "BatchNorm": _norm_shapes,
+    "_contrib_SyncBatchNorm": _norm_shapes,
     "InstanceNorm": _norm_shapes,
     "LayerNorm": _ln_shapes,
     "Embedding": _embedding_shapes,

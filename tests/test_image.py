@@ -144,3 +144,25 @@ def test_image_det_iter(img_tree, tmp_path):
     lab = batch.label[0].asnumpy()
     assert (lab[:, 0, 0] >= 0).all()
     assert (lab[:, 1:, 0] == -1).all()
+
+
+def test_image_iter_grayscale(img_tree):
+    """data_shape c=1 decodes grayscale; batches match provide_data."""
+    d, lst, entries = img_tree
+    it = image.ImageIter(batch_size=4, data_shape=(1, 32, 32),
+                         path_imglist=str(lst), path_root=str(d))
+    assert it.provide_data[0].shape == (4, 1, 32, 32)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (4, 1, 32, 32)
+
+
+def test_create_augmenter_std_only():
+    """std without mean must still normalize (the reference appends
+    ColorNormalizeAug when either is set)."""
+    augs = image.CreateAugmenter((3, 8, 8), resize=0,
+                                 std=np.array([2.0, 4.0, 8.0]))
+    img = np.full((8, 8, 3), 8.0, np.float32)
+    for a in augs:
+        img = a(img)
+    img = np.asarray(img if not hasattr(img, "asnumpy") else img.asnumpy())
+    np.testing.assert_allclose(img[0, 0], [4.0, 2.0, 1.0])

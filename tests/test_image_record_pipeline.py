@@ -93,9 +93,15 @@ def test_multithread_decode_faster(tmp_path):
         # the C++ libjpeg pool must beat the GIL-bound PIL fallback
         # (best-of-3 each; modest margin — the CI host has 1 core and
         # runs the rest of the suite's teardown threads)
-        t_native = min(epoch_time(2) for _ in range(3))
-        t_pil = min(epoch_time(2, force_pil=True) for _ in range(3))
-        assert t_native < t_pil / 1.1, (t_native, t_pil)
+        # timing comparison on a shared CI host: re-measure on failure
+        # instead of flaking when a background thread steals the core
+        for attempt in range(3):
+            t_native = min(epoch_time(2) for _ in range(3))
+            t_pil = min(epoch_time(2, force_pil=True) for _ in range(3))
+            if t_native < t_pil / 1.1:
+                break
+        else:
+            raise AssertionError((t_native, t_pil))
 
     if (os.cpu_count() or 1) >= 2:
         # thread scaling only observable with >1 core (CI hosts vary)

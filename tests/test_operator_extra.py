@@ -1,0 +1,170 @@
+"""Ops added in round 3's gap-fill: fft/ifft, count_sketch, boolean_mask,
+SyncBatchNorm, Correlation, SVMOutput
+(ref: src/operator/contrib/fft-inl.h, count_sketch-inl.h, boolean_mask.cc,
+sync_batch_norm.cc; src/operator/correlation-inl.h, svm_output.cc)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_fft_ifft():
+    x = np.random.default_rng(0).standard_normal((4, 16)).astype(np.float32)
+    out = nd.contrib.fft(nd.array(x))
+    spec = np.fft.fft(x, axis=-1)
+    ref = np.stack([spec.real, spec.imag], -1).reshape(4, 32)
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
+    # the reference's ifft is the unnormalized inverse (ifft-inl.h:136)
+    back = nd.contrib.ifft(out)
+    assert_almost_equal(back, x * 16, rtol=1e-4, atol=1e-4)
+
+
+def test_count_sketch():
+    d, od = 8, 5
+    h = np.random.default_rng(1).integers(0, od, (1, d)).astype(np.float32)
+    s = np.random.default_rng(2).choice([-1.0, 1.0], (1, d)).astype(np.float32)
+    data = np.random.default_rng(3).standard_normal((3, d)).astype(np.float32)
+    out = nd.contrib.count_sketch(nd.array(data), nd.array(h), nd.array(s),
+                                  out_dim=od)
+    ref = np.zeros((3, od), np.float32)
+    for j in range(d):
+        ref[:, int(h[0, j])] += s[0, j] * data[:, j]
+    assert_almost_equal(out, ref)
+
+
+def test_boolean_mask():
+    data = np.arange(12, dtype=np.float32).reshape(4, 3)
+    idx = np.array([1, 0, 1, 1], np.float32)
+    out, cnt = nd.contrib.boolean_mask(nd.array(data), nd.array(idx))
+    assert int(cnt.asnumpy()) == 3
+    assert_almost_equal(out.asnumpy()[:3], data[[0, 2, 3]])
+    assert (out.asnumpy()[3] == 0).all()
+
+
+def test_sync_batch_norm_local():
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal((8, 4, 2, 2)).astype(np.float32)
+    gamma = np.ones(4, np.float32)
+    beta = np.zeros(4, np.float32)
+    mm = np.zeros(4, np.float32)
+    mv = np.ones(4, np.float32)
+    out = nd.contrib.SyncBatchNorm(nd.array(data), nd.array(gamma),
+                                   nd.array(beta), nd.array(mm), nd.array(mv),
+                                   training=True)
+    ref = (data - data.mean((0, 2, 3), keepdims=True)) / \
+        np.sqrt(data.var((0, 2, 3), keepdims=True) + 1e-3)
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_sync_batch_norm_cross_device_matches_global():
+    """Sharded SyncBatchNorm over the dp axis == unsharded BatchNorm on the
+    full batch (the reference's cross-GPU contract, sync_batch_norm.cc)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    from mxnet_tpu.ops import registry
+
+    sbn = registry.get("_contrib_SyncBatchNorm").fn
+    ndev = jax.device_count()
+    rng = np.random.default_rng(1)
+    data = rng.standard_normal((4 * ndev, 3, 2, 2)).astype(np.float32)
+    gamma = np.ones(3, np.float32)
+    beta = np.zeros(3, np.float32)
+    mm = np.zeros(3, np.float32)
+    mv = np.ones(3, np.float32)
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    f = shard_map(
+        lambda d, g, b, m, v: sbn(d, g, b, m, v, training=True,
+                                  axis_name="dp"),
+        mesh=mesh,
+        in_specs=(P("dp"), P(), P(), P(), P()),
+        out_specs=P("dp"))
+    out = f(jnp.asarray(data), jnp.asarray(gamma), jnp.asarray(beta),
+            jnp.asarray(mm), jnp.asarray(mv))
+    ref = (data - data.mean((0, 2, 3), keepdims=True)) / \
+        np.sqrt(data.var((0, 2, 3), keepdims=True) + 1e-3)
+    assert_almost_equal(np.asarray(out), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_correlation_numeric():
+    rng = np.random.default_rng(4)
+    d1 = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+    d2 = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+    out = nd.Correlation(nd.array(d1), nd.array(d2), kernel_size=1,
+                         max_displacement=2, stride1=1, stride2=1,
+                         pad_size=2).asnumpy()
+    assert out.shape == (2, 25, 8, 8)
+    p1 = np.pad(d1, ((0, 0), (0, 0), (2, 2), (2, 2)))
+    p2 = np.pad(d2, ((0, 0), (0, 0), (2, 2), (2, 2)))
+    border = 2
+    # naive check at a few positions/displacements
+    for (n, dy, dx, y, x) in [(0, -2, 1, 3, 4), (1, 0, 0, 0, 0),
+                              (1, 2, -2, 5, 6)]:
+        ch = (dy + 2) * 5 + (dx + 2)
+        ref = np.sum(p1[n, :, border + y, border + x]
+                     * p2[n, :, border + y + dy, border + x + dx]) / 3
+        np.testing.assert_allclose(out[n, ch, y, x], ref, rtol=1e-4)
+
+
+def test_correlation_subtract_stride():
+    rng = np.random.default_rng(5)
+    d1 = rng.standard_normal((1, 2, 10, 10)).astype(np.float32)
+    d2 = rng.standard_normal((1, 2, 10, 10)).astype(np.float32)
+    out = nd.Correlation(nd.array(d1), nd.array(d2), kernel_size=3,
+                         max_displacement=2, stride1=2, stride2=2,
+                         pad_size=4, is_multiply=False).asnumpy()
+    # padded 18, border = 2 + 1 = 3 -> top = ceil(12/2) = 6; grid 2*1+1 = 3
+    assert out.shape == (1, 9, 6, 6)
+    assert np.isfinite(out).all()
+
+
+def test_svm_output_forward_and_grads():
+    from mxnet_tpu import autograd
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((4, 5)).astype(np.float32)
+    label = np.array([1, 3, 0, 2], np.float32)
+    margin, reg = 1.0, 0.7
+    for use_linear in (True, False):
+        a = nd.array(x)
+        a.attach_grad()
+        with autograd.record():
+            out = nd.SVMOutput(a, nd.array(label), margin=margin,
+                               regularization_coefficient=reg,
+                               use_linear=use_linear)
+        assert_almost_equal(out, x)  # forward is identity
+        out.backward()
+        ref = np.zeros_like(x)
+        for y in range(4):
+            k = int(label[y])
+            for c in range(5):
+                if use_linear:  # L1_SVM svm_output.cc:31-46
+                    ref[y, c] = (-float(margin > x[y, k]) * reg if c == k
+                                 else float(margin > -x[y, c]) * reg)
+                else:           # L2_SVM svm_output.cc:49-66
+                    if c == k:
+                        ref[y, c] = -reg * (2 * (margin - x[y, k])
+                                            if margin > x[y, k] else 0.0)
+                    else:
+                        ref[y, c] = -reg * (-2 * (margin + x[y, c])
+                                            if margin > -x[y, c] else 0.0)
+        assert_almost_equal(a.grad, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_sync_batch_norm_symbolic_updates_aux():
+    """The executor's moving-stat update must fire for SyncBatchNorm too
+    (the reference op updates aux in-place, sync_batch_norm.cc)."""
+    import mxnet_tpu.symbol as sym
+    data = sym.var("data")
+    b = sym.contrib.SyncBatchNorm(data, name="sbn0", momentum=0.5,
+                                  fix_gamma=False)
+    ex = b.simple_bind(data=(4, 3))
+    x = np.random.rand(4, 3).astype("float32") + 2.0
+    ex.arg_dict["data"]._data = mx.nd.array(x)._data
+    ex.arg_dict["sbn0_gamma"]._data = mx.nd.ones((3,))._data
+    ex.forward(is_train=True)
+    np.testing.assert_allclose(ex.aux_dict["sbn0_moving_mean"].asnumpy(),
+                               0.5 * x.mean(axis=0), rtol=1e-5)
