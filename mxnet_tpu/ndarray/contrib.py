@@ -299,16 +299,14 @@ def cond(pred, then_func, else_func):
 def __getattr__(name):
     """Resolve ``nd.contrib.<op>`` to the registered ``_contrib_<op>``
     (the reference generates these at import from the C registry,
-    python/mxnet/ndarray/register.py:30-60; we resolve lazily)."""
+    python/mxnet/ndarray/register.py:30-60; we resolve lazily and cache
+    the wrapper so repeated lookups return the same function)."""
+    from .register import make_op_func
     for cand in ("_contrib_" + name, name):
         if cand in _reg._OPS:
-            def fn(*args, _cand=cand, **kwargs):
-                # positional args are all array inputs (invoke converts
-                # raw numpy/jax values to NDArray); attrs go by keyword,
-                # matching the reference's generated contrib API
-                from .ndarray import invoke
-                return invoke(_cand, list(args), kwargs)
+            fn = make_op_func(_reg._OPS[cand])
             fn.__name__ = name
+            globals()[name] = fn
             return fn
     raise AttributeError(f"module 'mxnet_tpu.ndarray.contrib' has no "
                          f"attribute {name!r}")

@@ -389,6 +389,11 @@ def invoke(op_name, inputs, attrs, out=None):
 
     op = _reg.get(op_name) if isinstance(op_name, str) else op_name
     attrs = {k: _canon_attr(v) for k, v in attrs.items() if v is not None}
+    if "num_args" not in op._kwarg_names:
+        # the input count is implied by the arrays, but the reference's
+        # generated API still passes num_args — accept and drop, except for
+        # ops that genuinely consume it (e.g. UpSampling's concat gate)
+        attrs.pop("num_args", None)
     if "training" in op._kwarg_names and "training" not in attrs:
         attrs["training"] = autograd.is_training()
 

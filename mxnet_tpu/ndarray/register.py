@@ -38,7 +38,8 @@ def make_op_func(op):
             if pname in kwargs:
                 v = kwargs.pop(pname)
                 inputs.append(v if (v is None or isinstance(v, NDArray)) else NDArray(v))
-        kwargs.pop("num_args", None)
+        if "num_args" not in op._kwarg_names:
+            kwargs.pop("num_args", None)
         # drop any remaining tensor-valued kwargs into inputs (variadic ops)
         return invoke(op, inputs, kwargs, out=out)
 

@@ -53,7 +53,10 @@ class OpDef:
         # per-device Philox states, ref: include/mxnet/random_generator.h).
         self.needs_rng = needs_rng
         sig = inspect.signature(fn)
-        params = [p for p in sig.parameters.values() if p.name != "key"]
+        # "key" is the injected PRNG slot only for RNG ops; elsewhere it is
+        # an ordinary attr (e.g. SyncBatchNorm's barrier key string)
+        params = [p for p in sig.parameters.values()
+                  if not (needs_rng and p.name == "key")]
         # optional *array* params (default None) vs attrs with None
         # defaults: per-op via register(optional_arrays=...), plus names
         # that are always arrays across the op set

@@ -14,6 +14,8 @@ from .register import make_sym_func
 def __getattr__(name):
     for cand in ("_contrib_" + name, name):
         if cand in _reg._OPS:
-            return make_sym_func(_reg._OPS[cand])
+            fn = make_sym_func(_reg._OPS[cand])
+            globals()[name] = fn  # cache: later lookups skip __getattr__
+            return fn
     raise AttributeError(f"module 'mxnet_tpu.symbol.contrib' has no "
                          f"attribute {name!r}")
