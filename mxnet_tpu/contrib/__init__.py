@@ -1,3 +1,6 @@
-"""mx.contrib — quantization, ONNX, text utilities
+"""mx.contrib — quantization, ONNX, text, SVRG, tensorboard
 (ref: python/mxnet/contrib/)."""
 from . import quantization
+from . import text
+from . import svrg_optimization
+from . import tensorboard

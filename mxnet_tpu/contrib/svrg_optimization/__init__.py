@@ -1,0 +1,4 @@
+"""SVRG optimization: variance-reduced SGD over the Module API
+(ref: python/mxnet/contrib/svrg_optimization/__init__.py)."""
+from .svrg_module import SVRGModule
+from .svrg_optimizer import _SVRGOptimizer

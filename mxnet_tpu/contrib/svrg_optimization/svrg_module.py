@@ -1,0 +1,193 @@
+"""SVRGModule: Module with Stochastic Variance Reduced Gradient updates
+(ref: python/mxnet/contrib/svrg_optimization/svrg_module.py; Johnson &
+Zhang 2013).
+
+Every `update_freq` epochs the module snapshots its weights and computes
+the full-dataset gradient at that snapshot; each minibatch step then uses
+
+    g = g_batch(w) - g_batch(w_snapshot) + g_full(w_snapshot)
+
+(ref: svrg_module.py:360 _svrg_grads_update_rule), an unbiased gradient
+estimate with vanishing variance near the optimum. The reference keeps a
+second executor group (`_mod_aux`) bound to the snapshot weights; here
+the aux module shares the same symbol and is re-bound functionally —
+each forward is one jitted XLA call, so the extra pass costs one
+compiled executable, not a second engine.
+"""
+from __future__ import annotations
+
+import logging
+import time
+
+from ... import metric as metric_mod
+from ...module.base_module import BatchEndParam, _as_list
+from ...module.module import Module
+
+
+class SVRGModule(Module):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, update_freq=2, **kwargs):
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names, logger=logger,
+                         context=context, **kwargs)
+        if not isinstance(update_freq, int) or update_freq <= 0:
+            raise ValueError(
+                f"update_freq in SVRGModule must be a positive integer, "
+                f"got {update_freq}")
+        self.update_freq = update_freq
+        self._mod_aux = Module(symbol, data_names=data_names,
+                               label_names=label_names, logger=logger,
+                               context=context, **kwargs)
+        self._full_grads = {}   # name -> NDArray, mean grad at snapshot
+
+    # -- lifecycle ---------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        super().bind(data_shapes, label_shapes, for_training,
+                     inputs_need_grad, force_rebind, shared_module, grad_req)
+        if for_training:
+            self._mod_aux.bind(data_shapes, label_shapes, for_training,
+                               inputs_need_grad, force_rebind,
+                               shared_module, grad_req)
+
+    def init_params(self, *args, **kwargs):
+        super().init_params(*args, **kwargs)
+        if self._mod_aux.binded:
+            arg, aux = self.get_params()
+            self._mod_aux.init_params(arg_params=arg, aux_params=aux,
+                                      allow_missing=False, force_init=True)
+
+    def reshape(self, data_shapes, label_shapes=None):
+        super().reshape(data_shapes, label_shapes=label_shapes)
+        if self._mod_aux.binded:
+            self._mod_aux.reshape(data_shapes, label_shapes=label_shapes)
+
+    # -- SVRG steps --------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        super().forward(data_batch, is_train)
+        if is_train if is_train is not None else self.for_training:
+            self._mod_aux.forward(data_batch, is_train=True)
+
+    def backward(self, out_grads=None):
+        super().backward(out_grads)
+        if self._mod_aux.binded:
+            self._mod_aux.backward(out_grads)
+
+    def update(self):
+        """Optimizer step over SVRG-adjusted gradients
+        (ref: svrg_module.py:274 update -> _update_svrg_gradients)."""
+        if self._full_grads:
+            self._update_svrg_gradients()
+        super().update()
+
+    def _update_svrg_gradients(self):
+        """g <- g - g_special + g_full (ref: svrg_module.py:382)."""
+        for name in self._param_names:
+            g = self._exec.grad_dict.get(name)
+            if g is None or name not in self._full_grads:
+                continue
+            g_special = self._mod_aux._exec.grad_dict.get(name)
+            if g_special is None:
+                continue
+            self._exec.grad_dict[name] = \
+                g - g_special + self._full_grads[name]
+
+    def update_full_grads(self, train_data):
+        """Snapshot the current weights into the aux module and average
+        gradients over the full dataset (ref: svrg_module.py:292)."""
+        arg, aux = self.get_params()
+        self._mod_aux.init_params(arg_params=arg, aux_params=aux,
+                                  allow_missing=False, force_init=True)
+        train_data.reset()
+        accum = {}
+        nbatch = 0
+        padding = 0
+        for batch in train_data:
+            self._mod_aux.forward(batch, is_train=True)
+            self._mod_aux.backward()
+            for name in self._param_names:
+                g = self._mod_aux._exec.grad_dict.get(name)
+                if g is None:
+                    continue
+                accum[name] = g.copy() if name not in accum \
+                    else accum[name] + g
+            nbatch += 1
+            padding = getattr(batch, "pad", 0) or 0
+        true_num_batch = nbatch - padding / train_data.batch_size
+        self._full_grads = {name: g / true_num_batch
+                            for name, g in accum.items()}
+
+    # -- training loop -----------------------------------------------------
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.01),),
+            eval_end_callback=None, eval_batch_end_callback=None,
+            initializer=None, arg_params=None, aux_params=None,
+            allow_missing=False, force_rebind=False, force_init=False,
+            begin_epoch=0, num_epoch=None, validation_metric=None,
+            monitor=None, sparse_row_id_fn=None):
+        """The reference's fit loop with a full-gradient refresh every
+        `update_freq` epochs (ref: svrg_module.py:395)."""
+        assert num_epoch is not None, "please specify number of epochs"
+        from ...initializer import Uniform
+        if initializer is None:
+            initializer = Uniform(0.01)
+
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_rebind)
+        if monitor is not None:
+            self.install_monitor(monitor)
+        self.init_params(initializer=initializer, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=dict(optimizer_params or {}))
+
+        if validation_metric is None:
+            validation_metric = eval_metric
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+
+        for epoch in range(begin_epoch, num_epoch):
+            tic = time.time()
+            if epoch % self.update_freq == 0:
+                self.update_full_grads(train_data)
+            eval_metric.reset()
+            nbatch = 0
+            train_data.reset()
+            for data_batch in train_data:
+                if monitor is not None:
+                    monitor.tic()
+                self.forward_backward(data_batch)
+                self.update()
+                self.update_metric(eval_metric, data_batch.label)
+                if monitor is not None:
+                    monitor.toc_print()
+                if batch_end_callback is not None:
+                    params = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                           eval_metric=eval_metric,
+                                           locals=locals())
+                    for cb in _as_list(batch_end_callback):
+                        cb(params)
+                nbatch += 1
+
+            for name, val in eval_metric.get_name_value():
+                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                             time.time() - tic)
+            if epoch_end_callback is not None:
+                arg_p, aux_p = self.get_params()
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self.symbol, arg_p, aux_p)
+            if eval_data is not None:
+                res = self.score(eval_data, validation_metric,
+                                 score_end_callback=eval_end_callback,
+                                 batch_end_callback=eval_batch_end_callback,
+                                 epoch=epoch)
+                for name, val in res:
+                    self.logger.info("Epoch[%d] Validation-%s=%f",
+                                     epoch, name, val)

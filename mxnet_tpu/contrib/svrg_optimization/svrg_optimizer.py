@@ -1,0 +1,61 @@
+"""SVRG optimizer wrapper (ref: python/mxnet/contrib/svrg_optimization/
+svrg_optimizer.py).
+
+The reference splits keys between an _AssignmentOptimizer (full-gradient
+accumulation slots in the kvstore) and the user's base optimizer. In this
+build the full-gradient bookkeeping lives on the module (functional
+arrays, no kvstore aliasing needed), so _SVRGOptimizer reduces to "base
+optimizer over SVRG-adjusted gradients" — kept as a class so user code
+addressing the reference API still composes.
+"""
+from __future__ import annotations
+
+from ... import optimizer as _opt
+
+
+class _AssignmentOptimizer(_opt.Optimizer):
+    """'Update' that just overwrites the weight with the gradient — the
+    kvstore slot trick used for full-grad accumulation
+    (ref: svrg_optimizer.py:26)."""
+
+    def update(self, index, weight, grad, state):
+        weight[:] = grad
+
+    def create_state(self, index, weight):
+        return None
+
+
+class _SVRGOptimizer(_opt.Optimizer):
+    """Dispatch wrapper: full-grad keys go to _AssignmentOptimizer, model
+    keys to the user's optimizer (ref: svrg_optimizer.py:51)."""
+
+    def __init__(self, default_optimizer, **kwargs):
+        base_kwargs = self._check_params(**kwargs)
+        super().__init__(**base_kwargs)
+        if isinstance(default_optimizer, str):
+            self.default_opt = _opt.create(default_optimizer, **base_kwargs)
+        else:
+            self.default_opt = default_optimizer
+        self.aux_opt = _AssignmentOptimizer()
+
+    @staticmethod
+    def _check_params(**kwargs):
+        import inspect
+        optimizer_param = set(
+            inspect.signature(_opt.Optimizer.__init__).parameters)
+        return {k: v for k, v in kwargs.items() if k in optimizer_param}
+
+    def update(self, index, weight, grad, state):
+        if self._is_full_grad_key(index):
+            self.aux_opt.update(index, weight, grad, state)
+        else:
+            self.default_opt.update(index, weight, grad, state)
+
+    def create_state(self, index, weight):
+        if self._is_full_grad_key(index):
+            return self.aux_opt.create_state(index, weight)
+        return self.default_opt.create_state(index, weight)
+
+    @staticmethod
+    def _is_full_grad_key(index):
+        return isinstance(index, str) and index.endswith("_full")

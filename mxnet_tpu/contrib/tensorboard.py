@@ -1,0 +1,49 @@
+"""TensorBoard bridge (ref: python/mxnet/contrib/tensorboard.py).
+
+The reference logs metric values through mxboard's SummaryWriter; this
+build tries mxboard first, then torch.utils.tensorboard (torch is
+available CPU-side), and degrades to a logged error when neither can
+write event files — matching the reference's soft-failure on a missing
+mxboard install.
+"""
+from __future__ import annotations
+
+import logging
+
+
+def _make_summary_writer(logging_dir):
+    try:
+        from mxboard import SummaryWriter
+        return SummaryWriter(logging_dir)
+    except ImportError:
+        pass
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+        return SummaryWriter(logging_dir)
+    except ImportError:
+        return None
+
+
+class LogMetricsCallback:
+    """Batch/eval-end callback writing metrics as TensorBoard scalars
+    (ref: contrib/tensorboard.py:25 LogMetricsCallback)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.summary_writer = _make_summary_writer(logging_dir)
+        if self.summary_writer is None:
+            logging.error(
+                "No TensorBoard writer available: install mxboard "
+                "(`pip install mxboard`) or tensorboard for "
+                "torch.utils.tensorboard.")
+
+    def __call__(self, param):
+        """Log the callback param's metric values
+        (ref: contrib/tensorboard.py:66)."""
+        if param.eval_metric is None or self.summary_writer is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = f"{self.prefix}-{name}"
+            self.summary_writer.add_scalar(name, value,
+                                           global_step=param.epoch)

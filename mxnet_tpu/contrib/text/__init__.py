@@ -1,0 +1,6 @@
+"""Text token indexing and embeddings
+(ref: python/mxnet/contrib/text/__init__.py)."""
+from . import utils
+from . import vocab
+from . import embedding
+from .vocab import Vocabulary

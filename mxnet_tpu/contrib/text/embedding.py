@@ -1,0 +1,323 @@
+"""Pretrained token embeddings (ref: python/mxnet/contrib/text/embedding.py).
+
+The reference downloads GloVe/fastText archives from the dmlc repo at
+first use; this build has no network egress, so pretrained files must
+already sit under ``embedding_root`` (default ``$MXNET_HOME/embeddings``,
+``~/.mxnet_tpu/embeddings``) — the loader, vocabulary intersection, and
+composite logic are the same.
+"""
+from __future__ import annotations
+
+import io
+import logging
+import os
+
+import numpy as np
+
+from ...base import MXNetError, get_env
+from ...ndarray import array
+from ...ndarray.ndarray import NDArray
+from . import vocab
+
+_REGISTRY = {}
+
+
+def register(embedding_cls):
+    """Register a TokenEmbedding class (ref: embedding.py:40)."""
+    _REGISTRY[embedding_cls.__name__.lower()] = embedding_cls
+    return embedding_cls
+
+
+def create(embedding_name, **kwargs):
+    """Create by registered name, e.g. ``create('glove',
+    pretrained_file_name=...)`` (ref: embedding.py:63)."""
+    key = embedding_name.lower()
+    if key not in _REGISTRY:
+        raise MXNetError(
+            f"Cannot find registered embedding {embedding_name}; options "
+            f"are {sorted(_REGISTRY)}")
+    return _REGISTRY[key](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Known pretrained file names per embedding (ref: embedding.py:90)."""
+    if embedding_name is not None:
+        key = embedding_name.lower()
+        if key not in _REGISTRY:
+            raise MXNetError(
+                f"Cannot find registered embedding {embedding_name}")
+        return list(_REGISTRY[key].pretrained_file_name_sha1.keys())
+    return {name: list(cls.pretrained_file_name_sha1.keys())
+            for name, cls in _REGISTRY.items()}
+
+
+def _default_root():
+    home = get_env("MXNET_HOME", os.path.expanduser("~/.mxnet_tpu"))
+    return os.path.join(home, "embeddings")
+
+
+class _TokenEmbedding(vocab.Vocabulary):
+    """Base token embedding: a Vocabulary whose indices carry vectors
+    (ref: embedding.py:133 _TokenEmbedding)."""
+
+    pretrained_file_name_sha1 = {}
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    # -- loading ----------------------------------------------------------
+    @classmethod
+    def _get_pretrained_file(cls, embedding_root, pretrained_file_name):
+        """Resolve the local pretrained file path; the reference downloads
+        it here (embedding.py:200) — offline builds must pre-place it."""
+        path = os.path.join(embedding_root, cls.__name__.lower(),
+                            pretrained_file_name)
+        if not os.path.isfile(path):
+            raise MXNetError(
+                f"Pretrained embedding file {path} not found. This build "
+                "has no network access; place the file there manually "
+                "(the reference downloads it from the dmlc repository).")
+        return path
+
+    def _load_embedding(self, pretrained_file_path, elem_delim,
+                        init_unknown_vec, encoding="utf8"):
+        """Parse 'token v1 v2 ...' lines into the vocabulary + matrix
+        (ref: embedding.py:232)."""
+        pretrained_file_path = os.path.expanduser(pretrained_file_path)
+        if not os.path.isfile(pretrained_file_path):
+            raise MXNetError(
+                f"`pretrained_file_path` must be a valid path to the "
+                f"pre-trained token embedding file: {pretrained_file_path}")
+        all_elems = []
+        tokens = set()
+        loaded_unknown_vec = None
+        with io.open(pretrained_file_path, "r", encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                elems = line.rstrip().split(elem_delim)
+                assert len(elems) > 1, (
+                    f"line {line_num} in {pretrained_file_path}: unexpected "
+                    "data format")
+                token, elems = elems[0], [float(i) for i in elems[1:]]
+                if token == self.unknown_token and \
+                        loaded_unknown_vec is None:
+                    loaded_unknown_vec = elems
+                elif token in tokens:
+                    logging.warning(
+                        "line %d in %s: duplicate embedding found for token "
+                        "%s. Skipped.", line_num, pretrained_file_path, token)
+                elif len(elems) == 1:
+                    logging.warning(
+                        "line %d in %s: token %s with 1-dimensional vector "
+                        "%s; likely a header and skipped.",
+                        line_num, pretrained_file_path, token, elems)
+                else:
+                    if self._vec_len == 0:
+                        self._vec_len = len(elems)
+                    elif len(elems) != self._vec_len:
+                        logging.warning(
+                            "line %d in %s: found vector of inconsistent "
+                            "dimension for token %s. Skipped.",
+                            line_num, pretrained_file_path, token)
+                        continue
+                    all_elems.extend(elems)
+                    self._idx_to_token.append(token)
+                    self._token_to_idx[token] = len(self._idx_to_token) - 1
+                    tokens.add(token)
+        mat = np.zeros((len(self), self._vec_len), np.float32)
+        mat[1:] = np.asarray(all_elems, np.float32).reshape(-1, self._vec_len)
+        if loaded_unknown_vec is None:
+            mat[0] = init_unknown_vec(shape=self._vec_len).asnumpy() \
+                if callable(init_unknown_vec) else 0.0
+        else:
+            mat[0] = np.asarray(loaded_unknown_vec, np.float32)
+        self._idx_to_vec = array(mat)
+
+    def _index_tokens_from_vocabulary(self, vocabulary):
+        self._token_to_idx = vocabulary.token_to_idx.copy() \
+            if vocabulary.token_to_idx is not None else None
+        self._idx_to_token = vocabulary.idx_to_token[:] \
+            if vocabulary.idx_to_token is not None else None
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = vocabulary.reserved_tokens[:] \
+            if vocabulary.reserved_tokens is not None else None
+
+    def _set_idx_to_vec_by_embeddings(self, token_embeddings, vocab_len,
+                                      vocab_idx_to_token):
+        """Build this vocabulary's matrix by querying source embeddings
+        (ref: embedding.py:314)."""
+        new_vec_len = sum(e.vec_len for e in token_embeddings)
+        new_idx_to_vec = np.zeros((vocab_len, new_vec_len), np.float32)
+        col_start = 0
+        for embed in token_embeddings:
+            col_end = col_start + embed.vec_len
+            new_idx_to_vec[1:, col_start:col_end] = embed.get_vecs_by_tokens(
+                vocab_idx_to_token[1:]).asnumpy()
+            new_idx_to_vec[0, col_start:col_end] = \
+                embed.get_vecs_by_tokens(embed.unknown_token).asnumpy()
+            col_start = col_end
+        self._vec_len = new_vec_len
+        self._idx_to_vec = array(new_idx_to_vec)
+
+    def _build_embedding_for_vocabulary(self, vocabulary):
+        if vocabulary is not None:
+            assert isinstance(vocabulary, vocab.Vocabulary), \
+                "`vocabulary` must be an instance of Vocabulary"
+            # build the matrix FIRST (queries use the loaded indexing),
+            # THEN adopt the vocabulary's indexing (ref: embedding.py:345)
+            self._set_idx_to_vec_by_embeddings(
+                [self], len(vocabulary), vocabulary.idx_to_token)
+            self._index_tokens_from_vocabulary(vocabulary)
+
+    # -- queries ----------------------------------------------------------
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Look up vectors; unknown tokens get row 0
+        (ref: embedding.py:366)."""
+        to_reduce = False
+        if not isinstance(tokens, list):
+            tokens = [tokens]
+            to_reduce = True
+        if not lower_case_backup:
+            indices = [self.token_to_idx.get(t, 0) for t in tokens]
+        else:
+            indices = [self.token_to_idx[t] if t in self.token_to_idx
+                       else self.token_to_idx.get(t.lower(), 0)
+                       for t in tokens]
+        vecs = self._idx_to_vec.asnumpy()[np.asarray(indices, np.int64)]
+        return array(vecs[0] if to_reduce else vecs)
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Overwrite vectors of known tokens (ref: embedding.py:405)."""
+        assert self._idx_to_vec is not None, \
+            "The property `idx_to_vec` has not been properly set."
+        if not isinstance(tokens, list) or len(tokens) == 1:
+            assert isinstance(new_vectors, NDArray) and \
+                len(new_vectors.shape) in (1, 2), \
+                "`new_vectors` must be a 1-D or 2-D NDArray when `tokens` " \
+                "is a single token."
+            if not isinstance(tokens, list):
+                tokens = [tokens]
+            if len(new_vectors.shape) == 1:
+                new_vectors = new_vectors.reshape((1, -1))
+        else:
+            assert isinstance(new_vectors, NDArray) and \
+                len(new_vectors.shape) == 2, \
+                "`new_vectors` must be a 2-D NDArray when `tokens` is a " \
+                "list of multiple strings."
+        assert new_vectors.shape == (len(tokens), self.vec_len), \
+            f"The length of `new_vectors` must be equal to the number of " \
+            f"tokens and the width of the vectors ({self.vec_len})."
+        indices = []
+        for token in tokens:
+            if token in self.token_to_idx:
+                indices.append(self.token_to_idx[token])
+            else:
+                raise MXNetError(
+                    f"Token {token} is unknown. To update the embedding "
+                    "vector for an unknown token, please specify it "
+                    "explicitly as the `unknown_token` "
+                    f"{self.unknown_token} in `tokens`.")
+        mat = np.array(self._idx_to_vec.asnumpy())  # asnumpy is read-only
+        mat[np.asarray(indices, np.int64)] = new_vectors.asnumpy()
+        self._idx_to_vec = array(mat)
+
+    @classmethod
+    def _check_pretrained_file_names(cls, pretrained_file_name):
+        embedding_name = cls.__name__.lower()
+        if pretrained_file_name not in cls.pretrained_file_name_sha1:
+            raise KeyError(
+                f"Cannot find pretrained file {pretrained_file_name} for "
+                f"token embedding {embedding_name}. Valid pretrained files "
+                f"for embedding {embedding_name}: "
+                f"{', '.join(cls.pretrained_file_name_sha1.keys())}")
+
+
+def _zeros_init(shape):
+    return array(np.zeros(shape, np.float32))
+
+
+@register
+class GloVe(_TokenEmbedding):
+    """GloVe embeddings (ref: embedding.py:469; Pennington et al. 2014).
+
+    Files must be pre-placed under ``<embedding_root>/glove/`` (no
+    network egress in this build)."""
+
+    # names mirror the reference's published table (sha1 elided: files
+    # are user-supplied offline, so integrity is the user's choice)
+    pretrained_file_name_sha1 = {name: "" for name in [
+        "glove.42B.300d.txt", "glove.6B.50d.txt", "glove.6B.100d.txt",
+        "glove.6B.200d.txt", "glove.6B.300d.txt", "glove.840B.300d.txt",
+        "glove.twitter.27B.25d.txt", "glove.twitter.27B.50d.txt",
+        "glove.twitter.27B.100d.txt", "glove.twitter.27B.200d.txt"]}
+
+    def __init__(self, pretrained_file_name="glove.840B.300d.txt",
+                 embedding_root=None, init_unknown_vec=_zeros_init,
+                 vocabulary=None, **kwargs):
+        GloVe._check_pretrained_file_names(pretrained_file_name)
+        super().__init__(**kwargs)
+        path = GloVe._get_pretrained_file(
+            embedding_root or _default_root(), pretrained_file_name)
+        self._load_embedding(path, " ", init_unknown_vec)
+        self._build_embedding_for_vocabulary(vocabulary)
+
+
+@register
+class FastText(_TokenEmbedding):
+    """fastText embeddings (ref: embedding.py:541; Bojanowski et al. 2017).
+
+    Files must be pre-placed under ``<embedding_root>/fasttext/``."""
+
+    pretrained_file_name_sha1 = {name: "" for name in [
+        "wiki.en.vec", "wiki.simple.vec", "wiki.zh.vec", "wiki.de.vec",
+        "wiki.fr.vec", "wiki.es.vec", "wiki.ru.vec", "wiki.ja.vec"]}
+
+    def __init__(self, pretrained_file_name="wiki.simple.vec",
+                 embedding_root=None, init_unknown_vec=_zeros_init,
+                 vocabulary=None, **kwargs):
+        FastText._check_pretrained_file_names(pretrained_file_name)
+        super().__init__(**kwargs)
+        path = FastText._get_pretrained_file(
+            embedding_root or _default_root(), pretrained_file_name)
+        self._load_embedding(path, " ", init_unknown_vec)
+        self._build_embedding_for_vocabulary(vocabulary)
+
+
+class CustomEmbedding(_TokenEmbedding):
+    """User-provided embedding file of 'token<delim>v1<delim>v2...' lines
+    (ref: embedding.py:623)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf8", init_unknown_vec=_zeros_init,
+                 vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(pretrained_file_path, elem_delim,
+                             init_unknown_vec, encoding)
+        self._build_embedding_for_vocabulary(vocabulary)
+
+
+class CompositeEmbedding(_TokenEmbedding):
+    """Concatenate multiple embeddings over one vocabulary
+    (ref: embedding.py:665)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        assert isinstance(vocabulary, vocab.Vocabulary), \
+            "`vocabulary` must be an instance of Vocabulary"
+        if not isinstance(token_embeddings, list):
+            token_embeddings = [token_embeddings]
+        for embed in token_embeddings:
+            assert isinstance(embed, _TokenEmbedding), \
+                "`token_embeddings` must be a _TokenEmbedding or list " \
+                "of them"
+        self._index_tokens_from_vocabulary(vocabulary)
+        self._set_idx_to_vec_by_embeddings(
+            token_embeddings, len(vocabulary), vocabulary.idx_to_token)

@@ -1,0 +1,24 @@
+"""Text processing utilities (ref: python/mxnet/contrib/text/utils.py)."""
+from __future__ import annotations
+
+import re
+from collections import Counter
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Count tokens in a (possibly multi-line) string
+    (ref: text/utils.py:28 count_tokens_from_str).
+
+    Splits `source_str` on both delimiters, optionally lower-cases, and
+    returns a `collections.Counter` (updating `counter_to_update` when
+    given).
+    """
+    source_str = filter(
+        None, re.split(token_delim + "|" + seq_delim, source_str))
+    if to_lower:
+        source_str = [t.lower() for t in source_str]
+    if counter_to_update is None:
+        return Counter(source_str)
+    counter_to_update.update(source_str)
+    return counter_to_update

@@ -159,12 +159,20 @@ class Module(BaseModule):
         return mesh, arg_specs
 
     # -- parameters --------------------------------------------------------
-    def init_params(self, initializer=None, arg_params=None, aux_params=None,
-                    allow_missing=False, force_init=False,
+    _UNSET = object()  # distinguishes "defaulted" from an explicit None
+
+    def init_params(self, initializer=_UNSET, arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
                     allow_extra=False):
         if self.params_initialized and not force_init:
             return
         assert self.binded, "call bind before init_params"
+        if initializer is Module._UNSET:
+            # the reference's signature default is Uniform(0.01)
+            # (python/mxnet/module/module.py init_params); an explicit
+            # None (the set_params path) keeps missing params untouched
+            from ..initializer import Uniform
+            initializer = Uniform(0.01)
 
         for name in self._param_names:
             arr = self._exec.arg_dict[name]

@@ -38,9 +38,7 @@ def make_op_func(op):
             if pname in kwargs:
                 v = kwargs.pop(pname)
                 inputs.append(v if (v is None or isinstance(v, NDArray)) else NDArray(v))
-        if "num_args" not in op._kwarg_names:
-            kwargs.pop("num_args", None)
-        # drop any remaining tensor-valued kwargs into inputs (variadic ops)
+        # num_args filtering happens in invoke() for every call path
         return invoke(op, inputs, kwargs, out=out)
 
     op_func.__name__ = op.name

@@ -288,19 +288,63 @@ def softmax_cross_entropy(data, label):
     return -jnp.sum(picked)
 
 
+def _regression_closure(grad_scale, fwd, bwd):
+    """Loss-layer contract shared by the regression heads (ref:
+    src/operator/regression_output-inl.h:190-206): forward transforms the
+    data, backward REPLACES the head gradient with
+    BackwardOp(out, label) * grad_scale / num_output."""
+
+    @jax.custom_vjp
+    def f(data, label):
+        return fwd(data)
+
+    def f_fwd(data, label):
+        out = fwd(data)
+        return out, (out, label)
+
+    def f_bwd(res, g):
+        out, label = res
+        lab = label.reshape(out.shape) if label.size == out.size \
+            else jnp.broadcast_to(label.reshape(label.shape + (1,) * (
+                out.ndim - label.ndim)), out.shape)
+        num_output = max(int(np.prod(out.shape[1:])), 1)
+        grad = bwd(out, lab) * (grad_scale / num_output)
+        return grad.astype(out.dtype), jnp.zeros_like(label)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+@functools.lru_cache(maxsize=None)
+def _linear_reg_closure(grad_scale):
+    return _regression_closure(grad_scale, lambda d: d, lambda o, l: o - l)
+
+
+@functools.lru_cache(maxsize=None)
+def _mae_reg_closure(grad_scale):
+    return _regression_closure(grad_scale, lambda d: d,
+                               lambda o, l: jnp.sign(o - l))
+
+
+@functools.lru_cache(maxsize=None)
+def _logistic_reg_closure(grad_scale):
+    return _regression_closure(grad_scale, jax.nn.sigmoid,
+                               lambda o, l: o - l)
+
+
 @register("LinearRegressionOutput")
 def linear_regression_output(data, label, grad_scale=1.0):
-    return data
+    return _linear_reg_closure(float(grad_scale))(data, label)
 
 
 @register("MAERegressionOutput")
 def mae_regression_output(data, label, grad_scale=1.0):
-    return data
+    return _mae_reg_closure(float(grad_scale))(data, label)
 
 
 @register("LogisticRegressionOutput")
 def logistic_regression_output(data, label, grad_scale=1.0):
-    return jax.nn.sigmoid(data)
+    return _logistic_reg_closure(float(grad_scale))(data, label)
 
 
 # ---------------------------------------------------------------------------

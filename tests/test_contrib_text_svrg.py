@@ -1,0 +1,218 @@
+"""contrib.text (vocab + embeddings), contrib.svrg_optimization, and the
+tensorboard bridge (ref: tests/python/unittest/test_contrib_text.py,
+test_contrib_svrg_module.py / test_contrib_svrg_optimizer.py)."""
+import logging
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.contrib import text
+from mxnet_tpu.contrib.svrg_optimization import SVRGModule
+
+
+def test_count_tokens_from_str():
+    source = "life is great ! \n life is good ! \n"
+    c = text.utils.count_tokens_from_str(source)
+    assert c == Counter({"life": 2, "is": 2, "!": 2, "great": 1, "good": 1})
+    c2 = text.utils.count_tokens_from_str(source, to_lower=True,
+                                          counter_to_update=Counter(["life"]))
+    assert c2["life"] == 3
+
+
+def test_vocabulary_indexing():
+    counter = Counter(["a", "b", "b", "c", "c", "c", "some_word$"])
+    v = text.vocab.Vocabulary(counter, most_freq_count=None, min_freq=1,
+                              unknown_token="<unk>",
+                              reserved_tokens=["<pad>"])
+    assert len(v) == 6
+    assert v.token_to_idx["<unk>"] == 0
+    assert v.token_to_idx["<pad>"] == 1
+    assert v.idx_to_token[2] == "c"   # most frequent first
+    assert v.to_indices("c") == 2
+    assert v.to_indices(["c", "never_seen"]) == [2, 0]
+    assert v.to_tokens([0, 2]) == ["<unk>", "c"]
+    with pytest.raises(Exception):
+        v.to_tokens(100)
+    # min_freq filters
+    v2 = text.vocab.Vocabulary(counter, min_freq=2)
+    assert set(v2.idx_to_token) == {"<unk>", "b", "c"}
+    # most_freq_count caps
+    v3 = text.vocab.Vocabulary(counter, most_freq_count=2)
+    assert len(v3) == 3
+
+
+@pytest.fixture
+def embed_file(tmp_path):
+    p = tmp_path / "my_embed.txt"
+    p.write_text("a 0.1 0.2 0.3\nb 1.0 2.0 3.0\nc -1.0 -2.0 -3.0\n")
+    return str(p)
+
+
+def test_custom_embedding(embed_file):
+    e = text.embedding.CustomEmbedding(embed_file)
+    assert e.vec_len == 3
+    assert len(e) == 4  # <unk> + 3 tokens
+    np.testing.assert_allclose(
+        e.get_vecs_by_tokens("b").asnumpy(), [1.0, 2.0, 3.0])
+    # unknown -> zeros (init_unknown_vec default)
+    np.testing.assert_allclose(
+        e.get_vecs_by_tokens("zzz").asnumpy(), [0, 0, 0])
+    # batch + lower-case backup
+    np.testing.assert_allclose(
+        e.get_vecs_by_tokens(["A", "c"], lower_case_backup=True).asnumpy(),
+        [[0.1, 0.2, 0.3], [-1.0, -2.0, -3.0]], rtol=1e-6)
+    # update
+    e.update_token_vectors("a", nd.array(np.array([9.0, 9.0, 9.0],
+                                                  np.float32)))
+    np.testing.assert_allclose(
+        e.get_vecs_by_tokens("a").asnumpy(), [9, 9, 9])
+    with pytest.raises(Exception):
+        e.update_token_vectors("not_there",
+                               nd.array(np.zeros(3, np.float32)))
+
+
+def test_embedding_with_vocabulary(embed_file):
+    counter = Counter(["a", "a", "c", "d"])
+    v = text.vocab.Vocabulary(counter)
+    e = text.embedding.CustomEmbedding(embed_file, vocabulary=v)
+    # matrix follows the vocabulary's indexing, d (not in file) -> zeros
+    assert len(e) == len(v)
+    np.testing.assert_allclose(
+        e.idx_to_vec.asnumpy()[v.token_to_idx["c"]], [-1, -2, -3])
+    np.testing.assert_allclose(
+        e.idx_to_vec.asnumpy()[v.token_to_idx["d"]], [0, 0, 0])
+
+
+def test_composite_embedding(embed_file, tmp_path):
+    p2 = tmp_path / "second.txt"
+    p2.write_text("a 7.0 7.5\nd 8.0 8.5\n")
+    e1 = text.embedding.CustomEmbedding(embed_file)
+    e2 = text.embedding.CustomEmbedding(str(p2))
+    v = text.vocab.Vocabulary(Counter(["a", "d"]))
+    comp = text.embedding.CompositeEmbedding(v, [e1, e2])
+    assert comp.vec_len == 5
+    np.testing.assert_allclose(
+        comp.get_vecs_by_tokens("a").asnumpy(), [0.1, 0.2, 0.3, 7.0, 7.5],
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        comp.get_vecs_by_tokens("d").asnumpy(), [0, 0, 0, 8.0, 8.5])
+
+
+def test_embedding_registry():
+    assert "glove" in text.embedding.get_pretrained_file_names()
+    names = text.embedding.get_pretrained_file_names("fasttext")
+    assert "wiki.simple.vec" in names
+    # offline build: a missing pretrained file raises a clear error
+    with pytest.raises(Exception, match="not found"):
+        text.embedding.create("glove",
+                              pretrained_file_name="glove.6B.50d.txt")
+
+
+def _toy_regression_iter(n=200, batch=20, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, 4)).astype(np.float32)
+    w = np.array([1.0, -2.0, 3.0, 0.5], np.float32)
+    y = X @ w + 0.01 * rng.standard_normal(n).astype(np.float32)
+    return mx.io.NDArrayIter(X, y.reshape(-1, 1), batch_size=batch,
+                             label_name="lin_reg_label")
+
+
+def _linreg_symbol():
+    import mxnet_tpu.symbol as sym
+    data = sym.var("data")
+    label = sym.var("lin_reg_label")
+    fc = sym.FullyConnected(data, num_hidden=1, name="fc")
+    return sym.LinearRegressionOutput(fc, label, name="lin_reg")
+
+
+def test_svrg_module_trains():
+    train = _toy_regression_iter()
+    mod = SVRGModule(_linreg_symbol(), data_names=("data",),
+                     label_names=("lin_reg_label",), update_freq=2)
+    mod.fit(train, num_epoch=8, eval_metric="mse",
+            optimizer_params={"learning_rate": 0.1})
+    # loss must be tiny: the model is exactly realizable
+    train.reset()
+    m = mx.metric.create("mse")
+    mod.score(train, m)
+    assert m.get()[1] < 0.01, m.get()
+
+
+def test_svrg_full_grads_and_adjustment():
+    train = _toy_regression_iter(n=40, batch=20)
+    mod = SVRGModule(_linreg_symbol(), data_names=("data",),
+                     label_names=("lin_reg_label",), update_freq=1)
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.0})
+    mod.update_full_grads(train)
+    assert "fc_weight" in mod._full_grads
+    # at the snapshot weights, E[g_batch - g_special] = 0, so the SVRG
+    # gradient equals the full gradient
+    train.reset()
+    batch = next(iter(train))
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod._update_svrg_gradients()
+    g = mod._exec.grad_dict["fc_weight"].asnumpy()
+    g_special = mod._mod_aux._exec.grad_dict["fc_weight"].asnumpy()
+    full = mod._full_grads["fc_weight"].asnumpy()
+    np.testing.assert_allclose(g, g_special - g_special + full, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_svrg_update_freq_validation():
+    with pytest.raises(ValueError):
+        SVRGModule(_linreg_symbol(), update_freq=0)
+
+
+def test_svrg_optimizer_dispatch():
+    from mxnet_tpu.contrib.svrg_optimization.svrg_optimizer import \
+        _SVRGOptimizer
+    opt = _SVRGOptimizer("sgd", learning_rate=0.5)
+    w = nd.array(np.ones(3, np.float32))
+    g = nd.array(np.full(3, 0.2, np.float32))
+    opt.update(0, w, g, opt.create_state(0, w))
+    np.testing.assert_allclose(w.asnumpy(), 1 - 0.5 * 0.2, rtol=1e-5)
+    # full-grad keys assign instead of stepping
+    w2 = nd.array(np.ones(3, np.float32))
+    opt.update("fc_weight_full", w2, g, None)
+    np.testing.assert_allclose(w2.asnumpy(), 0.2, rtol=1e-6)
+
+
+def test_tensorboard_callback_soft_failure(tmp_path, caplog):
+    from mxnet_tpu.contrib.tensorboard import LogMetricsCallback
+    cb = LogMetricsCallback(str(tmp_path / "logs"))
+    m = mx.metric.create("acc")
+    m.update([nd.array(np.array([0, 1], np.float32))],
+             [nd.array(np.array([[0.9, 0.1], [0.2, 0.8]], np.float32))])
+
+    class P:
+        epoch = 0
+        eval_metric = m
+    # must not raise whether or not a writer backend is installed
+    cb(P())
+
+
+def test_init_params_partial_arg_params_uses_default_init():
+    """Missing params with allow_missing=True get the reference's default
+    Uniform(0.01) init instead of silently staying zero
+    (ref: module.py init_params signature default)."""
+    import mxnet_tpu.symbol as sym
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, num_hidden=4, name="fc1")
+    fc2 = sym.FullyConnected(fc1, num_hidden=2, name="fc2")
+    mod = mx.module.Module(fc2, data_names=("data",), label_names=())
+    mod.bind(data_shapes=[("data", (2, 3))], label_shapes=None,
+             for_training=True)
+    w1 = np.full((4, 3), 0.5, np.float32)
+    mod.init_params(arg_params={"fc1_weight": nd.array(w1)},
+                    allow_missing=True)
+    arg, _ = mod.get_params()
+    np.testing.assert_allclose(arg["fc1_weight"].asnumpy(), w1)
+    assert np.abs(arg["fc2_weight"].asnumpy()).sum() > 0  # got initialized
