@@ -19,6 +19,28 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+import os as _os
+
+if (_os.environ.get("DMLC_ROLE") == "worker"
+        and _os.environ.get("DMLC_NUM_SERVER") == "0"
+        and _os.environ.get("DMLC_PS_ROOT_URI")
+        and not _os.environ.get("_MXTPU_DIST_JOINED")):
+    # serverless (collective) dist job from tools/launch.py -s 0: the
+    # jax.distributed runtime must come up before ANY XLA backend touch,
+    # so join the mesh at import — the analogue of ps-lite reading its
+    # DMLC_* env at library init (ref: src/kvstore/kvstore_dist.h:44,
+    # python/mxnet/kvstore_server.py import-time server entry)
+    import jax as _jax
+
+    _jax.distributed.initialize(
+        coordinator_address="%s:%s" % (_os.environ["DMLC_PS_ROOT_URI"],
+                                       _os.environ["DMLC_PS_ROOT_PORT"]),
+        num_processes=int(_os.environ.get("DMLC_NUM_WORKER", "1")),
+        process_id=int(_os.environ.get("DMLC_WORKER_ID", "0")))
+    # children of this worker inherit the DMLC_* env; this marker stops
+    # them from rejoining the mesh with a duplicate process_id
+    _os.environ["_MXTPU_DIST_JOINED"] = "1"
+
 from .base import MXNetError, get_env
 from .context import (Context, cpu, cpu_pinned, current_context, gpu, num_gpus,
                       num_tpus, tpu)

@@ -30,10 +30,12 @@ class _SVRGOptimizer(_opt.Optimizer):
     keys to the user's optimizer (ref: svrg_optimizer.py:51)."""
 
     def __init__(self, default_optimizer, **kwargs):
-        base_kwargs = self._check_params(**kwargs)
-        super().__init__(**base_kwargs)
+        # base class takes only Optimizer.__init__ params; the created
+        # optimizer gets the FULL kwargs so sgd momentum / adam betas
+        # survive (ref: svrg_optimizer.py:64-75 _check_params)
+        super().__init__(**self._check_params(**kwargs))
         if isinstance(default_optimizer, str):
-            self.default_opt = _opt.create(default_optimizer, **base_kwargs)
+            self.default_opt = _opt.create(default_optimizer, **kwargs)
         else:
             self.default_opt = default_optimizer
         self.aux_opt = _AssignmentOptimizer()

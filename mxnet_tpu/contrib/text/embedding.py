@@ -192,7 +192,10 @@ class _TokenEmbedding(vocab.Vocabulary):
             indices = [self.token_to_idx[t] if t in self.token_to_idx
                        else self.token_to_idx.get(t.lower(), 0)
                        for t in tokens]
-        vecs = self._idx_to_vec.asnumpy()[np.asarray(indices, np.int64)]
+        # gather on device, fetch only the selected rows (a host copy of
+        # the whole matrix per lookup would be ~GBs for glove.840B)
+        vecs = np.asarray(
+            self._idx_to_vec._data[np.asarray(indices, np.int64)])
         return array(vecs[0] if to_reduce else vecs)
 
     def update_token_vectors(self, tokens, new_vectors):

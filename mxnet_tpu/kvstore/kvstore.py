@@ -20,6 +20,13 @@ from ..ndarray.sparse import RowSparseNDArray
 from ..optimizer import Updater
 
 
+def _jax_process_count():
+    try:
+        return jax.process_count()
+    except Exception:  # backend not yet initialized
+        return 1
+
+
 class KVStore:
     def __init__(self, kv_type="local"):
         self.type = kv_type
@@ -28,11 +35,24 @@ class KVStore:
         self._optimizer = None
         self._compression = None
         self._conn = None
+        self._coll = None
         self._update_on_server = False
         if kv_type.startswith("dist"):
             import os
             from . import dist
-            if dist.role() == "worker" and \
+            serverless = os.environ.get("DMLC_NUM_SERVER") == "0"
+            if kv_type in ("dist_device_sync", "dist_sync_device") and \
+                    (serverless or _jax_process_count() > 1):
+                # device-sync = collective data plane: gradients are
+                # all-reduced by XLA over ICI/DCN inside the mesh, no
+                # parameter-server hop (SURVEY §5.8; the reference's
+                # analogue reduces on GPUs instead of the PS). Launch
+                # with `tools/launch.py -s 0`; with servers present
+                # (-s >= 1) device_sync falls through to the PS
+                # transport like plain dist_sync.
+                from .collective import CollectiveConn
+                self._coll = CollectiveConn.get()
+            elif dist.role() == "worker" and \
                     os.environ.get("DMLC_PS_ROOT_URI"):
                 self._conn = dist.connect_workers()
                 sync = "async" not in kv_type
@@ -45,6 +65,8 @@ class KVStore:
     def rank(self):
         if self._conn is not None:
             return self._conn.rank
+        if self._coll is not None:
+            return self._coll.rank
         # single-process SPMD: jax process index is the worker rank
         return jax.process_index()
 
@@ -52,11 +74,22 @@ class KVStore:
     def num_workers(self):
         if self._conn is not None:
             return self._conn.num_workers
+        if self._coll is not None:
+            return self._coll.num_workers
         return jax.process_count() if self.type.startswith("dist") else 1
 
     # -- data plane --------------------------------------------------------
     def init(self, key, value):
         keys, values = self._normalize(key, value)
+        if self._coll is not None:
+            # rank 0's values seed everyone (kvstore_dist.h Init contract)
+            for k, v in zip(keys, values):
+                dense = v.tostype("default") \
+                    if isinstance(v, RowSparseNDArray) else v
+                seeded = self._coll.broadcast(dense.asnumpy(), root=0)
+                self._store[k] = NDArray(
+                    jnp.asarray(seeded, dtype=dense._data.dtype))
+            return
         for k, v in zip(keys, values):
             self._store[k] = v.copy() if isinstance(v, NDArray) else v
             if self._conn is not None:
@@ -86,6 +119,21 @@ class KVStore:
                 v = agg
             if k not in self._store:
                 raise MXNetError(f"key {k} not initialized in kvstore")
+            if self._coll is not None:
+                # one BSP all-reduce per push round; every worker gets the
+                # identical aggregate, so a local updater stays consistent
+                # everywhere (the reference's server-side update becomes
+                # an SPMD-replicated update)
+                if isinstance(v, RowSparseNDArray):
+                    v = v.tostype("default")
+                agg = NDArray(jnp.asarray(
+                    self._coll.allreduce(v.asnumpy()),
+                    dtype=self._store[k]._data.dtype))
+                if self._updater is not None:
+                    self._updater(self._key_index(k), agg, self._store[k])
+                else:
+                    self._store[k]._data = agg._data
+                continue
             if self._conn is not None:
                 import numpy as np
                 if isinstance(v, RowSparseNDArray):
@@ -169,7 +217,12 @@ class KVStore:
         (python/mxnet/kvstore.py:450-495) — pushes then carry gradients
         and pulls return server-updated weights."""
         self._optimizer = optimizer
-        if self._conn is not None:
+        if self._coll is not None:
+            # every process applies the same update to the same aggregate
+            # — state stays replicated, checkpointable locally
+            self._updater = Updater(optimizer)
+            self._coll.barrier()
+        elif self._conn is not None:
             if self._conn.rank == 0:
                 self._conn.send_optimizer(optimizer)
             self._conn.barrier()
@@ -212,6 +265,9 @@ class KVStore:
         if self._conn is not None:
             self._conn.barrier()
             return
+        if self._coll is not None:
+            self._coll.barrier()
+            return
         from .. import engine
         engine.waitall()
 
@@ -226,6 +282,8 @@ class KVStore:
     def close(self):
         """Finalize: barrier all workers, rank 0 stops the server (the
         ps-lite Finalize analogue)."""
+        if self._coll is not None:
+            self._coll.barrier()
         if self._conn is not None:
             try:
                 self._conn.barrier()

@@ -1,0 +1,75 @@
+"""Worker body for the collective (no-server) dist_device_sync kvstore.
+
+Launched by tools/launch.py -s 0 -n N: every worker joins the
+jax.distributed mesh and gradients all-reduce over XLA collectives —
+no parameter-server process exists (SURVEY §5.8 north star; the
+reference analogue is dist_device_sync's GPU-side reduce,
+src/kvstore/kvstore.cc:55).
+"""
+import os
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+rank_env = int(os.environ.get("DMLC_WORKER_ID", "0"))
+
+kv = mx.kv.create("dist_device_sync")
+assert kv._coll is not None, "collective data plane must be active"
+rank, n = kv.rank, kv.num_workers
+assert rank == rank_env, (rank, rank_env)
+
+# init: rank 0 seeds every worker
+kv.init("w", nd.array(np.full((3, 2), float(rank + 1), np.float32)))
+got = nd.zeros((3, 2))
+kv.pull("w", out=got)
+np.testing.assert_allclose(got.asnumpy(), 1.0)  # rank 0's value
+
+# push/pull: sum across workers
+kv.push("w", nd.array(np.full((3, 2), float(rank + 1), np.float32)))
+kv.pull("w", out=got)
+expect = sum(r + 1 for r in range(n))
+np.testing.assert_allclose(got.asnumpy(), expect)
+
+# updater path: identical SGD step on every worker
+kv2 = mx.kv.create("dist_device_sync")
+kv2.init(3, nd.ones((4,)))
+kv2.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+kv2.push(3, nd.array(np.full((4,), float(rank + 1), np.float32)))
+out = nd.zeros((4,))
+kv2.pull(3, out=out)
+# grad sum = n(n+1)/2, lr 0.1 (no wd on plain SGD default? wd=0)
+np.testing.assert_allclose(out.asnumpy(),
+                           1.0 - 0.1 * (n * (n + 1) / 2), rtol=1e-5,
+                           atol=1e-6)
+
+# e2e: Gluon Trainer training with sharded data converges identically
+from mxnet_tpu import autograd, gluon
+
+rng = np.random.default_rng(0)
+X = rng.standard_normal((64, 4)).astype(np.float32)
+w_true = np.array([[1.0], [-2.0], [3.0], [0.5]], np.float32)
+y = X @ w_true
+shard = slice(rank * (64 // n), (rank + 1) * (64 // n))
+
+net = gluon.nn.Dense(1, use_bias=False)
+net.initialize()
+# materialize params; Trainer's kvstore.init then broadcasts rank 0's
+# values so every worker starts identical
+_ = net(nd.array(X[:2]))
+trainer = gluon.Trainer(net.collect_params(), "sgd",
+                        {"learning_rate": 0.05},
+                        kvstore="dist_device_sync")
+for step in range(120):
+    with autograd.record():
+        loss = ((net(nd.array(X[shard])) -
+                 nd.array(y[shard])) ** 2).mean()
+    loss.backward()
+    trainer.step(batch_size=1)
+final_w = list(net.collect_params().values())[0].data().asnumpy()
+np.testing.assert_allclose(final_w.ravel(), w_true.ravel(), atol=0.05)
+
+kv.barrier()
+print(f"[worker {rank}] OK", flush=True)

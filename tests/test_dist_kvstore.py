@@ -107,3 +107,23 @@ def test_gradient_compression_numerics():
     _, decoded2 = gc.quantize("k", np.zeros_like(grad))
     np.testing.assert_allclose(
         decoded2, [0.0, 0.0, 0.0, 0.5, 0.0, 0.0], atol=1e-6)
+
+
+def test_dist_device_sync_collective_no_server():
+    """Serverless dist_device_sync: gradients all-reduce through XLA
+    collectives over the jax.distributed mesh — the SURVEY §5.8 TPU
+    contract (no PS hop). 4 workers, -s 0."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PALLAS_AXON_POOL_IPS"] = ""  # keep the TPU tunnel free
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "4", "-s", "0", sys.executable,
+         os.path.join(REPO, "tests", "dist_device_sync_collective.py")],
+        env=env, capture_output=True, text=True, timeout=300)
+    sys.stdout.write(proc.stdout)
+    sys.stderr.write(proc.stderr)
+    assert proc.returncode == 0, "collective dist job failed"
+    for i in range(4):
+        assert f"[worker {i}] OK" in proc.stdout

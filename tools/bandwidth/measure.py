@@ -56,15 +56,38 @@ def measure_allreduce(size, iters=20, warmup=3):
     return dt, busbw, n
 
 
+def measure_dist_allreduce(size, iters=20, warmup=3):
+    """Cross-process path: the dist_device_sync kvstore's collective
+    data plane (DCN analogue). Run under tools/launch.py -s 0 -n W."""
+    from mxnet_tpu.kvstore.collective import CollectiveConn
+
+    conn = CollectiveConn.get()
+    x = np.ones(size, np.float32)
+    for _ in range(warmup):
+        conn.allreduce(x)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        conn.allreduce(x)
+    dt = (time.perf_counter() - t0) / iters
+    n = conn.num_workers
+    busbw = 2 * (n - 1) / max(n, 1) * size * 4 / dt
+    return dt, busbw, n
+
+
 if __name__ == "__main__":
     p = argparse.ArgumentParser()
     p.add_argument("--sizes", type=str, default="1e5,1e6,1e7",
                    help="comma-separated element counts per device")
     p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--dist", action="store_true",
+                   help="measure the cross-process kvstore collective "
+                   "(launch via tools/launch.py -s 0 -n W)")
     args = p.parse_args()
 
+    fn = measure_dist_allreduce if args.dist else measure_allreduce
+    kind = "dist-allreduce" if args.dist else "allreduce"
     for s in args.sizes.split(","):
         size = int(float(s))
-        dt, busbw, n = measure_allreduce(size, args.iters)
-        print("allreduce %d x %.0e f32: %.3f ms/iter, busbw %.2f GB/s"
-              % (n, size, dt * 1e3, busbw / 1e9))
+        dt, busbw, n = fn(size, args.iters)
+        print("%s %d x %.0e f32: %.3f ms/iter, busbw %.2f GB/s"
+              % (kind, n, size, dt * 1e3, busbw / 1e9))

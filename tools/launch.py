@@ -47,7 +47,11 @@ def main(argv=None):
     parser.add_argument("-s", "--num-servers", type=int, default=1,
                         help="parameter servers; keys are sharded "
                         "across them and big arrays are sliced "
-                        "(ref: kvstore_dist.h EncodeDefaultKey)")
+                        "(ref: kvstore_dist.h EncodeDefaultKey). "
+                        "-s 0 starts no servers: workers use the "
+                        "collective data plane (dist_device_sync), "
+                        "rendezvousing on worker 0's jax coordinator "
+                        "at DMLC_PS_ROOT_URI:PORT")
     parser.add_argument("--launcher", default="local",
                         choices=["local"])
     parser.add_argument("--env-server", default="",
@@ -57,8 +61,8 @@ def main(argv=None):
     if not args.command:
         parser.error("no command given")
 
-    nserv = max(args.num_servers, 1)
-    port = _free_port(span=nserv)
+    nserv = max(args.num_servers, 0)
+    port = _free_port(span=max(nserv, 1))
     base_env = dict(os.environ)
     base_env.update({
         "DMLC_PS_ROOT_URI": "127.0.0.1",
