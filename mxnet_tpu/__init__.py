@@ -24,6 +24,7 @@ import os as _os
 if (_os.environ.get("DMLC_ROLE") == "worker"
         and _os.environ.get("DMLC_NUM_SERVER") == "0"
         and _os.environ.get("DMLC_PS_ROOT_URI")
+        and _os.environ.get("DMLC_PS_ROOT_PORT")
         and not _os.environ.get("_MXTPU_DIST_JOINED")):
     # serverless (collective) dist job from tools/launch.py -s 0: the
     # jax.distributed runtime must come up before ANY XLA backend touch,

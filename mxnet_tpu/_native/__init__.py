@@ -147,3 +147,67 @@ def set_server_updater(py_fn):
     cb = UPDATER_CFUNC(trampoline)
     _updater_keepalive.append(cb)
     lib.mxtpu_server_set_updater(ctypes.cast(cb, ctypes.c_void_p))
+
+
+_core_lib = None
+
+ENGINE_OP_CFUNC = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)
+
+
+def load_core():
+    """The native runtime core (core.cc): host storage pool, dependency
+    engine, C API error shim."""
+    global _core_lib
+    if _core_lib is not None:
+        return _core_lib
+    src = os.path.join(_HERE, "core.cc")
+    out = os.path.join(_HERE, "libmxtpu_core.so")
+    _build(src, out)
+    lib = ctypes.CDLL(out)
+    lib.mxtpu_version.restype = ctypes.c_int
+    lib.mxtpu_get_last_error.restype = ctypes.c_char_p
+    lib.mxtpu_storage_alloc.restype = ctypes.c_void_p
+    lib.mxtpu_storage_alloc.argtypes = [ctypes.c_size_t]
+    lib.mxtpu_storage_free.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_storage_direct_free.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_storage_stats.argtypes = [ctypes.POINTER(ctypes.c_uint64)]
+    lib.mxtpu_engine_start.restype = ctypes.c_int
+    lib.mxtpu_engine_start.argtypes = [ctypes.c_int]
+    lib.mxtpu_engine_new_var.restype = ctypes.c_int64
+    lib.mxtpu_engine_delete_var.argtypes = [ctypes.c_int64]
+    lib.mxtpu_engine_push.restype = ctypes.c_int
+    lib.mxtpu_engine_push.argtypes = [
+        ENGINE_OP_CFUNC, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+    lib.mxtpu_engine_wait_for_var.restype = ctypes.c_int
+    lib.mxtpu_engine_wait_for_var.argtypes = [ctypes.c_int64]
+    lib.mxtpu_engine_wait_all.restype = ctypes.c_int
+    _core_lib = lib
+    return lib
+
+
+def pooled_empty(shape, dtype="float32"):
+    """A numpy array backed by the native host storage pool
+    (core.cc StoragePool — the CPUPinned staging-buffer analogue,
+    ref: src/storage/pooled_storage_manager.h). The buffer returns to
+    the pool when the array is garbage collected, so steady-state batch
+    loops allocate no new host memory."""
+    import weakref
+
+    import numpy as np
+
+    lib = load_core()
+    nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+    ptr = lib.mxtpu_storage_alloc(nbytes)
+    if not ptr:
+        raise MemoryError(lib.mxtpu_get_last_error().decode())
+    buf = (ctypes.c_char * nbytes).from_address(ptr)
+    arr = np.frombuffer(buf, dtype=dtype).reshape(shape)
+    arr.flags.writeable = True
+    # finalize on `buf`, not `arr`: every numpy view of arr chains to buf
+    # as its base (numpy collapses bases), so the buffer returns to the
+    # pool only when the LAST view dies — finalizing arr would recycle
+    # memory still referenced by live views
+    weakref.finalize(buf, lib.mxtpu_storage_free, ptr)
+    return arr

@@ -1,0 +1,418 @@
+// Native runtime core: storage pool + dependency engine + C API shim.
+//
+// The reference keeps these native (SURVEY §2.1/§2.7):
+//  - Storage: pooled per-device allocators behind one singleton
+//    (src/storage/pooled_storage_manager.h:52 GPUPooledStorageManager,
+//    src/storage/storage.cc:36). On TPU, device memory belongs to PJRT;
+//    the native pool owns the HOST side: aligned, size-bucketed, reused
+//    buffers feeding the data pipeline (the CPUPinned/shm analogue —
+//    batch staging buffers that would otherwise be malloc'd per batch).
+//  - Dependency engine: ops are closures with read/write variable sets;
+//    writers to a var serialize in push order, readers run concurrently
+//    (include/mxnet/engine.h:115, src/engine/threaded_engine.h:66
+//    ThreadedVar). XLA schedules device work; this engine schedules the
+//    HOST side of the framework (decode, prefetch, file IO) with the
+//    same dependency discipline, and is the MXNET_ENGINE_TYPE seam.
+//  - C API: flat extern-C ABI with thread-local error strings
+//    (include/mxnet/c_api.h MXGetLastError; src/c_api/).
+//
+// C ABI (ctypes):
+//   mxtpu_version() -> int
+//   mxtpu_get_last_error() -> const char*      (thread-local)
+//   -- storage --
+//   mxtpu_storage_alloc(size_t) -> void*
+//   mxtpu_storage_free(void*)                  (returns to pool)
+//   mxtpu_storage_direct_free(void*)           (bypasses pool)
+//   mxtpu_storage_release_all()
+//   mxtpu_storage_stats(uint64_t out[4])       (alloc'd, pooled bytes,
+//                                               hits, misses)
+//   -- engine --
+//   mxtpu_engine_start(int nthreads) -> int
+//   mxtpu_engine_new_var() -> int64
+//   mxtpu_engine_push(fn, arg, read[], nread, write[], nwrite) -> int
+//        fn: int(*)(void* arg); nonzero return marks the op failed and
+//        poisons its write vars (rethrown at wait, threaded_engine.cc:472)
+//   mxtpu_engine_wait_for_var(int64) -> int    (0 ok, -1 poisoned)
+//   mxtpu_engine_wait_all() -> int
+//   mxtpu_engine_stop()
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+thread_local std::string tls_error;
+
+// ---------------------------------------------------------------------
+// storage pool (ref: pooled_storage_manager.h round-to-bucket free lists)
+// ---------------------------------------------------------------------
+class StoragePool {
+ public:
+  static StoragePool& Get() {
+    static StoragePool inst;
+    return inst;
+  }
+
+  void* Alloc(size_t size) {
+    size_t bucket = RoundSize(size);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = free_.find(bucket);
+      if (it != free_.end() && !it->second.empty()) {
+        void* p = it->second.back();
+        it->second.pop_back();
+        pooled_bytes_ -= bucket;
+        ++hits_;
+        sizes_[p] = bucket;
+        return p;
+      }
+      ++misses_;
+    }
+    void* p = aligned_alloc(64, bucket);
+    if (p == nullptr) {
+      tls_error = "mxtpu_storage_alloc: out of host memory";
+      return nullptr;
+    }
+    std::lock_guard<std::mutex> lk(mu_);
+    alloc_bytes_ += bucket;
+    sizes_[p] = bucket;
+    return p;
+  }
+
+  void Free(void* p) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = sizes_.find(p);
+    if (it == sizes_.end()) return;
+    free_[it->second].push_back(p);
+    pooled_bytes_ += it->second;
+  }
+
+  void DirectFree(void* p) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = sizes_.find(p);
+    if (it == sizes_.end()) return;
+    alloc_bytes_ -= it->second;
+    sizes_.erase(it);
+    ::free(p);
+  }
+
+  void ReleaseAll() {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& kv : free_) {
+      for (void* p : kv.second) {
+        alloc_bytes_ -= kv.first;
+        sizes_.erase(p);
+        ::free(p);
+      }
+    }
+    free_.clear();
+    pooled_bytes_ = 0;
+  }
+
+  void Stats(uint64_t out[4]) {
+    std::lock_guard<std::mutex> lk(mu_);
+    out[0] = alloc_bytes_;
+    out[1] = pooled_bytes_;
+    out[2] = hits_;
+    out[3] = misses_;
+  }
+
+ private:
+  static size_t RoundSize(size_t size) {
+    // round small sizes to the next power of two, large ones to 4 KiB
+    // pages (ref: GPUPooledStorageManager MXNET_GPU_MEM_POOL_PAGE_SIZE)
+    if (size < 64) return 64;
+    if (size <= (1u << 20)) {
+      size_t b = 64;
+      while (b < size) b <<= 1;
+      return b;
+    }
+    return (size + 4095) & ~size_t(4095);
+  }
+
+  std::mutex mu_;
+  std::unordered_map<size_t, std::vector<void*>> free_;
+  std::unordered_map<void*, size_t> sizes_;
+  uint64_t alloc_bytes_ = 0, pooled_bytes_ = 0, hits_ = 0, misses_ = 0;
+};
+
+// ---------------------------------------------------------------------
+// dependency engine (ref: threaded_engine.h ThreadedVar/OprBlock)
+// ---------------------------------------------------------------------
+using OpFn = int (*)(void*);
+
+struct Opr;
+
+struct Var {
+  // reader-writer dependency queue, the ThreadedVar discipline:
+  // pending ops in push order; reads at the head run together, a write
+  // runs alone after all prior reads complete.
+  std::deque<std::pair<Opr*, bool>> queue;  // (op, is_write)
+  int running_reads = 0;
+  bool running_write = false;
+  bool poisoned = false;  // a writer failed (exception propagation)
+};
+
+struct Opr {
+  OpFn fn;
+  void* arg;
+  std::vector<int64_t> reads, writes;
+  std::atomic<int> wait{0};
+};
+
+class Engine {
+ public:
+  static Engine& Get() {
+    static Engine inst;
+    return inst;
+  }
+
+  ~Engine() { Stop(); }  // joinable threads at static destruction
+                         // would std::terminate
+
+  int Start(int nthreads) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (running_) return 0;
+    if (nthreads <= 0) {
+      const char* env = getenv("MXNET_CPU_WORKER_NTHREADS");
+      nthreads = env ? atoi(env) : (int)std::thread::hardware_concurrency();
+      // host tasks are IO-bound: floor at 4 threads even on small hosts
+      // (the reference keeps a 4-thread CPU priority pool,
+      // threaded_engine_perdevice.cc:76-90)
+      if (!env && nthreads < 4) nthreads = 4;
+      if (nthreads <= 0) nthreads = 1;
+    }
+    running_ = true;
+    for (int i = 0; i < nthreads; ++i)
+      workers_.emplace_back([this] { WorkerLoop(); });
+    return nthreads;
+  }
+
+  void Stop() {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      if (!running_) return;
+      all_done_.wait(lk, [this] { return pending_ == 0; });
+      running_ = false;
+      work_cv_.notify_all();
+    }
+    for (auto& t : workers_) t.join();
+    workers_.clear();
+  }
+
+  int64_t NewVar() {
+    std::lock_guard<std::mutex> lk(mu_);
+    int64_t id = next_var_++;
+    vars_.emplace(id, Var{});
+    return id;
+  }
+
+  void DeleteVar(int64_t id) {
+    // ref: Engine::DeleteVariable — caller guarantees no further pushes
+    // use the var; removal waits for in-flight work via WaitForVar first
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = vars_.find(id);
+    if (it != vars_.end() && it->second.queue.empty() &&
+        !it->second.running_write && it->second.running_reads == 0)
+      vars_.erase(it);
+  }
+
+  int Push(OpFn fn, void* arg, const int64_t* reads, int nread,
+           const int64_t* writes, int nwrite) {
+    // the reference asserts const/mutable var sets are disjoint and
+    // duplicate-free (engine.h PushAsync contract); overlap here would
+    // queue the op behind its own admitted slot = permanent deadlock
+    std::vector<int64_t> rv(reads, reads + nread);
+    std::vector<int64_t> wv(writes, writes + nwrite);
+    std::sort(rv.begin(), rv.end());
+    rv.erase(std::unique(rv.begin(), rv.end()), rv.end());
+    std::sort(wv.begin(), wv.end());
+    if (std::adjacent_find(wv.begin(), wv.end()) != wv.end()) {
+      tls_error = "mxtpu_engine_push: duplicate write var";
+      return -1;
+    }
+    for (int64_t w : wv) {
+      if (std::binary_search(rv.begin(), rv.end(), w)) {
+        tls_error = "mxtpu_engine_push: var in both read and write sets";
+        return -1;
+      }
+    }
+    Opr* op = new Opr{fn, arg, std::move(rv), std::move(wv)};
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!running_) {
+      delete op;
+      tls_error = "engine not started";
+      return -1;
+    }
+    ++pending_;
+    // every dependency registers in the var queues; wait counts the
+    // vars that cannot be satisfied immediately
+    int blocked = 0;
+    for (int64_t r : op->reads) {
+      Var& v = vars_[r];
+      if (v.queue.empty() && !v.running_write) {
+        ++v.running_reads;  // read admitted now
+      } else {
+        v.queue.emplace_back(op, false);
+        ++blocked;
+      }
+    }
+    for (int64_t w : op->writes) {
+      Var& v = vars_[w];
+      if (v.queue.empty() && !v.running_write && v.running_reads == 0) {
+        v.running_write = true;  // write admitted now
+      } else {
+        v.queue.emplace_back(op, true);
+        ++blocked;
+      }
+    }
+    op->wait.store(blocked, std::memory_order_relaxed);
+    if (blocked == 0) {
+      ready_.push_back(op);
+      work_cv_.notify_one();
+    }
+    return 0;
+  }
+
+  int WaitForVar(int64_t var) {
+    std::unique_lock<std::mutex> lk(mu_);
+    var_cv_.wait(lk, [&] {
+      auto it = vars_.find(var);
+      if (it == vars_.end()) return true;
+      return it->second.queue.empty() && !it->second.running_write &&
+             it->second.running_reads == 0;
+    });
+    auto it = vars_.find(var);
+    if (it != vars_.end() && it->second.poisoned) {
+      it->second.poisoned = false;  // rethrow-once, like WaitForVar
+      tls_error = last_op_error_;
+      return -1;
+    }
+    return 0;
+  }
+
+  int WaitAll() {
+    std::unique_lock<std::mutex> lk(mu_);
+    all_done_.wait(lk, [this] { return pending_ == 0; });
+    for (auto& kv : vars_) {
+      if (kv.second.poisoned) {
+        kv.second.poisoned = false;
+        tls_error = last_op_error_;
+        return -1;
+      }
+    }
+    return 0;
+  }
+
+ private:
+  void WorkerLoop() {
+    for (;;) {
+      Opr* op;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        work_cv_.wait(lk, [this] { return !ready_.empty() || !running_; });
+        if (!running_ && ready_.empty()) return;
+        op = ready_.front();
+        ready_.pop_front();
+      }
+      int rc = op->fn(op->arg);
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        if (rc != 0) {
+          last_op_error_ = "engine op failed with code " +
+                           std::to_string(rc);
+          for (int64_t w : op->writes) vars_[w].poisoned = true;
+        }
+        for (int64_t r : op->reads) CompleteRead(r);
+        for (int64_t w : op->writes) CompleteWrite(w);
+        --pending_;
+        delete op;
+        var_cv_.notify_all();
+        if (pending_ == 0) all_done_.notify_all();
+      }
+    }
+  }
+
+  // bump a var's queue after a completed read/write (mu_ held)
+  void CompleteRead(int64_t id) {
+    Var& v = vars_[id];
+    --v.running_reads;
+    Advance(v);
+  }
+  void CompleteWrite(int64_t id) {
+    Var& v = vars_[id];
+    v.running_write = false;
+    Advance(v);
+  }
+  void Advance(Var& v) {
+    // admit from the queue head: either one write (when idle) or a
+    // maximal run of reads
+    while (!v.queue.empty()) {
+      auto [op, is_write] = v.queue.front();
+      if (is_write) {
+        if (v.running_reads > 0 || v.running_write) break;
+        v.running_write = true;
+      } else {
+        if (v.running_write) break;
+        ++v.running_reads;
+      }
+      v.queue.pop_front();
+      if (op->wait.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+        ready_.push_back(op);
+        work_cv_.notify_one();
+      }
+      if (is_write) break;  // a write runs alone
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable work_cv_, var_cv_, all_done_;
+  std::deque<Opr*> ready_;
+  std::unordered_map<int64_t, Var> vars_;
+  std::vector<std::thread> workers_;
+  std::string last_op_error_;
+  int64_t next_var_ = 1;
+  int pending_ = 0;
+  bool running_ = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+int mxtpu_version() { return 10000; }  // 1.0.0
+
+const char* mxtpu_get_last_error() { return tls_error.c_str(); }
+
+void* mxtpu_storage_alloc(size_t size) {
+  return StoragePool::Get().Alloc(size);
+}
+void mxtpu_storage_free(void* p) { StoragePool::Get().Free(p); }
+void mxtpu_storage_direct_free(void* p) { StoragePool::Get().DirectFree(p); }
+void mxtpu_storage_release_all() { StoragePool::Get().ReleaseAll(); }
+void mxtpu_storage_stats(uint64_t out[4]) { StoragePool::Get().Stats(out); }
+
+int mxtpu_engine_start(int nthreads) { return Engine::Get().Start(nthreads); }
+void mxtpu_engine_stop() { Engine::Get().Stop(); }
+int64_t mxtpu_engine_new_var() { return Engine::Get().NewVar(); }
+void mxtpu_engine_delete_var(int64_t var) { Engine::Get().DeleteVar(var); }
+int mxtpu_engine_push(OpFn fn, void* arg, const int64_t* reads, int nread,
+                      const int64_t* writes, int nwrite) {
+  return Engine::Get().Push(fn, arg, reads, nread, writes, nwrite);
+}
+int mxtpu_engine_wait_for_var(int64_t var) {
+  return Engine::Get().WaitForVar(var);
+}
+int mxtpu_engine_wait_all() { return Engine::Get().WaitAll(); }
+
+}  // extern "C"

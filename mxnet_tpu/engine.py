@@ -64,7 +64,111 @@ def waitall():
             _pending.clear()
             raise
     _pending.clear()
+    if _host is not None:
+        _host.wait_all()
 
 
 def wait_for_var(arr):
     jax.block_until_ready(arr)
+
+
+# ---------------------------------------------------------------------------
+# native host engine — the C++ dependency scheduler (_native/core.cc).
+# XLA/PJRT is the engine for DEVICE work; this one carries the reference's
+# ThreadedEngine semantics (serialize writers per var, parallel readers,
+# poison-on-failure) for HOST-side framework work: decode, prefetch, IO
+# (ref: include/mxnet/engine.h:115, src/engine/threaded_engine.h:66).
+# ---------------------------------------------------------------------------
+
+_host = None
+
+
+class _HostEngine:
+    def __init__(self):
+        import atexit
+        import ctypes
+        import itertools
+
+        from ._native import ENGINE_OP_CFUNC, load_core
+        self._lib = load_core()
+        self._CFUNC = ENGINE_OP_CFUNC
+        self._ctypes = ctypes
+        self._keep = {}
+        self._done = []      # tags whose callbacks have RETURNED
+        self._tags = itertools.count()  # atomic under the GIL
+        self._lib.mxtpu_engine_start(0)  # MXNET_CPU_WORKER_NTHREADS
+        # drain + stop while the interpreter is still alive: the C++
+        # static destructor runs after Py_Finalize, when invoking a
+        # pending Python callback would abort the process
+        atexit.register(self._shutdown)
+
+    def _shutdown(self):
+        try:
+            self._lib.mxtpu_engine_wait_all()
+        finally:
+            self._lib.mxtpu_engine_stop()
+
+    def _drain_done(self):
+        # free keepalives only AFTER their callback returned (popping
+        # inside the callback would deallocate the libffi thunk while C
+        # is still executing it)
+        while self._done:
+            self._keep.pop(self._done.pop(), None)
+
+    def new_var(self):
+        return int(self._lib.mxtpu_engine_new_var())
+
+    def delete_var(self, var):
+        self._lib.mxtpu_engine_delete_var(var)
+
+    def push(self, fn, read_vars=(), write_vars=()):
+        """Run `fn()` on a worker thread once its var deps are satisfied.
+        A raised exception poisons the write vars (rethrown at wait)."""
+        if _naive:
+            # determinism switch serializes host tasks too
+            # (ref: src/engine/naive_engine.cc:50 executes on push)
+            fn()
+            return
+        ct = self._ctypes
+        self._drain_done()
+        tag = next(self._tags)
+
+        def wrapper(_):
+            try:
+                fn()
+                return 0
+            except Exception:  # noqa: BLE001 — crosses the C boundary
+                import traceback
+                traceback.print_exc()
+                return 1
+            finally:
+                self._done.append(tag)
+
+        cb = self._CFUNC(wrapper)
+        self._keep[tag] = cb
+        nr, nw = len(read_vars), len(write_vars)
+        r = (ct.c_int64 * nr)(*read_vars) if nr else None
+        w = (ct.c_int64 * nw)(*write_vars) if nw else None
+        if self._lib.mxtpu_engine_push(cb, None, r, nr, w, nw) != 0:
+            self._keep.pop(tag, None)
+            raise RuntimeError(self._lib.mxtpu_get_last_error().decode())
+
+    def wait_for_var(self, var):
+        rc = self._lib.mxtpu_engine_wait_for_var(var)
+        self._drain_done()
+        if rc != 0:
+            raise RuntimeError(self._lib.mxtpu_get_last_error().decode())
+
+    def wait_all(self):
+        rc = self._lib.mxtpu_engine_wait_all()
+        self._drain_done()
+        if rc != 0:
+            raise RuntimeError(self._lib.mxtpu_get_last_error().decode())
+
+
+def host_engine():
+    """The process-wide native host engine (built on first use)."""
+    global _host
+    if _host is None:
+        _host = _HostEngine()
+    return _host

@@ -669,7 +669,11 @@ class ImageRecordIter(DataIter):
             uv = np.full((n, 2), -1.0, np.float32)
         mirror = ((rng.rand(n) < 0.5) if self.rand_mirror
                   else np.zeros(n)).astype(np.uint8)
-        out = np.empty((n, 3, h, w), np.float32)
+        # batch staging buffer from the native host pool: steady-state
+        # epochs recycle the same memory instead of malloc'ing per batch
+        # (ref: iter_image_recordio_2.cc fills pinned batches in place)
+        from .._native import pooled_empty
+        out = pooled_empty((n, 3, h, w), np.float32)
         bufs = (ctypes.c_char_p * n)(*payloads)
         lens = (ctypes.c_int64 * n)(*[len(p) for p in payloads])
         errbuf = ctypes.create_string_buffer(512)

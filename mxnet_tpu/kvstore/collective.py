@@ -99,8 +99,9 @@ class CollectiveConn:
         One global array is formed with a leading process axis and
         reduced with out_shardings=replicated — XLA lowers this to an
         all-reduce over the mesh links (the literal psum-over-ICI the
-        survey prescribes)."""
-        local = np.asarray(value, np.float32)
+        survey prescribes). Reduction runs in the value's own dtype —
+        an f32 cast would silently corrupt f64/int payloads."""
+        local = np.asarray(value)
         in_sh, reduce_fn = self._reducer(local.shape, local.dtype)
         garr = self._jax.make_array_from_process_local_data(
             in_sh, local[None],
@@ -110,7 +111,7 @@ class CollectiveConn:
     def broadcast(self, value, root=0):
         """Value from `root` replicated to every process (reference
         kvstore Init semantics: rank 0 seeds, everyone pulls)."""
-        local = np.asarray(value, np.float32)
+        local = np.asarray(value)
         if self.rank != root:
             local = np.zeros_like(local)
         return self.allreduce(local)

@@ -60,10 +60,12 @@ def test_dead_worker_fails_fast():
         [sys.executable, os.path.join(REPO, "tools", "launch.py"),
          "-n", "4", sys.executable,
          os.path.join(REPO, "tests", "dist_dead_worker.py")],
-        env=env, capture_output=True, text=True, timeout=120)
+        env=env, capture_output=True, text=True, timeout=180)
     sys.stdout.write(proc.stdout)
     sys.stderr.write(proc.stderr)
-    assert time.monotonic() - t0 < 60, "job should fail fast, not hang"
+    # bound = fail-fast vs hang-forever; generous because 4 jax imports
+    # contend for one CI core under the full suite
+    assert time.monotonic() - t0 < 120, "job should fail fast, not hang"
     # connect order assigns server ranks, so any 3 of the 4 launcher ids
     # survive — require exactly three fail-fast reports
     assert proc.stdout.count("DEGRADED OK") == 3, proc.stdout

