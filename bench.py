@@ -26,6 +26,10 @@ WARMUP = 3
 ITERS = 50
 TARGET = 4000.0  # img/s/chip, BASELINE.json
 METRIC = "resnet50_inference_bf16_bs%d" % BATCH
+# ResNet-50 forward ≈ 4.1 GFLOPs/image at 224x224 (2 x 2.05 GMACs);
+# peak overridable for other chips via MXTPU_PEAK_TFLOPS (v5e bf16: 197)
+RESNET50_GFLOPS = 4.1
+PEAK_TFLOPS = float(os.environ.get("MXTPU_PEAK_TFLOPS", "197"))
 
 _CHILD_SENTINEL = "MXNET_TPU_BENCH_CHILD"
 
@@ -238,6 +242,9 @@ def main():
         "value": round(ips_bf16, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(ips_bf16 / TARGET, 4),
+        # model-FLOPs utilization: achieved / peak matmul throughput
+        "mfu_bf16": round(
+            ips_bf16 * RESNET50_GFLOPS / (PEAK_TFLOPS * 1e3), 4),
     }
     result.update(extra)
     print(json.dumps(result), flush=True)

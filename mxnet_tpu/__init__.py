@@ -66,6 +66,9 @@ from . import kvstore as kv  # noqa: E402
 from . import recordio  # noqa: E402
 from . import symbol  # noqa: E402
 from . import symbol as sym  # noqa: E402
+from . import attribute  # noqa: E402
+from . import libinfo  # noqa: E402
+from .attribute import AttrScope  # noqa: E402
 from .executor import Executor  # noqa: E402
 from . import io  # noqa: E402
 from . import callback  # noqa: E402

@@ -161,6 +161,7 @@ struct Var {
   int running_reads = 0;
   bool running_write = false;
   bool poisoned = false;  // a writer failed (exception propagation)
+  bool pending_delete = false;  // erase once idle (async DeleteVariable)
 };
 
 struct Opr {
@@ -218,13 +219,14 @@ class Engine {
   }
 
   void DeleteVar(int64_t id) {
-    // ref: Engine::DeleteVariable — caller guarantees no further pushes
-    // use the var; removal waits for in-flight work via WaitForVar first
+    // ref: Engine::DeleteVariable is asynchronous — the var disappears
+    // after its in-flight ops drain; the caller must not push to it
+    // again. Idle vars erase immediately, busy ones on last completion.
     std::lock_guard<std::mutex> lk(mu_);
     auto it = vars_.find(id);
-    if (it != vars_.end() && it->second.queue.empty() &&
-        !it->second.running_write && it->second.running_reads == 0)
-      vars_.erase(it);
+    if (it == vars_.end()) return;
+    it->second.pending_delete = true;
+    MaybeErase(it);
   }
 
   int Push(OpFn fn, void* arg, const int64_t* reads, int nread,
@@ -345,14 +347,24 @@ class Engine {
 
   // bump a var's queue after a completed read/write (mu_ held)
   void CompleteRead(int64_t id) {
-    Var& v = vars_[id];
-    --v.running_reads;
-    Advance(v);
+    auto it = vars_.find(id);
+    if (it == vars_.end()) return;
+    --it->second.running_reads;
+    Advance(it->second);
+    MaybeErase(it);
   }
   void CompleteWrite(int64_t id) {
-    Var& v = vars_[id];
-    v.running_write = false;
-    Advance(v);
+    auto it = vars_.find(id);
+    if (it == vars_.end()) return;
+    it->second.running_write = false;
+    Advance(it->second);
+    MaybeErase(it);
+  }
+  void MaybeErase(std::unordered_map<int64_t, Var>::iterator it) {
+    Var& v = it->second;
+    if (v.pending_delete && v.queue.empty() && !v.running_write &&
+        v.running_reads == 0)
+      vars_.erase(it);
   }
   void Advance(Var& v) {
     // admit from the queue head: either one write (when idle) or a

@@ -1,0 +1,73 @@
+"""Attribute scoping for symbol construction
+(ref: python/mxnet/attribute.py AttrScope).
+
+Symbols created inside a scope inherit its attributes — the canonical
+use is manual model parallelism:
+
+    with mx.AttrScope(ctx_group="dev1"):
+        fc1 = mx.sym.FullyConnected(data, num_hidden=128)
+    with mx.AttrScope(ctx_group="dev2"):
+        fc2 = mx.sym.FullyConnected(fc1, num_hidden=128)
+    ex = out.bind(ctx, args, group2ctx={"dev1": mx.tpu(0),
+                                        "dev2": mx.tpu(1)})
+
+The executor places each group's ops on its context and XLA inserts the
+cross-device transfers (the reference's PlaceDevice pass +
+_CrossDeviceCopy nodes, graph_executor.cc:907).
+"""
+from __future__ import annotations
+
+import threading
+
+from .base import MXNetError
+
+_local = threading.local()
+
+
+class AttrScope:
+    """Attach attributes to every symbol created within the scope
+    (ref: attribute.py:30 AttrScope; attrs are stored on the node as
+    ``__key__`` entries like the C++ side expects)."""
+
+    def __init__(self, **kwargs):
+        for value in kwargs.values():
+            if not isinstance(value, str):
+                raise MXNetError(
+                    "Attributes need to be a string, for compatibility "
+                    "with the reference's attr protocol")
+        self._attr = kwargs
+        self._old = None
+
+    @classmethod
+    def current(cls):
+        return getattr(_local, "scope", None)
+
+    def get(self, attr=None):
+        """Merge scope attrs into `attr` (explicit attrs win)."""
+        merged = {"__%s__" % k: v for k, v in self._attr.items()}
+        if attr:
+            merged.update(attr)
+        return merged
+
+    def __enter__(self):
+        self._old = AttrScope.current()
+        if self._old is not None:
+            combined = dict(self._old._attr)
+            combined.update(self._attr)
+            scope = AttrScope(**combined)
+        else:
+            scope = self
+        _local.scope = scope
+        return self
+
+    def __exit__(self, *exc):
+        _local.scope = self._old
+        return False
+
+
+def current_attrs(attrs=None):
+    """The attrs a freshly created node should carry (scope + explicit)."""
+    scope = AttrScope.current()
+    if scope is None:
+        return attrs or {}
+    return scope.get(attrs)

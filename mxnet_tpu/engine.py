@@ -91,11 +91,15 @@ class _HostEngine:
 
         from ._native import ENGINE_OP_CFUNC, load_core
         self._lib = load_core()
-        self._CFUNC = ENGINE_OP_CFUNC
         self._ctypes = ctypes
-        self._keep = {}
-        self._done = []      # tags whose callbacks have RETURNED
-        self._tags = itertools.count()  # atomic under the GIL
+        self._inflight = {}  # tag -> python fn
+        self._tags = itertools.count(1)  # atomic under the GIL
+        # ONE persistent libffi trampoline for every op: the C side only
+        # ever calls this thunk (which lives for the engine's lifetime),
+        # and the per-op Python closure is looked up by the tag passed as
+        # the op's void* arg — no thunk is ever freed while C might be
+        # executing it
+        self._cb = ENGINE_OP_CFUNC(self._dispatch)
         self._lib.mxtpu_engine_start(0)  # MXNET_CPU_WORKER_NTHREADS
         # drain + stop while the interpreter is still alive: the C++
         # static destructor runs after Py_Finalize, when invoking a
@@ -108,12 +112,17 @@ class _HostEngine:
         finally:
             self._lib.mxtpu_engine_stop()
 
-    def _drain_done(self):
-        # free keepalives only AFTER their callback returned (popping
-        # inside the callback would deallocate the libffi thunk while C
-        # is still executing it)
-        while self._done:
-            self._keep.pop(self._done.pop(), None)
+    def _dispatch(self, argp):
+        fn = self._inflight.pop(int(argp or 0), None)
+        if fn is None:
+            return 2
+        try:
+            fn()
+            return 0
+        except Exception:  # noqa: BLE001 — crosses the C boundary
+            import traceback
+            traceback.print_exc()
+            return 1
 
     def new_var(self):
         return int(self._lib.mxtpu_engine_new_var())
@@ -130,39 +139,22 @@ class _HostEngine:
             fn()
             return
         ct = self._ctypes
-        self._drain_done()
         tag = next(self._tags)
-
-        def wrapper(_):
-            try:
-                fn()
-                return 0
-            except Exception:  # noqa: BLE001 — crosses the C boundary
-                import traceback
-                traceback.print_exc()
-                return 1
-            finally:
-                self._done.append(tag)
-
-        cb = self._CFUNC(wrapper)
-        self._keep[tag] = cb
+        self._inflight[tag] = fn
         nr, nw = len(read_vars), len(write_vars)
         r = (ct.c_int64 * nr)(*read_vars) if nr else None
         w = (ct.c_int64 * nw)(*write_vars) if nw else None
-        if self._lib.mxtpu_engine_push(cb, None, r, nr, w, nw) != 0:
-            self._keep.pop(tag, None)
+        if self._lib.mxtpu_engine_push(self._cb, ct.c_void_p(tag),
+                                       r, nr, w, nw) != 0:
+            self._inflight.pop(tag, None)
             raise RuntimeError(self._lib.mxtpu_get_last_error().decode())
 
     def wait_for_var(self, var):
-        rc = self._lib.mxtpu_engine_wait_for_var(var)
-        self._drain_done()
-        if rc != 0:
+        if self._lib.mxtpu_engine_wait_for_var(var) != 0:
             raise RuntimeError(self._lib.mxtpu_get_last_error().decode())
 
     def wait_all(self):
-        rc = self._lib.mxtpu_engine_wait_all()
-        self._drain_done()
-        if rc != 0:
+        if self._lib.mxtpu_engine_wait_all() != 0:
             raise RuntimeError(self._lib.mxtpu_get_last_error().decode())
 
 

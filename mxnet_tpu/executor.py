@@ -30,9 +30,18 @@ class Executor:
 
     def __init__(self, symbol, ctx=None, args=None, args_grad=None,
                  grad_req="write", aux_states=None, mesh=None,
-                 arg_specs=None):
+                 arg_specs=None, group2ctx=None):
         self._symbol = symbol
         self._ctx = ctx
+        # manual model parallelism: ctx_group attrs (AttrScope) map to
+        # devices; ops in a group run pinned there and XLA inserts the
+        # cross-device transfers (the reference's PlaceDevice pass +
+        # _CrossDeviceCopy nodes, graph_executor.cc:897-915)
+        self._group2dev = {}
+        if group2ctx:
+            from .context import Context
+            self._group2dev = {g: Context(c).jax_device
+                               for g, c in group2ctx.items()}
         # data-parallel execution over a device mesh: args are placed with
         # NamedShardings (params replicated, data sharded over 'dp') and
         # jit compiles one SPMD program — GSPMD inserts the gradient
@@ -127,6 +136,10 @@ class Executor:
                 if opdef.needs_rng:
                     key, sub = jax.random.split(key)
                     ins = [sub] + ins
+                dev = self._group2dev.get(
+                    node.attrs.get("__ctx_group__"))
+                if dev is not None:
+                    ins = [jax.device_put(x, dev) for x in ins]
                 if training and opdef.name in ("BatchNorm",
                                                "_contrib_SyncBatchNorm") \
                         and not attrs.get("use_global_stats"):

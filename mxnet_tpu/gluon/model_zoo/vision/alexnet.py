@@ -38,5 +38,6 @@ class AlexNet(HybridBlock):
 def alexnet(pretrained=False, ctx=None, root=None, **kwargs):
     net = AlexNet(**kwargs)
     if pretrained:
-        net.load_parameters(root, ctx=ctx)
+        from ..model_store import load_pretrained
+        load_pretrained(net, "alexnet", root, ctx)
     return net

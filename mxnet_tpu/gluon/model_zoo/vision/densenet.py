@@ -84,7 +84,8 @@ def get_densenet(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
     num_init_features, growth_rate, block_config = densenet_spec[num_layers]
     net = DenseNet(num_init_features, growth_rate, block_config, **kwargs)
     if pretrained:
-        net.load_parameters(root, ctx=ctx)
+        from ..model_store import load_pretrained
+        load_pretrained(net, "densenet%d" % num_layers, root, ctx)
     return net
 
 

@@ -105,7 +105,8 @@ def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None,
                   **kwargs):
     net = MobileNet(multiplier, **kwargs)
     if pretrained:
-        net.load_parameters(root, ctx=ctx)
+        from ..model_store import load_pretrained
+        load_pretrained(net, "mobilenet%s" % str(multiplier), root, ctx)
     return net
 
 
@@ -113,7 +114,8 @@ def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None,
                      **kwargs):
     net = MobileNetV2(multiplier, **kwargs)
     if pretrained:
-        net.load_parameters(root, ctx=ctx)
+        from ..model_store import load_pretrained
+        load_pretrained(net, "mobilenetv2_%s" % str(multiplier), root, ctx)
     return net
 
 

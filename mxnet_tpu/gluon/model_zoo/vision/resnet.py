@@ -239,9 +239,8 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
     block_class = resnet_block_versions[version - 1][block_type]
     net = resnet_class(block_class, layers, channels, **kwargs)
     if pretrained:
-        if root is None:
-            raise MXNetError("no network egress: pass root=<params file>")
-        net.load_parameters(root, ctx=ctx)
+        from ..model_store import load_pretrained
+        load_pretrained(net, f"resnet{num_layers}_v{version}", root, ctx)
     return net
 
 

@@ -78,7 +78,8 @@ class SqueezeNet(HybridBlock):
 def get_squeezenet(version, pretrained=False, ctx=None, root=None, **kwargs):
     net = SqueezeNet(version, **kwargs)
     if pretrained:
-        net.load_parameters(root, ctx=ctx)
+        from ..model_store import load_pretrained
+        load_pretrained(net, "squeezenet" + version, root, ctx)
     return net
 
 

@@ -43,7 +43,9 @@ def get_vgg(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
     layers, filters = vgg_spec[num_layers]
     net = VGG(layers, filters, **kwargs)
     if pretrained:
-        net.load_parameters(root, ctx=ctx)
+        from ..model_store import load_pretrained
+        bn = "_bn" if kwargs.get("batch_norm") else ""
+        load_pretrained(net, f"vgg{num_layers}{bn}", root, ctx)
     return net
 
 

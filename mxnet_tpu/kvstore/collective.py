@@ -99,9 +99,17 @@ class CollectiveConn:
         One global array is formed with a leading process axis and
         reduced with out_shardings=replicated — XLA lowers this to an
         all-reduce over the mesh links (the literal psum-over-ICI the
-        survey prescribes). Reduction runs in the value's own dtype —
-        an f32 cast would silently corrupt f64/int payloads."""
+        survey prescribes). Reduction runs in the value's own dtype;
+        64-bit payloads are reduced under enable_x64 (jax's default
+        canonicalization would silently truncate them to 32 bits)."""
         local = np.asarray(value)
+        if local.dtype.itemsize == 8:
+            with self._jax.enable_x64(True):
+                in_sh, reduce_fn = self._reducer(local.shape, local.dtype)
+                garr = self._jax.make_array_from_process_local_data(
+                    in_sh, local[None],
+                    (self.num_workers,) + local.shape)
+                return np.asarray(reduce_fn(garr))
         in_sh, reduce_fn = self._reducer(local.shape, local.dtype)
         garr = self._jax.make_array_from_process_local_data(
             in_sh, local[None],

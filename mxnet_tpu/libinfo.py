@@ -1,0 +1,118 @@
+"""Runtime feature discovery + the canonical environment-variable list
+(ref: python/mxnet/libinfo.py find_lib_path/__version__;
+python/mxnet/runtime.py Features; docs/faq/env_var.md).
+
+    >>> import mxnet_tpu as mx
+    >>> mx.libinfo.features()          # what this build can do
+    >>> mx.libinfo.env_vars()          # every honored env var + value
+    >>> mx.libinfo.find_lib_path()     # built native libraries
+"""
+from __future__ import annotations
+
+import os
+
+__version__ = "0.1.0"
+
+# every environment variable the framework reads, with where it acts —
+# the docs/faq/env_var.md analogue, kept next to the code so it cannot
+# drift silently. (DMLC_* come from tools/launch.py's tracker contract.)
+_ENV_VARS = {
+    "MXNET_ENGINE_TYPE": (
+        "ThreadedEnginePerDevice | NaiveEngine — NaiveEngine serializes "
+        "every op (determinism/race-debug switch; engine.py)"),
+    "MXNET_CPU_WORKER_NTHREADS": (
+        "host worker threads for the native engine and decode pools "
+        "(_native/core.cc, io pipeline)"),
+    "MXNET_SUBGRAPH_BACKEND": (
+        "graph-partition backend applied at bind, e.g. XLA "
+        "(symbol.simple_bind; subgraph/xla_fuse.py)"),
+    "MXNET_PROFILER_AUTOSTART": (
+        "1 = profiling from import, chrome-trace on exit (profiler.py)"),
+    "MXNET_HOME": (
+        "root for local data: model store weights, text embeddings "
+        "(default ~/.mxnet_tpu)"),
+    "MXNET_KVSTORE_BIGARRAY_BOUND": (
+        "elements above which dist pushes are sliced across servers "
+        "(kvstore/dist.py)"),
+    "MXNET_KVSTORE_REQUEST_TIMEOUT_MS": (
+        "client-side dist request timeout; a dead server fails the job "
+        "instead of hanging it (kvstore/dist.py)"),
+    "DMLC_ROLE": "worker|server — set per process by tools/launch.py",
+    "DMLC_PS_ROOT_URI": "rendezvous host (launch.py tracker contract)",
+    "DMLC_PS_ROOT_PORT": "rendezvous port; with -s 0 it is the "
+                         "jax.distributed coordinator",
+    "DMLC_NUM_WORKER": "worker count in the dist job",
+    "DMLC_NUM_SERVER": "server count; 0 = collective data plane",
+    "DMLC_WORKER_ID": "this worker's rank",
+    "DMLC_SERVER_ID": "this server's index",
+}
+
+
+def env_vars():
+    """{name: (current value or None, description)} for every honored
+    environment variable."""
+    return {k: (os.environ.get(k), v) for k, v in _ENV_VARS.items()}
+
+
+def find_lib_path():
+    """Paths of the built native libraries (ref: libinfo.py
+    find_lib_path — there it locates libmxnet.so; here the runtime is
+    jax + the _native components)."""
+    here = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "_native")
+    return sorted(
+        os.path.join(here, f) for f in os.listdir(here)
+        if f.endswith(".so"))
+
+
+class Feature:
+    def __init__(self, name, enabled, detail=""):
+        self.name = name
+        self.enabled = bool(enabled)
+        self.detail = detail
+
+    def __repr__(self):
+        mark = "✔" if self.enabled else "✖"
+        return f"{mark} {self.name}" + (f" ({self.detail})"
+                                        if self.detail else "")
+
+
+def features():
+    """Runtime feature flags (ref: python/mxnet/runtime.py Features —
+    there compile-time USE_* flags; here what this host can actually
+    do)."""
+    import jax
+
+    feats = []
+    try:
+        devs = jax.devices()
+        plat = devs[0].platform
+    except Exception:  # noqa: BLE001 — backend init can fail headless
+        devs, plat = [], "none"
+    feats.append(Feature("TPU", plat == "tpu" or plat == "axon",
+                         f"{len(devs)} x {plat}"))
+    feats.append(Feature("MULTI_DEVICE", len(devs) > 1,
+                         f"{len(devs)} devices"))
+    from .base import get_env
+    feats.append(Feature("NAIVE_ENGINE",
+                         get_env("MXNET_ENGINE_TYPE", "") == "NaiveEngine"))
+
+    def _native_ok(loader):
+        try:
+            return loader() is not None
+        except Exception:  # noqa: BLE001 — missing toolchain/headers
+            return False
+
+    from . import _native
+    feats.append(Feature("NATIVE_CORE", _native_ok(_native.load_core),
+                         "host storage pool + dependency engine"))
+    feats.append(Feature("NATIVE_COMM", _native_ok(_native.load_comm),
+                         "TCP parameter-server transport"))
+    feats.append(Feature("NATIVE_IMGDEC", _native_ok(_native.load_imgdec),
+                         "libjpeg batch decoder"))
+    try:
+        import PIL  # noqa: F401
+        feats.append(Feature("PIL", True))
+    except ImportError:
+        feats.append(Feature("PIL", False))
+    return feats

@@ -35,6 +35,7 @@ class Module(BaseModule):
         self._data_names = list(data_names or [])
         self._label_names = list(label_names or [])
         self._context = context
+        self._group2ctxs = group2ctxs
         self._fixed_param_names = list(fixed_param_names or [])
 
         arg_names = symbol.list_arguments()
@@ -121,8 +122,14 @@ class Module(BaseModule):
             req = req_dict
         self._grad_req = req
         mesh, arg_specs = self._dp_mesh()
+        g2c = self._group2ctxs
+        if isinstance(g2c, (list, tuple)):
+            # the reference accepts one dict per DP device; the SPMD
+            # executor compiles one program, so one placement map applies
+            g2c = g2c[0] if g2c else None
         self._exec = self._symbol.simple_bind(grad_req=req, mesh=mesh,
                                               arg_specs=arg_specs,
+                                              group2ctx=g2c,
                                               **shape_hints)
 
         if shared_module is not None and shared_module.params_initialized:

@@ -426,7 +426,8 @@ class Symbol:
              aux_states=None, group2ctx=None, shared_exec=None):
         from ..executor import Executor
         return Executor(self, ctx, args=args, args_grad=args_grad,
-                        grad_req=grad_req, aux_states=aux_states)
+                        grad_req=grad_req, aux_states=aux_states,
+                        group2ctx=group2ctx)
 
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
                     shared_exec=None, group2ctx=None, mesh=None,
@@ -463,7 +464,8 @@ class Symbol:
         # per-name req != 'null' — handing it a dense args_grad here would
         # make fixed/data args look trainable to Module.update
         return Executor(self, ctx, args=args, grad_req=grad_req,
-                        aux_states=aux, mesh=mesh, arg_specs=arg_specs)
+                        aux_states=aux, mesh=mesh, arg_specs=arg_specs,
+                        group2ctx=group2ctx)
 
     def _maybe_partition(self, backend):
         if not backend:
@@ -645,7 +647,8 @@ def _apply(op_name, input_syms, attrs, name=None):
                 f"{op_name}: multi-output symbol used as a single input")
         inputs.append(s._outputs[0])
     name = name or _gen_name(opdef.name.lower().lstrip("_"))
-    node = _Node(opdef.name, name, attrs, inputs)
+    from ..attribute import current_attrs
+    node = _Node(opdef.name, name, current_attrs(attrs), inputs)
     n_out = node.num_outputs()
     return Symbol([(node, k) for k in range(n_out)])
 

@@ -29,9 +29,12 @@ np.testing.assert_allclose(got.asnumpy(), 1.0)  # rank 0's value
 
 # push/pull: sum across workers
 kv.push("w", nd.array(np.full((3, 2), float(rank + 1), np.float32)))
-# dtype fidelity: int sums above 2**24 survive (no f32 cast on the wire)
-big = kv._coll.allreduce(np.array([2**24 + 1], np.int64))
-assert int(big[0]) == n * (2**24 + 1), big
+# dtype fidelity: true 64-bit payloads survive (no f32 cast, no int32
+# canonicalization on the wire)
+big = kv._coll.allreduce(np.array([2**40 + 3], np.int64))
+assert int(big[0]) == n * (2**40 + 3), big
+dbl = kv._coll.allreduce(np.array([1.0 + 2.0**-40], np.float64))
+assert dbl[0] == n * (1.0 + 2.0**-40), dbl
 kv.pull("w", out=got)
 expect = sum(r + 1 for r in range(n))
 np.testing.assert_allclose(got.asnumpy(), expect)

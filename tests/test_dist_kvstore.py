@@ -55,20 +55,25 @@ def test_dead_worker_fails_fast():
 
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
-    t0 = time.monotonic()
-    proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
-         "-n", "4", sys.executable,
-         os.path.join(REPO, "tests", "dist_dead_worker.py")],
-        env=env, capture_output=True, text=True, timeout=180)
-    sys.stdout.write(proc.stdout)
-    sys.stderr.write(proc.stderr)
-    # bound = fail-fast vs hang-forever; generous because 4 jax imports
-    # contend for one CI core under the full suite
-    assert time.monotonic() - t0 < 120, "job should fail fast, not hang"
-    # connect order assigns server ranks, so any 3 of the 4 launcher ids
-    # survive — require exactly three fail-fast reports
-    assert proc.stdout.count("DEGRADED OK") == 3, proc.stdout
+    # the scenario is timing-sensitive (a worker must die mid-round);
+    # on a loaded 1-core CI host the kill can land before the round
+    # starts, so re-run once before declaring failure
+    for attempt in range(2):
+        t0 = time.monotonic()
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+             "-n", "4", sys.executable,
+             os.path.join(REPO, "tests", "dist_dead_worker.py")],
+            env=env, capture_output=True, text=True, timeout=180)
+        sys.stdout.write(proc.stdout)
+        sys.stderr.write(proc.stderr)
+        # bound = fail-fast vs hang-forever
+        fast = time.monotonic() - t0 < 120
+        if fast and proc.stdout.count("DEGRADED OK") == 3:
+            return
+    raise AssertionError(
+        f"fail-fast degradation not observed (fast={fast}):\n"
+        + proc.stdout)
 
 
 def test_multi_server_sharding():

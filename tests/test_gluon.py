@@ -437,3 +437,47 @@ def test_unroll_unmerged_valid_length():
     assert isinstance(outs, list) and len(outs) == 5
     assert outs[0].shape == (2, 4)
     assert np.all(outs[3].asnumpy()[0] == 0)  # masked past valid_length
+
+
+def test_model_store_local_resolution(tmp_path):
+    """Pretrained weights resolve through the local store: plain
+    {name}.params is accepted, hashed release names are sha1-verified,
+    missing files raise the offline-placement error
+    (ref: gluon/model_zoo/model_store.py)."""
+    import pytest
+
+    from mxnet_tpu.gluon.model_zoo import model_store, vision
+
+    net = vision.mobilenet0_25()
+    net.initialize()
+    _ = net(mx.nd.ones((1, 3, 32, 32)))
+    net.save_parameters(str(tmp_path / "mobilenet0.25.params"))
+
+    # plain name resolves
+    loaded = vision.get_model("mobilenet0.25", pretrained=True,
+                              root=str(tmp_path))
+    ref = net(mx.nd.ones((1, 3, 32, 32))).asnumpy()
+    got = loaded(mx.nd.ones((1, 3, 32, 32))).asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    # hashed name must sha1-verify (our re-export won't match)
+    hashed = "mobilenet0.25-%s.params" % model_store.short_hash(
+        "mobilenet0.25")
+    (tmp_path / hashed).write_bytes(
+        (tmp_path / "mobilenet0.25.params").read_bytes())
+    with pytest.raises(Exception, match="checksum mismatch"):
+        model_store.get_model_file("mobilenet0.25", str(tmp_path))
+
+    # missing -> clear offline message
+    with pytest.raises(Exception, match="no pretrained weights"):
+        model_store.get_model_file("resnet50_v1", str(tmp_path))
+
+
+def test_libinfo():
+    import mxnet_tpu.libinfo as li
+    feats = {f.name: f.enabled for f in li.features()}
+    assert feats["NATIVE_CORE"]
+    assert feats["NATIVE_COMM"]
+    ev = li.env_vars()
+    assert "MXNET_ENGINE_TYPE" in ev and len(ev["MXNET_ENGINE_TYPE"]) == 2
+    assert any(p.endswith(".so") for p in li.find_lib_path())

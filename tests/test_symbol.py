@@ -150,3 +150,47 @@ def test_eval_dict():
     out = sym.broadcast_add(a, b)
     r = out.eval_dict({"a": mx.nd.ones((2, 3)), "b": mx.nd.ones((1, 3))})
     np.testing.assert_allclose(r.asnumpy(), 2 * np.ones((2, 3)))
+
+
+def test_attr_scope_sets_ctx_group():
+    with mx.AttrScope(ctx_group="dev1"):
+        a = sym.FullyConnected(sym.var("data"), num_hidden=4, name="fca")
+        with mx.AttrScope(ctx_group="dev2", lr_mult="2"):
+            b = sym.FullyConnected(a, num_hidden=2, name="fcb")
+    assert a._outputs[0][0].attrs["__ctx_group__"] == "dev1"
+    assert b._outputs[0][0].attrs["__ctx_group__"] == "dev2"
+    assert b._outputs[0][0].attrs["__lr_mult__"] == "2"
+    # outside the scope: no group attr
+    c = sym.FullyConnected(b, num_hidden=2, name="fcc")
+    assert "__ctx_group__" not in c._outputs[0][0].attrs
+
+
+def test_group2ctx_model_parallel_bind():
+    """Manual model parallelism: stages pinned to devices via group2ctx,
+    numerics identical to the unpinned graph (ref: symbol.py:1290
+    bind(group2ctx), docs/faq/model_parallel_lstm.md)."""
+    import jax
+
+    data = sym.var("data")
+    with mx.AttrScope(ctx_group="stage1"):
+        h = sym.FullyConnected(data, num_hidden=8, name="fc1")
+        h = sym.Activation(h, act_type="relu", name="act1")
+    with mx.AttrScope(ctx_group="stage2"):
+        out = sym.FullyConnected(h, num_hidden=3, name="fc2")
+
+    x = np.random.default_rng(0).standard_normal((4, 5)).astype("float32")
+    n_dev = len(jax.devices())
+    g2c = {"stage1": mx.cpu(0),
+           "stage2": mx.cpu(1 if n_dev > 1 else 0)}
+    ex = out.simple_bind(grad_req="null", group2ctx=g2c, data=(4, 5))
+    ex_ref = out.simple_bind(grad_req="null", data=(4, 5))
+    rng = np.random.default_rng(1)
+    for name in ("fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"):
+        w = rng.standard_normal(ex.arg_dict[name].shape).astype("float32")
+        ex.arg_dict[name]._data = mx.nd.array(w)._data
+        ex_ref.arg_dict[name]._data = mx.nd.array(w)._data
+    ex.arg_dict["data"]._data = mx.nd.array(x)._data
+    ex_ref.arg_dict["data"]._data = mx.nd.array(x)._data
+    got = ex.forward(is_train=False)[0].asnumpy()
+    ref = ex_ref.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
