@@ -53,6 +53,100 @@ class _Entry:
         self.calib_key = calib_key
 
 
+def fold_batch_norm(symbol, arg_params, aux_params):
+    """Fold inference-mode BatchNorm into the preceding Convolution
+    (ref: the MKLDNN backend's conv+BN fusion the quantization example
+    applies before quantizing, example/quantization/
+    imagenet_gen_qsym_mkldnn.py + mkldnn_conv_property.cc kBN state).
+
+    BN(conv(x)) = conv(x)*s + (beta - mean*s) with s = gamma/sqrt(var+eps)
+    is absorbed into the conv weights/bias, so the quantized graph chains
+    quantized_conv -> requantize -> int8 relu with no f32 round-trip.
+    Returns (new symbol, new arg_params); aux stats become unused.
+    """
+    from collections import Counter as _Counter
+
+    arg_params = dict(arg_params)
+    consumers = _Counter()
+    for n in symbol._topo():
+        for c, k in n.inputs:
+            consumers[(id(c), k)] += 1
+    for c, k in symbol._outputs:
+        # a conv output that is ALSO a graph output must keep its raw
+        # (pre-BN) value, so it counts as an extra consumer
+        consumers[(id(c), k)] += 1
+
+    def _val(params, name):
+        v = params.get(name)
+        if v is None:
+            return None
+        return v.asnumpy() if isinstance(v, nd.NDArray) else np.asarray(v)
+
+    # functional rewrite: the input graph is never mutated
+    memo = {}      # id(old node) -> new node
+    redirect = {}  # id(old bn node) -> (new conv node, 0)
+    folded = 0
+
+    def entry(c, k):
+        if id(c) in redirect:
+            return redirect[id(c)]
+        return (memo[id(c)], k)
+
+    for node in symbol._topo():
+        if node.op is None:
+            memo[id(node)] = _Node(None, node.name, node.attrs)
+            continue
+        new = _Node(node.op, node.name, dict(node.attrs),
+                    [entry(c, k) for c, k in node.inputs])
+        memo[id(node)] = new
+        if node.op != "BatchNorm":
+            continue
+        old_conv, k0 = node.inputs[0]
+        if old_conv.op != "Convolution" or k0 != 0 or \
+                consumers[(id(old_conv), 0)] != 1:
+            continue
+        conv = memo[id(old_conv)]
+        wnode = conv.inputs[1][0]
+        if wnode.op is not None:
+            continue
+        names = [c.name for c, _ in node.inputs[1:5]]
+        gamma = _val(arg_params, names[0])
+        beta = _val(arg_params, names[1])
+        mean = _val(aux_params, names[2])
+        var = _val(aux_params, names[3])
+        W = _val(arg_params, wnode.name)
+        if any(v is None for v in (gamma, beta, mean, var, W)):
+            continue
+        eps = float(node.attrs.get("eps", 1e-3))
+        if node.attrs.get("fix_gamma", True) in (True, "True", "true", 1):
+            gamma = np.ones_like(gamma)
+        s = gamma / np.sqrt(var + eps)
+        arg_params[wnode.name] = nd.array(
+            (W * s.reshape((-1,) + (1,) * (W.ndim - 1))).astype(W.dtype))
+        has_bias = len(conv.inputs) >= 3 and \
+            not conv.attrs.get("no_bias", False)
+        b = _val(arg_params, conv.inputs[2][0].name) if has_bias \
+            else np.zeros_like(beta)
+        new_b = (b * s + beta - mean * s).astype(beta.dtype)
+        if has_bias:
+            arg_params[conv.inputs[2][0].name] = nd.array(new_b)
+        else:
+            bname = f"{conv.name}_bias"
+            arg_params[bname] = nd.array(new_b)
+            conv.attrs["no_bias"] = False
+            bvar = _Node(None, bname)
+            if len(conv.inputs) >= 3:
+                conv.inputs[2] = (bvar, 0)
+            else:
+                conv.inputs.append((bvar, 0))
+        redirect[id(node)] = (conv, 0)
+        folded += 1
+
+    if not folded:
+        return symbol, arg_params
+    return Symbol([entry(c, k) for c, k in symbol._outputs]), arg_params
+
+
 def _quantize_symbol(symbol, excluded_sym_names=(), offline_params=()):
     """The QuantizeGraph pass (ref: quantize_graph_pass.cc:118).
 
@@ -131,6 +225,40 @@ def _quantize_symbol(symbol, excluded_sym_names=(), offline_params=()):
                                          (qnode, 2),
                                          f"{node.name}_output")]
             continue
+        if node.op in ("elemwise_add", "broadcast_add") and \
+                len(node.inputs) == 2 and node.name not in excluded:
+            # residual adds between two int8 producers stay int8
+            # (rescale + requantize in one fused kernel); the reference
+            # fuses the sum into the conv as an MKL-DNN post-op
+            e1 = memo[id(node.inputs[0][0])][node.inputs[0][1]]
+            e2 = memo[id(node.inputs[1][0])][node.inputs[1][1]]
+            if e1.is_int8 and e2.is_int8:
+                qn = _Node("_contrib_quantized_elemwise_add",
+                           f"quantized_{node.name}", {},
+                           [(e1.node, e1.k), (e2.node, e2.k),
+                            e1.min_entry, e1.max_entry,
+                            e2.min_entry, e2.max_entry])
+                key = f"{node.name}_output"
+                calib_nodes.setdefault(key, []).append(qn)
+                memo[id(node)] = [_Entry(qn, 0, True, (qn, 1), (qn, 2),
+                                         key)]
+                continue
+        if node.op == "Activation" and \
+                node.attrs.get("act_type", "relu") == "relu" and \
+                node.name not in excluded:
+            # relu commutes with symmetric int8 quantization (zero point
+            # 0), so an int8 input passes through as max(q, 0) with no
+            # dequantize/quantize round-trip — the fusion the reference
+            # gets from MKLDNN conv post-ops (mkldnn_conv_property.cc)
+            e = memo[id(node.inputs[0][0])][node.inputs[0][1]]
+            if e.is_int8:
+                qn = _Node("_contrib_quantized_act",
+                           f"quantized_{node.name}",
+                           {"act_type": "relu"},
+                           [(e.node, e.k), e.min_entry, e.max_entry])
+                memo[id(node)] = [_Entry(qn, 0, True, (qn, 1), (qn, 2),
+                                         f"{node.name}_output")]
+                continue
         # fp32 node: wire fp32 inputs (dequantizing where needed)
         new = _Node(node.op, node.name, node.attrs,
                     [fp32_entry((c, k)) for c, k in node.inputs])
@@ -335,10 +463,13 @@ def _offline_quantize_params(qsym, arg_params):
 def quantize_model(sym, arg_params, aux_params, ctx=None,
                    excluded_sym_names=None, calib_mode="entropy",
                    calib_data=None, num_calib_examples=None,
-                   quantized_dtype="int8", logger=logging, **kwargs):
+                   quantized_dtype="int8", logger=logging, fold_bn=True,
+                   **kwargs):
     """End-to-end int8 conversion (ref: quantization.py:423)."""
     if quantized_dtype not in ("int8", "auto"):
         raise MXNetError(f"unsupported quantized_dtype {quantized_dtype}")
+    if fold_bn:
+        sym, arg_params = fold_batch_norm(sym, arg_params, aux_params)
     qsym, calib_nodes = _quantize_symbol(
         sym, excluded_sym_names=excluded_sym_names or ())
 

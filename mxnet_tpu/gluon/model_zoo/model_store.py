@@ -10,10 +10,10 @@ integrity contract is unchanged, only the transport is gone.
 """
 from __future__ import annotations
 
-import hashlib
 import os
 
 from ...base import MXNetError, get_env
+from ..utils import check_sha1
 
 # published artifact checksums (ref: model_store.py:29 _model_sha1 —
 # the sha1s of the Apache-hosted .params releases, so officially
@@ -66,19 +66,6 @@ def short_hash(name):
             f"Pretrained model for {name} is not available; known "
             f"models: {sorted(_model_sha1)}")
     return _model_sha1[name][:8]
-
-
-def check_sha1(filename, sha1_hash):
-    """True when the file's sha1 matches (ref: gluon/utils.py
-    check_sha1)."""
-    sha1 = hashlib.sha1()
-    with open(filename, "rb") as f:
-        while True:
-            data = f.read(1 << 20)
-            if not data:
-                break
-            sha1.update(data)
-    return sha1.hexdigest() == sha1_hash
 
 
 def get_model_file(name, root=None):

@@ -11,7 +11,14 @@ from __future__ import annotations
 
 import os
 
-__version__ = "0.1.0"
+
+def __getattr__(name):
+    if name == "__version__":
+        # single source of truth: the package (avoids two literals
+        # drifting on a version bump)
+        from mxnet_tpu import __version__ as v
+        return v
+    raise AttributeError(name)
 
 # every environment variable the framework reads, with where it acts —
 # the docs/faq/env_var.md analogue, kept next to the code so it cannot

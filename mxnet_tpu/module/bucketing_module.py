@@ -25,6 +25,7 @@ class BucketingModule(BaseModule):
         self._sym_gen = sym_gen
         self._default_bucket_key = default_bucket_key
         self._context = context
+        self._group2ctxs = group2ctxs
         self._fixed_param_names = fixed_param_names
         self._buckets = {}
         self._curr_module = None
@@ -62,6 +63,7 @@ class BucketingModule(BaseModule):
         sym, data_names, label_names = self._sym_gen(bucket_key)
         mod = Module(sym, data_names=data_names, label_names=label_names,
                      logger=self.logger, context=self._context,
+                     group2ctxs=self._group2ctxs,
                      fixed_param_names=self._fixed_param_names)
         self._buckets[bucket_key] = mod
         return mod

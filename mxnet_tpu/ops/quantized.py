@@ -170,3 +170,37 @@ def quantized_concat(*args, dim=1, num_args=None):
         for d, a in zip(datas, amaxs)
     ]
     return jnp.concatenate(scaled, axis=dim), -out_max, out_max
+
+
+@register("_contrib_quantized_act", num_outputs=3)
+def quantized_act(data, min_data, max_data, act_type="relu"):
+    """int8 relu passthrough: with symmetric quantization (zero point 0)
+    relu(dequant(q)) == dequant(max(q, 0)) exactly, so the activation
+    runs on int8 and the tensor never widens to f32 (the reference gets
+    this by fusing relu into the conv primitive as an MKL-DNN post-op,
+    mkldnn_conv_property.cc kSuccess)."""
+    out = jnp.maximum(data, 0)
+    zero = jnp.zeros((), jnp.float32)
+    return out, jnp.maximum(min_data, zero), jnp.maximum(max_data, zero)
+
+
+@register("_contrib_quantized_elemwise_add", num_outputs=3)
+def quantized_elemwise_add(lhs, rhs, lhs_min, lhs_max, rhs_min, rhs_max,
+                           min_calib_range=None, max_calib_range=None):
+    """int8 residual add: rescale both operands in f32 and requantize to
+    the calibrated output range — one fused elementwise kernel whose
+    memory traffic is int8 in / int8 out (the reference fuses the sum
+    into the conv primitive as an MKL-DNN post-op, 
+    mkldnn_conv_property.cc kSum)."""
+    ls = jnp.maximum(jnp.abs(lhs_min), jnp.abs(lhs_max)) / INT8_RANGE
+    rs = jnp.maximum(jnp.abs(rhs_min), jnp.abs(rhs_max)) / INT8_RANGE
+    f = lhs.astype(jnp.float32) * ls + rhs.astype(jnp.float32) * rs
+    if min_calib_range is not None:
+        omax = jnp.float32(max(abs(min_calib_range), abs(max_calib_range)))
+    else:
+        omax = jnp.max(jnp.abs(f))
+    # all-zero range (dead units over the calib set) must quantize to
+    # zeros, not 0*inf=NaN — same guard as _range_scale/requantize
+    q = jnp.clip(jnp.rint(f * (INT8_RANGE / jnp.maximum(omax, 1e-30))),
+                 -INT8_RANGE, INT8_RANGE).astype(jnp.int8)
+    return q, -omax, omax

@@ -78,8 +78,10 @@ def test_quantize_symbol_structure():
     assert ops.get("_contrib_requantize", 0) == 2
     assert ops.get("Convolution", 0) == 0
     assert ops.get("FullyConnected", 0) == 0
-    # relu stays fp32 -> dequantize before, quantize after
-    assert ops.get("Activation", 0) == 1
+    # relu between int8 producers runs ON int8 (symmetric quantization
+    # commutes with max(x, 0)) — no dequantize/quantize round-trip
+    assert ops.get("_contrib_quantized_act", 0) == 1
+    assert ops.get("Activation", 0) == 0
     assert "conv0_output" in calib and "fc_output" in calib
 
 
@@ -152,3 +154,76 @@ def test_quantize_model_end_to_end(calib_mode):
     # argmax agreement on most rows
     agree = (out.argmax(1) == ref.argmax(1)).mean()
     assert agree > 0.9, f"class agreement too low: {agree}"
+
+
+def test_fold_batch_norm_exact():
+    """conv+BN folding is numerically exact and does not mutate the
+    input graph (ref: the MKLDNN conv+BN fusion applied before
+    quantization, mkldnn_conv_property.cc kBN)."""
+    from mxnet_tpu.contrib.quantization import fold_batch_norm
+    from mxnet_tpu.ndarray.ndarray import NDArray
+
+    data = sym.var("data")
+    c = sym.Convolution(data, kernel=(3, 3), num_filter=4, name="conv0")
+    b = sym.BatchNorm(c, name="bn0", fix_gamma=False, eps=1e-3)
+    out = sym.Activation(b, act_type="relu")
+    rng = np.random.default_rng(0)
+    args = {"conv0_weight": mx.nd.array(rng.standard_normal(
+                (4, 2, 3, 3)).astype("float32")),
+            "conv0_bias": mx.nd.array(rng.standard_normal(
+                (4,)).astype("float32")),
+            "bn0_gamma": mx.nd.array(rng.uniform(0.5, 1.5, 4)
+                                     .astype("float32")),
+            "bn0_beta": mx.nd.array(rng.standard_normal(4)
+                                    .astype("float32"))}
+    aux = {"bn0_moving_mean": mx.nd.array(rng.standard_normal(4)
+                                          .astype("float32")),
+           "bn0_moving_var": mx.nd.array(rng.uniform(0.5, 2.0, 4)
+                                         .astype("float32"))}
+    x = rng.standard_normal((2, 2, 8, 8)).astype("float32")
+    bindings = dict(args); bindings.update(aux)
+    bindings["data"] = NDArray(x)
+    ref = out.eval_dict(bindings)
+    ref = (ref[0] if isinstance(ref, (list, tuple)) else ref).asnumpy()
+
+    fsym, fargs = fold_batch_norm(out, args, aux)
+    assert "BatchNorm" not in [n.op for n in fsym._topo()]
+    fb = dict(fargs); fb["data"] = NDArray(x)
+    got = fsym.eval_dict(fb)
+    got = (got[0] if isinstance(got, (list, tuple)) else got).asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+    # no-bias convs gain a folded bias
+    c2 = sym.Convolution(data, kernel=(3, 3), num_filter=4,
+                         name="convnb", no_bias=True)
+    b2 = sym.BatchNorm(c2, name="bn1", fix_gamma=True)
+    args2 = {"convnb_weight": args["conv0_weight"],
+             "bn1_gamma": args["bn0_gamma"], "bn1_beta": args["bn0_beta"]}
+    aux2 = {"bn1_moving_mean": aux["bn0_moving_mean"],
+            "bn1_moving_var": aux["bn0_moving_var"]}
+    bindings2 = dict(args2); bindings2.update(aux2)
+    bindings2["data"] = NDArray(x)
+    ref2 = b2.eval_dict(bindings2)
+    ref2 = (ref2[0] if isinstance(ref2, (list, tuple)) else ref2).asnumpy()
+    fsym2, fargs2 = fold_batch_norm(b2, args2, aux2)
+    assert "convnb_bias" in fargs2
+    fb2 = dict(fargs2); fb2["data"] = NDArray(x)
+    got2 = fsym2.eval_dict(fb2)
+    got2 = (got2[0] if isinstance(got2, (list, tuple)) else got2).asnumpy()
+    np.testing.assert_allclose(got2, ref2, rtol=1e-5, atol=1e-5)
+
+
+def test_quantized_act_exact_commute():
+    """relu on int8 == quantize(relu(dequantize)) under symmetric
+    quantization."""
+    from mxnet_tpu.ops import registry
+    q = np.array([-127, -3, 0, 5, 127], np.int8)
+    mn, mx_ = np.float32(-2.0), np.float32(2.0)
+    act = registry.get("_contrib_quantized_act").fn
+    out, omn, omx = act(q, mn, mx_)
+    deq = registry.get("_contrib_dequantize").fn
+    quant = registry.get("_contrib_quantize").fn
+    f = np.asarray(deq(q, mn, mx_))
+    ref = np.asarray(quant(np.maximum(f, 0), np.float32(0.0),
+                           np.maximum(mx_, 0))[0])
+    np.testing.assert_array_equal(np.asarray(out), ref)
+    assert float(omn) == 0.0 and float(omx) == 2.0
