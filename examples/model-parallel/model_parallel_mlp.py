@@ -1,0 +1,67 @@
+"""Manual model parallelism with ctx groups
+(ref: example/model-parallel/ + docs/faq/model_parallel_lstm.md — the
+reference splits an 8-layer LSTM across GPUs with group2ctx; here the
+same API pins network stages to devices and XLA inserts the transfers
+inside one compiled program).
+
+    # 8 virtual devices on CPU:
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/model-parallel/model_parallel_mlp.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def build():
+    data = sym.var("data")
+    label = sym.var("softmax_label")
+    # stage 1 on device 0, stage 2 on device 1 (falls back to one
+    # device transparently when only one exists)
+    with mx.AttrScope(ctx_group="stage1"):
+        h = sym.FullyConnected(data, num_hidden=64, name="fc1")
+        h = sym.Activation(h, act_type="relu", name="relu1")
+        h = sym.FullyConnected(h, num_hidden=64, name="fc2")
+        h = sym.Activation(h, act_type="relu", name="relu2")
+    with mx.AttrScope(ctx_group="stage2"):
+        h = sym.FullyConnected(h, num_hidden=64, name="fc3")
+        h = sym.Activation(h, act_type="relu", name="relu3")
+        out = sym.FullyConnected(h, num_hidden=4, name="fc4")
+    return sym.SoftmaxOutput(out, label, name="softmax")
+
+
+def main():
+    import jax
+    n_dev = len(jax.devices())
+    group2ctxs = {"stage1": mx.Context(jax.devices()[0].platform, 0),
+                  "stage2": mx.Context(jax.devices()[0].platform,
+                                       1 if n_dev > 1 else 0)}
+    print(f"{n_dev} devices; stage placement: {group2ctxs}")
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((512, 20)).astype(np.float32)
+    y = (np.abs(X[:, :4]).argmax(1)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=64, shuffle=True)
+
+    mod = mx.module.Module(build(), group2ctxs=group2ctxs)
+    mod.fit(it, num_epoch=25, eval_metric="acc",
+            initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.3},
+            batch_end_callback=None)
+    m = mx.metric.create("acc")
+    it.reset()
+    mod.score(it, m)
+    print("final train accuracy:", round(m.get()[1], 3))
+    assert m.get()[1] > 0.9
+    print("DONE")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,157 @@
+"""Bucketing LSTM language model on a synthetic corpus
+(ref: example/rnn/bucketing/lstm_bucketing.py — same structure: variable-
+length sequences bucketed by length, one BucketingModule sharing
+parameters across per-length executors, Perplexity metric).
+
+    python examples/rnn/bucketing_lm.py [--num-epochs 5]
+
+The corpus is generated (a noisy repeating alphabet) so the example is
+self-contained offline; swap `synthetic_corpus` for a tokenized text
+file to train on real data.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.io.io import DataBatch, DataDesc
+
+BUCKETS = [8, 16]
+VOCAB = 16
+NUM_HIDDEN = 32
+
+
+def synthetic_corpus(n_seq=400, seed=0):
+    """Sequences of a repeating ramp with noise — next-token is
+    predictable, so perplexity must drop well below uniform (=VOCAB)."""
+    rng = np.random.default_rng(seed)
+    seqs = []
+    for _ in range(n_seq):
+        length = int(rng.choice(BUCKETS))
+        start = int(rng.integers(0, VOCAB))
+        seq = [(start + i) % VOCAB for i in range(length + 1)]
+        if rng.random() < 0.1:  # noise
+            seq[int(rng.integers(0, length))] = int(rng.integers(0, VOCAB))
+        seqs.append(seq)
+    return seqs
+
+
+class BucketSeqIter:
+    """Minimal bucketed iterator (ref: the BucketSentenceIter the
+    example uses): groups sequences by bucket, yields DataBatch with
+    bucket_key + per-bucket provide_data."""
+
+    def __init__(self, seqs, batch_size):
+        self.batch_size = batch_size
+        self.buckets = {b: [] for b in BUCKETS}
+        for s in seqs:
+            b = min(x for x in BUCKETS if x >= len(s) - 1)
+            data = np.zeros(b, np.float32)
+            label = np.zeros(b, np.float32)
+            data[:len(s) - 1] = s[:-1]
+            label[:len(s) - 1] = s[1:]
+            self.buckets[b].append((data, label))
+        self.default_bucket_key = max(BUCKETS)
+        # the classic bucketing contract: LSTM init states ride in
+        # provide_data (ref: example/rnn/bucketing BucketSentenceIter
+        # init_states), so shape inference knows them at bind
+        self.init_states = [("lstm_begin_state_1", (batch_size, NUM_HIDDEN)),
+                            ("lstm_begin_state_2", (batch_size, NUM_HIDDEN))]
+        self.provide_data = [DataDesc("data",
+                                      (batch_size,
+                                       self.default_bucket_key))] + \
+            [DataDesc(n, s) for n, s in self.init_states]
+        self.provide_label = [DataDesc("softmax_label",
+                                       (batch_size,
+                                        self.default_bucket_key))]
+        self._rng = np.random.default_rng(0)  # one stream: epochs differ
+        self.reset()
+
+    def reset(self):
+        self._plan = []
+        for b, rows in self.buckets.items():
+            for i in range(0, len(rows) - self.batch_size + 1,
+                           self.batch_size):
+                self._plan.append((b, rows[i:i + self.batch_size]))
+        self._rng.shuffle(self._plan)
+        self._pos = 0
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self):
+        if self._pos >= len(self._plan):
+            raise StopIteration
+        b, rows = self._plan[self._pos]
+        self._pos += 1
+        data = np.stack([r[0] for r in rows])
+        label = np.stack([r[1] for r in rows])
+        zeros = [mx.nd.zeros(s) for _, s in self.init_states]
+        return DataBatch(
+            data=[mx.nd.array(data)] + zeros,
+            label=[mx.nd.array(label)],
+            bucket_key=b,
+            provide_data=[DataDesc("data", (self.batch_size, b))] +
+            [DataDesc(n, s) for n, s in self.init_states],
+            provide_label=[DataDesc("softmax_label",
+                                    (self.batch_size, b))])
+
+
+def sym_gen_factory(num_hidden, num_embed):
+    def sym_gen(seq_len):
+        data = sym.var("data")
+        label = sym.var("softmax_label")
+        embed = sym.Embedding(data, input_dim=VOCAB, output_dim=num_embed,
+                              name="embed")
+        cell = mx.rnn.LSTMCell(num_hidden, prefix="lstm_")
+        out, _ = cell.unroll(seq_len, embed, layout="NTC")
+        out = sym.Reshape(out, shape=(-1, num_hidden))
+        pred = sym.FullyConnected(out, num_hidden=VOCAB, name="pred")
+        label_flat = sym.Reshape(label, shape=(-1,))
+        return (sym.SoftmaxOutput(pred, label_flat, name="softmax"),
+                ["data", "lstm_begin_state_1", "lstm_begin_state_2"],
+                ["softmax_label"])
+    return sym_gen
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-epochs", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--num-hidden", type=int, default=NUM_HIDDEN)
+    p.add_argument("--num-embed", type=int, default=16)
+    p.add_argument("--lr", type=float, default=0.5)
+    args = p.parse_args()
+
+    train = BucketSeqIter(synthetic_corpus(), args.batch_size)
+    mod = mx.mod.BucketingModule(
+        sym_gen_factory(args.num_hidden, args.num_embed),
+        default_bucket_key=train.default_bucket_key)
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": args.lr})
+    metric = mx.metric.Perplexity(ignore_label=None)
+    for epoch in range(args.num_epochs):
+        metric.reset()
+        for batch in train:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+            mod.update_metric(metric, batch.label)
+        print("Epoch[%d] %s=%.3f" % (epoch, *metric.get()), flush=True)
+    name, ppl = metric.get()
+    assert ppl < VOCAB / 2, f"perplexity {ppl} did not improve"
+    print("DONE perplexity", round(ppl, 3))
+
+
+if __name__ == "__main__":
+    main()

@@ -75,6 +75,7 @@ from . import callback  # noqa: E402
 from . import model  # noqa: E402
 from . import module  # noqa: E402
 from . import module as mod  # noqa: E402
+from . import rnn  # noqa: E402
 from . import subgraph  # noqa: E402
 from . import profiler  # noqa: E402
 from . import contrib  # noqa: E402

@@ -105,9 +105,18 @@ def fold_batch_norm(symbol, arg_params, aux_params):
         if old_conv.op != "Convolution" or k0 != 0 or \
                 consumers[(id(old_conv), 0)] != 1:
             continue
+        if consumers[(id(node), 1)] or consumers[(id(node), 2)]:
+            # someone consumes the BN's mean/var outputs — folding would
+            # rewire them to the conv activation; leave this BN alone
+            continue
         conv = memo[id(old_conv)]
         wnode = conv.inputs[1][0]
         if wnode.op is not None:
+            continue
+        old_wnode = old_conv.inputs[1][0]
+        if consumers[(id(old_wnode), 0)] > 1:
+            # a weight shared by several convs would get scaled once per
+            # fold — skip (the reference's fusion requires exclusive use)
             continue
         names = [c.name for c, _ in node.inputs[1:5]]
         gamma = _val(arg_params, names[0])

@@ -51,12 +51,19 @@ class Trainer:
         self._optimizer.set_learning_rate(lr)
 
     def _init_kvstore(self):
-        if self._kvstore_type and self._kvstore_type != "None" and \
+        from ..kvstore.kvstore import KVStore as _KVStore
+        if isinstance(self._kvstore_type, _KVStore):
+            # the reference accepts a ready KVStore instance as well as a
+            # type string (gluon/trainer.py _init_kvstore)
+            self._kvstore = self._kvstore_type
+        elif self._kvstore_type and self._kvstore_type != "None" and \
                 str(self._kvstore_type).startswith("dist"):
             from .. import kvstore as kv_mod
             self._kvstore = kv_mod.create(self._kvstore_type)
+        if self._kvstore is not None:
             if self._compression_params:
-                self._kvstore.set_gradient_compression(self._compression_params)
+                self._kvstore.set_gradient_compression(
+                    self._compression_params)
             if self._update_on_kvstore is None:
                 self._update_on_kvstore = True
             if self._update_on_kvstore:

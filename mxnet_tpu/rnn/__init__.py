@@ -1,0 +1,5 @@
+"""mx.rnn — symbolic RNN cells for explicit unrolling
+(ref: python/mxnet/rnn/__init__.py)."""
+from .rnn_cell import (BaseRNNCell, RNNCell, LSTMCell, GRUCell,
+                       FusedRNNCell, SequentialRNNCell, BidirectionalCell,
+                       DropoutCell, ZoneoutCell, ResidualCell)

@@ -1,0 +1,386 @@
+"""Symbolic RNN cells (ref: python/mxnet/rnn/rnn_cell.py).
+
+Cells compose symbols one step at a time; ``unroll`` lays T steps into
+the graph. Under this framework the unrolled graph compiles into a
+single XLA program at bind — the fused alternative (`FusedRNNCell`,
+wrapping the `RNN` op's lax.scan lowering) produces the same numbers
+with one op. Used by BucketingModule language models exactly as in the
+reference's example/rnn.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .. import symbol as sym
+
+
+class BaseRNNCell:
+    """Abstract cell (ref: rnn_cell.py BaseRNNCell)."""
+
+    def __init__(self, prefix=""):
+        self._prefix = prefix
+        self._counter = 0
+        self._init_counter = 0
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError
+
+    @property
+    def state_info(self):
+        raise NotImplementedError
+
+    def begin_state(self, func=None, **kwargs):
+        """Initial state symbols (ref: rnn_cell.py begin_state)."""
+        states = []
+        for info in self.state_info:
+            self._init_counter += 1
+            name = f"{self._prefix}begin_state_{self._init_counter}"
+            if func is None:
+                states.append(sym.var(name, **kwargs))
+            else:
+                states.append(func(name=name, **info, **kwargs))
+        return states
+
+    def reset(self):
+        self._counter = 0
+        self._init_counter = 0
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        """Unroll the cell `length` steps (ref: rnn_cell.py unroll).
+
+        inputs: one (N, T, C) symbol ("NTC"), a (T, N, C) symbol
+        ("TNC"), or a list of T (N, C) symbols. Returns
+        (outputs, final_states) — outputs merged back to the input
+        layout when merge_outputs is not False.
+        """
+        self.reset()
+        axis = layout.find("T")
+        if isinstance(inputs, (list, tuple)):
+            seq = list(inputs)
+            if len(seq) != length:
+                raise MXNetError(
+                    f"unroll: expected {length} step inputs, got {len(seq)}")
+        else:
+            seq = sym.SliceChannel(inputs, num_outputs=length, axis=axis,
+                                   squeeze_axis=True)
+            seq = [seq[i] for i in range(length)]
+        states = begin_state if begin_state is not None \
+            else self.begin_state()
+        outputs = []
+        for t in range(length):
+            out, states = self(seq[t], states)
+            outputs.append(out)
+        if merge_outputs is False:
+            return outputs, states
+        # stack along the time axis, preserving layout
+        expanded = [sym.expand_dims(o, axis=axis) for o in outputs]
+        merged = sym.Concat(*expanded, dim=axis)
+        return merged, states
+
+
+class RNNCell(BaseRNNCell):
+    """Vanilla tanh/relu cell (ref: rnn_cell.py RNNCell)."""
+
+    def __init__(self, num_hidden, activation="tanh", prefix="rnn_"):
+        super().__init__(prefix)
+        self._num_hidden = num_hidden
+        self._activation = activation
+        p = self._prefix
+        self._iW = sym.var(p + "i2h_weight")
+        self._iB = sym.var(p + "i2h_bias")
+        self._hW = sym.var(p + "h2h_weight")
+        self._hB = sym.var(p + "h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden)}]
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f"{self._prefix}t{self._counter}_"
+        i2h = sym.FullyConnected(inputs, self._iW, self._iB,
+                                 num_hidden=self._num_hidden,
+                                 name=name + "i2h")
+        h2h = sym.FullyConnected(states[0], self._hW, self._hB,
+                                 num_hidden=self._num_hidden,
+                                 name=name + "h2h")
+        out = sym.Activation(i2h + h2h, act_type=self._activation,
+                             name=name + "out")
+        return out, [out]
+
+
+class LSTMCell(BaseRNNCell):
+    """LSTM cell (ref: rnn_cell.py LSTMCell; Hochreiter 1997)."""
+
+    def __init__(self, num_hidden, prefix="lstm_", forget_bias=1.0):
+        super().__init__(prefix)
+        self._num_hidden = num_hidden
+        self._forget_bias = forget_bias
+        p = self._prefix
+        self._iW = sym.var(p + "i2h_weight")
+        self._iB = sym.var(p + "i2h_bias")
+        self._hW = sym.var(p + "h2h_weight")
+        self._hB = sym.var(p + "h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden)},
+                {"shape": (0, self._num_hidden)}]
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f"{self._prefix}t{self._counter}_"
+        H = self._num_hidden
+        i2h = sym.FullyConnected(inputs, self._iW, self._iB,
+                                 num_hidden=4 * H, name=name + "i2h")
+        h2h = sym.FullyConnected(states[0], self._hW, self._hB,
+                                 num_hidden=4 * H, name=name + "h2h")
+        gates = i2h + h2h
+        split = sym.SliceChannel(gates, num_outputs=4, axis=1,
+                                 name=name + "slice")
+        i = sym.Activation(split[0], act_type="sigmoid")
+        f = sym.Activation(split[1] + self._forget_bias,
+                           act_type="sigmoid")
+        g = sym.Activation(split[2], act_type="tanh")
+        o = sym.Activation(split[3], act_type="sigmoid")
+        c = f * states[1] + i * g
+        h = o * sym.Activation(c, act_type="tanh")
+        return h, [h, c]
+
+
+class GRUCell(BaseRNNCell):
+    """GRU cell (ref: rnn_cell.py GRUCell; Cho 2014)."""
+
+    def __init__(self, num_hidden, prefix="gru_"):
+        super().__init__(prefix)
+        self._num_hidden = num_hidden
+        p = self._prefix
+        self._iW = sym.var(p + "i2h_weight")
+        self._iB = sym.var(p + "i2h_bias")
+        self._hW = sym.var(p + "h2h_weight")
+        self._hB = sym.var(p + "h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden)}]
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f"{self._prefix}t{self._counter}_"
+        H = self._num_hidden
+        i2h = sym.FullyConnected(inputs, self._iW, self._iB,
+                                 num_hidden=3 * H, name=name + "i2h")
+        h2h = sym.FullyConnected(states[0], self._hW, self._hB,
+                                 num_hidden=3 * H, name=name + "h2h")
+        i_split = sym.SliceChannel(i2h, num_outputs=3, axis=1)
+        h_split = sym.SliceChannel(h2h, num_outputs=3, axis=1)
+        i_r, i_z, i_n = (i_split[k] for k in range(3))
+        h_r, h_z, h_n = (h_split[k] for k in range(3))
+        r = sym.Activation(i_r + h_r, act_type="sigmoid")
+        z = sym.Activation(i_z + h_z, act_type="sigmoid")
+        n = sym.Activation(i_n + r * h_n, act_type="tanh")
+        out = (1 - z) * n + z * states[0]
+        return out, [out]
+
+
+class FusedRNNCell(BaseRNNCell):
+    """One fused RNN op for the whole sequence (ref: rnn_cell.py
+    FusedRNNCell -> the RNN op, src/operator/rnn-inl.h:49)."""
+
+    def __init__(self, num_hidden, num_layers=1, mode="lstm",
+                 bidirectional=False, prefix="rnn_"):
+        super().__init__(prefix)
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+
+    @property
+    def state_info(self):
+        dirs = 2 if self._bidirectional else 1
+        info = [{"shape": (self._num_layers * dirs, 0, self._num_hidden)}]
+        if self._mode == "lstm":
+            info.append(dict(info[0]))
+        return info
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        if isinstance(inputs, (list, tuple)):
+            inputs = sym.Concat(*[sym.expand_dims(i, axis=0)
+                                  for i in inputs], dim=0)  # (T, N, C)
+        elif layout == "NTC":
+            inputs = sym.transpose(inputs, axes=(1, 0, 2))
+        states = begin_state if begin_state is not None \
+            else self.begin_state()
+        params = sym.var(self._prefix + "parameters")
+        kwargs = dict(state_size=self._num_hidden,
+                      num_layers=self._num_layers, mode=self._mode,
+                      bidirectional=self._bidirectional,
+                      state_outputs=True)
+        if self._mode == "lstm":
+            out = sym.RNN(inputs, params, states[0],
+                          state_cell=states[1], name=self._prefix + "rnn",
+                          **kwargs)
+        else:
+            out = sym.RNN(inputs, params, states[0],
+                          name=self._prefix + "rnn", **kwargs)
+        outputs = out[0]
+        if layout == "NTC":
+            outputs = sym.transpose(outputs, axes=(1, 0, 2))
+        n_state = len(self.state_info)
+        return outputs, [out[1 + k] for k in range(n_state)]
+
+
+class SequentialRNNCell(BaseRNNCell):
+    """Stack cells (ref: rnn_cell.py SequentialRNNCell)."""
+
+    def __init__(self):
+        super().__init__("")
+        self._cells = []
+
+    def add(self, cell):
+        self._cells.append(cell)
+
+    @property
+    def state_info(self):
+        return [i for c in self._cells for i in c.state_info]
+
+    def begin_state(self, **kwargs):
+        return [s for c in self._cells for s in c.begin_state(**kwargs)]
+
+    def __call__(self, inputs, states):
+        next_states = []
+        pos = 0
+        for cell in self._cells:
+            n = len(cell.state_info)
+            inputs, st = cell(inputs, states[pos:pos + n])
+            next_states.extend(st)
+            pos += n
+        return inputs, next_states
+
+
+class BidirectionalCell(BaseRNNCell):
+    """Run two cells over the sequence in opposite directions
+    (ref: rnn_cell.py BidirectionalCell)."""
+
+    def __init__(self, l_cell, r_cell):
+        super().__init__("")
+        self._l = l_cell
+        self._r = r_cell
+
+    @property
+    def state_info(self):
+        return self._l.state_info + self._r.state_info
+
+    def begin_state(self, **kwargs):
+        return self._l.begin_state(**kwargs) + \
+            self._r.begin_state(**kwargs)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        axis = layout.find("T")
+        if not isinstance(inputs, (list, tuple)):
+            s = sym.SliceChannel(inputs, num_outputs=length, axis=axis,
+                                 squeeze_axis=True)
+            inputs = [s[i] for i in range(length)]
+        states = begin_state if begin_state is not None \
+            else self.begin_state()
+        nl = len(self._l.state_info)
+        lo, ls = self._l.unroll(length, inputs, states[:nl],
+                                layout="NTC", merge_outputs=False)
+        ro, rs = self._r.unroll(length, list(reversed(inputs)),
+                                states[nl:], layout="NTC",
+                                merge_outputs=False)
+        ro = list(reversed(ro))
+        outs = [sym.Concat(l, r, dim=1) for l, r in zip(lo, ro)]
+        if merge_outputs is False:
+            return outs, ls + rs
+        merged = sym.Concat(*[sym.expand_dims(o, axis=axis)
+                              for o in outs], dim=axis)
+        return merged, ls + rs
+
+    def __call__(self, inputs, states):
+        raise MXNetError(
+            "BidirectionalCell cannot step one timestep at a time; "
+            "call unroll (the reference raises the same)")
+
+
+class DropoutCell(BaseRNNCell):
+    """Dropout on the output stream (ref: rnn_cell.py DropoutCell)."""
+
+    def __init__(self, dropout):
+        super().__init__("")
+        self._dropout = dropout
+
+    @property
+    def state_info(self):
+        return []
+
+    def __call__(self, inputs, states):
+        if self._dropout > 0:
+            inputs = sym.Dropout(inputs, p=self._dropout)
+        return inputs, states
+
+
+class ZoneoutCell(BaseRNNCell):
+    """Zoneout regularization wrapper (ref: rnn_cell.py ZoneoutCell;
+    Krueger 2016): randomly preserve previous states."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__(base_cell._prefix + "zoneout_")
+        self._base = base_cell
+        self._zo = zoneout_outputs
+        self._zs = zoneout_states
+        self._prev_out = None
+
+    def reset(self):
+        # drop the previous unroll's output symbol, or a second unroll
+        # would splice the old graph in (ref: ZoneoutCell.reset)
+        super().reset()
+        self._base.reset()
+        self._prev_out = None
+
+    @property
+    def state_info(self):
+        return self._base.state_info
+
+    def begin_state(self, **kwargs):
+        return self._base.begin_state(**kwargs)
+
+    def __call__(self, inputs, states):
+        out, next_states = self._base(inputs, states)
+
+        def mask(p, new, old):
+            m = sym.Dropout(sym.ones_like(new), p=p)
+            return sym.where(m, new, old)
+
+        prev = self._prev_out if self._prev_out is not None \
+            else sym.zeros_like(out)
+        if self._zo > 0:
+            out = mask(self._zo, out, prev)
+        self._prev_out = out
+        if self._zs > 0:
+            next_states = [mask(self._zs, n, o)
+                           for n, o in zip(next_states, states)]
+        return out, next_states
+
+
+class ResidualCell(BaseRNNCell):
+    """output = cell(x) + x (ref: rnn_cell.py ResidualCell)."""
+
+    def __init__(self, base_cell):
+        super().__init__(base_cell._prefix + "residual_")
+        self._base = base_cell
+
+    @property
+    def state_info(self):
+        return self._base.state_info
+
+    def begin_state(self, **kwargs):
+        return self._base.begin_state(**kwargs)
+
+    def __call__(self, inputs, states):
+        out, states = self._base(inputs, states)
+        return out + inputs, states

@@ -74,3 +74,22 @@ def test_quantize_model_script():
     agree = float(out.split("top-1 agreement fp32 vs int8:")[1]
                   .split()[0])
     assert agree >= 0.5, out[-500:]
+
+
+def test_bucketing_lm_example():
+    out = _run([sys.executable, "examples/rnn/bucketing_lm.py",
+                "--num-epochs", "3"])
+    assert "DONE perplexity" in out
+
+
+def test_model_parallel_example():
+    out = _run([sys.executable,
+                "examples/model-parallel/model_parallel_mlp.py"])
+    assert "DONE" in out
+
+
+def test_distributed_example_collective():
+    out = _run([sys.executable, "tools/launch.py", "-n", "2", "-s", "0",
+                sys.executable, "examples/distributed/train_dist.py",
+                "--kv-store", "dist_device_sync"])
+    assert out.count("OK") >= 2

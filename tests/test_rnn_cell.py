@@ -1,0 +1,119 @@
+"""mx.rnn cell package (ref: tests/python/unittest/test_rnn.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.ndarray.ndarray import NDArray
+
+
+def _run(out_sym, bindings):
+    b = {k: (v if isinstance(v, NDArray) else mx.nd.array(v))
+         for k, v in bindings.items()}
+    out = out_sym.eval_dict(b)
+    out = out[0] if isinstance(out, (list, tuple)) else out
+    return out.asnumpy()
+
+
+def test_rnn_cell_unroll_matches_numpy():
+    T, N, I, H = 3, 2, 4, 5
+    cell = mx.rnn.RNNCell(H, prefix="r_")
+    data = sym.var("data")
+    out, states = cell.unroll(T, data, layout="NTC")
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((N, T, I)).astype(np.float32)
+    iW = rng.standard_normal((H, I)).astype(np.float32)
+    iB = rng.standard_normal(H).astype(np.float32)
+    hW = rng.standard_normal((H, H)).astype(np.float32)
+    hB = rng.standard_normal(H).astype(np.float32)
+    h0 = np.zeros((N, H), np.float32)
+    got = _run(out, {"data": x, "r_i2h_weight": iW, "r_i2h_bias": iB,
+                     "r_h2h_weight": hW, "r_h2h_bias": hB,
+                     "r_begin_state_1": h0})
+    h = h0
+    ref = []
+    for t in range(T):
+        h = np.tanh(x[:, t] @ iW.T + iB + h @ hW.T + hB)
+        ref.append(h)
+    np.testing.assert_allclose(got, np.stack(ref, axis=1), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_lstm_cell_unroll_matches_numpy():
+    T, N, I, H = 3, 2, 4, 5
+    cell = mx.rnn.LSTMCell(H, prefix="l_", forget_bias=0.0)
+    data = sym.var("data")
+    out, states = cell.unroll(T, data, layout="NTC")
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((N, T, I)).astype(np.float32)
+    iW = rng.standard_normal((4 * H, I)).astype(np.float32) * 0.5
+    iB = rng.standard_normal(4 * H).astype(np.float32) * 0.5
+    hW = rng.standard_normal((4 * H, H)).astype(np.float32) * 0.5
+    hB = rng.standard_normal(4 * H).astype(np.float32) * 0.5
+    h = np.zeros((N, H), np.float32)
+    c = np.zeros((N, H), np.float32)
+    got = _run(out, {"data": x, "l_i2h_weight": iW, "l_i2h_bias": iB,
+                     "l_h2h_weight": hW, "l_h2h_bias": hB,
+                     "l_begin_state_1": h, "l_begin_state_2": c})
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+
+    ref = []
+    for t in range(T):
+        g = x[:, t] @ iW.T + iB + h @ hW.T + hB
+        i_, f_, g_, o_ = np.split(g, 4, axis=1)
+        c = sig(f_) * c + sig(i_) * np.tanh(g_)
+        h = sig(o_) * np.tanh(c)
+        ref.append(h)
+    np.testing.assert_allclose(got, np.stack(ref, axis=1), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_gru_cell_shapes_and_stacking():
+    T, N, I, H = 4, 3, 6, 5
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.GRUCell(H, prefix="g1_"))
+    stack.add(mx.rnn.ResidualCell(mx.rnn.GRUCell(H, prefix="g2_")))
+    data = sym.var("data")
+    out, states = stack.unroll(T, data, layout="NTC")
+    shapes, _, _ = out.infer_shape(
+        data=(N, T, I),
+        **{f"g1_begin_state_1": (N, H), f"g2_begin_state_1": (N, H)})
+    args = out.list_arguments()
+    ex = out.simple_bind(grad_req="null", data=(N, T, I),
+                         g1_begin_state_1=(N, H),
+                         g2_begin_state_1=(N, H))
+    ex.arg_dict["data"]._data = mx.nd.array(
+        np.random.rand(N, T, I).astype("float32"))._data
+    o = ex.forward(is_train=False)
+    assert o[0].shape == (N, T, H)
+    assert len(states) == 2
+
+
+def test_bidirectional_cell():
+    T, N, I, H = 3, 2, 4, 5
+    bi = mx.rnn.BidirectionalCell(mx.rnn.RNNCell(H, prefix="fw_"),
+                                  mx.rnn.RNNCell(H, prefix="bw_"))
+    data = sym.var("data")
+    out, states = bi.unroll(T, data, layout="NTC")
+    ex = out.simple_bind(grad_req="null", data=(N, T, I),
+                         fw_begin_state_1=(N, H),
+                         bw_begin_state_1=(N, H))
+    ex.arg_dict["data"]._data = mx.nd.array(
+        np.random.rand(N, T, I).astype("float32"))._data
+    o = ex.forward(is_train=False)
+    assert o[0].shape == (N, T, 2 * H)
+
+
+def test_dropout_zoneout_cells_eval_mode():
+    H = 4
+    cell = mx.rnn.SequentialRNNCell()
+    cell.add(mx.rnn.RNNCell(H, prefix="a_"))
+    cell.add(mx.rnn.DropoutCell(0.5))
+    data = sym.var("data")
+    out, _ = cell.unroll(2, data, layout="NTC")
+    ex = out.simple_bind(grad_req="null", data=(2, 2, 3),
+                         a_begin_state_1=(2, H))
+    o1 = ex.forward(is_train=False)[0].asnumpy()
+    o2 = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(o1, o2)  # dropout inert at inference
