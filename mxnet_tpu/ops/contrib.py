@@ -262,3 +262,14 @@ def sync_batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     if output_mean_var:
         return out, mean, var
     return out
+
+
+@register("_contrib_flash_attention", aliases=("flash_attention",),
+          wrap_jit=False)
+def contrib_flash_attention(q, k, v, causal=False, scale=None):
+    """Blockwise Pallas attention (O(T) memory) with automatic
+    dense-path dispatch below the measured crossover — the TPU analogue
+    of the reference's fused transformer helpers
+    (src/operator/contrib/transformer.cc interleaved_matmul_*)."""
+    from .pallas_kernels import flash_attention as _fa
+    return _fa(q, k, v, causal=bool(causal), scale=scale)

@@ -15,14 +15,17 @@ from .ring_attention import NEG_INF
 
 
 def _dense_attention(q, k, v, causal, scale):
-    # q, k, v: [B, T, H, D]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
-    if causal:
-        T = q.shape[1]
-        mask = jnp.tril(jnp.ones((T, T), bool))
-        s = jnp.where(mask[None, None], s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    # q, k, v: [B, T, H, D]. flash_attention owns the dispatch policy:
+    # long sequences take the Pallas O(T)-memory kernel (the all-to-all
+    # gives each device the FULL sequence for its head group — exactly
+    # where the T^2 score matrix would blow HBM), short ones its fused
+    # dense path. One crossover policy, one place.
+    from ..ops.pallas_kernels import flash_attention
+    out = flash_attention(q.transpose(0, 2, 1, 3),
+                          k.transpose(0, 2, 1, 3),
+                          v.transpose(0, 2, 1, 3),
+                          causal=causal, scale=scale)
+    return out.transpose(0, 2, 1, 3)
 
 
 def ulysses_attention(q, k, v, axis_name, causal=False, scale=None):

@@ -229,7 +229,14 @@ class FusedRNNCell(BaseRNNCell):
         if layout == "NTC":
             outputs = sym.transpose(outputs, axes=(1, 0, 2))
         n_state = len(self.state_info)
-        return outputs, [out[1 + k] for k in range(n_state)]
+        states = [out[1 + k] for k in range(n_state)]
+        if merge_outputs is False:
+            # per-step list, as composite cells (Bidirectional) expect
+            axis = layout.find("T")
+            split = sym.SliceChannel(outputs, num_outputs=length,
+                                     axis=axis, squeeze_axis=True)
+            return [split[i] for i in range(length)], states
+        return outputs, states
 
 
 class SequentialRNNCell(BaseRNNCell):
@@ -241,6 +248,11 @@ class SequentialRNNCell(BaseRNNCell):
 
     def add(self, cell):
         self._cells.append(cell)
+
+    def reset(self):
+        super().reset()
+        for c in self._cells:
+            c.reset()
 
     @property
     def state_info(self):
@@ -268,6 +280,11 @@ class BidirectionalCell(BaseRNNCell):
         super().__init__("")
         self._l = l_cell
         self._r = r_cell
+
+    def reset(self):
+        super().reset()
+        self._l.reset()
+        self._r.reset()
 
     @property
     def state_info(self):
@@ -373,6 +390,10 @@ class ResidualCell(BaseRNNCell):
     def __init__(self, base_cell):
         super().__init__(base_cell._prefix + "residual_")
         self._base = base_cell
+
+    def reset(self):
+        super().reset()
+        self._base.reset()
 
     @property
     def state_info(self):

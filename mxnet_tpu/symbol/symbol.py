@@ -67,6 +67,8 @@ class _Node:
             n = 3
         if self.op in ("SliceChannel", "split"):
             n = int(self.attrs.get("num_outputs", 1))
+        if self.op == "RNN" and self.attrs.get("state_outputs"):
+            n = 3 if self.attrs.get("mode", "lstm") == "lstm" else 2
         return max(n, 1)
 
 

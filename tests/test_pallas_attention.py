@@ -1,0 +1,89 @@
+"""Pallas flash-attention kernel (interpret mode on the CPU mesh)
+vs the dense reference (ref: the transformer.cc fused helpers the
+reference hand-writes in CUDA; here the hot kernel is Pallas)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.ops.pallas_kernels import (FLASH_MIN_SEQ, _dense_reference,
+                                          flash_attention)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_dense(causal):
+    rng = np.random.default_rng(0)
+    B, H, T, D = 2, 2, 512, 32
+    q, k, v = (jnp.asarray(rng.standard_normal((B, H, T, D)),
+                           jnp.float32) for _ in range(3))
+    out = flash_attention(q, k, v, causal=causal, force=True,
+                          block_q=128, block_k=128)
+    ref = _dense_reference(q.reshape(B * H, T, D), k.reshape(B * H, T, D),
+                           v.reshape(B * H, T, D), causal,
+                           D ** -0.5).reshape(B, H, T, D)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_flash_dispatch_policy():
+    rng = np.random.default_rng(1)
+    # short/untileable sequences -> dense path (same numbers either way)
+    q, k, v = (jnp.asarray(rng.standard_normal((1, 2, 100, 16)),
+                           jnp.float32) for _ in range(3))
+    out = flash_attention(q, k, v)
+    assert out.shape == (1, 2, 100, 16)
+    # 3-d input form
+    q3, k3, v3 = (jnp.asarray(rng.standard_normal((4, 256, 32)),
+                              jnp.float32) for _ in range(3))
+    out3 = flash_attention(q3, k3, v3, force=True, block_q=128,
+                           block_k=128)
+    ref3 = _dense_reference(q3, k3, v3, False, 32 ** -0.5)
+    np.testing.assert_allclose(np.asarray(out3), np.asarray(ref3),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_flash_bf16():
+    rng = np.random.default_rng(2)
+    B, H, T, D = 1, 2, 256, 32
+    q, k, v = (jnp.asarray(rng.standard_normal((B, H, T, D)),
+                           jnp.bfloat16) for _ in range(3))
+    out = flash_attention(q, k, v, causal=True, force=True,
+                          block_q=128, block_k=128)
+    ref = _dense_reference(
+        q.reshape(B * H, T, D).astype(jnp.float32),
+        k.reshape(B * H, T, D).astype(jnp.float32),
+        v.reshape(B * H, T, D).astype(jnp.float32), True,
+        D ** -0.5).reshape(B, H, T, D)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), rtol=0.1, atol=0.05)
+
+
+def test_contrib_op_registered():
+    from mxnet_tpu import nd
+    rng = np.random.default_rng(3)
+    q = nd.array(rng.standard_normal((1, 2, 64, 16)).astype(np.float32))
+    out = nd.contrib.flash_attention(q, q, q, causal=True)
+    assert out.shape == (1, 2, 64, 16)
+
+
+def test_flash_gradients():
+    """The kernel path is differentiable (custom VJP recomputes through
+    the dense formulation), matching dense gradients."""
+    rng = np.random.default_rng(4)
+    q, k, v = (jnp.asarray(rng.standard_normal((2, 128, 16)), jnp.float32)
+               for _ in range(3))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, force=True,
+                                       block_q=64, block_k=64) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_dense_reference(q, k, v, True, 16 ** -0.5) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)

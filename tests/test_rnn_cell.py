@@ -117,3 +117,29 @@ def test_dropout_zoneout_cells_eval_mode():
     o1 = ex.forward(is_train=False)[0].asnumpy()
     o2 = ex.forward(is_train=False)[0].asnumpy()
     np.testing.assert_allclose(o1, o2)  # dropout inert at inference
+
+
+def test_composite_cells_reset_on_reunroll():
+    """One cell instance unrolled twice (the BucketingModule sym_gen
+    pattern) must produce identical begin_state names both times."""
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.GRUCell(4, prefix="s1_"))
+    stack.add(mx.rnn.ResidualCell(mx.rnn.GRUCell(4, prefix="s2_")))
+    data = sym.var("data")
+    out1, _ = stack.unroll(3, data, layout="NTC")
+    out2, _ = stack.unroll(5, data, layout="NTC")
+    args1 = {a for a in out1.list_arguments() if "begin_state" in a}
+    args2 = {a for a in out2.list_arguments() if "begin_state" in a}
+    assert args1 == args2, (args1, args2)
+
+
+def test_fused_cell_merge_outputs_false_and_bidirectional():
+    fused = mx.rnn.FusedRNNCell(4, mode="gru", prefix="fg_")
+    outs, states = fused.unroll(3, sym.var("data"), merge_outputs=False)
+    assert isinstance(outs, list) and len(outs) == 3
+    bi = mx.rnn.BidirectionalCell(
+        mx.rnn.FusedRNNCell(4, mode="gru", prefix="bfl_"),
+        mx.rnn.FusedRNNCell(4, mode="gru", prefix="bfr_"))
+    out, _ = bi.unroll(3, sym.var("data"), layout="NTC")
+    # composes without shape errors at trace level
+    assert out is not None
