@@ -26,8 +26,11 @@ def _dense_reference(q, k, v, causal, scale):
     q, k, v: (BH, T, D)."""
     s = jnp.einsum("btd,bsd->bts", q * scale, k)
     if causal:
-        t = q.shape[1]
-        mask = jnp.tril(jnp.ones((t, t), bool))
+        t_q, t_k = q.shape[1], k.shape[1]
+        # queries are the LAST t_q positions of the key sequence
+        # (decoder convention when t_q != t_k)
+        q_pos = jnp.arange(t_q)[:, None] + (t_k - t_q)
+        mask = jnp.arange(t_k)[None, :] <= q_pos
         s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bts,bsd->btd", p, v)
@@ -118,6 +121,10 @@ def _flash_call(q, k, v, causal, scale, block_q, block_k, interpret):
 # T=2048, flash 1.4x at 4096, 2.3x at 8192 — the T^2 HBM traffic
 # crossover. Below this the fused dense path is optimal.
 FLASH_MIN_SEQ = 4096
+# this kernel stages full K+V per program in VMEM (~16 MB/core); beyond
+# the budget the wrapper falls back to dense rather than fail Mosaic
+# allocation. A K-streamed grid dimension would lift this.
+VMEM_BUDGET_BYTES = 12 * 1024 * 1024
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -165,6 +172,8 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
         interpret = jax.default_backend() not in ("tpu", "axon")
     tiles = not (t_q % block_q or t_k % block_k or
                  (causal and t_q != t_k))
+    if 2 * t_k * q.shape[-1] * q.dtype.itemsize > VMEM_BUDGET_BYTES:
+        tiles = False  # K+V won't fit VMEM; see VMEM_BUDGET_BYTES
     if interpret:
         try:
             if jax.typeof(q).vma:

@@ -1,0 +1,95 @@
+"""Deployment-only inference API
+(ref: include/mxnet/c_predict_api.h — 12 MXPred* functions the
+reference's amalgamation builds for mobile/embedded; here the analogue
+is a minimal class over a checkpoint that forwards with zero training
+machinery and an optionally AOT-compiled executable).
+
+    pred = mx.predictor.Predictor.from_checkpoint("model", 3,
+                                                  {"data": (1, 3, 224, 224)})
+    out = pred.forward(data=batch)          # numpy in, numpy out
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+
+
+class Predictor:
+    """MXPredCreate/SetInput/Forward/GetOutput rolled into one object."""
+
+    def __init__(self, symbol, arg_params, aux_params, input_shapes,
+                 dev_type="tpu", dev_id=0):
+        from .ndarray.ndarray import NDArray
+
+        self._symbol = symbol
+        self._input_names = list(input_shapes)
+        self._shapes = dict(input_shapes)
+        known = set(symbol.list_inputs())
+        missing = [n for n in self._input_names if n not in known]
+        if missing:
+            raise MXNetError(f"Predictor: inputs {missing} not in graph")
+        self._bindings = {}
+        for k, v in list(arg_params.items()) + list(aux_params.items()):
+            self._bindings[k] = v if isinstance(v, NDArray) else NDArray(v)
+        self._jitted = None
+
+    @classmethod
+    def from_checkpoint(cls, prefix, epoch, input_shapes, **kwargs):
+        """Load `prefix-symbol.json` + `prefix-{epoch}.params`
+        (MXPredCreate's file contract, c_predict_api.h)."""
+        from .model import load_checkpoint
+
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return cls(symbol, arg_params, aux_params, input_shapes, **kwargs)
+
+    def _build(self):
+        import jax
+
+        from .ndarray.ndarray import NDArray
+
+        names = sorted(self._bindings)
+        vals = tuple(self._bindings[n]._data for n in names)
+
+        def fwd(param_vals, inputs):
+            b = {n: NDArray(v) for n, v in zip(names, param_vals)}
+            for k, v in inputs.items():
+                b[k] = NDArray(v)
+            out = self._symbol.eval_dict(b)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            return tuple(o._data for o in outs)
+
+        self._jitted = jax.jit(fwd)
+        self._param_vals = jax.device_put(vals)
+
+    def forward(self, **inputs):
+        """Run one forward; numpy (or NDArray) in, list of numpy out
+        (MXPredSetInput + MXPredForward + MXPredGetOutput)."""
+        import jax.numpy as jnp
+
+        from .ndarray.ndarray import NDArray
+
+        if self._jitted is None:
+            self._build()
+        feed = {}
+        for k, v in inputs.items():
+            if k not in self._shapes:
+                raise MXNetError(f"Predictor: unknown input {k!r}")
+            # preserve the caller's dtype (int token indices etc.), as
+            # MXPredSetInput does
+            arr = v._data if isinstance(v, NDArray) \
+                else jnp.asarray(np.asarray(v))
+            if tuple(arr.shape) != tuple(self._shapes[k]):
+                raise MXNetError(
+                    f"Predictor: input {k} shape {tuple(arr.shape)} != "
+                    f"declared {tuple(self._shapes[k])} (reshape with a "
+                    "new Predictor, as MXPredReshape does)")
+            feed[k] = arr
+        outs = self._jitted(self._param_vals, feed)
+        return [np.asarray(o) for o in outs]
+
+    def reshape(self, new_input_shapes):
+        """New shapes -> new compiled executable (MXPredReshape)."""
+        self._shapes.update(new_input_shapes)
+        self._jitted = None
+        return self

@@ -67,8 +67,11 @@ def test_dead_worker_fails_fast():
             env=env, capture_output=True, text=True, timeout=180)
         sys.stdout.write(proc.stdout)
         sys.stderr.write(proc.stderr)
-        # bound = fail-fast vs hang-forever
-        fast = time.monotonic() - t0 < 120
+        # bound = fail-fast vs hang-forever; the tight latency assert
+        # (pull errors < 30s, vs the 60s timeout) lives in the worker —
+        # total wall just has to beat the subprocess timeout, since 4
+        # cold jax imports on one contended CI core dominate it
+        fast = time.monotonic() - t0 < 170
         if fast and proc.stdout.count("DEGRADED OK") == 3:
             return
     raise AssertionError(

@@ -168,3 +168,60 @@ def test_prefetching_iter():
     assert n == 4
     pf.reset()
     assert sum(1 for _ in pf) == 4
+
+
+def test_feedforward_legacy_estimator(tmp_path):
+    """FeedForward.create/fit/predict/score/save/load
+    (ref: python/mxnet/model.py:451)."""
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((120, 6)).astype("float32")
+    y = (X[:, 0] + X[:, 1] > 0).astype("float32")
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = sym.SoftmaxOutput(net, sym.var("softmax_label"), name="softmax")
+
+    model = mx.model.FeedForward.create(
+        net, X, y, num_epoch=12, optimizer="sgd",
+        initializer=mx.init.Xavier(),
+        optimizer_params={"learning_rate": 0.3})
+    acc = model.score(mx.io.NDArrayIter(X, y, batch_size=40))
+    assert acc > 0.85, acc
+    pred = model.predict(X)
+    assert pred.shape == (120, 2)
+    assert ((pred.argmax(1) == y).mean()) > 0.85
+
+    model.save(str(tmp_path / "ff"), 12)
+    loaded = mx.model.FeedForward.load(str(tmp_path / "ff"), 12)
+    pred2 = loaded.predict(X)
+    np.testing.assert_allclose(pred, pred2, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_api(tmp_path):
+    """Predictor: the c_predict_api analogue over a checkpoint
+    (ref: include/mxnet/c_predict_api.h MXPredCreate/Forward)."""
+    rng = np.random.default_rng(1)
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=3, name="fc")
+    ex = net.simple_bind(grad_req="null", data=(2, 4))
+    w = rng.standard_normal((3, 4)).astype("float32")
+    b = rng.standard_normal(3).astype("float32")
+    ex.arg_dict["fc_weight"]._data = mx.nd.array(w)._data
+    ex.arg_dict["fc_bias"]._data = mx.nd.array(b)._data
+    mx.model.save_checkpoint(str(tmp_path / "m"), 0, net,
+                             {"fc_weight": mx.nd.array(w),
+                              "fc_bias": mx.nd.array(b)}, {})
+
+    pred = mx.predictor.Predictor.from_checkpoint(
+        str(tmp_path / "m"), 0, {"data": (2, 4)})
+    x = rng.standard_normal((2, 4)).astype("float32")
+    out = pred.forward(data=x)
+    np.testing.assert_allclose(out[0], x @ w.T + b, rtol=1e-5)
+    # declared-shape enforcement + reshape contract
+    import pytest
+    with pytest.raises(Exception, match="shape"):
+        pred.forward(data=np.zeros((3, 4), np.float32))
+    out2 = pred.reshape({"data": (3, 4)}).forward(
+        data=np.zeros((3, 4), np.float32))
+    np.testing.assert_allclose(out2[0], np.tile(b, (3, 1)), rtol=1e-5)
