@@ -58,7 +58,7 @@ def supervise():
     """
     env = dict(os.environ)
     env[_CHILD_SENTINEL] = "1"
-    attempts, delay = 3, 20
+    attempts, delay = 4, 30
     last_err = "unknown"
 
     def _json_line(raw):
@@ -68,20 +68,53 @@ def supervise():
         return next((ln for ln in reversed(out.splitlines())
                      if ln.startswith("{")), None)
 
+    def _run_child():
+        """Run one attempt; kill it EARLY (300s) while it has produced no
+        measurement yet — a wedged TPU-tunnel grant blocks jax.devices()
+        inside grpc where the child's own SIGALRM cannot fire, and
+        burning the full budget on a dead attempt costs the retries that
+        would land after the grant lease expires."""
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, stdout=subprocess.PIPE)
+        t0 = time.monotonic()
+        chunks = []
+        import threading
+
+        def _pump():
+            while True:
+                b = proc.stdout.read(4096)
+                if not b:
+                    return
+                chunks.append(b)
+
+        th = threading.Thread(target=_pump, daemon=True)
+        th.start()
+        while True:
+            rc = proc.poll()
+            waited = time.monotonic() - t0
+            if rc is not None:
+                th.join(timeout=5)
+                return b"".join(chunks), rc, None
+            got_data = bool(chunks)
+            if (not got_data and waited > 300) or waited > 900:
+                proc.kill()
+                proc.wait()
+                th.join(timeout=5)
+                why = ("no output in 300s (wedged backend init?)"
+                       if not got_data else "timed out after 900s")
+                return b"".join(chunks), -1, why
+            time.sleep(2)
+
     for i in range(attempts):
         _diag("attempt %d/%d starting" % (i + 1, attempts))
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                env=env, stdout=subprocess.PIPE, timeout=900)
-            out, rc = proc.stdout, proc.returncode
-        except subprocess.TimeoutExpired as e:
+        out, rc, why = _run_child()
+        if why is not None:
             # the child prints the headline metric as a partial JSON line
             # the moment the bf16 number is in hand — a later hang in an
             # auxiliary section (fp32/int8 can wedge in C++ where SIGALRM
             # can't fire) must not discard it
-            out, rc = e.stdout, -1
-            last_err = "bench child timed out after 900s"
+            last_err = "bench child " + why
             _diag(last_err)
         line = _json_line(out)
         # accept the line on clean exit, or (timeout/crash rescue) when it

@@ -84,6 +84,17 @@ class FeedForward:
                                           X.shape[0]),
                            shuffle=is_train)
 
+    def _optimizer_params(self):
+        """The reference passes optimizer hyperparams as loose ctor
+        kwargs (learning_rate=..., momentum=...); accept both that and
+        an explicit optimizer_params dict (model.py:488 **kwargs)."""
+        params = dict(self._kwargs.get("optimizer_params") or {})
+        for k, v in self._kwargs.items():
+            if k != "optimizer_params":
+                params.setdefault(k, v)
+        params.setdefault("learning_rate", 0.01)
+        return params
+
     def fit(self, X, y=None, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None,
             kvstore="local", logger=None, work_load_list=None,
@@ -99,12 +110,12 @@ class FeedForward:
             epoch_end_callback=epoch_end_callback,
             batch_end_callback=batch_end_callback, kvstore=kvstore,
             optimizer=self.optimizer,
-            optimizer_params=self._kwargs.get("optimizer_params",
-                                              {"learning_rate": 0.01}),
+            optimizer_params=self._optimizer_params(),
             initializer=self.initializer,
             arg_params=self.arg_params, aux_params=self.aux_params,
             begin_epoch=self.begin_epoch, num_epoch=self.num_epoch,
-            monitor=monitor)
+            monitor=monitor, eval_end_callback=eval_end_callback,
+            eval_batch_end_callback=eval_batch_end_callback)
         self.arg_params, self.aux_params = self._module.get_params()
         return self
 
@@ -133,17 +144,15 @@ class FeedForward:
         mod = self._ensure_module(data)
         if not return_data:
             out = mod.predict(data, num_batch=num_batch, reset=reset)
+            if isinstance(out, list):  # multi-output symbol / empty iter
+                return [o.asnumpy() for o in out]
             return out.asnumpy()
-        if reset:
-            data.reset()
         outs, datas, labels = [], [], []
-        for i, batch in enumerate(data):
-            if num_batch is not None and i >= num_batch:
-                break
-            mod.forward(batch, is_train=False)
+        for outputs, _, batch in mod.iter_predict(data, num_batch=num_batch,
+                                                  reset=reset):
             pad = batch.pad or 0
             end = batch.data[0].shape[0] - pad
-            outs.append(mod.get_outputs()[0].asnumpy()[:end])
+            outs.append(outputs[0].asnumpy())
             datas.append(batch.data[0].asnumpy()[:end])
             labels.append(batch.label[0].asnumpy()[:end])
         return (np.concatenate(outs), np.concatenate(datas),
@@ -158,7 +167,8 @@ class FeedForward:
         mod = self._ensure_module(data)
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
-        mod.score(data, eval_metric, num_batch=num_batch)
+        mod.score(data, eval_metric, num_batch=num_batch,
+                  batch_end_callback=batch_end_callback, reset=reset)
         return eval_metric.get()[1]
 
     def save(self, prefix, epoch=None):

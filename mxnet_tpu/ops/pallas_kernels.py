@@ -27,6 +27,10 @@ def _dense_reference(q, k, v, causal, scale):
     s = jnp.einsum("btd,bsd->bts", q * scale, k)
     if causal:
         t_q, t_k = q.shape[1], k.shape[1]
+        if t_q > t_k:
+            raise ValueError(
+                f"causal attention with t_q ({t_q}) > t_k ({t_k}) leaves "
+                "queries with no visible keys; pad K/V or drop causal")
         # queries are the LAST t_q positions of the key sequence
         # (decoder convention when t_q != t_k)
         q_pos = jnp.arange(t_q)[:, None] + (t_k - t_q)

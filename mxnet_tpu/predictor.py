@@ -19,9 +19,23 @@ class Predictor:
     """MXPredCreate/SetInput/Forward/GetOutput rolled into one object."""
 
     def __init__(self, symbol, arg_params, aux_params, input_shapes,
-                 dev_type="tpu", dev_id=0):
+                 dev_type=None, dev_id=0):
+        import jax
+
         from .ndarray.ndarray import NDArray
 
+        # MXPredCreate's dev_type/dev_id select the device; None = the
+        # backend default (the TPU under axon)
+        self._device = None
+        if dev_type is not None:
+            matching = [d for d in jax.devices()
+                        if d.platform == dev_type or
+                        (dev_type == "tpu" and d.platform == "axon")]
+            if not matching or dev_id >= len(matching):
+                raise MXNetError(
+                    f"Predictor: no device {dev_type}:{dev_id}; available "
+                    f"platforms: {sorted({d.platform for d in jax.devices()})}")
+            self._device = matching[dev_id]
         self._symbol = symbol
         self._input_names = list(input_shapes)
         self._shapes = dict(input_shapes)
@@ -60,7 +74,9 @@ class Predictor:
             return tuple(o._data for o in outs)
 
         self._jitted = jax.jit(fwd)
-        self._param_vals = jax.device_put(vals)
+        # committed params pin the computation to the selected device
+        self._param_vals = jax.device_put(vals, self._device) \
+            if self._device is not None else jax.device_put(vals)
 
     def forward(self, **inputs):
         """Run one forward; numpy (or NDArray) in, list of numpy out
