@@ -28,9 +28,15 @@ class Predictor:
         # backend default (the TPU under axon)
         self._device = None
         if dev_type is not None:
-            matching = [d for d in jax.devices()
-                        if d.platform == dev_type or
-                        (dev_type == "tpu" and d.platform == "axon")]
+            matching = []
+            for backend in (dev_type, "axon" if dev_type == "tpu" else None):
+                if backend is None:
+                    continue
+                try:
+                    matching = jax.devices(backend)
+                    break
+                except RuntimeError:
+                    continue
             if not matching or dev_id >= len(matching):
                 raise MXNetError(
                     f"Predictor: no device {dev_type}:{dev_id}; available "
