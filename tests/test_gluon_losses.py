@@ -1,0 +1,107 @@
+"""Gluon loss classes vs NumPy references
+(ref: tests/python/unittest/test_loss.py — every loss checked against
+the closed-form expression)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+
+RNG = np.random.default_rng(11)
+
+
+def _softrelu(x):
+    return np.log1p(np.exp(-np.abs(x))) + np.maximum(x, 0)
+
+
+def test_sigmoid_bce_logits_and_probs():
+    pred = RNG.standard_normal((4, 5)).astype(np.float32)
+    label = (RNG.random((4, 5)) > 0.5).astype(np.float32)
+    # with logits
+    got = gluon.loss.SigmoidBinaryCrossEntropyLoss()(
+        nd.array(pred), nd.array(label)).asnumpy()
+    ref = np.maximum(pred, 0) - pred * label + np.log1p(np.exp(-np.abs(pred)))
+    np.testing.assert_allclose(got, ref.mean(axis=1), rtol=1e-5, atol=1e-6)
+    # from_sigmoid
+    probs = 1 / (1 + np.exp(-pred))
+    got2 = gluon.loss.SigmoidBCELoss(from_sigmoid=True)(
+        nd.array(probs), nd.array(label)).asnumpy()
+    ref2 = -(np.log(probs + 1e-12) * label
+             + np.log(1 - probs + 1e-12) * (1 - label))
+    np.testing.assert_allclose(got2, ref2.mean(axis=1), rtol=1e-4, atol=1e-5)
+    # pos_weight branch
+    pw = np.full((1, 5), 2.0, np.float32)
+    got3 = gluon.loss.SigmoidBinaryCrossEntropyLoss()(
+        nd.array(pred), nd.array(label), None, nd.array(pw)).asnumpy()
+    lw = 1 + (pw - 1) * label
+    ref3 = pred - pred * label + lw * (
+        np.log1p(np.exp(-np.abs(pred))) + np.maximum(-pred, 0))
+    np.testing.assert_allclose(got3, ref3.mean(axis=1), rtol=1e-4, atol=1e-5)
+
+
+def test_kldiv_loss():
+    logits = RNG.standard_normal((3, 6)).astype(np.float32)
+    logp = logits - np.log(np.exp(logits).sum(1, keepdims=True))
+    label = RNG.random((3, 6)).astype(np.float32)
+    label /= label.sum(1, keepdims=True)
+    got = gluon.loss.KLDivLoss()(nd.array(logp), nd.array(label)).asnumpy()
+    ref = (label * (np.log(label + 1e-12) - logp)).mean(axis=1)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-6)
+
+
+def test_hinge_losses():
+    pred = RNG.standard_normal((6, 1)).astype(np.float32)
+    label = RNG.choice([-1.0, 1.0], (6, 1)).astype(np.float32)
+    got = gluon.loss.HingeLoss()(nd.array(pred), nd.array(label)).asnumpy()
+    ref = np.maximum(1 - pred * label, 0).mean(axis=1)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    got2 = gluon.loss.SquaredHingeLoss()(nd.array(pred),
+                                         nd.array(label)).asnumpy()
+    np.testing.assert_allclose(got2, (np.maximum(1 - pred * label, 0) ** 2)
+                               .mean(axis=1), rtol=1e-5, atol=1e-6)
+
+
+def test_logistic_loss_formats():
+    pred = RNG.standard_normal((5, 1)).astype(np.float32)
+    signed = RNG.choice([-1.0, 1.0], (5, 1)).astype(np.float32)
+    got = gluon.loss.LogisticLoss()(nd.array(pred),
+                                    nd.array(signed)).asnumpy()
+    l01 = (signed + 1) / 2
+    ref = (np.maximum(pred, 0) - pred * l01
+           + np.log1p(np.exp(-np.abs(pred)))).mean(axis=1)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    got2 = gluon.loss.LogisticLoss(label_format="binary")(
+        nd.array(pred), nd.array(l01)).asnumpy()
+    np.testing.assert_allclose(got2, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_triplet_loss():
+    a = RNG.standard_normal((4, 8)).astype(np.float32)
+    p = RNG.standard_normal((4, 8)).astype(np.float32)
+    n = RNG.standard_normal((4, 8)).astype(np.float32)
+    got = gluon.loss.TripletLoss(margin=1.0)(
+        nd.array(a), nd.array(p), nd.array(n)).asnumpy()
+    ref = np.maximum(((p - a) ** 2).sum(1) - ((n - a) ** 2).sum(1) + 1.0, 0)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_cosine_embedding_loss():
+    x1 = RNG.standard_normal((4, 8)).astype(np.float32)
+    x2 = RNG.standard_normal((4, 8)).astype(np.float32)
+    label = np.array([1, -1, 1, -1], np.float32)
+    got = gluon.loss.CosineEmbeddingLoss(margin=0.1)(
+        nd.array(x1), nd.array(x2), nd.array(label)).asnumpy()
+    cos = (x1 * x2).sum(1) / (np.linalg.norm(x1, axis=1)
+                              * np.linalg.norm(x2, axis=1) + 1e-12)
+    ref = np.where(label == 1, 1 - cos, np.maximum(cos - 0.1, 0))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_loss_sample_weight_and_weight():
+    pred = RNG.standard_normal((4, 3)).astype(np.float32)
+    label = RNG.standard_normal((4, 3)).astype(np.float32)
+    sw = np.array([[1.0], [0.0], [2.0], [1.0]], np.float32)
+    got = gluon.loss.L2Loss(weight=3.0)(
+        nd.array(pred), nd.array(label), nd.array(sw)).asnumpy()
+    base = 0.5 * (pred - label) ** 2 * 3.0 * sw
+    np.testing.assert_allclose(got, base.mean(axis=1), rtol=1e-5, atol=1e-6)
+    assert got[1] == 0.0  # zero sample weight nulls the row

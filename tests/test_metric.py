@@ -1,0 +1,104 @@
+"""Metric classes vs closed-form references
+(ref: tests/python/unittest/test_metric.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+RNG = np.random.default_rng(5)
+
+
+def test_accuracy_and_topk():
+    label = np.array([0, 1, 2, 1], np.float32)
+    pred = np.array([[0.7, 0.2, 0.1],
+                     [0.1, 0.8, 0.1],
+                     [0.5, 0.4, 0.1],   # wrong (argmax 0, label 2)
+                     [0.2, 0.35, 0.45]],  # argmax 2, label 1 -> top-1 wrong
+                    np.float32)
+    m = mx.metric.Accuracy()
+    m.update([nd.array(label)], [nd.array(pred)])
+    assert m.get()[1] == 0.5
+    t = mx.metric.TopKAccuracy(top_k=2)
+    t.update([nd.array(label)], [nd.array(pred)])
+    # top-2 sets: {0,1}✓ {1,0}✓ {0,1}✗ {2,1}✓ -> 3/4
+    assert t.get()[1] == 0.75
+
+
+def test_f1_and_mcc():
+    label = np.array([1, 0, 1, 1, 0, 0], np.float32)
+    pred = np.array([[0.2, 0.8], [0.9, 0.1], [0.4, 0.6],
+                     [0.6, 0.4], [0.3, 0.7], [0.8, 0.2]], np.float32)
+    # predictions: 1,0,1,0,1,0 -> tp=2 fp=1 fn=1 tn=2
+    f1 = mx.metric.F1()
+    f1.update([nd.array(label)], [nd.array(pred)])
+    prec, rec = 2 / 3, 2 / 3
+    np.testing.assert_allclose(f1.get()[1],
+                               2 * prec * rec / (prec + rec), rtol=1e-6)
+    mcc = mx.metric.MCC()
+    mcc.update([nd.array(label)], [nd.array(pred)])
+    num = 2 * 2 - 1 * 1
+    den = np.sqrt(3 * 3 * 3 * 3)
+    np.testing.assert_allclose(mcc.get()[1], num / den, rtol=1e-6)
+
+
+def test_regression_metrics():
+    label = RNG.standard_normal((8,)).astype(np.float32)
+    pred = RNG.standard_normal((8,)).astype(np.float32)
+    for name, ref in [("mae", np.abs(pred - label).mean()),
+                      ("mse", ((pred - label) ** 2).mean()),
+                      ("rmse", np.sqrt(((pred - label) ** 2).mean()))]:
+        m = mx.metric.create(name)
+        m.update([nd.array(label)], [nd.array(pred)])
+        np.testing.assert_allclose(m.get()[1], ref, rtol=1e-5)
+
+
+def test_cross_entropy_nll_perplexity():
+    label = np.array([0, 2, 1], np.float32)
+    pred = np.array([[0.6, 0.3, 0.1],
+                     [0.2, 0.2, 0.6],
+                     [0.1, 0.7, 0.2]], np.float32)
+    picked = pred[np.arange(3), label.astype(int)]
+    ce = mx.metric.CrossEntropy()
+    ce.update([nd.array(label)], [nd.array(pred)])
+    np.testing.assert_allclose(ce.get()[1], -np.log(picked).mean(),
+                               rtol=1e-5)
+    p = mx.metric.Perplexity(ignore_label=None)
+    p.update([nd.array(label)], [nd.array(pred)])
+    np.testing.assert_allclose(p.get()[1],
+                               np.exp(-np.log(picked).mean()), rtol=1e-5)
+
+
+def test_pearson_and_custom_and_composite():
+    label = RNG.standard_normal((16,)).astype(np.float32)
+    pred = 0.5 * label + 0.1 * RNG.standard_normal((16,)).astype(np.float32)
+    pc = mx.metric.PearsonCorrelation()
+    pc.update([nd.array(label)], [nd.array(pred)])
+    ref = np.corrcoef(label, pred)[0, 1]
+    np.testing.assert_allclose(pc.get()[1], ref, rtol=1e-4)
+
+    cm = mx.metric.CustomMetric(
+        lambda l, p: float(np.abs(l - p).max()), name="maxerr")
+    cm.update([nd.array(label)], [nd.array(pred)])
+    np.testing.assert_allclose(cm.get()[1], np.abs(label - pred).max(),
+                               rtol=1e-5)
+
+    comp = mx.metric.CompositeEvalMetric()
+    comp.add(mx.metric.MAE())
+    comp.add(mx.metric.MSE())
+    comp.update([nd.array(label)], [nd.array(pred)])
+    names, vals = comp.get()
+    assert list(names) == ["mae", "mse"]
+    np.testing.assert_allclose(vals[0], np.abs(pred - label).mean(),
+                               rtol=1e-5)
+
+
+def test_metric_reset_and_accumulation():
+    m = mx.metric.Accuracy()
+    m.update([nd.array([0.0, 1.0])],
+             [nd.array(np.array([[0.9, 0.1], [0.1, 0.9]], np.float32))])
+    m.update([nd.array([0.0])],
+             [nd.array(np.array([[0.1, 0.9]], np.float32))])
+    np.testing.assert_allclose(m.get()[1], 2 / 3, rtol=1e-6)
+    m.reset()
+    assert np.isnan(m.get()[1]) or m.get()[1] == 0.0
