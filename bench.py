@@ -179,6 +179,8 @@ def supervise():
         # nonzero so the failure is never mistaken for a fresh run.
         try:
             stale = json.loads(prior["line"])
+            if not isinstance(stale, dict):
+                raise ValueError("saved line is not a JSON object")
             stale["stale"] = True
             stale["stale_reason"] = str(last_err)[:200]
             stale["measured_at"] = prior.get("measured_at")

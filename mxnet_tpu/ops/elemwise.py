@@ -10,7 +10,9 @@ needed when the compiler does the fusion.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 import jax.scipy.special as jsp
 from jax import lax
 
@@ -88,9 +90,34 @@ def block_grad(data):
     return lax.stop_gradient(data)
 
 
-@register("make_loss")
-def make_loss(data):
-    return data
+@register("make_loss", aliases=("MakeLoss",))
+def make_loss(data, grad_scale=1.0, valid_thresh=0.0,
+              normalization="null"):
+    """Marks an output as a loss (ref: src/operator/make_loss.cc):
+    forward is identity; backward seeds ones * grad_scale instead of the
+    head gradient (the loss-layer contract)."""
+    @jax.custom_vjp
+    def f(d):
+        return d
+
+    def f_fwd(d):
+        return d, d
+
+    def f_bwd(d, g):
+        shape = d.shape
+        if normalization == "batch":
+            scale = grad_scale / shape[0]
+            return (jnp.full(shape, scale, g.dtype),)
+        if normalization == "valid":
+            # divide by the VALID element count (ref: make_loss.cc
+            # normalization=valid with valid_thresh)
+            n_valid = jnp.maximum(
+                jnp.sum((d > valid_thresh).astype(g.dtype)), 1.0)
+            return (jnp.full(shape, grad_scale, g.dtype) / n_valid,)
+        return (jnp.full(shape, grad_scale, g.dtype),)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(data)
 
 
 # ---------------------------------------------------------------------------

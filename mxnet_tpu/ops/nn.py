@@ -646,3 +646,61 @@ def svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
     f = _svm_output_closure(float(margin), float(regularization_coefficient),
                             bool(use_linear))
     return f(flat, label).reshape(data.shape)
+
+
+# ---------------------------------------------------------------------------
+# legacy v1/compat ops (ref: src/operator/batch_norm_v1.cc,
+# convolution_v1.cc, pooling_v1.cc, crop.cc, swapaxis.cc — deprecated
+# spellings the reference still registers; they alias the modern
+# implementations, whose math is a superset)
+# ---------------------------------------------------------------------------
+
+
+@register("BatchNorm_v1")
+def batch_norm_v1(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                  momentum=0.9, fix_gamma=True, use_global_stats=False,
+                  output_mean_var=False, training=False):
+    return batch_norm(data, gamma, beta, moving_mean, moving_var, eps=eps,
+                      momentum=momentum, fix_gamma=fix_gamma,
+                      use_global_stats=use_global_stats,
+                      output_mean_var=output_mean_var, training=training)
+
+
+@register("Convolution_v1")
+def convolution_v1(data, weight, bias=None, kernel=(), stride=(),
+                   dilate=(), pad=(), num_filter=0, num_group=1,
+                   workspace=1024, no_bias=False, layout=None):
+    return convolution(data, weight, bias, kernel=kernel, stride=stride,
+                       dilate=dilate, pad=pad, num_filter=num_filter,
+                       num_group=num_group, no_bias=no_bias)
+
+
+@register("Pooling_v1")
+def pooling_v1(data, kernel=(), pool_type="max", global_pool=False,
+               stride=(), pad=(), pooling_convention="valid"):
+    return pooling(data, kernel=kernel, pool_type=pool_type,
+                   global_pool=global_pool, stride=stride, pad=pad,
+                   pooling_convention=pooling_convention)
+
+
+@register("Crop", optional_arrays=("crop_like",))
+def legacy_crop(data, crop_like=None, offset=(0, 0), h_w=(0, 0),
+                center_crop=False, num_args=1):
+    """Legacy spatial Crop (ref: src/operator/crop-inl.h:47-62): crop
+    NCHW `data` to `h_w` (or to `crop_like`'s spatial dims), at `offset`
+    or centered."""
+    H, W = data.shape[2], data.shape[3]
+    if crop_like is not None:
+        th, tw = crop_like.shape[2], crop_like.shape[3]
+    else:
+        th, tw = int(h_w[0]), int(h_w[1])
+    if center_crop:
+        y0 = max((H - th) // 2, 0)
+        x0 = max((W - tw) // 2, 0)
+    else:
+        y0, x0 = int(offset[0]), int(offset[1])
+    if y0 + th > H or x0 + tw > W or y0 < 0 or x0 < 0:
+        raise MXNetError(
+            f"Crop: window offset ({y0},{x0}) size ({th},{tw}) exceeds "
+            f"input ({H},{W}) (the reference CHECKs the same at crop-inl.h)")
+    return data[:, :, y0:y0 + th, x0:x0 + tw]

@@ -168,3 +168,63 @@ def test_sync_batch_norm_symbolic_updates_aux():
     ex.forward(is_train=True)
     np.testing.assert_allclose(ex.aux_dict["sbn0_moving_mean"].asnumpy(),
                                0.5 * x.mean(axis=0), rtol=1e-5)
+
+
+def test_legacy_v1_aliases_and_crop():
+    """Deprecated spellings the reference still registers
+    (ref: src/operator/batch_norm_v1.cc, convolution_v1.cc,
+    pooling_v1.cc, crop.cc)."""
+    rng = np.random.default_rng(0)
+    x = nd.array(rng.random((2, 3, 8, 8)).astype(np.float32))
+    w = nd.array(rng.random((4, 3, 3, 3)).astype(np.float32))
+    b = nd.array(np.zeros(4, np.float32))
+    v1 = nd.invoke("Convolution_v1", [x, w, b],
+                   {"kernel": (3, 3), "num_filter": 4})
+    modern = nd.invoke("Convolution", [x, w, b],
+                       {"kernel": (3, 3), "num_filter": 4})
+    assert_almost_equal(v1, modern.asnumpy())
+    p1 = nd.invoke("Pooling_v1", [x], {"kernel": (2, 2), "stride": (2, 2)})
+    assert p1.shape == (2, 3, 4, 4)
+    c = nd.invoke("Crop", [x], {"h_w": (4, 4), "center_crop": True})
+    assert_almost_equal(c, x.asnumpy()[:, :, 2:6, 2:6])
+    like = nd.array(np.zeros((2, 3, 5, 5), np.float32))
+    c2 = nd.invoke("Crop", [x, like], {"num_args": 2, "offset": (1, 2)})
+    assert_almost_equal(c2, x.asnumpy()[:, :, 1:6, 2:7])
+
+
+def test_make_loss_backward_contract():
+    """MakeLoss seeds ones*grad_scale in backward regardless of the head
+    gradient (ref: src/operator/make_loss.cc)."""
+    from mxnet_tpu import autograd
+    a = nd.array(np.random.rand(4, 3).astype(np.float32))
+    a.attach_grad()
+    with autograd.record():
+        out = (nd.invoke("MakeLoss", [a], {"grad_scale": 2.0}) * 5.0).sum()
+    out.backward()
+    assert_almost_equal(a.grad, np.full((4, 3), 2.0, np.float32))
+    # normalization='batch' divides by batch size
+    a.attach_grad()
+    with autograd.record():
+        out = nd.invoke("MakeLoss", [a],
+                        {"normalization": "batch"}).sum()
+    out.backward()
+    assert_almost_equal(a.grad, np.full((4, 3), 0.25, np.float32))
+
+
+def test_make_loss_valid_normalization_and_crop_bounds():
+    import pytest
+
+    from mxnet_tpu import autograd
+    # valid normalization divides by the count ABOVE valid_thresh
+    a = nd.array(np.array([[0.0, 2.0, 3.0, 0.0]], np.float32))
+    a.attach_grad()
+    with autograd.record():
+        out = nd.invoke("MakeLoss", [a],
+                        {"normalization": "valid",
+                         "valid_thresh": 0.5}).sum()
+    out.backward()
+    assert_almost_equal(a.grad, np.full((1, 4), 0.5, np.float32))
+    # out-of-bounds crop fails fast
+    x = nd.array(np.zeros((1, 1, 8, 8), np.float32))
+    with pytest.raises(Exception, match="exceeds"):
+        nd.invoke("Crop", [x], {"h_w": (4, 4), "offset": (7, 7)})
