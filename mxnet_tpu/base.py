@@ -30,6 +30,24 @@ def get_env(name, default=None, dtype=str):
     return dtype(val)
 
 
+def attr_bool(val, default=False):
+    """Normalize a graph-attr boolean that may arrive as bool, int, or a
+    string spelling from externally produced symbol JSON ("True", "true",
+    "1", "False", "false", "0") — plain truthiness would read "false" as
+    True (the reference parses these through dmlc parameter boolean
+    fields, which accept the same spellings)."""
+    if val is None:
+        return default
+    if isinstance(val, str):
+        s = val.strip().lower()
+        if s in ("true", "1"):
+            return True
+        if s in ("false", "0", ""):
+            return False
+        raise MXNetError(f"cannot parse boolean attr {val!r}")
+    return bool(val)
+
+
 class _Registry:
     """Generic name -> object registry (ref: python/mxnet/registry.py)."""
 

@@ -19,7 +19,7 @@ import logging
 
 import numpy as np
 
-from ..base import MXNetError
+from ..base import MXNetError, attr_bool
 from .. import ndarray as nd
 from ..symbol.symbol import Symbol, _Node, var, is_aux_name
 
@@ -127,13 +127,13 @@ def fold_batch_norm(symbol, arg_params, aux_params):
         if any(v is None for v in (gamma, beta, mean, var, W)):
             continue
         eps = float(node.attrs.get("eps", 1e-3))
-        if node.attrs.get("fix_gamma", True) in (True, "True", "true", 1):
+        if attr_bool(node.attrs.get("fix_gamma"), True):
             gamma = np.ones_like(gamma)
         s = gamma / np.sqrt(var + eps)
         arg_params[wnode.name] = nd.array(
             (W * s.reshape((-1,) + (1,) * (W.ndim - 1))).astype(W.dtype))
         has_bias = len(conv.inputs) >= 3 and \
-            not conv.attrs.get("no_bias", False)
+            not attr_bool(conv.attrs.get("no_bias"), False)
         b = _val(arg_params, conv.inputs[2][0].name) if has_bias \
             else np.zeros_like(beta)
         new_b = (b * s + beta - mean * s).astype(beta.dtype)

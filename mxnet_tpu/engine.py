@@ -21,8 +21,12 @@ import jax
 from .base import get_env
 
 _naive = get_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice") == "NaiveEngine"
-_pending = []
-_PENDING_MAX = 64
+# newest in-flight result PER DEVICE: device streams execute in order, so
+# blocking on the most recent array touching each device fences everything
+# dispatched before it on that device. A bounded global window (the old
+# scheme) could drop the only handle living on some device of a sharded
+# output, leaving waitall() blind to that device's stream.
+_newest_by_device = {}
 
 
 def is_naive():
@@ -43,27 +47,30 @@ def on_op_executed(outputs):
         for o in outputs:
             jax.block_until_ready(o)
         return
-    # keep a small window of in-flight results so waitall() has handles to
-    # block on without retaining everything (stream ordering does the rest)
-    _pending.extend(outputs)
-    if len(_pending) > _PENDING_MAX:
-        del _pending[: len(_pending) - _PENDING_MAX]
+    for o in outputs:
+        try:
+            devs = o.devices()
+        except Exception:  # noqa: BLE001 — committed scalars etc.
+            devs = ()
+        for d in devs:
+            _newest_by_device[d] = o
 
 
 def waitall():
     """Block until all pushed work completes (MXNDArrayWaitAll analogue).
 
-    Device streams execute in order, so blocking on the most recently
-    dispatched arrays implies completion of everything before them.
+    Device streams execute in order, so blocking on the newest array on
+    each device implies completion of everything before it there.
     """
-    for o in _pending:
-        try:
+    try:
+        # dedupe: one sharded array may be the newest entry on many devices
+        for o in {id(v): v for v in _newest_by_device.values()}.values():
             jax.block_until_ready(o)
-        except Exception:
-            # waitall surfaces the first pending error, like WaitForAll
-            _pending.clear()
-            raise
-    _pending.clear()
+    except Exception:
+        # waitall surfaces the first pending error, like WaitForAll
+        _newest_by_device.clear()
+        raise
+    _newest_by_device.clear()
     if _host is not None:
         _host.wait_all()
 

@@ -412,8 +412,85 @@ class HybridBlock(Block):
         flat = list(outs)
         return _regroup(flat, out_spec_box[0])
 
+    def optimize_for(self, x, *args, backend="XLA"):
+        """Partition the inference graph with a registered subgraph
+        backend and keep using it from the hybridized call path (ref:
+        gluon/block.py optimize_for; parity with CachedOp running the
+        same graph passes as bind, src/imperative/cached_op.cc:685).
+
+        With the default ``backend="XLA"`` conv+BN(+add)+relu chains
+        collapse into ``_sg_xla_conv`` with the BN affine folded into
+        the convolution weights (subgraph/xla_fuse.py). Training-mode
+        calls (autograd.is_training()) bypass the partitioned graph —
+        folding moving stats would silently freeze BN statistics."""
+        out = self(x, *args)  # resolves deferred shapes imperatively
+        self._optimized_backend = backend
+        self._cached_jit = {}
+        self._cached_plist = None
+        self._active = True
+        return out
+
+    @staticmethod
+    def _spec_nleaves(spec):
+        if spec in ("0", "raw"):
+            return 1
+        return sum(HybridBlock._spec_nleaves(s) for s in spec[2])
+
+    def _build_cached_partitioned(self, plist, in_spec, backend):
+        """Symbolically trace, run the subgraph partitioner, and lower
+        the optimized graph to a jitted fn with the same signature as
+        `_build_cached`'s direct trace."""
+        from ..symbol import Group
+        from ..symbol import var as sym_var
+
+        n_in = self._spec_nleaves(in_spec)
+        placeholders = [sym_var(f"__cached_in{i}") for i in range(n_in)]
+        flat = list(placeholders)
+        args = _regroup(flat, in_spec)
+        if not isinstance(args, list):
+            args = [args]
+        prev = _in_trace_flag()
+        _set_in_trace(True)
+        try:
+            out = self.forward(*args)
+        finally:
+            _set_in_trace(prev)
+        flat_out, out_spec = _flatten(out)
+        sym = Group(list(flat_out)) if len(flat_out) > 1 else flat_out[0]
+        opt = sym.get_backend_symbol(backend)
+        needed = set(opt.list_inputs())
+
+        def pure_fn(param_vals, key, *in_datas):
+            bindings = {}
+            for (n, _p), v in zip(plist, param_vals):
+                if n in needed:
+                    bindings[n] = NDArray(v)
+            for i, d in enumerate(in_datas):
+                bindings[f"__cached_in{i}"] = NDArray(d)
+            prev_trace = _in_trace_flag()
+            _set_in_trace(True)
+            try:
+                with _random.key_context(key):
+                    res = opt.eval_dict(bindings)
+            finally:
+                _set_in_trace(prev_trace)
+            res_list = res if isinstance(res, list) else [res]
+            return [r._data for r in res_list], []
+
+        return jax.jit(pure_fn), [out_spec], [[]]
+
     def _build_cached(self, plist, in_spec, training):
         """Trace the whole subtree once into a jitted pure function."""
+        backend = getattr(self, "_optimized_backend", None)
+        if backend and not training:
+            try:
+                return self._build_cached_partitioned(
+                    plist, in_spec, backend)
+            except Exception as e:  # noqa: BLE001 — un-traceable blocks
+                import warnings
+                warnings.warn(
+                    f"optimize_for({backend!r}): symbolic partition "
+                    f"failed ({e!r}); falling back to the direct trace")
         out_spec_box = [None]
         aux_params_box = [[]]
         params = [p for _, p in plist]

@@ -140,9 +140,10 @@ class BottleneckV2(HybridBlock):
 
 class ResNetV1(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 **kwargs):
+                 layout="NCHW", **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
+        self._data_layout = layout
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             if thumbnail:
@@ -174,15 +175,20 @@ class ResNetV1(HybridBlock):
         return layer
 
     def hybrid_forward(self, F, x):
+        if self._data_layout == "NHWC":
+            # models always take NCHW user data; one transpose at the
+            # graph edge puts the whole internal graph channel-last
+            x = F.transpose(x, axes=(0, 2, 3, 1))
         x = self.features(x)
         return self.output(x)
 
 
 class ResNetV2(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 **kwargs):
+                 layout="NCHW", **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
+        self._data_layout = layout
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             self.features.add(nn.BatchNorm(scale=False, center=False))
@@ -211,6 +217,8 @@ class ResNetV2(HybridBlock):
     _make_layer = ResNetV1._make_layer
 
     def hybrid_forward(self, F, x):
+        if self._data_layout == "NHWC":
+            x = F.transpose(x, axes=(0, 2, 3, 1))
         x = self.features(x)
         return self.output(x)
 
@@ -237,7 +245,14 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
     block_type, layers, channels = resnet_spec[num_layers]
     resnet_class = resnet_net_versions[version - 1]
     block_class = resnet_block_versions[version - 1][block_type]
-    net = resnet_class(block_class, layers, channels, **kwargs)
+    layout = kwargs.get("layout", "NCHW")
+    if layout == "NHWC":
+        # every conv/pool/BN in the subtree builds channel-last; the
+        # model transposes its NCHW input once at the stem
+        with nn.layout_scope("NHWC"):
+            net = resnet_class(block_class, layers, channels, **kwargs)
+    else:
+        net = resnet_class(block_class, layers, channels, **kwargs)
     if pretrained:
         from ..model_store import load_pretrained
         load_pretrained(net, f"resnet{num_layers}_v{version}", root, ctx)

@@ -8,5 +8,5 @@ from .conv_layers import (AvgPool1D, AvgPool2D, AvgPool3D, Conv1D,
                           Conv3DTranspose, GlobalAvgPool1D, GlobalAvgPool2D,
                           GlobalAvgPool3D, GlobalMaxPool1D, GlobalMaxPool2D,
                           GlobalMaxPool3D, MaxPool1D, MaxPool2D, MaxPool3D,
-                          ReflectionPad2D)
+                          ReflectionPad2D, active_layout, layout_scope)
 from ..block import Block, HybridBlock, SymbolBlock

@@ -189,6 +189,12 @@ class BatchNorm(HybridBlock):
                  running_variance_initializer="ones", in_channels=0,
                  prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
+        if axis == 1:
+            # inside an nn.layout_scope("NHWC") the default channel axis
+            # follows the scope's channel-last convention
+            from .conv_layers import active_layout
+            if active_layout():
+                axis = -1
         self._axis = axis
         self._momentum = momentum
         self._epsilon = epsilon

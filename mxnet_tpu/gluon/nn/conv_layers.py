@@ -1,6 +1,8 @@
 """Convolution / pooling layers (ref: python/mxnet/gluon/nn/conv_layers.py)."""
 from __future__ import annotations
 
+import threading
+
 from ...base import MXNetError
 from ..block import HybridBlock
 from .basic_layers import Activation
@@ -10,6 +12,50 @@ def _pair(x, n):
     if isinstance(x, (tuple, list)):
         return tuple(x)
     return (x,) * n
+
+
+_layout_tls = threading.local()
+_NC_FIRST = {"NCW", "NCHW", "NCDHW"}
+_CHANNEL_LAST_BY_ND = {1: "NWC", 2: "NHWC", 3: "NDHWC"}
+
+
+class layout_scope:
+    """Build layers channel-last without per-layer layout arguments.
+
+    TPU convolutions want C on the 128-lane minor dimension; inside
+    ``with nn.layout_scope("NHWC"):`` every Conv/Pool layer constructed
+    with the default NC-first layout switches to the channel-last layout
+    of its rank, and BatchNorm's default axis=1 becomes axis=-1. This is
+    the construction-time analogue of the reference's MKL-DNN opaque
+    layouts (ref: src/ndarray/ndarray.cc:389 GetMKLDNNData — the
+    accelerator gets its preferred layout; the graph edges stay in the
+    user's NCHW convention via one transpose at the model stem)."""
+
+    def __init__(self, layout="NHWC"):
+        if layout not in ("NHWC", "NWC", "NDHWC", None):
+            raise MXNetError(f"layout_scope: unsupported {layout!r}")
+        self._layout = layout
+
+    def __enter__(self):
+        self._prev = getattr(_layout_tls, "value", None)
+        _layout_tls.value = self._layout
+        return self
+
+    def __exit__(self, *exc):
+        _layout_tls.value = self._prev
+        return False
+
+
+def active_layout():
+    return getattr(_layout_tls, "value", None)
+
+
+def _resolve_layout(layout, nd):
+    """Switch a defaulted NC-first layout to channel-last when a
+    channel-last layout_scope is active (explicit layouts win)."""
+    if active_layout() and layout in _NC_FIRST:
+        return _CHANNEL_LAST_BY_ND[nd]
+    return layout
 
 
 class _Conv(HybridBlock):
@@ -22,6 +68,7 @@ class _Conv(HybridBlock):
         self._channels = channels
         self._in_channels = in_channels
         ndim = len(kernel_size)
+        layout = _resolve_layout(layout, ndim)
         self._kwargs = {
             "kernel": kernel_size, "stride": strides, "dilate": dilation,
             "pad": padding, "num_filter": channels, "num_group": groups,
@@ -48,7 +95,7 @@ class _Conv(HybridBlock):
             self.act = Activation(activation) if activation else None
 
     def infer_shape(self, x, *args):
-        c = x.shape[1]
+        c = x.shape[self._kwargs["layout"].index("C")]
         if self._op_name == "Convolution":
             self.weight.shape_inferred(
                 (self._channels, c // self._groups) + self._kwargs["kernel"])
@@ -155,10 +202,13 @@ class _Pooling(HybridBlock):
         super().__init__(prefix=prefix, params=params)
         if strides is None:
             strides = pool_size
+        layout = _resolve_layout(layout, len(pool_size))
         self._kwargs = {
             "kernel": pool_size, "stride": strides, "pad": padding,
             "pool_type": pool_type, "global_pool": global_pool,
             "pooling_convention": "full" if ceil_mode else "valid"}
+        if layout and "C" in layout and not layout.startswith("NC"):
+            self._kwargs["layout"] = layout
         if count_include_pad is not None:
             self._kwargs["count_include_pad"] = count_include_pad
 

@@ -281,4 +281,10 @@ def create(name, **kwargs):
         return name
     if callable(name):
         return name
+    if isinstance(name, str) and name.startswith("["):
+        # dumps() format: ["lstmbias", {"forget_bias": 1.0}] — how the
+        # reference serializes initializers into variable attrs
+        import json
+        parsed = json.loads(name)
+        return _reg.get(parsed[0])(**(parsed[1] if len(parsed) > 1 else {}))
     return _reg.get(name)(**kwargs)

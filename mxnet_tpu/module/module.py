@@ -181,6 +181,10 @@ class Module(BaseModule):
             from ..initializer import Uniform
             initializer = Uniform(0.01)
 
+        # variable attrs (__init__, lr_mult, ...) steer initialization the
+        # way the reference passes them via InitDesc (module.py:init_params
+        # builds InitDesc(name, attrs) from the symbol's attr_dict)
+        var_attrs = self._symbol.attr_dict()
         for name in self._param_names:
             arr = self._exec.arg_dict[name]
             if arg_params is not None and name in arg_params:
@@ -188,7 +192,7 @@ class Module(BaseModule):
                 arr._data = (src._data if isinstance(src, nd.NDArray)
                              else nd.array(src)._data)
             elif initializer is not None:
-                initializer(InitDesc(name), arr)
+                initializer(InitDesc(name, var_attrs.get(name)), arr)
             elif not allow_missing:
                 raise MXNetError(f"no initializer and no value for {name}")
         for name in self._aux_names:
@@ -198,7 +202,7 @@ class Module(BaseModule):
                 arr._data = (src._data if isinstance(src, nd.NDArray)
                              else nd.array(src)._data)
             elif initializer is not None:
-                initializer(InitDesc(name), arr)
+                initializer(InitDesc(name, var_attrs.get(name)), arr)
         self.params_initialized = True
         self._params_dirty = False
 

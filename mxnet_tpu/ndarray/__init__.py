@@ -31,6 +31,41 @@ def Custom(*inputs, op_type=None, **kwargs):
 populate(globals())
 
 
+# optimizer update ops: the reference mutates state inputs (mom, mean/var,
+# z/n, history...) in place and returns only the weight (ref:
+# src/operator/optimizer_op.cc TMutateInputs); the registry ops are pure
+# and return (out, *new_states), so these wrappers restore the reference
+# call surface by writing the state outputs back into the input arrays.
+_UPDATE_OP_STATE_START = {
+    "sgd_mom_update": 2, "mp_sgd_update": 2, "mp_sgd_mom_update": 2,
+    "signum_update": 2, "adam_update": 2, "ftml_update": 2,
+    "ftrl_update": 2, "rmsprop_update": 2, "rmspropalex_update": 2,
+    "_sparse_adagrad_update": 2, "_contrib_group_adagrad_update": 2,
+    "group_adagrad_update": 2,
+}
+
+
+def _make_inplace_update(base, state_start):
+    def wrapper(*args, out=None, **kwargs):
+        res = base(*args, **kwargs)
+        outs = list(res) if isinstance(res, (list, tuple)) else [res]
+        for s, v in zip(args[state_start:], outs[1:]):
+            s._data = v._data
+        w = outs[0]
+        if out is not None:
+            out._data = w._data
+            return out
+        return w
+    wrapper.__name__ = base.__name__
+    wrapper.__doc__ = base.__doc__
+    return wrapper
+
+
+for _name, _start in _UPDATE_OP_STATE_START.items():
+    globals()[_name] = _make_inplace_update(globals()[_name], _start)
+del _name, _start
+
+
 # constructors shadow same-named registry wrappers (shape is positional here)
 def zeros(shape, ctx=None, dtype=None, **kwargs):
     shape = (shape,) if isinstance(shape, int) else tuple(shape)

@@ -234,12 +234,60 @@ class NDArray:
         out = self._data[key]
         return NDArray(out)
 
+    @staticmethod
+    def _setitem_slices(key, ndim):
+        """Normalize a basic-slicing key to (begin, end, step) tuples for
+        _slice_assign; None when the key needs advanced indexing."""
+        key = key if isinstance(key, tuple) else (key,)
+        if any(k is Ellipsis for k in key):
+            i = key.index(Ellipsis)
+            key = key[:i] + (slice(None),) * (ndim - len(key) + 1) \
+                + key[i + 1:]
+        begin, end, step = [], [], []
+        for k in key:
+            if isinstance(k, slice):
+                begin.append(k.start)
+                end.append(k.stop)
+                step.append(k.step)
+            elif isinstance(k, numbers.Integral):
+                b = int(k)
+                begin.append(b)
+                end.append(None if b == -1 else b + 1)
+                step.append(None)
+            else:
+                return None
+        for _ in range(ndim - len(begin)):
+            begin.append(None)
+            end.append(None)
+            step.append(None)
+        return tuple(begin), tuple(end), tuple(step)
+
     def __setitem__(self, key, value):
         from .. import autograd
         if autograd.is_recording() and self._entry is not None:
-            raise MXNetError(
-                "in-place assignment to an array in the autograd graph is not "
-                "supported; use masked ops (where/boolean_mask_fill) instead")
+            # recorded in-place assignment lowers to the functional
+            # _slice_assign op (ref: tensor/matrix_op.cc _slice_assign —
+            # the same rewrite the reference's autograd performs); self
+            # rebinds to the op output so the tape sees a fresh array
+            spec = self._setitem_slices(key, self._data.ndim)
+            if spec is None:
+                raise MXNetError(
+                    "recorded in-place assignment supports only basic "
+                    "slicing; use masked ops (where/boolean_mask_fill) "
+                    "for advanced indexing")
+            begin, end, step = spec
+            attrs = {"begin": begin, "end": end, "step": step}
+            if not isinstance(value, NDArray) and \
+                    not isinstance(value, numbers.Number):
+                value = NDArray(jnp.asarray(value))  # list / np.ndarray
+            if isinstance(value, NDArray):
+                out = invoke("_slice_assign", [self, value], attrs)
+            else:
+                attrs["scalar"] = float(value)
+                out = invoke("_slice_assign_scalar", [self], attrs)
+            self._data = out._data
+            self._entry = out._entry
+            return
         key = self._key(key)
         if isinstance(value, NDArray):
             value = value._data

@@ -16,3 +16,5 @@ from . import contrib  # noqa: F401
 from . import quantized  # noqa: F401
 from . import control_flow  # noqa: F401
 from . import detection  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import parity  # noqa: F401

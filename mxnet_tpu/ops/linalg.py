@@ -108,6 +108,24 @@ def linalg_slogdet(A):
     return sign, logdet
 
 
+@register("_linalg_gelqf", aliases=("linalg_gelqf",), num_outputs=2)
+def linalg_gelqf(A):
+    """LQ factorization A = L·Q with Q's rows orthonormal (ref:
+    tensor/la_op.cc _linalg_gelqf) — computed as QR of Aᵀ: Aᵀ = Q̃R̃
+    gives L = R̃ᵀ, Q = Q̃ᵀ."""
+    qt, rt = jnp.linalg.qr(jnp.swapaxes(A, -1, -2), mode="reduced")
+    return jnp.swapaxes(rt, -1, -2), jnp.swapaxes(qt, -1, -2)
+
+
+@register("_linalg_syevd", aliases=("linalg_syevd",), num_outputs=2)
+def linalg_syevd(A):
+    """Symmetric eigendecomposition A = Uᵀ·diag(L)·U (ref:
+    tensor/la_op.cc _linalg_syevd — rows of U are the eigenvectors,
+    eigenvalues ascending)."""
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w
+
+
 @register("khatri_rao", num_inputs=None)
 def khatri_rao(*args):
     # column-wise Kronecker product: (n, k) x (m, k) -> (n*m, k)

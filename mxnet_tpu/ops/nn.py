@@ -29,11 +29,13 @@ def fully_connected(data, weight, bias=None, num_hidden=0, no_bias=False,
                     flatten=True):
     x = data.reshape(data.shape[0], -1) if flatten else data
     # weight layout (num_hidden, in_units) as in the reference
+    # bf16 operands ride the MXU, which accumulates in fp32 internally;
+    # requesting an f32 output via preferred_element_type would break
+    # the VJP (the transpose rule feeds the f32 cotangent into a conv
+    # with bf16 operands) so the output stays in the input dtype
     out = lax.dot_general(
         x, weight,
-        dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
-    ).astype(x.dtype)
+        dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())))
     if not no_bias and bias is not None:
         out = out + bias
     return out
@@ -47,6 +49,26 @@ _CONV_DNUMS = {1: ("NCH", "OIH", "NCH"),
                2: ("NCHW", "OIHW", "NCHW"),
                3: ("NCDHW", "OIDHW", "NCDHW")}
 
+# channel-last data layouts (TPU-preferred: C rides the 128-lane minor
+# dim so XLA needs no relayout copies around each conv — the analogue of
+# the reference's MKL-DNN blocked layouts, src/ndarray/ndarray.cc:389).
+# Weights stay in the reference's OIHW storage convention either way;
+# dnums tell XLA where C lives, so no weight transpose materializes.
+_CHANNEL_LAST = {"NWC": "H", "NHWC": "HW", "NDHWC": "DHW"}
+
+
+def _conv_layout(layout, nd):
+    """(data_spec, weight_spec, channel_axis) for an MXNet layout string."""
+    default = _CONV_DNUMS[nd][0]
+    if layout is None or layout == default:
+        return _CONV_DNUMS[nd] + (1,)
+    spatial = _CHANNEL_LAST.get(layout)
+    if spatial is None or len(spatial) != nd:
+        raise MXNetError(f"Convolution: unsupported layout {layout!r} "
+                         f"for {nd}-d kernel")
+    spec = "N" + spatial + "C"
+    return (spec, "OI" + spatial, spec, nd + 1)
+
 
 @register("Convolution")
 def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
@@ -57,17 +79,18 @@ def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     stride = tuple(stride) or (1,) * nd
     dilate = tuple(dilate) or (1,) * nd
     pad = tuple(pad) or (0,) * nd
+    lhs_spec, w_spec, out_spec, c_axis = _conv_layout(layout, nd)
     out = lax.conv_general_dilated(
         data, weight,
         window_strides=stride,
         padding=tuple((p, p) for p in pad),
         rhs_dilation=dilate,
-        dimension_numbers=_CONV_DNUMS[nd],
+        dimension_numbers=(lhs_spec, w_spec, out_spec),
         feature_group_count=num_group,
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
     ).astype(data.dtype)
     if not no_bias and bias is not None:
-        out = out + bias.reshape((1, -1) + (1,) * nd)
+        bshape = tuple(-1 if i == c_axis else 1 for i in range(nd + 2))
+        out = out + bias.reshape(bshape)
     return out
 
 
@@ -76,6 +99,9 @@ def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
                   pad=(), adj=(), target_shape=(), num_filter=0, num_group=1,
                   no_bias=True, layout=None, workspace=1024, cudnn_tune=None,
                   cudnn_off=False):
+    if layout in _CHANNEL_LAST:
+        raise MXNetError(
+            f"Deconvolution: channel-last layout {layout!r} not supported")
     nd = len(kernel)
     stride = tuple(stride) or (1,) * nd
     pad = tuple(pad) or (0,) * nd
@@ -116,28 +142,39 @@ def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
 @register("Pooling")
 def pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
             global_pool=False, pooling_convention="valid", cudnn_off=False,
-            p_value=2, count_include_pad=True):
+            p_value=2, count_include_pad=True, layout=None):
     nd = data.ndim - 2
+    channel_last = layout in _CHANNEL_LAST
+    # spatial dims: 2..ndim-1 for NC-first, 1..ndim-2 for channel-last
+    sp0 = 1 if channel_last else 2
+    spatial_axes = tuple(range(sp0, sp0 + nd))
     if global_pool:
-        kernel = data.shape[2:]
+        kernel = tuple(data.shape[a] for a in spatial_axes)
         stride = (1,) * nd
         pad = (0,) * nd
     kernel = tuple(kernel)
     stride = tuple(stride) or (1,) * nd
     pad = tuple(pad) or (0,) * nd
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
+
+    def _place(vals, fill):
+        out = [fill] * data.ndim
+        for a, v in zip(spatial_axes, vals):
+            out[a] = v
+        return tuple(out)
+
+    window = _place(kernel, 1)
+    strides = _place(stride, 1)
     if pooling_convention == "full":
         # ceil-mode: pad on the high side so the last partial window counts
-        extra = []
+        pads = []
         for i in range(nd):
-            in_i = data.shape[2 + i] + 2 * pad[i]
+            in_i = data.shape[spatial_axes[i]] + 2 * pad[i]
             rem = (in_i - kernel[i]) % stride[i]
-            extra.append((stride[i] - rem) % stride[i] if in_i > kernel[i] else 0)
-        padding = ((0, 0), (0, 0)) + tuple(
-            (pad[i], pad[i] + extra[i]) for i in range(nd))
+            extra = (stride[i] - rem) % stride[i] if in_i > kernel[i] else 0
+            pads.append((pad[i], pad[i] + extra))
     else:
-        padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+        pads = [(p, p) for p in pad]
+    padding = _place(pads, (0, 0))
 
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
@@ -360,6 +397,7 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     """Normalize; batch statistics when training (moving-stat update is
     managed functionally by the BatchNorm layer / executor, since this op is
     pure — the reference mutates aux states in-place instead)."""
+    axis = axis % data.ndim
     reduce_axes = tuple(i for i in range(data.ndim) if i != axis)
     bshape = tuple(data.shape[axis] if i == axis else 1 for i in range(data.ndim))
     if training and not use_global_stats:

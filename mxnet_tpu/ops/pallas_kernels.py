@@ -138,17 +138,63 @@ def _flash_diff(q, k, v, causal, scale, block_q, block_k, interpret):
 
 def _flash_diff_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     out = _flash_call(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    return out, (q, k, v, out)
+
+
+def _chunked_attention_bwd(q, k, v, out, g, causal, scale, block_q):
+    """FlashAttention-style backward without the (T, T) HBM matrix
+    (Dao 2022 §3.1 backward): scan over q-blocks, recomputing each
+    (block_q, T_k) score tile from q/k and using D = rowsum(dO ∘ O)
+    for the softmax VJP. Peak memory is O(block_q · T_k) per step plus
+    the dk/dv carries — the regime where the forward kernel dispatches
+    (T ≥ FLASH_MIN_SEQ) no longer OOMs in training."""
+    bh, t_q, d = q.shape
+    t_k = k.shape[1]
+    nb = t_q // block_q
+    f32 = jnp.float32
+    dD = jnp.sum(g.astype(f32) * out.astype(f32), axis=-1)   # (BH, T_q)
+    qs = jnp.swapaxes(q.reshape(bh, nb, block_q, d), 0, 1)
+    gs = jnp.swapaxes(g.reshape(bh, nb, block_q, d), 0, 1)
+    Ds = jnp.swapaxes(dD.reshape(bh, nb, block_q), 0, 1)
+    kf = k.astype(f32)
+    vf = v.astype(f32)
+
+    def body(carry, inp):
+        dk, dv = carry
+        qi, gi, Di, i = inp
+        qi = qi.astype(f32)
+        gi = gi.astype(f32)
+        s = jnp.einsum("bqd,bsd->bqs", qi * scale, kf)
+        if causal:
+            # forward kernel requires t_q == t_k when causal, so no
+            # decoder offset here
+            q_pos = i * block_q + jnp.arange(block_q)[:, None]
+            s = jnp.where(jnp.arange(t_k)[None, :] <= q_pos, s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)                       # (b, bq, Tk)
+        dp = jnp.einsum("bqd,bsd->bqs", gi, vf)
+        ds = p * (dp - Di[..., None])
+        dqi = jnp.einsum("bqs,bsd->bqd", ds, kf) * scale
+        dk = dk + jnp.einsum("bqs,bqd->bsd", ds, qi) * scale
+        dv = dv + jnp.einsum("bqs,bqd->bsd", p, gi)
+        return (dk, dv), dqi
+
+    (dk, dv), dq = jax.lax.scan(
+        body,
+        (jnp.zeros((bh, t_k, d), f32), jnp.zeros((bh, t_k, d), f32)),
+        (qs, gs, Ds, jnp.arange(nb)))
+    dq = jnp.swapaxes(dq, 0, 1).reshape(bh, t_q, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 def _flash_diff_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    # backward recomputes through the dense formulation (numerically the
-    # same function): gradients stay exact while the forward keeps the
-    # O(T) kernel — the flash backward kernel is a future optimization
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda a, b, c: _dense_reference(a, b, c, causal, scale), q, k, v)
-    return vjp(g)
+    q, k, v, out = res
+    if q.shape[1] % block_q:
+        # shapes the forward kernel accepted always tile; safety net
+        _, vjp = jax.vjp(
+            lambda a, b, c: _dense_reference(a, b, c, causal, scale),
+            q, k, v)
+        return vjp(g)
+    return _chunked_attention_bwd(q, k, v, out, g, causal, scale, block_q)
 
 
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)

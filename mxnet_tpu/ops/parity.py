@@ -1,0 +1,422 @@
+"""Op-name parity sweep: the remaining reference registrations
+(VERDICT r3 #5) that had no counterpart name in this registry.
+
+Grouped by reference source file; each op is a pure JAX lowering with
+the reference's call signature. Gradient comes from jax.vjp as
+everywhere else (the reference's `_backward_*` registrations are
+therefore structural non-goals — see tools/op_parity.py EXCLUSIONS).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register
+
+# ---------------------------------------------------------------------------
+# elemwise / unary (ref: src/operator/tensor/elemwise_*.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("reshape_like")
+def reshape_like(lhs, rhs):
+    """ref: tensor/elemwise_unary_op_basic.cc reshape_like."""
+    return lhs.reshape(rhs.shape)
+
+
+@register("round")
+def round_(data):
+    """Round half away from zero (ref: mshadow_op::round — NOT banker's
+    rounding, which jnp.round would give)."""
+    return jnp.where(data >= 0, jnp.floor(data + 0.5), jnp.ceil(data - 0.5))
+
+
+@register("hard_sigmoid")
+def hard_sigmoid(data, alpha=0.2, beta=0.5):
+    """ref: tensor/elemwise_unary_op_basic.cc hard_sigmoid."""
+    return jnp.clip(alpha * data + beta, 0.0, 1.0)
+
+
+@register("softmin")
+def softmin(data, axis=-1, temperature=None, dtype=None):
+    """softmax over negated input (ref: nn/softmax.cc softmin)."""
+    x = -data
+    if temperature:
+        x = x / temperature
+    out = jax.nn.softmax(x, axis=axis)
+    return out.astype(dtype) if dtype else out
+
+
+def _as_bool_float(fn, name, doc):
+    def op(lhs, rhs):
+        return fn(lhs, rhs).astype(lhs.dtype)
+    op.__name__ = name
+    op.__doc__ = doc
+    return op
+
+
+for _n, _f in [
+        ("_logical_and", lambda a, b: (a != 0) & (b != 0)),
+        ("_logical_or", lambda a, b: (a != 0) | (b != 0)),
+        ("_logical_xor", lambda a, b: (a != 0) ^ (b != 0)),
+        ("_not_equal", lambda a, b: a != b),
+        ("_greater", lambda a, b: a > b),
+        ("_greater_equal", lambda a, b: a >= b),
+        ("_lesser", lambda a, b: a < b),
+        ("_lesser_equal", lambda a, b: a <= b)]:
+    register(_n)(_as_bool_float(
+        _f, _n, f"elemwise {_n} (ref: tensor/elemwise_binary_op_logic.cc)"))
+
+
+@register("_mod")
+def _mod(lhs, rhs):
+    """C-style fmod semantics (ref: mshadow_op::mod — sign follows the
+    dividend, unlike jnp.mod which follows the divisor)."""
+    return jnp.fmod(lhs, rhs)
+
+
+@register("_grad_add")
+def _grad_add(lhs, rhs):
+    """Gradient accumulation add (ref: elemwise_binary_op_basic.cc) —
+    numerically identical to elemwise_add; registered separately because
+    graph passes treat it as an always-accumulate edge."""
+    return lhs + rhs
+
+
+@register("broadcast_plus")
+def broadcast_plus(lhs, rhs):
+    """alias family of broadcast_add (ref: elemwise_binary_broadcast_op
+    _basic.cc registers broadcast_plus separately, not as an alias)."""
+    return lhs + rhs
+
+
+@register("broadcast_minus")
+def broadcast_minus(lhs, rhs):
+    return lhs - rhs
+
+
+@register("_identity_with_attr_like_rhs")
+def _identity_with_attr_like_rhs(lhs, rhs):
+    """Identity on lhs; rhs only contributes shape/stype attrs during
+    graph passes (ref: elemwise_unary_op_basic.cc)."""
+    return lhs
+
+
+@register("_zeros_without_dtype")
+def _zeros_without_dtype(shape=(), ctx=None, dtype=None):
+    """zeros whose dtype is inferred (defaults f32) — the reference
+    registers this for the Gradient pass's zero-grad nodes."""
+    return jnp.zeros(tuple(shape),
+                     jnp.dtype(dtype) if dtype else jnp.float32)
+
+
+@register("_rnn_param_concat", num_inputs=None)
+def _rnn_param_concat(*args, dim=0, num_args=None):
+    """Concat specialization for fused-RNN parameter packing
+    (ref: rnn.cc _rnn_param_concat — same math as Concat, separate name
+    so the storage planner can fold it)."""
+    return jnp.concatenate(args, axis=dim)
+
+
+# scatter_* — elemwise on sparse storage in the reference
+# (elemwise_scatter_op.cc); with dense XLA buffers the math is identical,
+# the names exist so sparse-aware callers resolve.
+@register("_scatter_plus_scalar")
+def _scatter_plus_scalar(data, scalar=0.0):
+    return data + scalar
+
+
+@register("_scatter_minus_scalar")
+def _scatter_minus_scalar(data, scalar=0.0):
+    return data - scalar
+
+
+@register("_scatter_elemwise_div")
+def _scatter_elemwise_div(lhs, rhs):
+    return lhs / rhs
+
+
+# ---------------------------------------------------------------------------
+# index transforms (ref: src/operator/tensor/ravel.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("_ravel_multi_index")
+def _ravel_multi_index(data, shape=()):
+    """data (ndim, N) coordinates -> (N,) flat indices."""
+    coords = tuple(data[i].astype(jnp.int32) for i in range(len(shape)))
+    out = jnp.ravel_multi_index(coords, tuple(int(s) for s in shape),
+                                mode="clip")
+    return out.astype(data.dtype)
+
+
+@register("_unravel_index")
+def _unravel_index(data, shape=()):
+    """data (N,) flat indices -> (ndim, N) coordinates."""
+    coords = jnp.unravel_index(data.astype(jnp.int32),
+                               tuple(int(s) for s in shape))
+    return jnp.stack(coords).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# slice assignment (ref: tensor/matrix_op.cc _slice_assign — the op
+# behind autograd-safe `x[a:b] = y`)
+# ---------------------------------------------------------------------------
+
+
+def _assign_slices(shape, begin, end, step):
+    out = []
+    step = tuple(step) or (None,) * len(begin)
+    for i in range(len(begin)):
+        st = step[i] if i < len(step) and step[i] not in (None, 0) else 1
+        b, e = begin[i], end[i] if i < len(end) else None
+        out.append(slice(b, e, st))
+    return tuple(out)
+
+
+@register("_slice_assign")
+def _slice_assign(lhs, rhs, begin=(), end=(), step=()):
+    return lhs.at[_assign_slices(lhs.shape, begin, end, step)].set(rhs)
+
+
+@register("_slice_assign_scalar")
+def _slice_assign_scalar(data, scalar=0.0, begin=(), end=(), step=()):
+    return data.at[_assign_slices(data.shape, begin, end, step)].set(scalar)
+
+
+# ---------------------------------------------------------------------------
+# sparse-storage helpers, dense lowering (ref: tensor/cast_storage.cc,
+# sparse_retain.cc, square_sum.cc) — the NDArray layer holds the actual
+# CSR/row-sparse representations (ndarray/sparse.py); these registry ops
+# give dense-semantics fallbacks under the reference names.
+# ---------------------------------------------------------------------------
+
+
+@register("cast_storage")
+def cast_storage(data, stype=None):
+    """Dense fallback is the identity; NDArray.tostype() performs real
+    representation changes (ref: tensor/cast_storage.cc)."""
+    return data
+
+
+@register("_sparse_retain", num_outputs=1)
+def _sparse_retain(data, indices):
+    """Keep only the given rows, zero the rest (ref: sparse_retain.cc —
+    defined on row_sparse; the dense lowering writes explicit zeros)."""
+    idx = indices.astype(jnp.int32)
+    mask = jnp.zeros((data.shape[0],), jnp.bool_).at[idx].set(True)
+    return jnp.where(mask.reshape((-1,) + (1,) * (data.ndim - 1)),
+                     data, jnp.zeros((), data.dtype))
+
+
+@register("_square_sum")
+def _square_sum(data, axis=None, keepdims=False, exclude=False):
+    """sum(x^2) (ref: square_sum.cc — the fused kernel the row-sparse
+    LAMB/adam paths use)."""
+    from .tensor import _norm_axis
+    ax = _norm_axis(axis, data.ndim, exclude)
+    return jnp.sum(jnp.square(data), axis=ax, keepdims=keepdims)
+
+
+# ---------------------------------------------------------------------------
+# image ops (ref: src/operator/image/image_random.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("_image_to_tensor")
+def _image_to_tensor(data):
+    """HWC [0,255] -> CHW float32 [0,1]; batched NHWC -> NCHW."""
+    x = data.astype(jnp.float32) / 255.0
+    if x.ndim == 3:
+        return jnp.transpose(x, (2, 0, 1))
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+@register("_image_normalize")
+def _image_normalize(data, mean=(0.0,), std=(1.0,)):
+    """Per-channel (x - mean) / std on CHW or NCHW float input."""
+    c_axis = 0 if data.ndim == 3 else 1
+    shape = tuple(-1 if i == c_axis else 1 for i in range(data.ndim))
+    mean = jnp.asarray(mean, jnp.float32).reshape(shape)
+    std = jnp.asarray(std, jnp.float32).reshape(shape)
+    return (data - mean) / std
+
+
+# ---------------------------------------------------------------------------
+# contrib (ref: src/operator/contrib/)
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_SparseEmbedding")
+def _contrib_sparse_embedding(data, weight, input_dim=0, output_dim=0,
+                              dtype="float32", sparse_grad=True):
+    """Embedding with row-sparse gradient storage in the reference
+    (contrib/sparse_embedding... indexing math is Embedding's; the
+    row-sparse gradient materializes through the optimizer's
+    row-granular path here)."""
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@register("_contrib_getnnz")
+def _contrib_getnnz(data, axis=None):
+    """Count non-zeros (ref: contrib/nnz.cc — defined on CSR; dense
+    fallback counts directly)."""
+    return jnp.sum((data != 0).astype(jnp.int32), axis=axis)
+
+
+@register("_contrib_bipartite_matching", num_outputs=2)
+def _contrib_bipartite_matching(data, is_ascend=False, threshold=1e-12,
+                                topk=-1):
+    """Greedy bipartite matching by score order (ref:
+    contrib/bounding_box.cc:154, BipartiteMatchingForward): walk all
+    (row, col) pairs from best score to worst; take a pair when both
+    sides are free and the score passes `threshold`. Returns (rows,
+    cols): per-row matched col index / per-col matched row index, -1
+    when unmatched."""
+    shape = data.shape
+    n, m = shape[-2], shape[-1]
+    flat = data.reshape(-1, n, m)
+
+    def one(scores):
+        order = jnp.argsort(scores.reshape(-1))
+        if not is_ascend:
+            order = order[::-1]
+        limit = n * m if topk is None or topk < 0 else min(topk, n * m)
+
+        def body(t, carry):
+            rows, cols, taken = carry
+            pos = order[t]
+            i, j = pos // m, pos % m
+            s = scores[i, j]
+            ok = (rows[i] < 0) & (cols[j] < 0) & (taken < limit)
+            ok &= (s <= threshold) if is_ascend else (s >= threshold)
+            rows = rows.at[i].set(jnp.where(ok, j, rows[i]))
+            cols = cols.at[j].set(jnp.where(ok, i, cols[j]))
+            return rows, cols, taken + ok.astype(jnp.int32)
+
+        rows0 = jnp.full((n,), -1, jnp.int32)
+        cols0 = jnp.full((m,), -1, jnp.int32)
+        rows, cols, _ = lax.fori_loop(0, n * m, body,
+                                      (rows0, cols0, jnp.int32(0)))
+        return rows.astype(data.dtype), cols.astype(data.dtype)
+
+    rows, cols = jax.vmap(one)(flat)
+    return (rows.reshape(shape[:-1]),
+            cols.reshape(shape[:-2] + (m,)))
+
+
+@register("IdentityAttachKLSparseReg")
+def identity_attach_kl_sparse_reg(data, sparseness_target=0.1,
+                                  penalty=0.001, momentum=0.9):
+    """Identity forward; backward adds the KL-sparsity penalty gradient
+    penalty * (-t/rho + (1-t)/(1-rho)) with rho the batch-mean
+    activation (ref: identity_attach_KL_sparse_reg-inl.h — the
+    reference keeps a momentum-smoothed rho in an aux state; the
+    functional form uses the current batch's mean, which is the
+    momentum=0 case)."""
+    t, p = float(sparseness_target), float(penalty)
+
+    @jax.custom_vjp
+    def _f(x):
+        return x
+
+    def _fwd(x):
+        return x, jnp.mean(x, axis=0)
+
+    def _bwd(rho, g):
+        kl_grad = p * (-t / rho + (1.0 - t) / (1.0 - rho))
+        return (g + jnp.broadcast_to(kl_grad, g.shape),)
+
+    _f.defvjp(_fwd, _bwd)
+    return _f(data)
+
+
+@register("_contrib_DeformablePSROIPooling", num_outputs=2,
+          aliases=("DeformablePSROIPooling",))
+def deformable_psroi_pooling(data, rois, trans, spatial_scale=1.0,
+                             output_dim=1, group_size=1, pooled_size=7,
+                             part_size=0, sample_per_part=1,
+                             trans_std=0.0, no_trans=False):
+    """Deformable position-sensitive ROI pooling (ref:
+    contrib/deformable_psroi_pooling.cc, Dai 2017 §3.2): each output bin
+    shifts by a learned normalized offset trans[(cls, {y,x}), part_y,
+    part_x] * trans_std scaled by the ROI size, then averages
+    sample_per_part^2 bilinear taps. Outputs (out, top_count) like the
+    reference (top_count = live samples per bin)."""
+    ps = int(pooled_size)
+    gs = int(group_size) or ps
+    pz = int(part_size) or ps
+    sp = int(sample_per_part)
+    N, C, H, W = data.shape
+
+    ys_all = jnp.arange(H, dtype=jnp.float32)
+    xs_all = jnp.arange(W, dtype=jnp.float32)
+
+    def _bilinear(img, y, x):
+        y0 = jnp.clip(jnp.floor(y), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(x), 0, W - 1)
+        y1 = jnp.clip(y0 + 1, 0, H - 1)
+        x1 = jnp.clip(x0 + 1, 0, W - 1)
+        wy = y - y0
+        wx = x - x0
+        iy0, ix0, iy1, ix1 = (v.astype(jnp.int32) for v in (y0, x0, y1, x1))
+        v = (img[:, iy0, ix0] * (1 - wy) * (1 - wx)
+             + img[:, iy1, ix0] * wy * (1 - wx)
+             + img[:, iy0, ix1] * (1 - wy) * wx
+             + img[:, iy1, ix1] * wy * wx)
+        return v
+
+    def one(roi, tr):
+        bidx = jnp.clip(roi[0].astype(jnp.int32), 0, N - 1)
+        img = data[bidx]
+        x1 = jnp.round(roi[1]) * spatial_scale - 0.5
+        y1 = jnp.round(roi[2]) * spatial_scale - 0.5
+        x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
+        y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w = rw / ps
+        bin_h = rh / ps
+        sub_w = bin_w / sp
+        sub_h = bin_h / sp
+        out = jnp.zeros((output_dim, ps, ps), data.dtype)
+        cnt = jnp.zeros((output_dim, ps, ps), data.dtype)
+        for py in range(ps):
+            for px in range(ps):
+                part_y = py * pz // ps
+                part_x = px * pz // ps
+                if no_trans:
+                    dy = dx = jnp.float32(0)
+                else:
+                    dy = tr[0, part_y, part_x] * trans_std * rh
+                    dx = tr[1, part_y, part_x] * trans_std * rw
+                gy = min(py * gs // ps, gs - 1)
+                gx = min(px * gs // ps, gs - 1)
+                chans = (jnp.arange(output_dim) * gs + gy) * gs + gx
+                acc = jnp.zeros((output_dim,), jnp.float32)
+                live = jnp.zeros((), jnp.float32)
+                for iy in range(sp):
+                    for ix in range(sp):
+                        y = y1 + py * bin_h + dy + (iy + 0.5) * sub_h
+                        x = x1 + px * bin_w + dx + (ix + 0.5) * sub_w
+                        inb = (y > -1) & (y < H) & (x > -1) & (x < W)
+                        yc = jnp.clip(y, 0, H - 1)
+                        xc = jnp.clip(x, 0, W - 1)
+                        v = _bilinear(img[chans], yc, xc)
+                        acc = acc + jnp.where(inb, v, 0.0)
+                        live = live + inb.astype(jnp.float32)
+                out = out.at[:, py, px].set(
+                    (acc / jnp.maximum(live, 1.0)).astype(data.dtype))
+                cnt = cnt.at[:, py, px].set(live.astype(data.dtype))
+        return out, cnt
+
+    # trans: (num_rois or N, 2*num_classes, part, part); take the first
+    # two channels per the no-class-aware default
+    ntr = rois.shape[0]
+    if no_trans:
+        tr_all = jnp.zeros((ntr, 2, pz, pz), jnp.float32)
+    else:
+        tr_all = trans[:, :2].astype(jnp.float32)
+    return jax.vmap(one)(rois, tr_all)

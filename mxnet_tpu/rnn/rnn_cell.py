@@ -115,10 +115,16 @@ class LSTMCell(BaseRNNCell):
     def __init__(self, num_hidden, prefix="lstm_", forget_bias=1.0):
         super().__init__(prefix)
         self._num_hidden = num_hidden
-        self._forget_bias = forget_bias
         p = self._prefix
         self._iW = sym.var(p + "i2h_weight")
-        self._iB = sym.var(p + "i2h_bias")
+        # forget_bias is realized through the bias *initializer* (ref:
+        # rnn_cell.py LSTMCell uses init.LSTMBias so trained weights
+        # absorb it) — NOT an in-graph addition, which would double-apply
+        # it when loading reference-format checkpoints whose biases
+        # already encode the +forget_bias
+        from ..initializer import LSTMBias
+        self._iB = sym.var(p + "i2h_bias",
+                           init=LSTMBias(forget_bias=forget_bias))
         self._hW = sym.var(p + "h2h_weight")
         self._hB = sym.var(p + "h2h_bias")
 
@@ -139,8 +145,7 @@ class LSTMCell(BaseRNNCell):
         split = sym.SliceChannel(gates, num_outputs=4, axis=1,
                                  name=name + "slice")
         i = sym.Activation(split[0], act_type="sigmoid")
-        f = sym.Activation(split[1] + self._forget_bias,
-                           act_type="sigmoid")
+        f = sym.Activation(split[1], act_type="sigmoid")
         g = sym.Activation(split[2], act_type="tanh")
         o = sym.Activation(split[3], act_type="sigmoid")
         c = f * states[1] + i * g

@@ -47,7 +47,7 @@ def sg_xla_conv(data, weight, *rest, kernel=(), stride=(), dilate=(),
         bias = fold_b if bias is None else bias * scale + fold_b
     out = convolution(data, weight, bias, kernel=kernel, stride=stride,
                       dilate=dilate, pad=pad, num_filter=num_filter,
-                      num_group=num_group,
+                      num_group=num_group, layout=layout,
                       no_bias=bias is None)
     if with_sum:
         out = out + rest.pop(0)
@@ -84,7 +84,18 @@ class XlaConvSelector(SubgraphSelector):
         if self.status == _K_START and op == "BatchNorm":
             # the executor's training hook can't see through the fused
             # node, so only global-stats (inference-semantics) BN or
-            # fix_gamma'd BN folds; training graphs keep BN separate
+            # fix_gamma'd BN folds; training graphs keep BN separate.
+            # The BN must normalize the conv's channel axis (NCHW→1,
+            # channel-last→last), else folding into weights is wrong.
+            conv = self.matched[0]
+            layout = str(conv.attrs.get("layout") or "")
+            nd = len(tuple(conv.attrs.get("kernel", ()))) or 2
+            c_axis = ((nd + 1) if layout and not layout.startswith("NC")
+                      else 1)
+            bn_axis = int(output_node.attrs.get("axis", 1))
+            if bn_axis % (nd + 2) != c_axis:
+                self.status = _K_SUCCESS
+                return False
             self.matched.append(output_node)
             self.status = _K_BN
             return True
@@ -147,16 +158,21 @@ def _sg_conv_shapes(ins, attrs):
     pad = tuple(attrs.get("pad", ())) or (0,) * len(kernel)
     nf = int(attrs.get("num_filter", 0))
     ng = int(attrs.get("num_group", 1))
-    out = [None, (nf, int(data[1]) // ng) + kernel]
+    layout = str(attrs.get("layout") or "")
+    channel_last = bool(layout) and not layout.startswith("NC")
+    cin = int(data[-1] if channel_last else data[1])
+    sp0 = 1 if channel_last else 2
+    out = [None, (nf, cin // ng) + kernel]
     if not attrs.get("no_bias", False):
         out.append((nf,))
     if attrs.get("with_bn"):
         out.extend([(nf,)] * 4)
     if attrs.get("with_sum"):
         spatial = tuple(
-            (data[2 + i] + 2 * pad[i] - (dilate[i] * (kernel[i] - 1) + 1))
+            (data[sp0 + i] + 2 * pad[i] - (dilate[i] * (kernel[i] - 1) + 1))
             // stride[i] + 1 for i in range(len(kernel)))
-        out.append((data[0], nf) + spatial)
+        out.append((data[0],) + spatial + (nf,) if channel_last
+                   else (data[0], nf) + spatial)
     return out
 
 

@@ -674,7 +674,12 @@ def var(name, attr=None, shape=None, dtype=None, lr_mult=None, wd_mult=None,
     if wd_mult is not None:
         attrs["__wd_mult__"] = wd_mult
     if init is not None:
-        attrs["__init__"] = init if isinstance(init, str) else repr(init)
+        if isinstance(init, str):
+            attrs["__init__"] = init
+        elif hasattr(init, "dumps"):
+            attrs["__init__"] = init.dumps()
+        else:
+            attrs["__init__"] = repr(init)
     attrs.update(kwargs)
     return Symbol([(_Node(None, name, attrs), 0)])
 

@@ -1,0 +1,36 @@
+"""waitall() must fence every device, not a global recency window
+(ref: the reference engine's WaitForAll blocks until all device queues
+drain, src/engine/threaded_engine.cc; here per-device stream order makes
+the newest handle per device a sufficient fence — but only if one is
+retained for *each* device)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import mxnet_tpu.engine as engine
+
+
+def test_waitall_retains_per_device_handles():
+    devs = jax.devices()
+    assert len(devs) >= 2, "conftest should provide the 8-device CPU mesh"
+    engine._newest_by_device.clear()
+    mesh = Mesh(np.array(devs), ("x",))
+    sharded = jax.device_put(jnp.ones((len(devs), 4)),
+                             NamedSharding(mesh, P("x")))
+    engine.on_op_executed([sharded])
+    # flood with single-device work; under the old 64-entry global
+    # window this evicted the only handles for devices 1..N-1
+    for _ in range(100):
+        engine.on_op_executed([jnp.ones(2) + 1])
+    fenced = set(engine._newest_by_device)
+    assert fenced == set(devs), f"unfenced devices: {set(devs) - fenced}"
+    engine.waitall()
+    assert not engine._newest_by_device
+
+
+def test_waitall_propagates_and_clears():
+    engine._newest_by_device.clear()
+    engine.on_op_executed([jnp.zeros(3)])
+    engine.waitall()
+    assert not engine._newest_by_device

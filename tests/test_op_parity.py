@@ -1,0 +1,266 @@
+"""Op-parity sweep tests (VERDICT r3 #5): the registry diff is clean and
+every newly registered op computes the reference math."""
+import subprocess
+import sys
+import os
+
+import numpy as np
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ops import registry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_registry_diff_clean():
+    """tools/op_parity.py reports zero undocumented gaps."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "op_parity.py")],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def _op(name, *arrays, **attrs):
+    out = registry.get(name)(*[jnp.asarray(a) for a in arrays], **attrs)
+    if isinstance(out, (list, tuple)):
+        return [np.asarray(o) for o in out]
+    return np.asarray(out)
+
+
+def test_elemwise_parity_table():
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((3, 4)).astype(np.float32)
+    b = rng.standard_normal((3, 4)).astype(np.float32)
+    cases = [
+        ("reshape_like", (a, np.zeros((4, 3))), {}, a.reshape(4, 3)),
+        ("round", (np.array([-2.5, -0.5, 0.5, 1.5, 2.5], np.float32),),
+         {}, np.array([-3., -1., 1., 2., 3.], np.float32)),
+        ("hard_sigmoid", (a,), {},
+         np.clip(0.2 * a + 0.5, 0, 1)),
+        ("_logical_and", (a > 0, b > 0), {},
+         ((a > 0) & (b > 0)).astype(np.float32)),
+        ("_logical_xor", (a > 0, b > 0), {},
+         ((a > 0) ^ (b > 0)).astype(np.float32)),
+        ("_mod", (a * 7, np.abs(b) + 1), {},
+         np.fmod(a * 7, np.abs(b) + 1)),
+        ("_greater", (a, b), {}, (a > b).astype(np.float32)),
+        ("_lesser_equal", (a, b), {}, (a <= b).astype(np.float32)),
+        ("_grad_add", (a, b), {}, a + b),
+        ("broadcast_plus", (a, b), {}, a + b),
+        ("broadcast_minus", (a, b), {}, a - b),
+        ("_identity_with_attr_like_rhs", (a, b), {}, a),
+        ("cast_storage", (a,), {"stype": "row_sparse"}, a),
+        ("_square_sum", (a,), {"axis": 1}, (a ** 2).sum(axis=1)),
+    ]
+    for name, arrays, attrs, want in cases:
+        got = _op(name, *arrays, **attrs)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6,
+                                   err_msg=name)
+
+
+def test_softmin():
+    x = np.array([[1.0, 2.0, 3.0]], np.float32)
+    got = _op("softmin", x)
+    e = np.exp(-x - (-x).max())
+    np.testing.assert_allclose(got, e / e.sum(), rtol=1e-5)
+
+
+def test_ravel_unravel_roundtrip():
+    shape = (5, 7)
+    coords = np.array([[0, 4, 2], [6, 0, 3]], np.float32)
+    flat = _op("_ravel_multi_index", coords, shape=shape)
+    np.testing.assert_allclose(flat, [6, 28, 17])
+    back = _op("_unravel_index", flat, shape=shape)
+    np.testing.assert_allclose(back, coords)
+
+
+def test_rnn_param_concat_and_zeros_without_dtype():
+    a, b = np.ones((2, 3), np.float32), np.zeros((1, 3), np.float32)
+    got = _op("_rnn_param_concat", a, b, dim=0)
+    assert got.shape == (3, 3)
+    z = _op("_zeros_without_dtype", shape=(2, 2))
+    assert z.dtype == np.float32 and not z.any()
+
+
+def test_sparse_retain_dense():
+    d = np.arange(12, dtype=np.float32).reshape(4, 3)
+    got = _op("_sparse_retain", d, np.array([0, 2], np.int64))
+    want = d.copy()
+    want[1] = 0
+    want[3] = 0
+    np.testing.assert_allclose(got, want)
+
+
+def test_image_ops():
+    img = np.random.default_rng(1).integers(
+        0, 255, (8, 6, 3)).astype(np.uint8)
+    t = _op("_image_to_tensor", img)
+    assert t.shape == (3, 8, 6) and t.max() <= 1.0
+    norm = _op("_image_normalize", t, mean=(0.5, 0.5, 0.5),
+               std=(0.25, 0.25, 0.25))
+    np.testing.assert_allclose(norm, (t - 0.5) / 0.25, rtol=1e-5)
+
+
+def test_getnnz_and_sparse_embedding():
+    d = np.array([[1.0, 0.0], [0.0, 2.0]], np.float32)
+    assert _op("_contrib_getnnz", d) == 2
+    w = np.arange(12, dtype=np.float32).reshape(4, 3)
+    got = _op("_contrib_SparseEmbedding", np.array([1, 3], np.float32), w)
+    np.testing.assert_allclose(got, w[[1, 3]])
+
+
+def test_bipartite_matching():
+    score = np.array([[[0.5, 0.6], [0.1, 0.2], [0.3, 0.4]]], np.float32)
+    rows, cols = _op("_contrib_bipartite_matching", score,
+                     is_ascend=False, threshold=1e-12)
+    # greedy: (0,1)=0.6 first, then (2,0)=0.3; row 1 unmatched
+    np.testing.assert_allclose(rows[0], [1, -1, 0])
+    np.testing.assert_allclose(cols[0], [2, 0])
+
+
+def test_linalg_gelqf_syevd():
+    rng = np.random.default_rng(2)
+    A = rng.standard_normal((3, 5)).astype(np.float32)
+    L, Q = _op("_linalg_gelqf", A)
+    np.testing.assert_allclose(L @ Q, A, atol=1e-4)
+    np.testing.assert_allclose(Q @ Q.T, np.eye(3), atol=1e-4)
+    S = rng.standard_normal((4, 4)).astype(np.float32)
+    S = S + S.T
+    U, lam = _op("_linalg_syevd", S)
+    np.testing.assert_allclose(U.T @ np.diag(lam) @ U, S, atol=1e-3)
+
+
+def test_optimizer_update_ops_match_optimizer_classes():
+    """The registered update ops and the Optimizer classes share the
+    reference kernel math (optimizer_op.cc)."""
+    rng = np.random.default_rng(3)
+    w = rng.standard_normal((4, 3)).astype(np.float32)
+    g = rng.standard_normal((4, 3)).astype(np.float32)
+    mom = np.zeros_like(w)
+
+    # sgd_mom_update vs SGD optimizer (wd=0 so conventions align)
+    out, mom_new = _op("sgd_mom_update", w, g, mom, lr=0.1, momentum=0.9,
+                       wd=0.0)
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.0)
+    wnd = nd.array(w.copy())
+    state = opt.create_state(0, wnd)
+    opt.update(0, wnd, nd.array(g), state)
+    np.testing.assert_allclose(out, wnd.asnumpy(), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(mom_new, state.asnumpy(), rtol=1e-5,
+                               atol=1e-6)
+
+    # adam_update reference math (no bias correction in-kernel)
+    mean = np.zeros_like(w)
+    var = np.zeros_like(w)
+    out, mean_n, var_n = _op("adam_update", w, g, mean, var, lr=0.01)
+    m_want = 0.1 * g
+    v_want = 0.001 * g * g
+    np.testing.assert_allclose(mean_n, m_want, rtol=1e-5)
+    np.testing.assert_allclose(var_n, v_want, rtol=1e-5)
+    np.testing.assert_allclose(
+        out, w - 0.01 * m_want / (np.sqrt(v_want) + 1e-8), rtol=1e-5)
+
+    # ftrl: one step from zero state
+    z = np.zeros_like(w)
+    n = np.zeros_like(w)
+    out, z_n, n_n = _op("ftrl_update", w, g, z, n, lr=0.1, lamda1=0.01,
+                        beta=1.0)
+    z_want = g - (np.abs(g) - 0.0) * w / 0.1
+    n_want = g * g
+    np.testing.assert_allclose(z_n, z_want, rtol=1e-5, atol=1e-6)
+    want = (np.sign(z_want) * 0.01 - z_want) / ((1 + np.abs(g)) / 0.1) \
+        * (np.abs(z_want) > 0.01)
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-6)
+
+    # rmsprop / signum / ftml / group_adagrad smoke: finite + state moves
+    for name, arrs, kw in [
+            ("rmsprop_update", (w, g, np.zeros_like(w)), {"lr": 0.01}),
+            ("rmspropalex_update",
+             (w, g, np.zeros_like(w), np.zeros_like(w), np.zeros_like(w)),
+             {"lr": 0.01}),
+            ("signum_update", (w, g, mom), {"lr": 0.01, "momentum": 0.9}),
+            ("signsgd_update", (w, g), {"lr": 0.01}),
+            ("ftml_update",
+             (w, g, np.zeros_like(w), np.zeros_like(w), np.zeros_like(w)),
+             {"lr": 0.01, "t": 1}),
+            ("_sparse_adagrad_update", (w, g, np.zeros_like(w)),
+             {"lr": 0.01}),
+            ("_contrib_group_adagrad_update",
+             (w, g, np.zeros((4,), np.float32)), {"lr": 0.01}),
+            ("mp_sgd_update", (w.astype(np.float16), g, w), {"lr": 0.1}),
+    ]:
+        outs = _op(name, *arrs, **kw)
+        outs = outs if isinstance(outs, list) else [outs]
+        for o in outs:
+            assert np.isfinite(o).all(), name
+        assert not np.allclose(outs[0], arrs[0]), name
+
+
+def test_nd_update_ops_mutate_state_in_place():
+    """nd-layer wrappers restore the reference's mutate-in-place call
+    surface (state inputs updated, weight returned)."""
+    w = nd.array(np.ones((3,), np.float32))
+    g = nd.array(np.full((3,), 2.0, np.float32))
+    mom = nd.array(np.zeros((3,), np.float32))
+    out = nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9)
+    assert not np.allclose(mom.asnumpy(), 0.0), "mom not updated in place"
+    np.testing.assert_allclose(out.asnumpy(), 1.0 + mom.asnumpy())
+
+
+def test_recorded_setitem_slice_assign():
+    """x[a:b] = y under autograd recording routes through _slice_assign
+    and gradients flow to both sides (VERDICT r3 #5 `_slice_assign`)."""
+    from mxnet_tpu import autograd
+    x = nd.array(np.ones((4, 3), np.float32))
+    y = nd.array(np.full((2, 3), 5.0, np.float32))
+    x.attach_grad()
+    y.attach_grad()
+    with autograd.record():
+        z = x * 2.0
+        z[1:3] = y
+        loss = (z * z).sum()
+    loss.backward()
+    zv = np.ones((4, 3)) * 2
+    zv[1:3] = 5.0
+    # d loss/dz = 2z; rows 1:3 of z came from y, others from 2x
+    gx = 2 * zv * 2
+    gx[1:3] = 0
+    gy = (2 * zv)[1:3]
+    np.testing.assert_allclose(x.grad.asnumpy(), gx, rtol=1e-5)
+    np.testing.assert_allclose(y.grad.asnumpy(), gy, rtol=1e-5)
+
+
+def test_deformable_psroi_matches_psroi_when_no_trans():
+    """With no_trans and zero offsets the deformable op reduces to plain
+    PSROIPooling's averaging (up to the sampling scheme); sanity: finite,
+    right shape, and responds to input."""
+    rng = np.random.default_rng(4)
+    ps, od, gs = 3, 2, 3
+    data = rng.standard_normal((1, od * gs * gs, 12, 12)).astype(np.float32)
+    rois = np.array([[0, 0, 0, 11, 11]], np.float32)
+    trans = np.zeros((1, 2, ps, ps), np.float32)
+    out, cnt = _op("_contrib_DeformablePSROIPooling", data, rois, trans,
+                   spatial_scale=1.0, output_dim=od, group_size=gs,
+                   pooled_size=ps, sample_per_part=2, trans_std=0.1)
+    assert out.shape == (1, od, ps, ps)
+    assert np.isfinite(out).all() and (cnt > 0).all()
+    out2, _ = _op("_contrib_DeformablePSROIPooling", data * 2, rois, trans,
+                  spatial_scale=1.0, output_dim=od, group_size=gs,
+                  pooled_size=ps, sample_per_part=2, trans_std=0.1)
+    np.testing.assert_allclose(out2, out * 2, rtol=1e-4)
+
+
+def test_identity_attach_kl_sparse_reg_gradient():
+    import jax
+    x = jnp.asarray(np.full((4, 2), 0.2, np.float32))
+    fn = registry.get("IdentityAttachKLSparseReg")
+
+    def loss(x):
+        return jnp.sum(fn(x, sparseness_target=0.1, penalty=0.001))
+
+    g = np.asarray(jax.grad(loss)(x))
+    rho = 0.2
+    want = 1.0 + 0.001 * (-0.1 / rho + 0.9 / (1 - rho))
+    np.testing.assert_allclose(g, want, rtol=1e-5)

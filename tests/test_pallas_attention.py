@@ -87,3 +87,25 @@ def test_flash_gradients():
     for a, b in zip(gf, gd):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_flash_chunked_backward_rectangular():
+    """Non-causal t_q != t_k through the chunked backward (the (T,T)
+    matrix is never materialized; ADVICE r3)."""
+    rng = np.random.default_rng(5)
+    q = jnp.asarray(rng.standard_normal((2, 128, 16)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, 192, 16)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 192, 16)), jnp.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, force=True,
+                                       block_q=64, block_k=64) ** 3)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_dense_reference(q, k, v, False, 16 ** -0.5) ** 3)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)

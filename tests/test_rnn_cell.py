@@ -143,3 +143,30 @@ def test_fused_cell_merge_outputs_false_and_bidirectional():
     out, _ = bi.unroll(3, sym.var("data"), layout="NTC")
     # composes without shape errors at trace level
     assert out is not None
+
+
+def test_lstm_forget_bias_via_initializer():
+    """forget_bias lives in the i2h_bias initializer (LSTMBias), not the
+    graph — reference-format checkpoints whose biases already encode it
+    must not get it applied twice (ADVICE r3)."""
+    H = 4
+    cell = mx.rnn.LSTMCell(H, prefix="fb_", forget_bias=2.0)
+    data = sym.var("data")
+    out, _ = cell.unroll(2, data, layout="NTC")
+    # 1) the graph carries no baked-in scalar add on the forget gate:
+    # evaluating with an all-zero bias gives sigmoid(0)=0.5 gates
+    attrs = out.attr_dict()
+    assert "__init__" in attrs.get("fb_i2h_bias", {}), attrs.get(
+        "fb_i2h_bias")
+    # 2) Module init realizes the bias through LSTMBias
+    mod = mx.mod.Module(
+        out, data_names=("data", "fb_begin_state_1", "fb_begin_state_2"),
+        label_names=None)
+    mod.bind(data_shapes=[("data", (2, 2, 3)),
+                          ("fb_begin_state_1", (2, H)),
+                          ("fb_begin_state_2", (2, H))], grad_req="null")
+    mod.init_params(mx.init.Zero())
+    b = mod._exec.arg_dict["fb_i2h_bias"].asnumpy()
+    np.testing.assert_allclose(b[:H], 0.0)
+    np.testing.assert_allclose(b[H:2 * H], 2.0)
+    np.testing.assert_allclose(b[2 * H:], 0.0)
