@@ -352,6 +352,7 @@ def main():
         "value": round(ips_bf16, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(ips_bf16 / TARGET, 4),
+        "bf16_variant": "nchw",  # the final line reports best-of-variants
         "partial": True,
     }), flush=True)
 
@@ -433,6 +434,10 @@ def main():
         "mfu_bf16": round(
             best_ips * RESNET50_GFLOPS / (PEAK_TFLOPS * 1e3), 4),
     }
+    # stable cross-round series: the plain-NCHW number always ships
+    # under its own key regardless of which variant wins the headline
+    result["resnet50_inference_bf16_nchw_bs%d" % BATCH] = round(
+        variants["nchw"], 2)
     for k, v in variants.items():
         result["mfu_bf16_" + k] = round(
             v * RESNET50_GFLOPS / (PEAK_TFLOPS * 1e3), 4)

@@ -1,0 +1,112 @@
+"""VOC mean-average-precision for detection
+(ref: example/ssd/evaluate/eval_metric.py MApMetric / VOC07MApMetric).
+
+update() consumes MultiBoxDetection-format predictions (B, N, 6) rows
+[cls_id, score, x1, y1, x2, y2] (cls_id -1 = pruned) and labels
+(B, M, 5) rows [cls_id, x1, y1, x2, y2] (cls_id -1 = padding).
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+from mxnet_tpu.metric import EvalMetric
+
+
+def _iou(box, boxes):
+    tl = np.maximum(box[:2], boxes[:, :2])
+    br = np.minimum(box[2:4], boxes[:, 2:4])
+    wh = np.maximum(br - tl, 0)
+    inter = wh[:, 0] * wh[:, 1]
+    a = (box[2] - box[0]) * (box[3] - box[1])
+    b = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    union = a + b - inter
+    return np.where(union > 0, inter / union, 0.0)
+
+
+class MApMetric(EvalMetric):
+    """Area-under-PR mAP (integrated, VOC2010+ style)."""
+
+    def __init__(self, iou_thresh=0.5, class_names=None, name="mAP"):
+        super().__init__(name)
+        self.iou_thresh = iou_thresh
+        self.class_names = class_names
+        self.reset()
+
+    def reset(self):
+        # per class: list of (score, tp) records + total gt count
+        self._records = {}
+        self._gt_counts = {}
+        self.num_inst = 1
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        for lab, det in zip(labels, preds):
+            lab = np.asarray(lab.asnumpy() if hasattr(lab, "asnumpy")
+                             else lab)
+            det = np.asarray(det.asnumpy() if hasattr(det, "asnumpy")
+                             else det)
+            lab = lab[lab[:, 0] >= 0]
+            det = det[det[:, 0] >= 0]
+            for c in np.unique(lab[:, 0]).astype(int):
+                self._gt_counts[c] = self._gt_counts.get(c, 0) + \
+                    int((lab[:, 0] == c).sum())
+            order = np.argsort(-det[:, 1]) if len(det) else []
+            matched = set()
+            for i in order:
+                c = int(det[i, 0])
+                gt = np.nonzero(lab[:, 0] == c)[0]
+                rec = self._records.setdefault(c, [])
+                if len(gt) == 0:
+                    rec.append((float(det[i, 1]), 0))
+                    continue
+                ious = _iou(det[i, 2:6], lab[gt, 1:5])
+                j = int(np.argmax(ious))
+                if ious[j] >= self.iou_thresh and (c, gt[j]) not in matched:
+                    matched.add((c, gt[j]))
+                    rec.append((float(det[i, 1]), 1))
+                else:
+                    rec.append((float(det[i, 1]), 0))
+
+    def _class_ap(self, recall, precision):
+        # integrated AP: sum over recall steps
+        mrec = np.concatenate([[0.0], recall, [1.0]])
+        mpre = np.concatenate([[0.0], precision, [0.0]])
+        for i in range(len(mpre) - 2, -1, -1):
+            mpre[i] = max(mpre[i], mpre[i + 1])
+        idx = np.nonzero(mrec[1:] != mrec[:-1])[0]
+        return float(np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]))
+
+    def get(self):
+        aps = []
+        for c, npos in self._gt_counts.items():
+            rec = sorted(self._records.get(c, []), key=lambda r: -r[0])
+            if npos == 0:
+                continue
+            if not rec:
+                aps.append(0.0)
+                continue
+            tp = np.cumsum([r[1] for r in rec])
+            fp = np.cumsum([1 - r[1] for r in rec])
+            recall = tp / npos
+            precision = tp / np.maximum(tp + fp, 1e-12)
+            aps.append(self._class_ap(recall, precision))
+        return self.name, float(np.mean(aps)) if aps else float("nan")
+
+
+class VOC07MApMetric(MApMetric):
+    """11-point interpolated AP (VOC 2007 protocol,
+    ref: eval_metric.py VOC07MApMetric)."""
+
+    def _class_ap(self, recall, precision):
+        ap = 0.0
+        for t in np.arange(0.0, 1.1, 0.1):
+            mask = recall >= t
+            p = float(np.max(precision[mask])) if mask.any() else 0.0
+            ap += p / 11.0
+        return ap

@@ -1,0 +1,103 @@
+"""SSD detector as one hybridizable Gluon block (BASELINE config 4).
+
+The reference builds SSD by splicing multi-scale heads onto a backbone
+symbol (ref: example/ssd/symbol/symbol_builder.py get_symbol_train);
+here the whole detector — backbone, scale pyramid, per-scale class/box
+heads, and anchor generation — is a single HybridBlock, so
+`hybridize()` compiles detection into one XLA program (anchors fold to
+constants under jit since they depend only on feature shapes).
+
+Scales follow the reference's design: each pyramid level halves the
+spatial dims and owns anchors of growing size; every level contributes
+`anchors_per_pixel * (num_classes + 1)` class logits and
+`anchors_per_pixel * 4` box offsets per pixel.
+"""
+from __future__ import annotations
+
+import sys
+import os
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+from mxnet_tpu.gluon import HybridBlock, nn
+
+# anchor geometry per pyramid level (ref: example/ssd/symbol/vgg16_ssd_300
+# sizes/ratios ladder, shrunk to 5 levels)
+SIZES = [(0.2, 0.272), (0.37, 0.447), (0.54, 0.619),
+         (0.71, 0.79), (0.88, 0.961)]
+RATIOS = [(1.0, 2.0, 0.5)] * 5
+
+
+def _down_block(channels):
+    """Two conv+BN+relu then 2x2 pool: one pyramid step."""
+    blk = nn.HybridSequential()
+    for _ in range(2):
+        blk.add(nn.Conv2D(channels, 3, padding=1, use_bias=False),
+                nn.BatchNorm(), nn.Activation("relu"))
+    blk.add(nn.MaxPool2D(2, 2))
+    return blk
+
+
+class SSD(HybridBlock):
+    """Single-shot detector over a small conv backbone.
+
+    forward(x) -> (anchors (1, N, 4), cls_preds (B, N, C+1),
+    box_preds (B, N*4)); x is NCHW in [0, 1]-ish range.
+    """
+
+    def __init__(self, num_classes, base_channels=(16, 32, 64),
+                 layout="NCHW", **kwargs):
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self._layout = layout
+        napp = len(SIZES[0]) + len(RATIOS[0]) - 1  # anchors per pixel
+        self._napp = napp
+        with self.name_scope():
+            self.stages = []
+            base = nn.HybridSequential(prefix="base_")
+            with base.name_scope():
+                for c in base_channels:
+                    base.add(_down_block(c))
+            blocks = [base, _down_block(128), _down_block(128),
+                      _down_block(128)]
+            self.cls_heads = []
+            self.box_heads = []
+            for i, blk in enumerate(blocks + [None]):
+                if blk is not None:
+                    setattr(self, f"stage{i}", blk)
+                    self.stages.append(blk)
+                cls = nn.Conv2D(napp * (num_classes + 1), 3, padding=1,
+                                prefix=f"cls{i}_")
+                box = nn.Conv2D(napp * 4, 3, padding=1, prefix=f"box{i}_")
+                setattr(self, f"clshead{i}", cls)
+                setattr(self, f"boxhead{i}", box)
+                self.cls_heads.append(cls)
+                self.box_heads.append(box)
+
+    def hybrid_forward(self, F, x):
+        anchors, cls_preds, box_preds = [], [], []
+        feat = x
+        n_levels = len(self.cls_heads)
+        for i in range(n_levels):
+            if i < len(self.stages):
+                feat = self.stages[i](feat)
+            else:  # last level: collapse to 1x1 (global context anchors)
+                feat = F.Pooling(feat, global_pool=True, kernel=(1, 1),
+                                 pool_type="max")
+            anchors.append(F.MultiBoxPrior(
+                feat, sizes=SIZES[i], ratios=RATIOS[i]))
+            c = self.cls_heads[i](feat)
+            b = self.box_heads[i](feat)
+            # (B, A*(C+1), H, W) -> (B, H*W*A, C+1) / flat boxes;
+            # shape codes (0 = copy, -1 = infer) keep this traceable
+            # both eagerly and symbolically
+            c = F.transpose(c, axes=(0, 2, 3, 1))
+            cls_preds.append(F.reshape(
+                c, shape=(0, -1, self.num_classes + 1)))
+            b = F.transpose(b, axes=(0, 2, 3, 1))
+            box_preds.append(F.reshape(b, shape=(0, -1)))
+        anchors = F.concat(*anchors, dim=1)
+        cls_preds = F.concat(*cls_preds, dim=1)
+        box_preds = F.concat(*box_preds, dim=1)
+        return anchors, cls_preds, box_preds

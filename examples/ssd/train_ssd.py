@@ -1,0 +1,169 @@
+"""Train + evaluate + INT8-quantize SSD on synthetic detection data
+(BASELINE config 4; ref: example/ssd/train/train_net.py train_net and
+example/quantization's INT8-SSD row).
+
+No dataset download in this environment, so the data is synthetic but
+non-trivial: each image carries one solid axis-aligned rectangle whose
+class is its color channel; the detector must localize and classify it.
+
+    python examples/ssd/train_ssd.py --steps 150 --eval --int8
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+from model import SSD  # noqa: E402
+from metric import VOC07MApMetric  # noqa: E402
+
+NUM_CLASSES = 2
+IMG = 64
+
+
+def synth_batch(rng, batch):
+    """Images (B, 3, IMG, IMG) with one colored rectangle each; labels
+    (B, 1, 5) rows [cls, x1, y1, x2, y2] in normalized corners."""
+    x = rng.normal(0.0, 0.05, (batch, 3, IMG, IMG)).astype(np.float32)
+    labels = np.zeros((batch, 1, 5), np.float32)
+    for i in range(batch):
+        cls = int(rng.integers(0, NUM_CLASSES))
+        w = int(rng.integers(16, 40))
+        h = int(rng.integers(16, 40))
+        x0 = int(rng.integers(0, IMG - w))
+        y0 = int(rng.integers(0, IMG - h))
+        x[i, cls, y0:y0 + h, x0:x0 + w] += 1.0
+        labels[i, 0] = [cls, x0 / IMG, y0 / IMG,
+                        (x0 + w) / IMG, (y0 + h) / IMG]
+    return x, labels
+
+
+def build(seed=0):
+    net = SSD(NUM_CLASSES)
+    net.initialize()
+    from mxnet_tpu.gluon.block import infer_shapes
+    infer_shapes(net, (2, 3, IMG, IMG))
+    net.hybridize()
+    return net
+
+
+def train(net, steps=150, batch=8, lr=0.05, log_every=25):
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": lr, "momentum": 0.9,
+                             "wd": 5e-4})
+    cls_loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    l1_loss = gluon.loss.L1Loss()
+    rng = np.random.default_rng(42)
+    first = last = None
+    for step in range(steps):
+        xs, ys = synth_batch(rng, batch)
+        X, Y = nd.array(xs), nd.array(ys)
+        with autograd.record():
+            anchors, cls_preds, box_preds = net(X)
+            box_t, box_m, cls_t = nd.MultiBoxTarget(
+                anchors, Y, nd.transpose(cls_preds, axes=(0, 2, 1)),
+                negative_mining_ratio=3.0)
+            l_cls = cls_loss(cls_preds, cls_t)
+            l_box = l1_loss(box_preds * box_m, box_t * box_m)
+            loss = l_cls + l_box
+        loss.backward()
+        trainer.step(batch)
+        cur = float(loss.mean().asscalar())
+        if first is None:
+            first = cur
+        last = cur
+        if step % log_every == 0:
+            print(f"step {step}: loss {cur:.4f}", flush=True)
+    print(f"train: loss {first:.4f} -> {last:.4f}")
+    return first, last
+
+
+def predict_fn(net):
+    def predict(xs):
+        anchors, cls_preds, box_preds = net(nd.array(xs))
+        probs = nd.softmax(cls_preds, axis=-1)
+        return nd.MultiBoxDetection(
+            nd.transpose(probs, axes=(0, 2, 1)), box_preds, anchors,
+            nms_threshold=0.45, threshold=0.01)
+    return predict
+
+
+def evaluate(predict, batches=4, batch=8, seed=7):
+    metric = VOC07MApMetric(iou_thresh=0.5)
+    rng = np.random.default_rng(seed)
+    for _ in range(batches):
+        xs, ys = synth_batch(rng, batch)
+        dets = predict(xs)
+        metric.update(nd.array(ys), dets)
+    name, value = metric.get()
+    print(f"{name}: {value:.4f}")
+    return value
+
+
+def quantize_int8(net, calib_batches=2, batch=8):
+    """INT8 SSD through the QuantizeGraph pass (the reference publishes
+    an INT8-SSD accuracy row, example/quantization/README.md:38). The
+    detection ops (anchors, NMS) stay fp32 — only the conv backbone and
+    heads quantize, mirroring the reference's exclude list."""
+    from mxnet_tpu.contrib.quantization import quantize_model
+    from mxnet_tpu.io import NDArrayIter
+    from mxnet_tpu.ndarray.ndarray import NDArray
+    from mxnet_tpu.symbol.trace import trace_block
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(11)
+    calib, _ = synth_batch(rng, calib_batches * batch)
+
+    sym, params = trace_block(net)
+    aux_names = set(sym.list_auxiliary_states())
+    arg_params = {k: p.data() for k, p in params.items()
+                  if k not in aux_names}
+    aux_params = {k: p.data() for k, p in params.items() if k in aux_names}
+    qsym, qarg, qaux = quantize_model(
+        sym, arg_params, aux_params, calib_mode="naive",
+        calib_data=NDArrayIter(data=calib, batch_size=batch),
+        num_calib_examples=len(calib))
+
+    def predict(xs):
+        bindings = {k: v for k, v in list(qarg.items()) + list(qaux.items())}
+        bindings["data"] = NDArray(jnp.asarray(xs))
+        anchors, cls_preds, box_preds = qsym.eval_dict(bindings)
+        probs = nd.softmax(cls_preds, axis=-1)
+        return nd.MultiBoxDetection(
+            nd.transpose(probs, axes=(0, 2, 1)), box_preds, anchors,
+            nms_threshold=0.45, threshold=0.01)
+    return predict
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=150)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--eval", action="store_true")
+    ap.add_argument("--int8", action="store_true")
+    args = ap.parse_args()
+
+    t0 = time.time()
+    net = build()
+    first, last = train(net, steps=args.steps, batch=args.batch, lr=args.lr)
+    assert np.isfinite(last), "training diverged"
+    if args.eval:
+        evaluate(predict_fn(net))
+    if args.int8:
+        print("quantizing to int8...")
+        evaluate(quantize_int8(net), batches=2)
+    print(f"done in {time.time() - t0:.1f}s")
+
+
+if __name__ == "__main__":
+    main()

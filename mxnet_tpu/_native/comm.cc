@@ -34,6 +34,7 @@
 #include <cstring>
 #include <map>
 #include <mutex>
+#include <set>
 #include <thread>
 #include <vector>
 
@@ -91,6 +92,13 @@ struct KeyState {
   std::vector<float> store;
   std::vector<float> merge;
   int pushed = 0;              // workers reported this round
+  // which ranks contributed to the in-flight round: a pull from a rank
+  // that has NOT pushed yet is for the PREVIOUS round's result and must
+  // be answered from the store immediately — queueing it would deadlock
+  // BSP when a fast worker opens round N+1 before a slow worker pulled
+  // round N (the reference keys requests by timestamp for the same
+  // reason, ps-lite van timestamps)
+  std::set<int> pushed_ranks;
   std::vector<int> pending_pulls;  // fds waiting for round completion
   // row-granular pulls queued on the in-flight round: fd + request body
   std::vector<std::pair<int, std::vector<char>>> pending_row_pulls;
@@ -188,6 +196,7 @@ void apply_round(Server* s, uint32_t key, KeyState* ks) {
     ks->store = ks->merge;
   }
   ks->pushed = 0;
+  ks->pushed_ranks.clear();
   for (int fd : ks->pending_pulls) {
     send_response(fd, 1, ks->store.data(), ks->store.size() * 4);
   }
@@ -199,7 +208,7 @@ void apply_round(Server* s, uint32_t key, KeyState* ks) {
 }
 
 void handle_push(Server* s, int fd, uint32_t key, const char* payload,
-                 uint64_t nbytes, bool compressed) {
+                 uint64_t nbytes, bool compressed, int rank) {
   std::unique_lock<std::mutex> lk(s->mu);
   if (s->sync_mode && sync_unhealthy_locked(s)) {
     lk.unlock();
@@ -209,6 +218,7 @@ void handle_push(Server* s, int fd, uint32_t key, const char* payload,
   KeyState& ks = s->keys[key];
   bool first = ks.pushed == 0;
   if (s->sync_mode) {
+    if (rank >= 0) ks.pushed_ranks.insert(rank);
     if (first) ks.merge.assign(ks.store.size(), 0.f);
     if (compressed) {
       accumulate_2bit(payload, nbytes, &ks.merge);
@@ -331,7 +341,7 @@ void handle_conn(Server* s, int fd) {
       send_response(fd, 1, nullptr, 0);
     } else if (h.op == kPush || h.op == kPush2Bit) {
       handle_push(s, fd, h.key, payload.data(), h.nbytes,
-                  h.op == kPush2Bit);
+                  h.op == kPush2Bit, rank);
     } else if (h.op == kPull) {
       std::unique_lock<std::mutex> lk(s->mu);
       if (s->sync_mode && sync_unhealthy_locked(s)) {
@@ -340,8 +350,12 @@ void handle_conn(Server* s, int fd) {
         continue;
       }
       KeyState& ks = s->keys[h.key];
-      if (s->sync_mode && ks.pushed > 0) {
-        // round in flight: queue until the last worker pushes
+      if (s->sync_mode && ks.pushed > 0 &&
+          ks.pushed_ranks.count(rank)) {
+        // this worker already contributed to the in-flight round —
+        // its pull wants the round's RESULT: queue until the last
+        // worker pushes. Pulls from not-yet-pushed ranks are for the
+        // previous round and are answered from the store right away.
         ks.pending_pulls.push_back(fd);
         lk.unlock();
       } else {
@@ -359,9 +373,10 @@ void handle_conn(Server* s, int fd) {
         continue;
       }
       KeyState& ks = s->keys[h.key];
-      if (s->sync_mode && ks.pushed > 0) {
-        // round in flight: queue like kPull so every worker sees the
-        // same post-round rows
+      if (s->sync_mode && ks.pushed > 0 &&
+          ks.pushed_ranks.count(rank)) {
+        // round in flight and this rank contributed: queue like kPull
+        // so the puller sees the post-round rows
         ks.pending_row_pulls.emplace_back(fd, payload);
         lk.unlock();
       } else {

@@ -63,8 +63,11 @@ def waitall():
     each device implies completion of everything before it there.
     """
     try:
-        # dedupe: one sharded array may be the newest entry on many devices
-        for o in {id(v): v for v in _newest_by_device.values()}.values():
+        # snapshot first: loader/prefetch threads may insert new device
+        # keys mid-iteration; dedupe because one sharded array may be
+        # the newest entry on many devices
+        snapshot = list(_newest_by_device.values())
+        for o in {id(v): v for v in snapshot}.values():
             jax.block_until_ready(o)
     except Exception:
         # waitall surfaces the first pending error, like WaitForAll

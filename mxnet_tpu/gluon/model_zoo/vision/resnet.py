@@ -138,13 +138,21 @@ class BottleneckV2(HybridBlock):
         return x + residual
 
 
+def _layout_build_scope(layout):
+    """Constructing with layout="NHWC" must build every conv/pool/BN in
+    the subtree channel-last — resolve the scope HERE so direct class
+    construction works, not only the get_resnet factory."""
+    from contextlib import nullcontext
+    return nn.layout_scope("NHWC") if layout == "NHWC" else nullcontext()
+
+
 class ResNetV1(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
                  layout="NCHW", **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         self._data_layout = layout
-        with self.name_scope():
+        with _layout_build_scope(layout), self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             if thumbnail:
                 self.features.add(nn.Conv2D(channels[0], 3, 1, 1,
@@ -189,7 +197,7 @@ class ResNetV2(HybridBlock):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         self._data_layout = layout
-        with self.name_scope():
+        with _layout_build_scope(layout), self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             self.features.add(nn.BatchNorm(scale=False, center=False))
             if thumbnail:
@@ -245,14 +253,7 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
     block_type, layers, channels = resnet_spec[num_layers]
     resnet_class = resnet_net_versions[version - 1]
     block_class = resnet_block_versions[version - 1][block_type]
-    layout = kwargs.get("layout", "NCHW")
-    if layout == "NHWC":
-        # every conv/pool/BN in the subtree builds channel-last; the
-        # model transposes its NCHW input once at the stem
-        with nn.layout_scope("NHWC"):
-            net = resnet_class(block_class, layers, channels, **kwargs)
-    else:
-        net = resnet_class(block_class, layers, channels, **kwargs)
+    net = resnet_class(block_class, layers, channels, **kwargs)
     if pretrained:
         from ..model_store import load_pretrained
         load_pretrained(net, f"resnet{num_layers}_v{version}", root, ctx)

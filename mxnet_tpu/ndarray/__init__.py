@@ -45,12 +45,21 @@ _UPDATE_OP_STATE_START = {
 }
 
 
-def _make_inplace_update(base, state_start):
+def _make_inplace_update(name, base, state_start):
+    from ..ops import registry as _opreg
+    state_names = _opreg.get(name).arg_names[state_start:]
+
     def wrapper(*args, out=None, **kwargs):
+        # states may arrive positionally or as keywords; resolve both
+        # so keyword callers don't silently lose the writeback
+        states = list(args[state_start:])
+        for n in state_names[len(states):]:
+            states.append(kwargs.get(n))
         res = base(*args, **kwargs)
         outs = list(res) if isinstance(res, (list, tuple)) else [res]
-        for s, v in zip(args[state_start:], outs[1:]):
-            s._data = v._data
+        for s, v in zip(states, outs[1:]):
+            if hasattr(s, "_data"):  # only NDArrays can reflect updates
+                s._data = v._data
         w = outs[0]
         if out is not None:
             out._data = w._data
@@ -62,7 +71,7 @@ def _make_inplace_update(base, state_start):
 
 
 for _name, _start in _UPDATE_OP_STATE_START.items():
-    globals()[_name] = _make_inplace_update(globals()[_name], _start)
+    globals()[_name] = _make_inplace_update(_name, globals()[_name], _start)
 del _name, _start
 
 

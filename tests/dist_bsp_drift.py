@@ -1,0 +1,52 @@
+"""BSP round-drift regression (run under tools/launch.py -n 2 -s 1).
+
+A fast worker opens round N+1 with its push before the slow worker has
+pulled round N's result. The slow worker's pull must be answered from
+the committed store immediately — queueing it behind the in-flight
+round deadlocks the job (the server can only complete that round after
+the slow worker pushes, which it can't do while blocked in its pull).
+The rank staggering below forces the drift deterministically.
+"""
+import sys
+import os
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+
+def main():
+    kv = mx.kvstore.create("dist_sync")
+    rank = kv.rank
+    V, D = 64, 4
+    kv.init(0, nd.array(np.zeros((V, 1), np.float32)))
+    kv.init(1, nd.array(np.zeros((V, D), np.float32)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0))
+    kv.barrier()
+    rng = np.random.default_rng(rank)
+    for step in range(6):
+        if rank == 1:
+            time.sleep(0.3)  # force this worker to lag a round behind
+        rows = np.unique(rng.integers(0, V, 16)).astype(np.int32)
+        o0 = RowSparseNDArray(nd.zeros((len(rows), 1)),
+                              nd.array(rows.astype(np.float32)), (V, 1))
+        kv.row_sparse_pull(0, out=o0,
+                           row_ids=nd.array(rows.astype(np.float32)))
+        full = nd.zeros((V, D))
+        kv.pull(1, out=full)
+        g0 = np.ones((len(rows), 1), np.float32)
+        kv.push(0, RowSparseNDArray(
+            nd.array(g0), nd.array(rows.astype(np.float32)), (V, 1)))
+        kv.push(1, nd.array(np.ones((V, D), np.float32)))
+    kv.barrier()
+    kv.close()
+    print(f"[worker {rank}] OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -137,3 +137,40 @@ def test_dist_device_sync_collective_no_server():
     assert proc.returncode == 0, "collective dist job failed"
     for i in range(4):
         assert f"[worker {i}] OK" in proc.stdout
+
+
+def test_dist_bsp_round_drift_no_deadlock():
+    """A lagging worker's pull for round N must not queue behind round
+    N+1 (deadlock-then-timeout under the old per-key round counting)."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["MXNET_KVSTORE_REQUEST_TIMEOUT_MS"] = "30000"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "-s", "1", sys.executable,
+         os.path.join(REPO, "tests", "dist_bsp_drift.py")],
+        env=env, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stdout[-1500:] + proc.stderr[-800:]
+    for i in range(2):
+        assert f"[worker {i}] OK" in proc.stdout
+
+
+def test_wide_deep_example_local_and_dist():
+    """BASELINE config 5: the wide_deep script converges locally and
+    runs distributed with server-side updates + row-granular pulls."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    script = os.path.join(REPO, "examples", "sparse", "wide_deep.py")
+    local = subprocess.run(
+        [sys.executable, script, "--steps", "80"], env=env,
+        capture_output=True, text=True, timeout=240)
+    assert local.returncode == 0, local.stdout[-1200:] + local.stderr[-800:]
+    assert "[worker 0] OK" in local.stdout
+    dist = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "-s", "1", sys.executable, script,
+         "--kvstore", "dist_sync", "--steps", "40"],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert dist.returncode == 0, dist.stdout[-1500:] + dist.stderr[-800:]
+    for i in range(2):
+        assert f"[worker {i}] OK" in dist.stdout

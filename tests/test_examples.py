@@ -93,3 +93,21 @@ def test_distributed_example_collective():
                 sys.executable, "examples/distributed/train_dist.py",
                 "--kv-store", "dist_device_sync"])
     assert out.count("OK") >= 2
+
+
+def test_ssd_train_eval_int8():
+    """BASELINE config 4: SSD trains on synthetic data to a real mAP and
+    survives INT8 quantization with bounded accuracy loss (the
+    reference's published INT8-SSD row, example/quantization/README.md)."""
+    out = _run([sys.executable, "train_ssd.py", "--steps", "60",
+                "--batch", "8", "--eval", "--int8"],
+               cwd=os.path.join(REPO, "examples/ssd"), timeout=560)
+    lines = [ln for ln in out.splitlines() if ln.startswith("mAP:")]
+    assert len(lines) == 2, out[-1500:]
+    fp32_map = float(lines[0].split()[-1])
+    int8_map = float(lines[1].split()[-1])
+    assert fp32_map > 0.3, out[-1500:]
+    assert int8_map > fp32_map - 0.3, (fp32_map, int8_map)
+    first, last = [float(x) for x in
+                   out.split("train: loss ")[1].split()[0:3:2]]
+    assert last < first
