@@ -1,0 +1,104 @@
+/*!
+ * Deployment-only C prediction ABI — signature-compatible with the
+ * reference's include/mxnet/c_predict_api.h (the amalgamation's
+ * embed-in-C++ seam). Backed by mxnet_tpu.predictor semantics: the
+ * shim hosts (or joins) a Python interpreter and drives the jitted
+ * XLA forward, so a C/C++ application links one .so and predicts.
+ *
+ * Build: the library is compiled on demand by
+ * mxnet_tpu._native.load_predict(); link against the produced
+ * libmxtpu_predict.so and a libpython of the matching version.
+ */
+#ifndef MXNET_TPU_C_PREDICT_API_H_
+#define MXNET_TPU_C_PREDICT_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+typedef void *NDListHandle;
+
+/*! \brief last error message on this thread (empty when none) */
+const char *MXGetLastError(void);
+
+/*!
+ * Create a predictor from a symbol JSON string and a parameter blob
+ * (either the reference's dmlc .params bytes or this framework's npz).
+ * dev_type: 1 cpu, 2 accelerator; input shapes are CSR-packed:
+ * shape of input i = input_shape_data[indptr[i] .. indptr[i+1]].
+ */
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out);
+
+/*! \brief Create with explicit output nodes (taps on internal layers) */
+int MXPredCreatePartialOut(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id,
+                           mx_uint num_input_nodes,
+                           const char **input_keys,
+                           const mx_uint *input_shape_indptr,
+                           const mx_uint *input_shape_data,
+                           mx_uint num_output_nodes,
+                           const char **output_keys,
+                           PredictorHandle *out);
+
+/*! \brief num_threads independent predictors sharing one model blob */
+int MXPredCreateMultiThread(const char *symbol_json_str,
+                            const void *param_bytes, int param_size,
+                            int dev_type, int dev_id,
+                            mx_uint num_input_nodes,
+                            const char **input_keys,
+                            const mx_uint *input_shape_indptr,
+                            const mx_uint *input_shape_data,
+                            int num_threads, PredictorHandle *out);
+
+/*! \brief re-declare input shapes; recompiles on next forward */
+int MXPredReshape(mx_uint num_input_nodes, const char **input_keys,
+                  const mx_uint *input_shape_indptr,
+                  const mx_uint *input_shape_data,
+                  PredictorHandle handle, PredictorHandle *out);
+
+/*! \brief shape of output `index` (pointers valid until next call) */
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim);
+
+/*! \brief copy `size` floats in as input `key` (row-major) */
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size);
+
+/*! \brief run the forward pass (compiles on first call) */
+int MXPredForward(PredictorHandle handle);
+
+/*! \brief stepped forward for parity; completes in one step here */
+int MXPredPartialForward(PredictorHandle handle, int step, int *step_left);
+
+/*! \brief copy `size` floats of output `index` out */
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size);
+
+/*! \brief free the predictor */
+int MXPredFree(PredictorHandle handle);
+
+/*! \brief load an NDArray list (e.g. mean image .nd file) from bytes */
+int MXNDListCreate(const char *nd_file_bytes, int nd_file_size,
+                   NDListHandle *out, mx_uint *out_length);
+
+/*! \brief borrow entry `index`: name + shape + data pointers stay valid
+ *  until the list is freed */
+int MXNDListGet(NDListHandle handle, mx_uint index, const char **out_key,
+                const mx_float **out_data, const mx_uint **out_shape,
+                mx_uint *out_ndim);
+
+/*! \brief free the list */
+int MXNDListFree(NDListHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+#endif  /* MXNET_TPU_C_PREDICT_API_H_ */

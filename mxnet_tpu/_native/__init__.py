@@ -211,3 +211,54 @@ def pooled_empty(shape, dtype="float32"):
     # memory still referenced by live views
     weakref.finalize(buf, lib.mxtpu_storage_free, ptr)
     return arr
+
+
+_predict_lib = None
+
+
+def load_predict():
+    """The MXPred* deployment ABI (predict.cc) — a C surface over the
+    Python/JAX predictor (include/mxnet_tpu/c_predict_api.h). Loaded
+    with RTLD_GLOBAL-free ctypes into this process the shim joins the
+    running interpreter; linked into a C++ binary it embeds one."""
+    global _predict_lib
+    if _predict_lib is not None:
+        return _predict_lib
+    import sysconfig
+    src = os.path.join(_HERE, "predict.cc")
+    out = os.path.join(_HERE, "libmxtpu_predict.so")
+    inc = sysconfig.get_paths()["include"]
+    _build(src, out, extra_flags=(f"-I{inc}",))
+    lib = ctypes.CDLL(out)
+    u = ctypes.c_uint
+    up = ctypes.POINTER(u)
+    fp = ctypes.POINTER(ctypes.c_float)
+    sp = ctypes.POINTER(ctypes.c_char_p)
+    vp = ctypes.c_void_p
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    lib.MXPredCreate.restype = ctypes.c_int
+    lib.MXPredCreate.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                                 ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                                 u, sp, up, up, ctypes.POINTER(vp)]
+    lib.MXPredCreatePartialOut.argtypes = \
+        lib.MXPredCreate.argtypes[:-1] + [u, sp, ctypes.POINTER(vp)]
+    lib.MXPredCreateMultiThread.argtypes = \
+        lib.MXPredCreate.argtypes[:-1] + [ctypes.c_int,
+                                          ctypes.POINTER(vp)]
+    lib.MXPredReshape.argtypes = [u, sp, up, up, vp, ctypes.POINTER(vp)]
+    lib.MXPredSetInput.argtypes = [vp, ctypes.c_char_p, fp, u]
+    lib.MXPredForward.argtypes = [vp]
+    lib.MXPredPartialForward.argtypes = [vp, ctypes.c_int,
+                                         ctypes.POINTER(ctypes.c_int)]
+    lib.MXPredGetOutputShape.argtypes = [vp, u, ctypes.POINTER(up),
+                                         ctypes.POINTER(u)]
+    lib.MXPredGetOutput.argtypes = [vp, u, fp, u]
+    lib.MXPredFree.argtypes = [vp]
+    lib.MXNDListCreate.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                   ctypes.POINTER(vp), ctypes.POINTER(u)]
+    lib.MXNDListGet.argtypes = [vp, u, ctypes.POINTER(ctypes.c_char_p),
+                                ctypes.POINTER(fp), ctypes.POINTER(up),
+                                ctypes.POINTER(u)]
+    lib.MXNDListFree.argtypes = [vp]
+    _predict_lib = lib
+    return lib

@@ -1,0 +1,412 @@
+// MXPred* deployment ABI (ref: include/mxnet/c_predict_api.h, impl
+// src/c_api/c_predict_api.cc) over the Python/JAX runtime.
+//
+// The reference's predict ABI fronts its C++ executor; this framework's
+// executor IS the jitted XLA program driven from Python, so the C seam
+// hosts (or joins) a CPython interpreter and forwards each call to
+// mxnet_tpu.predictor._CPredictor under the GIL. A C++ application gets
+// the same 13-function surface without knowing Python exists:
+//   - loaded into an existing Python process (ctypes tests): joins it.
+//   - linked into a plain C++ binary: Py_InitializeEx on first use.
+//
+// Build (done on demand by mxnet_tpu._native.load_predict()):
+//   g++ -O2 -shared -fPIC -pthread predict.cc -o libmxtpu_predict.so \
+//       $(python3-config --includes)
+// (symbols resolve from the host process's libpython, or link
+//  $(python3-config --embed --ldflags) for standalone embedding)
+
+#include <Python.h>
+
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+extern "C" int MXPredFree(void* handle);
+
+namespace {
+
+thread_local std::string g_last_error;
+
+struct Pred {
+  PyObject* obj;  // _CPredictor instance
+  // per-output shape storage: pointers returned by
+  // MXPredGetOutputShape stay valid for the handle's lifetime even
+  // when the caller collects several outputs before reading them
+  std::map<unsigned, std::vector<unsigned>> shape_bufs;
+};
+
+struct NDList {
+  PyObject* arrays;  // list of C-contiguous float32 numpy arrays
+  std::vector<std::string> keys;  // per-entry: c_str() stays valid
+  std::vector<std::vector<unsigned>> shapes;
+};
+
+// ensure the interpreter exists; returns a GIL state to restore
+PyGILState_STATE ensure_python() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // release the GIL acquired by initialization so Ensure() below
+    // (and other threads) can take it
+    PyEval_SaveThread();
+  }
+  return PyGILState_Ensure();
+}
+
+int fail_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  g_last_error = "unknown python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) g_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return -1;
+}
+
+PyObject* bridge_class() {
+  PyObject* mod = PyImport_ImportModule("mxnet_tpu.predictor");
+  if (mod == nullptr) return nullptr;
+  PyObject* cls = PyObject_GetAttrString(mod, "_CPredictor");
+  Py_DECREF(mod);
+  return cls;
+}
+
+PyObject* make_shape_args(unsigned num, const char** keys,
+                          const unsigned* indptr, const unsigned* data,
+                          PyObject** names_out) {
+  PyObject* names = PyList_New(num);
+  PyObject* shapes = PyList_New(num);
+  for (unsigned i = 0; i < num; ++i) {
+    PyList_SET_ITEM(names, i, PyUnicode_FromString(keys[i]));
+    unsigned lo = indptr[i], hi = indptr[i + 1];
+    PyObject* shp = PyTuple_New(hi - lo);
+    for (unsigned j = lo; j < hi; ++j)
+      PyTuple_SET_ITEM(shp, j - lo, PyLong_FromUnsignedLong(data[j]));
+    PyList_SET_ITEM(shapes, i, shp);
+  }
+  *names_out = names;
+  return shapes;
+}
+
+int create_impl(const char* json, const void* param_bytes, int param_size,
+                int dev_type, int dev_id, unsigned num_input,
+                const char** keys, const unsigned* indptr,
+                const unsigned* data, unsigned num_output,
+                const char** output_keys, void** out) {
+  PyGILState_STATE st = ensure_python();
+  int rc = -1;
+  PyObject *cls = nullptr, *names = nullptr, *shapes = nullptr,
+           *outputs = nullptr, *obj = nullptr, *blob = nullptr;
+  cls = bridge_class();
+  if (cls == nullptr) goto done;
+  blob = PyBytes_FromStringAndSize(static_cast<const char*>(param_bytes),
+                                   param_size);
+  shapes = make_shape_args(num_input, keys, indptr, data, &names);
+  outputs = PyList_New(num_output);
+  for (unsigned i = 0; i < num_output; ++i)
+    PyList_SET_ITEM(outputs, i, PyUnicode_FromString(output_keys[i]));
+  obj = PyObject_CallFunction(cls, "sOiiOOO", json, blob, dev_type,
+                              dev_id, names, shapes, outputs);
+  if (obj == nullptr) goto done;
+  {
+    Pred* p = new Pred();
+    p->obj = obj;
+    obj = nullptr;
+    *out = p;
+  }
+  rc = 0;
+done:
+  if (rc != 0) rc = fail_from_python();
+  Py_XDECREF(cls);
+  Py_XDECREF(blob);
+  Py_XDECREF(names);
+  Py_XDECREF(shapes);
+  Py_XDECREF(outputs);
+  Py_XDECREF(obj);
+  PyGILState_Release(st);
+  return rc;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* MXGetLastError() { return g_last_error.c_str(); }
+
+int MXPredCreate(const char* json, const void* param_bytes, int param_size,
+                 int dev_type, int dev_id, unsigned num_input,
+                 const char** keys, const unsigned* indptr,
+                 const unsigned* data, void** out) {
+  return create_impl(json, param_bytes, param_size, dev_type, dev_id,
+                     num_input, keys, indptr, data, 0, nullptr, out);
+}
+
+int MXPredCreatePartialOut(const char* json, const void* param_bytes,
+                           int param_size, int dev_type, int dev_id,
+                           unsigned num_input, const char** keys,
+                           const unsigned* indptr, const unsigned* data,
+                           unsigned num_output, const char** output_keys,
+                           void** out) {
+  return create_impl(json, param_bytes, param_size, dev_type, dev_id,
+                     num_input, keys, indptr, data, num_output,
+                     output_keys, out);
+}
+
+int MXPredCreateMultiThread(const char* json, const void* param_bytes,
+                            int param_size, int dev_type, int dev_id,
+                            unsigned num_input, const char** keys,
+                            const unsigned* indptr, const unsigned* data,
+                            int num_threads, void** out) {
+  for (int t = 0; t < num_threads; ++t) {
+    int rc = create_impl(json, param_bytes, param_size, dev_type, dev_id,
+                         num_input, keys, indptr, data, 0, nullptr,
+                         &out[t]);
+    if (rc != 0) {
+      for (int u = 0; u < t; ++u) {
+        MXPredFree(out[u]);  // decrefs the bridge object under the GIL
+        out[u] = nullptr;
+      }
+      return rc;
+    }
+  }
+  return 0;
+}
+
+int MXPredReshape(unsigned num_input, const char** keys,
+                  const unsigned* indptr, const unsigned* data,
+                  void* handle, void** out) {
+  PyGILState_STATE st = ensure_python();
+  Pred* p = static_cast<Pred*>(handle);
+  PyObject* names = nullptr;
+  PyObject* shapes = make_shape_args(num_input, keys, indptr, data, &names);
+  PyObject* r = PyObject_CallMethod(p->obj, "reshape", "OO", names, shapes);
+  Py_DECREF(names);
+  Py_DECREF(shapes);
+  int rc = 0;
+  if (r == nullptr) {
+    rc = fail_from_python();
+  } else {
+    Py_DECREF(r);
+    // reference semantics return a NEW handle sharing weights; the
+    // bridge reshapes in place, so the new handle wraps the same obj
+    Pred* q = new Pred();
+    Py_INCREF(p->obj);
+    q->obj = p->obj;
+    *out = q;
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXPredSetInput(void* handle, const char* key, const float* data,
+                   unsigned size) {
+  PyGILState_STATE st = ensure_python();
+  Pred* p = static_cast<Pred*>(handle);
+  PyObject* mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char*>(const_cast<float*>(data)),
+      static_cast<Py_ssize_t>(size) * 4, PyBUF_READ);
+  PyObject* np = PyImport_ImportModule("numpy");
+  int rc = 0;
+  PyObject *arr = nullptr, *r = nullptr;
+  if (mv == nullptr || np == nullptr) {
+    rc = fail_from_python();
+  } else {
+    arr = PyObject_CallMethod(np, "frombuffer", "Os", mv, "float32");
+    if (arr == nullptr) {
+      rc = fail_from_python();
+    } else {
+      r = PyObject_CallMethod(p->obj, "set_input", "sO", key, arr);
+      if (r == nullptr) rc = fail_from_python();
+    }
+  }
+  Py_XDECREF(r);
+  Py_XDECREF(arr);
+  Py_XDECREF(np);
+  Py_XDECREF(mv);
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXPredForward(void* handle) {
+  PyGILState_STATE st = ensure_python();
+  Pred* p = static_cast<Pred*>(handle);
+  PyObject* r = PyObject_CallMethod(p->obj, "forward", nullptr);
+  int rc = (r == nullptr) ? fail_from_python() : 0;
+  Py_XDECREF(r);
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXPredPartialForward(void* handle, int step, int* step_left) {
+  int rc = MXPredForward(handle);
+  if (step_left != nullptr) *step_left = 0;
+  (void)step;
+  return rc;
+}
+
+int MXPredGetOutputShape(void* handle, unsigned index,
+                         unsigned** shape_data, unsigned* shape_ndim) {
+  PyGILState_STATE st = ensure_python();
+  Pred* p = static_cast<Pred*>(handle);
+  PyObject* shp = PyObject_CallMethod(p->obj, "output_shape", "I", index);
+  int rc = 0;
+  if (shp == nullptr) {
+    rc = fail_from_python();
+  } else {
+    Py_ssize_t n = PyTuple_Size(shp);
+    std::vector<unsigned>& buf = p->shape_bufs[index];
+    buf.resize(static_cast<size_t>(n));
+    for (Py_ssize_t i = 0; i < n; ++i)
+      buf[static_cast<size_t>(i)] = static_cast<unsigned>(
+          PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shp, i)));
+    *shape_data = buf.data();
+    *shape_ndim = static_cast<unsigned>(n);
+    Py_DECREF(shp);
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXPredGetOutput(void* handle, unsigned index, float* data,
+                    unsigned size) {
+  PyGILState_STATE st = ensure_python();
+  Pred* p = static_cast<Pred*>(handle);
+  PyObject* arr = PyObject_CallMethod(p->obj, "output", "I", index);
+  int rc = 0;
+  if (arr == nullptr) {
+    rc = fail_from_python();
+  } else {
+    Py_buffer view;
+    if (PyObject_GetBuffer(arr, &view, PyBUF_C_CONTIGUOUS) != 0) {
+      rc = fail_from_python();
+    } else {
+      size_t want = static_cast<size_t>(size) * 4;
+      if (static_cast<size_t>(view.len) != want) {
+        g_last_error = "MXPredGetOutput: size mismatch (got " +
+                       std::to_string(view.len / 4) + " elements, asked " +
+                       std::to_string(size) + ")";
+        rc = -1;
+      } else {
+        std::memcpy(data, view.buf, want);
+      }
+      PyBuffer_Release(&view);
+    }
+    Py_DECREF(arr);
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXPredFree(void* handle) {
+  if (handle == nullptr) return 0;
+  PyGILState_STATE st = ensure_python();
+  Pred* p = static_cast<Pred*>(handle);
+  Py_XDECREF(p->obj);
+  delete p;
+  PyGILState_Release(st);
+  return 0;
+}
+
+int MXNDListCreate(const char* nd_file_bytes, int nd_file_size, void** out,
+                   unsigned* out_length) {
+  PyGILState_STATE st = ensure_python();
+  int rc = -1;
+  PyObject *mod = nullptr, *blob = nullptr, *d = nullptr, *np = nullptr;
+  NDList* lst = nullptr;
+  mod = PyImport_ImportModule("mxnet_tpu.ndarray.utils");
+  np = PyImport_ImportModule("numpy");
+  if (mod == nullptr || np == nullptr) goto done;
+  blob = PyBytes_FromStringAndSize(nd_file_bytes, nd_file_size);
+  d = PyObject_CallMethod(mod, "load_frombuffer", "O", blob);
+  if (d == nullptr) goto done;
+  lst = new NDList();
+  lst->arrays = PyList_New(0);
+  {
+    PyObject *key = nullptr, *val = nullptr;
+    Py_ssize_t pos = 0;
+    while (PyDict_Next(d, &pos, &key, &val)) {
+      PyObject* nd = PyObject_GetAttrString(val, "asnumpy");
+      PyObject* raw = nd ? PyObject_CallObject(nd, nullptr) : nullptr;
+      Py_XDECREF(nd);
+      if (raw == nullptr) goto done;
+      PyObject* f32 = PyObject_CallMethod(
+          np, "ascontiguousarray", "Os", raw, "float32");
+      Py_DECREF(raw);
+      if (f32 == nullptr) goto done;
+      const char* kc = PyUnicode_AsUTF8(key);
+      lst->keys.emplace_back(kc != nullptr ? kc : "");
+      PyList_Append(lst->arrays, f32);
+      PyObject* shp = PyObject_GetAttrString(f32, "shape");
+      std::vector<unsigned> dims;
+      for (Py_ssize_t i = 0; i < PyTuple_Size(shp); ++i)
+        dims.push_back(static_cast<unsigned>(
+            PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shp, i))));
+      lst->shapes.push_back(dims);
+      Py_DECREF(shp);
+      Py_DECREF(f32);
+    }
+  }
+  *out = lst;
+  *out_length = static_cast<unsigned>(lst->keys.size());
+  lst = nullptr;
+  rc = 0;
+done:
+  if (rc != 0) rc = fail_from_python();
+  if (lst != nullptr) {
+    Py_XDECREF(lst->arrays);
+    delete lst;
+  }
+  Py_XDECREF(d);
+  Py_XDECREF(blob);
+  Py_XDECREF(np);
+  Py_XDECREF(mod);
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXNDListGet(void* handle, unsigned index, const char** out_key,
+                const float** out_data, const unsigned** out_shape,
+                unsigned* out_ndim) {
+  PyGILState_STATE st = ensure_python();
+  NDList* lst = static_cast<NDList*>(handle);
+  int rc = 0;
+  if (index >= lst->shapes.size()) {
+    g_last_error = "MXNDListGet: index out of range";
+    rc = -1;
+  } else {
+    PyObject* arr = PyList_GET_ITEM(lst->arrays, index);   // borrowed
+    *out_key = lst->keys[index].c_str();
+    Py_buffer view;
+    if (PyObject_GetBuffer(arr, &view, PyBUF_C_CONTIGUOUS) != 0) {
+      rc = fail_from_python();
+    } else {
+      // the list holds a reference to arr, so the pointer stays valid
+      *out_data = static_cast<const float*>(view.buf);
+      PyBuffer_Release(&view);
+      *out_shape = lst->shapes[index].data();
+      *out_ndim = static_cast<unsigned>(lst->shapes[index].size());
+    }
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXNDListFree(void* handle) {
+  if (handle == nullptr) return 0;
+  PyGILState_STATE st = ensure_python();
+  NDList* lst = static_cast<NDList*>(handle);
+  Py_XDECREF(lst->arrays);
+  delete lst;
+  PyGILState_Release(st);
+  return 0;
+}
+
+}  // extern "C"

@@ -17,7 +17,7 @@ from .ndarray import NDArray, array, concatenate, invoke
 from .register import populate
 from . import random  # noqa: F401
 from . import contrib  # noqa: F401
-from .utils import save, load
+from .utils import save, load, load_frombuffer
 
 
 def Custom(*inputs, op_type=None, **kwargs):

@@ -27,10 +27,35 @@ def save(fname, data):
         np.savez(f, **payload)
 
 
+def _from_npz(npz):
+    keys = list(npz.keys())
+    if keys and all(k.startswith(_LIST_PREFIX) for k in keys):
+        keys.sort(key=lambda k: int(k[len(_LIST_PREFIX):]))
+        return [array(npz[k]) for k in keys]
+    return {k: array(npz[k]) for k in keys}
+
+
 def load(fname):
+    with open(fname, "rb") as f:
+        head = f.read(8)
+    from .ref_serde import is_reference_format
+    if is_reference_format(head):
+        # reference-format .params checkpoints load transparently
+        with open(fname, "rb") as f:
+            return load_frombuffer(f.read())
     with np.load(fname, allow_pickle=False) as npz:
-        keys = list(npz.keys())
-        if keys and all(k.startswith(_LIST_PREFIX) for k in keys):
-            keys.sort(key=lambda k: int(k[len(_LIST_PREFIX):]))
-            return [array(npz[k]) for k in keys]
-        return {k: array(npz[k]) for k in keys}
+        return _from_npz(npz)
+
+
+def load_frombuffer(buf):
+    """Deserialize an NDArray dict/list from in-memory bytes (ref:
+    python/mxnet/ndarray/utils.py load_frombuffer / MXNDArrayLoad
+    FromBuffer). Accepts both this framework's npz container and the
+    reference's dmlc byte format (ndarray/ref_serde.py)."""
+    import io as _io
+
+    from .ref_serde import is_reference_format, load_reference_buffer
+    if is_reference_format(buf):
+        return {k: array(v) for k, v in load_reference_buffer(buf).items()}
+    with np.load(_io.BytesIO(buf), allow_pickle=False) as npz:
+        return _from_npz(npz)

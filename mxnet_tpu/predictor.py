@@ -115,3 +115,98 @@ class Predictor:
         self._shapes.update(new_input_shapes)
         self._jitted = None
         return self
+
+
+class _CPredictor:
+    """Bridge object behind the MXPred* C ABI (_native/predict.cc):
+    one instance per PredictorHandle; the C side calls these methods
+    under the GIL. Mirrors c_predict_api.h semantics: declared input
+    shapes, set_input copies, forward compiles-and-runs, outputs are
+    fetched as flat fp32."""
+
+    # reference dev_type codes (c_predict_api.h: 1 cpu, 2 gpu) — the
+    # accelerator code maps to this framework's chip backend
+    _DEV = {1: "cpu", 2: "tpu"}
+
+    def __init__(self, symbol_json, param_bytes, dev_type, dev_id,
+                 input_names, input_shapes, output_names=()):
+        from . import symbol as sym_mod
+        from .ndarray.utils import load_frombuffer
+        from .symbol.symbol import is_aux_name
+
+        sym = sym_mod.load_json(symbol_json)
+        if output_names:
+            internals = sym.get_internals()
+            names = internals.list_outputs()
+            outs = []
+            for name in output_names:
+                cand = name if name in names else name + "_output"
+                if cand not in names:
+                    raise MXNetError(
+                        f"MXPredCreatePartialOut: {name} not in graph")
+                outs.append(internals[cand])
+            sym = sym_mod.Group(outs)
+        loaded = load_frombuffer(param_bytes)
+        arg_params, aux_params = {}, {}
+        for k, v in loaded.items():
+            if k.startswith("aux:"):
+                aux_params[k[4:]] = v
+            elif k.startswith("arg:"):
+                arg_params[k[4:]] = v
+            else:
+                (aux_params if is_aux_name(k) else arg_params)[k] = v
+        dev = self._DEV.get(int(dev_type)) if dev_type else None
+        try:
+            self._pred = Predictor(sym, arg_params, aux_params,
+                                   dict(zip(input_names, input_shapes)),
+                                   dev_type=dev, dev_id=int(dev_id))
+        except MXNetError:
+            if dev != "cpu":
+                raise
+            # cpu requested but jax only exposes the chip backend: the
+            # default device is the deployment target anyway
+            self._pred = Predictor(sym, arg_params, aux_params,
+                                   dict(zip(input_names, input_shapes)))
+        self._inputs = {}
+        self._outputs = None
+
+    def set_input(self, key, flat):
+        if key not in self._pred._shapes:
+            raise MXNetError(f"MXPredSetInput: unknown input {key!r}")
+        shape = tuple(self._pred._shapes[key])
+        # copy: the C caller's buffer is only valid during the call
+        arr = np.array(flat, np.float32, copy=True)
+        if arr.size != int(np.prod(shape)):
+            raise MXNetError(
+                f"MXPredSetInput: {key} got {arr.size} elements, "
+                f"shape {shape} needs {int(np.prod(shape))}")
+        self._inputs[key] = arr.reshape(shape)
+        self._outputs = None
+
+    def forward(self):
+        missing = [k for k in self._pred._shapes if k not in self._inputs]
+        if missing:
+            raise MXNetError(f"MXPredForward: inputs not set: {missing}")
+        self._outputs = [np.asarray(o, np.float32)
+                         for o in self._pred.forward(**self._inputs)]
+
+    def reshape(self, input_names, input_shapes):
+        self._pred.reshape(dict(zip(input_names, input_shapes)))
+        self._inputs.clear()
+        self._outputs = None
+
+    def num_outputs(self):
+        self._ensure()
+        return len(self._outputs)
+
+    def output_shape(self, index):
+        self._ensure()
+        return tuple(self._outputs[index].shape)
+
+    def output(self, index):
+        self._ensure()
+        return np.ascontiguousarray(self._outputs[index], np.float32)
+
+    def _ensure(self):
+        if self._outputs is None:
+            raise MXNetError("MXPredGetOutput: call MXPredForward first")

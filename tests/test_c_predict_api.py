@@ -1,0 +1,183 @@
+"""MXPred* C deployment ABI (VERDICT r3 #10): the 13-function surface
+of the reference's c_predict_api.h driven through ctypes, fed a
+reference-byte-format param blob."""
+import ctypes
+import os
+
+import numpy as np
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu._native import load_predict
+from mxnet_tpu.ndarray.ref_serde import save_reference_buffer
+
+u = ctypes.c_uint
+
+
+def _model(tmp_path):
+    """Tiny 2-layer net: returns (symbol_json, param_blob, ref_fn)."""
+    data = sym.var("data")
+    h = sym.FullyConnected(data, sym.var("fc1_weight"),
+                           sym.var("fc1_bias"), num_hidden=5, name="fc1")
+    a = sym.Activation(h, act_type="relu", name="act1")
+    out = sym.FullyConnected(a, sym.var("fc2_weight"),
+                             sym.var("fc2_bias"), num_hidden=3, name="fc2")
+    rng = np.random.default_rng(0)
+    params = {
+        "arg:fc1_weight": rng.normal(size=(5, 4)).astype(np.float32),
+        "arg:fc1_bias": rng.normal(size=(5,)).astype(np.float32),
+        "arg:fc2_weight": rng.normal(size=(3, 5)).astype(np.float32),
+        "arg:fc2_bias": rng.normal(size=(3,)).astype(np.float32),
+    }
+    blob = save_reference_buffer(params)
+    js = out.tojson() if hasattr(out, "tojson") else None
+    if js is None:
+        p = str(tmp_path / "m-symbol.json")
+        out.save(p)
+        with open(p) as f:
+            js = f.read()
+
+    def ref_fn(x):
+        w1, b1 = params["arg:fc1_weight"], params["arg:fc1_bias"]
+        w2, b2 = params["arg:fc2_weight"], params["arg:fc2_bias"]
+        h = np.maximum(x @ w1.T + b1, 0)
+        return h @ w2.T + b2
+
+    return js, blob, ref_fn
+
+
+def _create(lib, js, blob, shape, partial=None):
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (u * 2)(0, len(shape))
+    sdata = (u * len(shape))(*shape)
+    handle = ctypes.c_void_p()
+    if partial is None:
+        rc = lib.MXPredCreate(js.encode(), blob, len(blob), 1, 0, 1,
+                              keys, indptr, sdata,
+                              ctypes.byref(handle))
+    else:
+        outs = (ctypes.c_char_p * len(partial))(
+            *[p.encode() for p in partial])
+        rc = lib.MXPredCreatePartialOut(
+            js.encode(), blob, len(blob), 1, 0, 1, keys, indptr, sdata,
+            len(partial), outs, ctypes.byref(handle))
+    assert rc == 0, lib.MXGetLastError().decode()
+    return handle
+
+
+def test_predict_full_cycle(tmp_path):
+    lib = load_predict()
+    js, blob, ref_fn = _model(tmp_path)
+    x = np.random.default_rng(1).normal(size=(2, 4)).astype(np.float32)
+
+    h = _create(lib, js, blob, (2, 4))
+    flat = np.ascontiguousarray(x.ravel())
+    rc = lib.MXPredSetInput(
+        h, b"data", flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        flat.size)
+    assert rc == 0, lib.MXGetLastError().decode()
+    assert lib.MXPredForward(h) == 0, lib.MXGetLastError().decode()
+
+    shp = ctypes.POINTER(u)()
+    ndim = u()
+    assert lib.MXPredGetOutputShape(h, 0, ctypes.byref(shp),
+                                    ctypes.byref(ndim)) == 0
+    shape = tuple(shp[i] for i in range(ndim.value))
+    assert shape == (2, 3)
+    out = np.zeros(6, np.float32)
+    assert lib.MXPredGetOutput(
+        h, 0, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        6) == 0, lib.MXGetLastError().decode()
+    np.testing.assert_allclose(out.reshape(2, 3), ref_fn(x), rtol=1e-4,
+                               atol=1e-5)
+
+    # wrong-size fetch errors cleanly
+    assert lib.MXPredGetOutput(
+        h, 0, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), 5) != 0
+    assert b"size mismatch" in lib.MXGetLastError()
+
+    # stepped variant completes in one step
+    left = ctypes.c_int(7)
+    assert lib.MXPredPartialForward(h, 0, ctypes.byref(left)) == 0
+    assert left.value == 0
+    assert lib.MXPredFree(h) == 0
+
+
+def test_predict_reshape_and_partial_out(tmp_path):
+    lib = load_predict()
+    js, blob, ref_fn = _model(tmp_path)
+
+    h = _create(lib, js, blob, (2, 4))
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (u * 2)(0, 2)
+    sdata = (u * 2)(3, 4)
+    h2 = ctypes.c_void_p()
+    assert lib.MXPredReshape(1, keys, indptr, sdata, h,
+                             ctypes.byref(h2)) == 0, \
+        lib.MXGetLastError().decode()
+    x = np.random.default_rng(2).normal(size=(3, 4)).astype(np.float32)
+    flat = np.ascontiguousarray(x.ravel())
+    assert lib.MXPredSetInput(
+        h2, b"data", flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        flat.size) == 0
+    assert lib.MXPredForward(h2) == 0, lib.MXGetLastError().decode()
+    out = np.zeros(9, np.float32)
+    assert lib.MXPredGetOutput(
+        h2, 0, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), 9) == 0
+    np.testing.assert_allclose(out.reshape(3, 3), ref_fn(x), rtol=1e-4,
+                               atol=1e-5)
+    lib.MXPredFree(h2)
+    lib.MXPredFree(h)
+
+    # partial-out: tap the hidden relu
+    hp = _create(lib, js, blob, (2, 4), partial=["act1_output"])
+    x2 = np.random.default_rng(3).normal(size=(2, 4)).astype(np.float32)
+    flat2 = np.ascontiguousarray(x2.ravel())
+    assert lib.MXPredSetInput(
+        hp, b"data",
+        flat2.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        flat2.size) == 0
+    assert lib.MXPredForward(hp) == 0, lib.MXGetLastError().decode()
+    shp = ctypes.POINTER(u)()
+    ndim = u()
+    assert lib.MXPredGetOutputShape(hp, 0, ctypes.byref(shp),
+                                    ctypes.byref(ndim)) == 0
+    assert tuple(shp[i] for i in range(ndim.value)) == (2, 5)
+    lib.MXPredFree(hp)
+
+
+def test_ndlist_over_reference_bytes():
+    lib = load_predict()
+    blob = save_reference_buffer({
+        "mean_img": np.arange(12, dtype=np.float32).reshape(3, 4)})
+    handle = ctypes.c_void_p()
+    length = u()
+    assert lib.MXNDListCreate(blob, len(blob), ctypes.byref(handle),
+                              ctypes.byref(length)) == 0, \
+        lib.MXGetLastError().decode()
+    assert length.value == 1
+    key = ctypes.c_char_p()
+    data = ctypes.POINTER(ctypes.c_float)()
+    shp = ctypes.POINTER(u)()
+    ndim = u()
+    assert lib.MXNDListGet(handle, 0, ctypes.byref(key),
+                           ctypes.byref(data), ctypes.byref(shp),
+                           ctypes.byref(ndim)) == 0
+    assert key.value == b"mean_img"
+    assert tuple(shp[i] for i in range(ndim.value)) == (3, 4)
+    got = np.array([data[i] for i in range(12)])
+    np.testing.assert_array_equal(got, np.arange(12, dtype=np.float32))
+    assert lib.MXNDListFree(handle) == 0
+
+
+def test_create_error_reporting(tmp_path):
+    lib = load_predict()
+    handle = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (u * 2)(0, 2)
+    sdata = (u * 2)(1, 4)
+    rc = lib.MXPredCreate(b"{not json", b"xx", 2, 1, 0, 1, keys, indptr,
+                          sdata, ctypes.byref(handle))
+    assert rc != 0
+    assert lib.MXGetLastError() != b""

@@ -136,3 +136,28 @@ def test_augmentation_and_errors(recfile):
                                 preprocess_threads=2)
     with pytest.raises(Exception, match="smaller than data_shape"):
         next(iter(bad))
+
+
+def test_host_engine_pipeline_matches_thread_fallback(recfile, monkeypatch):
+    """The host-engine pipeline (read/decode/emit as dependency-engine
+    ops, VERDICT r3 #6) must produce the identical ordered batch stream
+    as the plain thread producer."""
+    streams = {}
+    for flag in ("1", "0"):
+        monkeypatch.setenv("MXTPU_IO_HOST_ENGINE", flag)
+        it = mx.io.ImageRecordIter(path_imgrec=recfile,
+                                   data_shape=(3, 32, 32), batch_size=100,
+                                   preprocess_threads=2)
+        assert it._use_engine == (flag == "1")
+        batches = [(b.data[0].asnumpy(), b.label[0].asnumpy())
+                   for b in it]
+        # second epoch works too (ring vars are reused)
+        it.reset()
+        batches += [(b.data[0].asnumpy(), b.label[0].asnumpy())
+                    for b in it]
+        it.close()
+        streams[flag] = batches
+    assert len(streams["1"]) == len(streams["0"]) == 20
+    for (d1, l1), (d0, l0) in zip(streams["1"], streams["0"]):
+        np.testing.assert_array_equal(l1, l0)
+        np.testing.assert_allclose(d1, d0, atol=1e-5)

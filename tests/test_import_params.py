@@ -1,0 +1,117 @@
+"""Reference .params byte-format converter (VERDICT r3 #9): no egress,
+so the round-trip is against locally generated reference-format bytes
+whose layout follows src/ndarray/ndarray.cc:1574/1776 exactly."""
+import os
+import struct
+import sys
+
+import numpy as np
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.block import infer_shapes
+from mxnet_tpu.gluon.model_zoo import vision
+from mxnet_tpu.ndarray.ndarray import NDArray
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from import_params import (ND_MAGIC_V2, import_into,  # noqa: E402
+                           load_reference_params, save_reference_params)
+
+
+def test_byte_format_is_the_reference_layout(tmp_path):
+    """Hand-assemble a file following ndarray.cc's writer and read it."""
+    arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+    buf = struct.pack("<QQ", 0x112, 0)
+    buf += struct.pack("<Q", 1)                       # ndarray count
+    buf += struct.pack("<Ii", ND_MAGIC_V2, 0)         # magic, dense
+    buf += struct.pack("<I2I", 2, 2, 3)               # TShape
+    buf += struct.pack("<ii", 1, 0)                   # Context cpu:0
+    buf += struct.pack("<i", 0)                       # kFloat32
+    buf += arr.tobytes()
+    buf += struct.pack("<Q", 1)                       # key count
+    buf += struct.pack("<Q", len(b"arg:w")) + b"arg:w"
+    p = tmp_path / "ref.params"
+    p.write_bytes(buf)
+    loaded = load_reference_params(str(p))
+    assert list(loaded) == ["arg:w"]
+    np.testing.assert_array_equal(loaded["arg:w"], arr)
+
+
+def test_row_sparse_and_fp16_arrays(tmp_path):
+    """Writer/reader round-trip plus a hand-built row_sparse entry."""
+    dense = {"a": np.random.default_rng(0).normal(
+        size=(4, 5)).astype(np.float16)}
+    f = tmp_path / "rt.params"
+    save_reference_params(str(f), dense)
+    back = load_reference_params(str(f))
+    np.testing.assert_array_equal(back["a"], dense["a"])
+
+    # row_sparse: stype=1, storage shape (2,3), full shape (5,3),
+    # aux idx int64 rows [1, 4]
+    vals = np.arange(6, dtype=np.float32).reshape(2, 3)
+    idx = np.array([1, 4], np.int64)
+    buf = struct.pack("<QQ", 0x112, 0) + struct.pack("<Q", 1)
+    buf += struct.pack("<Ii", ND_MAGIC_V2, 1)
+    buf += struct.pack("<I2I", 2, 2, 3)               # storage shape
+    buf += struct.pack("<I2I", 2, 5, 3)               # logical shape
+    buf += struct.pack("<ii", 1, 0)
+    buf += struct.pack("<i", 0)                       # values f32
+    buf += struct.pack("<i", 6)                       # aux idx int64
+    buf += struct.pack("<I1I", 1, 2)                  # aux shape (2,)
+    buf += vals.tobytes() + idx.tobytes()
+    buf += struct.pack("<Q", 1)
+    buf += struct.pack("<Q", 3) + b"rsp"
+    p = tmp_path / "rsp.params"
+    p.write_bytes(buf)
+    out = load_reference_params(str(p))["rsp"]
+    want = np.zeros((5, 3), np.float32)
+    want[[1, 4]] = vals
+    np.testing.assert_array_equal(out, want)
+
+
+def test_zoo_roundtrip_through_reference_format(tmp_path):
+    """resnet18 weights survive export-to-reference-format + import,
+    reproducing identical outputs (the pretrained-checkpoint path)."""
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((1, 3, 32, 32)).astype(np.float32)
+
+    src = vision.resnet18_v1()
+    src.initialize()
+    infer_shapes(src, (1, 3, 32, 32))
+    ref_out = src(NDArray(jnp.asarray(x))).asnumpy()
+
+    # export with Module-style arg:/aux: prefixed flat names
+    payload = {}
+    aux_like = ("running_mean", "running_var")
+    for name, p in src.collect_params().items():
+        prefix = "aux" if name.endswith(aux_like) else "arg"
+        payload[f"{prefix}:{name}"] = p.data().asnumpy()
+    f = tmp_path / "resnet18-0000.params"
+    save_reference_params(str(f), payload)
+
+    dst = vision.resnet18_v1()
+    dst.initialize()
+    infer_shapes(dst, (1, 3, 32, 32))
+    matched = import_into(dst, str(f))
+    assert len(matched) == len(payload)
+    new_out = dst(NDArray(jnp.asarray(x))).asnumpy()
+    np.testing.assert_allclose(new_out, ref_out, rtol=1e-5, atol=1e-5)
+
+
+def test_cli_conversion(tmp_path):
+    import subprocess
+    payload = {"arg:w": np.ones((2, 2), np.float32)}
+    src = tmp_path / "in.params"
+    save_reference_params(str(src), payload)
+    dst = tmp_path / "out.params"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "import_params.py"),
+         str(src), str(dst)],
+        capture_output=True, text=True,
+        env=dict(os.environ, PYTHONPATH=REPO), timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    from mxnet_tpu import nd
+    out = nd.load(str(dst))
+    np.testing.assert_array_equal(out["arg:w"].asnumpy(), 1.0)
