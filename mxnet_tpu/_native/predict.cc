@@ -187,19 +187,18 @@ int MXPredReshape(unsigned num_input, const char** keys,
   Pred* p = static_cast<Pred*>(handle);
   PyObject* names = nullptr;
   PyObject* shapes = make_shape_args(num_input, keys, indptr, data, &names);
-  PyObject* r = PyObject_CallMethod(p->obj, "reshape", "OO", names, shapes);
+  // reference semantics: a NEW handle at the new shapes sharing
+  // weights; the original handle keeps serving its old shapes
+  PyObject* r = PyObject_CallMethod(p->obj, "reshaped", "OO", names,
+                                    shapes);
   Py_DECREF(names);
   Py_DECREF(shapes);
   int rc = 0;
   if (r == nullptr) {
     rc = fail_from_python();
   } else {
-    Py_DECREF(r);
-    // reference semantics return a NEW handle sharing weights; the
-    // bridge reshapes in place, so the new handle wraps the same obj
     Pred* q = new Pred();
-    Py_INCREF(p->obj);
-    q->obj = p->obj;
+    q->obj = r;  // owned
     *out = q;
   }
   PyGILState_Release(st);
@@ -327,6 +326,22 @@ int MXNDListCreate(const char* nd_file_bytes, int nd_file_size, void** out,
   blob = PyBytes_FromStringAndSize(nd_file_bytes, nd_file_size);
   d = PyObject_CallMethod(mod, "load_frombuffer", "O", blob);
   if (d == nullptr) goto done;
+  if (PyList_Check(d)) {
+    // list container: synthesize positional names so entries survive
+    // (PyDict_Next on a list would silently yield nothing)
+    PyObject* as_dict = PyDict_New();
+    for (Py_ssize_t i = 0; i < PyList_Size(d); ++i) {
+      PyObject* k = PyUnicode_FromFormat("ndarray_%zd", i);
+      PyDict_SetItem(as_dict, k, PyList_GET_ITEM(d, i));
+      Py_DECREF(k);
+    }
+    Py_DECREF(d);
+    d = as_dict;
+  } else if (!PyDict_Check(d)) {
+    PyErr_SetString(PyExc_TypeError,
+                    "MXNDListCreate: unsupported container");
+    goto done;
+  }
   lst = new NDList();
   lst->arrays = PyList_New(0);
   {

@@ -3,6 +3,7 @@ from __future__ import annotations
 
 from ...block import HybridBlock
 from ... import nn
+from .resnet import _layout_build_scope
 
 
 def _add_conv(out, channels=1, kernel=1, stride=1, pad=0, num_group=1,
@@ -44,9 +45,11 @@ class LinearBottleneck(HybridBlock):
 
 
 class MobileNet(HybridBlock):
-    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
+    def __init__(self, multiplier=1.0, classes=1000, layout="NCHW",
+                 **kwargs):
         super().__init__(**kwargs)
-        with self.name_scope():
+        self._data_layout = layout
+        with _layout_build_scope(layout), self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             _add_conv(self.features, int(32 * multiplier), kernel=3,
                       stride=2, pad=1)
@@ -62,14 +65,18 @@ class MobileNet(HybridBlock):
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
+        if self._data_layout == "NHWC":
+            x = F.transpose(x, axes=(0, 2, 3, 1))
         x = self.features(x)
         return self.output(x)
 
 
 class MobileNetV2(HybridBlock):
-    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
+    def __init__(self, multiplier=1.0, classes=1000, layout="NCHW",
+                 **kwargs):
         super().__init__(**kwargs)
-        with self.name_scope():
+        self._data_layout = layout
+        with _layout_build_scope(layout), self.name_scope():
             self.features = nn.HybridSequential(prefix="features_")
             with self.features.name_scope():
                 _add_conv(self.features, int(32 * multiplier), kernel=3,
@@ -97,6 +104,8 @@ class MobileNetV2(HybridBlock):
                     nn.Flatten())
 
     def hybrid_forward(self, F, x):
+        if self._data_layout == "NHWC":
+            x = F.transpose(x, axes=(0, 2, 3, 1))
         x = self.features(x)
         return self.output(x)
 

@@ -71,6 +71,13 @@ class Trainer:
             for i, p in enumerate(self._params):
                 if p._data is not None:
                     self._kvstore.init(i, p.data())
+                    if not self._update_on_kvstore:
+                        # worker-side updates never pull params from the
+                        # store afterwards, so the init broadcast (rank
+                        # 0's values) must land here or replicas diverge
+                        # from their own random inits (ref: trainer.py
+                        # _init_params pulls after init)
+                        self._kvstore.pull(i, out=p.data())
         self._kv_initialized = True
 
     def step(self, batch_size, ignore_stale_grad=False):

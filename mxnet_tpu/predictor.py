@@ -116,6 +116,30 @@ class Predictor:
         self._jitted = None
         return self
 
+    def output_shapes(self):
+        """Output shapes for the declared input shapes, WITHOUT running
+        or compiling a forward (MXPredGetOutputShape is legal right
+        after MXPredCreate in the reference ABI) — jax.eval_shape
+        traces abstractly."""
+        import jax
+        import jax.numpy as jnp
+
+        from .ndarray.ndarray import NDArray
+
+        bindings = {k: jax.ShapeDtypeStruct(tuple(v), jnp.float32)
+                    for k, v in self._shapes.items()}
+
+        def absfwd(inputs):
+            b = dict(self._bindings)
+            for k, v in inputs.items():
+                b[k] = NDArray(v)
+            out = self._symbol.eval_dict(b)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            return tuple(o._data for o in outs)
+
+        shaped = jax.eval_shape(absfwd, bindings)
+        return [tuple(s.shape) for s in shaped]
+
 
 class _CPredictor:
     """Bridge object behind the MXPred* C ABI (_native/predict.cc):
@@ -190,17 +214,32 @@ class _CPredictor:
         self._outputs = [np.asarray(o, np.float32)
                          for o in self._pred.forward(**self._inputs)]
 
-    def reshape(self, input_names, input_shapes):
-        self._pred.reshape(dict(zip(input_names, input_shapes)))
-        self._inputs.clear()
-        self._outputs = None
+    def reshaped(self, input_names, input_shapes):
+        """A NEW bridge at the new shapes; this handle keeps serving its
+        original shapes (reference MXPredReshape returns a fresh handle
+        sharing weights, c_predict_api.h)."""
+        clone = object.__new__(_CPredictor)
+        p = Predictor.__new__(Predictor)
+        p._device = self._pred._device
+        p._symbol = self._pred._symbol
+        p._input_names = list(input_names)
+        p._shapes = dict(zip(input_names, input_shapes))
+        p._bindings = self._pred._bindings  # weights shared, not copied
+        p._jitted = None
+        clone._pred = p
+        clone._inputs = {}
+        clone._outputs = None
+        return clone
 
     def num_outputs(self):
-        self._ensure()
+        if self._outputs is None:
+            return len(self._pred.output_shapes())
         return len(self._outputs)
 
     def output_shape(self, index):
-        self._ensure()
+        if self._outputs is None:
+            # legal straight after create: infer abstractly
+            return self._pred.output_shapes()[index]
         return tuple(self._outputs[index].shape)
 
     def output(self, index):

@@ -77,5 +77,29 @@ for step in range(120):
 final_w = list(net.collect_params().values())[0].data().asnumpy()
 np.testing.assert_allclose(final_w.ravel(), w_true.ravel(), atol=0.05)
 
+# update_on_kvstore=False policy (ref: python/mxnet/model.py:77-116
+# makes this a choice, not a hardwire): the collective plane only
+# aggregates gradients; each worker applies its own optimizer locally.
+net2 = gluon.nn.Dense(1, use_bias=False)
+net2.initialize()
+_ = net2(nd.array(X[:2]))
+trainer2 = gluon.Trainer(net2.collect_params(), "sgd",
+                         {"learning_rate": 0.05},
+                         kvstore="dist_device_sync",
+                         update_on_kvstore=False)
+assert trainer2._update_on_kvstore is False
+for step in range(120):
+    with autograd.record():
+        loss = ((net2(nd.array(X[shard])) -
+                 nd.array(y[shard])) ** 2).mean()
+    loss.backward()
+    trainer2.step(batch_size=1)
+w2 = list(net2.collect_params().values())[0].data().asnumpy().ravel()
+np.testing.assert_allclose(w2, w_true.ravel(), atol=0.1)
+# worker-side updates stayed replica-identical: max cross-worker spread
+# of the final weights must be ~0
+spread = kv._coll.allreduce(w2) / n - w2
+assert np.abs(spread).max() < 1e-5, spread
+
 kv.barrier()
 print(f"[worker {rank}] OK", flush=True)

@@ -72,6 +72,14 @@ def test_predict_full_cycle(tmp_path):
     x = np.random.default_rng(1).normal(size=(2, 4)).astype(np.float32)
 
     h = _create(lib, js, blob, (2, 4))
+    # shape query is legal straight after create (reference ABI:
+    # clients size their buffers before the first forward)
+    shp0 = ctypes.POINTER(u)()
+    nd0 = u()
+    assert lib.MXPredGetOutputShape(h, 0, ctypes.byref(shp0),
+                                    ctypes.byref(nd0)) == 0, \
+        lib.MXGetLastError().decode()
+    assert tuple(shp0[i] for i in range(nd0.value)) == (2, 3)
     flat = np.ascontiguousarray(x.ravel())
     rc = lib.MXPredSetInput(
         h, b"data", flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
@@ -127,6 +135,18 @@ def test_predict_reshape_and_partial_out(tmp_path):
         h2, 0, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), 9) == 0
     np.testing.assert_allclose(out.reshape(3, 3), ref_fn(x), rtol=1e-4,
                                atol=1e-5)
+    # the ORIGINAL handle still serves its old (2, 4) shapes
+    xo = np.random.default_rng(5).normal(size=(2, 4)).astype(np.float32)
+    flato = np.ascontiguousarray(xo.ravel())
+    assert lib.MXPredSetInput(
+        h, b"data", flato.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        flato.size) == 0, lib.MXGetLastError().decode()
+    assert lib.MXPredForward(h) == 0
+    outo = np.zeros(6, np.float32)
+    assert lib.MXPredGetOutput(
+        h, 0, outo.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), 6) == 0
+    np.testing.assert_allclose(outo.reshape(2, 3), ref_fn(xo), rtol=1e-4,
+                               atol=1e-5)
     lib.MXPredFree(h2)
     lib.MXPredFree(h)
 
@@ -181,3 +201,29 @@ def test_create_error_reporting(tmp_path):
                           sdata, ctypes.byref(handle))
     assert rc != 0
     assert lib.MXGetLastError() != b""
+
+
+def test_ndlist_npz_list_container():
+    """nd.save list containers load through MXNDListCreate too (not
+    silently dropped)."""
+    import io as _io
+    lib = load_predict()
+    import tempfile
+    with tempfile.NamedTemporaryFile(suffix=".params") as f:
+        nd.save(f.name, [nd.array(np.full((2, 2), 7.0, np.float32))])
+        blob = open(f.name, "rb").read()
+    handle = ctypes.c_void_p()
+    length = u()
+    assert lib.MXNDListCreate(blob, len(blob), ctypes.byref(handle),
+                              ctypes.byref(length)) == 0, \
+        lib.MXGetLastError().decode()
+    assert length.value == 1
+    key = ctypes.c_char_p()
+    data = ctypes.POINTER(ctypes.c_float)()
+    shp = ctypes.POINTER(u)()
+    ndim = u()
+    assert lib.MXNDListGet(handle, 0, ctypes.byref(key),
+                           ctypes.byref(data), ctypes.byref(shp),
+                           ctypes.byref(ndim)) == 0
+    assert data[0] == 7.0
+    lib.MXNDListFree(handle)

@@ -131,3 +131,28 @@ def test_sg_conv_shape_infer_channel_last():
     assert shapes[1] == (8, 4, 3, 3)          # weight stays OIHW
     assert shapes[2:6] == [(8,)] * 4          # BN vectors
     assert shapes[6] == (2, 8, 8, 8)          # sum input NHWC
+
+
+def test_mobilenet_nhwc_matches_nchw():
+    """BASELINE config 2's second model family builds channel-last too."""
+    import re
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((1, 3, 32, 32)).astype(np.float32)
+    for maker in (vision.mobilenet0_25, vision.mobilenet_v2_0_25):
+        outs = {}
+        ref = None
+        key = (lambda k: re.sub(r"^[A-Za-z0-9]+\d+_", "", k))
+        for layout in ("NCHW", "NHWC"):
+            net = maker(layout=layout)
+            net.initialize()
+            infer_shapes(net, (1, 3, 32, 32))
+            if layout == "NCHW":
+                ref = {key(k): v.data().asnumpy()
+                       for k, v in net.collect_params().items()}
+            else:
+                for k, p in net.collect_params().items():
+                    p.set_data(_nd(ref[key(k)]))
+            net.hybridize()
+            outs[layout] = net(_nd(x)).asnumpy()
+        np.testing.assert_allclose(outs["NHWC"], outs["NCHW"], rtol=1e-4,
+                                   atol=1e-4, err_msg=maker.__name__)

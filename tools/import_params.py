@@ -87,7 +87,8 @@ def import_into(net, path, allow_missing=False, ignore_extra=True,
             raise ValueError(
                 f"{key}: shape {val.shape} != parameter shape {want}")
         if cast_dtype and p._data is not None:
-            val = val.astype(np.asarray(p.data().asnumpy()).dtype)
+            # dtype only — no device-to-host transfer of the old value
+            val = val.astype(np.dtype(p.data()._data.dtype))
         p.set_data(NDArray(jnp.asarray(val)))
         matched.add(key)
     if not allow_missing:
