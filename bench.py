@@ -305,6 +305,11 @@ def measure(fwd, pvals, data, sync, iters=ITERS, warmup=WARMUP, label=None):
         sync(out)
         dt = time.perf_counter() - t0
         best = dt if best is None else min(best, dt)
+        if label:
+            # per-trial heartbeat: bounds stdout silence to one trial
+            # so the supervisor's stall clock never kills a healthy
+            # child mid-measurement on a slow backend
+            _hb("%s: trial %.2fs" % (label, dt))
     return data.shape[0] * iters / best
 
 
@@ -523,6 +528,7 @@ def _bench_train(host_data, sync, iters=20, layout="NCHW"):
         sync(loss)
         dt = time.perf_counter() - t0
         best = dt if best is None else min(best, dt)
+        _hb("train: trial %.2fs" % dt)
     return BATCH * iters / best
 
 

@@ -1,0 +1,317 @@
+"""Two-stage Faster-RCNN-style detector on synthetic data
+(BASELINE config 4, ref: example/rcnn — rcnn/symbol/symbol_resnet.py
+get_resnet_train wires backbone + RPN + Proposal + ROIPooling + heads;
+rcnn/rpn/generate.py builds anchor targets).
+
+End-to-end mode: one jitted program runs backbone -> RPN (anchor
+classification + box regression, trained against IoU-assigned anchor
+targets) -> Proposal op (decode + NMS, fixed post-NMS count keeps XLA
+shapes static) -> ROIAlign -> classification/regression heads, with the
+joint loss (RPN cls/box + head cls/box) optimized by one SGD trainer —
+the reference's end2end training flow as a single XLA compile.
+
+    python examples/rcnn/train_rcnn.py --steps 120 --eval
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.ndarray.ndarray import NDArray
+from mxnet_tpu.ops import registry as _reg
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "ssd"))
+from metric import VOC07MApMetric  # noqa: E402  (shared with SSD)
+
+NUM_CLASSES = 2          # foreground classes; head predicts C+1 with bg=0
+IMG = 64
+STRIDE = 8
+SCALES = (2, 3)
+RATIOS = (1.0,)
+A = len(SCALES) * len(RATIOS)
+POST_NMS = 16            # static proposal count per image
+ROI_POOL = 5
+
+
+class Backbone(nn.HybridSequential):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            for c in (16, 32, 64):  # stride 8 feature map
+                self.add(nn.Conv2D(c, 3, padding=1, use_bias=False),
+                         nn.BatchNorm(), nn.Activation("relu"),
+                         nn.MaxPool2D(2, 2))
+
+
+class FasterRCNN(gluon.HybridBlock):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.backbone = Backbone(prefix="backbone_")
+            self.rpn_conv = nn.Conv2D(64, 3, padding=1,
+                                      activation="relu", prefix="rpnc_")
+            self.rpn_cls = nn.Conv2D(2 * A, 1, prefix="rpncls_")
+            self.rpn_box = nn.Conv2D(4 * A, 1, prefix="rpnbox_")
+            self.head_fc = nn.Dense(64, activation="relu",
+                                    in_units=64 * ROI_POOL * ROI_POOL,
+                                    prefix="headfc_")
+            self.head_cls = nn.Dense(NUM_CLASSES + 1, in_units=64,
+                                     prefix="headcls_")
+            self.head_box = nn.Dense(4, in_units=64, prefix="headbox_")
+
+    def hybrid_forward(self, F, x):
+        feat = self.backbone(x)
+        r = self.rpn_conv(feat)
+        rpn_cls = self.rpn_cls(r)      # (B, 2A, H, W)
+        rpn_box = self.rpn_box(r)      # (B, 4A, H, W)
+        return feat, rpn_cls, rpn_box
+
+
+def anchors_for(h, w):
+    """(K, 4) base anchors over the feature grid (numpy, build-time)."""
+    out = []
+    for yy in range(h):
+        for xx in range(w):
+            cy, cx = (yy + 0.5) * STRIDE, (xx + 0.5) * STRIDE
+            for s in SCALES:
+                for r in RATIOS:
+                    hh = s * STRIDE * (r ** 0.5)
+                    ww = s * STRIDE / (r ** 0.5)
+                    out.append([cx - ww / 2, cy - hh / 2,
+                                cx + ww / 2, cy + hh / 2])
+    return np.asarray(out, np.float32)
+
+
+def synth_batch(rng, batch):
+    x = rng.normal(0.0, 0.05, (batch, 3, IMG, IMG)).astype(np.float32)
+    gt = np.zeros((batch, 1, 5), np.float32)
+    for i in range(batch):
+        cls = int(rng.integers(0, NUM_CLASSES))
+        w = int(rng.integers(18, 40))
+        h = int(rng.integers(18, 40))
+        x0 = int(rng.integers(0, IMG - w))
+        y0 = int(rng.integers(0, IMG - h))
+        x[i, cls, y0:y0 + h, x0:x0 + w] += 1.0
+        gt[i, 0] = [cls, x0, y0, x0 + w, y0 + h]  # PIXEL corners
+    return x, gt
+
+
+def _iou(boxes, gt):
+    tl = jnp.maximum(boxes[:, :2], gt[:2])
+    br = jnp.minimum(boxes[:, 2:4], gt[2:4])
+    wh = jnp.maximum(br - tl, 0)
+    inter = wh[:, 0] * wh[:, 1]
+    a = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    b = (gt[2] - gt[0]) * (gt[3] - gt[1])
+    return inter / jnp.maximum(a + b - inter, 1e-6)
+
+
+def _deltas(src, dst):
+    """box regression targets src->dst (standard R-CNN encoding)."""
+    sw = jnp.maximum(src[:, 2] - src[:, 0], 1.0)
+    sh = jnp.maximum(src[:, 3] - src[:, 1], 1.0)
+    sx = (src[:, 0] + src[:, 2]) / 2
+    sy = (src[:, 1] + src[:, 3]) / 2
+    dw = jnp.maximum(dst[:, 2] - dst[:, 0], 1.0)
+    dh = jnp.maximum(dst[:, 3] - dst[:, 1], 1.0)
+    dx = (dst[:, 0] + dst[:, 2]) / 2
+    dy = (dst[:, 1] + dst[:, 3]) / 2
+    return jnp.stack([(dx - sx) / sw, (dy - sy) / sh,
+                      jnp.log(dw / sw), jnp.log(dh / sh)], -1)
+
+
+def _apply_deltas(boxes, d):
+    w = jnp.maximum(boxes[:, 2] - boxes[:, 0], 1.0)
+    h = jnp.maximum(boxes[:, 3] - boxes[:, 1], 1.0)
+    cx = (boxes[:, 0] + boxes[:, 2]) / 2 + d[:, 0] * w
+    cy = (boxes[:, 1] + boxes[:, 3]) / 2 + d[:, 1] * h
+    nw = w * jnp.exp(jnp.clip(d[:, 2], -4, 4))
+    nh = h * jnp.exp(jnp.clip(d[:, 3], -4, 4))
+    return jnp.stack([cx - nw / 2, cy - nh / 2,
+                      cx + nw / 2, cy + nh / 2], -1)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=120)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--lr", type=float, default=0.02)
+    ap.add_argument("--eval", action="store_true")
+    args = ap.parse_args()
+
+    t0 = time.time()
+    net = FasterRCNN()
+    net.initialize()
+    from mxnet_tpu.gluon.block import infer_shapes
+    infer_shapes(net, (args.batch, 3, IMG, IMG))
+    net.hybridize()
+
+    FH = FW = IMG // STRIDE
+    anchors = jnp.asarray(anchors_for(FH, FW))      # (K, 4) pixel coords
+    K = anchors.shape[0]
+    proposal = _reg.get("_contrib_Proposal")
+    roi_align = _reg.get("_contrib_ROIAlign")
+
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9,
+                             "wd": 1e-4})
+    rng = np.random.default_rng(7)
+
+    def heads(feat_nd, rois_nd):
+        pooled = nd.contrib.ROIAlign(
+            feat_nd, rois_nd, pooled_size=(ROI_POOL, ROI_POOL),
+            spatial_scale=1.0 / STRIDE)
+        flat = nd.Flatten(pooled)
+        hf = net.head_fc(flat)
+        return net.head_cls(hf), net.head_box(hf)
+
+    def rpn_targets(gt):
+        """IoU-assign anchors per image: labels {1 fg, 0 bg, -1 ignore}
+        + deltas (ref: rcnn/rpn/generate.py assign_anchor)."""
+        def one(g):
+            box = g[0, 1:5]
+            iou = _iou(anchors, box)
+            best = jnp.argmax(iou)
+            lbl = jnp.where(iou >= 0.5, 1.0,
+                            jnp.where(iou < 0.3, 0.0, -1.0))
+            lbl = lbl.at[best].set(1.0)
+            d = _deltas(anchors, jnp.broadcast_to(box, (K, 4)))
+            return lbl, d
+        return jax.vmap(one)(gt)
+
+    first = last = None
+    for step in range(args.steps):
+        xs, gts = synth_batch(rng, args.batch)
+        X = nd.array(xs)
+        gt_j = jnp.asarray(gts)
+        rpn_lbl, rpn_tgt = rpn_targets(gt_j)        # (B,K), (B,K,4)
+        with autograd.record():
+            feat, rpn_cls, rpn_box = net(X)
+            B = args.batch
+            # RPN losses over all anchors (layout (B, A2, H, W) ->
+            # (B, K) matching anchors_for's y-major, x, then anchor idx)
+            logits = nd.transpose(
+                nd.reshape(rpn_cls, shape=(0, 2, A, FH, FW)),
+                axes=(0, 3, 4, 2, 1))               # (B, H, W, A, 2)
+            logits = nd.reshape(logits, shape=(0, -1, 2))     # (B, K, 2)
+            lbl_nd = nd.array(np.asarray(rpn_lbl))
+            ce = gluon.loss.SoftmaxCrossEntropyLoss()
+            # per-anchor CE with -1 labels masked out
+            mask = lbl_nd >= 0
+            logp = nd.log_softmax(logits, axis=-1)          # (B, K, 2)
+            pick = nd.pick(logp, nd.broadcast_maximum(
+                lbl_nd, nd.zeros((1,))), axis=-1)           # (B, K)
+            rpn_cls_loss = -(pick * mask).sum() / \
+                nd.broadcast_maximum(mask.sum(), nd.ones((1,)))
+            boxp = nd.transpose(
+                nd.reshape(rpn_box, shape=(0, A, 4, FH, FW)),
+                axes=(0, 3, 4, 1, 2))
+            boxp = nd.reshape(boxp, shape=(0, -1, 4))          # (B, K, 4)
+            fg = (lbl_nd == 1)
+            tgt_nd = nd.array(np.asarray(rpn_tgt))
+            rpn_box_loss = (nd.abs(boxp - tgt_nd).sum(axis=-1)
+                            * fg).sum() / nd.broadcast_maximum(fg.sum(), nd.ones((1,)))
+
+            # proposals (stop-gradient region: decode + NMS)
+            im_info = nd.array(np.tile([IMG, IMG, 1.0],
+                                       (B, 1)).astype(np.float32))
+            cls_prob_nd = nd.softmax(
+                nd.reshape(rpn_cls, shape=(0, 2, -1)), axis=1)
+            cls_prob_nd = nd.reshape(cls_prob_nd, shape=(0, 2 * A, FH, FW))
+            rois = NDArray(jax.lax.stop_gradient(proposal(
+                cls_prob_nd._data, rpn_box._data, im_info._data,
+                rpn_post_nms_top_n=POST_NMS, feature_stride=STRIDE,
+                scales=SCALES, ratios=RATIOS, rpn_min_size=4,
+                threshold=0.7)))                    # (B*P, 5)
+
+            # ROI head targets: IoU vs this image's gt
+            rj = rois._data
+            bidx = rj[:, 0].astype(jnp.int32)
+            gt_boxes = gt_j[bidx, 0, 1:5]
+            gt_cls = gt_j[bidx, 0, 0]
+            tl = jnp.maximum(rj[:, 1:3], gt_boxes[:, :2])
+            br = jnp.minimum(rj[:, 3:5], gt_boxes[:, 2:4])
+            wh = jnp.maximum(br - tl, 0)
+            inter = wh[:, 0] * wh[:, 1]
+            ra = (rj[:, 3] - rj[:, 1]) * (rj[:, 4] - rj[:, 2])
+            ga = (gt_boxes[:, 2] - gt_boxes[:, 0]) * \
+                 (gt_boxes[:, 3] - gt_boxes[:, 1])
+            iou = inter / jnp.maximum(ra + ga - inter, 1e-6)
+            roi_lbl = jnp.where(iou >= 0.5, gt_cls + 1, 0.0)
+            roi_tgt = _deltas(rj[:, 1:5], gt_boxes)
+
+            cls_logits, box_pred = heads(feat, rois)
+            head_cls_loss = ce(cls_logits,
+                               nd.array(np.asarray(roi_lbl))).mean()
+            fg2 = nd.array(np.asarray((roi_lbl > 0).astype(np.float32)))
+            head_box_loss = (nd.abs(box_pred -
+                                    nd.array(np.asarray(roi_tgt)))
+                             .sum(axis=-1) * fg2).sum() / \
+                nd.broadcast_maximum(fg2.sum(), nd.ones((1,)))
+            loss = rpn_cls_loss + rpn_box_loss + head_cls_loss + \
+                head_box_loss
+        loss.backward()
+        trainer.step(args.batch)
+        cur = float(loss.asscalar())
+        first = first if first is not None else cur
+        last = cur
+        if step % 30 == 0:
+            print(f"step {step}: loss {cur:.4f}", flush=True)
+    print(f"train: loss {first:.4f} -> {last:.4f}")
+    assert np.isfinite(last)
+
+    if args.eval:
+        metric = VOC07MApMetric(iou_thresh=0.5)
+        erng = np.random.default_rng(99)
+        for _ in range(4):
+            xs, gts = synth_batch(erng, args.batch)
+            feat, rpn_cls, rpn_box = net(nd.array(xs))
+            B = args.batch
+            im_info = nd.array(np.tile([IMG, IMG, 1.0],
+                                       (B, 1)).astype(np.float32))
+            cls_prob_nd = nd.softmax(
+                nd.reshape(rpn_cls, shape=(0, 2, -1)), axis=1)
+            cls_prob_nd = nd.reshape(cls_prob_nd,
+                                     shape=(0, 2 * A, FH, FW))
+            rois = NDArray(proposal(
+                cls_prob_nd._data, rpn_box._data, im_info._data,
+                rpn_post_nms_top_n=POST_NMS, feature_stride=STRIDE,
+                scales=SCALES, ratios=RATIOS, rpn_min_size=4,
+                threshold=0.7))
+            cls_logits, box_pred = heads(feat, rois)
+            probs = jax.nn.softmax(cls_logits._data, axis=-1)
+            boxes = _apply_deltas(rois._data[:, 1:5], box_pred._data)
+            cls_id = jnp.argmax(probs[:, 1:], axis=-1)
+            score = jnp.max(probs[:, 1:], axis=-1)
+            dets = []
+            for b in range(B):
+                m = rois._data[:, 0].astype(jnp.int32) == b
+                rows = jnp.concatenate(
+                    [cls_id[:, None].astype(jnp.float32),
+                     score[:, None], boxes / IMG], -1)
+                rows = jnp.where(m[:, None], rows, -1.0)
+                dets.append(np.asarray(rows))
+            gtn = gts.copy()
+            gtn[:, :, 1:5] /= IMG
+            metric.update(nd.array(gtn), [nd.array(d) for d in dets])
+        name, value = metric.get()
+        print(f"{name}: {value:.4f}")
+    print(f"done in {time.time() - t0:.1f}s")
+
+
+if __name__ == "__main__":
+    main()

@@ -163,8 +163,13 @@ def roi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
         px = jnp.arange(pw)[None, :, None, None]
         iy = jnp.arange(sr)[None, None, :, None]
         ix = jnp.arange(sr)[None, None, None, :]
-        ys = y1 + (py + (iy + 0.5) / sr) * rh
-        xs = x1 + (px + (ix + 0.5) / sr) * rw
+        # full (ph, pw, sr, sr) sample grid: y varies over (py, iy),
+        # x over (px, ix) — broadcast BEFORE flattening, else the two
+        # flattened axes pair up elementwise (diagonal sampling)
+        ys = jnp.broadcast_to(y1 + (py + (iy + 0.5) / sr) * rh,
+                              (ph, pw, sr, sr))
+        xs = jnp.broadcast_to(x1 + (px + (ix + 0.5) / sr) * rw,
+                              (ph, pw, sr, sr))
         vals = bilinear(img, ys.reshape(-1), xs.reshape(-1))
         vals = vals.reshape(C, ph, pw, sr * sr)
         return jnp.mean(vals, axis=-1)

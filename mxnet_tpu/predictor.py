@@ -116,18 +116,23 @@ class Predictor:
         self._jitted = None
         return self
 
-    def output_shapes(self):
+    def output_shapes(self, dtypes=None):
         """Output shapes for the declared input shapes, WITHOUT running
         or compiling a forward (MXPredGetOutputShape is legal right
         after MXPredCreate in the reference ABI) — jax.eval_shape
-        traces abstractly."""
+        traces abstractly. Inputs default to float32 (the C ABI is
+        float-only by signature); Python callers with integer inputs
+        (token ids) pass ``dtypes={"data": "int32"}``."""
         import jax
         import jax.numpy as jnp
 
         from .ndarray.ndarray import NDArray
 
-        bindings = {k: jax.ShapeDtypeStruct(tuple(v), jnp.float32)
-                    for k, v in self._shapes.items()}
+        dtypes = dtypes or {}
+        bindings = {
+            k: jax.ShapeDtypeStruct(
+                tuple(v), jnp.dtype(dtypes.get(k, jnp.float32)))
+            for k, v in self._shapes.items()}
 
         def absfwd(inputs):
             b = dict(self._bindings)
@@ -193,6 +198,7 @@ class _CPredictor:
                                    dict(zip(input_names, input_shapes)))
         self._inputs = {}
         self._outputs = None
+        self._abstract_shapes = None
 
     def set_input(self, key, flat):
         if key not in self._pred._shapes:
@@ -217,29 +223,45 @@ class _CPredictor:
     def reshaped(self, input_names, input_shapes):
         """A NEW bridge at the new shapes; this handle keeps serving its
         original shapes (reference MXPredReshape returns a fresh handle
-        sharing weights, c_predict_api.h)."""
+        sharing weights, c_predict_api.h). Inputs not named keep their
+        previous shapes, as the reference does."""
+        unknown = [n for n in input_names
+                   if n not in self._pred._shapes]
+        if unknown:
+            raise MXNetError(
+                f"MXPredReshape: {unknown} are not inputs of this "
+                f"predictor (declared: {sorted(self._pred._shapes)})")
+        shapes = dict(self._pred._shapes)
+        shapes.update(dict(zip(input_names, input_shapes)))
         clone = object.__new__(_CPredictor)
         p = Predictor.__new__(Predictor)
         p._device = self._pred._device
         p._symbol = self._pred._symbol
-        p._input_names = list(input_names)
-        p._shapes = dict(zip(input_names, input_shapes))
+        p._input_names = list(shapes)
+        p._shapes = shapes
         p._bindings = self._pred._bindings  # weights shared, not copied
         p._jitted = None
         clone._pred = p
         clone._inputs = {}
         clone._outputs = None
+        clone._abstract_shapes = None
         return clone
+
+    def _inferred_shapes(self):
+        # one abstract trace per handle: shapes are fixed for its life
+        if self._abstract_shapes is None:
+            self._abstract_shapes = self._pred.output_shapes()
+        return self._abstract_shapes
 
     def num_outputs(self):
         if self._outputs is None:
-            return len(self._pred.output_shapes())
+            return len(self._inferred_shapes())
         return len(self._outputs)
 
     def output_shape(self, index):
         if self._outputs is None:
             # legal straight after create: infer abstractly
-            return self._pred.output_shapes()[index]
+            return self._inferred_shapes()[index]
         return tuple(self._outputs[index].shape)
 
     def output(self, index):

@@ -233,3 +233,29 @@ def test_spatial_transformer_gradient():
     loss.backward()
     assert np.abs(x.grad.asnumpy()).sum() > 0
     assert np.abs(theta.grad.asnumpy()).sum() > 0
+
+
+def test_roi_align_full_sample_grid():
+    """ROIAlign must sample the full (ph, pw, sr, sr) grid — the
+    flattened y/x grids pair positionally, so without broadcasting they
+    collapse to a diagonal (caught by the RCNN example)."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops import registry
+    H = W = 8
+    # feature = x-coordinate ramp: pooling any aligned box column-wise
+    # must reproduce distinct per-column x means
+    data = jnp.broadcast_to(jnp.arange(W, dtype=jnp.float32),
+                            (1, 1, H, W))
+    rois = jnp.asarray([[0, 0.0, 0.0, 7.0, 7.0]], jnp.float32)
+    out = registry.get("_contrib_ROIAlign")(
+        data, rois, pooled_size=(2, 4), sample_ratio=2,
+        spatial_scale=1.0)
+    assert out.shape == (1, 1, 2, 4)
+    col = np.asarray(out)[0, 0, 0]
+    # 4 bins over x in [0, 7]: bin width 1.75, centers at sample means
+    want = np.asarray([0.875 - 0.4375, 2.625 - 0.4375,
+                       4.375 - 0.4375, 6.125 - 0.4375]) + 0.4375
+    np.testing.assert_allclose(col, want, atol=1e-4)
+    # both pooled rows identical (feature constant in y)
+    np.testing.assert_allclose(np.asarray(out)[0, 0, 0],
+                               np.asarray(out)[0, 0, 1], atol=1e-5)

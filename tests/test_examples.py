@@ -111,3 +111,16 @@ def test_ssd_train_eval_int8():
     first, last = [float(x) for x in
                    out.split("train: loss ")[1].split()[0:3:2]]
     assert last < first
+
+
+def test_rcnn_smoke():
+    """BASELINE config 4 second family: the two-stage detector trains
+    end-to-end (static-shape Proposal -> ROIAlign -> heads) with finite
+    decreasing loss and a computable mAP."""
+    out = _run([sys.executable, "train_rcnn.py", "--steps", "40",
+                "--batch", "2", "--eval"],
+               cwd=os.path.join(REPO, "examples/rcnn"), timeout=560)
+    first, last = [float(x) for x in
+                   out.split("train: loss ")[1].split()[0:3:2]]
+    assert np.isfinite(last) and last < first, out[-800:]
+    assert "mAP:" in out
