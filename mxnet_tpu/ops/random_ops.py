@@ -12,6 +12,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..base import MXNetError
 from .registry import register
 
 
@@ -285,13 +286,44 @@ def shuffle(key, data):
 
 @register("_sample_unique_zipfian", needs_rng=True)
 def sample_unique_zipfian(key, range_max=1, shape=()):
-    # approximate: log-uniform samples (used by sampled-softmax candidate sampling)
+    """Unique log-uniform (zipfian) candidate samples (ref:
+    src/operator/random/unique_sample_op.cc — samples WITHOUT
+    replacement; used by sampled-softmax candidate sampling).
+
+    Sampling without replacement = Gumbel top-k over the class
+    log-probs p(k) ∝ log(1 + 1/(k+1)): exact, one XLA top-k, no
+    rejection loop. For very large ranges (> 2^21 classes) the densely
+    materialized log-prob vector would dominate memory, so the sampler
+    falls back to the plain log-uniform draw (may repeat — the regime
+    where collisions are vanishingly rare anyway)."""
     n = 1
     for s in tuple(shape):
         n *= s
-    u = jax.random.uniform(key, (n,))
-    out = jnp.minimum(
-        jnp.exp(u * jnp.log(float(range_max))).astype(jnp.int32), range_max - 1
-    )
+    rm = int(range_max)
+    if n > rm:
+        raise MXNetError(
+            f"_sample_unique_zipfian: cannot draw {n} unique samples "
+            f"from range_max={rm} classes")
+    if rm <= (1 << 21):
+        k = jnp.arange(rm, dtype=jnp.float32)
+        logp = jnp.log(jnp.log1p(1.0 / (k + 1.0)))
+        g = jax.random.gumbel(key, (rm,))
+        _, out = jax.lax.top_k(logp + g, n)
+        out = out.astype(jnp.int32)
+    else:
+        # approximate fallback: reference formula
+        # floor(exp(u * log(range_max + 1))) - 1 in [0, rm) — class 0
+        # (the most probable) included. Duplicates ARE likely here for
+        # head classes (P(class 0) ~ log2/log(rm)); this regime trades
+        # the without-replacement guarantee for not materializing an
+        # rm-sized logit vector.
+        u = jax.random.uniform(key, (n,))
+        out = jnp.clip(
+            jnp.exp(u * jnp.log(float(rm) + 1.0)).astype(jnp.int32) - 1,
+            0, rm - 1)
+    # second output: the reference reports rejection-loop trial counts;
+    # the Gumbel-top-k path has no rejection loop, so this stays 1 per
+    # sample — callers needing the reference's P(hit)=1-(1-p)^tries
+    # correction should compute inclusion probabilities directly
     cnt = jnp.ones((n,), dtype=jnp.float32)
     return out.reshape(tuple(shape)), cnt

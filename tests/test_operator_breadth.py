@@ -1,0 +1,299 @@
+"""Breadth sweep: every registered op that had no direct test anywhere
+else gets at least one numeric check here (the reference's
+test_operator.py is exhaustive by name; this file closes the coverage
+gap the registry diff found — tools/op_parity.py is the name diff,
+this is the behavior diff)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray.ndarray import invoke
+from mxnet_tpu.ops import registry
+
+RNG = np.random.default_rng(11)
+
+
+def _run(op, arrays, attrs=None):
+    out = invoke(op, [nd.array(np.asarray(a)) for a in arrays],
+                 attrs or {})
+    if isinstance(out, (list, tuple)):
+        return [o.asnumpy() for o in out]
+    return out.asnumpy()
+
+
+A = RNG.standard_normal((3, 4)).astype(np.float32)
+B = RNG.standard_normal((3, 4)).astype(np.float32)
+IMG = RNG.standard_normal((2, 3, 6, 6)).astype(np.float32)
+
+
+def _np_softmax(x, axis):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def _l2norm_ref(x):
+    return x / np.sqrt((x.reshape(x.shape[0], -1) ** 2).sum(1)
+                       ).reshape((-1,) + (1,) * (x.ndim - 1))
+
+
+DETERMINISTIC = [
+    # (op, inputs, attrs, oracle(outputs-or-None -> expected))
+    ("InstanceNorm",
+     [IMG, np.ones(3, np.float32), np.zeros(3, np.float32)], {},
+     lambda: (IMG - IMG.mean(axis=(2, 3), keepdims=True))
+     / np.sqrt(IMG.var(axis=(2, 3), keepdims=True) + 1e-3)),
+    ("L2Normalization", [IMG], {"mode": "instance"},
+     lambda: _l2norm_ref(IMG)),
+    ("SoftmaxActivation", [IMG], {"mode": "channel"},
+     lambda: _np_softmax(IMG, 1)),
+    ("softmax_cross_entropy",
+     [A, np.array([1, 0, 3], np.float32)], {},
+     lambda: -np.log(_np_softmax(A, -1))[np.arange(3), [1, 0, 3]].sum()),
+    ("LogisticRegressionOutput",
+     [A, (A > 0).astype(np.float32)], {},
+     lambda: 1 / (1 + np.exp(-A))),
+    ("MAERegressionOutput", [A, B], {}, lambda: A),
+    ("UpSampling", [IMG], {"scale": 2, "sample_type": "nearest"},
+     lambda: IMG.repeat(2, axis=2).repeat(2, axis=3)),
+    ("SequenceReverse",
+     [np.arange(24, dtype=np.float32).reshape(4, 2, 3)], {},
+     lambda: np.arange(24, dtype=np.float32).reshape(4, 2, 3)[::-1]),
+    ("_contrib_div_sqrt_dim", [A], {}, lambda: A / 2.0),
+    ("_contrib_quadratic", [A], {"a": 2.0, "b": -1.0, "c": 0.5},
+     lambda: 2 * A * A - A + 0.5),
+    ("_contrib_index_copy",
+     [np.zeros((4, 2), np.float32), np.array([1, 3], np.float32),
+      np.ones((2, 2), np.float32)], {},
+     lambda: np.array([[0, 0], [1, 1], [0, 0], [1, 1]], np.float32)),
+    ("_greater_equal", [A, B], {}, lambda: (A >= B).astype(np.float32)),
+    ("_lesser", [A, B], {}, lambda: (A < B).astype(np.float32)),
+    ("_not_equal", [A, B], {}, lambda: (A != B).astype(np.float32)),
+    ("_logical_or", [A > 0, B > 0], {},
+     lambda: ((A > 0) | (B > 0)).astype(np.float32)),
+    ("_scatter_plus_scalar", [A], {"scalar": 2.5}, lambda: A + 2.5),
+    ("_scatter_minus_scalar", [A], {"scalar": 1.5}, lambda: A - 1.5),
+    ("_scatter_elemwise_div", [A, np.abs(B) + 1], {},
+     lambda: A / (np.abs(B) + 1)),
+    ("_slice_assign_scalar", [A],
+     {"scalar": 9.0, "begin": (1, None), "end": (2, None),
+      "step": (None, None)},
+     lambda: np.concatenate([A[:1], np.full((1, 4), 9.0, np.float32),
+                             A[2:]])),
+    ("boolean_mask_fill", [A, (A > 0).astype(np.float32)],
+     {"value": -1.0}, lambda: np.where(A > 0, A, -1.0)),
+    ("erfinv", [np.clip(A, -0.9, 0.9)], {},
+     lambda: __import__("scipy.special", fromlist=["erfinv"]).erfinv(
+         np.clip(A, -0.9, 0.9)) if _has_scipy()
+     else pytest.skip("scipy absent")),
+]
+
+
+def _has_scipy():
+    try:
+        import scipy.special  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+@pytest.mark.parametrize("op,arrays,attrs,oracle",
+                         DETERMINISTIC, ids=[c[0] for c in DETERMINISTIC])
+def test_deterministic_op(op, arrays, attrs, oracle):
+    got = _run(op, arrays, attrs)
+    want = oracle()
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_v1_matches_batchnorm():
+    g, b = np.ones(3, np.float32), np.zeros(3, np.float32)
+    mean = RNG.standard_normal(3).astype(np.float32)
+    var = np.abs(RNG.standard_normal(3)).astype(np.float32) + 0.5
+    v1 = _run("BatchNorm_v1", [IMG, g, b, mean, var],
+              {"use_global_stats": True, "fix_gamma": False})
+    v2 = _run("BatchNorm", [IMG, g, b, mean, var],
+              {"use_global_stats": True, "fix_gamma": False})
+    np.testing.assert_allclose(v1, v2, rtol=1e-5)
+
+
+def test_lrn_local_response():
+    out = _run("LRN", [IMG], {"nsize": 3, "alpha": 1e-3, "beta": 0.75,
+                              "knorm": 2.0})
+    # denominators >= knorm^beta, same shape, order-preserving per pixel
+    assert out.shape == IMG.shape
+    assert np.isfinite(out).all()
+    assert np.abs(out).max() <= np.abs(IMG).max() / (2.0 ** 0.75) + 1e-3
+
+
+def test_roi_pooling_max_semantics():
+    data = np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8)
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = _run("ROIPooling", [data, rois],
+               {"pooled_size": (2, 2), "spatial_scale": 1.0})
+    np.testing.assert_allclose(out[0, 0],
+                               [[27, 31], [59, 63]])
+
+
+def test_box_iou_and_nms():
+    b1 = np.array([[0, 0, 2, 2]], np.float32)
+    b2 = np.array([[1, 1, 3, 3], [4, 4, 5, 5]], np.float32)
+    iou = _run("_contrib_box_iou", [b1, b2], {"format": "corner"})
+    np.testing.assert_allclose(iou, [[1 / 7, 0.0]], rtol=1e-5)
+    dets = np.array([[[0.9, 0, 0, 2, 2], [0.8, 0.1, 0.1, 2, 2],
+                      [0.7, 4, 4, 5, 5]]], np.float32)
+    out = _run("_contrib_box_nms", [dets],
+               {"overlap_thresh": 0.5, "coord_start": 1,
+                "score_index": 0, "id_index": -1})
+    out = out[0] if isinstance(out, list) else out
+    kept = out[0][out[0][:, 0] > 0]
+    assert len(kept) == 2  # the 0.8 duplicate suppressed
+
+
+def test_adaptive_avg_pool_and_bilinear_resize():
+    out = _run("_contrib_AdaptiveAvgPooling2D", [IMG],
+               {"output_size": (3, 3)})
+    assert out.shape == (2, 3, 3, 3)
+    np.testing.assert_allclose(out[:, :, 0, 0],
+                               IMG[:, :, :2, :2].mean(axis=(2, 3)),
+                               rtol=1e-4)
+    rs = _run("_contrib_BilinearResize2D", [IMG],
+              {"height": 12, "width": 12})
+    assert rs.shape == (2, 3, 12, 12)
+    # corners align
+    np.testing.assert_allclose(rs[:, :, 0, 0], IMG[:, :, 0, 0], rtol=1e-4)
+
+
+def test_control_flow_direct_ops():
+    """_foreach/_while_loop/_cond registry entries drive lax control flow
+    (the contrib wrappers are tested elsewhere; this is the op seam)."""
+    from mxnet_tpu.ndarray import contrib as ndc
+    x = nd.array(np.arange(4, dtype=np.float32))
+    outs, states = ndc.foreach(
+        lambda xi, st: (xi * 2, [st[0] + xi]), x, [nd.array(np.zeros(1))])
+    np.testing.assert_allclose(outs.asnumpy(), [0, 2, 4, 6])
+    np.testing.assert_allclose(states[0].asnumpy(), [6.0])
+    out, st = ndc.while_loop(
+        cond=lambda i, s: i < 5,
+        func=lambda i, s: ([], (i + 1, s + i)),
+        loop_vars=(nd.array(np.zeros(1)), nd.array(np.zeros(1))),
+        max_iterations=10)
+    np.testing.assert_allclose(st[1].asnumpy(), [10.0])
+    r = ndc.cond(nd.array(np.ones(1)) > 0,
+                 lambda: nd.array(np.full(1, 7.0)),
+                 lambda: nd.array(np.zeros(1)))
+    np.testing.assert_allclose(r.asnumpy(), [7.0])
+
+
+def test_random_family_statistics():
+    """Seeded draws from every registered sampler: shape, dtype, finite,
+    and first-moment sanity (ref: test_random.py's technique)."""
+    mx.random.seed(3)
+    n = 4000
+    cases = [
+        ("_random_exponential", {"lam": 2.0}, 1 / 2.0, 0.15),
+        ("_random_gamma", {"alpha": 3.0, "beta": 2.0}, 6.0, 0.8),
+        ("_random_poisson", {"lam": 4.0}, 4.0, 0.4),
+        ("_random_negative_binomial", {"k": 5, "p": 0.5}, 5.0, 0.8),
+        ("_random_generalized_negative_binomial",
+         {"mu": 2.0, "alpha": 0.3}, 2.0, 0.5),
+    ]
+    for op, attrs, mean, tol in cases:
+        out = _run(op, [], dict(attrs, shape=(n,)))
+        assert out.shape == (n,) and np.isfinite(out).all(), op
+        assert abs(out.mean() - mean) < tol, (op, out.mean())
+    ri = _run("_random_randint", [], {"low": 2, "high": 9, "shape": (n,)})
+    assert ri.min() >= 2 and ri.max() < 9
+
+
+def test_sample_family_per_distribution_params():
+    """_sample_* ops draw one batch per parameter row."""
+    mx.random.seed(4)
+    lam = np.array([1.0, 10.0], np.float32)
+    out = _run("_sample_exponential", [lam], {"shape": (3000,)})
+    assert out.shape == (2, 3000)
+    assert abs(out[0].mean() - 1.0) < 0.2
+    assert abs(out[1].mean() - 0.1) < 0.05
+    mu = np.array([[0.0], [5.0]], np.float32)
+    sg = np.array([[1.0], [0.1]], np.float32)
+    nrm = _run("_sample_normal", [mu, sg], {"shape": (2000,)})
+    assert nrm.shape == (2, 1, 2000)
+    assert abs(nrm[0].mean()) < 0.2 and abs(nrm[1].mean() - 5.0) < 0.2
+    uni = _run("_sample_uniform",
+               [np.array([0.0], np.float32), np.array([4.0], np.float32)],
+               {"shape": (2000,)})
+    assert 1.8 < uni.mean() < 2.2
+    gnb = _run("_sample_generalized_negative_binomial",
+               [np.array([2.0], np.float32), np.array([0.3], np.float32)],
+               {"shape": (2000,)})
+    assert abs(gnb.mean() - 2.0) < 0.5
+    nb = _run("_sample_negative_binomial",
+              [np.array([5.0], np.float32), np.array([0.5], np.float32)],
+              {"shape": (2000,)})
+    assert abs(nb.mean() - 5.0) < 1.0
+    gnbl = _run("_random_generalized_negative_binomial_like",
+                [np.zeros((8, 8), np.float32)], {"mu": 2.0, "alpha": 0.3})
+    assert gnbl.shape == (8, 8)
+    nbl = _run("_random_negative_binomial_like",
+               [np.zeros((8, 8), np.float32)], {"k": 5, "p": 0.5})
+    assert nbl.shape == (8, 8)
+    mult = _run("_sample_multinomial",
+                [np.array([[0.0, 0.0, 1.0]], np.float32)], {"shape": (50,)})
+    np.testing.assert_array_equal(np.asarray(mult), 2)
+    zipf = _run("_sample_unique_zipfian", [], {"range_max": 100,
+                                               "shape": (1, 20)})
+    z = np.asarray(zipf[0] if isinstance(zipf, list) else zipf)
+    assert z.shape[-1] == 20 and len(np.unique(z)) == 20
+
+
+def test_quantized_ops_roundtrip():
+    """Quantized concat/add/flatten/pooling: int8 in, correct scale out
+    (ref: quantization/mkldnn int8 kernels)."""
+    x = RNG.standard_normal((2, 4, 4, 4)).astype(np.float32)
+    y = RNG.standard_normal((2, 4, 4, 4)).astype(np.float32)
+
+    def q(a):
+        mn, mx_ = float(a.min()), float(a.max())
+        scale = 127.0 / max(abs(mn), abs(mx_))
+        return (np.clip(np.round(a * scale), -127, 127).astype(np.int8),
+                np.float32(mn), np.float32(mx_))
+
+    qx, xmin, xmax = q(x)
+    qy, ymin, ymax = q(y)
+    out = _run("_contrib_quantized_concat",
+               [qx, qy, np.float32(xmin), np.float32(xmax),
+                np.float32(ymin), np.float32(ymax)],
+               {"dim": 1, "num_args": 2})
+    deq = out[0].astype(np.float32) * max(out[2].ravel()[0],
+                                          -out[1].ravel()[0]) / 127.0
+    np.testing.assert_allclose(deq, np.concatenate([x, y], 1), atol=0.1)
+
+    flat = _run("_contrib_quantized_flatten",
+                [qx, np.float32(xmin), np.float32(xmax)], {})
+    assert flat[0].shape == (2, 64)
+
+    pool = _run("_contrib_quantized_pooling",
+                [qx, np.float32(xmin), np.float32(xmax)],
+                {"kernel": (2, 2), "stride": (2, 2), "pool_type": "max"})
+    assert pool[0].shape == (2, 4, 2, 2)
+    assert pool[0].dtype == np.int8
+
+    add = _run("_contrib_quantized_elemwise_add",
+               [qx, qy, np.float32(xmin), np.float32(xmax),
+                np.float32(ymin), np.float32(ymax)], {})
+    scale_out = max(abs(add[1].ravel()[0]), abs(add[2].ravel()[0]))
+    deq_add = add[0].astype(np.float32) / (
+        127.0 if add[0].dtype == np.int8 else 2 ** 31 - 1) * scale_out
+    np.testing.assert_allclose(deq_add, x + y, atol=0.15)
+
+
+def test_update_ops_by_canonical_name():
+    w = np.ones((3,), np.float32)
+    g = np.full((3,), 0.5, np.float32)
+    out = _run("sgd_update", [w, g], {"lr": 0.1, "wd": 0.1})
+    np.testing.assert_allclose(out, (1 - 0.01) * 1 - 0.05, rtol=1e-6)
+    outs = _run("mp_sgd_mom_update",
+                [w.astype(np.float16), g, np.zeros(3, np.float32), w],
+                {"lr": 0.1, "momentum": 0.9})
+    assert outs[0].dtype == np.float16
+    np.testing.assert_allclose(outs[2], 1 - 0.05, rtol=1e-3)
