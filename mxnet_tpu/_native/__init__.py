@@ -89,20 +89,26 @@ def load_comm():
 
 
 _imgdec_lib = None
+_imgdec_failed = False
 
 
 def load_imgdec():
     """The threaded JPEG batch decoder (imgdec.cc); None when libjpeg
-    is unavailable on this host (callers fall back to PIL)."""
-    global _imgdec_lib
+    is unavailable on this host (callers fall back to PIL). A failed
+    build is cached — per-record callers (decode_jpeg) must not respawn
+    g++ for every item on libjpeg-less hosts."""
+    global _imgdec_lib, _imgdec_failed
     if _imgdec_lib is not None:
         return _imgdec_lib
+    if _imgdec_failed:
+        return None
     src = os.path.join(_HERE, "imgdec.cc")
     out = os.path.join(_HERE, "libmxtpu_imgdec.so")
     try:
         _build(src, out, extra_flags=("-ljpeg",))
         lib = ctypes.CDLL(out)
     except (subprocess.CalledProcessError, OSError):
+        _imgdec_failed = True
         return None
     lib.mxtpu_decode_batch.restype = ctypes.c_int
     lib.mxtpu_decode_batch.argtypes = [
@@ -117,8 +123,44 @@ def load_imgdec():
         ctypes.c_int,                               # nthreads
         ctypes.c_char_p, ctypes.c_int,              # errbuf
     ]
+    lib.mxtpu_jpeg_dims.restype = ctypes.c_int
+    lib.mxtpu_jpeg_dims.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.c_char_p, ctypes.c_int]
+    lib.mxtpu_decode_jpeg.restype = ctypes.c_int
+    lib.mxtpu_decode_jpeg.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_char_p, ctypes.c_int]
     _imgdec_lib = lib
     return lib
+
+
+def decode_jpeg(payload):
+    """Decode one JPEG to an HWC uint8 RGB array via libjpeg; None when
+    the native lib is unavailable or the payload isn't a JPEG (callers
+    fall back to cv2/PIL). The per-item seam: gluon.data's
+    ImageRecordDataset, ImageRecordIter's non-batch path, and
+    mx.image.imdecode route through it."""
+    import numpy as np
+    if not payload[:2] == b"\xff\xd8":
+        return None
+    lib = load_imgdec()
+    if lib is None:
+        return None
+    h = ctypes.c_int()
+    w = ctypes.c_int()
+    err = ctypes.create_string_buffer(256)
+    if lib.mxtpu_jpeg_dims(payload, len(payload), ctypes.byref(h),
+                           ctypes.byref(w), err, len(err)) != 0:
+        return None
+    out = np.empty((h.value, w.value, 3), np.uint8)
+    if lib.mxtpu_decode_jpeg(
+            payload, len(payload),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            err, len(err)) != 0:
+        return None
+    return out
 
 
 # keeps the ctypes callback object alive for the lib's lifetime

@@ -143,3 +143,47 @@ extern "C" int mxtpu_decode_batch(
   }
   return 0;
 }
+
+// ---------------------------------------------------------------------------
+// single-image decode: the seam the PIL/cv2 fallbacks route through
+// (gluon.data ImageRecordDataset, mx.image, recordio.unpack_img) —
+// two-call protocol so the caller owns the pixel buffer:
+//   mxtpu_jpeg_dims(buf, len, &h, &w)          -> 0 ok / -1 not-a-jpeg
+//   mxtpu_decode_jpeg(buf, len, out /*h*w*3*/) -> 0 ok / -1 error
+// ---------------------------------------------------------------------------
+
+extern "C" int mxtpu_jpeg_dims(const char* buf, int64_t len, int* h,
+                               int* w, char* errbuf, int errbuf_len) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jb)) {
+    snprintf(errbuf, errbuf_len, "%s", jerr.msg);
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, reinterpret_cast<const uint8_t*>(buf),
+               size_t(len));
+  jpeg_read_header(&cinfo, TRUE);
+  *h = cinfo.image_height;
+  *w = cinfo.image_width;
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+extern "C" int mxtpu_decode_jpeg(const char* buf, int64_t len,
+                                 uint8_t* out, char* errbuf,
+                                 int errbuf_len) {
+  std::vector<uint8_t> px;
+  int h = 0, w = 0;
+  std::string err;
+  if (!decode_rgb(reinterpret_cast<const uint8_t*>(buf), size_t(len),
+                  &px, &h, &w, &err)) {
+    snprintf(errbuf, errbuf_len, "%s", err.c_str());
+    return -1;
+  }
+  std::memcpy(out, px.data(), px.size());
+  return 0;
+}

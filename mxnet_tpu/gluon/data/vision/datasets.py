@@ -17,6 +17,11 @@ from ....ndarray import array
 from ..dataset import ArrayDataset, Dataset
 
 
+def _cv2_present():
+    import importlib.util
+    return importlib.util.find_spec("cv2") is not None
+
+
 class _DownloadedDataset(Dataset):
     def __init__(self, root, train, transform):
         self._root = os.path.expanduser(root)
@@ -136,7 +141,17 @@ class ImageRecordDataset(Dataset):
 
     def __getitem__(self, idx):
         record = self._record.read_idx(self._record.keys[idx])
-        header, img = self._unpack(record)
+        from ....recordio import unpack
+        from ...._native import decode_jpeg
+        header, payload = unpack(record)
+        img = decode_jpeg(payload) if self._flag != 0 else None
+        if img is None:
+            # PIL/cv2 fallback; cv2 decodes BGR — normalize so items
+            # are RGB regardless of which decoder this host has
+            header, img = self._unpack(record)
+            if self._flag != 0 and img.ndim == 3 and _cv2_present() \
+                    and payload[:6] != b"\x93NUMPY":
+                img = np.ascontiguousarray(img[:, :, ::-1])
         if self._transform is not None:
             return self._transform(array(img), header.label)
         return array(img), header.label

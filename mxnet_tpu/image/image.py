@@ -20,13 +20,20 @@ from ..ndarray import NDArray, array
 
 
 def imdecode(buf, flag=1, to_rgb=True):
-    """Decode an encoded image buffer (ref: image.imdecode)."""
+    """Decode an encoded image buffer (ref: image.imdecode). JPEGs go
+    through the native libjpeg decoder (_native.decode_jpeg, RGB);
+    other formats through PIL."""
     import io as _io
 
-    from PIL import Image
-    img = Image.open(_io.BytesIO(bytes(buf)))
-    img = img.convert("RGB" if flag else "L")
-    a = np.asarray(img)
+    a = None
+    if flag:
+        from .._native import decode_jpeg
+        a = decode_jpeg(bytes(buf))
+    if a is None:
+        from PIL import Image
+        img = Image.open(_io.BytesIO(bytes(buf)))
+        img = img.convert("RGB" if flag else "L")
+        a = np.asarray(img)
     if not flag:
         a = a[:, :, None]
     if flag and not to_rgb:

@@ -848,15 +848,20 @@ class ImageRecordIter(DataIter):
         return rng
 
     def _decode(self, raw):
+        from .._native import decode_jpeg
         from ..recordio import unpack, unpack_img
         header, payload = unpack(raw)
         c, h, w = self.data_shape
         try:
-            _, img = unpack_img(raw)          # HWC uint8
-            if img.ndim == 2:
-                img = img[:, :, None].repeat(3, axis=2)
-            if self._cv2_decoder():
-                img = img[:, :, ::-1]  # cv2 decodes BGR; pipeline is RGB
+            img = decode_jpeg(payload)        # native libjpeg, RGB HWC
+            if img is None:
+                _, img = unpack_img(raw)      # HWC uint8
+                if img.ndim == 2:
+                    img = img[:, :, None].repeat(3, axis=2)
+                if self._cv2_decoder() and payload[:6] != b"\x93NUMPY":
+                    # cv2 decodes BGR; pipeline is RGB (npy payloads
+                    # bypass cv2 inside unpack_img — don't flip those)
+                    img = img[:, :, ::-1]
             if self.resize > 0:
                 img = self._resize_shorter(img, self.resize)
             img = img.astype(np.float32).transpose(2, 0, 1)  # CHW

@@ -161,3 +161,24 @@ def test_host_engine_pipeline_matches_thread_fallback(recfile, monkeypatch):
     for (d1, l1), (d0, l0) in zip(streams["1"], streams["0"]):
         np.testing.assert_array_equal(l1, l0)
         np.testing.assert_allclose(d1, d0, atol=1e-5)
+
+
+def test_native_single_image_decode_seam():
+    """_native.decode_jpeg (the per-item seam gluon.data and the PIL
+    fallback route through) matches PIL exactly and rejects non-JPEG."""
+    import io as _io
+    from PIL import Image
+    from mxnet_tpu._native import decode_jpeg
+    rng = np.random.default_rng(5)
+    arr = rng.integers(0, 255, (9, 7, 3), dtype=np.uint8)
+    buf = _io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=95)
+    img = decode_jpeg(buf.getvalue())
+    if img is None:
+        pytest.skip("libjpeg unavailable on this host")
+    ref = np.asarray(Image.open(_io.BytesIO(buf.getvalue()))
+                     .convert("RGB"))
+    assert img.shape == ref.shape
+    np.testing.assert_allclose(img.astype(int), ref.astype(int), atol=2)
+    assert decode_jpeg(b"\x93NUMPYnot-a-jpeg") is None
+    assert decode_jpeg(b"\xff\xd8corrupted") is None
