@@ -17,9 +17,6 @@ from ....ndarray import array
 from ..dataset import ArrayDataset, Dataset
 
 
-def _cv2_present():
-    import importlib.util
-    return importlib.util.find_spec("cv2") is not None
 
 
 class _DownloadedDataset(Dataset):
@@ -141,17 +138,23 @@ class ImageRecordDataset(Dataset):
 
     def __getitem__(self, idx):
         record = self._record.read_idx(self._record.keys[idx])
-        from ....recordio import unpack
+        from ....recordio import cv2_present, decode_payload, unpack
         from ...._native import decode_jpeg
         header, payload = unpack(record)
         img = decode_jpeg(payload) if self._flag != 0 else None
         if img is None:
-            # PIL/cv2 fallback; cv2 decodes BGR — normalize so items
-            # are RGB regardless of which decoder this host has
-            header, img = self._unpack(record)
-            if self._flag != 0 and img.ndim == 3 and _cv2_present() \
-                    and payload[:6] != b"\x93NUMPY":
-                img = np.ascontiguousarray(img[:, :, ::-1])
+            # cv2/PIL fallback on the already-extracted payload; items
+            # must come out decoder-independent: color requests always
+            # (H, W, 3) RGB
+            img = decode_payload(payload, iscolor=self._flag)
+            if self._flag != 0:
+                if img.ndim == 2:
+                    img = img[:, :, None].repeat(3, axis=2)
+                elif img.shape[2] == 3 and cv2_present() \
+                        and payload[:6] != b"\x93NUMPY":
+                    # cv2 decodes BGR; normalize to RGB (4-channel
+                    # BGRA etc. is passed through untouched)
+                    img = np.ascontiguousarray(img[:, :, ::-1])
         if self._transform is not None:
             return self._transform(array(img), header.label)
         return array(img), header.label

@@ -821,8 +821,8 @@ class ImageRecordIter(DataIter):
     @staticmethod
     def _cv2_decoder():
         """unpack_img decodes through cv2 (BGR) when it is installed."""
-        import importlib.util
-        return importlib.util.find_spec("cv2") is not None
+        from ..recordio import cv2_present
+        return cv2_present()
 
     @staticmethod
     def _resize_shorter(img, size):

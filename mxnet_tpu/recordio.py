@@ -195,21 +195,33 @@ def pack_img(header, img, quality=95, img_fmt=".jpg"):
         return pack(header, bio.getvalue())
 
 
+def cv2_present():
+    """Whether unpack_img/decode_payload would decode through cv2
+    (which yields BGR) — the one place callers consult to decide
+    channel normalization."""
+    import importlib.util
+    return importlib.util.find_spec("cv2") is not None
+
+
+def decode_payload(payload, iscolor=-1):
+    """Decode one record payload to an array: raw .npy passthrough,
+    else cv2 (BGR, the reference's convention) or PIL (RGB)."""
+    if payload[:6] == b"\x93NUMPY":
+        return np.load(io.BytesIO(payload))
+    try:
+        import cv2
+        return cv2.imdecode(np.frombuffer(payload, np.uint8), iscolor)
+    except ImportError:
+        try:
+            from PIL import Image
+            return np.asarray(Image.open(io.BytesIO(payload))
+                              .convert("RGB"))
+        except ImportError:
+            raise MXNetError(
+                "cannot decode image without cv2 or PIL; pack with "
+                "raw npy payloads in this environment")
+
+
 def unpack_img(s, iscolor=-1):
     header, payload = unpack(s)
-    if payload[:6] == b"\x93NUMPY":
-        img = np.load(io.BytesIO(payload))
-    else:
-        try:
-            import cv2
-            img = cv2.imdecode(np.frombuffer(payload, np.uint8), iscolor)
-        except ImportError:
-            try:
-                from PIL import Image
-                img = np.asarray(Image.open(io.BytesIO(payload))
-                                 .convert("RGB"))
-            except ImportError:
-                raise MXNetError(
-                    "cannot decode image without cv2 or PIL; pack with "
-                    "raw npy payloads in this environment")
-    return header, img
+    return header, decode_payload(payload, iscolor)

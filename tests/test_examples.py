@@ -124,3 +124,13 @@ def test_rcnn_smoke():
                    out.split("train: loss ")[1].split()[0:3:2]]
     assert np.isfinite(last) and last < first, out[-800:]
     assert "mAP:" in out
+
+
+def test_dcgan_smoke():
+    """Adversarial training with a Deconvolution generator (the only
+    model-scale transposed-conv consumer) stays finite and the
+    generator leaves its init regime."""
+    out = _run([sys.executable, "dcgan.py", "--steps", "60",
+                "--batch", "8"],
+               cwd=os.path.join(REPO, "examples/gan"), timeout=420)
+    assert "sample-spread" in out and "done in" in out, out[-600:]
