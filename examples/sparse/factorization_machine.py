@@ -1,0 +1,146 @@
+"""Factorization Machine over the sparse path (BASELINE config 5;
+ref: example/sparse/factorization_machine/train.py).
+
+FM score for a row with active feature ids F and values x:
+    y = w0 + sum_i w[i] x_i + 1/2 * ((sum_i v_i x_i)^2 - sum_i v_i^2 x_i^2)
+with w (V, 1) and v (V, K) both row-sparse tables — only the rows a
+batch touches move, through the row-granular AdaGrad kernels (or the
+parameter servers under --kvstore dist_sync, exactly like
+examples/sparse/wide_deep.py).
+
+    python examples/sparse/factorization_machine.py --steps 300
+    python tools/launch.py -n 2 -s 1 \
+        python examples/sparse/factorization_machine.py --kvstore dist_sync
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+VOCAB = 600
+FIELDS = 6          # active features per row
+DIM = 8
+
+
+def synth_batch(rng, batch, v_true, w_true):
+    ids = np.stack([rng.integers(0, VOCAB, batch)
+                    for _ in range(FIELDS)], axis=1)       # (B, F)
+    vals = rng.uniform(0.5, 1.5, (batch, FIELDS)).astype(np.float32)
+    vi = v_true[ids] * vals[..., None]                     # (B, F, D)
+    pair = 0.5 * ((vi.sum(1) ** 2).sum(-1)
+                  - (vi ** 2).sum((1, 2)))
+    logit = (w_true[ids] * vals).sum(1) + 0.3 * pair
+    prob = 1 / (1 + np.exp(-(logit - np.median(logit))))
+    label = (rng.random(batch) < prob).astype(np.float32)
+    return ids, vals, label
+
+
+def fm_loss(w_rows, v_rows, local, vals, label):
+    """w_rows (R, 1) / v_rows (R, D) gathered unique rows; local (B, F)
+    indexes into them."""
+    wi = w_rows[local, 0] * vals                           # (B, F)
+    vi = v_rows[local] * vals[..., None]                   # (B, F, D)
+    pair = 0.5 * ((vi.sum(1) ** 2).sum(-1) - (vi ** 2).sum((1, 2)))
+    logit = wi.sum(1) + pair
+    return jnp.mean(jax.nn.softplus(logit) - label * logit)
+
+
+grad_fn = jax.jit(jax.value_and_grad(fm_loss, argnums=(0, 1)))
+
+
+def _rsp(rows, vals, shape):
+    return RowSparseNDArray(nd.array(vals),
+                            nd.array(rows.astype(np.float32)), shape)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--kvstore", type=str, default=None)
+    args = ap.parse_args()
+
+    rng = np.random.default_rng(0)
+    v_true = (rng.normal(size=(VOCAB, DIM)) * 0.4).astype(np.float32)
+    w_true = (rng.normal(size=(VOCAB,)) * 0.4).astype(np.float32)
+
+    w = nd.array(rng.normal(size=(VOCAB, 1)).astype(np.float32) * 0.01)
+    v = nd.array(rng.normal(size=(VOCAB, DIM)).astype(np.float32) * 0.01)
+
+    opt = mx.optimizer.AdaGrad(learning_rate=args.lr, wd=0.0)
+    kv = None
+    rank = 0
+    if args.kvstore:
+        kv = mx.kvstore.create(args.kvstore)
+        rank = kv.rank
+        kv.init(0, w)
+        kv.init(1, v)
+        kv.set_optimizer(opt)
+        kv.barrier()
+        st_w = st_v = None
+    else:
+        st_w = opt.create_state(0, w)
+        st_v = opt.create_state(1, v)
+
+    data_rng = np.random.default_rng(50 + rank)
+    first = last = None
+    for step in range(args.steps):
+        ids, vals, label = synth_batch(data_rng, args.batch, v_true,
+                                       w_true)
+        rows, local = np.unique(ids, return_inverse=True)
+        local = local.reshape(ids.shape)
+        if kv is not None:
+            ow = RowSparseNDArray(nd.zeros((len(rows), 1)),
+                                  nd.array(rows.astype(np.float32)),
+                                  (VOCAB, 1))
+            ov = RowSparseNDArray(nd.zeros((len(rows), DIM)),
+                                  nd.array(rows.astype(np.float32)),
+                                  (VOCAB, DIM))
+            kv.row_sparse_pull(0, out=ow,
+                               row_ids=nd.array(rows.astype(np.float32)))
+            kv.row_sparse_pull(1, out=ov,
+                               row_ids=nd.array(rows.astype(np.float32)))
+            w_rows, v_rows = ow.data._data, ov.data._data
+        else:
+            w_rows, v_rows = w._data[rows], v._data[rows]
+
+        loss, (g_w, g_v) = grad_fn(w_rows, v_rows, local, vals, label)
+        if kv is not None:
+            kv.push(0, _rsp(rows, np.asarray(g_w), (VOCAB, 1)))
+            kv.push(1, _rsp(rows, np.asarray(g_v), (VOCAB, DIM)))
+        else:
+            opt.update(0, w, _rsp(rows, np.asarray(g_w), (VOCAB, 1)),
+                       st_w)
+            opt.update(1, v, _rsp(rows, np.asarray(g_v), (VOCAB, DIM)),
+                       st_v)
+        cur = float(loss)
+        first = first if first is not None else cur
+        last = cur
+        if step % 60 == 0:
+            print(f"[worker {rank}] step {step}: logloss {cur:.4f}",
+                  flush=True)
+
+    print(f"[worker {rank}] logloss {first:.4f} -> {last:.4f}", flush=True)
+    assert last < first
+    if kv is not None:
+        kv.barrier()
+        kv.close()
+    print(f"[worker {rank}] OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -144,6 +144,7 @@ def test_dist_bsp_round_drift_no_deadlock():
     N+1 (deadlock-then-timeout under the old per-key round counting)."""
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PALLAS_AXON_POOL_IPS"] = ""  # skip the axon tunnel hook
     env["MXNET_KVSTORE_REQUEST_TIMEOUT_MS"] = "30000"
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "launch.py"),
@@ -160,6 +161,7 @@ def test_wide_deep_example_local_and_dist():
     runs distributed with server-side updates + row-granular pulls."""
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PALLAS_AXON_POOL_IPS"] = ""  # skip the axon tunnel hook
     script = os.path.join(REPO, "examples", "sparse", "wide_deep.py")
     local = subprocess.run(
         [sys.executable, script, "--steps", "80"], env=env,
@@ -172,5 +174,28 @@ def test_wide_deep_example_local_and_dist():
          "--kvstore", "dist_sync", "--steps", "40"],
         env=env, capture_output=True, text=True, timeout=420)
     assert dist.returncode == 0, dist.stdout[-1500:] + dist.stderr[-800:]
+    for i in range(2):
+        assert f"[worker {i}] OK" in dist.stdout
+
+
+def test_factorization_machine_example():
+    """BASELINE config 5 second half: FM over row-sparse tables, local
+    and under the PS with server-side updates."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PALLAS_AXON_POOL_IPS"] = ""  # skip the axon tunnel hook
+    script = os.path.join(REPO, "examples", "sparse",
+                          "factorization_machine.py")
+    local = subprocess.run([sys.executable, script, "--steps", "120"],
+                           env=env, capture_output=True, text=True,
+                           timeout=300)
+    assert local.returncode == 0, local.stdout[-1000:] + local.stderr[-500:]
+    assert "[worker 0] OK" in local.stdout
+    dist = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "-s", "1", sys.executable, script,
+         "--kvstore", "dist_sync", "--steps", "40"],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert dist.returncode == 0, dist.stdout[-1200:] + dist.stderr[-500:]
     for i in range(2):
         assert f"[worker {i}] OK" in dist.stdout
