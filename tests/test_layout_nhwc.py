@@ -65,13 +65,16 @@ def test_layout_scope_builds_channel_last_layers():
     assert nn.BatchNorm()._axis == 1
 
 
+def _param_key(k):
+    import re
+    return re.sub(r"^[A-Za-z0-9]+\d+_", "", k)
+
+
 def _clone_params(src_net, dst_net):
-    def key(k):
-        return k.split("_", 1)[1] if "_" in k else k
-    vals = {key(k): v.data().asnumpy()
+    vals = {_param_key(k): v.data().asnumpy()
             for k, v in src_net.collect_params().items()}
     for k, p in dst_net.collect_params().items():
-        p.set_data(_nd(vals[key(k)]))
+        p.set_data(_nd(vals[_param_key(k)]))
 
 
 def test_resnet_nhwc_matches_nchw_inference_and_training():
@@ -135,24 +138,59 @@ def test_sg_conv_shape_infer_channel_last():
 
 def test_mobilenet_nhwc_matches_nchw():
     """BASELINE config 2's second model family builds channel-last too."""
-    import re
     rng = np.random.default_rng(3)
     x = rng.standard_normal((1, 3, 32, 32)).astype(np.float32)
     for maker in (vision.mobilenet0_25, vision.mobilenet_v2_0_25):
         outs = {}
-        ref = None
-        key = (lambda k: re.sub(r"^[A-Za-z0-9]+\d+_", "", k))
+        nets = {}
         for layout in ("NCHW", "NHWC"):
             net = maker(layout=layout)
             net.initialize()
             infer_shapes(net, (1, 3, 32, 32))
-            if layout == "NCHW":
-                ref = {key(k): v.data().asnumpy()
-                       for k, v in net.collect_params().items()}
-            else:
-                for k, p in net.collect_params().items():
-                    p.set_data(_nd(ref[key(k)]))
+            nets[layout] = net
+        _clone_params(nets["NCHW"], nets["NHWC"])
+        for layout, net in nets.items():
             net.hybridize()
             outs[layout] = net(_nd(x)).asnumpy()
         np.testing.assert_allclose(outs["NHWC"], outs["NCHW"], rtol=1e-4,
                                    atol=1e-4, err_msg=maker.__name__)
+
+
+def test_nhwc_gradients_match_nchw():
+    """The training path differentiates through NHWC conv/pool/BN
+    (bench's train section uses the best layout); gradients must match
+    the NCHW lowering parameter-for-parameter."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.gluon.block import _flatten
+
+    rng = np.random.default_rng(9)
+    x = jnp.asarray(rng.standard_normal((2, 3, 16, 16)), jnp.float32)
+    grads = {}
+    nets = {}
+    for layout in ("NCHW", "NHWC"):
+        net = vision.get_resnet(1, 18, classes=4, layout=layout)
+        net.initialize()
+        infer_shapes(net, (2, 3, 16, 16))
+        nets[layout] = net
+    _clone_params(nets["NCHW"], nets["NHWC"])
+    for layout, net in nets.items():
+        net.hybridize()
+        plist = sorted(net.collect_params().items())
+        pvals = tuple(p.data()._data for _, p in plist)
+        _, in_spec = _flatten([_nd(np.zeros((2, 3, 16, 16),
+                                            np.float32))])
+        jfn, _o, _a = net._build_cached(plist, in_spec, training=True)
+        k0 = jax.random.PRNGKey(0)
+
+        def loss(pv):
+            outs, _aux = jfn(pv, k0, x)
+            return jnp.sum(outs[0] ** 2)
+
+        g = jax.grad(loss)(pvals)
+        grads[layout] = {_param_key(n): np.asarray(gv)
+                         for (n, _p), gv in zip(plist, g)}
+    for name in grads["NCHW"]:
+        np.testing.assert_allclose(
+            grads["NHWC"][name], grads["NCHW"][name], rtol=2e-2,
+            atol=2e-3, err_msg=name)
