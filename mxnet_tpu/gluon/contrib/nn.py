@@ -2,6 +2,7 @@
 from __future__ import annotations
 
 from ..block import HybridBlock
+from ..nn import Embedding as _Embedding
 from ..nn import SyncBatchNorm  # noqa: F401  (re-export: lives in core nn here)
 
 
@@ -28,3 +29,26 @@ class HybridConcurrent(Concurrent):
 class Identity(HybridBlock):
     def hybrid_forward(self, F, x):
         return x
+
+
+class SparseEmbedding(_Embedding):
+    """Embedding whose gradient is row-sparse (ref:
+    contrib/nn/basic_layers.py:116 SparseEmbedding) — a thin veneer
+    over nn.Embedding(sparse_grad=True): the row-granular optimizer
+    kernels touch only the rows a batch used, and dist kvstores pull
+    rows on demand."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, prefix=None, params=None):
+        super().__init__(input_dim, output_dim, dtype=dtype,
+                         weight_initializer=weight_initializer,
+                         sparse_grad=True, prefix=prefix, params=params)
+
+    def hybrid_forward(self, F, x, weight):
+        return F._contrib_SparseEmbedding(
+            x, weight, input_dim=self._input_dim,
+            output_dim=self._output_dim)
+
+    def __repr__(self):
+        return (f"SparseEmbedding({self._input_dim} -> "
+                f"{self._output_dim})")

@@ -3,7 +3,7 @@
 Conv-RNN variants; VariationalDropoutCell."""
 from __future__ import annotations
 
-from ..rnn.rnn_cell import ModifierCell
+from ..rnn.rnn_cell import ModifierCell, RecurrentCell
 
 
 class VariationalDropoutCell(ModifierCell):
@@ -40,3 +40,178 @@ class VariationalDropoutCell(ModifierCell):
         return out, states
 
     forward = __call__
+
+
+# ---------------------------------------------------------------------------
+# Convolutional RNN cells (ref: python/mxnet/gluon/contrib/rnn/
+# conv_rnn_cell.py — i2h/h2h become convolutions over spatial state)
+# ---------------------------------------------------------------------------
+
+
+from ..nn.conv_layers import _pair as _tuple
+
+
+def _conv_out(dims, kernels, pads, dilates):
+    return tuple((d + 2 * p - dl * (k - 1) - 1) + 1
+                 for d, k, p, dl in zip(dims, kernels, pads, dilates))
+
+
+class _ConvRNNCellBase(RecurrentCell):
+    """Shared conv-cell machinery: i2h conv over the input, h2h conv
+    over the spatial hidden state (kernel pads chosen so the state
+    shape is invariant)."""
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                 h2h_kernel, i2h_pad=0, i2h_dilate=1, h2h_dilate=1,
+                 dims=2, activation="tanh", num_gates=1,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._ndims = dims
+        self._input_shape = tuple(input_shape)  # (C, *spatial)
+        self._hidden = int(hidden_channels)
+        self._activation = activation
+        self._gates = num_gates
+        self._i2h_kernel = _tuple(i2h_kernel, dims)
+        self._h2h_kernel = _tuple(h2h_kernel, dims)
+        for k in self._h2h_kernel:
+            if k % 2 == 0:
+                raise ValueError(
+                    "h2h_kernel dims must be odd so the state shape is "
+                    f"invariant; got {self._h2h_kernel}")
+        self._i2h_pad = _tuple(i2h_pad, dims)
+        self._i2h_dilate = _tuple(i2h_dilate, dims)
+        self._h2h_dilate = _tuple(h2h_dilate, dims)
+        self._h2h_pad = tuple(d * (k - 1) // 2 for k, d in
+                              zip(self._h2h_kernel, self._h2h_dilate))
+        self._state_spatial = _conv_out(
+            self._input_shape[1:], self._i2h_kernel, self._i2h_pad,
+            self._i2h_dilate)
+        if any(d <= 0 for d in self._state_spatial):
+            raise ValueError(
+                f"i2h kernel {self._i2h_kernel} / pad {self._i2h_pad} "
+                f"leave no spatial state for input {self._input_shape}: "
+                f"computed state spatial {self._state_spatial}")
+        ngh = self._gates * self._hidden
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight",
+                shape=(ngh, self._input_shape[0]) + self._i2h_kernel,
+                init=i2h_weight_initializer)
+            self.h2h_weight = self.params.get(
+                "h2h_weight",
+                shape=(ngh, self._hidden) + self._h2h_kernel,
+                init=h2h_weight_initializer)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(ngh,), init=i2h_bias_initializer)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(ngh,), init=h2h_bias_initializer)
+
+    def _convs(self, F, x, h, i2h_weight, h2h_weight, i2h_bias,
+               h2h_bias):
+        i2h = F.Convolution(
+            x, i2h_weight, i2h_bias, kernel=self._i2h_kernel,
+            pad=self._i2h_pad, dilate=self._i2h_dilate,
+            num_filter=self._gates * self._hidden)
+        h2h = F.Convolution(
+            h, h2h_weight, h2h_bias, kernel=self._h2h_kernel,
+            pad=self._h2h_pad, dilate=self._h2h_dilate,
+            num_filter=self._gates * self._hidden)
+        return i2h, h2h
+
+    def state_info(self, batch_size=0):
+        shape = (batch_size, self._hidden) + self._state_spatial
+        return [{"shape": shape} for _ in range(self._num_states)]
+
+
+class _ConvRNNCell(_ConvRNNCellBase):
+    _num_states = 1
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, num_gates=1, **kwargs)
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._convs(F, x, states[0], i2h_weight, h2h_weight,
+                               i2h_bias, h2h_bias)
+        out = F.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class _ConvLSTMCell(_ConvRNNCellBase):
+    _num_states = 2
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, num_gates=4, **kwargs)
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._convs(F, x, states[0], i2h_weight, h2h_weight,
+                               i2h_bias, h2h_bias)
+        gates = i2h + h2h
+        sl = F.SliceChannel(gates, num_outputs=4, axis=1)
+        i = F.Activation(sl[0], act_type="sigmoid")
+        f = F.Activation(sl[1], act_type="sigmoid")
+        g = F.Activation(sl[2], act_type=self._activation)
+        o = F.Activation(sl[3], act_type="sigmoid")
+        c = f * states[1] + i * g
+        h = o * F.Activation(c, act_type=self._activation)
+        return h, [h, c]
+
+
+class _ConvGRUCell(_ConvRNNCellBase):
+    _num_states = 1
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, num_gates=3, **kwargs)
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._convs(F, x, states[0], i2h_weight, h2h_weight,
+                               i2h_bias, h2h_bias)
+        i_r, i_z, i_n = F.SliceChannel(i2h, num_outputs=3, axis=1)
+        h_r, h_z, h_n = F.SliceChannel(h2h, num_outputs=3, axis=1)
+        r = F.Activation(i_r + h_r, act_type="sigmoid")
+        z = F.Activation(i_z + h_z, act_type="sigmoid")
+        n = F.Activation(i_n + r * h_n, act_type=self._activation)
+        out = (1 - z) * n + z * states[0]
+        return out, [out]
+
+
+def _make_cell(base, dims, name):
+    class Cell(base):
+        def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                     h2h_kernel, i2h_pad=0, i2h_dilate=1, h2h_dilate=1,
+                     activation="tanh", i2h_weight_initializer=None,
+                     h2h_weight_initializer=None,
+                     i2h_bias_initializer="zeros",
+                     h2h_bias_initializer="zeros", prefix=None,
+                     params=None):
+            super().__init__(
+                input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                i2h_pad=i2h_pad, i2h_dilate=i2h_dilate,
+                h2h_dilate=h2h_dilate, dims=dims,
+                activation=activation,
+                i2h_weight_initializer=i2h_weight_initializer,
+                h2h_weight_initializer=h2h_weight_initializer,
+                i2h_bias_initializer=i2h_bias_initializer,
+                h2h_bias_initializer=h2h_bias_initializer,
+                prefix=prefix, params=params)
+
+    Cell.__name__ = Cell.__qualname__ = name
+    Cell.__doc__ = (f"{name} (ref: gluon/contrib/rnn/conv_rnn_cell.py "
+                    f"{name}) — recurrent cell whose i2h/h2h transforms "
+                    "are convolutions over spatial state.")
+    return Cell
+
+
+Conv1DRNNCell = _make_cell(_ConvRNNCell, 1, "Conv1DRNNCell")
+Conv2DRNNCell = _make_cell(_ConvRNNCell, 2, "Conv2DRNNCell")
+Conv3DRNNCell = _make_cell(_ConvRNNCell, 3, "Conv3DRNNCell")
+Conv1DLSTMCell = _make_cell(_ConvLSTMCell, 1, "Conv1DLSTMCell")
+Conv2DLSTMCell = _make_cell(_ConvLSTMCell, 2, "Conv2DLSTMCell")
+Conv3DLSTMCell = _make_cell(_ConvLSTMCell, 3, "Conv3DLSTMCell")
+Conv1DGRUCell = _make_cell(_ConvGRUCell, 1, "Conv1DGRUCell")
+Conv2DGRUCell = _make_cell(_ConvGRUCell, 2, "Conv2DGRUCell")
+Conv3DGRUCell = _make_cell(_ConvGRUCell, 3, "Conv3DGRUCell")

@@ -1,0 +1,86 @@
+"""gluon.contrib conv-RNN cells (ref: gluon/contrib/rnn/conv_rnn_cell.py):
+state shapes, unroll, numpy parity for the LSTM gate math."""
+import numpy as np
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon.contrib import rnn as crnn
+from mxnet_tpu.ndarray.ndarray import NDArray
+
+
+def _nd(a):
+    return NDArray(jnp.asarray(a))
+
+
+def test_conv_rnn_cell_shapes_and_unroll():
+    rng = np.random.default_rng(0)
+    for cell_cls, nstates in ((crnn.Conv2DRNNCell, 1),
+                              (crnn.Conv2DLSTMCell, 2),
+                              (crnn.Conv2DGRUCell, 1)):
+        cell = cell_cls(input_shape=(3, 8, 8), hidden_channels=4,
+                        i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+        cell.initialize()
+        infos = cell.state_info(batch_size=2)
+        assert len(infos) == nstates
+        assert infos[0]["shape"] == (2, 4, 8, 8)
+        x = _nd(rng.standard_normal((2, 5, 3, 8, 8)).astype(np.float32))
+        out, states = cell.unroll(5, x, layout="NTC")
+        assert out.shape == (2, 5, 4, 8, 8)
+        assert len(states) == nstates
+        assert np.isfinite(out.asnumpy()).all()
+
+
+def test_conv1d_and_3d_variants():
+    rng = np.random.default_rng(1)
+    c1 = crnn.Conv1DLSTMCell(input_shape=(2, 10), hidden_channels=3,
+                             i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    c1.initialize()
+    h, s = c1(_nd(rng.standard_normal((2, 2, 10)).astype(np.float32)),
+              c1.begin_state(2))
+    assert h.shape == (2, 3, 10) and len(s) == 2
+    c3 = crnn.Conv3DGRUCell(input_shape=(1, 4, 4, 4), hidden_channels=2,
+                            i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    c3.initialize()
+    h3, _ = c3(_nd(rng.standard_normal((1, 1, 4, 4, 4))
+                   .astype(np.float32)), c3.begin_state(1))
+    assert h3.shape == (1, 2, 4, 4, 4)
+
+
+def test_conv_lstm_matches_numpy():
+    """One Conv2DLSTM step against an explicit numpy computation."""
+    rng = np.random.default_rng(2)
+    H, C, S = 2, 1, 4
+    cell = crnn.Conv2DLSTMCell(input_shape=(C, S, S), hidden_channels=H,
+                               i2h_kernel=1, h2h_kernel=1)
+    cell.initialize()
+    wi = rng.standard_normal((4 * H, C, 1, 1)).astype(np.float32)
+    wh = rng.standard_normal((4 * H, H, 1, 1)).astype(np.float32)
+    bi = rng.standard_normal(4 * H).astype(np.float32)
+    bh = rng.standard_normal(4 * H).astype(np.float32)
+    cell.i2h_weight.set_data(_nd(wi))
+    cell.h2h_weight.set_data(_nd(wh))
+    cell.i2h_bias.set_data(_nd(bi))
+    cell.h2h_bias.set_data(_nd(bh))
+    x = rng.standard_normal((1, C, S, S)).astype(np.float32)
+    h0 = rng.standard_normal((1, H, S, S)).astype(np.float32)
+    c0 = rng.standard_normal((1, H, S, S)).astype(np.float32)
+    out, (h1, c1) = cell(_nd(x), [_nd(h0), _nd(c0)])
+
+    # 1x1 convs are per-pixel matmuls over channels
+    gates = (np.einsum("gc,bcij->bgij", wi[:, :, 0, 0], x)
+             + np.einsum("gh,bhij->bgij", wh[:, :, 0, 0], h0)
+             + (bi + bh)[None, :, None, None])
+    i, f, g, o = np.split(gates, 4, axis=1)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    c_ref = sig(f) * c0 + sig(i) * np.tanh(g)
+    h_ref = sig(o) * np.tanh(c_ref)
+    np.testing.assert_allclose(c1.asnumpy(), c_ref, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(h1.asnumpy(), h_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_even_h2h_kernel_rejected():
+    import pytest
+    with pytest.raises(ValueError):
+        crnn.Conv2DRNNCell(input_shape=(1, 4, 4), hidden_channels=1,
+                           i2h_kernel=3, h2h_kernel=2, i2h_pad=1)
