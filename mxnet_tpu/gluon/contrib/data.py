@@ -1,0 +1,83 @@
+"""Contrib data utilities (ref: python/mxnet/gluon/contrib/data/).
+
+No network egress in this environment: the WikiText datasets read
+pre-downloaded token files from ``root`` (same convention as the core
+vision datasets) and raise with instructions otherwise.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ...base import MXNetError
+from ..data.sampler import Sampler
+from ..data.dataset import Dataset
+
+
+class IntervalSampler(Sampler):
+    """Samples [0, length) at fixed intervals (ref:
+    contrib/data/sampler.py:25 — used to deal sequence shards across
+    truncated-BPTT streams)."""
+
+    def __init__(self, length, interval, rollover=True):
+        if interval > length:
+            raise MXNetError(
+                f"interval {interval} must be <= length {length}")
+        self._length = length
+        self._interval = interval
+        self._rollover = rollover
+
+    def __iter__(self):
+        for i in range(self._interval if self._rollover else 1):
+            yield from range(i, self._length, self._interval)
+
+    def __len__(self):
+        return self._length if self._rollover else \
+            len(range(0, self._length, self._interval))
+
+
+class _WikiText(Dataset):
+    """Language-modelling dataset over a local tokens file: the text is
+    split on whitespace, vocab built on first use, and samples are
+    fixed-length id sequences (ref: contrib/data/text.py _WikiText —
+    the download step is out of scope here; point ``root`` at the
+    extracted .tokens files)."""
+
+    _fname = None
+
+    def __init__(self, root, segment="train", seq_len=35, vocab=None):
+        path = os.path.join(root, self._fname.format(segment=segment))
+        if not os.path.exists(path):
+            raise MXNetError(
+                f"{type(self).__name__}: tokens file {path} not found; "
+                "download the dataset out of band and point root= at it")
+        with open(path, encoding="utf-8") as f:
+            tokens = f.read().replace("\n", " <eos> ").split()
+        if vocab is None:
+            from ...contrib.text.vocab import Vocabulary
+            from collections import Counter
+            vocab = Vocabulary(Counter(tokens))
+        self.vocab = vocab
+        idx = vocab.to_indices(tokens)
+        n = (len(idx) - 1) // seq_len
+        self._seq_len = seq_len
+        self._data = np.asarray(idx[:n * seq_len], np.int32) \
+            .reshape(n, seq_len)
+        self._label = np.asarray(idx[1:n * seq_len + 1], np.int32) \
+            .reshape(n, seq_len)
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, i):
+        from ...ndarray import array
+        return array(self._data[i]), array(self._label[i])
+
+
+class WikiText2(_WikiText):
+    _fname = "wiki.{segment}.tokens"
+
+
+class WikiText103(_WikiText):
+    _fname = "wiki.{segment}.tokens"

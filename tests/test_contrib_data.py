@@ -1,0 +1,36 @@
+"""gluon.contrib.data (ref: python/mxnet/gluon/contrib/data/)."""
+import os
+
+import numpy as np
+
+from mxnet_tpu.gluon.contrib.data import IntervalSampler, WikiText2
+
+
+def test_interval_sampler_matches_reference_doc():
+    assert list(IntervalSampler(13, 3)) == \
+        [0, 3, 6, 9, 12, 1, 4, 7, 10, 2, 5, 8, 11]
+    assert list(IntervalSampler(13, 3, rollover=False)) == [0, 3, 6, 9, 12]
+    assert len(IntervalSampler(13, 3)) == 13
+    assert len(IntervalSampler(13, 3, rollover=False)) == 5
+
+
+def test_wikitext_local_file(tmp_path):
+    (tmp_path / "wiki.train.tokens").write_text(
+        "a b c d e \n f g h i j \n" * 10)
+    ds = WikiText2(str(tmp_path), "train", seq_len=4)
+    x0, y0 = ds[0]
+    assert x0.shape == (4,) and y0.shape == (4,)
+    flat_x = np.concatenate([ds[i][0].asnumpy() for i in range(len(ds))])
+    flat_y = np.concatenate([ds[i][1].asnumpy() for i in range(len(ds))])
+    np.testing.assert_array_equal(flat_y[:-1], flat_x[1:])
+    # shared vocab reuse across segments
+    (tmp_path / "wiki.valid.tokens").write_text("a b c <eos> ")
+    ds2 = WikiText2(str(tmp_path), "valid", seq_len=2, vocab=ds.vocab)
+    assert ds2.vocab is ds.vocab
+
+
+def test_wikitext_missing_file_raises(tmp_path):
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError):
+        WikiText2(str(tmp_path), "test")
