@@ -205,10 +205,14 @@ def supervise():
         # error lines must still go through the retry loop
         if line is not None and (rc == 0 or '"error"' not in line):
             print(line, flush=True)
-            if rc == 0 and '"partial"' not in line:
-                # only COMPLETE measurements become the stale fallback —
-                # a rescued partial headline must not shadow a prior
-                # full record (it lacks the fp32/int8/mfu keys)
+            if rc == 0 and '"partial"' not in line and \
+                    ('"backend": "tpu"' in line
+                     or '"backend": "axon"' in line) and \
+                    ("bs%d" % BATCH) in line:
+                # only COMPLETE, FULL-SIZE, ON-CHIP measurements become
+                # the stale fallback — a rescued partial headline lacks
+                # the aux keys, and a CPU smoke run (tiny batch, cpu
+                # backend) must never masquerade as a chip number
                 _save_last_good(line)
             return 0
         if rc >= 0:
@@ -433,6 +437,7 @@ def main():
         "value": round(best_ips, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(best_ips / TARGET, 4),
+        "backend": jax.default_backend(),
         "bf16_variant_best": best_name,
         # model-FLOPs utilization: achieved / peak matmul throughput;
         # one mfu per measured bf16 layout/fusion variant

@@ -21,9 +21,9 @@ class IntervalSampler(Sampler):
     truncated-BPTT streams)."""
 
     def __init__(self, length, interval, rollover=True):
-        if interval > length:
+        if not 1 <= interval <= length:
             raise MXNetError(
-                f"interval {interval} must be <= length {length}")
+                f"interval {interval} must be in [1, length={length}]")
         self._length = length
         self._interval = interval
         self._rollover = rollover
@@ -47,6 +47,7 @@ class _WikiText(Dataset):
     _fname = None
 
     def __init__(self, root, segment="train", seq_len=35, vocab=None):
+        root = os.path.expanduser(root)
         path = os.path.join(root, self._fname.format(segment=segment))
         if not os.path.exists(path):
             raise MXNetError(

@@ -75,8 +75,14 @@ class _ConvRNNCellBase(RecurrentCell):
         self._gates = num_gates
         self._i2h_kernel = _tuple(i2h_kernel, dims)
         self._h2h_kernel = _tuple(h2h_kernel, dims)
+        self._i2h_pad = _tuple(i2h_pad, dims)
+        self._i2h_dilate = _tuple(i2h_dilate, dims)
+        self._h2h_dilate = _tuple(h2h_dilate, dims)
         for name, t in (("i2h_kernel", self._i2h_kernel),
-                        ("h2h_kernel", self._h2h_kernel)):
+                        ("h2h_kernel", self._h2h_kernel),
+                        ("i2h_pad", self._i2h_pad),
+                        ("i2h_dilate", self._i2h_dilate),
+                        ("h2h_dilate", self._h2h_dilate)):
             if len(t) != dims:
                 raise ValueError(
                     f"{name} {t} must have {dims} dims for this cell")
@@ -85,15 +91,6 @@ class _ConvRNNCellBase(RecurrentCell):
                 raise ValueError(
                     "h2h_kernel dims must be odd so the state shape is "
                     f"invariant; got {self._h2h_kernel}")
-        self._i2h_pad = _tuple(i2h_pad, dims)
-        self._i2h_dilate = _tuple(i2h_dilate, dims)
-        self._h2h_dilate = _tuple(h2h_dilate, dims)
-        for name, t in (("i2h_pad", self._i2h_pad),
-                        ("i2h_dilate", self._i2h_dilate),
-                        ("h2h_dilate", self._h2h_dilate)):
-            if len(t) != dims:
-                raise ValueError(
-                    f"{name} {t} must have {dims} dims for this cell")
         self._h2h_pad = tuple(d * (k - 1) // 2 for k, d in
                               zip(self._h2h_kernel, self._h2h_dilate))
         self._state_spatial = _conv_out(

@@ -34,3 +34,25 @@ def test_wikitext_missing_file_raises(tmp_path):
     from mxnet_tpu.base import MXNetError
     with pytest.raises(MXNetError):
         WikiText2(str(tmp_path), "test")
+
+
+# gluon.contrib.nn ------------------------------------------------------
+
+
+def test_sparse_embedding_block():
+    import jax.numpy as jnp
+    from mxnet_tpu.gluon.contrib.nn import SparseEmbedding
+    from mxnet_tpu.ndarray.ndarray import NDArray
+
+    def _nd(a):
+        return NDArray(jnp.asarray(a))
+
+    rng = np.random.default_rng(4)
+    emb = SparseEmbedding(6, 3)
+    emb.initialize()
+    w = rng.standard_normal((6, 3)).astype(np.float32)
+    emb.weight.set_data(_nd(w))
+    out = emb(_nd(np.array([4, 0, 4], np.float32)))
+    np.testing.assert_allclose(out.asnumpy(), w[[4, 0, 4]], rtol=1e-6)
+    assert emb.weight.grad_stype == "row_sparse"
+    assert "SparseEmbedding(6 -> 3)" in repr(emb)

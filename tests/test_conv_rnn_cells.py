@@ -91,16 +91,3 @@ def test_kernel_dim_mismatch_rejected():
     with pytest.raises(ValueError):
         crnn.Conv3DLSTMCell(input_shape=(1, 4, 4, 4), hidden_channels=1,
                             i2h_kernel=(3, 3), h2h_kernel=3)
-
-
-def test_sparse_embedding_block():
-    from mxnet_tpu.gluon.contrib.nn import SparseEmbedding
-    rng = np.random.default_rng(4)
-    emb = SparseEmbedding(6, 3)
-    emb.initialize()
-    w = rng.standard_normal((6, 3)).astype(np.float32)
-    emb.weight.set_data(_nd(w))
-    out = emb(_nd(np.array([4, 0, 4], np.float32)))
-    np.testing.assert_allclose(out.asnumpy(), w[[4, 0, 4]], rtol=1e-6)
-    assert emb.weight.grad_stype == "row_sparse"
-    assert "SparseEmbedding(6 -> 3)" in repr(emb)
