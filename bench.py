@@ -234,6 +234,13 @@ def supervise():
             stale = json.loads(prior["line"])
             if not isinstance(stale, dict):
                 raise ValueError("saved line is not a JSON object")
+            # load-time gate: a saved run from a different config (e.g. a
+            # small-batch MXTPU_BENCH_BATCH dev run — its save-side gate
+            # compared against its OWN batch) must never stand in for
+            # this round's full-size metric
+            if stale.get("metric") != METRIC:
+                raise ValueError("saved metric %r != current %r"
+                                 % (stale.get("metric"), METRIC))
             stale["stale"] = True
             stale["stale_reason"] = str(last_err)[:200]
             stale["measured_at"] = prior.get("measured_at")

@@ -1,0 +1,79 @@
+"""Multiclass SVM head via the SVMOutput op (ref:
+example/svm_mnist/svm_mnist.py — swap SoftmaxOutput for SVMOutput to
+train an MLP with hinge loss, symbolic Module API).
+
+Uses the *symbolic* path end-to-end: mx.sym graph with SVMOutput
+(squared hinge), Module.fit over an NDArrayIter of synthetic 4-class
+Gaussian data. Exercises the legacy symbol+Module stack and the
+SVMOutput op's margin gradient.
+
+    python examples/svm_mnist/svm_mnist.py --epochs 5
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx
+
+DIM = 16
+N_CLASS = 4
+
+
+CENTERS = np.random.default_rng(42).normal(0, 1, (N_CLASS, DIM)) * 2.0
+
+
+def make_data(rng, n):
+    ys = rng.integers(0, N_CLASS, n)
+    xs = CENTERS[ys] + rng.normal(0, 0.7, (n, DIM))
+    return xs.astype(np.float32), ys.astype(np.float32)
+
+
+def build_sym(use_linear=False):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=N_CLASS, name="fc2")
+    return mx.sym.SVMOutput(net, name="svm", use_linear=use_linear)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=5)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args()
+
+    t0 = time.time()
+    rng = np.random.default_rng(0)
+    xs, ys = make_data(rng, 4000)
+    vx, vy = make_data(rng, 1000)
+
+    train = mx.io.NDArrayIter(xs, ys, args.batch, shuffle=True,
+                              label_name="svm_label")
+    val = mx.io.NDArrayIter(vx, vy, args.batch, label_name="svm_label")
+
+    mod = mx.mod.Module(build_sym(), data_names=("data",),
+                        label_names=("svm_label",))
+    mod.fit(train, eval_data=val,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            eval_metric="acc",
+            num_epoch=args.epochs)
+
+    val.reset()
+    score = mod.score(val, "acc")
+    acc = dict(score)["accuracy"]
+    print("elapsed %.1fs" % (time.time() - t0))
+    print("final validation accuracy %.4f" % acc)
+
+
+if __name__ == "__main__":
+    main()

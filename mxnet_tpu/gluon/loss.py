@@ -123,8 +123,10 @@ class KLDivLoss(Loss):
 
 class CTCLoss(Loss):
     """Connectionist temporal classification (ref: loss.py CTCLoss; op
-    src/operator/nn/ctc_loss.cc). Lowered to optax.ctc_loss — a pure-JAX
-    dynamic-program that XLA compiles to an on-device scan."""
+    src/operator/nn/ctc_loss.cc). Routed through the registered CTCLoss
+    op (optax.ctc_loss under the hood) so the result stays on the
+    autograd tape — `loss.backward()` through this head works exactly
+    like any other gluon loss."""
 
     def __init__(self, layout="NTC", label_layout="NT", weight=None,
                  **kwargs):
@@ -134,32 +136,15 @@ class CTCLoss(Loss):
 
     def hybrid_forward(self, F, pred, label, pred_lengths=None,
                        label_lengths=None, sample_weight=None):
-        import jax.numpy as jnp
-        import optax
-        from ..ndarray import NDArray
-
-        logits = pred._data
-        labels = label._data.astype(jnp.int32)
-        if self._layout == "TNC":
-            logits = jnp.swapaxes(logits, 0, 1)
+        if self._layout == "NTC":
+            pred = F.swapaxes(pred, dim1=0, dim2=1)   # op wants (T, N, C)
         if self._label_layout == "TN":
-            labels = jnp.swapaxes(labels, 0, 1)
-        B, T = logits.shape[0], logits.shape[1]
-        if pred_lengths is None:
-            logit_pad = jnp.zeros((B, T), jnp.float32)
-        else:
-            steps = jnp.arange(T)[None, :]
-            logit_pad = (steps >= pred_lengths._data[:, None]).astype(jnp.float32)
-        L = labels.shape[1]
-        if label_lengths is None:
-            label_pad = (labels == 0).astype(jnp.float32)
-        else:
-            steps = jnp.arange(L)[None, :]
-            label_pad = (steps >= label_lengths._data[:, None]).astype(jnp.float32)
-        # optax blank_id default 0 matches the reference's blank convention
-        loss = optax.ctc_loss(logits, logit_pad, labels, label_pad)
-        out = NDArray(loss)
-        return _apply_weighting(F, out, self._weight, sample_weight)
+            label = F.swapaxes(label, dim1=0, dim2=1)  # op wants (N, L)
+        loss = F.CTCLoss(
+            pred, label, pred_lengths, label_lengths,
+            use_data_lengths=pred_lengths is not None,
+            use_label_lengths=label_lengths is not None)
+        return _apply_weighting(F, loss, self._weight, sample_weight)
 
 
 class HuberLoss(Loss):

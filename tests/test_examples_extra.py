@@ -1,0 +1,99 @@
+"""Smoke gates for the round-4 example families (ref: the reference's
+example/ breadth — adversary, recommenders, numpy-ops,
+cnn_text_classification, bi-lstm-sort, ctc, multi-task, autoencoder,
+svm_mnist, nce-loss). Each runs the script small-but-real and asserts
+its printed learning signal, mirroring tests/test_examples.py."""
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(rel, args, timeout=420):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    cmd = [sys.executable, os.path.join(REPO, rel)] + args
+    r = subprocess.run(cmd, cwd=REPO, env=env, timeout=timeout,
+                       stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    out = r.stdout.decode(errors="replace")
+    assert r.returncode == 0, out[-2000:]
+    return out
+
+
+def _get(out, pattern):
+    m = re.search(pattern, out)
+    assert m, out[-1500:]
+    return float(m.group(1))
+
+
+def test_adversary_fgsm():
+    out = _run("examples/adversary/fgsm.py",
+               ["--steps", "120", "--eps", "0.25"])
+    clean = _get(out, r"clean accuracy ([0-9.]+)")
+    adv = _get(out, r"adversarial accuracy ([0-9.]+)")
+    assert clean > 0.9, out[-500:]
+    assert adv < clean - 0.25, (clean, adv)
+
+
+def test_recommender_matrix_fact():
+    out = _run("examples/recommenders/matrix_fact.py", ["--steps", "300"])
+    r0 = _get(out, r"initial holdout rmse ([0-9.]+)")
+    r1 = _get(out, r"final holdout rmse ([0-9.]+)")
+    assert r1 < 0.7 * r0, (r0, r1)
+    assert r1 < 0.30, (r0, r1)
+
+
+def test_numpy_ops_custom_softmax():
+    out = _run("examples/numpy-ops/custom_softmax.py", ["--steps", "150"])
+    acc = _get(out, r"final accuracy ([0-9.]+)")
+    assert acc > 0.9, out[-500:]
+
+
+def test_text_cnn():
+    out = _run("examples/cnn_text_classification/text_cnn.py",
+               ["--steps", "150"])
+    acc = _get(out, r"final accuracy ([0-9.]+)")
+    assert acc > 0.85, out[-500:]
+
+
+def test_bi_lstm_sort():
+    out = _run("examples/bi-lstm-sort/bi_lstm_sort.py", ["--steps", "250"])
+    acc = _get(out, r"token accuracy ([0-9.]+)")
+    assert acc > 0.8, out[-500:]
+
+
+def test_ctc_lstm_ocr():
+    # 150 steps keeps the single-core CI cost bounded; full convergence
+    # (seq acc 1.0 at 300 steps) is documented in the example header
+    out = _run("examples/ctc/lstm_ocr.py", ["--steps", "150"])
+    acc = _get(out, r"sequence accuracy ([0-9.]+)")
+    assert acc > 0.4, out[-500:]
+
+
+def test_multi_task():
+    out = _run("examples/multi-task/multitask_mnist.py", ["--steps", "150"])
+    acc_c = _get(out, r"class accuracy ([0-9.]+)")
+    acc_p = _get(out, r"parity accuracy ([0-9.]+)")
+    assert acc_c > 0.8 and acc_p > 0.8, (acc_c, acc_p)
+
+
+def test_vae():
+    out = _run("examples/autoencoder/vae.py", ["--steps", "250"])
+    mse = _get(out, r"final recon mse ([0-9.]+)")
+    base = _get(out, r"baseline ([0-9.]+)")
+    assert mse < 0.5 * base, (mse, base)
+
+
+def test_svm_mnist():
+    out = _run("examples/svm_mnist/svm_mnist.py", ["--epochs", "4"])
+    acc = _get(out, r"final validation accuracy ([0-9.]+)")
+    assert acc > 0.9, out[-500:]
+
+
+def test_nce_skipgram():
+    out = _run("examples/nce-loss/skipgram_nce.py", ["--steps", "300"])
+    within = _get(out, r"within-block cosine ([0-9.-]+)")
+    across = _get(out, r"across-block cosine ([0-9.-]+)")
+    assert within > across + 0.15, (within, across)
