@@ -1,0 +1,26 @@
+"""Shared harness for example smoke gates: run a repo script as a
+subprocess (clean PYTHONPATH so the axon sitecustomize never claims the
+TPU tunnel from a CI worker) and regex out its printed learning signal."""
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_example(rel, args, timeout=420):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    cmd = [sys.executable, os.path.join(REPO, rel)] + args
+    r = subprocess.run(cmd, cwd=REPO, env=env, timeout=timeout,
+                       stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    out = r.stdout.decode(errors="replace")
+    assert r.returncode == 0, out[-2000:]
+    return out
+
+
+def get_metric(out, pattern):
+    m = re.search(pattern, out)
+    assert m, out[-1500:]
+    return float(m.group(1))

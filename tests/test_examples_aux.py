@@ -1,0 +1,53 @@
+"""Smoke gates for the auxiliary-methods example families (ref:
+example/profiler, example/svrg_module, example/bayesian-methods,
+example/restricted-boltzmann-machine, example/stochastic-depth). Each
+runs the script small-but-real and asserts its printed learning
+signal, mirroring tests/test_examples.py."""
+import json
+
+from example_harness import get_metric as _get, run_example as _run
+
+
+def test_profiler_example(tmp_path):
+    trace = str(tmp_path / "profile.json")
+    out = _run("examples/profiler/profile_train.py",
+               ["--steps", "30", "--out", trace])
+    n_events = _get(out, r"trace events (\d+)")
+    n_tasks = _get(out, r"user tasks (\d+)")
+    assert n_events > 50, out[-500:]
+    assert n_tasks >= 1, out[-500:]
+    with open(trace) as f:
+        parsed = json.load(f)
+    events = parsed["traceEvents"] if isinstance(parsed, dict) else parsed
+    assert any(e.get("name") == "epoch0" for e in events)
+
+
+def test_svrg_regression():
+    out = _run("examples/svrg_module/svrg_regression.py", ["--epochs", "6"])
+    first = _get(out, r"initial epoch mse ([0-9.]+)")
+    last = _get(out, r"final epoch mse ([0-9.]+)")
+    assert last < 0.05 * first, (first, last)
+    assert last < 0.1, (first, last)
+
+
+def test_sgld_gaussian():
+    out = _run("examples/bayesian-methods/sgld_gaussian.py",
+               ["--steps", "1500", "--burnin", "300"])
+    err = _get(out, r"posterior mean abs error ([0-9.]+)")
+    ratio = _get(out, r"posterior std ratio ([0-9.]+)")
+    assert err < 0.1, out[-500:]
+    assert 0.5 < ratio < 2.0, out[-500:]
+
+
+def test_binary_rbm():
+    out = _run("examples/restricted-boltzmann-machine/binary_rbm.py",
+               ["--steps", "400"])
+    ratio = _get(out, r"error ratio ([0-9.]+)")
+    assert ratio < 0.5, out[-500:]
+
+
+def test_stochastic_depth():
+    out = _run("examples/stochastic-depth/sd_resnet.py",
+               ["--steps", "200"])
+    acc = _get(out, r"final accuracy ([0-9.]+)")
+    assert acc > 0.85, out[-500:]

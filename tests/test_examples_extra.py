@@ -3,29 +3,7 @@ example/ breadth — adversary, recommenders, numpy-ops,
 cnn_text_classification, bi-lstm-sort, ctc, multi-task, autoencoder,
 svm_mnist, nce-loss). Each runs the script small-but-real and asserts
 its printed learning signal, mirroring tests/test_examples.py."""
-import os
-import re
-import subprocess
-import sys
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def _run(rel, args, timeout=420):
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO
-    cmd = [sys.executable, os.path.join(REPO, rel)] + args
-    r = subprocess.run(cmd, cwd=REPO, env=env, timeout=timeout,
-                       stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
-    out = r.stdout.decode(errors="replace")
-    assert r.returncode == 0, out[-2000:]
-    return out
-
-
-def _get(out, pattern):
-    m = re.search(pattern, out)
-    assert m, out[-1500:]
-    return float(m.group(1))
+from example_harness import get_metric as _get, run_example as _run
 
 
 def test_adversary_fgsm():

@@ -1,0 +1,205 @@
+"""MFU diagnosis probe: what limits ResNet-50 throughput on this chip?
+
+Times (a) a raw bf16 matmul (MXU ceiling), (b) a representative conv
+microbench, (c) a hand-written pure-JAX NHWC bf16 ResNet-50 forward
+with folded BN (the framework-free ceiling), and (d) the framework's
+own hybridized forward, at several batch sizes. Comparing (c) vs (d)
+separates lowering overhead from XLA/hardware limits.
+
+    python tools/mfu_probe.py [--quick]
+"""
+from __future__ import annotations
+
+import argparse
+import functools
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+PEAK_TFLOPS = float(os.environ.get("MXTPU_PEAK_TFLOPS", "197"))
+
+
+def _sync_factory():
+    import jax
+    import jax.numpy as jnp
+    reduce_fn = jax.jit(lambda t: jnp.sum(t.astype(jnp.float32)))
+    return lambda out: float(reduce_fn(out))
+
+
+def timeit(fn, args, sync, iters=30, warmup=3):
+    for _ in range(warmup):
+        sync(fn(*args))
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = fn(*args)
+        sync(out)
+        dt = (time.perf_counter() - t0) / iters
+        best = dt if best is None else min(best, dt)
+    return best
+
+
+def probe_matmul(sync):
+    import jax
+    import jax.numpy as jnp
+    n = 4096
+    a = jnp.ones((n, n), jnp.bfloat16)
+    f = jax.jit(lambda x, y: x @ y)
+    dt = timeit(f, (a, a), sync)
+    tf = 2 * n ** 3 / dt / 1e12
+    print("matmul %dx%d bf16: %.1f TFLOP/s (%.2f of peak)"
+          % (n, n, tf, tf / PEAK_TFLOPS))
+
+
+def probe_conv(sync, batch=128):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    # mid-network ResNet conv: 3x3 s1 28x28x128
+    x = jnp.ones((batch, 28, 28, 128), jnp.bfloat16)
+    w = jnp.ones((3, 3, 128, 128), jnp.bfloat16)
+    f = jax.jit(functools.partial(
+        lax.conv_general_dilated, window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC")))
+    dt = timeit(f, (x, w), sync)
+    fl = 2 * batch * 28 * 28 * 128 * 128 * 9
+    tf = fl / dt / 1e12
+    print("conv3x3 28x28x128 bs%d: %.1f TFLOP/s (%.2f of peak)"
+          % (batch, tf, tf / PEAK_TFLOPS))
+
+
+def _pure_resnet50(batch):
+    """Framework-free NHWC bf16 ResNet-50 v1 with BN pre-folded."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    rng = np.random.default_rng(0)
+    layers = [3, 4, 6, 3]
+    chans = [64, 128, 256, 512]
+
+    params = []
+
+    def mk(shape):
+        params.append(jnp.asarray(
+            rng.normal(0, 0.05, shape).astype(np.float32), jnp.bfloat16))
+        return len(params) - 1
+
+    def conv_spec(cin, cout, k):
+        return mk((k, k, cin, cout)), mk((cout,))  # weight, folded bias
+
+    stem = conv_spec(3, 64, 7)
+    blocks = []
+    cin = 64
+    for st, (n, c) in enumerate(zip(layers, chans)):
+        stage = []
+        for b in range(n):
+            mid = c
+            cout = c * 4
+            proj = conv_spec(cin, cout, 1) if (b == 0) else None
+            stage.append((proj,
+                          conv_spec(cin, mid, 1),
+                          conv_spec(mid, mid, 3),
+                          conv_spec(mid, cout, 1),
+                          2 if (b == 0 and st > 0) else 1))
+            cin = cout
+        blocks.append(stage)
+    fc_w = mk((2048, 1000))
+    fc_b = mk((1000,))
+
+    def conv(x, wi, bi, stride=1, k=1):
+        w = P[wi]
+        pad = "SAME"
+        y = lax.conv_general_dilated(
+            x, w, (stride, stride), pad,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return y + P[bi]
+
+    P = None
+
+    def forward(pvals, x):
+        nonlocal P
+        P = pvals
+        x = conv(x, stem[0], stem[1], 2, 7)
+        x = jax.nn.relu(x)
+        x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), "SAME")
+        for stage in blocks:
+            for proj, c1, c2, c3, stride in stage:
+                sc = x
+                if proj is not None:
+                    sc = conv(x, proj[0], proj[1], stride)
+                y = jax.nn.relu(conv(x, c1[0], c1[1], stride))
+                y = jax.nn.relu(conv(y, c2[0], c2[1], 1))
+                y = conv(y, c3[0], c3[1], 1)
+                x = jax.nn.relu(y + sc)
+        x = jnp.mean(x, axis=(1, 2))
+        return x @ P[fc_w] + P[fc_b]
+
+    return jax.jit(forward), tuple(params)
+
+
+def probe_pure(sync, batch):
+    import jax.numpy as jnp
+    f, pvals = _pure_resnet50(batch)
+    x = jnp.ones((batch, 224, 224, 3), jnp.bfloat16)
+    dt = timeit(f, (pvals, x), sync, iters=20)
+    ips = batch / dt
+    mfu = ips * 4.1 / (PEAK_TFLOPS * 1e3)
+    print("pure-jax resnet50 NHWC bs%d: %.0f img/s mfu %.3f"
+          % (batch, ips, mfu))
+    return ips
+
+
+def probe_framework(sync, batch, layout="NHWC", fuse=True):
+    import jax
+    import jax.numpy as jnp
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+    f, pvals = bench.build_forward(batch, layout=layout, fuse=fuse)
+    pvals = jax.device_put(pvals)
+    x = jnp.ones((batch, 3, 224, 224), jnp.bfloat16)
+    dt = timeit(f, (pvals, x), sync, iters=20)
+    ips = batch / dt
+    mfu = ips * 4.1 / (PEAK_TFLOPS * 1e3)
+    print("framework resnet50 %s fuse=%s bs%d: %.0f img/s mfu %.3f"
+          % (layout, fuse, batch, ips, mfu))
+    return ips
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--skip-framework", action="store_true")
+    args = ap.parse_args()
+
+    os.environ.setdefault("MXTPU_COMPILE_CACHE", os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".xla_cache"))
+    import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["MXTPU_COMPILE_CACHE"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    print("devices:", jax.devices())
+    sync = _sync_factory()
+
+    probe_matmul(sync)
+    probe_conv(sync)
+    probe_pure(sync, args.batch)
+    if not args.quick:
+        probe_pure(sync, args.batch * 2)
+    if not args.skip_framework:
+        probe_framework(sync, args.batch)
+
+
+if __name__ == "__main__":
+    main()
