@@ -1,0 +1,145 @@
+"""Capsule network with dynamic routing (ref: example/capsnet/
+capsulenet.py — primary caps -> digit caps with routing-by-agreement,
+squash nonlinearity, margin loss, Sabour et al. 2017).
+
+TPU-first formulation: the routing loop is a fixed small constant
+(3 iterations) unrolled at trace time — static shapes, pure einsum-like
+batched matmuls that XLA tiles onto the MXU — instead of the
+reference's imperative per-iteration graph stitching. Synthetic
+4-class 20x20 data; CI asserts final accuracy > 0.85.
+
+    python examples/capsnet/capsnet.py --steps 250
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+IMG = 20
+N_CLASS = 4
+
+
+def squash(s, axis=-1):
+    """v = |s|^2/(1+|s|^2) * s/|s| (ref capsulenet.py squash)."""
+    sq = nd.sum(s ** 2, axis=axis, keepdims=True)
+    return sq / (1.0 + sq) * s / nd.sqrt(sq + 1e-9)
+
+
+class CapsNet(gluon.Block):
+    def __init__(self, n_class=N_CLASS, prim_caps=32, prim_dim=8,
+                 digit_dim=16, routings=3, **kwargs):
+        super().__init__(**kwargs)
+        self.n_class = n_class
+        self.prim_dim = prim_dim
+        self.digit_dim = digit_dim
+        self.routings = routings
+        with self.name_scope():
+            self.conv = nn.Conv2D(64, 5, 2, 2, in_channels=1,
+                                  activation="relu")
+            self.prim = nn.Conv2D(prim_caps * prim_dim, 5, 2, 2,
+                                  in_channels=64)
+            # routing weight W: (1, n_prim_total, n_class, digit_dim,
+            # prim_dim) — registered directly as a Parameter
+            n_prim_total = prim_caps * 5 * 5
+            self.W = self.params.get(
+                "routing_weight",
+                shape=(1, n_prim_total, n_class, digit_dim, prim_dim),
+                init=mx.init.Normal(0.05))
+
+    def forward(self, x):
+        b = x.shape[0]
+        h = self.conv(x)
+        p = self.prim(h)                            # (b, 256, 5, 5)
+        p = p.reshape((b, -1, self.prim_dim))       # (b, P, 8)
+        u = squash(p)
+        # u_hat[b,P,C,D] = W[.,P,C,D,d] @ u[b,P,d]
+        W = self.W.data()
+        u_exp = u.reshape((b, -1, 1, self.prim_dim, 1))
+        u_hat = nd.sum(W * u_exp.transpose((0, 1, 2, 4, 3)), axis=-1)
+        # dynamic routing: logits start at 0; fixed 3-round unroll
+        logits = nd.zeros((b, u_hat.shape[1], self.n_class, 1))
+        v = None
+        for _ in range(self.routings):
+            c = nd.softmax(logits, axis=2)
+            s = nd.sum(c * u_hat, axis=1)           # (b, C, D)
+            v = squash(s, axis=-1)
+            agree = nd.sum(u_hat * v.reshape(
+                (b, 1, self.n_class, self.digit_dim)), axis=-1,
+                keepdims=True)
+            logits = logits + agree
+        return nd.sqrt(nd.sum(v ** 2, axis=-1) + 1e-9)  # caps lengths
+
+
+def margin_loss(lengths, y, n_class=N_CLASS):
+    """L = T max(0, .9-|v|)^2 + .5 (1-T) max(0, |v|-.1)^2."""
+    t = nd.one_hot(y, n_class)
+    pos = nd.relu(0.9 - lengths) ** 2
+    neg = nd.relu(lengths - 0.1) ** 2
+    return nd.mean(nd.sum(t * pos + 0.5 * (1 - t) * neg, axis=1))
+
+
+def make_batch(rng, batch):
+    """4 classes of oriented bars/crosses, translation-jittered."""
+    xs = np.zeros((batch, 1, IMG, IMG), np.float32)
+    ys = rng.integers(0, N_CLASS, batch)
+    for i in range(batch):
+        c = int(rng.integers(5, IMG - 5))
+        r = int(rng.integers(5, IMG - 5))
+        if ys[i] == 0:
+            xs[i, 0, r, :] = 1.0
+        elif ys[i] == 1:
+            xs[i, 0, :, c] = 1.0
+        elif ys[i] == 2:
+            xs[i, 0, r, :] = 1.0
+            xs[i, 0, :, c] = 1.0
+        else:
+            for k in range(-4, 5):
+                rr, cc = r + k, c + k
+                if 0 <= rr < IMG and 0 <= cc < IMG:
+                    xs[i, 0, rr, cc] = 1.0
+        xs[i, 0] += rng.normal(0, 0.05, (IMG, IMG))
+    return xs, ys.astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=250)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=0.002)
+    args = ap.parse_args()
+
+    rng = np.random.default_rng(9)
+    net = CapsNet()
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    for step in range(args.steps):
+        xs, ys = make_batch(rng, args.batch_size)
+        x, y = nd.array(xs), nd.array(ys)
+        with autograd.record():
+            loss = margin_loss(net(x), y)
+        loss.backward()
+        trainer.step(args.batch_size)
+        if (step + 1) % 50 == 0:
+            print("step %d margin loss %.4f"
+                  % (step + 1, float(loss.asscalar())))
+
+    xs, ys = make_batch(rng, 256)
+    pred = net(nd.array(xs)).asnumpy().argmax(axis=1)
+    acc = float((pred == ys).mean())
+    print("final accuracy %.4f" % acc)
+
+
+if __name__ == "__main__":
+    main()

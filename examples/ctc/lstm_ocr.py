@@ -29,7 +29,8 @@ from mxnet_tpu.gluon import nn, rnn
 N_DIGIT = 4          # digits per strip
 COLS_PER = 6         # columns per digit slot
 HEIGHT = 8           # strip height (features per column)
-N_CLASS = 5          # digit alphabet 1..4 (class 0 = CTC blank)
+N_CLASS = 5          # digit alphabet 0..3; class 4 = CTC blank (gluon
+                     # convention: blank is the LAST class, loss.py:475)
 T = N_DIGIT * COLS_PER
 
 
@@ -39,18 +40,18 @@ def digit_glyph(d):
     return g.astype(np.float32)
 
 
-GLYPHS = [digit_glyph(d) for d in range(1, N_CLASS)]
+GLYPHS = [digit_glyph(d) for d in range(N_CLASS - 1)]
 
 
 def make_batch(rng, batch):
     xs = rng.normal(0, 0.05, (batch, T, HEIGHT)).astype(np.float32)
     ys = np.zeros((batch, N_DIGIT), np.float32)
     for i in range(batch):
-        digits = rng.integers(1, N_CLASS, N_DIGIT)
+        digits = rng.integers(0, N_CLASS - 1, N_DIGIT)
         ys[i] = digits
         for j, d in enumerate(digits):
             off = j * COLS_PER + rng.integers(0, COLS_PER - 4 + 1)
-            xs[i, off:off + 4, :] += GLYPHS[d - 1].T
+            xs[i, off:off + 4, :] += GLYPHS[d].T
     return xs, ys
 
 
@@ -68,13 +69,13 @@ class OCRNet(gluon.HybridBlock):
 
 
 def greedy_decode(logits):
-    """argmax per step, collapse repeats, drop blanks (class 0)."""
+    """argmax per step, collapse repeats, drop blanks (last class)."""
     path = logits.argmax(axis=2)
     out = []
     for row in path:
         seq, prev = [], -1
         for c in row:
-            if c != prev and c != 0:
+            if c != prev and c != N_CLASS - 1:
                 seq.append(int(c))
             prev = c
         out.append(seq)

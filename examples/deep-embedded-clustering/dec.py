@@ -1,0 +1,137 @@
+"""Deep embedded clustering (ref: example/deep-embedded-clustering/
+dec.py — pretrain an autoencoder, then refine cluster assignments by
+minimizing KL(P||Q) between the soft assignment Q and its sharpened
+target P, Xie et al. 2016).
+
+Both phases on synthetic 3-cluster 16-d data: (1) autoencoder
+pretrain, (2) DEC refinement of encoder + centroids with the
+self-sharpening target. CI asserts final cluster accuracy > 0.9
+(label-permutation-invariant, greedy matching).
+
+    python examples/deep-embedded-clustering/dec.py
+"""
+from __future__ import annotations
+
+import argparse
+import itertools
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+DIM = 16
+LATENT = 4
+K = 3
+
+
+def make_data(rng, n):
+    centers = rng.normal(0, 2.0, (K, DIM)).astype(np.float32)
+    ys = rng.integers(0, K, n)
+    xs = centers[ys] + rng.normal(0, 0.4, (n, DIM)).astype(np.float32)
+    return xs.astype(np.float32), ys
+
+
+def soft_assign(z, mu, alpha=1.0):
+    """Student-t similarity q_ij (dec.py's q distribution)."""
+    d2 = nd.sum((z.expand_dims(1) - mu.expand_dims(0)) ** 2, axis=2)
+    q = (1.0 + d2 / alpha) ** (-(alpha + 1) / 2)
+    return q / nd.sum(q, axis=1, keepdims=True)
+
+
+def target_dist(q):
+    w = q ** 2 / nd.sum(q, axis=0, keepdims=True)
+    return (w / nd.sum(w, axis=1, keepdims=True)).detach()
+
+
+def cluster_acc(pred, ys):
+    best = 0.0
+    for perm in itertools.permutations(range(K)):
+        remap = np.array(perm)[pred]
+        best = max(best, float((remap == ys).mean()))
+    return best
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pretrain-steps", type=int, default=300)
+    ap.add_argument("--dec-steps", type=int, default=150)
+    ap.add_argument("--n", type=int, default=512)
+    args = ap.parse_args()
+
+    rng = np.random.default_rng(8)
+    xs, ys = make_data(rng, args.n)
+    x_all = nd.array(xs)
+
+    enc = nn.Sequential()
+    enc.add(nn.Dense(32, activation="relu", in_units=DIM),
+            nn.Dense(LATENT, in_units=32))
+    dec_net = nn.Sequential()
+    dec_net.add(nn.Dense(32, activation="relu", in_units=LATENT),
+                nn.Dense(DIM, in_units=32))
+    enc.initialize(mx.init.Xavier())
+    dec_net.initialize(mx.init.Xavier())
+    params = list(enc.collect_params().values()) \
+        + list(dec_net.collect_params().values())
+    trainer = gluon.Trainer(
+        {p.name: p for p in params}, "adam", {"learning_rate": 0.005})
+
+    # phase 1: autoencoder pretrain
+    for step in range(args.pretrain_steps):
+        idx = rng.integers(0, args.n, 64)
+        xb = nd.array(xs[idx])
+        with autograd.record():
+            loss = nd.mean((dec_net(enc(xb)) - xb) ** 2)
+        loss.backward()
+        trainer.step(64)
+    print("pretrain reconstruction mse %.5f" % float(loss.asscalar()))
+
+    # init centroids: farthest-point (k-means++-style) seeding — a
+    # uniform K-point draw lands two seeds in one cluster ~78% of the
+    # time for K=3, and lloyd cannot escape that local minimum
+    z = enc(x_all).asnumpy()
+    seeds = [int(rng.integers(0, args.n))]
+    for _ in range(K - 1):
+        d2 = np.min(((z[:, None, :] - z[seeds][None]) ** 2).sum(-1), axis=1)
+        seeds.append(int(d2.argmax()))
+    mu_np = z[seeds].copy()
+    # a few lloyd iterations to settle initial centroids
+    for _ in range(10):
+        d = ((z[:, None, :] - mu_np[None]) ** 2).sum(-1)
+        a = d.argmin(1)
+        for k in range(K):
+            if (a == k).any():
+                mu_np[k] = z[a == k].mean(0)
+    d = ((z[:, None, :] - mu_np[None]) ** 2).sum(-1)
+    print("post-kmeans accuracy %.4f" % cluster_acc(d.argmin(1), ys))
+    mu = nd.array(mu_np)
+    mu.attach_grad()
+
+    # phase 2: DEC refinement — KL(P || Q) on encoder + centroids
+    dec_trainer = gluon.Trainer(enc.collect_params(), "sgd",
+                                {"learning_rate": 0.1})
+    for step in range(args.dec_steps):
+        with autograd.record():
+            q = soft_assign(enc(x_all), mu)
+            p = target_dist(q)
+            kl = nd.sum(p * nd.log((p + 1e-9) / (q + 1e-9))) / args.n
+        kl.backward()
+        dec_trainer.step(args.n)
+        mu -= 0.1 * mu.grad
+        mu.attach_grad()
+        if (step + 1) % 50 == 0:
+            print("dec step %d kl %.5f" % (step + 1, float(kl.asscalar())))
+
+    pred = soft_assign(enc(x_all), mu).asnumpy().argmax(1)
+    acc = cluster_acc(pred, ys)
+    print("cluster accuracy %.4f" % acc)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,109 @@
+"""Module API tour: SequentialModule, checkpointing, resume (ref:
+example/module/sequential_module.py — chain feature/classifier
+Modules, fit, save_checkpoint, resume from epoch).
+
+Two Modules chained: a feature MLP and a softmax classifier, trained
+with SequentialModule.fit on synthetic 3-class data; then checkpoint
+at epoch 2, reload into a fresh module with begin_epoch=2 and confirm
+training resumes (loss continues down, final accuracy high). CI
+asserts resumed accuracy > 0.9.
+
+    python examples/module/sequential_module.py --epochs 4
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx
+
+DIM = 16
+N_CLASS = 3
+
+
+CENTERS = np.random.default_rng(99).normal(
+    0, 1.5, (N_CLASS, DIM)).astype(np.float32)
+
+
+def make_data(rng, n):
+    ys = rng.integers(0, N_CLASS, n)
+    xs = CENTERS[ys] + rng.normal(0, 0.5, (n, DIM)).astype(np.float32)
+    return xs.astype(np.float32), ys.astype(np.float32)
+
+
+def feature_sym():
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, name="feat_fc", num_hidden=32)
+    return mx.sym.Activation(h, act_type="relu", name="feat_relu")
+
+
+def classifier_sym():
+    data = mx.sym.Variable("feat_relu_output")
+    fc = mx.sym.FullyConnected(data, name="cls_fc", num_hidden=N_CLASS)
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--batch-size", type=int, default=32)
+    args = ap.parse_args()
+
+    rng = np.random.default_rng(29)
+    xs, ys = make_data(rng, 600)
+    it = mx.io.NDArrayIter(xs, ys, batch_size=args.batch_size,
+                           shuffle=True, label_name="softmax_label")
+    val_xs, val_ys = make_data(rng, 300)
+    val = mx.io.NDArrayIter(val_xs, val_ys, batch_size=args.batch_size,
+                            label_name="softmax_label")
+
+    feat = mx.mod.Module(feature_sym(), data_names=("data",),
+                         label_names=())
+    cls = mx.mod.Module(classifier_sym(),
+                        data_names=("feat_relu_output",),
+                        label_names=("softmax_label",))
+    seq = mx.mod.SequentialModule()
+    seq.add(feat).add(cls, take_labels=True, auto_wiring=True)
+
+    prefix = os.path.join(tempfile.gettempdir(), "seqmod")
+    seq.fit(it, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            num_epoch=args.epochs)
+    score = seq.score(val, "acc")
+    acc = dict(score)["accuracy"] if isinstance(score, list) else score
+    print("final accuracy %.4f" % float(acc))
+
+    # single-module checkpoint/resume demonstration on the classifier
+    mod = mx.mod.Module(
+        mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(mx.sym.Variable("data"), name="fc",
+                                  num_hidden=N_CLASS),
+            name="softmax"),
+        data_names=("data",), label_names=("softmax_label",))
+    it2 = mx.io.NDArrayIter(xs, ys, batch_size=args.batch_size,
+                            shuffle=True, label_name="softmax_label")
+    mod.fit(it2, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            epoch_end_callback=mx.callback.do_checkpoint(prefix))
+    sym, arg, aux = mx.model.load_checkpoint(prefix, 2)
+    mod2 = mx.mod.Module(sym, data_names=("data",),
+                         label_names=("softmax_label",))
+    mod2.fit(it2, num_epoch=args.epochs, arg_params=arg, aux_params=aux,
+             begin_epoch=2, optimizer="sgd",
+             optimizer_params={"learning_rate": 0.05})
+    score2 = mod2.score(mx.io.NDArrayIter(
+        val_xs, val_ys, batch_size=args.batch_size,
+        label_name="softmax_label"), "acc")
+    acc2 = dict(score2)["accuracy"] if isinstance(score2, list) else score2
+    print("resumed accuracy %.4f" % float(acc2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,102 @@
+"""Named-entity tagging with a BiLSTM (ref:
+example/named_entity_recognition/src/ner.py — embedding -> BiLSTM ->
+per-token softmax over entity tags, trained with a masked CE loss).
+
+Synthetic micro-language: sequences over a 40-word vocab where words
+from designated "person"/"place" sub-ranges must be tagged PER/LOC
+when (and only when) preceded by a trigger word, so the tagger needs
+*context*, not a lookup table — exactly what the BiLSTM provides.
+CI asserts token accuracy > 0.9.
+
+    python examples/named_entity_recognition/ner_bilstm.py --steps 250
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn, rnn
+
+VOCAB = 40
+SEQ = 12
+TAGS = 3            # O, PER, LOC
+TRIG_PER = 1        # "mr" — next word is a person
+TRIG_LOC = 2        # "in" — next word is a place
+NAME_LO, NAME_HI = 20, 30   # ambiguous surface forms: these ids are
+
+
+# tagged PER after TRIG_PER, LOC after TRIG_LOC, O otherwise
+
+
+def make_batch(rng, batch):
+    xs = rng.integers(3, VOCAB, (batch, SEQ))
+    ys = np.zeros((batch, SEQ), np.int64)
+    for i in range(batch):
+        for _ in range(3):
+            pos = int(rng.integers(0, SEQ - 1))
+            trig = TRIG_PER if rng.random() < 0.5 else TRIG_LOC
+            xs[i, pos] = trig
+            xs[i, pos + 1] = rng.integers(NAME_LO, NAME_HI)
+            ys[i, pos + 1] = 1 if trig == TRIG_PER else 2
+    return xs.astype(np.float32), ys
+
+
+class NER(gluon.Block):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.emb = nn.Embedding(VOCAB, 16)
+            self.lstm = rnn.LSTM(24, bidirectional=True,
+                                 layout="NTC", input_size=16)
+            self.out = nn.Dense(TAGS, flatten=False, in_units=48)
+
+    def forward(self, x):
+        return self.out(self.lstm(self.emb(x)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=250)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=0.01)
+    args = ap.parse_args()
+
+    rng = np.random.default_rng(13)
+    net = NER()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()      # one jitted step instead of per-op dispatch
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss(axis=-1)
+
+    for step in range(args.steps):
+        xs, ys = make_batch(rng, args.batch_size)
+        x, y = nd.array(xs), nd.array(ys.astype(np.float32))
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(args.batch_size)
+        if (step + 1) % 50 == 0:
+            print("step %d loss %.4f"
+                  % (step + 1, float(loss.mean().asscalar())))
+
+    xs, ys = make_batch(rng, 256)
+    pred = net(nd.array(xs)).asnumpy().argmax(axis=-1)
+    acc = float((pred == ys).mean())
+    # entity-only accuracy is the hard part (O dominates)
+    ent = ys > 0
+    ent_acc = float((pred[ent] == ys[ent]).mean())
+    print("token accuracy %.4f" % acc)
+    print("entity accuracy %.4f" % ent_acc)
+
+
+if __name__ == "__main__":
+    main()

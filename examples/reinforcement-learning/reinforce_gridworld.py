@@ -1,0 +1,133 @@
+"""Policy-gradient RL: REINFORCE with a value baseline on a gridworld
+(ref: example/reinforcement-learning/parallel_actor_critic/ — policy +
+value heads, discounted-return advantage, entropy bonus; the env here
+is a 5x5 numpy gridworld instead of gym since the env is offline).
+
+Agent starts at a random cell, goal at a fixed corner; +1 on reaching
+the goal, -0.01 per step, episode cap 20 steps. Policy is a 2-layer
+MLP over one-hot position. CI asserts mean return improves by > 0.3
+and final success rate > 0.8.
+
+    python examples/reinforcement-learning/reinforce_gridworld.py
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+SIDE = 5
+N_S = SIDE * SIDE
+N_A = 4          # up, down, left, right
+GOAL = (SIDE - 1, SIDE - 1)
+CAP = 20
+
+
+def step_env(pos, a):
+    r, c = pos
+    if a == 0:
+        r = max(0, r - 1)
+    elif a == 1:
+        r = min(SIDE - 1, r + 1)
+    elif a == 2:
+        c = max(0, c - 1)
+    else:
+        c = min(SIDE - 1, c + 1)
+    done = (r, c) == GOAL
+    return (r, c), (1.0 if done else -0.01), done
+
+
+def rollout(net, rng):
+    pos = (int(rng.integers(0, SIDE)), int(rng.integers(0, SIDE)))
+    if pos == GOAL:
+        pos = (0, 0)
+    states, actions, rewards = [], [], []
+    for _ in range(CAP):
+        s = pos[0] * SIDE + pos[1]
+        logits, _v = net(nd.one_hot(nd.array([float(s)]), N_S))
+        p = nd.softmax(logits).asnumpy().ravel()
+        a = int(rng.choice(N_A, p=p / p.sum()))
+        pos, r, done = step_env(pos, a)
+        states.append(s)
+        actions.append(a)
+        rewards.append(r)
+        if done:
+            break
+    return states, actions, rewards, done
+
+
+class PolicyValue(gluon.Block):
+    def __init__(self):
+        super().__init__(prefix="pv_")
+        with self.name_scope():
+            self.trunk = nn.Dense(32, activation="relu", in_units=N_S)
+            self.pi = nn.Dense(N_A, in_units=32)
+            self.v = nn.Dense(1, in_units=32)
+
+    def forward(self, x):
+        h = self.trunk(x)
+        return self.pi(h), self.v(h)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--episodes", type=int, default=400)
+    ap.add_argument("--gamma", type=float, default=0.95)
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--entropy", type=float, default=0.01)
+    args = ap.parse_args()
+
+    rng = np.random.default_rng(17)
+    net = PolicyValue()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    def run_phase(episodes):
+        returns, succ = [], 0
+        for _ in range(episodes):
+            states, actions, rewards, done = rollout(net, rng)
+            succ += int(done)
+            # discounted returns, per-step
+            G, gs = 0.0, []
+            for r in reversed(rewards):
+                G = r + args.gamma * G
+                gs.append(G)
+            gs.reverse()
+            returns.append(gs[0])
+            x = nd.one_hot(nd.array([float(s) for s in states]), N_S)
+            a = nd.array([float(a) for a in actions])
+            g = nd.array(np.array(gs, np.float32))
+            with autograd.record():
+                logits, v = net(x)
+                logp = nd.log_softmax(logits)
+                sel = nd.pick(logp, a, axis=1)
+                adv = g - v.reshape((-1,))
+                pol = -nd.mean(sel * adv.detach())
+                vl = nd.mean(adv ** 2)
+                ent = -nd.mean(nd.sum(nd.softmax(logits) * logp, axis=1))
+                loss = pol + 0.5 * vl - args.entropy * ent
+            loss.backward()
+            trainer.step(len(states))
+        return float(np.mean(returns)), succ / episodes
+
+    early_ret, _ = run_phase(50)
+    print("early mean return %.3f" % early_ret)
+    _, _ = run_phase(args.episodes - 100)
+    late_ret, late_succ = run_phase(50)
+    print("late mean return %.3f" % late_ret)
+    print("final success rate %.3f" % late_succ)
+    print("return improvement %.3f" % (late_ret - early_ret))
+
+
+if __name__ == "__main__":
+    main()

@@ -143,7 +143,11 @@ class CTCLoss(Loss):
         loss = F.CTCLoss(
             pred, label, pred_lengths, label_lengths,
             use_data_lengths=pred_lengths is not None,
-            use_label_lengths=label_lengths is not None)
+            use_label_lengths=label_lengths is not None,
+            # gluon convention: blank is the LAST class, labels are
+            # 0..alphabet-2, padding is -1 (ref: gluon/loss.py:475
+            # passes blank_label='last'; the bare op defaults 'first')
+            blank_label="last")
         return _apply_weighting(F, loss, self._weight, sample_weight)
 
 

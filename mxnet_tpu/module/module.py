@@ -79,8 +79,20 @@ class Module(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
-        return [(n, o.shape) for n, o in zip(self._output_names,
-                                             self._exec.outputs)]
+        if self._exec.outputs:
+            return [(n, o.shape) for n, o in zip(self._output_names,
+                                                 self._exec.outputs)]
+        # before the first forward the executor holds no arrays yet, but
+        # shapes are known from bind-time inference (the reference's
+        # GraphExecutor exposes them immediately after bind —
+        # SequentialModule wiring depends on that); reuse the hints
+        # bind() computed and cache the inferred result
+        if self._cached_output_shapes is None:
+            _, out_shapes, _ = self._symbol.infer_shape_partial(
+                **self._shape_hints)
+            self._cached_output_shapes = list(
+                zip(self._output_names, out_shapes))
+        return self._cached_output_shapes
 
     # -- binding -----------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
@@ -104,6 +116,8 @@ class Module(BaseModule):
         # inferred shapes (labels of loss-less graphs etc.)
         known = set(self._symbol.list_inputs())
         shape_hints = {k: v for k, v in shape_hints.items() if k in known}
+        self._shape_hints = shape_hints
+        self._cached_output_shapes = None
 
         req = grad_req
         if not for_training:

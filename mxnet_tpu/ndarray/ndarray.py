@@ -396,10 +396,32 @@ class NDArray:
         return invoke("Reshape", [self], {"shape": other.shape})
 
 
+def _arrayish(v):
+    """Array-valued argument (numpy/jax) that should become an input,
+    not an attr — mirrors register.py's _is_tensor classification."""
+    return isinstance(v, np.ndarray) or (
+        hasattr(v, "shape") and hasattr(v, "dtype") and not np.isscalar(v))
+
+
 def _make_method(opname):
     def method(self, *args, **kwargs):
         attrs = {k: _canon_attr(v) for k, v in kwargs.items() if v is not None}
-        extra = [a for a in args if isinstance(a, NDArray)]
+        extra = []
+        scalars = []
+        for a in args:
+            if isinstance(a, NDArray):
+                extra.append(a)
+            elif _arrayish(a):
+                extra.append(NDArray(jnp.asarray(a)))
+            else:
+                scalars.append(a)
+        if scalars:
+            # bind positional non-array args (x.transpose(0, 2, 1),
+            # x.clip(0, 1), x.sum(0)...) to the op's declared attr names
+            # in signature order — silently dropping them produced
+            # reversed transposes (round-4 capsnet finding)
+            _reg.bind_positional_attrs(_reg.get(opname), scalars, attrs,
+                                       err_cls=MXNetError)
         return invoke(opname, [self] + extra, attrs)
 
     method.__name__ = opname

@@ -22,17 +22,24 @@ def _is_tensor(v):
 def make_op_func(op):
     def op_func(*args, out=None, name=None, **kwargs):
         inputs = []
+        scalars = []
         for a in args:
             if a is None:
                 inputs.append(None)
             elif _is_tensor(a):
+                if scalars:
+                    raise TypeError(
+                        f"{op.name}: array argument after scalar "
+                        f"parameter {scalars[-1]!r}")
                 inputs.append(a if isinstance(a, NDArray) else NDArray(a))
             else:
-                # scalar positional: tolerate (maps onto first free attr slot
-                # only via kwargs in this implementation)
-                raise TypeError(
-                    f"{op.name}: positional argument {a!r} is not an array; "
-                    "pass operator parameters as keyword arguments")
+                scalars.append(a)
+        if scalars:
+            # positional operator parameters after the arrays — the
+            # reference's generated API accepts e.g. one_hot(idx, depth)
+            # positionally (ref: python/mxnet/ndarray/register.py codegen
+            # emits real named signatures)
+            _reg.bind_positional_attrs(op, scalars, kwargs)
         # keyword tensor args in signature order after positionals
         for pname in op.arg_names[len(inputs):]:
             if pname in kwargs:

@@ -57,10 +57,15 @@ _CONV_DNUMS = {1: ("NCH", "OIH", "NCH"),
 _CHANNEL_LAST = {"NWC": "H", "NHWC": "HW", "NDHWC": "DHW"}
 
 
+_CHANNEL_FIRST = {"NCW": 1, "NCHW": 2, "NCDHW": 3}
+
+
 def _conv_layout(layout, nd):
     """(data_spec, weight_spec, channel_axis) for an MXNet layout string."""
     default = _CONV_DNUMS[nd][0]
-    if layout is None or layout == default:
+    if layout is None or layout == default \
+            or _CHANNEL_FIRST.get(layout) == nd:
+        # MXNet spells 1-d channel-first "NCW"; the jax spec uses "NCH"
         return _CONV_DNUMS[nd] + (1,)
     spatial = _CHANNEL_LAST.get(layout)
     if spatial is None or len(spatial) != nd:

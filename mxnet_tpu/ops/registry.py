@@ -154,6 +154,32 @@ def get(name):
         raise MXNetError(f"operator {name!r} is not registered") from None
 
 
+def bind_positional_attrs(op, scalars, attrs, err_cls=TypeError):
+    """Bind positional non-array call arguments to the op's declared
+    attr names in signature order (the reference's generated API has
+    real named signatures, python/mxnet/ndarray/register.py codegen —
+    nd.one_hot(idx, 3) and x.clip(0, 1) must work positionally).
+    Mutates `attrs`. Python semantics: a name given both positionally
+    and by keyword raises. The one vararg special case: MXNet spells
+    transpose as x.transpose(*axes), so integer overflow onto a sole
+    'axes'/'axis' slot packs into a tuple."""
+    names = op._kwarg_names
+    if len(scalars) > len(names) and len(names) >= 1 \
+            and names[0] in ("axes", "axis") and names[0] not in attrs \
+            and all(isinstance(s, int) for s in scalars):
+        scalars = [tuple(scalars)]
+    if len(scalars) > len(names):
+        raise err_cls(
+            "%s: %d positional parameter(s) but only %d declared: %r"
+            % (op.name, len(scalars), len(names), list(names)))
+    for n, v in zip(names, scalars):
+        if n in attrs:
+            raise err_cls(
+                "%s got multiple values for parameter %r" % (op.name, n))
+        if v is not None:
+            attrs[n] = v
+
+
 def find(name):
     return _OPS.get(name)
 

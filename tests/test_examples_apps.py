@@ -1,0 +1,63 @@
+"""Smoke gates for the round-4 application example families (ref:
+example/captcha, example/vae-gan, example/dsd,
+example/reinforcement-learning, example/speech_recognition,
+example/module, example/gluon)."""
+from example_harness import get_metric as _get, run_example as _run
+
+
+def test_captcha_multihead():
+    out = _run("examples/captcha/captcha_multihead.py", ["--steps", "250"])
+    acc = _get(out, r"per-digit accuracy ([0-9.]+)")
+    seq = _get(out, r"sequence accuracy ([0-9.]+)")
+    assert acc > 0.9, out[-500:]
+    assert seq > 0.7, out[-500:]
+
+
+def test_vaegan():
+    out = _run("examples/vae-gan/vaegan.py", ["--steps", "400"])
+    gap = _get(out, r"gap ([0-9.]+)")
+    recon = _get(out, r"mean reconstruction distance ([0-9.]+)")
+    assert gap < 0.45, out[-500:]
+    assert recon < 1.0, out[-500:]
+
+
+def test_dsd_training():
+    out = _run("examples/dsd/dsd_training.py", ["--steps", "150"])
+    dense = _get(out, r"dense accuracy ([0-9.]+)")
+    sparse = _get(out, r"sparse accuracy ([0-9.]+)")
+    final = _get(out, r"final dense accuracy ([0-9.]+)")
+    assert dense > 0.9, out[-500:]
+    assert sparse > dense - 0.05, (dense, sparse)
+    assert final >= dense - 0.02, (dense, final)
+
+
+def test_reinforce_gridworld():
+    out = _run("examples/reinforcement-learning/reinforce_gridworld.py",
+               ["--episodes", "300"])
+    imp = _get(out, r"return improvement (-?[0-9.]+)")
+    succ = _get(out, r"final success rate ([0-9.]+)")
+    assert imp > 0.2, out[-500:]
+    assert succ > 0.8, out[-500:]
+
+
+def test_speech_ctc():
+    out = _run("examples/speech_recognition/lstm_ctc_speech.py",
+               ["--steps", "250"], timeout=560)
+    acc = _get(out, r"sequence accuracy ([0-9.]+)")
+    assert acc > 0.7, out[-500:]
+
+
+def test_sequential_module():
+    out = _run("examples/module/sequential_module.py", ["--epochs", "3"])
+    acc = _get(out, r"final accuracy ([0-9.]+)")
+    res = _get(out, r"resumed accuracy ([0-9.]+)")
+    assert acc > 0.9, out[-500:]
+    assert res > 0.9, out[-500:]
+
+
+def test_gluon_mnist():
+    out = _run("examples/gluon/mnist_gluon.py", ["--epochs", "2"])
+    acc = _get(out, r"final val accuracy ([0-9.]+)")
+    rel = _get(out, r"reloaded val accuracy ([0-9.]+)")
+    assert acc > 0.9, out[-500:]
+    assert abs(acc - rel) < 1e-6, (acc, rel)

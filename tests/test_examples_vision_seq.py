@@ -1,0 +1,46 @@
+"""Smoke gates for the round-4 vision/sequence example families (ref:
+example/capsnet, example/fcn-xs, example/neural-style,
+example/deep-embedded-clustering, example/named_entity_recognition,
+example/multivariate_time_series)."""
+from example_harness import get_metric as _get, run_example as _run
+
+
+def test_capsnet():
+    out = _run("examples/capsnet/capsnet.py", ["--steps", "150"])
+    acc = _get(out, r"final accuracy ([0-9.]+)")
+    assert acc > 0.85, out[-500:]
+
+
+def test_fcn_segmentation():
+    out = _run("examples/fcn-xs/fcn_segmentation.py", ["--steps", "250"])
+    miou = _get(out, r"mean IoU ([0-9.]+)")
+    assert miou > 0.6, out[-500:]
+
+
+def test_neural_style():
+    out = _run("examples/neural-style/neural_style.py", ["--steps", "150"])
+    ratio = _get(out, r"objective ratio ([0-9.]+)")
+    assert ratio < 0.3, out[-500:]
+
+
+def test_dec_clustering():
+    out = _run("examples/deep-embedded-clustering/dec.py",
+               ["--pretrain-steps", "300", "--dec-steps", "100"])
+    acc = _get(out, r"cluster accuracy ([0-9.]+)")
+    assert acc > 0.9, out[-500:]
+
+
+def test_ner_bilstm():
+    out = _run("examples/named_entity_recognition/ner_bilstm.py",
+               ["--steps", "150"])
+    acc = _get(out, r"token accuracy ([0-9.]+)")
+    ent = _get(out, r"entity accuracy ([0-9.]+)")
+    assert acc > 0.9, out[-500:]
+    assert ent > 0.6, out[-500:]
+
+
+def test_lstnet_time_series():
+    out = _run("examples/multivariate_time_series/lstnet_lite.py",
+               ["--steps", "250"])
+    rel = _get(out, r"relative rmse ([0-9.]+)")
+    assert rel < 0.8, out[-500:]
