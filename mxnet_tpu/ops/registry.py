@@ -77,6 +77,10 @@ class OpDef:
             and p.default is not p.empty
             and not (p.default is None and p.name in _arrayish)
         )
+        self.attr_defaults = {
+            p.name: p.default for p in params
+            if p.kind in (p.KEYWORD_ONLY, p.POSITIONAL_OR_KEYWORD)
+            and p.default is not p.empty}
         self._jitted = None
         self._warned_unjitted = False
 
@@ -163,11 +167,17 @@ def bind_positional_attrs(op, scalars, attrs, err_cls=TypeError):
     and by keyword raises. The one vararg special case: MXNet spells
     transpose as x.transpose(*axes), so integer overflow onto a sole
     'axes'/'axis' slot packs into a tuple."""
+    import numpy as _np
     names = op._kwarg_names
-    if len(scalars) > len(names) and len(names) >= 1 \
-            and names[0] in ("axes", "axis") and names[0] not in attrs \
-            and all(isinstance(s, int) for s in scalars):
-        scalars = [tuple(scalars)]
+    ints = all(isinstance(s, (int, _np.integer))
+               and not isinstance(s, bool) for s in scalars)
+    # 'axes' is semantically a tuple, so integer positionals always pack
+    # (x.transpose(2, 0, 1) AND the 1-d x.transpose(0)); 'axis' takes a
+    # scalar, so it packs only on overflow
+    if ints and len(names) >= 1 and names[0] not in attrs and (
+            (names[0] == "axes" and scalars)
+            or (names[0] == "axis" and len(scalars) > len(names))):
+        scalars = [tuple(int(s) for s in scalars)]
     if len(scalars) > len(names):
         raise err_cls(
             "%s: %d positional parameter(s) but only %d declared: %r"

@@ -59,7 +59,23 @@ def make_sym_func(op):
                     continue
             inputs.append(var(f"{name}_{pname}"))
         kwargs.pop("num_args", None)
+        # user annotation attrs (ref: generated symbol functions take an
+        # `attr` dict merged into the node, test_attr.py) ride alongside
+        # op parameters; REQUIRING dunder keys keeps them disjoint from
+        # op parameters (the reference's attr protocol for op nodes —
+        # a plain key would leak into the op's kwargs at infer/exec or
+        # silently shadow a real parameter)
+        user_attr = kwargs.pop("attr", None) or {}
+        for k, v in user_attr.items():
+            if not isinstance(v, str):
+                raise MXNetError(
+                    f"{op.name}: attribute {k!r} must be a string")
+            if not (k.startswith("__") and k.endswith("__")):
+                raise MXNetError(
+                    f"{op.name}: operator attribute names must be of the "
+                    f"form __name__, got {k!r}")
         attrs = {k: v for k, v in kwargs.items() if v is not None}
+        attrs.update(user_attr)
         return _apply(op.name, inputs, attrs, name=name)
 
     sym_func.__name__ = op.name

@@ -76,6 +76,13 @@ def is_aux_name(name):
     return name.endswith(_AUX_SUFFIXES)
 
 
+# ops whose `dtype` attribute (or its signature default) determines ALL
+# outputs' dtype — the only ones safe to shortcut in shape-free type
+# inference (topk also has a dtype attr, but it governs only the indices
+# output, so it must NOT be here)
+_DTYPE_FIXES_OUTPUT_OPS = {"Cast", "amp_cast", "one_hot", "Embedding"}
+
+
 class Symbol:
     """An output list over a shared node DAG (ref: symbol.py Symbol)."""
 
@@ -297,12 +304,42 @@ class Symbol:
                 dtypes.setdefault(key, dtypes.get(
                     (id(node.inputs[0][0]), node.inputs[0][1]), "float32"))
 
+    _PARAM_SUFFIXES = ("_weight", "_bias", "_gamma", "_beta")
+
+    def _retype_param_inputs(self, node, dtypes, defaulted):
+        """Give default-typed parameter vars (weight/bias/gamma/beta)
+        the float dtype the op's data input resolved to, so fp16/bf16
+        graphs type their parameters from one Cast at the input (the
+        backward half of the reference's FInferType fixpoint)."""
+        src = None
+        for child, k in node.inputs:
+            ck = (id(child), k)
+            dt = dtypes.get(ck)
+            if dt is not None and ck not in defaulted:
+                src = dt
+                break
+        if src is None or not np.issubdtype(np.dtype(src), np.floating):
+            return
+        src = np.dtype(src).name
+        for child, k in node.inputs:
+            ck = (id(child), k)
+            if (child.op is None and ck in defaulted
+                    and child.name.endswith(self._PARAM_SUFFIXES)):
+                dtypes[ck] = src
+                defaulted.discard(ck)
+
     def _infer(self, shape_hints, dtype_hints, partial=False):
         """Forward-propagate (shape, dtype) through the graph via
         jax.eval_shape on each node's op fn (the one-pass analogue of
         the reference's iterative fixpoint in infer_graph_attr_pass.cc —
         a DAG needs only one forward sweep)."""
         shapes, dtypes = {}, {}
+        # var nodes whose dtype is the float32 *default* rather than
+        # user-specified: candidates for retyping when the op they feed
+        # resolves to another float width (the backward half of the
+        # reference's bidirectional FInferType — fp16 flows type their
+        # weights from the cast data, infer_graph_attr_pass.cc)
+        defaulted = set()
         for node in self._topo():
             key = (id(node), 0)  # node identity — names may collide
             if node.op is None:
@@ -310,14 +347,19 @@ class Symbol:
                 if shape is None:
                     sh = node.attrs.get("__shape__")
                     shape = tuple(sh) if sh else None
+                explicit = (node.name in dtype_hints
+                            or "__dtype__" in node.attrs)
                 dtype = dtype_hints.get(node.name,
                                         node.attrs.get("__dtype__",
                                                        "float32"))
                 if shape is not None:
                     shapes[key] = tuple(shape)
                 dtypes[key] = dtype
+                if not explicit:
+                    defaulted.add(key)
                 continue
             self._infer_param_shapes(node, shapes, dtypes)
+            self._retype_param_inputs(node, dtypes, defaulted)
             in_specs = []
             missing = False
             for child, k in node.inputs:
@@ -329,11 +371,22 @@ class Symbol:
             if missing:
                 if partial:
                     # dtype-only propagation (type inference without
-                    # shapes): outputs take the first known input dtype
-                    in_dts = [dtypes.get((id(c), k))
-                              for c, k in node.inputs]
-                    dt = next((d for d in in_dts if d), None)
+                    # shapes): for ops whose dtype attr fixes EVERY
+                    # output (a curated set — topk's dtype governs only
+                    # the indices output, so a blanket rule mistypes
+                    # its values) use the attr; otherwise outputs take
+                    # the first known input dtype
+                    opdef = _reg.get(node.op)
+                    dt = None
+                    if node.op in _DTYPE_FIXES_OUTPUT_OPS:
+                        dt = node.attrs.get(
+                            "dtype", opdef.attr_defaults.get("dtype"))
+                    if not dt:
+                        in_dts = [dtypes.get((id(c), k))
+                                  for c, k in node.inputs]
+                        dt = next((d for d in in_dts if d), None)
                     if dt:
+                        dt = np.dtype(dt).name
                         for k in range(node.num_outputs()):
                             dtypes.setdefault((id(node), k), dt)
                     continue
@@ -664,6 +717,10 @@ def _binary(lhs, rhs, broadcast_op, scalar_op, reverse=False):
 def var(name, attr=None, shape=None, dtype=None, lr_mult=None, wd_mult=None,
         init=None, stype=None, **kwargs):
     """Create a free variable (ref: symbol.py var/Variable)."""
+    for k, v in (attr or {}).items():
+        if not isinstance(v, str):
+            raise MXNetError(f"var {name!r}: attribute {k!r} must be a "
+                             "string (reference attr protocol)")
     attrs = dict(attr or {})
     if shape is not None:
         attrs["__shape__"] = tuple(shape)
@@ -681,7 +738,8 @@ def var(name, attr=None, shape=None, dtype=None, lr_mult=None, wd_mult=None,
         else:
             attrs["__init__"] = repr(init)
     attrs.update(kwargs)
-    return Symbol([(_Node(None, name, attrs), 0)])
+    from ..attribute import current_attrs
+    return Symbol([(_Node(None, name, current_attrs(attrs)), 0)])
 
 
 Variable = var

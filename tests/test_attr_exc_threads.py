@@ -1,0 +1,151 @@
+"""Attribute scoping, exception propagation, and thread-local state
+(ref: tests/python/unittest/test_attr.py, test_exc_handling.py,
+test_thread_local.py — the runtime-semantics suite).
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd, sym
+from mxnet_tpu.base import MXNetError
+
+
+# -- test_attr.py analogues -------------------------------------------------
+
+def test_attr_basic():
+    data = sym.var("data", attr={"mood": "angry"})
+    op = sym.Convolution(data, name="conv", kernel=(1, 1), num_filter=1,
+                         attr={"__mood__": "so so"})
+    assert data.attr("mood") == "angry"
+    assert op.attr("__mood__") == "so so"
+
+
+def test_attr_scope_inheritance():
+    with mx.AttrScope(group="4", data="great"):
+        data = sym.var("data", attr={"dtype": "data", "group": "1"})
+        gdata = sym.var("gdata")
+    assert gdata.attr("__group__") == "4"
+    assert data.attr("group") == "1"          # explicit beats scope
+
+    # nested scopes merge, inner wins
+    with mx.AttrScope(x="10"):
+        with mx.AttrScope(x="20", y="30"):
+            a = sym.var("a")
+        b = sym.var("b")
+    assert a.attr("__x__") == "20" and a.attr("__y__") == "30"
+    assert b.attr("__x__") == "10" and b.attr("__y__") is None
+
+
+def test_attr_non_string_rejected():
+    with pytest.raises(MXNetError):
+        mx.AttrScope(group=4)
+    with pytest.raises(Exception):
+        sym.var("data", attr={"mood": 7})
+
+
+def test_attr_dict_roundtrip():
+    data = sym.var("data", attr={"a": "1"})
+    fc = sym.FullyConnected(data, name="fc", num_hidden=2,
+                            attr={"__b__": "2"})
+    d = fc.attr_dict()
+    assert d["data"]["a"] == "1"
+    assert d["fc"]["__b__"] == "2"
+
+
+# -- test_exc_handling.py analogues ----------------------------------------
+
+def test_bad_op_name_raises():
+    with pytest.raises(MXNetError):
+        nd.invoke("NoSuchOperator", [nd.zeros((1,))], {})
+
+
+def test_shape_mismatch_surfaces():
+    a = nd.zeros((2, 3))
+    b = nd.zeros((4, 5))
+    with pytest.raises(Exception):
+        nd.dot(a, b).wait_to_read()
+
+
+def test_exception_in_backward_surfaces():
+    x = nd.array(np.ones((2, 2), np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = (x * 2).sum()
+    y.backward()                       # fine
+    with pytest.raises(Exception):
+        y.backward()                   # tape consumed / replay misuse
+
+
+def test_error_does_not_poison_session():
+    """After a failed op the runtime keeps working (the reference's
+    engine clears var exceptions on wait, threaded_engine.cc:472)."""
+    try:
+        nd.dot(nd.zeros((2, 3)), nd.zeros((4, 5))).wait_to_read()
+    except Exception:
+        pass
+    out = nd.dot(nd.ones((2, 3)), nd.ones((3, 2)))
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 2), 3.0))
+
+
+# -- test_thread_local.py analogues ----------------------------------------
+
+def test_attr_scope_is_thread_local():
+    results = {}
+
+    def worker():
+        # the main thread's scope must not leak into this thread
+        s = sym.var("tdata")
+        results["thread_attr"] = s.attr("__group__")
+        with mx.AttrScope(group="9"):
+            results["thread_inner"] = sym.var("t2").attr("__group__")
+
+    with mx.AttrScope(group="1"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        results["main"] = sym.var("m").attr("__group__")
+    assert results["thread_attr"] is None
+    assert results["thread_inner"] == "9"
+    assert results["main"] == "1"
+
+
+def test_autograd_recording_is_thread_local():
+    flags = {}
+
+    def worker():
+        flags["worker_recording"] = autograd.is_recording()
+
+    with autograd.record():
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        flags["main_recording"] = autograd.is_recording()
+    assert flags["main_recording"] is True
+    assert flags["worker_recording"] is False
+
+
+def test_concurrent_imperative_ops():
+    """Concurrent eager math from several threads produces correct
+    independent results (engine-threading stress,
+    ref: tests/python/mkl/test_mkldnn.py:76)."""
+    errs = []
+
+    def worker(seed):
+        try:
+            rng = np.random.default_rng(seed)
+            a = rng.normal(0, 1, (16, 16)).astype(np.float32)
+            b = rng.normal(0, 1, (16, 16)).astype(np.float32)
+            out = nd.dot(nd.array(a), nd.array(b)).asnumpy()
+            np.testing.assert_allclose(out, a @ b, rtol=2e-3, atol=1e-3)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(s,))
+               for s in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs

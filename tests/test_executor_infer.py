@@ -1,0 +1,150 @@
+"""Executor semantics + shape/type inference depth (ref:
+tests/python/unittest/test_executor.py, test_infer_shape.py,
+test_infer_type.py).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.base import MXNetError
+
+
+def _mlp():
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=8)
+    act = sym.Activation(fc1, act_type="relu")
+    return sym.FullyConnected(act, name="fc2", num_hidden=3)
+
+
+# -- executor ---------------------------------------------------------------
+
+def test_bind_forward_backward_grads():
+    out = _mlp()
+    rng = np.random.default_rng(0)
+    args = {n: nd.array(rng.normal(0, 0.5, s).astype(np.float32))
+            for n, s in zip(out.list_arguments(),
+                            out.infer_shape(data=(4, 6))[0])}
+    grads = {n: nd.zeros_like(a) for n, a in args.items()}
+    ex = out.bind(mx.cpu(), args=args, args_grad=grads)
+    y = ex.forward(is_train=True)[0]
+    assert y.shape == (4, 3)
+    ex.backward(nd.ones((4, 3)))
+    # numeric check of dL/dfc2_bias for L = sum(out): it is batch size
+    np.testing.assert_allclose(ex.grad_dict["fc2_bias"].asnumpy(),
+                               np.full((3,), 4.0), rtol=1e-5)
+
+
+def test_grad_req_null_and_add():
+    out = _mlp()
+    shapes = dict(zip(out.list_arguments(),
+                      out.infer_shape(data=(2, 6))[0]))
+    rng = np.random.default_rng(1)
+    args = {n: nd.array(rng.normal(0, 0.5, s).astype(np.float32))
+            for n, s in shapes.items()}
+    ex = out.bind(mx.cpu(), args=args,
+                  args_grad={n: nd.zeros(shapes[n]) for n in shapes
+                             if n != "data"},
+                  grad_req={n: ("null" if n == "data" else "add")
+                            for n in shapes})
+    ex.forward(is_train=True)
+    ex.backward(nd.ones((2, 3)))
+    g1 = ex.grad_dict["fc2_bias"].asnumpy().copy()
+    ex.forward(is_train=True)
+    ex.backward(nd.ones((2, 3)))
+    g2 = ex.grad_dict["fc2_bias"].asnumpy()
+    np.testing.assert_allclose(g2, 2 * g1, rtol=1e-5)   # add accumulated
+    assert ex.grad_dict.get("data") is None or \
+        not ex.grad_dict["data"].asnumpy().any()
+
+
+def test_executor_outputs_and_monitor():
+    out = _mlp()
+    ex = out.simple_bind(mx.cpu(), data=(2, 6))
+    seen = []
+    ex.set_monitor_callback(lambda name, arr: seen.append(name),
+                            monitor_all=True)
+    ex.forward(is_train=False)
+    assert ex.outputs[0].shape == (2, 3)
+    assert any("fc1" in n for n in seen)
+
+
+def test_simple_bind_unknown_shape_raises():
+    data = sym.var("data")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=2)
+    with pytest.raises(MXNetError):
+        fc.simple_bind(mx.cpu())       # no data shape given
+
+
+def test_copy_params_and_reshape_like_flow():
+    out = _mlp()
+    ex = out.simple_bind(mx.cpu(), data=(2, 6))
+    rng = np.random.default_rng(2)
+    newp = {n: nd.array(rng.normal(0, 1, a.shape).astype(np.float32))
+            for n, a in ex.arg_dict.items() if n != "data"}
+    for n, v in newp.items():
+        ex.arg_dict[n].set_data(v) if hasattr(ex.arg_dict[n], "set_data") \
+            else ex.arg_dict[n]._inplace(v)
+    y1 = ex.forward(is_train=False, data=nd.ones((2, 6)))[0].asnumpy()
+    # a second executor with the same params gives identical outputs
+    ex2 = out.bind(mx.cpu(), args=dict(ex.arg_dict))
+    y2 = ex2.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(y1, y2, rtol=1e-6)
+
+
+# -- shape inference depth --------------------------------------------------
+
+def test_infer_shape_backward_through_reshape():
+    data = sym.var("data")
+    r = sym.Reshape(data, shape=(-1, 12))
+    fc = sym.FullyConnected(r, name="fc", num_hidden=2)
+    arg_shapes, out_shapes, _ = fc.infer_shape(data=(4, 3, 4))
+    assert out_shapes == [(4, 2)]
+    assert arg_shapes[0] == (4, 3, 4)
+
+
+def test_infer_shape_partial_unknowns():
+    data = sym.var("data")
+    w = sym.var("w")
+    fc = sym.FullyConnected(data, weight=w, name="fc", num_hidden=4,
+                            no_bias=True)
+    arg_shapes, out_shapes, _ = fc.infer_shape_partial()
+    # nothing known: everything stays None rather than raising
+    assert out_shapes[0] is None or out_shapes == [None]
+
+
+def test_infer_shape_broadcast_chain():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = sym.broadcast_add(a, b)
+    d = sym.broadcast_mul(c, sym.var("e"))
+    _, out_shapes, _ = d.infer_shape(a=(2, 1, 4), b=(1, 3, 1), e=(2, 3, 4))
+    assert out_shapes == [(2, 3, 4)]
+
+
+def test_infer_shape_conflict_raises():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = a + b
+    with pytest.raises(Exception):
+        c.infer_shape(a=(2, 3), b=(4, 5))
+
+
+# -- type inference depth ---------------------------------------------------
+
+def test_infer_type_propagates_and_casts():
+    data = sym.var("data")
+    c = sym.Cast(data, dtype="float16")
+    fc = sym.FullyConnected(c, name="fc", num_hidden=2)
+    arg_types, out_types, _ = fc.infer_type(data="float32")
+    assert out_types[0] == np.float16
+    d = dict(zip(fc.list_arguments(), arg_types))
+    assert d["data"] == np.float32
+    assert d["fc_weight"] == np.float16    # weights follow the cast input
+
+
+def test_infer_type_integer_ops():
+    data = sym.var("idx")
+    oh = sym.one_hot(data, depth=4)
+    _, out_types, _ = oh.infer_type(idx="int32")
+    assert out_types[0] in (np.float32, np.float16)

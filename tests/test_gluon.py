@@ -171,11 +171,18 @@ def test_losses():
 
 
 def test_ctc_loss():
+    # gluon convention: blank is the LAST class, padding is -1
+    # (ref: gluon/loss.py:475 passes blank_label='last')
     pred = nd.array(np.random.uniform(-1, 1, (2, 20, 6)).astype(np.float32))
-    label = nd.array([[1, 2, 3, 0], [2, 2, 0, 0]])
+    label = nd.array([[1, 2, 3, -1], [2, 2, -1, -1]])
     loss = gluon.loss.CTCLoss()(pred, label)
     assert loss.shape == (2,)
     assert np.all(loss.asnumpy() > 0)
+    # padding must actually mask: explicit label_lengths giving the same
+    # effective labels must produce the same loss
+    loss2 = gluon.loss.CTCLoss()(pred, nd.array([[1, 2, 3, 5], [2, 2, 5, 5]]),
+                                 None, nd.array([3.0, 2.0]))
+    np.testing.assert_allclose(loss.asnumpy(), loss2.asnumpy(), rtol=1e-5)
 
 
 def test_rnn_layers():

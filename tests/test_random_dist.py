@@ -1,0 +1,122 @@
+"""Distributional correctness of the random op suite (ref:
+tests/python/unittest/test_random.py — chi-square / moment checks per
+sampler, seed reproducibility, *_like variants).
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+N = 20000
+
+
+def _chi2_uniform(samples, lo, hi, bins=20):
+    hist, _ = np.histogram(samples, bins=bins, range=(lo, hi))
+    expected = len(samples) / bins
+    chi2 = ((hist - expected) ** 2 / expected).sum()
+    # df=19, alpha=1e-4 critical value ~ 50.6 — generous to stay unflaky
+    return chi2 < 60.0
+
+
+def test_uniform_distribution():
+    mx.random.seed(7)
+    s = mx.nd.random.uniform(-2.0, 3.0, shape=(N,)).asnumpy()
+    assert s.min() >= -2.0 and s.max() < 3.0
+    assert _chi2_uniform(s, -2.0, 3.0)
+    assert abs(s.mean() - 0.5) < 0.05
+
+
+def test_normal_moments():
+    mx.random.seed(8)
+    s = mx.nd.random.normal(1.5, 2.0, shape=(N,)).asnumpy()
+    assert abs(s.mean() - 1.5) < 0.06
+    assert abs(s.std() - 2.0) < 0.06
+    # third standardized moment ~ 0 (symmetry)
+    z = (s - s.mean()) / s.std()
+    assert abs((z ** 3).mean()) < 0.08
+
+
+def test_poisson_mean_var():
+    mx.random.seed(9)
+    lam = 4.0
+    s = mx.nd.random.poisson(lam, shape=(N,)).asnumpy()
+    assert abs(s.mean() - lam) < 0.1
+    assert abs(s.var() - lam) < 0.25
+    assert (s >= 0).all() and np.allclose(s, np.round(s))
+
+
+def test_gamma_moments():
+    mx.random.seed(10)
+    alpha, beta = 3.0, 2.0     # shape, scale
+    s = mx.nd.random.gamma(alpha, beta, shape=(N,)).asnumpy()
+    assert abs(s.mean() - alpha * beta) < 0.2
+    assert abs(s.var() - alpha * beta * beta) < 0.9
+
+
+def test_exponential_tail():
+    mx.random.seed(11)
+    # MXNet convention: the parameter is the SCALE (mean), not the rate
+    s = mx.nd.random.exponential(0.5, shape=(N,)).asnumpy()
+    assert abs(s.mean() - 0.5) < 0.03
+    # memoryless tail check: P(X > t) ~ exp(-t / scale)
+    for t in (0.5, 1.0):
+        emp = (s > t).mean()
+        assert abs(emp - np.exp(-t / 0.5)) < 0.02
+
+
+def test_negative_binomial_mean():
+    mx.random.seed(12)
+    k, p = 5, 0.4
+    s = mx.nd.random.negative_binomial(k, p, shape=(N,)).asnumpy()
+    expect = k * (1 - p) / p
+    assert abs(s.mean() - expect) < 0.3
+
+
+def test_seed_reproducibility_across_ops():
+    mx.random.seed(123)
+    a1 = mx.nd.random.uniform(shape=(50,)).asnumpy()
+    b1 = mx.nd.random.normal(shape=(50,)).asnumpy()
+    mx.random.seed(123)
+    a2 = mx.nd.random.uniform(shape=(50,)).asnumpy()
+    b2 = mx.nd.random.normal(shape=(50,)).asnumpy()
+    np.testing.assert_array_equal(a1, a2)
+    np.testing.assert_array_equal(b1, b2)
+
+
+def test_seed_divergence():
+    mx.random.seed(1)
+    a = mx.nd.random.uniform(shape=(50,)).asnumpy()
+    mx.random.seed(2)
+    b = mx.nd.random.uniform(shape=(50,)).asnumpy()
+    assert not np.array_equal(a, b)
+
+
+def test_sample_like_variants():
+    ref = nd.zeros((3, 4))
+    out = mx.nd.random_uniform_like(ref)
+    assert out.shape == (3, 4)
+    out2 = mx.nd.random_normal_like(ref, loc=2.0, scale=0.1)
+    assert abs(float(out2.asnumpy().mean()) - 2.0) < 0.2
+
+
+def test_randint_range():
+    mx.random.seed(5)
+    s = mx.nd.random.randint(3, 9, shape=(5000,)).asnumpy()
+    assert s.min() >= 3 and s.max() < 9
+    assert set(np.unique(s).astype(int)) == set(range(3, 9))
+
+
+def test_shuffle_is_permutation():
+    mx.random.seed(6)
+    x = nd.array(np.arange(100, dtype=np.float32))
+    y = mx.nd.random.shuffle(x).asnumpy()
+    assert not np.array_equal(y, np.arange(100))
+    np.testing.assert_array_equal(np.sort(y), np.arange(100))
+
+
+def test_multinomial_frequencies():
+    mx.random.seed(13)
+    p = nd.array(np.array([0.2, 0.3, 0.5], np.float32))
+    s = mx.nd.random.multinomial(p, shape=(N,)).asnumpy().ravel()
+    freqs = np.bincount(s.astype(int), minlength=3) / len(s)
+    np.testing.assert_allclose(freqs, [0.2, 0.3, 0.5], atol=0.02)
