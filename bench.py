@@ -272,7 +272,8 @@ def supervise():
     return 1
 
 
-def build_forward(batch, dtype=None, layout="NCHW", fuse=False):
+def build_forward(batch, dtype=None, layout="NCHW", fuse=False,
+                  stem="standard"):
     import jax
     import jax.numpy as jnp
     import mxnet_tpu as mx  # noqa: F401  (registers ops)
@@ -280,7 +281,7 @@ def build_forward(batch, dtype=None, layout="NCHW", fuse=False):
     from mxnet_tpu.gluon.model_zoo import vision
     from mxnet_tpu.ndarray.ndarray import NDArray
 
-    net = vision.resnet50_v1(layout=layout)
+    net = vision.resnet50_v1(layout=layout, stem=stem)
     net.initialize()
     infer_shapes(net, (batch, 3, 224, 224))
     net.hybridize()
@@ -435,16 +436,20 @@ def main():
     extra = {}
     variants = {"nchw": ips_bf16}
 
-    def _variant(name, layout, fuse):
-        fwd_v, pv = build_forward(BATCH, layout=layout, fuse=fuse)
+    def _variant(name, layout, fuse, stem="standard"):
+        fwd_v, pv = build_forward(BATCH, layout=layout, fuse=fuse,
+                                  stem=stem)
         pv = jax.device_put(pv)
         ips = measure(fwd_v, pv, data, sync, label=name)
         variants[name] = ips
         return ips
 
+    _NHWC_VARIANTS = ("nhwc_fused", "nhwc_s2d")
+
     def _best_layout():
-        nhwc = variants.get("nhwc_fused") or 0.0
-        rest = max(v for k, v in variants.items() if k != "nhwc_fused")
+        nhwc = max((variants.get(k) or 0.0) for k in _NHWC_VARIANTS)
+        rest = max(v for k, v in variants.items()
+                   if k not in _NHWC_VARIANTS and v)
         return "NHWC" if nhwc > rest else "NCHW"
 
     def _allred():
@@ -462,6 +467,8 @@ def main():
              lambda: _variant("nchw_fused", "NCHW", True)),
             ("resnet50_inference_bf16_nhwc_fused", 300,
              lambda: _variant("nhwc_fused", "NHWC", True)),
+            ("resnet50_inference_bf16_nhwc_s2d", 300,
+             lambda: _variant("nhwc_s2d", "NHWC", True, stem="s2d")),
             ("resnet50_inference_fp32_bs%d" % BATCH, 600, _fp32),
             ("resnet50_inference_int8_bs%d" % BATCH, 480,
              lambda: _bench_int8(host_data, sync)),

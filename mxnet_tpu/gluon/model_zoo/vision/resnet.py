@@ -9,6 +9,7 @@ from __future__ import annotations
 from ....base import MXNetError
 from ...block import HybridBlock
 from ... import nn
+from ...nn import conv_layers as _conv_layers
 
 
 class BasicBlockV1(HybridBlock):
@@ -146,9 +147,42 @@ def _layout_build_scope(layout):
     return nn.layout_scope("NHWC") if layout == "NHWC" else nullcontext()
 
 
+class S2DStemConv(HybridBlock):
+    """7x7/s2 stem conv computed in space-to-depth form (MLPerf ResNet
+    TPU recipe — see ops/nn.py s2d_stem_conv). Holds the SAME
+    (O, C, 7, 7) OIHW weight a standard stem Conv2D would, so
+    checkpoints interoperate; only the compute layout differs."""
+
+    def __init__(self, channels, in_channels=3, block=2, **kwargs):
+        super().__init__(**kwargs)
+        self._block = block
+        self._layout = _conv_layers.active_layout() or "NCHW"
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(channels, in_channels, 7, 7),
+                init=None, allow_deferred_init=False)
+
+    def hybrid_forward(self, F, x, weight):
+        return F._contrib_s2d_stem_conv(
+            x, weight, stride=2, pad=3, block=self._block,
+            layout=self._layout)
+
+
+def _stem_layers(stem, channels0):
+    """The reference's 7x7 stem, optionally in space-to-depth form."""
+    if stem == "s2d":
+        conv = S2DStemConv(channels0)
+    elif stem == "standard":
+        conv = nn.Conv2D(channels0, 7, 2, 3, use_bias=False)
+    else:
+        raise MXNetError(f"unknown stem {stem!r}")
+    return [conv, nn.BatchNorm(), nn.Activation("relu"),
+            nn.MaxPool2D(3, 2, 1)]
+
+
 class ResNetV1(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 layout="NCHW", **kwargs):
+                 layout="NCHW", stem="standard", **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         self._data_layout = layout
@@ -158,11 +192,8 @@ class ResNetV1(HybridBlock):
                 self.features.add(nn.Conv2D(channels[0], 3, 1, 1,
                                             use_bias=False))
             else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False))
-                self.features.add(nn.BatchNorm())
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
+                for layer in _stem_layers(stem, channels[0]):
+                    self.features.add(layer)
             for i, num_layer in enumerate(layers):
                 stride = 1 if i == 0 else 2
                 self.features.add(self._make_layer(
@@ -193,7 +224,7 @@ class ResNetV1(HybridBlock):
 
 class ResNetV2(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 layout="NCHW", **kwargs):
+                 layout="NCHW", stem="standard", **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         self._data_layout = layout
@@ -204,11 +235,8 @@ class ResNetV2(HybridBlock):
                 self.features.add(nn.Conv2D(channels[0], 3, 1, 1,
                                             use_bias=False))
             else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False))
-                self.features.add(nn.BatchNorm())
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
+                for layer in _stem_layers(stem, channels[0]):
+                    self.features.add(layer)
             in_channels = channels[0]
             for i, num_layer in enumerate(layers):
                 stride = 1 if i == 0 else 2

@@ -99,6 +99,68 @@ def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     return out
 
 
+@register("_contrib_s2d_stem_conv")
+def s2d_stem_conv(data, weight, stride=2, pad=3, block=2, layout="NCHW"):
+    """Space-to-depth stem convolution (the MLPerf ResNet TPU trick).
+
+    A KxK stride-s conv on a C_in=3 image runs the MXU at <3% lane
+    utilization (3 input channels vs 128 lanes). Rearranging the input
+    into bxb blocks (space-to-depth) and the SAME OIHW weight into an
+    equivalent (K/b)x(K/b) conv over C_in*b*b channels computes the
+    identical result with b*b-fold better lane utilization. The weight
+    stays in the reference's OIHW storage convention — the rearrange is
+    part of the graph, so checkpoints interoperate freely with the
+    standard stem. (ref analogue: the reference reorders weights into
+    MKL-DNN blocked layouts at the same seam, mkldnn_base-inl.h
+    GetWeights; here the 'blocked layout' is the s2d form.)
+    """
+    O, C, KH, KW = weight.shape
+    b = int(block)
+    s = int(stride)
+    p = int(pad)
+    if s % b != 0:
+        raise MXNetError("s2d stem: block must divide stride")
+    front = (-KH) % b
+    if (p + front) % b != 0:
+        # exact equivalence needs the blocked window start b*(t*sp - pl)
+        # to equal the reference's t*s - (p + front) — i.e. b | (p+front).
+        # Flooring pl instead would silently shift every output pixel.
+        raise MXNetError(
+            "s2d stem: pad %d with kernel %d is not block-%d alignable"
+            % (p, KH, b))
+    w8 = jnp.pad(weight, ((0, 0), (0, 0), (front, 0), (front, 0)))
+    K8 = KH + front
+    Kp = K8 // b
+    # (O, C, kh', py, kw', px) -> (O, py, px, C, kh', kw') -> OIHW'
+    wp = w8.reshape(O, C, Kp, b, Kp, b).transpose(0, 3, 5, 1, 2, 4) \
+        .reshape(O, C * b * b, Kp, Kp)
+
+    # reuse the standard layout table so bad layout strings raise
+    # instead of silently computing on the wrong axes
+    lhs_spec, _w_spec, out_spec, c_axis = _conv_layout(layout, 2)
+    channel_last = c_axis == 3
+    if channel_last:
+        N, H, W, _ = data.shape
+        xp = data.reshape(N, H // b, b, W // b, b, C) \
+            .transpose(0, 1, 3, 2, 4, 5) \
+            .reshape(N, H // b, W // b, b * b * C)
+    else:
+        N, _, H, W = data.shape
+        xp = data.reshape(N, C, H // b, b, W // b, b) \
+            .transpose(0, 3, 5, 1, 2, 4) \
+            .reshape(N, C * b * b, H // b, W // b)
+
+    sp = s // b
+    out_sz = (H + 2 * p - KH) // s + 1
+    pl = (p + front) // b
+    pr = (out_sz - 1) * sp + Kp - H // b - pl
+    out = lax.conv_general_dilated(
+        xp, wp, (sp, sp), ((pl, pr), (pl, pr)),
+        dimension_numbers=(lhs_spec, "OIHW", out_spec),
+    ).astype(data.dtype)
+    return out
+
+
 @register("Deconvolution")
 def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
                   pad=(), adj=(), target_shape=(), num_filter=0, num_group=1,

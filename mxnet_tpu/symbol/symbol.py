@@ -304,7 +304,10 @@ class Symbol:
                 dtypes.setdefault(key, dtypes.get(
                     (id(node.inputs[0][0]), node.inputs[0][1]), "float32"))
 
-    _PARAM_SUFFIXES = ("_weight", "_bias", "_gamma", "_beta")
+    # NOT _gamma/_beta: the reference keeps BatchNorm scale/shift (and
+    # moving stats) float32 under fp16 data — its BN FInferType pins
+    # them, and fp16 checkpoints store BN params in fp32
+    _PARAM_SUFFIXES = ("_weight", "_bias")
 
     def _retype_param_inputs(self, node, dtypes, defaulted):
         """Give default-typed parameter vars (weight/bias/gamma/beta)

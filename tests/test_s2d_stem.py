@@ -1,0 +1,83 @@
+"""Space-to-depth stem correctness (the MLPerf ResNet TPU recipe —
+ops/nn.py s2d_stem_conv + model_zoo S2DStemConv). The contract: the
+SAME OIHW 7x7 weight computes the IDENTICAL stem output, so
+checkpoints interoperate between stems."""
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon.block import infer_shapes
+from mxnet_tpu.gluon.model_zoo import vision
+from mxnet_tpu.ops.nn import s2d_stem_conv
+
+
+def test_op_equivalence_both_layouts():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(0, 1, (2, 3, 32, 32)).astype(np.float32))
+    w = jnp.asarray(rng.normal(0, 0.1, (8, 3, 7, 7)).astype(np.float32))
+    ref = lax.conv_general_dilated(
+        x, w, (2, 2), ((3, 3), (3, 3)),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    out = s2d_stem_conv(x, w, stride=2, pad=3, block=2, layout="NCHW")
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               atol=1e-5)
+    outh = s2d_stem_conv(jnp.transpose(x, (0, 2, 3, 1)), w,
+                         stride=2, pad=3, block=2, layout="NHWC")
+    np.testing.assert_allclose(np.asarray(jnp.transpose(ref, (0, 2, 3, 1))),
+                               np.asarray(outh), atol=1e-5)
+
+
+def _clone_params(src, dst):
+    sp = list(src.collect_params().items())
+    dp = list(dst.collect_params().items())
+    assert len(sp) == len(dp)
+    for (_, p1), (n2, p2) in zip(sp, dp):
+        assert p1.shape == p2.shape, (n2, p1.shape, p2.shape)
+        p2.set_data(p1.data())
+
+
+def test_zoo_resnet_s2d_matches_standard():
+    np.random.seed(0)
+    std = vision.resnet18_v1(layout="NHWC")
+    std.initialize()
+    infer_shapes(std, (2, 3, 64, 64))
+    s2d = vision.resnet18_v1(layout="NHWC", stem="s2d")
+    s2d.initialize()
+    infer_shapes(s2d, (2, 3, 64, 64))
+    _clone_params(std, s2d)
+    x = nd.array(np.random.normal(0, 1, (2, 3, 64, 64)).astype(np.float32))
+    y1 = std(x).asnumpy()
+    y2 = s2d(x).asnumpy()
+    np.testing.assert_allclose(y1, y2, atol=2e-4)
+    s2d.hybridize()
+    np.testing.assert_allclose(y1, s2d(x).asnumpy(), atol=2e-4)
+
+
+def test_s2d_stem_gradient_matches():
+    """Training through the s2d stem gives the same weight gradient as
+    the standard stem (same math, different schedule)."""
+    rng = np.random.default_rng(3)
+    w_np = rng.normal(0, 0.1, (4, 3, 7, 7)).astype(np.float32)
+    x_np = rng.normal(0, 1, (2, 3, 16, 16)).astype(np.float32)
+
+    grads = []
+    for use_s2d in (False, True):
+        w = nd.array(w_np)
+        w.attach_grad()
+        x = nd.array(x_np)
+        with autograd.record():
+            if use_s2d:
+                y = nd.invoke("_contrib_s2d_stem_conv", [x, w],
+                              {"stride": 2, "pad": 3, "block": 2,
+                               "layout": "NCHW"})
+            else:
+                y = nd.invoke("Convolution", [x, w],
+                              {"kernel": (7, 7), "stride": (2, 2),
+                               "pad": (3, 3), "num_filter": 4,
+                               "no_bias": True})
+            loss = (y * y).sum()
+        loss.backward()
+        grads.append(w.grad.asnumpy())
+    np.testing.assert_allclose(grads[0], grads[1], rtol=1e-3, atol=1e-4)
