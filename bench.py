@@ -233,6 +233,18 @@ def supervise():
                 # the aux keys, and a CPU smoke run (tiny batch, cpu
                 # backend) must never masquerade as a chip number
                 _save_last_good(line)
+            elif '"partial"' in line and ("bs%d" % BATCH) in line \
+                    and ('"backend": "tpu"' in line
+                         or '"backend": "axon"' in line) \
+                    and '"error"' not in line:
+                # a rescued partial headline is still a real full-size
+                # ON-CHIP measurement from THIS machine (backend-gated
+                # like the full line — a cpu-backend run must never
+                # masquerade). Second-tier fallback: it may refresh an
+                # older partial but never overwrites a full measurement.
+                prior = _load_last_good()
+                if prior is None or '"partial"' in prior.get("line", ""):
+                    _save_last_good(line)
             return 0
         if rc >= 0:
             last_err = ("child rc=%d, stdout tail: %r"
@@ -401,6 +413,7 @@ def main():
         "value": round(ips_bf16, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(ips_bf16 / TARGET, 4),
+        "backend": jax.default_backend(),
         "bf16_variant": "nchw",  # the final line reports best-of-variants
         "partial": True,
     }))

@@ -171,7 +171,12 @@ class S2DStemConv(HybridBlock):
 def _stem_layers(stem, channels0):
     """The reference's 7x7 stem, optionally in space-to-depth form."""
     if stem == "s2d":
-        conv = S2DStemConv(channels0)
+        # explicit Conv2D-convention prefix: the stem weight must be
+        # named <net>_conv2d0_weight exactly like the standard stem's
+        # auto-named Conv2D, or load_parameters/pretrained checkpoints
+        # cannot cross stems (stage convs live in stage*_ scopes, so
+        # the bare conv2d0_ name stays collision-free)
+        conv = S2DStemConv(channels0, prefix="conv2d0_")
     elif stem == "standard":
         conv = nn.Conv2D(channels0, 7, 2, 3, use_bias=False)
     else:

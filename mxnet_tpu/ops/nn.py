@@ -151,11 +151,14 @@ def s2d_stem_conv(data, weight, stride=2, pad=3, block=2, layout="NCHW"):
             .reshape(N, C * b * b, H // b, W // b)
 
     sp = s // b
-    out_sz = (H + 2 * p - KH) // s + 1
     pl = (p + front) // b
-    pr = (out_sz - 1) * sp + Kp - H // b - pl
+    # per-axis right pad: pr only cancels across axes when stride==block
+    def _pr(size):
+        out_sz = (size + 2 * p - KH) // s + 1
+        return (out_sz - 1) * sp + Kp - size // b - pl
+
     out = lax.conv_general_dilated(
-        xp, wp, (sp, sp), ((pl, pr), (pl, pr)),
+        xp, wp, (sp, sp), ((pl, _pr(H)), (pl, _pr(W))),
         dimension_numbers=(lhs_spec, "OIHW", out_spec),
     ).astype(data.dtype)
     return out
@@ -475,6 +478,10 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     inv = lax.rsqrt(var + eps)
     out = (data - mean.reshape(bshape)) * (inv * g).reshape(bshape) + beta.reshape(bshape)
+    # fp32 gamma/beta/stats with fp16/bf16 data must not widen the graph
+    # downstream — the reference's BN kernel emits data-dtype output
+    # while keeping its parameters fp32 (mixed-precision contract)
+    out = out.astype(data.dtype)
     if output_mean_var:
         return out, mean, var
     return out

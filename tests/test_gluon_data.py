@@ -1,0 +1,112 @@
+"""Gluon data pipeline depth (ref: tests/python/unittest/
+test_gluon_data.py, test_gluon_data_vision.py — datasets, samplers,
+DataLoader batching/last_batch policies, vision transforms)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.gluon.data import (ArrayDataset, BatchSampler, DataLoader,
+                                  RandomSampler, SequentialSampler)
+from mxnet_tpu.gluon.data.vision import transforms
+
+
+def test_array_dataset_and_transforms_lazy():
+    X = np.arange(20, dtype=np.float32).reshape(10, 2)
+    y = np.arange(10, dtype=np.float32)
+    ds = ArrayDataset(X, y)
+    assert len(ds) == 10
+    x0, y0 = ds[3]
+    np.testing.assert_allclose(np.asarray(x0), X[3])
+    # transform_first applies to data only
+    t = ds.transform_first(lambda x: x * 2)
+    x1, y1 = t[3]
+    np.testing.assert_allclose(np.asarray(x1), X[3] * 2)
+    assert float(y1) == 3.0
+
+
+def test_dataset_filter_take():
+    ds = ArrayDataset(np.arange(10, dtype=np.float32))
+    taken = ds.take(4)
+    assert len(taken) == 4
+    filt = ds.filter(lambda x: float(x) % 2 == 0)
+    assert len(filt) == 5
+
+
+def test_samplers():
+    assert list(SequentialSampler(5)) == [0, 1, 2, 3, 4]
+    r = list(RandomSampler(100))
+    assert sorted(r) == list(range(100)) and r != list(range(100))
+    bs = BatchSampler(SequentialSampler(7), 3, last_batch="keep")
+    assert [len(b) for b in bs] == [3, 3, 1]
+    bs2 = BatchSampler(SequentialSampler(7), 3, last_batch="discard")
+    assert [len(b) for b in bs2] == [3, 3]
+    bs3 = BatchSampler(SequentialSampler(7), 3, last_batch="rollover")
+    first_pass = [len(b) for b in bs3]
+    second_pass = [len(b) for b in bs3]
+    assert first_pass == [3, 3]
+    assert second_pass[0] == 3          # the rolled-over 1 + next 2
+
+
+def test_dataloader_policies():
+    X = np.arange(14, dtype=np.float32).reshape(7, 2)
+    ds = ArrayDataset(X)
+    keep = list(DataLoader(ds, batch_size=3, last_batch="keep"))
+    assert [b.shape[0] for b in keep] == [3, 3, 1]
+    disc = list(DataLoader(ds, batch_size=3, last_batch="discard"))
+    assert [b.shape[0] for b in disc] == [3, 3]
+    # shuffle covers every sample exactly once
+    sh = list(DataLoader(ds, batch_size=7, shuffle=True))[0].asnumpy()
+    np.testing.assert_allclose(np.sort(sh[:, 0]), X[:, 0])
+
+
+def test_dataloader_num_workers_parity():
+    X = np.arange(64, dtype=np.float32).reshape(16, 4)
+    ds = ArrayDataset(X)
+    seq = np.concatenate([b.asnumpy() for b in
+                          DataLoader(ds, batch_size=4)])
+    par = np.concatenate([b.asnumpy() for b in
+                          DataLoader(ds, batch_size=4, num_workers=3)])
+    np.testing.assert_allclose(seq, par)
+
+
+def test_dataloader_batchify_fn():
+    ds = ArrayDataset(np.arange(6, dtype=np.float32))
+    loader = DataLoader(ds, batch_size=2,
+                        batchify_fn=lambda batch: sum(float(x)
+                                                      for x in batch))
+    assert list(loader) == [1.0, 5.0, 9.0]
+
+
+def test_vision_transforms_compose():
+    img = nd.array(np.random.default_rng(0).integers(
+        0, 255, (8, 8, 3)).astype(np.uint8))
+    t = transforms.Compose([transforms.ToTensor(),
+                            transforms.Normalize(0.5, 0.25)])
+    out = t(img)
+    assert out.shape == (3, 8, 8)
+    v = out.asnumpy()
+    assert v.min() >= -2.0 - 1e-6 and v.max() <= 2.0 + 1e-6
+
+
+def test_vision_resize_crop():
+    img = nd.array(np.zeros((16, 12, 3), np.uint8))
+    r = transforms.Resize((8, 10))(img)       # (w, h) convention
+    assert r.shape[2] == 3 and r.shape[0] in (8, 10)
+    c = transforms.CenterCrop(6)(img)
+    assert c.shape[0] == 6 and c.shape[1] == 6
+
+
+def test_synthetic_vision_dataset_loader_e2e():
+    from mxnet_tpu.gluon.data.vision import datasets as vdatasets
+    ds = ArrayDataset(
+        np.random.default_rng(1).integers(
+            0, 255, (20, 8, 8, 3)).astype(np.uint8),
+        np.arange(20, dtype=np.float32))
+    ds = ds.transform_first(transforms.ToTensor())
+    loader = DataLoader(ds, batch_size=5, shuffle=True, num_workers=2)
+    seen = 0
+    for xb, yb in loader:
+        assert xb.shape == (5, 3, 8, 8)
+        seen += xb.shape[0]
+    assert seen == 20

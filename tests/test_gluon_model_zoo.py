@@ -1,0 +1,62 @@
+"""Every model-zoo family forwards with correct output shape (ref:
+tests/python/unittest/test_gluon_model_zoo.py — the reference runs
+each zoo model forward; here one representative per family variant at
+the smallest depth/width to keep CI time sane, plus the full name
+list is checked against get_model)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon.model_zoo import vision
+
+ALL_MODELS = [
+    "alexnet", "densenet121", "densenet161", "densenet169", "densenet201",
+    "inceptionv3", "mobilenet0.25", "mobilenet0.5", "mobilenet0.75",
+    "mobilenet1.0", "mobilenetv2_0.25", "mobilenetv2_0.5",
+    "mobilenetv2_0.75", "mobilenetv2_1.0", "resnet101_v1", "resnet101_v2",
+    "resnet152_v1", "resnet152_v2", "resnet18_v1", "resnet18_v2",
+    "resnet34_v1", "resnet34_v2", "resnet50_v1", "resnet50_v2",
+    "squeezenet1.0", "squeezenet1.1", "vgg11", "vgg11_bn", "vgg13",
+    "vgg13_bn", "vgg16", "vgg16_bn", "vgg19", "vgg19_bn",
+]
+
+# one representative per family x variant axis (smallest member)
+REPRESENTATIVES = [
+    ("alexnet", 224),
+    ("densenet121", 224),
+    ("inceptionv3", 299),
+    ("mobilenet0.25", 224),
+    ("mobilenetv2_0.25", 224),
+    ("resnet18_v1", 224),
+    ("resnet18_v2", 224),
+    ("squeezenet1.0", 224),
+    ("squeezenet1.1", 224),
+    ("vgg11", 224),
+    ("vgg11_bn", 224),
+]
+
+
+def test_model_registry_complete():
+    for name in ALL_MODELS:
+        net = vision.get_model(name)
+        assert net is not None, name
+
+
+@pytest.mark.parametrize("name,size", REPRESENTATIVES)
+def test_zoo_forward_shape(name, size):
+    net = vision.get_model(name)
+    net.initialize()
+    x = nd.array(np.random.default_rng(0).normal(
+        0, 1, (1, 3, size, size)).astype(np.float32))
+    out = net(x)
+    assert out.shape == (1, 1000), (name, out.shape)
+    v = out.asnumpy()
+    assert np.isfinite(v).all(), name
+
+
+def test_zoo_classes_kwarg():
+    net = vision.get_model("resnet18_v1", classes=7)
+    net.initialize()
+    x = nd.array(np.zeros((1, 3, 224, 224), np.float32))
+    assert net(x).shape == (1, 7)

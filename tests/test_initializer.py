@@ -1,0 +1,106 @@
+"""Initializer statistics and dispatch (ref:
+tests/python/unittest/test_init.py)."""
+import json
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import init, nd
+from mxnet_tpu.initializer import InitDesc
+
+
+def _draw(initializer, shape, name="w_weight"):
+    arr = nd.zeros(shape)
+    initializer(InitDesc(name), arr)
+    return arr.asnumpy()
+
+
+def test_constant_zero_one():
+    assert (_draw(init.Zero(), (4, 5)) == 0).all()
+    assert (_draw(init.One(), (4, 5)) == 1).all()
+    assert (_draw(init.Constant(2.5), (4, 5)) == 2.5).all()
+
+
+def test_uniform_bounds_and_normal_sigma():
+    mx.random.seed(0)
+    u = _draw(init.Uniform(0.3), (200, 200))
+    assert u.min() >= -0.3 and u.max() <= 0.3
+    assert abs(u.mean()) < 0.01
+    n = _draw(init.Normal(0.2), (200, 200))
+    assert abs(n.std() - 0.2) < 0.01
+
+
+def test_xavier_variants():
+    mx.random.seed(1)
+    shape = (64, 32)
+    fan_in, fan_out = shape[1], shape[0]
+    # MXNet formula: sigma = sqrt(magnitude / ((fan_in + fan_out)/2))
+    g = _draw(init.Xavier(rnd_type="gaussian", factor_type="avg",
+                          magnitude=2), shape)
+    assert abs(g.std() - np.sqrt(2.0 / ((fan_in + fan_out) / 2))) < 0.012
+    # uniform in: bound = sqrt(3 / fan_in)
+    u = _draw(init.Xavier(rnd_type="uniform", factor_type="in",
+                          magnitude=3), shape)
+    bound = np.sqrt(3.0 / fan_in)
+    assert u.min() >= -bound - 1e-6 and u.max() <= bound + 1e-6
+
+
+def test_msra_prelu():
+    mx.random.seed(2)
+    shape = (128, 64)
+    m = _draw(init.MSRAPrelu(factor_type="in", slope=0.0), shape)
+    assert abs(m.std() - np.sqrt(2.0 / shape[1])) < 0.02
+
+
+def test_orthogonal_columns():
+    mx.random.seed(3)
+    # reference default scale is 1.414, so W W^T = scale^2 I
+    w = _draw(init.Orthogonal(scale=1.0), (32, 32))
+    np.testing.assert_allclose(w @ w.T, np.eye(32), atol=1e-4)
+    w2 = _draw(init.Orthogonal(), (32, 32))
+    np.testing.assert_allclose(w2 @ w2.T, 1.414 ** 2 * np.eye(32),
+                               atol=1e-3)
+
+
+def test_bilinear_upsampling_kernel():
+    w = _draw(init.Bilinear(), (1, 1, 4, 4), name="up_weight")
+    k = w[0, 0]
+    # symmetric and peaked at center
+    np.testing.assert_allclose(k, k[::-1, ::-1], atol=1e-6)
+    assert k.max() == k[1:3, 1:3].max()
+
+
+def test_lstmbias_forget_gate():
+    b = _draw(init.LSTMBias(forget_bias=1.0), (32,), name="lstm_i2h_bias")
+    h = 8
+    np.testing.assert_allclose(b[h:2 * h], 1.0)
+    assert (b[:h] == 0).all() and (b[2 * h:] == 0).all()
+
+
+def test_name_based_dispatch():
+    """Initializer.__call__ routes by name suffix: bias->0, gamma->1,
+    mean->0 (ref: initializer.py dispatch)."""
+    ini = init.Xavier()
+    bias = nd.zeros((5,))
+    ini(InitDesc("fc_bias"), bias)
+    assert (bias.asnumpy() == 0).all()
+    gamma = nd.zeros((5,))
+    ini(InitDesc("bn_gamma"), gamma)
+    assert (gamma.asnumpy() == 1).all()
+    var = nd.zeros((5,))
+    ini(InitDesc("bn_moving_var"), var)
+    assert (var.asnumpy() == 1).all()
+
+
+def test_mixed_and_serialization():
+    mixed = init.Mixed([".*bias", ".*"],
+                       [init.Zero(), init.Constant(3.0)])
+    b = nd.zeros((4,))
+    mixed(InitDesc("fc_bias"), b)
+    w = nd.zeros((4,))
+    mixed(InitDesc("fc_weight"), w)
+    assert (b.asnumpy() == 0).all() and (w.asnumpy() == 3.0).all()
+    # dumps round-trips through json
+    dumped = init.Xavier(magnitude=2.5).dumps()
+    kind, kwargs = json.loads(dumped)
+    assert kind.lower() == "xavier" and kwargs["magnitude"] == 2.5

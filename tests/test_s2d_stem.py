@@ -29,12 +29,20 @@ def test_op_equivalence_both_layouts():
                                np.asarray(outh), atol=1e-5)
 
 
+def _strip_net_prefix(name):
+    # drop the per-instance net prefix (resnetv10_ vs resnetv11_)
+    return name.split("_", 1)[1]
+
+
 def _clone_params(src, dst):
-    sp = list(src.collect_params().items())
-    dp = list(dst.collect_params().items())
-    assert len(sp) == len(dp)
-    for (_, p1), (n2, p2) in zip(sp, dp):
-        assert p1.shape == p2.shape, (n2, p1.shape, p2.shape)
+    """Clone BY NAME — this is the checkpoint-interop contract: the s2d
+    stem must use the exact parameter names the standard stem saves."""
+    sp = {_strip_net_prefix(n): p for n, p in src.collect_params().items()}
+    dp = {_strip_net_prefix(n): p for n, p in dst.collect_params().items()}
+    assert set(sp) == set(dp), set(sp) ^ set(dp)
+    for n, p2 in dp.items():
+        p1 = sp[n]
+        assert p1.shape == p2.shape, (n, p1.shape, p2.shape)
         p2.set_data(p1.data())
 
 
@@ -53,6 +61,19 @@ def test_zoo_resnet_s2d_matches_standard():
     np.testing.assert_allclose(y1, y2, atol=2e-4)
     s2d.hybridize()
     np.testing.assert_allclose(y1, s2d(x).asnumpy(), atol=2e-4)
+
+
+def test_op_equivalence_nonsquare_stride_ne_block():
+    """stride != block and H != W: the per-axis padding math."""
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(0, 1, (1, 3, 16, 18)).astype(np.float32))
+    w = jnp.asarray(rng.normal(0, 0.1, (4, 3, 7, 7)).astype(np.float32))
+    ref = lax.conv_general_dilated(
+        x, w, (4, 4), ((3, 3), (3, 3)),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    out = s2d_stem_conv(x, w, stride=4, pad=3, block=2, layout="NCHW")
+    assert out.shape == ref.shape, (out.shape, ref.shape)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=1e-5)
 
 
 def test_s2d_stem_gradient_matches():
