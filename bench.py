@@ -224,27 +224,30 @@ def supervise():
         # error lines must still go through the retry loop
         if line is not None and (rc == 0 or '"error"' not in line):
             print(line, flush=True)
-            if rc == 0 and '"partial"' not in line and \
-                    ('"backend": "tpu"' in line
-                     or '"backend": "axon"' in line) and \
-                    ("bs%d" % BATCH) in line:
-                # only COMPLETE, FULL-SIZE, ON-CHIP measurements become
-                # the stale fallback — a rescued partial headline lacks
-                # the aux keys, and a CPU smoke run (tiny batch, cpu
-                # backend) must never masquerade as a chip number
-                _save_last_good(line)
-            elif '"partial"' in line and ("bs%d" % BATCH) in line \
-                    and ('"backend": "tpu"' in line
-                         or '"backend": "axon"' in line) \
-                    and '"error"' not in line:
-                # a rescued partial headline is still a real full-size
-                # ON-CHIP measurement from THIS machine (backend-gated
-                # like the full line — a cpu-backend run must never
-                # masquerade). Second-tier fallback: it may refresh an
-                # older partial but never overwrites a full measurement.
-                prior = _load_last_good()
-                if prior is None or '"partial"' in prior.get("line", ""):
+
+            def _onchip_fullsize(ln):
+                # a CPU smoke run (tiny batch, cpu backend) must never
+                # masquerade as a chip number
+                return (('"backend": "tpu"' in ln
+                         or '"backend": "axon"' in ln)
+                        and ("bs%d" % BATCH) in ln
+                        and '"error"' not in ln)
+
+            if _onchip_fullsize(line):
+                if '"partial"' not in line:
+                    # a COMPLETE on-chip measurement is the first-tier
+                    # fallback, whether the child exited cleanly or was
+                    # killed after printing it (teardown wedge rescue)
                     _save_last_good(line)
+                else:
+                    # a rescued partial headline is still a real
+                    # full-size on-chip measurement from THIS machine;
+                    # second tier: it may refresh an older partial but
+                    # never overwrites a full measurement
+                    prior = _load_last_good()
+                    if prior is None or '"partial"' in prior.get(
+                            "line", ""):
+                        _save_last_good(line)
             return 0
         if rc >= 0:
             last_err = ("child rc=%d, stdout tail: %r"
@@ -459,11 +462,14 @@ def main():
 
     _NHWC_VARIANTS = ("nhwc_fused", "nhwc_s2d")
 
+    def _best_variant():
+        return max(variants, key=lambda k: variants[k] or 0.0)
+
     def _best_layout():
-        nhwc = max((variants.get(k) or 0.0) for k in _NHWC_VARIANTS)
-        rest = max(v for k, v in variants.items()
-                   if k not in _NHWC_VARIANTS and v)
-        return "NHWC" if nhwc > rest else "NCHW"
+        return "NHWC" if _best_variant() in _NHWC_VARIANTS else "NCHW"
+
+    def _best_stem():
+        return "s2d" if _best_variant() == "nhwc_s2d" else "standard"
 
     def _allred():
         bw, n = _bench_allreduce(sync)
@@ -486,15 +492,15 @@ def main():
             ("resnet50_inference_int8_bs%d" % BATCH, 480,
              lambda: _bench_int8(host_data, sync)),
             ("resnet50_train_bf16_bs%d" % BATCH, 600,
-             lambda: _bench_train(host_data, sync,
-                                  layout=_best_layout())),
+             lambda: _bench_train(host_data, sync, layout=_best_layout(),
+                                  stem=_best_stem())),
             ("allreduce_gbps", 150, _allred)):
         val, err = _aux_section(key, secs, fn)
         extra[key] = val
         if err is not None:
             extra[key + "_error"] = err
 
-    best_name = max(variants, key=lambda k: variants[k] or 0.0)
+    best_name = _best_variant()
     best_ips = variants[best_name]
     result = {
         "metric": METRIC,
@@ -521,11 +527,12 @@ def main():
         result["mfu_train_bf16"] = round(
             ips_train * 3 * RESNET50_GFLOPS / (PEAK_TFLOPS * 1e3), 4)
         result["train_layout"] = _best_layout()
+        result["train_stem"] = _best_stem()
     result.update(extra)
     _emit(json.dumps(result))
 
 
-def build_train(batch, layout="NCHW"):
+def build_train(batch, layout="NCHW", stem="standard"):
     """Jitted ResNet-50 training step: forward + softmax-CE loss +
     backward + SGD-momentum, params/momentum donated so updates are
     in-place on device (the reference's training benchmark analogue,
@@ -538,7 +545,7 @@ def build_train(batch, layout="NCHW"):
     from mxnet_tpu.gluon.model_zoo import vision
     from mxnet_tpu.ndarray.ndarray import NDArray
 
-    net = vision.resnet50_v1(layout=layout)
+    net = vision.resnet50_v1(layout=layout, stem=stem)
     net.initialize()
     infer_shapes(net, (batch, 3, 224, 224))
     net.hybridize()
@@ -574,11 +581,12 @@ def build_train(batch, layout="NCHW"):
             jax.device_put(pvals), jax.device_put(moms))
 
 
-def _bench_train(host_data, sync, iters=20, layout="NCHW"):
+def _bench_train(host_data, sync, iters=20, layout="NCHW",
+                 stem="standard"):
     import jax.numpy as jnp
     import numpy as np
 
-    step, params, moms = build_train(BATCH, layout=layout)
+    step, params, moms = build_train(BATCH, layout=layout, stem=stem)
     rng = np.random.default_rng(1)
     labels = jnp.asarray(rng.integers(0, 1000, BATCH).astype(np.int32))
     data = jnp.asarray(host_data, dtype=jnp.bfloat16)
