@@ -1,0 +1,157 @@
+"""Mixed-precision consistency sweep — the TPU analogue of the
+reference's cpu-vs-gpu check_consistency suite (ref:
+tests/python/gpu/test_operator_gpu.py): the same op evaluated in fp32
+and in bf16/fp16 must agree within the dtype's resolution, forward and
+backward. On TPU the accelerated path IS the low-precision path, so
+dtype agreement is the backend-consistency axis that matters."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.base import MXNetError
+
+rng = np.random.default_rng(0)
+
+# (name, builder(x_fp32_ndarray) -> NDArray, input shape, bf16 rtol)
+CASES = [
+    ("fully_connected",
+     lambda x, w: nd.FullyConnected(x, w, num_hidden=32, no_bias=True),
+     (8, 64), (32, 64), 0.05),
+    ("dot",
+     lambda x, w: nd.dot(x, w, transpose_b=True),
+     (16, 48), (24, 48), 0.05),
+    ("conv3x3",
+     lambda x, w: nd.Convolution(x, w, kernel=(3, 3), num_filter=8,
+                                 pad=(1, 1), no_bias=True),
+     (2, 4, 12, 12), (8, 4, 3, 3), 0.05),
+    ("softmax_chain",
+     lambda x, w: nd.softmax(nd.dot(x, w)),
+     (8, 32), (32, 16), 0.08),
+]
+
+
+def _cast_params(arrs, dtype):
+    return [a.astype(dtype) for a in arrs]
+
+
+@pytest.mark.parametrize("name,fn,xs,ws,rtol", CASES)
+def test_forward_backward_bf16_consistency(name, fn, xs, ws, rtol):
+    x32 = nd.array(rng.normal(0, 1, xs).astype(np.float32))
+    w32 = nd.array(rng.normal(0, 0.3, ws).astype(np.float32))
+
+    results = {}
+    for dt in ("float32", "bfloat16"):
+        x = x32.astype(dt)
+        w = w32.astype(dt)
+        x.attach_grad()
+        w.attach_grad()
+        with autograd.record():
+            y = fn(x, w)
+            loss = (y.astype("float32") ** 2).mean() if dt != "float32" \
+                else (y ** 2).mean()
+        loss.backward()
+        results[dt] = (y.astype("float32").asnumpy(),
+                       x.grad.astype("float32").asnumpy(),
+                       w.grad.astype("float32").asnumpy())
+
+    for a, b, what in zip(results["float32"], results["bfloat16"],
+                          ("output", "dx", "dw")):
+        denom = np.abs(a).max() + 1e-6
+        err = np.abs(a - b).max() / denom
+        assert err < rtol, (name, what, err)
+
+
+def test_check_consistency_utility():
+    """The test_utils.check_consistency entry point itself."""
+    from mxnet_tpu.test_utils import check_consistency
+
+    def fn(x):
+        return nd.softmax(x * 2.0)
+
+    x = nd.array(rng.normal(0, 1, (4, 8)).astype(np.float32))
+    outs = check_consistency(fn, [x], ctx_list=[mx.cpu(), mx.cpu()])
+    assert outs is not None
+
+
+def test_batchnorm_bf16_inference_close_to_fp32():
+    """net.cast('bfloat16') is the gluon mixed-precision entry (ref:
+    Block.cast) — the cast net on bf16 data tracks the fp32 net."""
+    from mxnet_tpu.gluon import nn
+
+    def build():
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(8, 3, 1, 1, in_channels=4),
+                nn.BatchNorm(in_channels=8),
+                nn.Activation("relu"))
+        return net
+
+    net = build()
+    net.initialize()
+    x32 = nd.array(rng.normal(0, 1, (2, 4, 8, 8)).astype(np.float32))
+    y32 = net(x32).asnumpy()
+    net.cast("bfloat16")
+    y16 = net(x32.astype("bfloat16")).astype("float32").asnumpy()
+    denom = np.abs(y32).max() + 1e-6
+    assert np.abs(y32 - y16).max() / denom < 0.05
+
+
+def test_dtype_mismatch_raises_like_reference():
+    """fp32 weights with bf16 data is an error, not a silent upcast
+    (reference parity: infer_type rejects mixed conv inputs)."""
+    from mxnet_tpu.gluon import nn
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, 1, 1, in_channels=4))
+    net.initialize()
+    x = nd.array(rng.normal(0, 1, (2, 4, 8, 8)).astype(np.float32))
+    with pytest.raises((MXNetError, TypeError)):
+        net(x.astype("bfloat16")).wait_to_read()
+
+
+def test_fp16_gluon_training_end_to_end():
+    """net.cast('float16') + multi-precision Trainer converges (ref:
+    tests/python/train/ fp16 dtype convergence tests)."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    r = np.random.default_rng(1)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=8),
+            nn.Dense(2, in_units=16))
+    net.initialize()
+    net.cast("float16")
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9,
+                        "multi_precision": True})
+    lf = gluon.loss.SoftmaxCrossEntropyLoss()
+    first = v = None
+    for _ in range(60):
+        xs = r.normal(0, 1, (32, 8)).astype(np.float16)
+        ys = (xs[:, 0] > 0).astype(np.float32)
+        x, y = nd.array(xs).astype("float16"), nd.array(ys)
+        with autograd.record():
+            loss = lf(net(x).astype("float32"), y)
+        loss.backward()
+        tr.step(32)
+        v = float(loss.mean().asscalar())
+        if first is None:
+            first = v
+    assert v < 0.5 * first, (first, v)
+    # weights remained fp16 throughout
+    for _, p in net.collect_params().items():
+        assert p.data().dtype == np.float16
+
+
+def test_fp16_master_weight_update_pattern():
+    """Multi-precision optimizer contract: fp16 weights, fp32 master +
+    state (ref: optimizer.py create_state_multi_precision)."""
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9,
+                              multi_precision=True)
+    w16 = nd.array(rng.normal(0, 1, (8,)).astype(np.float32)) \
+        .astype("float16")
+    state = opt.create_state_multi_precision(0, w16)
+    g16 = nd.array(np.full((8,), 0.5, np.float32)).astype("float16")
+    opt.update_multi_precision(0, w16, g16, state)
+    # master stays fp32; fp16 weight mirrors it
+    master = state[0] if isinstance(state, (list, tuple)) else state
+    assert master.dtype == np.float32
+    assert w16.dtype == np.float16
