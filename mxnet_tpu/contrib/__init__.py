@@ -1,5 +1,7 @@
 """mx.contrib — quantization, ONNX, text, SVRG, tensorboard
 (ref: python/mxnet/contrib/)."""
+from . import autograd
+from . import io
 from . import quantization
 from . import text
 from . import svrg_optimization

@@ -1,0 +1,53 @@
+"""Legacy functional autograd API (ref: python/mxnet/contrib/
+autograd.py — the pre-gluon `grad_and_loss`/`grad` decorators kept for
+old user code; the modern surface is mxnet_tpu.autograd)."""
+from __future__ import annotations
+
+import functools
+
+from .. import autograd as _ag
+from ..ndarray.ndarray import NDArray
+
+# re-exported pass-throughs (the reference exposes these here too)
+mark_variables = _ag.mark_variables
+backward = _ag.backward
+
+
+def grad_and_loss(func, argnum=None):
+    """Return a function computing both gradient of `func` and its
+    outputs (ref: contrib/autograd.py grad_and_loss)."""
+
+    @functools.wraps(func)
+    def wrapped(*args):
+        variables = list(args)
+        if argnum is not None:
+            argnums = [argnum] if isinstance(argnum, int) else list(argnum)
+            variables = [args[i] for i in argnums]
+        for x in variables:
+            assert isinstance(x, NDArray), \
+                "type of autograd input should be NDArray"
+            x.attach_grad()
+        with _ag.record():
+            outputs = func(*args)
+        heads = outputs if isinstance(outputs, (list, tuple)) \
+            else [outputs]
+        _ag.backward(list(heads))
+        return [x.grad for x in variables], outputs
+
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """Gradient-only variant (ref: contrib/autograd.py grad)."""
+    fn = grad_and_loss(func, argnum)
+
+    @functools.wraps(func)
+    def wrapped(*args):
+        return fn(*args)[0]
+
+    return wrapped
+
+
+def compute_gradient(outputs):
+    """Deprecated alias retained for API parity."""
+    _ag.backward(list(outputs))

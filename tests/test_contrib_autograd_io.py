@@ -1,0 +1,75 @@
+"""Legacy contrib surfaces: old functional autograd API + the
+DataLoaderIter bridge (ref: tests/python/unittest/
+test_contrib_autograd.py, test_contrib_io.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.contrib import autograd as cag
+from mxnet_tpu.contrib.io import DataLoaderIter
+
+
+def test_grad_and_loss():
+    def f(x):
+        return x * x + 2 * x
+
+    x = nd.array(np.array([1.0, 3.0], np.float32))
+    grads, out = cag.grad_and_loss(f)(x)
+    np.testing.assert_allclose(out.asnumpy(), [3.0, 15.0])
+    np.testing.assert_allclose(grads[0].asnumpy(), [4.0, 8.0])
+
+
+def test_grad_argnum():
+    def f(a, b):
+        return (a * b).sum()
+
+    a = nd.array(np.array([2.0], np.float32))
+    b = nd.array(np.array([5.0], np.float32))
+    grads = cag.grad(f, argnum=0)(a, b)
+    np.testing.assert_allclose(grads[0].asnumpy(), [5.0])
+    grads_both = cag.grad(f, argnum=[0, 1])(a, b)
+    np.testing.assert_allclose(grads_both[1].asnumpy(), [2.0])
+
+
+def test_khatri_rao():
+    A = np.arange(6).reshape(2, 3).astype(np.float32)
+    B = np.arange(1, 7).reshape(2, 3).astype(np.float32)
+    out = nd.khatri_rao(nd.array(A), nd.array(B)).asnumpy()
+    # column-wise kronecker: out[:, j] = kron(A[:, j], B[:, j])
+    expect = np.stack([np.kron(A[:, j], B[:, j]) for j in range(3)],
+                      axis=1)
+    np.testing.assert_allclose(out, expect)
+
+
+def test_dataloader_iter_bridge_with_module():
+    X = np.random.default_rng(0).normal(0, 1, (48, 6)).astype(np.float32)
+    w = np.array([1.0, -2.0, 0.5, 0, 0, 1.0], np.float32)
+    y = (X @ w > 0).astype(np.float32)
+    ds = gluon.data.ArrayDataset(X, y)
+    loader = gluon.data.DataLoader(ds, batch_size=8)
+    it = DataLoaderIter(loader)
+    assert it.provide_data[0].shape == (8, 6)
+    assert it.provide_label[0].shape == (8,)
+
+    # consumable by Module.fit end to end
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, name="fc", num_hidden=2)
+    out = mx.sym.SoftmaxOutput(fc, name="softmax")
+    mod = mx.mod.Module(out, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.fit(it, num_epoch=6, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    it.reset()
+    score = mod.score(it, "acc")
+    acc = dict(score)["accuracy"] if isinstance(score, list) else score
+    assert float(acc) > 0.8, acc
+
+
+def test_dataloader_iter_reset_reiterates():
+    ds = gluon.data.ArrayDataset(np.arange(12, dtype=np.float32))
+    it = DataLoaderIter(gluon.data.DataLoader(ds, batch_size=4),
+                        data_name="x")
+    n1 = sum(1 for _ in it)
+    it.reset()
+    n2 = sum(1 for _ in it)
+    assert n1 == n2 == 3
