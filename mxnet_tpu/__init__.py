@@ -67,6 +67,12 @@ from . import recordio  # noqa: E402
 from . import symbol  # noqa: E402
 from . import symbol as sym  # noqa: E402
 from . import attribute  # noqa: E402
+from . import name  # noqa: E402
+from . import registry  # noqa: E402
+from . import log  # noqa: E402
+from . import rtc  # noqa: E402
+from . import executor_manager  # noqa: E402
+from . import kvstore_server  # noqa: E402
 from . import libinfo  # noqa: E402
 from .attribute import AttrScope  # noqa: E402
 from .executor import Executor  # noqa: E402

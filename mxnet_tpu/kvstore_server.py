@@ -1,0 +1,26 @@
+"""Parameter-server entry point (ref: python/mxnet/kvstore_server.py —
+the process `tools/launch.py` starts in the server role enters this
+loop; the reference reads DMLC_ROLE and blocks in ps-lite's server).
+
+Here the server loop lives in the native transport
+(`kvstore/dist.py run_server` over `_native/comm.cc`); this module
+keeps the reference's import-level contract so `python -c "import
+mxnet_tpu; mxnet_tpu.kvstore_server._init_kvstore_server_module()"`
+behaves like the reference server bootstrap."""
+from __future__ import annotations
+
+import os
+
+
+def _init_kvstore_server_module():
+    """Enter the server loop when this process holds the server role
+    (ref: kvstore_server.py _init_kvstore_server_module)."""
+    role = os.environ.get("DMLC_ROLE", "")
+    if role == "server":
+        from .kvstore import dist
+        dist.run_server()
+    # worker/scheduler roles fall through exactly like the reference
+
+
+if __name__ == "__main__":
+    _init_kvstore_server_module()

@@ -1,0 +1,57 @@
+"""Logging utilities (ref: python/mxnet/log.py — a get_logger with the
+reference's level constants and single-handler discipline)."""
+from __future__ import annotations
+
+import logging
+import sys
+
+CRITICAL = logging.CRITICAL
+ERROR = logging.ERROR
+WARNING = logging.WARNING
+INFO = logging.INFO
+DEBUG = logging.DEBUG
+NOTSET = logging.NOTSET
+
+PY3 = sys.version_info[0] >= 3
+
+
+class _Formatter(logging.Formatter):
+    """Level-coded prefix formatter (ref: log.py _Formatter)."""
+
+    def __init__(self, colored=True):
+        self.colored = colored
+        super().__init__("%(message)s")
+
+    def _color(self, level):
+        codes = {logging.WARNING: "\x1b[33m", logging.ERROR: "\x1b[31m",
+                 logging.CRITICAL: "\x1b[35m"}
+        return codes.get(level, "\x1b[32m")
+
+    def format(self, record):
+        date = "%(asctime)s"
+        if self.colored and sys.stderr.isatty():
+            head = (self._color(record.levelno)
+                    + record.levelname[0] + date + "\x1b[0m")
+        else:
+            head = record.levelname[0] + date
+        self._style._fmt = head + " %(message)s"
+        return super().format(record)
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    """Create or retrieve a configured logger (ref: log.py get_logger)."""
+    logger = logging.getLogger(name)
+    if name is not None and not getattr(logger, "_init_done", False):
+        logger._init_done = True
+        if filename:
+            mode = filemode or "a"
+            handler = logging.FileHandler(filename, mode)
+            # files must never receive ANSI codes (ref: log.py applies
+            # the colored formatter to the stream handler only)
+            handler.setFormatter(_Formatter(colored=False))
+        else:
+            handler = logging.StreamHandler()
+            handler.setFormatter(_Formatter())
+        logger.addHandler(handler)
+        logger.setLevel(level)
+    return logger

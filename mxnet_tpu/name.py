@@ -1,0 +1,54 @@
+"""Automatic symbol naming (ref: python/mxnet/name.py — NameManager
+with a per-hint counter, Prefix prepends a scope prefix; symbol
+creation consults the active manager)."""
+from __future__ import annotations
+
+import threading
+
+_local = threading.local()
+
+
+class NameManager:
+    """Scope-based automatic naming. Entering pushes this manager; all
+    auto-generated symbol names go through ``get`` (ref: name.py
+    NameManager.get)."""
+
+    def __init__(self):
+        self._counter = {}
+        self._old = None
+
+    @classmethod
+    def current(cls):
+        mgr = getattr(_local, "manager", None)
+        if mgr is None:
+            mgr = _local.manager = NameManager()
+        return mgr
+
+    def get(self, name, hint):
+        if name:
+            return name
+        idx = self._counter.get(hint, 0)
+        self._counter[hint] = idx + 1
+        return f"{hint}{idx}"
+
+    def __enter__(self):
+        self._old = getattr(_local, "manager", None)
+        _local.manager = self
+        return self
+
+    def __exit__(self, *exc):
+        _local.manager = self._old
+        return False
+
+
+class Prefix(NameManager):
+    """Prepend a prefix to every auto-generated name within the scope
+    (ref: name.py Prefix)."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        name = super().get(name, hint)
+        return self._prefix + name

@@ -1,0 +1,76 @@
+"""Generic class registry factories (ref: python/mxnet/registry.py —
+get_register_func/get_alias_func/get_create_func build register/
+create machinery in the reference's style. The built-in optimizer/
+initializer/metric registries predate this module and keep their own
+tables; this public surface is for user libraries building their own
+registries the same way)."""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+
+_REGISTRIES = {}
+
+
+def _registry(base_class, nickname):
+    return _REGISTRIES.setdefault((base_class, nickname), {})
+
+
+def get_register_func(base_class, nickname):
+    """Build a @register decorator for subclasses of `base_class`
+    (ref: registry.py get_register_func)."""
+    reg = _registry(base_class, nickname)
+
+    def register(klass, name=None):
+        assert issubclass(klass, base_class), \
+            f"Can only register subclass of {base_class.__name__}"
+        key = (name or klass.__name__).lower()
+        reg[key] = klass
+        return klass
+
+    register.__doc__ = f"Register {nickname} to the {nickname} factory"
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    """Build an @alias("name", ...) decorator
+    (ref: registry.py get_alias_func)."""
+    register = get_register_func(base_class, nickname)
+
+    def alias(*aliases):
+        def reg(klass):
+            for name in aliases:
+                register(klass, name)
+            return klass
+        return reg
+
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    """Build a create(name_or_instance_or_json, **kwargs) factory
+    (ref: registry.py get_create_func — accepts an instance, a
+    registered name, or the '[name, kwargs]' json form that
+    Initializer.dumps produces)."""
+    reg = _registry(base_class, nickname)
+
+    def create(*args, **kwargs):
+        if args and isinstance(args[0], base_class):
+            assert len(args) == 1 and not kwargs, \
+                f"{nickname} instance given: no further arguments allowed"
+            return args[0]
+        if not args:
+            raise MXNetError(f"{nickname} name required")
+        name, args = args[0], args[1:]
+        if isinstance(name, str) and name.startswith("["):
+            assert not args and not kwargs
+            name, kwargs = json.loads(name)
+        key = name.lower()
+        if key not in reg:
+            raise MXNetError(
+                f"Cannot find {nickname} {name}. Registered: "
+                f"{sorted(reg)}")
+        return reg[key](*args, **kwargs)
+
+    return create

@@ -1,0 +1,27 @@
+"""Runtime kernel compilation (ref: python/mxnet/rtc.py — CudaModule
+compiles CUDA C source at runtime).
+
+The TPU analogue of a runtime-compiled kernel is a Pallas kernel
+(`mxnet_tpu.ops.pallas_kernels`) or a jitted JAX function — both
+compile at call time through XLA, which is the entire execution model
+here rather than an escape hatch. CUDA source compilation is
+meaningless on this backend, so the reference API surface raises a
+clear error pointing at the native alternatives."""
+from __future__ import annotations
+
+from .base import MXNetError
+
+_MSG = ("CudaModule is not supported on the TPU backend: runtime "
+        "kernels are Pallas kernels or jitted JAX functions "
+        "(see mxnet_tpu.ops.pallas_kernels), which XLA compiles at "
+        "call time")
+
+
+class CudaModule:
+    def __init__(self, *args, **kwargs):
+        raise MXNetError(_MSG)
+
+
+class CudaKernel:
+    def __init__(self, *args, **kwargs):
+        raise MXNetError(_MSG)

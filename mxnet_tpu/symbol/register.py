@@ -34,7 +34,12 @@ def make_sym_func(op):
                     f"{type(a).__name__}; pass operator parameters as "
                     "keyword arguments")
             inputs.append(a)
-        name = name or _gen_name(op.name.lower().lstrip("_"))
+        # every name — explicit too — passes through the active
+        # NameManager so mx.name.Prefix prepends uniformly (ref:
+        # name.py NameManager.current.get(name, hint))
+        from ..name import NameManager
+        name = NameManager.current().get(name,
+                                         op.name.lower().lstrip("_"))
         for pname in op.arg_names[len(inputs):]:
             if pname in kwargs:
                 v = kwargs.pop(pname)

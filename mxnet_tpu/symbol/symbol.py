@@ -20,15 +20,12 @@ from __future__ import annotations
 import ast
 import json
 import re
-import threading
 
 import jax
 import numpy as np
 
 from ..base import MXNetError
 from ..ops import registry as _reg
-
-_name_counter = threading.local()
 
 # variable-name suffixes treated as auxiliary states (not learnable
 # arguments) — the reference gets this from each op's ListAuxiliaryStates
@@ -37,12 +34,11 @@ _AUX_SUFFIXES = ("moving_mean", "moving_var", "running_mean", "running_var")
 
 
 def _gen_name(hint):
-    counts = getattr(_name_counter, "counts", None)
-    if counts is None:
-        counts = _name_counter.counts = {}
-    idx = counts.get(hint, 0)
-    counts[hint] = idx + 1
-    return f"{hint}{idx}"
+    """Auto-name through the active NameManager so `with mx.name.Prefix
+    ("foo_"):` scopes apply (ref: name.py — symbol creation consults
+    NameManager.current)."""
+    from ..name import NameManager
+    return NameManager.current().get(None, hint)
 
 
 class _Node:

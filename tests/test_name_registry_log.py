@@ -1,0 +1,98 @@
+"""Top-level utility modules (ref: tests/python/unittest/test_attr.py
+name scopes, test_base.py registry/log)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.base import MXNetError
+
+
+def test_name_prefix_scope():
+    with mx.name.Prefix("scope_"):
+        a = sym.FullyConnected(sym.var("x"), num_hidden=2)
+        b = sym.Activation(a, act_type="relu")
+    c = sym.Activation(b, act_type="relu")
+    assert a.name.startswith("scope_fullyconnected")
+    assert b.name.startswith("scope_activation")
+    assert not c.name.startswith("scope_")
+
+
+def test_name_manager_counts_per_scope():
+    with mx.name.NameManager():
+        a = sym.var("v")
+        fc0 = sym.FullyConnected(a, num_hidden=1)
+    with mx.name.NameManager():
+        fc1 = sym.FullyConnected(a, num_hidden=1)
+    # fresh managers restart their counters
+    assert fc0.name == fc1.name
+
+
+def test_registry_factories():
+    from mxnet_tpu.registry import (get_alias_func, get_create_func,
+                                    get_register_func)
+
+    class Sched:
+        pass
+
+    register = get_register_func(Sched, "sched")
+    alias = get_alias_func(Sched, "sched")
+    create = get_create_func(Sched, "sched")
+
+    @alias("warm", "warmup")
+    @register
+    class WarmSched(Sched):
+        def __init__(self, k=2):
+            self.k = k
+
+    assert create("warmsched").k == 2
+    assert create("warm", k=5).k == 5
+    assert create("warmup").k == 2
+    assert create('["warm", {"k": 7}]').k == 7
+    inst = WarmSched(k=9)
+    assert create(inst) is inst
+    with pytest.raises(MXNetError):
+        create("nope")
+
+
+def test_split_input_slice():
+    from mxnet_tpu.executor_manager import _split_input_slice
+    sl = _split_input_slice(12, [1, 1, 1])
+    assert [s.stop - s.start for s in sl] == [4, 4, 4]
+    sl = _split_input_slice(10, [1, 3])
+    assert sl[0] == slice(0, 2) and sl[1] == slice(2, 10)
+
+
+def test_check_arguments_duplicates():
+    from mxnet_tpu.executor_manager import _check_arguments
+    x = sym.var("x")
+    net = sym.FullyConnected(x, name="fc", num_hidden=2)
+    _check_arguments(net)      # unique names fine
+    w = sym.var("shared")
+    dup = sym.elemwise_add(sym.FullyConnected(x, weight=w, name="a",
+                                              num_hidden=2, no_bias=True),
+                           sym.FullyConnected(x, weight=w, name="b",
+                                              num_hidden=2, no_bias=True))
+    _check_arguments(dup)      # sharing one var is NOT a duplicate name
+
+
+def test_rtc_raises_with_guidance():
+    with pytest.raises(MXNetError, match="Pallas"):
+        mx.rtc.CudaModule("__global__ void k(){}")
+
+
+def test_log_get_logger(tmp_path):
+    f = str(tmp_path / "log.txt")
+    lg = mx.log.get_logger("test_log_x", filename=f, level=mx.log.INFO)
+    lg.info("recorded")
+    lg2 = mx.log.get_logger("test_log_x")
+    assert lg2 is lg
+    for h in lg.handlers:
+        h.flush()
+    assert "recorded" in open(f).read()
+
+
+def test_kvstore_server_module_noop_for_worker(monkeypatch):
+    monkeypatch.setenv("DMLC_ROLE", "worker")
+    # must not block or raise for non-server roles
+    mx.kvstore_server._init_kvstore_server_module()
