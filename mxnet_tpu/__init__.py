@@ -72,6 +72,8 @@ from . import registry  # noqa: E402
 from . import log  # noqa: E402
 from . import rtc  # noqa: E402
 from . import executor_manager  # noqa: E402
+from . import util  # noqa: E402
+from . import misc  # noqa: E402
 from . import kvstore_server  # noqa: E402
 from . import libinfo  # noqa: E402
 from .attribute import AttrScope  # noqa: E402

@@ -12,8 +12,6 @@ INFO = logging.INFO
 DEBUG = logging.DEBUG
 NOTSET = logging.NOTSET
 
-PY3 = sys.version_info[0] >= 3
-
 
 class _Formatter(logging.Formatter):
     """Level-coded prefix formatter (ref: log.py _Formatter)."""

@@ -1,0 +1,47 @@
+"""Deprecated learning-rate schedulers kept for old scripts (ref:
+python/mxnet/misc.py — the pre-lr_scheduler API; new code uses
+mxnet_tpu.lr_scheduler)."""
+from __future__ import annotations
+
+import logging
+import math
+
+from .base import MXNetError
+
+
+class LearningRateScheduler:
+    """Base class (ref: misc.py LearningRateScheduler)."""
+
+    def __init__(self):
+        self.base_lr = 0.01
+
+    def __call__(self, iteration):
+        raise NotImplementedError("must override this")
+
+
+class FactorScheduler(LearningRateScheduler):
+    """lr = base_lr * factor^(iteration // step)
+    (ref: misc.py FactorScheduler)."""
+
+    def __init__(self, step, factor=0.1):
+        super().__init__()
+        if step < 1:
+            raise MXNetError("Schedule step must be >= 1")
+        if factor >= 1.0:
+            raise MXNetError("Factor must be < 1 to make lr reduce")
+        self.step = step
+        self.factor = factor
+        self.old_lr = self.base_lr
+        self.init = False
+
+    def __call__(self, iteration):
+        if not self.init:
+            self.init = True
+            self.old_lr = self.base_lr
+        lr = self.base_lr * math.pow(self.factor,
+                                     int(iteration / self.step))
+        if lr != self.old_lr:
+            self.old_lr = lr
+            logging.info("At Iteration [%d]: Switch to new learning "
+                         "rate %.5f", iteration, lr)
+        return lr

@@ -12,7 +12,7 @@ import inspect
 
 from ..base import MXNetError
 from ..ops import registry as _reg
-from .symbol import Symbol, _apply, _gen_name, _Node, var
+from .symbol import Symbol, _apply, var
 
 # optional tensor args never auto-created (only used when supplied)
 _NEVER_AUTO = {"state_cell", "sequence_length", "length"}

@@ -1,0 +1,10 @@
+"""Small utilities (ref: python/mxnet/util.py)."""
+from __future__ import annotations
+
+import os
+
+
+def makedirs(d):
+    """Create directory recursively; no error if it exists
+    (ref: util.py makedirs)."""
+    os.makedirs(os.path.expanduser(d), exist_ok=True)

@@ -1,0 +1,29 @@
+"""util/misc parity modules (ref: python/mxnet/util.py, misc.py)."""
+import os
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+def test_makedirs_idempotent(tmp_path):
+    d = str(tmp_path / "x" / "y")
+    mx.util.makedirs(d)
+    mx.util.makedirs(d)          # second call: no error
+    assert os.path.isdir(d)
+
+
+def test_legacy_factor_scheduler():
+    sch = mx.misc.FactorScheduler(step=10, factor=0.5)
+    sch.base_lr = 1.0
+    assert sch(0) == 1.0
+    assert sch(9) == 1.0
+    assert sch(10) == 0.5
+    assert abs(sch(25) - 0.25) < 1e-12
+    with pytest.raises(MXNetError):
+        mx.misc.FactorScheduler(step=0)
+    with pytest.raises(MXNetError):
+        mx.misc.FactorScheduler(step=5, factor=1.5)
+    with pytest.raises(NotImplementedError):
+        mx.misc.LearningRateScheduler()(3)
