@@ -140,10 +140,10 @@ class NDArrayIter(DataIter):
         self.shuffle = shuffle
         self.last_batch_handle = last_batch_handle
         self.idx = np.arange(self.num_data)
-        if last_batch_handle == "discard":
-            self.num_batches = self.num_data // batch_size
-        else:
-            self.num_batches = (self.num_data + batch_size - 1) // batch_size
+        # roll_over: the trailing partial batch is NOT emitted; its
+        # samples lead the next epoch (ref: io.py NDArrayIter
+        # roll_over semantics — distinct from pad's wraparound)
+        self._cache = np.array([], dtype=np.int64)
         self.reset()
 
     @property
@@ -160,21 +160,33 @@ class NDArrayIter(DataIter):
         self.cursor = -self.batch_size
         if self.shuffle:
             np.random.shuffle(self.idx)
+        if self.last_batch_handle == "roll_over" and len(self._cache):
+            self._order = np.concatenate([self._cache, self.idx])
+            self._cache = np.array([], dtype=np.int64)
+        else:
+            self._order = self.idx
 
     def iter_next(self):
         self.cursor += self.batch_size
         if self.last_batch_handle == "discard":
             return self.cursor + self.batch_size <= self.num_data
+        if self.last_batch_handle == "roll_over":
+            n = len(self._order)
+            if self.cursor + self.batch_size <= n:
+                return True
+            if self.cursor < n:
+                self._cache = self._order[self.cursor:].copy()
+            return False
         return self.cursor < self.num_data
 
     def _take(self, arrays):
         end = self.cursor + self.batch_size
-        if end <= self.num_data:
-            sel = self.idx[self.cursor:end]
+        if end <= len(self._order):
+            sel = self._order[self.cursor:end]
             return [array(v[sel]) for _, v in arrays]
         # pad by wrapping around (last_batch_handle="pad")
-        sel = np.concatenate([self.idx[self.cursor:],
-                              self.idx[:end - self.num_data]])
+        sel = np.concatenate([self._order[self.cursor:],
+                              self._order[:end - len(self._order)]])
         return [array(v[sel]) for _, v in arrays]
 
     def getdata(self):
@@ -190,8 +202,8 @@ class NDArrayIter(DataIter):
         return 0
 
     def getindex(self):
-        end = min(self.cursor + self.batch_size, self.num_data)
-        return self.idx[self.cursor:end]
+        end = min(self.cursor + self.batch_size, len(self._order))
+        return self._order[self.cursor:end]
 
 
 class ResizeIter(DataIter):
