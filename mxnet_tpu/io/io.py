@@ -161,8 +161,10 @@ class NDArrayIter(DataIter):
         if self.shuffle:
             np.random.shuffle(self.idx)
         if self.last_batch_handle == "roll_over" and len(self._cache):
+            # the cache is cleared only when a batch is actually taken,
+            # so consecutive resets (bind-time + epoch-start) cannot
+            # drop the carried samples (ref roll_over semantics)
             self._order = np.concatenate([self._cache, self.idx])
-            self._cache = np.array([], dtype=np.int64)
         else:
             self._order = self.idx
 
@@ -180,6 +182,7 @@ class NDArrayIter(DataIter):
         return self.cursor < self.num_data
 
     def _take(self, arrays):
+        self._cache = np.array([], dtype=np.int64)   # carried samples consumed
         end = self.cursor + self.batch_size
         if end <= len(self._order):
             sel = self._order[self.cursor:end]

@@ -115,6 +115,33 @@ def ones_like(data, **kwargs):
     return invoke("ones_like", [data], {})
 
 
+def maximum(lhs, rhs):
+    """Elementwise max with scalar/array dispatch (ref: ndarray.py
+    maximum — a Python helper over broadcast_maximum/_maximum_scalar;
+    two plain numbers return a plain number like the reference's
+    _ufunc_helper)."""
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        return invoke("_maximum", [lhs, rhs], {})
+    if isinstance(lhs, NDArray):
+        return invoke("_maximum_scalar", [lhs], {"scalar": float(rhs)})
+    if isinstance(rhs, NDArray):
+        return invoke("_maximum_scalar", [rhs], {"scalar": float(lhs)})
+    import builtins
+    return builtins.max(lhs, rhs)   # module-scope max is the reduce op
+
+
+def minimum(lhs, rhs):
+    """Elementwise min (ref: ndarray.py minimum)."""
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        return invoke("_minimum", [lhs, rhs], {})
+    if isinstance(lhs, NDArray):
+        return invoke("_minimum_scalar", [lhs], {"scalar": float(rhs)})
+    if isinstance(rhs, NDArray):
+        return invoke("_minimum_scalar", [rhs], {"scalar": float(lhs)})
+    import builtins
+    return builtins.min(lhs, rhs)
+
+
 def moveaxis(tensor, source, destination):
     axes = list(range(tensor.ndim))
     axes.remove(source % tensor.ndim)

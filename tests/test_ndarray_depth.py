@@ -1,0 +1,155 @@
+"""NDArray semantics depth (ref: tests/python/unittest/test_ndarray.py
+— the long tail: advanced indexing, setitem under/outside autograd,
+broadcasting edge shapes, order ops, serialization of dtypes)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.base import MXNetError
+
+rng = np.random.default_rng(7)
+
+
+def _arr(shape, dtype=np.float32):
+    return rng.normal(0, 1, shape).astype(dtype)
+
+
+def test_advanced_indexing_reads():
+    a_np = _arr((5, 6))
+    a = nd.array(a_np)
+    np.testing.assert_allclose(a[2].asnumpy(), a_np[2])
+    np.testing.assert_allclose(a[1:4].asnumpy(), a_np[1:4])
+    np.testing.assert_allclose(a[:, 2:5].asnumpy(), a_np[:, 2:5])
+    np.testing.assert_allclose(a[-1].asnumpy(), a_np[-1])
+    np.testing.assert_allclose(a[::2, ::3].asnumpy(), a_np[::2, ::3])
+    np.testing.assert_allclose(a[::-1].asnumpy(), a_np[::-1])
+    # integer-array indexing
+    idx = nd.array(np.array([0.0, 2.0, 4.0], np.float32))
+    np.testing.assert_allclose(a.take(idx).asnumpy(), a_np[[0, 2, 4]])
+
+
+def test_setitem_variants():
+    a = nd.array(np.zeros((4, 4), np.float32))
+    a[1] = 5.0
+    assert (a.asnumpy()[1] == 5.0).all()
+    a[2:4, 0:2] = 7.0
+    assert (a.asnumpy()[2:4, 0:2] == 7.0).all()
+    a[0] = nd.array(np.arange(4, dtype=np.float32))
+    np.testing.assert_allclose(a.asnumpy()[0], np.arange(4))
+
+
+def test_setitem_recorded_is_differentiable():
+    """__setitem__ under the tape must not silently break gradients —
+    the _slice_assign path (VERDICT r3 item: recorded setitem)."""
+    x = nd.array(np.ones((4,), np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3.0
+        y[1:3] = 1.0
+        loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [3.0, 0.0, 0.0, 3.0])
+
+
+def test_broadcast_edges():
+    a = nd.array(_arr((3, 1, 5)))
+    b = nd.array(_arr((1, 4, 1)))
+    out = (a + b).asnumpy()
+    np.testing.assert_allclose(out, a.asnumpy() + b.asnumpy(),
+                               rtol=1e-6)
+    # zero-size dims
+    z = nd.zeros((0, 3))
+    assert (z + 1).shape == (0, 3)
+    assert nd.concat(z, z, dim=0).shape == (0, 3)
+
+
+def test_order_ops_against_numpy():
+    a_np = _arr((4, 7))
+    a = nd.array(a_np)
+    np.testing.assert_allclose(a.sort(axis=1).asnumpy(),
+                               np.sort(a_np, axis=1))
+    np.testing.assert_allclose(a.argsort(axis=1).asnumpy(),
+                               np.argsort(a_np, axis=1, kind="stable"))
+    top = a.topk(k=3, axis=1)
+    expect = np.argsort(-a_np, axis=1, kind="stable")[:, :3]
+    np.testing.assert_allclose(top.asnumpy(), expect)
+
+
+def test_scalar_ops_and_rops():
+    a_np = _arr((3, 3)) + 3.0
+    a = nd.array(a_np)
+    np.testing.assert_allclose((2.0 - a).asnumpy(), 2.0 - a_np,
+                               rtol=1e-6)
+    np.testing.assert_allclose((2.0 / a).asnumpy(), 2.0 / a_np,
+                               rtol=1e-5)
+    np.testing.assert_allclose((a ** 2).asnumpy(), a_np ** 2, rtol=1e-5)
+    np.testing.assert_allclose((2.0 ** nd.array(
+        np.ones((2,), np.float32))).asnumpy(), [2.0, 2.0])
+    np.testing.assert_allclose((-a).asnumpy(), -a_np)
+    np.testing.assert_allclose(abs(nd.array(
+        np.array([-1.0, 2.0], np.float32))).asnumpy(), [1.0, 2.0])
+
+
+def test_dtype_serialization_roundtrip(tmp_path):
+    path = str(tmp_path / "mixed.params")
+    arrays = {
+        "f32": nd.array(_arr((3, 3))),
+        "f16": nd.array(_arr((2, 2))).astype("float16"),
+        "i32": nd.array(np.arange(4, dtype=np.float32)).astype("int32"),
+        "u8": nd.array(np.arange(4, dtype=np.float32)).astype("uint8"),
+    }
+    nd.save(path, arrays)
+    loaded = nd.load(path)
+    for k, v in arrays.items():
+        assert loaded[k].dtype == v.dtype, k
+        np.testing.assert_allclose(
+            loaded[k].astype("float32").asnumpy(),
+            v.astype("float32").asnumpy())
+
+
+def test_expand_squeeze_flip_tile_repeat():
+    a_np = _arr((2, 3))
+    a = nd.array(a_np)
+    assert a.expand_dims(axis=1).shape == (2, 1, 3)
+    assert a.expand_dims(axis=-1).squeeze(axis=-1).shape == (2, 3)
+    np.testing.assert_allclose(a.flip(axis=1).asnumpy(),
+                               a_np[:, ::-1])
+    np.testing.assert_allclose(a.tile(reps=(2, 2)).asnumpy(),
+                               np.tile(a_np, (2, 2)))
+    np.testing.assert_allclose(a.repeat(repeats=2, axis=0).asnumpy(),
+                               np.repeat(a_np, 2, axis=0))
+
+
+def test_where_and_maximum_family():
+    a_np, b_np = _arr((3, 4)), _arr((3, 4))
+    a, b = nd.array(a_np), nd.array(b_np)
+    np.testing.assert_allclose(nd.maximum(a, b).asnumpy(),
+                               np.maximum(a_np, b_np))
+    np.testing.assert_allclose(nd.minimum(a, 0.0).asnumpy(),
+                               np.minimum(a_np, 0.0))
+    cond = nd.array((a_np > 0).astype(np.float32))
+    np.testing.assert_allclose(nd.where(cond, a, b).asnumpy(),
+                               np.where(a_np > 0, a_np, b_np))
+
+
+def test_norm_and_reductions_keepdims():
+    a_np = _arr((3, 4, 5))
+    a = nd.array(a_np)
+    np.testing.assert_allclose(
+        a.norm().asnumpy(), np.linalg.norm(a_np.ravel()), rtol=1e-5)
+    np.testing.assert_allclose(
+        a.sum(axis=(0, 2), keepdims=True).asnumpy(),
+        a_np.sum(axis=(0, 2), keepdims=True), rtol=1e-5)
+    np.testing.assert_allclose(
+        a.mean(axis=1, exclude=True).asnumpy(),
+        a_np.mean(axis=(0, 2)), rtol=1e-5)
+
+
+def test_full_and_arange_like_creation():
+    f = nd.full((2, 3), 7.5)
+    assert (f.asnumpy() == 7.5).all()
+    ar = nd.arange(2, 14, 3)
+    np.testing.assert_allclose(ar.asnumpy(), np.arange(2, 14, 3))
+    e = nd.ones_like(f)
+    assert (e.asnumpy() == 1).all()

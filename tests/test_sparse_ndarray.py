@@ -1,0 +1,100 @@
+"""Sparse NDArray semantics (ref: tests/python/unittest/
+test_sparse_ndarray.py, test_sparse_operator.py — creation,
+conversion, retain, sparse dot, elemwise)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse
+
+rng = np.random.default_rng(3)
+
+
+def _dense_rsp(shape, density=0.4):
+    d = rng.normal(0, 1, shape).astype(np.float32)
+    mask = rng.random(shape[0]) < density
+    d[~mask] = 0
+    return d
+
+
+def test_row_sparse_creation_and_roundtrip():
+    dense = _dense_rsp((6, 4))
+    rsp = sparse.row_sparse_array(dense)
+    assert rsp.stype == "row_sparse"
+    np.testing.assert_allclose(rsp.asnumpy(), dense)
+    back = rsp.tostype("default")
+    np.testing.assert_allclose(back.asnumpy(), dense)
+    # indices cover exactly the nonzero rows
+    nz = np.where((dense != 0).any(axis=1))[0]
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(rsp.indices.asnumpy(), dtype=np.int64)), nz)
+
+
+def test_csr_creation_and_attrs():
+    dense = np.array([[0, 1.5, 0], [2.0, 0, 0], [0, 0, 0],
+                      [0, 3.0, 4.0]], np.float32)
+    csr = sparse.csr_matrix(dense)
+    assert csr.stype == "csr"
+    np.testing.assert_allclose(csr.asnumpy(), dense)
+    indptr = np.asarray(csr.indptr.asnumpy(), np.int64)
+    assert indptr[0] == 0 and indptr[-1] == 4
+    np.testing.assert_allclose(np.asarray(csr.data.asnumpy()),
+                               [1.5, 2.0, 3.0, 4.0])
+
+
+def test_cast_storage_paths():
+    dense = _dense_rsp((5, 3))
+    d = nd.array(dense)
+    rsp = sparse.cast_storage(d, "row_sparse")
+    csr = sparse.cast_storage(d, "csr")
+    np.testing.assert_allclose(rsp.asnumpy(), dense)
+    np.testing.assert_allclose(csr.asnumpy(), dense)
+    np.testing.assert_allclose(
+        sparse.cast_storage(rsp, "default").asnumpy(), dense)
+
+
+def test_sparse_zeros():
+    z = sparse.zeros("row_sparse", (4, 3))
+    assert z.stype == "row_sparse"
+    assert (z.asnumpy() == 0).all()
+    z2 = sparse.zeros("csr", (4, 3))
+    assert z2.stype == "csr"
+
+
+def test_sparse_retain():
+    dense = _dense_rsp((8, 3), density=1.0)
+    rsp = sparse.row_sparse_array(dense)
+    keep = nd.array(np.array([1.0, 4.0, 6.0], np.float32))
+    out = sparse.sparse_retain(rsp, keep)
+    expect = np.zeros_like(dense)
+    for i in (1, 4, 6):
+        expect[i] = dense[i]
+    np.testing.assert_allclose(out.asnumpy(), expect)
+
+
+def test_csr_dot_dense():
+    dense = np.array([[0, 1.0, 0], [2.0, 0, 3.0]], np.float32)
+    w = rng.normal(0, 1, (3, 4)).astype(np.float32)
+    csr = sparse.csr_matrix(dense)
+    out = sparse.dot(csr, nd.array(w))
+    np.testing.assert_allclose(out.asnumpy(), dense @ w, rtol=1e-5)
+    # transpose_a: (3, 2) @ (2, 4)
+    out_t = sparse.dot(csr, nd.array(
+        rng.normal(0, 1, (2, 4)).astype(np.float32)), transpose_a=True)
+    assert out_t.shape == (3, 4)
+
+
+def test_rsp_elemwise_add():
+    a = _dense_rsp((5, 2))
+    b = _dense_rsp((5, 2))
+    out = sparse.elemwise_add(sparse.row_sparse_array(a),
+                              sparse.row_sparse_array(b))
+    np.testing.assert_allclose(out.asnumpy(), a + b, rtol=1e-6)
+
+
+def test_square_sum_rowwise():
+    dense = _dense_rsp((6, 3))
+    got = nd._square_sum(nd.array(dense), axis=1)
+    np.testing.assert_allclose(got.asnumpy(), (dense ** 2).sum(axis=1),
+                               rtol=1e-5)
