@@ -115,31 +115,26 @@ def ones_like(data, **kwargs):
     return invoke("ones_like", [data], {})
 
 
-def maximum(lhs, rhs):
-    """Elementwise max with scalar/array dispatch (ref: ndarray.py
-    maximum — a Python helper over broadcast_maximum/_maximum_scalar;
-    two plain numbers return a plain number like the reference's
-    _ufunc_helper)."""
-    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
-        return invoke("_maximum", [lhs, rhs], {})
-    if isinstance(lhs, NDArray):
-        return invoke("_maximum_scalar", [lhs], {"scalar": float(rhs)})
-    if isinstance(rhs, NDArray):
-        return invoke("_maximum_scalar", [rhs], {"scalar": float(lhs)})
-    import builtins
-    return builtins.max(lhs, rhs)   # module-scope max is the reduce op
+def _ufunc_helper(op, scalar_op, builtin_fn):
+    """array/array, array/scalar (both orders), number/number dispatch
+    (ref: ndarray.py _ufunc_helper; commutative ops only)."""
+    def f(lhs, rhs):
+        if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+            return invoke(op, [lhs, rhs], {})
+        if isinstance(lhs, NDArray):
+            return invoke(scalar_op, [lhs], {"scalar": float(rhs)})
+        if isinstance(rhs, NDArray):
+            return invoke(scalar_op, [rhs], {"scalar": float(lhs)})
+        return builtin_fn(lhs, rhs)
+    return f
 
 
-def minimum(lhs, rhs):
-    """Elementwise min (ref: ndarray.py minimum)."""
-    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
-        return invoke("_minimum", [lhs, rhs], {})
-    if isinstance(lhs, NDArray):
-        return invoke("_minimum_scalar", [lhs], {"scalar": float(rhs)})
-    if isinstance(rhs, NDArray):
-        return invoke("_minimum_scalar", [rhs], {"scalar": float(lhs)})
-    import builtins
-    return builtins.min(lhs, rhs)
+import builtins as _builtins  # module-scope max/min are the reduce ops
+
+#: Elementwise max (ref: ndarray.py maximum)
+maximum = _ufunc_helper("_maximum", "_maximum_scalar", _builtins.max)
+#: Elementwise min (ref: ndarray.py minimum)
+minimum = _ufunc_helper("_minimum", "_minimum_scalar", _builtins.min)
 
 
 def moveaxis(tensor, source, destination):

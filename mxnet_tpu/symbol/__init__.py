@@ -11,6 +11,32 @@ _register.populate(globals())
 _register.populate(op.__dict__)
 
 
+def maximum(lhs, rhs):
+    """Elementwise max for symbols (ref: symbol.py maximum)."""
+    from .symbol import _apply
+    if isinstance(lhs, Symbol) and isinstance(rhs, Symbol):
+        return _apply("_maximum", [lhs, rhs], {})
+    if isinstance(lhs, Symbol):
+        return _apply("_maximum_scalar", [lhs], {"scalar": float(rhs)})
+    if isinstance(rhs, Symbol):
+        return _apply("_maximum_scalar", [rhs], {"scalar": float(lhs)})
+    import builtins
+    return builtins.max(lhs, rhs)
+
+
+def minimum(lhs, rhs):
+    """Elementwise min for symbols (ref: symbol.py minimum)."""
+    from .symbol import _apply
+    if isinstance(lhs, Symbol) and isinstance(rhs, Symbol):
+        return _apply("_minimum", [lhs, rhs], {})
+    if isinstance(lhs, Symbol):
+        return _apply("_minimum_scalar", [lhs], {"scalar": float(rhs)})
+    if isinstance(rhs, Symbol):
+        return _apply("_minimum_scalar", [rhs], {"scalar": float(lhs)})
+    import builtins
+    return builtins.min(lhs, rhs)
+
+
 def zeros(shape, dtype="float32", **kwargs):
     from .symbol import _apply
     return _apply("_zeros", [], {"shape": tuple(shape), "dtype": dtype})

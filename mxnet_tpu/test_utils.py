@@ -52,24 +52,33 @@ def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-4):
     out.backward()
     analytic = [x.grad.asnumpy() for x in nds]
 
-    for i, x in enumerate(nds):
-        base = x.asnumpy().astype(np.float64)
-        num = np.zeros_like(base)
-        it = np.nditer(base, flags=["multi_index"])
-        while not it.finished:
-            idx = it.multi_index
-            for sgn in (+1, -1):
-                pert = base.copy()
-                pert[idx] += sgn * eps
-                vals = [array(pert.astype(np.float32)) if j == i else nds[j]
-                        for j in range(len(nds))]
-                v = fn(*vals)
-                v = v if v.size == 1 else v.sum()
-                num[idx] += sgn * float(v.asscalar())
-            num[idx] /= 2 * eps
-            it.iternext()
-        np.testing.assert_allclose(analytic[i], num, rtol=rtol, atol=atol,
-                                   err_msg=f"gradient mismatch on input {i}")
+    # finite-difference evals must run under the SAME mode the analytic
+    # gradient was recorded in (is_train=True — the reference passes
+    # is_train to both): batch-stat BatchNorm would otherwise switch to
+    # moving stats between the two measurements
+    prev_mode = autograd.set_training(True)
+    try:
+        for i, x in enumerate(nds):
+            base = x.asnumpy().astype(np.float64)
+            num = np.zeros_like(base)
+            it = np.nditer(base, flags=["multi_index"])
+            while not it.finished:
+                idx = it.multi_index
+                for sgn in (+1, -1):
+                    pert = base.copy()
+                    pert[idx] += sgn * eps
+                    vals = [array(pert.astype(np.float32)) if j == i
+                            else nds[j] for j in range(len(nds))]
+                    v = fn(*vals)
+                    v = v if v.size == 1 else v.sum()
+                    num[idx] += sgn * float(v.asscalar())
+                num[idx] /= 2 * eps
+                it.iternext()
+            np.testing.assert_allclose(
+                analytic[i], num, rtol=rtol, atol=atol,
+                err_msg=f"gradient mismatch on input {i}")
+    finally:
+        autograd.set_training(prev_mode)
 
 
 def check_consistency(fn, inputs, ctx_list=None, rtol=1e-4, atol=1e-6):

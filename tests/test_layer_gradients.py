@@ -1,0 +1,102 @@
+"""Finite-difference gradient checks through COMPOSITE gluon layers
+(ref: test_operator.py's check_numeric_gradient usage — here at layer
+granularity, where fusion/layout/traced-graph effects could corrupt
+what per-op checks miss)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import nn, rnn
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+
+def _check_layer(net, xshape, seed=0, hybrid=False, rtol=2e-2):
+    net.initialize(mx.init.Xavier())
+    if hybrid:
+        net.hybridize()
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0, 1, xshape).astype(np.float32)
+
+    def fn(inp):
+        return (net(inp) ** 2).mean()
+
+    check_numeric_gradient(fn, [x], rtol=rtol, atol=2e-3)
+
+
+@pytest.mark.parametrize("hybrid", [False, True])
+def test_conv_bn_relu_block_grad(hybrid):
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, 1, 1, in_channels=2),
+            nn.BatchNorm(in_channels=4),
+            nn.Activation("relu"))
+    _check_layer(net, (2, 2, 6, 6), hybrid=hybrid)
+
+
+def test_nhwc_conv_block_grad():
+    with nn.layout_scope("NHWC"):
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(4, 3, 1, 1, in_channels=2),
+                nn.BatchNorm(in_channels=4))
+    rng = np.random.default_rng(1)
+    net.initialize(mx.init.Xavier())
+    x = rng.normal(0, 1, (2, 6, 6, 2)).astype(np.float32)
+    check_numeric_gradient(lambda i: (net(i) ** 2).mean(), [x],
+                           rtol=2e-2, atol=2e-3)
+
+
+def test_s2d_stem_block_grad():
+    from mxnet_tpu.gluon.model_zoo.vision.resnet import S2DStemConv
+    net = S2DStemConv(4, in_channels=3)
+    net.initialize(mx.init.Normal(0.1))
+    rng = np.random.default_rng(2)
+    x = rng.normal(0, 1, (1, 3, 8, 8)).astype(np.float32)
+    check_numeric_gradient(lambda i: (net(i) ** 2).mean(), [x],
+                           rtol=2e-2, atol=2e-3)
+
+
+def test_dense_layernorm_grad():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=6), nn.LayerNorm(in_channels=8),
+            nn.Dense(3, in_units=8))
+    _check_layer(net, (4, 6))
+
+
+def test_deconv_grad():
+    net = nn.Conv2DTranspose(3, 4, 2, 1, in_channels=2)
+    _check_layer(net, (2, 2, 5, 5), seed=3)
+
+
+def test_pooling_grads():
+    for pool in (nn.MaxPool2D(2), nn.AvgPool2D(3, 1, 1),
+                 nn.GlobalAvgPool2D()):
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(3, 3, 1, 1, in_channels=2), pool)
+        _check_layer(net, (2, 2, 6, 6), seed=4)
+
+
+def test_lstm_layer_grad():
+    net = rnn.LSTM(5, layout="NTC", input_size=4)
+    net.initialize(mx.init.Xavier())
+    rng = np.random.default_rng(5)
+    x = rng.normal(0, 1, (2, 6, 4)).astype(np.float32)
+    check_numeric_gradient(lambda i: (net(i) ** 2).mean(), [x],
+                           rtol=3e-2, atol=2e-3)
+
+
+def test_embedding_grad_wrt_weight():
+    emb = nn.Embedding(7, 4)
+    emb.initialize(mx.init.Normal(0.5))
+    idx = nd.array(np.array([1.0, 3.0, 1.0], np.float32))
+    w = emb.collect_params()[list(emb.collect_params())[0]]
+    with autograd.record():
+        loss = (emb(idx) ** 2).sum()
+    loss.backward()
+    g = w.grad().asnumpy()
+    wv = w.data().asnumpy()
+    expect = np.zeros_like(wv)
+    for i in (1, 3, 1):
+        expect[i] += 2 * wv[i]
+    np.testing.assert_allclose(g, expect, rtol=1e-4)
+    # untouched rows get exactly zero gradient
+    assert (g[0] == 0).all() and (g[6] == 0).all()

@@ -2,11 +2,8 @@
 — the long tail: advanced indexing, setitem under/outside autograd,
 broadcasting edge shapes, order ops, serialization of dtypes)."""
 import numpy as np
-import pytest
 
-import mxnet_tpu as mx
 from mxnet_tpu import autograd, nd
-from mxnet_tpu.base import MXNetError
 
 rng = np.random.default_rng(7)
 

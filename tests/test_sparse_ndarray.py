@@ -2,9 +2,7 @@
 test_sparse_ndarray.py, test_sparse_operator.py — creation,
 conversion, retain, sparse dot, elemwise)."""
 import numpy as np
-import pytest
 
-import mxnet_tpu as mx
 from mxnet_tpu import nd
 from mxnet_tpu.ndarray import sparse
 
