@@ -29,7 +29,8 @@ from .tensor_parallel import (column_parallel_dense, row_parallel_dense,
                               tp_mlp)
 from .pipeline import pipeline_apply
 from .moe import moe_dispatch
-from .train_step import make_sharded_train_step, sgd_update
+from .train_step import (make_sharded_train_step,
+                         make_zero_train_step, sgd_update)
 
 __all__ = [
     "create_mesh", "auto_mesh_shape", "mesh_sharding", "shard_batch",
@@ -38,5 +39,5 @@ __all__ = [
     "ring_attention", "ulysses_attention",
     "column_parallel_dense", "row_parallel_dense", "tp_mlp",
     "pipeline_apply", "moe_dispatch",
-    "make_sharded_train_step", "sgd_update",
+    "make_sharded_train_step", "make_zero_train_step", "sgd_update",
 ]

@@ -11,30 +11,26 @@ _register.populate(globals())
 _register.populate(op.__dict__)
 
 
-def maximum(lhs, rhs):
-    """Elementwise max for symbols (ref: symbol.py maximum)."""
-    from .symbol import _apply
-    if isinstance(lhs, Symbol) and isinstance(rhs, Symbol):
-        return _apply("_maximum", [lhs, rhs], {})
-    if isinstance(lhs, Symbol):
-        return _apply("_maximum_scalar", [lhs], {"scalar": float(rhs)})
-    if isinstance(rhs, Symbol):
-        return _apply("_maximum_scalar", [rhs], {"scalar": float(lhs)})
-    import builtins
-    return builtins.max(lhs, rhs)
+def _sym_ufunc(op, scalar_op, builtin_fn):
+    """Symbol twin of ndarray._ufunc_helper (commutative ops)."""
+    def f(lhs, rhs):
+        from .symbol import _apply
+        if isinstance(lhs, Symbol) and isinstance(rhs, Symbol):
+            return _apply(op, [lhs, rhs], {})
+        if isinstance(lhs, Symbol):
+            return _apply(scalar_op, [lhs], {"scalar": float(rhs)})
+        if isinstance(rhs, Symbol):
+            return _apply(scalar_op, [rhs], {"scalar": float(lhs)})
+        return builtin_fn(lhs, rhs)
+    return f
 
 
-def minimum(lhs, rhs):
-    """Elementwise min for symbols (ref: symbol.py minimum)."""
-    from .symbol import _apply
-    if isinstance(lhs, Symbol) and isinstance(rhs, Symbol):
-        return _apply("_minimum", [lhs, rhs], {})
-    if isinstance(lhs, Symbol):
-        return _apply("_minimum_scalar", [lhs], {"scalar": float(rhs)})
-    if isinstance(rhs, Symbol):
-        return _apply("_minimum_scalar", [rhs], {"scalar": float(lhs)})
-    import builtins
-    return builtins.min(lhs, rhs)
+import builtins as _builtins
+
+#: Elementwise max for symbols (ref: symbol.py maximum)
+maximum = _sym_ufunc("_maximum", "_maximum_scalar", _builtins.max)
+#: Elementwise min for symbols (ref: symbol.py minimum)
+minimum = _sym_ufunc("_minimum", "_minimum_scalar", _builtins.min)
 
 
 def zeros(shape, dtype="float32", **kwargs):
