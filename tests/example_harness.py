@@ -9,7 +9,7 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_example(rel, args, timeout=420):
+def run_example(rel, args, timeout=600):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO
     cmd = [sys.executable, os.path.join(REPO, rel)] + args

@@ -12,7 +12,7 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run(cmd, cwd=None, timeout=420):
+def _run(cmd, cwd=None, timeout=600):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO
     r = subprocess.run(cmd, cwd=cwd or REPO, env=env, timeout=timeout,

@@ -42,7 +42,7 @@ def test_reinforce_gridworld():
 
 def test_speech_ctc():
     out = _run("examples/speech_recognition/lstm_ctc_speech.py",
-               ["--steps", "250"], timeout=560)
+               ["--steps", "250"], timeout=600)
     acc = _get(out, r"sequence accuracy ([0-9.]+)")
     assert acc > 0.7, out[-500:]
 
