@@ -68,7 +68,36 @@ def _diag(msg):
           file=sys.stderr, flush=True)
 
 
+def _child_record(line):
+    """Child-side last-good banking, applied the moment a measurement
+    line exists — the r4 postmortem's root cause was a live 03:17 window
+    whose numbers never reached BENCH_LAST_GOOD.json because only the
+    supervisor saved and only on clean exit. Tiering matches the
+    supervisor: a full-size on-chip COMPLETE line always saves; a
+    partial (headline-only) line saves only over nothing/another
+    partial. CPU smoke runs never save."""
+    onchip = ('"backend": "tpu"' in line or '"backend": "axon"' in line)
+    if not onchip or ("bs%d" % BATCH) not in line or '"error"' in line:
+        return
+    if '"partial"' not in line:
+        _save_last_good(line)
+    else:
+        saved = _load_last_good()
+        if saved is None or '"partial"' in saved.get("line", ""):
+            _save_last_good(line)
+
+
 _OUT_LOCK = threading.Lock()
+# bumped by every _hb(); the keepalive thread goes silent when this stops
+# advancing so the supervisor's silence clock can still kill a genuine
+# hang (advisor r4: an unconditional keepalive disabled stall detection
+# for the whole measurement phase)
+_PROGRESS = [0, 0.0]  # counter, monotonic time of last bump
+
+
+def _bump_progress():
+    _PROGRESS[0] += 1
+    _PROGRESS[1] = time.monotonic()
 
 
 def _emit(line):
@@ -87,6 +116,7 @@ def _hb(stage):
     these lines are what lets a slow-but-alive child (cold XLA compile,
     sluggish tunnel) survive while a wedged backend init still dies
     fast. `_json_line` ignores anything not starting with '{'."""
+    _bump_progress()
     _emit("#hb %s %s" % (time.strftime("%H:%M:%S"), stage))
     _diag(stage)
 
@@ -122,26 +152,78 @@ def _fail_json(err):
     }), flush=True)
 
 
+def _json_line(raw):
+    """Last metric-bearing JSON line of a child's stdout; the warmup
+    matmul proof line (no "metric" key) must never masquerade as the
+    headline."""
+    if not raw:
+        return None
+    out = raw.decode(errors="replace") if isinstance(raw, bytes) else raw
+    lines = [ln for ln in out.splitlines() if ln.startswith("{")]
+    with_metric = [ln for ln in lines if '"metric"' in ln]
+    return (with_metric or lines or [None])[-1]
+
+
+def _bench_env():
+    """Environment for probe/child subprocesses. On an explicit CPU run
+    (JAX_PLATFORMS=cpu — the CI smoke path) the axon sitecustomize must
+    be scrubbed from PYTHONPATH: its plugin registration dials the TPU
+    tunnel AT INTERPRETER STARTUP, before any Python of ours runs, so on
+    a host with a wedged tunnel even a pure-CPU child hangs silently —
+    this (not jax.devices()) is where rounds 3/4's children sat for
+    their whole 300s silence window."""
+    env = dict(os.environ)
+    if env.get("JAX_PLATFORMS") == "cpu":
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and "axon_site" not in p)
+    return env
+
+
+def _probe_backend(deadline=None):
+    """Cheap tunnel-health probe: a throwaway subprocess that only calls
+    jax.devices(), killed after `deadline` seconds of life. A wedged
+    tunnel grant blocks backend init inside grpc for *hours* (rounds 3+4
+    burned 4 x 300s attempts each learning this); probing first means a
+    wedge costs one probe, not a full attempt budget."""
+    if deadline is None:
+        deadline = int(os.environ.get("MXTPU_BENCH_PROBE_DEADLINE", "75"))
+    code = ("import jax; d = jax.devices(); "
+            "print('PROBE_OK', len(d), d[0].platform)")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], timeout=deadline,
+            env=_bench_env(),
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+    except subprocess.TimeoutExpired:
+        return False
+    out = (proc.stdout or b"").decode(errors="replace")
+    return proc.returncode == 0 and "PROBE_OK" in out
+
+
 def supervise():
-    """Run the real bench in a child process with retry + timeout.
+    """Run the real bench in a child process with probe + retry + timeout.
 
     Round 1 failed with 'Unable to initialize backend axon: UNAVAILABLE'
-    and produced no output at all (VERDICT.md Weak #1). A fresh process
-    per attempt sidesteps jax's cached backend-init failure, a per-attempt
-    timeout fails fast instead of hanging until the driver's kill, and a
-    retry after a delay rides out a slow-to-come-up TPU tunnel.
+    and produced no output at all (VERDICT.md Weak #1); rounds 3 and 4
+    showed the dominant failure is a tunnel wedged for longer than any
+    sane per-attempt retry budget (VERDICT r4 Weak #1). Shape of the fix:
+    (a) a 75s pre-probe subprocess gates every expensive attempt, so a
+    wedged tunnel costs one probe per backoff step, not 300s; (b) probes
+    retry with exponential backoff across a long budget window
+    (MXTPU_BENCH_BUDGET, default 45 min) instead of 4 fixed slots in
+    21 min; (c) if the first probe already shows the wedge signature, the
+    last-good measurement is emitted immediately as a provisional stale
+    line — the driver parses the LAST JSON line (BENCH_r03 tail), so a
+    later live measurement overrides it, while a driver-side kill during
+    the long wait still leaves a number on stdout.
     """
-    env = dict(os.environ)
+    env = _bench_env()
     env[_CHILD_SENTINEL] = "1"
-    attempts, delay = 4, 30
+    budget = float(os.environ.get("MXTPU_BENCH_BUDGET", "2700"))
+    max_full_attempts = 4
     last_err = "unknown"
-
-    def _json_line(raw):
-        if not raw:
-            return None
-        out = raw.decode(errors="replace") if isinstance(raw, bytes) else raw
-        return next((ln for ln in reversed(out.splitlines())
-                     if ln.startswith("{")), None)
+    t_start = time.monotonic()
 
     def _run_child():
         """Run one attempt; kill it after 300s of stdout SILENCE — a
@@ -207,9 +289,61 @@ def supervise():
                 return b"".join(chunks), -1, why
             time.sleep(2)
 
-    all_wedged = True  # every attempt killed for total silence?
-    for i in range(attempts):
-        _diag("attempt %d/%d starting" % (i + 1, attempts))
+    def _emit_stale(prior, reason, provisional=False):
+        """Re-emit the saved measurement marked stale. Load-time gates: a
+        saved run from a different config (e.g. a small-batch dev run —
+        its save-side gate compared against its OWN batch) must never
+        stand in for this round's full-size metric."""
+        try:
+            stale = json.loads(prior["line"])
+            if not isinstance(stale, dict):
+                raise ValueError("saved line is not a JSON object")
+            if stale.get("metric") != METRIC:
+                raise ValueError("saved metric %r != current %r"
+                                 % (stale.get("metric"), METRIC))
+            stale["stale"] = True
+            stale["stale_reason"] = str(reason)[:200]
+            stale["measured_at"] = prior.get("measured_at")
+            if provisional:
+                stale["provisional"] = True
+            print(json.dumps(stale), flush=True)
+            return True
+        except ValueError:
+            return False
+
+    prior = _load_last_good()
+    full_attempts = 0
+    backoff = 60
+    probe_failures = 0
+    emitted_provisional = False
+    code_failure = False  # a child ran and produced a bad/error result
+    while full_attempts < max_full_attempts:
+        if time.monotonic() - t_start > budget:
+            _diag("budget %ds exhausted" % budget)
+            break
+        if not _probe_backend():
+            probe_failures += 1
+            last_err = ("tunnel probe %d failed (wedged backend init?)"
+                        % probe_failures)
+            _diag(last_err)
+            if prior is not None and not emitted_provisional:
+                # wedge signature on first contact: put the last good
+                # number on stdout NOW so even a driver-side kill during
+                # the long backoff wait leaves a measurement behind; a
+                # live line printed later supersedes it (last JSON wins)
+                if _emit_stale(prior, "provisional: " + last_err,
+                               provisional=True):
+                    _diag("emitted provisional stale line")
+                    emitted_provisional = True
+            remain = budget - (time.monotonic() - t_start)
+            if remain <= 1:
+                break
+            time.sleep(min(backoff, remain))
+            backoff = min(backoff * 2, 600)
+            continue
+        full_attempts += 1
+        _diag("probe ok; attempt %d/%d starting"
+              % (full_attempts, max_full_attempts))
         out, rc, why = _run_child()
         if why is not None:
             # the child prints the headline metric as a partial JSON line
@@ -244,8 +378,8 @@ def supervise():
                     # full-size on-chip measurement from THIS machine;
                     # second tier: it may refresh an older partial but
                     # never overwrites a full measurement
-                    prior = _load_last_good()
-                    if prior is None or '"partial"' in prior.get(
+                    saved = _load_last_good()
+                    if saved is None or '"partial"' in saved.get(
                             "line", ""):
                         _save_last_good(line)
             return 0
@@ -254,36 +388,23 @@ def supervise():
                         % (rc, (out or b"")[-300:]))
             _diag(last_err)
         if why is None or "no output" not in why:
-            all_wedged = False
-        if i + 1 < attempts:
-            time.sleep(delay)
-    prior = _load_last_good() if all_wedged else None
-    if prior is not None:
-        # every attempt died producing NO output at all — the wedged-
-        # tunnel signature, an environment failure, not a code failure
-        # (a broken child prints a traceback or an error JSON). Emit the
-        # last good measurement explicitly marked stale, but still exit
+            # the child got far enough to produce output: the failure is
+            # in our code or a mid-run wedge, not pre-init — stale data
+            # must not mask it as "environment was down"
+            code_failure = True
+        time.sleep(30)
+    if prior is not None and not code_failure:
+        # never reached a healthy backend (or every contact died silent)
+        # — an environment failure, not a code failure. Emit the last
+        # good measurement explicitly marked stale, but still exit
         # nonzero so the failure is never mistaken for a fresh run.
-        try:
-            stale = json.loads(prior["line"])
-            if not isinstance(stale, dict):
-                raise ValueError("saved line is not a JSON object")
-            # load-time gate: a saved run from a different config (e.g. a
-            # small-batch MXTPU_BENCH_BATCH dev run — its save-side gate
-            # compared against its OWN batch) must never stand in for
-            # this round's full-size metric
-            if stale.get("metric") != METRIC:
-                raise ValueError("saved metric %r != current %r"
-                                 % (stale.get("metric"), METRIC))
-            stale["stale"] = True
-            stale["stale_reason"] = str(last_err)[:200]
-            stale["measured_at"] = prior.get("measured_at")
+        if _emit_stale(prior, last_err):
             _diag("emitting last good measurement (stale)")
-            print(json.dumps(stale), flush=True)
             return 1
-        except ValueError:
-            pass
-    _fail_json(last_err)
+    if code_failure or not emitted_provisional:
+        # error JSON printed LAST so the driver sees the real failure
+        # even if a provisional stale line went out earlier
+        _fail_json(last_err)
     return 1
 
 
@@ -383,12 +504,22 @@ def main():
     # one '#hb alive' line a minute so a long XLA compile (fp32 ResNet-50
     # took >300s cold in round 4 — SIGALRM cannot interrupt the C++
     # compile either) doesn't read as supervisor-visible silence. Started
-    # only AFTER backend-up so a wedged tunnel init still dies fast; a
-    # hang after this point is bounded by the supervisor's runaway wall.
+    # only AFTER backend-up so a wedged tunnel init still dies fast.
+    # Progress-tied (advisor r4): it goes SILENT once the main thread has
+    # not reached a new stage boundary in MXTPU_BENCH_KEEPALIVE_STALL
+    # seconds (default 900 — sized over the >300s cold-compile worst
+    # case), so the supervisor's 300s silence clock regains authority
+    # over genuine hangs: a wedged child now dies in ~20 min instead of
+    # burning the full runaway wall. Printing resumes if progress does.
+    stall_after = float(os.environ.get("MXTPU_BENCH_KEEPALIVE_STALL",
+                                       "900"))
+
     def _keepalive():
+        _bump_progress()
         while True:
             time.sleep(60)
-            _emit("#hb %s alive" % time.strftime("%H:%M:%S"))
+            if time.monotonic() - _PROGRESS[1] < stall_after:
+                _emit("#hb %s alive" % time.strftime("%H:%M:%S"))
 
     threading.Thread(target=_keepalive, daemon=True).start()
 
@@ -396,6 +527,29 @@ def main():
 
     def sync(out):
         return float(reduce_fn(out))
+
+    # First JSON within seconds of backend-up: a tiny bf16 matmul, timed.
+    # Proves the chip computes (not just that grpc connected) and puts a
+    # machine-readable line on stdout long before the ResNet compile —
+    # time-to-first-JSON < 60s warm (VERDICT r4 next-round item 1c). No
+    # "metric" key: the supervisor's _json_line never promotes it to the
+    # headline.
+    try:
+        m = jnp.ones((2048, 2048), jnp.bfloat16)
+        mm = jax.jit(lambda a: a @ a)
+        sync(mm(m))  # compile + run
+        t0 = time.perf_counter()
+        for _ in range(16):
+            o = mm(m)
+        sync(o)
+        dt = time.perf_counter() - t0
+        tflops = 16 * 2 * 2048 ** 3 / dt / 1e12
+        _bump_progress()
+        _emit(json.dumps({"probe": "warmup_matmul_bf16",
+                          "tflops": round(tflops, 2),
+                          "backend": jax.default_backend()}))
+    except Exception as e:  # noqa: BLE001 — proof line is best-effort
+        _diag("warmup matmul failed: %r" % (e,))
 
     rng = np.random.default_rng(0)
     host_data = rng.standard_normal((BATCH, 3, 224, 224), dtype=np.float32)
@@ -411,7 +565,7 @@ def main():
     # headline secured: emit it NOW so a hang in an aux section can never
     # cost the round its one measured number (supervise() keeps the last
     # JSON line it sees, including from a killed child)
-    _emit(json.dumps({
+    headline = json.dumps({
         "metric": METRIC,
         "value": round(ips_bf16, 2),
         "unit": "img/s/chip",
@@ -419,7 +573,9 @@ def main():
         "backend": jax.default_backend(),
         "bf16_variant": "nchw",  # the final line reports best-of-variants
         "partial": True,
-    }))
+    })
+    _emit(headline)
+    _child_record(headline)
 
     def _aux_section(name, seconds, fn):
         """Run an auxiliary metric under a hard SIGALRM deadline so it can
@@ -500,6 +656,39 @@ def main():
         if err is not None:
             extra[key + "_error"] = err
 
+    def _consistency():
+        """On-chip numerics vs CPU jax (SURVEY §4 accelerator-backend
+        consistency; VERDICT r4 Missing #1): the op table in fp32, the
+        MXU-heavy subset in bf16, one model-zoo forward. Returns the
+        failure count so 0.0 means "all consistent"."""
+        from mxnet_tpu.consistency import (model_forward_consistency,
+                                           run_sweep)
+        res32 = run_sweep("float32")
+        _hb("consistency fp32: %d/%d" % (res32["pass"], res32["total"]))
+        mxu_ops = ["dot", "dot_transpose", "batch_dot", "FullyConnected",
+                   "linalg_gemm2", "Convolution", "Convolution_stride2",
+                   "Pooling_avg", "softmax"]
+        res16 = run_sweep("bfloat16", ops=mxu_ops)
+        _hb("consistency bf16: %d/%d" % (res16["pass"], res16["total"]))
+        try:
+            model_forward_consistency()
+            model_ok = True
+        except AssertionError as e:
+            model_ok = False
+            extra["consistency_model_error"] = str(e)[:200]
+        extra["consistency_pass"] = res32["pass"] + res16["pass"]
+        extra["consistency_total"] = res32["total"] + res16["total"]
+        extra["consistency_model_ok"] = model_ok
+        fails = res32["failures"] + res16["failures"]
+        if fails:
+            extra["consistency_failures"] = [n for n, _ in fails][:20]
+        return float(len(fails) + (0 if model_ok else 1))
+
+    val, err = _aux_section("consistency_fail", 600, _consistency)
+    extra["consistency_fail"] = val
+    if err is not None:
+        extra["consistency_fail_error"] = err
+
     best_name = _best_variant()
     best_ips = variants[best_name]
     result = {
@@ -529,7 +718,9 @@ def main():
         result["train_layout"] = _best_layout()
         result["train_stem"] = _best_stem()
     result.update(extra)
-    _emit(json.dumps(result))
+    final = json.dumps(result)
+    _emit(final)
+    _child_record(final)
 
 
 def build_train(batch, layout="NCHW", stem="standard"):

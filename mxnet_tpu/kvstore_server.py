@@ -4,22 +4,41 @@ loop; the reference reads DMLC_ROLE and blocks in ps-lite's server).
 
 Here the server loop lives in the native transport
 (`kvstore/dist.py run_server` over `_native/comm.cc`); this module
-keeps the reference's import-level contract so `python -c "import
-mxnet_tpu; mxnet_tpu.kvstore_server._init_kvstore_server_module()"`
-behaves like the reference server bootstrap."""
+keeps the reference's import-level contract: the reference runs
+``_init_kvstore_server_module()`` AT MODULE IMPORT, so ``import mxnet``
+inside a ``DMLC_ROLE=server`` process blocks in the server loop and
+exits without ever returning to user code (ref:
+python/mxnet/kvstore_server.py:90 — module-level call, then
+``sys.exit()``). Third-party trackers rely on that: their server
+command is just any script that imports the library. The same happens
+here via the ``mxnet_tpu/__init__`` import of this module (advisor r4
+finding). Set ``MXTPU_NO_SERVER_AUTOINIT=1`` to import the library in
+a server-role process without entering the loop (no reference
+equivalent; useful for tooling that inspects a server environment)."""
 from __future__ import annotations
 
 import os
+import sys
 
 
 def _init_kvstore_server_module():
-    """Enter the server loop when this process holds the server role
-    (ref: kvstore_server.py _init_kvstore_server_module)."""
+    """Enter the server loop when this process holds the server role,
+    then exit the process (ref: kvstore_server.py
+    _init_kvstore_server_module — `server.run(); sys.exit()`)."""
     role = os.environ.get("DMLC_ROLE", "")
     if role == "server":
         from .kvstore import dist
         dist.run_server()
+        sys.exit()
     # worker/scheduler roles fall through exactly like the reference
+
+
+if (os.environ.get("DMLC_ROLE") == "server"
+        and os.environ.get("DMLC_PS_ROOT_PORT")
+        and not os.environ.get("MXTPU_NO_SERVER_AUTOINIT")):
+    # import-time entry, reference contract; gated on the tracker env
+    # actually being present so a stray DMLC_ROLE can't hang an import
+    _init_kvstore_server_module()
 
 
 if __name__ == "__main__":

@@ -52,6 +52,14 @@ _ENV_VARS = {
     "DMLC_NUM_SERVER": "server count; 0 = collective data plane",
     "DMLC_WORKER_ID": "this worker's rank",
     "DMLC_SERVER_ID": "this server's index",
+    "MXNET_TEST_SEED": (
+        "pins unseeded framework RNG draws (weight init, dropout) for "
+        "the whole process — the reference test harness's determinism "
+        "contract (random.py)"),
+    "MXTPU_NO_SERVER_AUTOINIT": (
+        "1 = do NOT enter the server loop at import in a "
+        "DMLC_ROLE=server process (the reference always enters; "
+        "kvstore_server.py)"),
 }
 
 

@@ -12,6 +12,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def run_example(rel, args, timeout=600):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO
+    # deterministic framework RNG (weight init, dropout) per example
+    # process: the r4 full-suite run flaked on an attack-success
+    # threshold purely through unseeded init (VERDICT r4 Weak #5)
+    env.setdefault("MXNET_TEST_SEED", "42")
     cmd = [sys.executable, os.path.join(REPO, rel)] + args
     r = subprocess.run(cmd, cwd=REPO, env=env, timeout=timeout,
                        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
