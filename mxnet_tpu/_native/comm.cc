@@ -17,8 +17,12 @@
 //   response: u8 ok | u64 nbytes | payload
 // Ops: 1=INIT 2=PUSH 3=PULL 4=BARRIER 5=COMMAND 6=PUSH_2BIT
 // Commands (key field): 1=set_sync_mode(payload u8) 2=stop
-//   3=server_profiler(ignored) 4=set_optimizer(opaque blob, polled by the
-//   host-language server loop via mxtpu_server_poll)
+//   3=server_profiler(opaque directive blob, enqueued for the host
+//   loop — the reference's kSetProfilerParams command family,
+//   ref: include/mxnet/kvstore.h:43-49) 4=set_optimizer(opaque blob;
+//   ack deferred until the host loop installs the updater). Both blob
+//   commands share one FIFO drained by mxtpu_server_poll; the host
+//   side distinguishes them by payload prefix.
 //
 // Build: g++ -O2 -shared -fPIC -pthread comm.cc -o libmxtpu_comm.so
 
@@ -32,6 +36,7 @@
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <deque>
 #include <map>
 #include <mutex>
 #include <set>
@@ -145,8 +150,10 @@ struct Server {
   std::map<uint32_t, KeyState> keys;
   std::mutex mu;
   std::condition_variable cv;
-  std::vector<char> opt_blob;
-  bool opt_blob_fresh = false;
+  // command-blob FIFO (optimizer installs, profiler directives): a
+  // single overwritable slot would let a quick optimizer push clobber
+  // an unpolled profiler directive
+  std::deque<std::vector<char>> blobs;
   int barrier_count = 0;
   uint64_t barrier_gen = 0;
   std::vector<int> barrier_fds;
@@ -407,14 +414,20 @@ void handle_conn(Server* s, int fd) {
         std::lock_guard<std::mutex> lk(s->mu);
         s->stop = true;
         s->cv.notify_all();
+      } else if (h.key == 3) {
+        // profiler directive: enqueue for the host loop and ack — the
+        // toggle is asynchronous by design (the reference logs-and-
+        // continues when servers can't run it, kvstore.h:387)
+        std::lock_guard<std::mutex> lk(s->mu);
+        s->blobs.emplace_back(payload.begin(), payload.end());
+        s->cv.notify_all();
       } else if (h.key == 4) {
         // ack only after the host loop picked the blob up and installed
         // the updater — otherwise the next push round races the install.
         // Bounded wait: a server started without run_server's poll loop
         // must reject instead of deadlocking this connection thread.
         std::unique_lock<std::mutex> lk(s->mu);
-        s->opt_blob.assign(payload.begin(), payload.end());
-        s->opt_blob_fresh = true;
+        s->blobs.emplace_back(payload.begin(), payload.end());
         s->cv.notify_all();
         bool ok = s->cv.wait_for(
             lk, std::chrono::seconds(60),
@@ -495,13 +508,14 @@ long mxtpu_server_poll(char* buf, uint64_t cap, int timeout_ms) {
   if (!g_server) return -1;
   std::unique_lock<std::mutex> lk(g_server->mu);
   g_server->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [] {
-    return g_server->stop || g_server->opt_blob_fresh;
+    return g_server->stop || !g_server->blobs.empty();
   });
-  if (g_server->opt_blob_fresh) {
-    g_server->opt_blob_fresh = false;
-    uint64_t n = g_server->opt_blob.size();
+  if (!g_server->blobs.empty()) {
+    std::vector<char> blob = std::move(g_server->blobs.front());
+    g_server->blobs.pop_front();
+    uint64_t n = blob.size();
     if (buf && n <= cap) {
-      std::memcpy(buf, g_server->opt_blob.data(), n);
+      std::memcpy(buf, blob.data(), n);
       return static_cast<long>(n);
     }
     return 0;

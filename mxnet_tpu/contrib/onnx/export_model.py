@@ -185,13 +185,9 @@ def _softmax_output(ctx, node, ins, out, a):
 # ---------------------------------------------------------------------------
 
 def _lit(v):
-    if isinstance(v, str):
-        import ast
-        try:
-            return ast.literal_eval(v)
-        except (ValueError, SyntaxError):
-            return v
-    return v
+    # the symbol layer's canonical attr coercion — one parser, no drift
+    from ...symbol.symbol import _parse_attr
+    return _parse_attr(v)
 
 
 def _ival(v, default=0):
@@ -416,6 +412,10 @@ def _topk(c, n, i, o, a):
         return [vals]
     if ret == "both":
         return [vals, idxf]
+    if ret != "indices":
+        # 'mask' returns a 0/1 tensor with the INPUT's shape — not
+        # TopK's output shape; silently exporting indices would be wrong
+        raise MXNetError(f"topk ret_typ={ret!r} has no ONNX mapping")
     return [idxf]  # mxnet default: float indices
 
 

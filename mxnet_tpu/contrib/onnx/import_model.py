@@ -228,8 +228,15 @@ def _squeeze_imp(ins, attrs, params, name, names):
 
 def _unsqueeze_imp(ins, attrs, params, name, names):
     axes = (tuple(int(x) for x in _cval(params, names, ins[1]).ravel())
-            if len(ins) > 1
+            if len(ins) > 1 and ins[1] is not None
             else tuple(int(x) for x in attrs.get("axes", (0,))))
+    if any(ax < 0 for ax in axes):
+        # ONNX negative axes index the OUTPUT rank; expand_dims indexes
+        # relative to the input — without rank info the translation
+        # would be silently wrong for mixed-sign multi-axis lists
+        raise MXNetError(
+            f"Unsqueeze with negative axes {list(axes)} requires rank "
+            "information; re-export with non-negative axes")
     out = ins[0]
     for ax in sorted(axes):
         out = sym_mod.expand_dims(out, axis=ax)
@@ -299,7 +306,15 @@ def _conv_transpose(ins, attrs, params, name, names):
 def _resize_imp(ins, attrs, params, name, names):
     if attrs.get("mode", "nearest") != "nearest":
         raise MXNetError("Resize: only nearest imports to UpSampling")
-    scales = _cval(params, names, ins[-1]).ravel()
+    # inputs: X, roi?, scales?, sizes? — only the scales form (input 2)
+    # maps to UpSampling; the sizes form specifies absolute output dims
+    # which cannot be converted to a scale without the input shape
+    if len(ins) > 3 and ins[3] is not None:
+        raise MXNetError("Resize with a `sizes` input has no UpSampling "
+                         "mapping; re-export using `scales`")
+    if len(ins) < 3 or ins[2] is None:
+        raise MXNetError("Resize without a `scales` input cannot import")
+    scales = _cval(params, names, ins[2]).ravel()
     return sym_mod.UpSampling(ins[0], scale=int(round(float(scales[2]))),
                               sample_type="nearest", name=name)
 

@@ -20,10 +20,19 @@ import numpy as np
 
 from ..base import MXNetError
 from .. import _native
+# imported at module scope ON PURPOSE: the server updater runs as a
+# ctypes callback on a C++ connection thread while the main thread may
+# still be mid-import of the mxnet_tpu package (the kvstore_server
+# import-time entry) — a lazy `from .. import profiler` inside the
+# callback would deadlock on the package's import lock
+from .. import profiler
 
 CMD_SYNC_MODE = 1
 CMD_STOP = 2
 CMD_SERVER_PROFILER = 3
+# profiler directives ride the same server-side blob FIFO as pickled
+# optimizers; pickles start with b"\x80", so this prefix is unambiguous
+PROF_MAGIC = b"PROF\x00"
 CMD_SET_OPTIMIZER = 4
 
 
@@ -166,6 +175,15 @@ class WorkerConnection:
     def send_optimizer(self, optimizer):
         self.command(CMD_SET_OPTIMIZER, pickle.dumps(optimizer))
 
+    def send_profiler_command(self, directive):
+        """Remote-control the SERVER process's profiler (ref:
+        include/mxnet/kvstore.h:43-49 kSetConfig/kState/kPause/kDump;
+        kvstore_dist_server.h:199 Controller profiler branch).
+        `directive` is a dict like {"cmd": "set_state", "state": "run"}
+        handled by run_server's poll loop."""
+        self.command(CMD_SERVER_PROFILER,
+                     PROF_MAGIC + pickle.dumps(directive))
+
     def stop_server(self):
         self.command(CMD_STOP)
 
@@ -298,6 +316,11 @@ class ShardedConnection:
     def send_optimizer(self, optimizer):
         self.command(CMD_SET_OPTIMIZER, pickle.dumps(optimizer))
 
+    def send_profiler_command(self, directive):
+        # every shard server gets the directive, like set_optimizer
+        self.command(CMD_SERVER_PROFILER,
+                     PROF_MAGIC + pickle.dumps(directive))
+
     def stop_server(self):
         self.command(CMD_STOP)
 
@@ -313,6 +336,38 @@ def connect_workers():
     if num_servers_env() > 1:
         return ShardedConnection()
     return WorkerConnection()
+
+
+def _apply_profiler_directive(body):
+    """Run a worker-sent profiler command in THIS (server) process
+    (ref: src/kvstore/kvstore_dist_server.h:199 — the reference's
+    server Controller handles kSetConfig/kState/kPause/kDump by calling
+    its own profiler; integration-tested 3-way by
+    tests/nightly/test_server_profiling.py)."""
+    cmd = "?"
+    try:
+        d = pickle.loads(body)
+        cmd = d.get("cmd")
+        if cmd == "set_config":
+            profiler.set_config(**d.get("kwargs", {}))
+        elif cmd == "set_state":
+            profiler.set_state(d.get("state", "stop"))
+        elif cmd == "pause":
+            profiler.pause()
+        elif cmd == "resume":
+            profiler.resume()
+        elif cmd == "dump":
+            profiler.dump()
+    except Exception as e:  # noqa: BLE001 — the worker already got its
+        # ACK (the command is async by design); a malformed directive
+        # must not take down the poll loop the whole job depends on
+        # (the reference also logs-and-continues, kvstore.h:387)
+        import sys
+        print("kvstore server: profiler command %r failed: %r"
+              % (cmd, e), file=sys.stderr, flush=True)
+        return
+    profiler.record_event("server_profiler_cmd:%s" % cmd, "kvstore",
+                          profiler._now_us(), 0)
 
 
 def run_server(port=None, num_workers=None, poll_ms=200):
@@ -339,17 +394,23 @@ def run_server(port=None, num_workers=None, poll_ms=200):
         if got < 0:
             break
         if got > 0:
-            optimizer = pickle.loads(buf.raw[:got])
+            blob = buf.raw[:got]
+            if blob.startswith(PROF_MAGIC):
+                _apply_profiler_directive(blob[len(PROF_MAGIC):])
+                continue
+            optimizer = pickle.loads(blob)
 
             def updater(key, recved, stored, _opt=optimizer,
                         _states=states):
                 from ..ndarray import NDArray
                 import jax.numpy as jnp
-                w = NDArray(jnp.asarray(stored))
-                g = NDArray(jnp.asarray(recved))
-                if key not in _states:
-                    _states[key] = _opt.create_state(key, w)
-                _opt.update(key, w, g, _states[key])
-                stored[:] = np.asarray(w._data, dtype=np.float32)
+                with profiler.timed_region("server_update:key%d" % key,
+                                           "kvstore"):
+                    w = NDArray(jnp.asarray(stored))
+                    g = NDArray(jnp.asarray(recved))
+                    if key not in _states:
+                        _states[key] = _opt.create_state(key, w)
+                    _opt.update(key, w, g, _states[key])
+                    stored[:] = np.asarray(w._data, dtype=np.float32)
 
             _native.set_server_updater(updater)

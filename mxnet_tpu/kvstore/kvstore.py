@@ -59,6 +59,9 @@ class KVStore:
                 if self._conn.rank == 0:
                     self._conn.set_sync_mode(sync)
                 self._conn.barrier()  # sync-mode visible to every push
+                # route profiler profile_process='server' calls here
+                from .. import profiler
+                profiler.set_kvstore_handle(self)
 
     # -- factory-reported topology ----------------------------------------
     @property

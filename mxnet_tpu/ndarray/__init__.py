@@ -13,6 +13,7 @@ import numpy as np
 
 from .. import engine
 from ..context import Context, current_context
+from . import ndarray
 from .ndarray import NDArray, array, concatenate, invoke
 from .register import populate
 from . import random  # noqa: F401
@@ -78,16 +79,19 @@ del _name, _start
 # constructors shadow same-named registry wrappers (shape is positional here)
 def zeros(shape, ctx=None, dtype=None, **kwargs):
     shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    ndarray.check_large_array(shape)  # refuse before the host alloc
     return array(np.zeros(shape, dtype or "float32"), ctx=ctx)
 
 
 def ones(shape, ctx=None, dtype=None, **kwargs):
     shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    ndarray.check_large_array(shape)
     return array(np.ones(shape, dtype or "float32"), ctx=ctx)
 
 
 def full(shape, val, ctx=None, dtype=None, out=None):
     shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    ndarray.check_large_array(shape)
     return array(np.full(shape, val, dtype or "float32"), ctx=ctx)
 
 

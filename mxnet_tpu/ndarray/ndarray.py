@@ -61,6 +61,31 @@ def _canon_attr(v):
     return v
 
 
+_INT32_MAX = 2 ** 31 - 1
+
+
+def check_large_array(shape):
+    """Large-array policy (ref: tests/nightly/test_large_array.py — the
+    reference supports >2^32-element NDArrays through int64 indexing).
+    This runtime is x32 by default (jax's default; TPU gathers/indexing
+    run int32), so element counts beyond 2^31-1 would silently corrupt
+    take/Embedding/argmax results. Refuse at construction with the
+    workaround spelled out rather than compute wrong numbers. With
+    jax_enable_x64 the gate lifts."""
+    n = 1
+    for d in shape:
+        n *= int(d)
+    if n > _INT32_MAX and not jax.config.jax_enable_x64:
+        raise MXNetError(
+            f"NDArray of {n} elements exceeds the 32-bit index range "
+            f"({_INT32_MAX}) of the x32 runtime; indexing ops (take, "
+            "Embedding, argmax) would overflow. Enable "
+            "jax.config.update('jax_enable_x64', True) on a CPU host, "
+            "or shard the array across devices with mxnet_tpu.parallel "
+            "(the TPU-native answer at this scale)")
+    return n
+
+
 class NDArray:
     """n-dimensional device array with async semantics."""
 
@@ -70,7 +95,12 @@ class NDArray:
         if isinstance(data, NDArray):
             data = data._data
         if not isinstance(data, jax.Array):
+            # gate BEFORE materialization: the refusal must beat the
+            # host->device transfer, not follow a device OOM
+            if hasattr(data, "shape"):
+                check_large_array(data.shape)
             data = _materialize(data)
+        check_large_array(data.shape)
         if ctx is not None:
             data = jax.device_put(data, Context(ctx).jax_device)
         self._data = data

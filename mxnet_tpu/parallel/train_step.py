@@ -46,18 +46,23 @@ def _as_sharding(mesh, spec_tree, like_tree):
 def make_sharded_train_step(loss_fn, mesh, param_example, batch_example,
                             param_specs=None, batch_specs=P("dp"),
                             lr=0.01, momentum=None, donate=True,
-                            state_specs=None):
+                            state_specs=None, grad_specs=None):
     """Compile `loss_fn(params, batch) -> scalar` into a sharded SGD step.
 
     Parameters replicated by default (or per-leaf `param_specs` for
     tensor/expert/pipeline sharding); batch sharded over `dp`;
     `state_specs` shards the OPTIMIZER STATE differently from the
     params (the ZeRO-1 weight-update-sharding hook — see
-    make_zero_train_step). Returns `step(params, opt_state, batch) ->
-    (params, opt_state, loss)` plus the placed initial state.
+    make_zero_train_step); `grad_specs` pins an in-step sharding
+    constraint on the gradients (ZeRO-2: the dp-summed grads are
+    reduce-scattered once and never materialize replicated). Returns
+    `step(params, opt_state, batch) -> (params, opt_state, loss)` plus
+    the placed initial state.
     """
     p_sh = _as_sharding(mesh, param_specs, param_example)
     b_sh = _as_sharding(mesh, batch_specs, batch_example)
+    g_sh = (None if grad_specs is None
+            else _as_sharding(mesh, grad_specs, param_example))
     on_cpu = jax.default_backend() == "cpu"
     if donate and on_cpu:
         # donation is an HBM-residency optimization; it buys nothing on
@@ -84,6 +89,8 @@ def make_sharded_train_step(loss_fn, mesh, param_example, batch_example,
         donate_argnums=(0, 1) if donate else ())
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        if g_sh is not None:
+            grads = jax.lax.with_sharding_constraint(grads, g_sh)
         params, opt_state = sgd_update(params, grads, lr, momentum,
                                        opt_state)
         return params, opt_state, loss
@@ -104,34 +111,48 @@ def make_sharded_train_step(loss_fn, mesh, param_example, batch_example,
 
 def make_zero_train_step(loss_fn, mesh, param_example, batch_example,
                          batch_specs=P("dp"), lr=0.01, momentum=0.9,
-                         dp_axis="dp", donate=True):
-    """ZeRO-1 / cross-replica weight-update sharding (Xu et al. 2020,
-    "Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
-    Training"): parameters stay replicated for the forward/backward,
-    but the OPTIMIZER STATE is sharded across the data-parallel axis —
-    XLA lowers the gradient psum into reduce-scatter + shard-local
-    update + all-gather, and each replica holds 1/dp of the momentum.
+                         dp_axis="dp", donate=True, stage=1):
+    """ZeRO weight/gradient/parameter sharding over the data-parallel
+    axis (Rajbhandari et al. 2020 "ZeRO: Memory Optimizations Toward
+    Training Trillion Parameter Models"; stage 1 is Xu et al. 2020
+    cross-replica weight-update sharding).
+
+    - ``stage=1``: optimizer state sharded across dp; params replicated.
+      XLA lowers the gradient psum into reduce-scatter + shard-local
+      update + all-gather; each replica holds 1/dp of the momentum.
+    - ``stage=2``: additionally pins a sharding constraint on the
+      gradients, so the dp-summed grads are reduce-scattered once and
+      never materialize replicated (grad memory also 1/dp).
+    - ``stage=3``: parameters themselves live sharded across dp;
+      GSPMD inserts all-gathers at each use inside forward/backward
+      (gather-on-use) and the update runs entirely shard-local — param,
+      grad, and state memory all 1/dp.
 
     Beyond the reference's grid: its PS/allreduce paths keep full
     optimizer state on every worker (SURVEY §2.3). Thin wrapper over
-    make_sharded_train_step's state_specs hook, so the scaffolding
-    (donation policy, CPU serialization, placement) stays in one place.
+    make_sharded_train_step's spec hooks, so the scaffolding (donation
+    policy, CPU serialization, placement) stays in one place.
     """
     if momentum is None:
-        raise ValueError("ZeRO-1 shards optimizer state; momentum must "
+        raise ValueError("ZeRO shards optimizer state; momentum must "
                          "not be None (stateless SGD has nothing to "
                          "shard — use make_sharded_train_step)")
+    if stage not in (1, 2, 3):
+        raise ValueError(f"ZeRO stage must be 1, 2, or 3, got {stage}")
     dp = mesh.shape[dp_axis]
 
-    def _state_spec(p):
+    def _shard_spec(p):
         # shard the leading axis across dp where it divides; tiny or
         # indivisible leaves stay replicated (they are the cheap ones)
         if p.ndim >= 1 and p.shape[0] % dp == 0 and p.shape[0] >= dp:
             return P(dp_axis)
         return P()
 
-    state_specs = jax.tree_util.tree_map(_state_spec, param_example)
+    sharded = jax.tree_util.tree_map(_shard_spec, param_example)
     return make_sharded_train_step(
         loss_fn, mesh, param_example, batch_example,
         batch_specs=batch_specs, lr=lr, momentum=momentum,
-        donate=donate, state_specs=state_specs)
+        donate=donate,
+        param_specs=sharded if stage >= 3 else None,
+        state_specs=None if stage >= 3 else sharded,
+        grad_specs=sharded if stage == 2 else None)

@@ -45,7 +45,32 @@ def _now_us():
     return (time.perf_counter() - _t0) * 1e6
 
 
-def set_config(**kwargs):
+# dist kvstore whose servers remote profiler commands reach; installed
+# automatically when a dist KVStore connects (ref: profiler.py
+# set_kvstore_handle + kvstore.h:380 SendCommandToServers)
+_kv_conn = None
+
+
+def set_kvstore_handle(kv):
+    """Register the dist kvstore that profile_process='server' calls
+    route through (ref: python/mxnet/profiler.py set_kvstore_handle)."""
+    global _kv_conn
+    _kv_conn = getattr(kv, "_conn", kv)
+
+
+def _send_server(directive):
+    if _kv_conn is None:
+        raise MXNetError(
+            "profile_process='server' needs a connected dist kvstore "
+            "(create mx.kv.create('dist_sync') first, ref: "
+            "kvstore.h:387's warning for the same misuse)")
+    _kv_conn.send_profiler_command(directive)
+
+
+def set_config(profile_process="worker", **kwargs):
+    if profile_process == "server":
+        _send_server({"cmd": "set_config", "kwargs": kwargs})
+        return
     unknown = set(kwargs) - set(_config)
     if unknown:
         raise MXNetError(f"unknown profiler config keys {sorted(unknown)}")
@@ -56,6 +81,9 @@ def set_state(state="stop", profile_process="worker"):
     """'run' starts collection, 'stop' ends it (ref: profiler.py
     set_state; MXSetProcessProfilerState)."""
     global _state, _xla_session
+    if profile_process == "server":
+        _send_server({"cmd": "set_state", "state": state})
+        return
     if state not in ("run", "stop"):
         raise MXNetError("profiler state must be 'run' or 'stop'")
     if state == "run" and _state != "run":
@@ -79,10 +107,16 @@ def is_running():
 
 
 def pause(profile_process="worker"):
+    if profile_process == "server":
+        _send_server({"cmd": "pause"})
+        return
     set_state("stop")
 
 
 def resume(profile_process="worker"):
+    if profile_process == "server":
+        _send_server({"cmd": "resume"})
+        return
     set_state("run")
 
 
@@ -126,6 +160,9 @@ def timed_region(name, cat="region"):
 
 def dump(finished=True, profile_process="worker"):
     """Write the chrome-trace JSON to the configured filename."""
+    if profile_process == "server":
+        _send_server({"cmd": "dump"})
+        return
     with _lock:
         events = list(_events)
         if finished:

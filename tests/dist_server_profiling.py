@@ -1,0 +1,67 @@
+"""Worker script: remote-control the SERVER's profiler over the dist
+transport, exactly the reference's 3-way nightly flow (ref:
+tests/nightly/test_server_profiling.py — set_config/set_state with
+profile_process='server' around sync push/pull, then assert the
+server-side trace file exists and holds events). Launched by
+tests/test_dist_kvstore.py through tools/launch.py.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, profiler
+
+SHAPE = (64, 64)
+KEY = "99"
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank, nworker = kv.rank, kv.num_workers
+    trace_path = os.environ["SERVER_TRACE_FILE"]
+
+    if rank == 0:
+        profiler.set_config(profile_process="server", filename=trace_path)
+        profiler.set_state("run", profile_process="server")
+    kv.init(KEY, nd.ones(SHAPE))
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1))
+
+    for _ in range(5):
+        kv.push(KEY, nd.ones(SHAPE) * (rank + 1))
+        out = nd.zeros(SHAPE)
+        kv.pull(KEY, out=out)
+        nd.waitall()
+
+    kv._conn.barrier()
+    if rank == 0:
+        profiler.set_state("stop", profile_process="server")
+        profiler.dump(profile_process="server")
+        # the dump command is asynchronous (acked on enqueue, executed
+        # by the server's poll loop): poll for the file
+        deadline = time.time() + 20
+        events = None
+        while time.time() < deadline:
+            if os.path.exists(trace_path):
+                try:
+                    with open(trace_path) as f:
+                        events = json.load(f)["traceEvents"]
+                    if events:
+                        break
+                except (ValueError, KeyError):
+                    pass
+            time.sleep(0.25)
+        assert events, f"no server trace at {trace_path}"
+        names = {e["name"] for e in events}
+        assert any(n.startswith("server_update:") for n in names), names
+        assert any("server_profiler_cmd" in n for n in names), names
+    print(f"[worker {rank}] SERVER_PROFILING OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -250,3 +250,78 @@ def test_zero_train_step_matches_replicated():
     np.testing.assert_allclose(float(loss_r), float(loss_z), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(p_r["w"]), np.asarray(p_z["w"]),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_zero2_zero3_match_replicated():
+    """ZeRO-2 (grad reduce-scatter constraint) and ZeRO-3 (parameters
+    sharded at rest, gather-on-use) follow the identical trajectory —
+    the stages change memory layout and collectives, not math
+    (Rajbhandari et al. 2020)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel import (create_mesh, make_sharded_train_step,
+                                    make_zero_train_step)
+
+    mesh = create_mesh({"dp": 8})
+    rng = np.random.default_rng(1)
+    params = {"w": jnp.asarray(rng.normal(0, 0.3, (16, 4)).astype(np.float32)),
+              "b": jnp.asarray(np.zeros((4,), np.float32))}
+    X = jnp.asarray(rng.normal(0, 1, (32, 16)).astype(np.float32))
+    y = jnp.asarray(rng.normal(0, 1, (32, 4)).astype(np.float32))
+
+    def loss_fn(p, batch):
+        data, lbl = batch
+        return jnp.mean((data @ p["w"] + p["b"] - lbl) ** 2)
+
+    step_r, p_r, s_r = make_sharded_train_step(
+        loss_fn, mesh, params, (X, y),
+        batch_specs=(P("dp"), P("dp")), lr=0.1, momentum=0.9)
+    step_2, p_2, s_2 = make_zero_train_step(
+        loss_fn, mesh, params, (X, y),
+        batch_specs=(P("dp"), P("dp")), lr=0.1, momentum=0.9, stage=2)
+    step_3, p_3, s_3 = make_zero_train_step(
+        loss_fn, mesh, params, (X, y),
+        batch_specs=(P("dp"), P("dp")), lr=0.1, momentum=0.9, stage=3)
+
+    # stage 2: state sharded, params replicated
+    assert s_2["w"].sharding.spec == P("dp")
+    assert p_2["w"].sharding.spec == P()
+    # stage 3: params themselves live sharded; so does the state
+    assert p_3["w"].sharding.spec == P("dp"), p_3["w"].sharding.spec
+    assert s_3["w"].sharding.spec == P("dp")
+    assert p_3["b"].sharding.spec == P()  # indivisible leaf replicated
+
+    for _ in range(4):
+        p_r, s_r, loss_r = step_r(p_r, s_r, (X, y))
+        p_2, s_2, loss_2 = step_2(p_2, s_2, (X, y))
+        p_3, s_3, loss_3 = step_3(p_3, s_3, (X, y))
+    np.testing.assert_allclose(float(loss_r), float(loss_2), rtol=1e-5)
+    np.testing.assert_allclose(float(loss_r), float(loss_3), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(p_r["w"]), np.asarray(p_2["w"]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(p_r["w"]), np.asarray(p_3["w"]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_zero_stage_validation():
+    import jax.numpy as jnp
+    import pytest
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel import create_mesh, make_zero_train_step
+
+    mesh = create_mesh({"dp": 8})
+    params = {"w": jnp.zeros((8, 2))}
+    batch = (jnp.zeros((8, 8)), jnp.zeros((8, 2)))
+
+    def loss_fn(p, b):
+        return jnp.mean((b[0] @ p["w"] - b[1]) ** 2)
+
+    with pytest.raises(ValueError, match="stage"):
+        make_zero_train_step(loss_fn, mesh, params, batch,
+                             batch_specs=(P("dp"), P("dp")), stage=4)
+    with pytest.raises(ValueError, match="momentum"):
+        make_zero_train_step(loss_fn, mesh, params, batch,
+                             batch_specs=(P("dp"), P("dp")),
+                             momentum=None)
