@@ -163,6 +163,53 @@ def decode_jpeg(payload):
     return out
 
 
+def decode_batch(payloads, th, tw, uv, mirror, mean, std, nthreads=None):
+    """Decode+crop+mirror+normalize a whole batch of JPEG payloads
+    through the C++ libjpeg thread pool into (n, 3, th, tw) float32 —
+    the reference's OMP batch pipeline shape (ref:
+    src/io/iter_image_recordio_2.cc:364-445). Returns None when the
+    native lib is unavailable (callers fall back to Python); raises
+    MXNetError on a decode failure.
+
+    ``uv``: (n, 2) float32 crop offsets in [0,1), negative = center;
+    ``mirror``: (n,) uint8; ``mean``/``std``: 3 floats each applied to
+    the RAW 0..255 pixel values."""
+    import numpy as np
+
+    from ..base import MXNetError
+
+    lib = load_imgdec()
+    if lib is None:
+        return None
+    n = len(payloads)
+    if nthreads is None:
+        nthreads = int(os.environ.get("MXNET_CPU_WORKER_NTHREADS",
+                                      str(os.cpu_count() or 4)))
+    uv = np.ascontiguousarray(uv, np.float32)
+    mirror = np.ascontiguousarray(mirror, np.uint8)
+    mean = np.ascontiguousarray(mean, np.float32).ravel()
+    std = np.ascontiguousarray(std, np.float32).ravel()
+    out = pooled_empty((n, 3, th, tw), np.float32)
+    bufs = (ctypes.c_char_p * n)(*payloads)
+    lens = (ctypes.c_int64 * n)(*[len(p) for p in payloads])
+    errbuf = ctypes.create_string_buffer(512)
+    fptr = ctypes.POINTER(ctypes.c_float)
+    rc = lib.mxtpu_decode_batch(
+        ctypes.cast(bufs, ctypes.POINTER(ctypes.c_char_p)),
+        ctypes.cast(lens, ctypes.POINTER(ctypes.c_int64)),
+        n, th, tw,
+        uv.ctypes.data_as(fptr),
+        mirror.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        mean.ctypes.data_as(fptr),
+        std.ctypes.data_as(fptr),
+        out.ctypes.data_as(fptr),
+        nthreads, errbuf, len(errbuf))
+    if rc != 0:
+        raise MXNetError("native decode failed: %s"
+                         % errbuf.value.decode(errors="replace"))
+    return out
+
+
 # keeps the ctypes callback object alive for the lib's lifetime
 _updater_keepalive = []
 

@@ -306,15 +306,19 @@ def _conv_transpose(ins, attrs, params, name, names):
 def _resize_imp(ins, attrs, params, name, names):
     if attrs.get("mode", "nearest") != "nearest":
         raise MXNetError("Resize: only nearest imports to UpSampling")
-    # inputs: X, roi?, scales?, sizes? — only the scales form (input 2)
-    # maps to UpSampling; the sizes form specifies absolute output dims
-    # which cannot be converted to a scale without the input shape
+    # opset-13 inputs: X, roi?, scales?, sizes?; opset-10: X, scales.
+    # Only a scales form maps to UpSampling; the sizes form specifies
+    # absolute output dims, unconvertible without the input shape.
     if len(ins) > 3 and ins[3] is not None:
         raise MXNetError("Resize with a `sizes` input has no UpSampling "
                          "mapping; re-export using `scales`")
-    if len(ins) < 3 or ins[2] is None:
+    if len(ins) == 2 and ins[1] is not None:
+        scales_in = ins[1]  # opset-10 (X, scales)
+    elif len(ins) >= 3 and ins[2] is not None:
+        scales_in = ins[2]
+    else:
         raise MXNetError("Resize without a `scales` input cannot import")
-    scales = _cval(params, names, ins[2]).ravel()
+    scales = _cval(params, names, scales_in).ravel()
     return sym_mod.UpSampling(ins[0], scale=int(round(float(scales[2]))),
                               sample_type="nearest", name=name)
 

@@ -6,6 +6,15 @@ TPU-native loader uses a thread pool: decode/augment run in Python threads
 (NumPy/opencv release the GIL), batches materialize as pinned host arrays and
 device transfer overlaps compute via the async stream — the same
 PrefetcherIter pattern as src/io/iter_prefetcher.h:47.
+
+When the dataset is an ImageRecordDataset and the transform pipeline is
+the standard vision shape (flip? + center-crop + ToTensor + Normalize?),
+whole batches bypass Python entirely: raw JPEG payloads go to the
+_native/imgdec.cc libjpeg thread pool, which decodes, crops, mirrors and
+normalizes straight into a pooled NCHW float32 buffer — the same one
+OMP pipeline that serves io.ImageRecordIter (ref:
+src/io/iter_image_recordio_2.cc:364-445 serves both of the reference's
+paths). Unsupported pipelines fall back to the per-item Python path.
 """
 from __future__ import annotations
 
@@ -15,6 +24,46 @@ import numpy as np
 
 from ...ndarray import NDArray, array
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+
+def compile_native_plan(fn):
+    """Map a transforms.Compose onto imgdec.cc's kernel if its steps are
+    exactly [RandomFlipLeftRight?] [CenterCrop] [ToTensor] [Normalize?].
+    Returns {"th","tw","flip","mean","std"} or None. The kernel works on
+    raw 0..255 pixels, so ToTensor's /255 and Normalize fold into the
+    affine: ((px/255) - m) / s == (px - 255m) / (255s)."""
+    from .vision import transforms as T
+
+    if not isinstance(fn, T.Compose):
+        return None
+    steps = list(fn._children.values())
+    flip = False
+    crop = None
+    mean = np.zeros(3, np.float32)
+    std = np.ones(3, np.float32)
+    i = 0
+    if i < len(steps) and isinstance(steps[i], T.RandomFlipLeftRight):
+        flip = True
+        i += 1
+    if i < len(steps) and isinstance(steps[i], T.CenterCrop):
+        crop = steps[i]._size  # (w, h)
+        i += 1
+    else:
+        return None  # no fixed output size -> variable shapes, bail
+    if not (i < len(steps) and isinstance(steps[i], T.ToTensor)):
+        return None
+    i += 1
+    if i < len(steps) and isinstance(steps[i], T.Normalize):
+        mean = np.broadcast_to(np.asarray(steps[i]._mean, np.float32),
+                               (3,)).copy()
+        std = np.broadcast_to(np.asarray(steps[i]._std, np.float32),
+                              (3,)).copy()
+        i += 1
+    if i != len(steps):
+        return None  # unrecognized trailing transforms
+    w, h = crop
+    return {"th": int(h), "tw": int(w), "flip": flip,
+            "mean": mean * 255.0, "std": std * 255.0}
 
 
 def default_batchify_fn(data):
@@ -49,12 +98,78 @@ class DataLoader:
         self._num_workers = max(0, num_workers)
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * self._num_workers)
+        self._native = None
+        if batchify_fn is None:
+            self._native = self._compile_native(dataset)
+
+    def _compile_native(self, dataset):
+        """(source dataset, plan) when the dataset chain is
+        ImageRecordDataset -> transform_first(<native-mappable Compose>);
+        None otherwise."""
+        from .dataset import _LazyTransformDataset
+        from .vision.datasets import ImageRecordDataset
+
+        if not isinstance(dataset, _LazyTransformDataset):
+            return None
+        fn = getattr(dataset._fn, "_transform_first", None)
+        src = dataset._data
+        if fn is None or not isinstance(src, ImageRecordDataset):
+            return None
+        if src._flag == 0 or src._transform is not None:
+            return None
+        plan = compile_native_plan(fn)
+        if plan is None:
+            return None
+        return src, plan
 
     def __len__(self):
         return len(self._batch_sampler)
 
     def _load_batch(self, indices):
+        if self._native is not None:
+            batch = self._load_batch_native(indices)
+            if batch is not None:
+                return batch
         return self._batchify_fn([self._dataset[i] for i in indices])
+
+    def _load_batch_native(self, indices):
+        """Whole-batch decode+augment in the C++ pool; None falls back
+        to the Python path (lib absent, a non-JPEG record, an image the
+        kernel refuses e.g. smaller than the crop)."""
+        from ... import _native
+        from ...base import MXNetError
+
+        src, plan = self._native
+        payloads, labels = [], []
+        for i in indices:
+            payload, label = src.raw_payload(i)
+            if payload[:2] != b"\xff\xd8":
+                return None
+            payloads.append(payload)
+            labels.append(np.atleast_1d(
+                np.asarray(label, np.float32)))
+        n = len(payloads)
+        uv = np.full((n, 2), -1.0, np.float32)  # center crop
+        mirror = ((np.random.rand(n) < 0.5) if plan["flip"]
+                  else np.zeros(n)).astype(np.uint8)
+        try:
+            # with executor workers in flight, each call decodes its
+            # batch single-threaded — the parallelism is across batches
+            # (N workers x N-thread pools would oversubscribe the host)
+            out = _native.decode_batch(
+                payloads, plan["th"], plan["tw"], uv, mirror,
+                plan["mean"], plan["std"],
+                nthreads=1 if self._num_workers else None)
+        except MXNetError:
+            # e.g. an image smaller than the crop: the Python
+            # CenterCrop clamps instead — let that path decide
+            return None
+        if out is None:
+            return None
+        lab = np.stack(labels)
+        if lab.shape[1] == 1:  # scalar labels batch as (n,) like the
+            lab = lab[:, 0]    # per-item path
+        return [array(out), array(lab)]
 
     def __iter__(self):
         if self._num_workers == 0:

@@ -25,6 +25,9 @@ class Dataset:
     def transform_first(self, fn, lazy=True):
         def first(x, *args):
             return (fn(x),) + args if args else fn(x)
+        # the DataLoader's native batch path unwraps this to see whether
+        # the user pipeline compiles onto the C++ decode kernel
+        first._transform_first = fn
         return self.transform(first, lazy)
 
 

@@ -126,18 +126,36 @@ class ImageRecordDataset(Dataset):
     """Images + labels packed in a RecordIO file (ref: datasets.py)."""
 
     def __init__(self, filename, flag=1, transform=None):
+        import threading
+
         from ....recordio import MXIndexedRecordIO, unpack_img
         idx_file = filename[:filename.rfind(".")] + ".idx"
         self._record = MXIndexedRecordIO(idx_file, filename, "r")
         self._flag = flag
         self._transform = transform
         self._unpack = unpack_img
+        # read_idx is seek+read on one shared handle; DataLoader thread
+        # workers hit it concurrently (decode stays parallel, only the
+        # file read serializes)
+        self._read_lock = threading.Lock()
 
     def __len__(self):
         return len(self._record.keys)
 
+    def raw_payload(self, idx):
+        """(undecoded payload bytes, label) — the seam the DataLoader's
+        native batch path reads so decode+augment can run in the C++
+        pool instead of per-item Python (ref: the reference feeds raw
+        records straight to its OMP decoder, iter_image_recordio_2.cc)."""
+        from ....recordio import unpack
+        with self._read_lock:
+            record = self._record.read_idx(self._record.keys[idx])
+        header, payload = unpack(record)
+        return payload, header.label
+
     def __getitem__(self, idx):
-        record = self._record.read_idx(self._record.keys[idx])
+        with self._read_lock:
+            record = self._record.read_idx(self._record.keys[idx])
         from ....recordio import cv2_present, decode_payload, unpack
         from ...._native import decode_jpeg
         header, payload = unpack(record)

@@ -785,8 +785,6 @@ class ImageRecordIter(DataIter):
             # shorter-side resize runs in the PIL path (the native
             # decoder crops/normalizes only)
             return None
-        import ctypes
-
         from ..recordio import unpack
         c, h, w = self.data_shape
         n = len(raws)
@@ -809,28 +807,16 @@ class ImageRecordIter(DataIter):
             uv = np.full((n, 2), -1.0, np.float32)
         mirror = ((rng.rand(n) < 0.5) if self.rand_mirror
                   else np.zeros(n)).astype(np.uint8)
-        # batch staging buffer from the native host pool: steady-state
-        # epochs recycle the same memory instead of malloc'ing per batch
-        # (ref: iter_image_recordio_2.cc fills pinned batches in place)
-        from .._native import pooled_empty
-        out = pooled_empty((n, 3, h, w), np.float32)
-        bufs = (ctypes.c_char_p * n)(*payloads)
-        lens = (ctypes.c_int64 * n)(*[len(p) for p in payloads])
-        errbuf = ctypes.create_string_buffer(512)
-        fptr = ctypes.POINTER(ctypes.c_float)
-        rc = self._native.mxtpu_decode_batch(
-            ctypes.cast(bufs, ctypes.POINTER(ctypes.c_char_p)),
-            ctypes.cast(lens, ctypes.POINTER(ctypes.c_int64)),
-            n, h, w,
-            uv.ctypes.data_as(fptr),
-            mirror.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-            self.mean.ravel().ctypes.data_as(fptr),
-            self.std.ravel().ctypes.data_as(fptr),
-            out.ctypes.data_as(fptr),
-            self._nthreads, errbuf, len(errbuf))
-        if rc != 0:
-            raise MXNetError("native decode failed: %s"
-                             % errbuf.value.decode(errors="replace"))
+        # shared C-ABI seam (also serves gluon.data.DataLoader's batch
+        # path); the staging buffer comes from the native host pool so
+        # steady-state epochs recycle memory instead of malloc'ing per
+        # batch (ref: iter_image_recordio_2.cc fills pinned batches)
+        from .. import _native as _native_mod
+        out = _native_mod.decode_batch(
+            payloads, h, w, uv, mirror, self.mean.ravel(),
+            self.std.ravel(), nthreads=self._nthreads)
+        if out is None:
+            return None  # native lib vanished: thread-pool fallback
         return out, np.stack(labels)
 
     @staticmethod

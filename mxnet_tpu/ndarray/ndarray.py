@@ -64,18 +64,21 @@ def _canon_attr(v):
 _INT32_MAX = 2 ** 31 - 1
 
 
-def check_large_array(shape):
+def check_large_array(shape, num_shards=1):
     """Large-array policy (ref: tests/nightly/test_large_array.py — the
     reference supports >2^32-element NDArrays through int64 indexing).
     This runtime is x32 by default (jax's default; TPU gathers/indexing
     run int32), so element counts beyond 2^31-1 would silently corrupt
     take/Embedding/argmax results. Refuse at construction with the
     workaround spelled out rather than compute wrong numbers. With
-    jax_enable_x64 the gate lifts."""
+    jax_enable_x64 the gate lifts; for sharded arrays the gate applies
+    PER DEVICE SHARD (indexing is shard-local under SPMD), so the
+    sharding workaround the error recommends actually works."""
     n = 1
     for d in shape:
         n *= int(d)
-    if n > _INT32_MAX and not jax.config.jax_enable_x64:
+    if n // max(int(num_shards), 1) > _INT32_MAX \
+            and not jax.config.jax_enable_x64:
         raise MXNetError(
             f"NDArray of {n} elements exceeds the 32-bit index range "
             f"({_INT32_MAX}) of the x32 runtime; indexing ops (take, "
@@ -100,7 +103,9 @@ class NDArray:
             if hasattr(data, "shape"):
                 check_large_array(data.shape)
             data = _materialize(data)
-        check_large_array(data.shape)
+        sharding = getattr(data, "sharding", None)
+        n_dev = len(sharding.device_set) if sharding is not None else 1
+        check_large_array(data.shape, num_shards=n_dev)
         if ctx is not None:
             data = jax.device_put(data, Context(ctx).jax_device)
         self._data = data

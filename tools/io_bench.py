@@ -62,6 +62,33 @@ def time_epochs(rec, hw, batch, threads, epochs=3):
     return n_img / dt
 
 
+def time_dataloader(rec, hw, batch, workers, native, epochs=3):
+    """gluon.data.DataLoader over ImageRecordDataset with the standard
+    vision pipeline — native C++ batch path vs per-item Python."""
+    from mxnet_tpu.gluon.data import DataLoader
+    from mxnet_tpu.gluon.data.vision import (ImageRecordDataset,
+                                             transforms)
+
+    crop = max(hw - 16, hw // 2)
+    ds = ImageRecordDataset(rec).transform_first(transforms.Compose([
+        transforms.CenterCrop(crop), transforms.ToTensor(),
+        transforms.Normalize(0.5, 0.25)]))
+    loader = DataLoader(ds, batch_size=batch, num_workers=workers)
+    if not native:
+        loader._native = None
+    elif loader._native is None:
+        raise RuntimeError("native plan did not compile")
+    n_img = 0
+    for _ in loader:  # warm pools/files
+        pass
+    t0 = time.perf_counter()
+    for _ in range(epochs):
+        for data, _label in loader:
+            n_img += data.shape[0]
+    dt = time.perf_counter() - t0
+    return n_img / dt
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=2048)
@@ -82,6 +109,15 @@ def main():
             print(f"{mode}: {ips:.0f} img/s")
         ratio = results["host_engine"] / results["threads"]
         print(f"host_engine/threads ratio: {ratio:.3f}")
+        for mode, native in (("dataloader_native", True),
+                             ("dataloader_python", False)):
+            ips = time_dataloader(rec, args.hw, args.batch,
+                                  args.threads, native)
+            results[mode] = ips
+            print(f"{mode}: {ips:.0f} img/s")
+        print("dataloader native/python ratio: %.3f"
+              % (results["dataloader_native"]
+                 / results["dataloader_python"]))
 
 
 if __name__ == "__main__":
