@@ -104,8 +104,22 @@ class NDArray:
                 check_large_array(data.shape)
             data = _materialize(data)
         sharding = getattr(data, "sharding", None)
-        n_dev = len(sharding.device_set) if sharding is not None else 1
-        check_large_array(data.shape, num_shards=n_dev)
+        if sharding is not None:
+            # the true shard factor, not the device count: a replicated
+            # array on 8 devices still holds ALL elements per device
+            try:
+                shard_elems = 1
+                for d in sharding.shard_shape(tuple(data.shape)):
+                    shard_elems *= int(d)
+                total = 1
+                for d in data.shape:
+                    total *= int(d)
+                n_shards = max(total // max(shard_elems, 1), 1)
+            except Exception:  # noqa: BLE001 — odd sharding type
+                n_shards = 1
+            check_large_array(data.shape, num_shards=n_shards)
+        else:
+            check_large_array(data.shape)
         if ctx is not None:
             data = jax.device_put(data, Context(ctx).jax_device)
         self._data = data
