@@ -96,12 +96,15 @@ def test_native_path_with_workers_and_flip(rec_path):
     """flip is stochastic: every flipped-pipeline sample must equal the
     unflipped reference sample or its exact width reversal (the crop
     margins here are even, so crop-then-mirror == mirror-then-crop)."""
+    # normalize stays ON: per-channel affine commutes with the mirror,
+    # and flip+normalize together is exactly the kernel combination a
+    # training pipeline runs
     ds = ImageRecordDataset(rec_path).transform_first(
-        _pipeline(normalize=False, flip=True))
+        _pipeline(flip=True))
     loader = DataLoader(ds, batch_size=8, num_workers=2)
     assert loader._native is not None
     ref_ds = ImageRecordDataset(rec_path).transform_first(
-        _pipeline(normalize=False, flip=False))
+        _pipeline(flip=False))
     ref_loader = DataLoader(ref_ds, batch_size=8)
     seen = 0
     for (data, _label), (ref, _rl) in zip(loader, ref_loader):

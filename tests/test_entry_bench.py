@@ -44,3 +44,19 @@ def test_bench_fp32_variant():
     out = fwd(jax.device_put(pvals),
               jnp.zeros((4, 3, 224, 224), jnp.float32))
     assert out.shape == (4, 1000)
+
+
+def test_bench_transformer_section(monkeypatch):
+    """The long-context transformer bench body runs end to end (tiny
+    config via MXTPU_BENCH_TFM) and reports finite tokens/s + MFU."""
+    import bench
+    monkeypatch.setenv("MXTPU_BENCH_TFM", "2,2,256,64")
+    reduce_fn = jax.jit(lambda t: jnp.sum(t.astype(jnp.float32)))
+
+    def sync(o):
+        return float(reduce_fn(o))
+
+    extra = {}
+    tps = bench._bench_transformer(sync, extra, lambda m: None)
+    assert tps > 0 and np.isfinite(tps)
+    assert "transformer_mfu_bf16" in extra

@@ -29,7 +29,9 @@ def main():
     ap.add_argument("--batch", type=int, default=32)
     ap.add_argument("--layout", default="NHWC")
     ap.add_argument("--stem", default="standard")
-    ap.add_argument("--fuse", action="store_true", default=True)
+    ap.add_argument("--fuse", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="--no-fuse audits the unfused baseline")
     ap.add_argument("--dump", help="write HLO text files to this dir")
     args = ap.parse_args()
 

@@ -95,6 +95,8 @@ def main():
     ap.add_argument("--hw", type=int, default=224)
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--json", help="also write results to this path "
+                                   "(machine-readable artifact)")
     args = ap.parse_args()
 
     with tempfile.TemporaryDirectory() as tmp:
@@ -118,6 +120,18 @@ def main():
         print("dataloader native/python ratio: %.3f"
               % (results["dataloader_native"]
                  / results["dataloader_python"]))
+        if args.json:
+            import json
+            payload = {
+                "tool": "io_bench", "n": args.n, "hw": args.hw,
+                "batch": args.batch, "threads": args.threads,
+                "measured_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+                "img_per_s": {k: round(v, 1)
+                              for k, v in results.items()},
+            }
+            with open(args.json, "w") as f:
+                json.dump(payload, f, indent=1)
+            print("artifact:", args.json)
 
 
 if __name__ == "__main__":
