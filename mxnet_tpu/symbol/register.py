@@ -25,15 +25,39 @@ def make_sym_func(op):
 
     def sym_func(*args, name=None, **kwargs):
         inputs = []
+        scalars = []
         for a in args:
             if a is None:
+                # in the tensor region a positional None is an omitted
+                # optional input (pre-existing semantics); once the
+                # scalar region starts it must CONSUME its parameter
+                # slot (bind_positional_attrs skips the value but
+                # advances) — sym.clip(d, None, 1.0) means a_max=1.0
+                if scalars or len(inputs) >= len(op.arg_names):
+                    scalars.append(None)
                 continue
-            if not isinstance(a, Symbol):
+            if isinstance(a, Symbol):
+                if scalars:
+                    raise TypeError(
+                        f"{op.name}: Symbol input after a scalar "
+                        "positional parameter")
+                inputs.append(a)
+            elif isinstance(a, (bool, int, float, str, tuple)) or (
+                    isinstance(a, list)
+                    and not any(isinstance(x, Symbol) for x in a)):
+                scalars.append(a)
+            else:
+                # arrays/NDArrays must not silently become attrs
                 raise TypeError(
-                    f"{op.name}: symbolic call takes Symbol inputs, got "
-                    f"{type(a).__name__}; pass operator parameters as "
-                    "keyword arguments")
-            inputs.append(a)
+                    f"{op.name}: symbolic call takes Symbol inputs, "
+                    f"got {type(a).__name__}; pass operator parameters "
+                    "as scalars/tuples or keyword arguments")
+        if scalars:
+            # positional operator parameters, reference codegen
+            # semantics: sym.clip(data, -1, 1), sym.one_hot(idx, 5) —
+            # same binding rule as the ndarray layer (and the same
+            # signature-order parity test covers both)
+            _reg.bind_positional_attrs(op, scalars, kwargs)
         # every name — explicit too — passes through the active
         # NameManager so mx.name.Prefix prepends uniformly (ref:
         # name.py NameManager.current.get(name, hint))

@@ -93,3 +93,57 @@ def test_positional_binding_end_to_end():
     # topk(data, axis, k)
     tk = mx.nd.topk(mx.nd.array(np.array([[3.0, 1.0, 2.0]])), 1, 2)
     assert tk.asnumpy().shape == (1, 2)
+
+
+def test_symbol_positional_binding():
+    """The symbol layer accepts positional operator parameters exactly
+    like the reference's generated signatures (and the nd layer)."""
+    import numpy as np
+
+    from mxnet_tpu import nd, sym
+
+    d = sym.var("data")
+    x = np.arange(-5, 5, dtype=np.float32).reshape(1, 10)
+
+    def run(s):
+        ex = s.bind(args={"data": nd.array(x)}, grad_req="null")
+        return ex.forward()[0].asnumpy()
+
+    out = run(sym.clip(d, -1.0, 2.0))
+    assert out.min() == -1.0 and out.max() == 2.0
+    tk = run(sym.topk(d, 1, 3, "value"))
+    assert tk.shape == (1, 3)
+    oh = run(sym.one_hot(sym.var("data"), 10))
+    assert oh.shape == (1, 10, 10)
+    tr = run(sym.transpose(d))
+    assert tr.shape == (10, 1)
+    # a Symbol appearing after a scalar is a user error, loudly
+    import pytest
+    with pytest.raises(TypeError, match="after a scalar"):
+        sym.broadcast_add(1.0, d)
+
+
+def test_symbol_positional_edge_cases():
+    import numpy as np
+    import pytest
+
+    from mxnet_tpu import nd, sym
+
+    d = sym.var("data")
+    x = np.arange(-5, 5, dtype=np.float32).reshape(1, 10)
+
+    def run(s):
+        ex = s.bind(args={"data": nd.array(x)}, grad_req="null")
+        return ex.forward()[0].asnumpy()
+
+    # a positional None consumes its parameter slot: a_max binds 1.0,
+    # a_min keeps its default (0.0) — the pre-fix bug bound 1.0 to
+    # a_min and returned all-ones
+    out = run(sym.clip(d, None, 1.0))
+    assert out.max() == 1.0 and out.min() == 0.0
+    assert not np.all(out == 1.0)
+    # arrays must not silently become operator attrs
+    with pytest.raises(TypeError, match="Symbol inputs"):
+        sym.Convolution(d, np.ones((8, 1, 3, 3)), num_filter=8)
+    with pytest.raises(TypeError, match="Symbol inputs"):
+        sym.one_hot(d, nd.array([3.0]))
