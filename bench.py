@@ -315,7 +315,8 @@ def supervise():
     full_attempts = 0
     backoff = 60
     probe_failures = 0
-    emitted_provisional = False
+    emitted_stale = False       # provisional last-good line on stdout
+    emitted_fail_early = False  # explicit failure JSON on stdout
     code_failure = False  # a child ran and produced a bad/error result
     while full_attempts < max_full_attempts:
         if time.monotonic() - t_start > budget:
@@ -326,7 +327,7 @@ def supervise():
             last_err = ("tunnel probe %d failed (wedged backend init?)"
                         % probe_failures)
             _diag(last_err)
-            if prior is not None and not emitted_provisional:
+            if prior is not None and not emitted_stale:
                 # wedge signature on first contact: put the last good
                 # number on stdout NOW so even a driver-side kill during
                 # the long backoff wait leaves a measurement behind; a
@@ -334,7 +335,16 @@ def supervise():
                 if _emit_stale(prior, "provisional: " + last_err,
                                provisional=True):
                     _diag("emitted provisional stale line")
-                    emitted_provisional = True
+                    emitted_stale = True
+            if (not emitted_stale and not emitted_fail_early
+                    and probe_failures >= 3):
+                # no usable fallback tier (no last-good, or one that
+                # fails the metric gate): after three wedge signatures
+                # put the explicit failure JSON on stdout so a
+                # driver-side kill mid-backoff still leaves a parseable
+                # line (a live measurement later supersedes it)
+                _fail_json(last_err)
+                emitted_fail_early = True
             remain = budget - (time.monotonic() - t_start)
             if remain <= 1:
                 break
@@ -401,9 +411,10 @@ def supervise():
         if _emit_stale(prior, last_err):
             _diag("emitting last good measurement (stale)")
             return 1
-    if code_failure or not emitted_provisional:
-        # error JSON printed LAST so the driver sees the real failure
-        # even if a provisional stale line went out earlier
+    if code_failure or not emitted_stale:
+        # error JSON printed LAST (with the latest cause) so the driver
+        # sees the real failure even when a provisional stale line or an
+        # earlier early-failure line went out with an older reason
         _fail_json(last_err)
     return 1
 

@@ -108,3 +108,82 @@ def test_load_rejects_corrupt(tmp_path, monkeypatch):
     p = _patch_last_good(tmp_path, monkeypatch)
     p.write_text("not json")
     assert bench._load_last_good() is None
+
+
+def test_fail_json_prints_metric_line(capsys):
+    bench._fail_json("tunnel wedged")
+    line = capsys.readouterr().out.strip()
+    assert line.startswith("{") and '"metric"' in line
+    assert bench._json_line(line.encode()) == line
+
+
+def _fake_clock(monkeypatch):
+    """Stepping clock + recorded no-op sleeps: supervise() loops run in
+    milliseconds instead of busy-spinning a real wall budget."""
+    t = [0.0]
+
+    def mono():
+        t[0] += 1.0
+        return t[0]
+
+    sleeps = []
+
+    def sleep(s):
+        sleeps.append(s)
+        t[0] += s
+
+    monkeypatch.setattr(bench.time, "monotonic", mono)
+    monkeypatch.setattr(bench.time, "sleep", sleep)
+    return sleeps
+
+
+def test_supervise_emits_failure_line_early_without_last_good(
+        tmp_path, monkeypatch, capsys):
+    """With no fallback tier and a wedged tunnel, the supervisor must
+    put a parseable failure line on stdout after the third failed probe
+    — not only at budget end (a driver-side kill mid-backoff would
+    otherwise capture nothing)."""
+    _patch_last_good(tmp_path, monkeypatch)
+    probes = []
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda **k: (probes.append(1), False)[1])
+    monkeypatch.setenv("MXTPU_BENCH_BUDGET", "500")
+    sleeps = _fake_clock(monkeypatch)
+    rc = bench.supervise()
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert len(probes) >= 3  # the early line needed three signatures
+    assert sleeps[:3] == [60, 120, 240]  # exponential backoff
+    line = bench._json_line(out.encode())
+    assert line is not None and '"error"' in line
+
+
+def test_supervise_emits_provisional_stale_with_last_good(
+        tmp_path, monkeypatch, capsys):
+    _patch_last_good(tmp_path, monkeypatch)
+    bench._save_last_good(FULL)
+    monkeypatch.setattr(bench, "_probe_backend", lambda **k: False)
+    monkeypatch.setenv("MXTPU_BENCH_BUDGET", "500")
+    _fake_clock(monkeypatch)
+    rc = bench.supervise()
+    out = capsys.readouterr().out
+    assert rc == 1
+    line = bench._json_line(out.encode())
+    assert '"stale": true' in line and '"value": 12000.0' in line
+
+
+def test_supervise_early_line_even_with_incompatible_last_good(
+        tmp_path, monkeypatch, capsys):
+    """A last-good file from a different config (metric gate fails)
+    must not suppress the early failure line."""
+    _patch_last_good(tmp_path, monkeypatch)
+    wrong_metric = FULL.replace(bench.METRIC, "resnet50_other_metric")
+    bench._save_last_good(wrong_metric)
+    monkeypatch.setattr(bench, "_probe_backend", lambda **k: False)
+    monkeypatch.setenv("MXTPU_BENCH_BUDGET", "500")
+    _fake_clock(monkeypatch)
+    rc = bench.supervise()
+    out = capsys.readouterr().out
+    assert rc == 1
+    line = bench._json_line(out.encode())
+    assert line is not None and '"error"' in line
