@@ -22,6 +22,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 
 PEAK_TFLOPS = float(os.environ.get("MXTPU_PEAK_TFLOPS", "197"))
+# single source of truth for the per-image FLOP estimate (bench.py:32)
+from bench import RESNET50_GFLOPS  # noqa: E402
 
 
 def _sync_factory():
@@ -56,6 +58,7 @@ def probe_matmul(sync):
     tf = 2 * n ** 3 / dt / 1e12
     print("matmul %dx%d bf16: %.1f TFLOP/s (%.2f of peak)"
           % (n, n, tf, tf / PEAK_TFLOPS))
+    return tf
 
 
 def probe_conv(sync, batch=128):
@@ -73,6 +76,7 @@ def probe_conv(sync, batch=128):
     tf = fl / dt / 1e12
     print("conv3x3 28x28x128 bs%d: %.1f TFLOP/s (%.2f of peak)"
           % (batch, tf, tf / PEAK_TFLOPS))
+    return tf
 
 
 def _pure_resnet50(batch):
@@ -152,10 +156,10 @@ def probe_pure(sync, batch):
     x = jnp.ones((batch, 224, 224, 3), jnp.bfloat16)
     dt = timeit(f, (pvals, x), sync, iters=20)
     ips = batch / dt
-    mfu = ips * 4.1 / (PEAK_TFLOPS * 1e3)
+    mfu = ips * RESNET50_GFLOPS / (PEAK_TFLOPS * 1e3)
     print("pure-jax resnet50 NHWC bs%d: %.0f img/s mfu %.3f"
           % (batch, ips, mfu))
-    return ips
+    return ips, mfu
 
 
 def probe_framework(sync, batch, layout="NHWC", fuse=True):
@@ -169,10 +173,10 @@ def probe_framework(sync, batch, layout="NHWC", fuse=True):
     x = jnp.ones((batch, 3, 224, 224), jnp.bfloat16)
     dt = timeit(f, (pvals, x), sync, iters=20)
     ips = batch / dt
-    mfu = ips * 4.1 / (PEAK_TFLOPS * 1e3)
+    mfu = ips * RESNET50_GFLOPS / (PEAK_TFLOPS * 1e3)
     print("framework resnet50 %s fuse=%s bs%d: %.0f img/s mfu %.3f"
           % (layout, fuse, batch, ips, mfu))
-    return ips
+    return ips, mfu
 
 
 def main():
@@ -180,6 +184,8 @@ def main():
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--batch", type=int, default=128)
     ap.add_argument("--skip-framework", action="store_true")
+    ap.add_argument("--json", help="write results to this path "
+                                   "(machine-readable artifact)")
     args = ap.parse_args()
 
     os.environ.setdefault("MXTPU_COMPILE_CACHE", os.path.join(
@@ -192,13 +198,31 @@ def main():
     print("devices:", jax.devices())
     sync = _sync_factory()
 
-    probe_matmul(sync)
-    probe_conv(sync)
-    probe_pure(sync, args.batch)
+    results = {"backend": jax.default_backend(),
+               "peak_tflops": PEAK_TFLOPS, "batch": args.batch}
+    results["matmul_tflops"] = round(probe_matmul(sync), 2)
+    results["conv_tflops_bs%d" % args.batch] = round(
+        probe_conv(sync, args.batch), 2)
+    ips, mfu = probe_pure(sync, args.batch)
+    results["pure_resnet50_img_s"] = round(ips, 1)
+    results["pure_resnet50_mfu"] = round(mfu, 4)
     if not args.quick:
-        probe_pure(sync, args.batch * 2)
+        ips2, _ = probe_pure(sync, args.batch * 2)
+        results["pure_resnet50_img_s_bs%d" % (args.batch * 2)] = round(
+            ips2, 1)
     if not args.skip_framework:
-        probe_framework(sync, args.batch)
+        fips, fmfu = probe_framework(sync, args.batch)
+        results["framework_resnet50_img_s"] = round(fips, 1)
+        results["framework_resnet50_mfu"] = round(fmfu, 4)
+    if args.json:
+        import json
+        results["measured_at"] = time.strftime("%Y-%m-%d %H:%M:%S")
+        # atomic, like bench._save_last_good: a kill mid-dump must not
+        # leave a truncated artifact
+        with open(args.json + ".tmp", "w") as f:
+            json.dump(results, f, indent=1)
+        os.replace(args.json + ".tmp", args.json)
+        print("artifact:", args.json)
 
 
 if __name__ == "__main__":
