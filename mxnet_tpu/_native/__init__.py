@@ -53,8 +53,34 @@ def load_comm():
     lib.mxtpu_server_poll.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
                                       ctypes.c_int]
     lib.mxtpu_server_set_updater.argtypes = [ctypes.c_void_p]
+    # robustness layer: snapshot/restore, recovery grace, fault seams
+    lib.mxtpu_server_snapshot.restype = ctypes.c_long
+    lib.mxtpu_server_snapshot.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                          ctypes.c_int]
+    lib.mxtpu_server_preload.restype = ctypes.c_int
+    lib.mxtpu_server_preload.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.mxtpu_server_set_recovery_grace.argtypes = [ctypes.c_int]
+    fptr0 = ctypes.POINTER(ctypes.c_float)
+    lib.mxtpu_server_key_write.restype = ctypes.c_int
+    lib.mxtpu_server_key_write.argtypes = [ctypes.c_uint32, fptr0,
+                                           ctypes.c_uint64]
+    lib.mxtpu_server_key_read.restype = ctypes.c_long
+    lib.mxtpu_server_key_read.argtypes = [ctypes.c_uint32, fptr0,
+                                          ctypes.c_uint64]
+    lib.mxtpu_fault_client_add.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_longlong, ctypes.c_longlong,
+        ctypes.c_longlong]
+    lib.mxtpu_fault_server_add.argtypes = lib.mxtpu_fault_client_add.argtypes
+    lib.mxtpu_fault_clear.argtypes = []
     lib.mxtpu_client_connect.restype = ctypes.c_void_p
     lib.mxtpu_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.mxtpu_client_connect_as.restype = ctypes.c_void_p
+    lib.mxtpu_client_connect_as.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                            ctypes.c_int]
+    lib.mxtpu_client_get_next_req_id.restype = ctypes.c_uint64
+    lib.mxtpu_client_get_next_req_id.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_client_set_next_req_id.argtypes = [ctypes.c_void_p,
+                                                 ctypes.c_uint64]
     lib.mxtpu_client_rank.argtypes = [ctypes.c_void_p]
     lib.mxtpu_client_rank.restype = ctypes.c_int
     lib.mxtpu_client_num_workers.argtypes = [ctypes.c_void_p]

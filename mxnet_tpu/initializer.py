@@ -140,6 +140,11 @@ class Normal(Initializer):
 class Orthogonal(Initializer):
     def __init__(self, scale=1.414, rand_type="uniform"):
         super().__init__(scale=scale, rand_type=rand_type)
+        if rand_type not in ("uniform", "normal"):
+            # same unvalidated-enum bug class as lr_scheduler warmup_mode:
+            # a typo silently fell through to the normal branch
+            raise ValueError(f"rand_type must be 'uniform' or 'normal', "
+                             f"got {rand_type!r}")
         self.scale = scale
         self.rand_type = rand_type
 
@@ -160,6 +165,12 @@ class Xavier(Initializer):
     def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
         super().__init__(rnd_type=rnd_type, factor_type=factor_type,
                          magnitude=magnitude)
+        if rnd_type not in ("uniform", "gaussian"):
+            raise ValueError(f"rnd_type must be 'uniform' or 'gaussian', "
+                             f"got {rnd_type!r}")
+        if factor_type not in ("avg", "in", "out"):
+            raise ValueError(f"factor_type must be 'avg', 'in' or 'out', "
+                             f"got {factor_type!r}")
         self.rnd_type = rnd_type
         self.factor_type = factor_type
         self.magnitude = float(magnitude)

@@ -8,6 +8,20 @@ tools/launch.py + dmlc-core tracker env): ``DMLC_ROLE`` is ``worker`` /
 equivalent): rank assignment at connect, BSP merge rounds, barriers,
 and an optional server-side optimizer shipped as a pickled blob
 (ref: python/mxnet/kvstore.py:450-495 set_optimizer).
+
+Recovery layer (ref: kvstore_dist.h:118-123 dead-node detection +
+ps-lite resend-on-timeout): every request carries a monotonically
+increasing id, so when a request dies with the connection the worker
+reconnects (reclaiming its rank), pins the failed id, and RESENDS —
+idempotent at the server. Retries run under exponential backoff with
+jitter until ``MXNET_KVSTORE_RECOVERY_BUDGET_MS`` is spent, then raise
+one clean ``MXNetError``. With the budget unset (0) the transport keeps
+its legacy fail-fast behavior. The server side snapshots its whole
+state on SIGTERM and restores it on start (``MXNET_KVSTORE_SNAPSHOT_
+PATH``), so a restarted server — ``tools/launch.py
+--restart-policy=server`` — rejoins with state intact. Deterministic
+fault injection for all of this lives in ``MXNET_KVSTORE_FAULT_PLAN``
+(kvstore/fault.py).
 """
 from __future__ import annotations
 
@@ -26,6 +40,7 @@ from .. import _native
 # import-time entry) — a lazy `from .. import profiler` inside the
 # callback would deadlock on the package's import lock
 from .. import profiler
+from . import fault as fault_mod
 
 CMD_SYNC_MODE = 1
 CMD_STOP = 2
@@ -34,6 +49,10 @@ CMD_SERVER_PROFILER = 3
 # optimizers; pickles start with b"\x80", so this prefix is unambiguous
 PROF_MAGIC = b"PROF\x00"
 CMD_SET_OPTIMIZER = 4
+# exit code of a SIGTERM'd server that snapshotted its state: tells the
+# launcher "restartable death with state on disk" apart from a clean
+# stop (0, never restarted) and a crash (anything else)
+SERVER_RESTART_EXITCODE = 17
 
 
 def role():
@@ -66,24 +85,65 @@ def request_timeout_ms():
                               "120000"))
 
 
+def recovery_budget_ms():
+    """Total wall-clock the client may spend recovering ONE request
+    (reconnect + resend loop). 0 disables recovery: the legacy
+    fail-fast transport."""
+    return int(os.environ.get("MXNET_KVSTORE_RECOVERY_BUDGET_MS", "0"))
+
+
+def recovery_backoff_ms():
+    return int(os.environ.get("MXNET_KVSTORE_RECOVERY_BACKOFF_MS", "50"))
+
+
+def recovery_backoff_max_ms():
+    return int(os.environ.get("MXNET_KVSTORE_RECOVERY_BACKOFF_MAX_MS",
+                              "2000"))
+
+
+_client_faults_installed = False
+
+
+def _ensure_client_faults(lib):
+    """Install the worker-side rules of MXNET_KVSTORE_FAULT_PLAN into
+    the native client seams, once per process."""
+    global _client_faults_installed
+    if _client_faults_installed:
+        return
+    _client_faults_installed = True
+    rules = fault_mod.plan_from_env()
+    if rules:
+        fault_mod.install_client_rules(lib, rules)
+
+
 class WorkerConnection:
-    """One worker's connection to the parameter server."""
+    """One worker's connection to the parameter server, with transparent
+    reconnect/resend recovery when a budget is armed."""
 
     def __init__(self, host=None, port=None, timeout=30.0):
         self._lib = _native.load_comm()
         if host is None:
             host, port = server_address()
-        deadline = time.monotonic() + timeout
+        self._host, self._port = host, int(port)
+        _ensure_client_faults(self._lib)
+        self._budget_ms = recovery_budget_ms()
+        self.telemetry = fault_mod.RecoveryTelemetry()
+        t0 = time.monotonic()
+        deadline = t0 + timeout
         handle = None
         while time.monotonic() < deadline:
             handle = self._lib.mxtpu_client_connect(
-                host.encode(), int(port))
+                host.encode(), self._port)
             if handle:
                 break
             time.sleep(0.1)
         if not handle:
+            elapsed = time.monotonic() - t0
             raise MXNetError(
-                f"could not reach kvstore server at {host}:{port}")
+                f"kvstore rendezvous with server at {host}:{self._port} "
+                f"failed: no connection after {elapsed:.1f}s (deadline "
+                f"{timeout:.0f}s) — server process not up, wrong "
+                "DMLC_PS_ROOT_URI/PORT, or the server died during startup")
         self._h = ctypes.c_void_p(handle)
         self.rank = self._lib.mxtpu_client_rank(self._h)
         self.num_workers = self._lib.mxtpu_client_num_workers(self._h)
@@ -104,25 +164,115 @@ class WorkerConnection:
                     "died mid-round, or the command was refused)")
         return f"rc={rc}"
 
+    # -- recovery core -----------------------------------------------------
+    def _call(self, op, invoke):
+        """Run ``invoke(handle) -> rc``; on a transport failure (rc -1)
+        with a recovery budget armed, reconnect with the reclaimed rank,
+        pin the failed request id, and resend until the budget is spent.
+        Non-transport errors (-2 size, -3 rejected) pass through — the
+        server answered, so resending cannot help.
+
+        Not safe for CONCURRENT calls on one connection: the resend id
+        is derived from the connection's request counter, which assumes
+        requests are serialized per connection (ShardedConnection issues
+        at most one in-flight request per underlying connection)."""
+        rc = invoke(self._h)
+        if rc != -1 or self._budget_ms <= 0:
+            return rc
+        failed_id = int(self._lib.mxtpu_client_get_next_req_id(self._h)) - 1
+        tel = self.telemetry
+        tel.last_op = op
+        tel.last_req_id = failed_id
+        reconnects_before = tel.reconnects
+        sched = fault_mod.BackoffSchedule(
+            self._budget_ms, base_ms=recovery_backoff_ms(),
+            max_ms=recovery_backoff_max_ms())
+        last_err = "request timed out or connection lost"
+        while True:
+            wait = sched.next_wait()
+            if wait is None:
+                break
+            time.sleep(wait)
+            tel.attempts += 1
+            newh = self._lib.mxtpu_client_connect_as(
+                self._host.encode(), self._port, self.rank)
+            if not newh:
+                last_err = ("reconnect refused (server down or "
+                            "restarting)")
+                continue
+            old, self._h = self._h, ctypes.c_void_p(newh)
+            # the resend must carry the SAME id the failed request
+            # consumed — that is what makes it idempotent at the server
+            self._lib.mxtpu_client_set_next_req_id(self._h, failed_id)
+            # clamp the resend's deadline to the REMAINING budget: the
+            # budget bounds the whole recovery, and one resend hanging
+            # for the full request timeout would blow through it
+            self._lib.mxtpu_client_set_timeout(
+                self._h, max(1, min(request_timeout_ms(),
+                                    int(sched.remaining_ms()))))
+            self._lib.mxtpu_client_close(old)
+            tel.reconnects += 1
+            rc = invoke(self._h)
+            if rc != -1:
+                # recovered: lift the budget clamp — the next request is
+                # a normal one and may legitimately park on the server
+                # (BSP straggler wait) for the full request timeout
+                self._lib.mxtpu_client_set_timeout(self._h,
+                                                   request_timeout_ms())
+                tel.recovered += 1
+                tel.backoff_wait_ms += sched.total_wait_ms
+                self._note(op, failed_id, "recovered", sched,
+                           tel.reconnects - reconnects_before)
+                return rc
+            last_err = "resent request timed out or connection lost again"
+        tel.exhausted += 1
+        tel.backoff_wait_ms += sched.total_wait_ms
+        tel.last_error = last_err
+        self._note(op, failed_id, "exhausted", sched,
+                   tel.reconnects - reconnects_before, last_err)
+        raise MXNetError(
+            f"kvstore recovery budget exhausted for {op} (request id "
+            f"{failed_id}) against {self._host}:{self._port}: "
+            f"{sched.attempts} attempts, {tel.reconnects} reconnects, "
+            f"{sched.elapsed_ms():.0f}ms elapsed of "
+            f"{self._budget_ms}ms budget; last error: {last_err}. "
+            "Raise MXNET_KVSTORE_RECOVERY_BUDGET_MS or restart the "
+            "server (tools/launch.py --restart-policy=server)")
+
+    def _note(self, op, req_id, outcome, sched, reconnects, error=""):
+        """One per-incident telemetry record (values for THIS recovery,
+        not cumulative — the profiler summary sums across incidents)."""
+        self.telemetry.events.append((op, req_id, outcome))
+        profiler.note_recovery({
+            "op": op, "req_id": req_id, "outcome": outcome,
+            "rank": self.rank, "attempts": sched.attempts,
+            "reconnects": reconnects,
+            "backoff_wait_ms": round(sched.total_wait_ms, 3),
+            "elapsed_ms": round(sched.elapsed_ms(), 1),
+            "budget_ms": self._budget_ms, "error": error,
+        })
+
+    # -- data plane --------------------------------------------------------
     def init(self, key, value):
         arr = np.ascontiguousarray(value, dtype=np.float32)
-        rc = self._lib.mxtpu_client_init(self._h, key, self._fptr(arr),
-                                         arr.size)
+        rc = self._call("init", lambda h: self._lib.mxtpu_client_init(
+            h, key, self._fptr(arr), arr.size))
         if rc != 0:
             raise MXNetError(f"dist init failed for key {key}: "
                              f"{self._explain(rc)}")
 
     def push(self, key, value):
         arr = np.ascontiguousarray(value, dtype=np.float32)
-        rc = self._lib.mxtpu_client_push(self._h, key, self._fptr(arr),
-                                         arr.size)
+        rc = self._call("push", lambda h: self._lib.mxtpu_client_push(
+            h, key, self._fptr(arr), arr.size))
         if rc != 0:
             raise MXNetError(f"dist push failed for key {key}: "
                              f"{self._explain(rc)}")
 
     def push_compressed(self, key, payload):
-        rc = self._lib.mxtpu_client_push_2bit(self._h, key, payload,
-                                              len(payload))
+        rc = self._call(
+            "push_2bit", lambda h: self._lib.mxtpu_client_push_2bit(
+                h, key, payload, len(payload)))
         if rc != 0:
             raise MXNetError(f"dist compressed push failed for key "
                              f"{key}: {self._explain(rc)}")
@@ -130,7 +280,8 @@ class WorkerConnection:
     def pull(self, key, shape):
         n = int(np.prod(shape)) if shape else 1
         out = np.empty(n, dtype=np.float32)
-        got = self._lib.mxtpu_client_pull(self._h, key, self._fptr(out), n)
+        got = self._call("pull", lambda h: self._lib.mxtpu_client_pull(
+            h, key, self._fptr(out), n))
         if got < 0:
             raise MXNetError(f"dist pull failed for key {key}: "
                              f"{self._explain(got)}")
@@ -146,10 +297,11 @@ class WorkerConnection:
         is accepted for signature parity with ShardedConnection."""
         ids = np.ascontiguousarray(row_ids, dtype=np.int32)
         out = np.empty((ids.size, int(row_len)), np.float32)
-        got = self._lib.mxtpu_client_pull_rows(
-            self._h, key,
-            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            ids.size, int(row_len), self._fptr(out))
+        got = self._call(
+            "pull_rows", lambda h: self._lib.mxtpu_client_pull_rows(
+                h, key,
+                ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                ids.size, int(row_len), self._fptr(out)))
         if got < 0:
             raise MXNetError(f"dist pull_rows failed for key {key}: "
                              f"{self._explain(got)}")
@@ -159,12 +311,14 @@ class WorkerConnection:
         return out
 
     def barrier(self):
-        rc = self._lib.mxtpu_client_barrier(self._h)
+        rc = self._call("barrier",
+                        lambda h: self._lib.mxtpu_client_barrier(h))
         if rc != 0:
             raise MXNetError(f"dist barrier failed: {self._explain(rc)}")
 
     def command(self, cmd, body=b""):
-        rc = self._lib.mxtpu_client_command(self._h, cmd, body, len(body))
+        rc = self._call("command", lambda h: self._lib.mxtpu_client_command(
+            h, cmd, body, len(body)))
         if rc != 0:
             raise MXNetError(f"dist command {cmd} failed: "
                              f"{self._explain(rc)}")
@@ -202,6 +356,9 @@ class ShardedConnection:
 
     Derived slice keys live at 1_000_000 + key * 64 + slice; user keys
     must stay below 1e6 (the reference packs keys similarly).
+
+    Recovery composes per shard: each WorkerConnection reconnects and
+    resends against its own server independently.
     """
 
     _SHARD_BASE = 1_000_000
@@ -225,6 +382,10 @@ class ShardedConnection:
     @property
     def num_servers(self):
         return len(self._conns)
+
+    @property
+    def telemetry(self):
+        return [c.telemetry for c in self._conns]
 
     def _srv(self, key):
         return self._conns[key % len(self._conns)]
@@ -370,25 +531,168 @@ def _apply_profiler_directive(body):
                           profiler._now_us(), 0)
 
 
+def snapshot_path():
+    return os.environ.get("MXNET_KVSTORE_SNAPSHOT_PATH", "")
+
+
+def _write_snapshot(lib, path, optimizer_blob):
+    """Serialize the whole native server state (committed stores,
+    in-flight merges, idempotency watermarks) plus the optimizer blob
+    to ``path``, FREEZING the server: after this no mutation can be
+    acked, so nothing the snapshot missed is ever acknowledged-then-
+    lost. Returns True on success."""
+    cap = max(int(lib.mxtpu_server_snapshot(None, 0, 0)), 0) + (1 << 16)
+    for _ in range(5):
+        buf = ctypes.create_string_buffer(cap)
+        got = int(lib.mxtpu_server_snapshot(buf, cap, 1))
+        if got < 0:
+            return False
+        if got <= cap:
+            blob = {"version": 1, "native": buf.raw[:got],
+                    "optimizer_blob": optimizer_blob,
+                    "saved_at": time.time()}
+            tmp = path + ".tmp"
+            try:
+                with open(tmp, "wb") as f:
+                    pickle.dump(blob, f)
+                os.replace(tmp, path)
+            except OSError:
+                # disk full / directory gone: the caller is a SIGTERM
+                # handler — it must still reach its restartable exit,
+                # not die on an uncaught traceback
+                return False
+            return True
+        cap = got + (1 << 16)  # state grew between size query and copy
+    return False
+
+
+def _read_snapshot(path):
+    try:
+        with open(path, "rb") as f:
+            snap = pickle.load(f)
+        if isinstance(snap, dict) and snap.get("version") == 1:
+            return snap
+    except (OSError, ValueError, pickle.UnpicklingError, EOFError):
+        pass
+    return None
+
+
 def run_server(port=None, num_workers=None, poll_ms=200):
     """Server process main loop (ref: python/mxnet/kvstore_server.py).
 
     Starts the native transport, then waits for control events: a
     pickled optimizer installs a Python updater applied per merge round;
-    a stop command ends the loop.
+    a stop command ends the loop. With MXNET_KVSTORE_SNAPSHOT_PATH set,
+    SIGTERM snapshots the whole server state (frozen atomically) before
+    exiting, and a start finding that snapshot restores it BEFORE
+    listening — the restart-with-state half of the recovery protocol.
     """
+    import signal
+    import sys
+
     lib = _native.load_comm()
     if port is None:
         _, port = server_address()
         port += int(os.environ.get("DMLC_SERVER_ID", "0"))
     if num_workers is None:
         num_workers = num_workers_env()
+
+    rules = fault_mod.plan_from_env()
+    if rules:
+        fault_mod.install_server_rules(lib, rules)
+
+    snap_file = snapshot_path()
+    restored = None
+    if snap_file and os.path.exists(snap_file):
+        restored = _read_snapshot(snap_file)
+        if restored is not None:
+            native = restored.get("native", b"")
+            if not native or lib.mxtpu_server_preload(
+                    native, len(native)) != 0:
+                print("kvstore server: snapshot %s is malformed — "
+                      "starting empty" % snap_file, file=sys.stderr,
+                      flush=True)
+                restored = None
+            else:
+                print("kvstore server: restored %d-byte snapshot from %s"
+                      % (len(native), snap_file), file=sys.stderr,
+                      flush=True)
+
+    states = {}
+    # the optimizer blob travels in the snapshot so a restarted server
+    # keeps applying updates without the workers resending set_optimizer
+    current = {"optimizer_blob": None}
+
+    def install_updater(blob):
+        optimizer = pickle.loads(blob)
+        current["optimizer_blob"] = blob
+
+        def updater(key, recved, stored, _opt=optimizer, _states=states):
+            from ..ndarray import NDArray
+            import jax.numpy as jnp
+            with profiler.timed_region("server_update:key%d" % key,
+                                       "kvstore"):
+                w = NDArray(jnp.asarray(stored))
+                g = NDArray(jnp.asarray(recved))
+                if key not in _states:
+                    _states[key] = _opt.create_state(key, w)
+                _opt.update(key, w, g, _states[key])
+                stored[:] = np.asarray(w._data, dtype=np.float32)
+
+        _native.set_server_updater(updater)
+
+    if snap_file:
+        # installed BEFORE the listen socket opens and BEFORE the
+        # consumed snapshot is unlinked: a SIGTERM at any point either
+        # finds the old file still on disk (pre-start snapshots fail
+        # cleanly and exit restartable) or snapshots the live state —
+        # back-to-back preemptions can never destroy the only copy
+        def _snapshot_and_exit(signum, frame):
+            ok = _write_snapshot(lib, snap_file,
+                                 current["optimizer_blob"])
+            print("kvstore server: SIGTERM — snapshot %s: %s"
+                  % (snap_file, "saved" if ok else "FAILED"),
+                  file=sys.stderr, flush=True)
+            # frozen either way; nothing more this process can do
+            os._exit(SERVER_RESTART_EXITCODE)
+
+        signal.signal(signal.SIGTERM, _snapshot_and_exit)
+
+    # reconnect tolerance: default the grace to the workers' recovery
+    # budget (the launcher forwards the whole env); a restored server
+    # always gets a floor so reconnecting workers are never declared
+    # dead before they can dial back in. Both the grace and a restored
+    # optimizer are STAGED before start — the native side adopts them
+    # pre-accept, so no worker resend racing the restart can degrade
+    # the job or complete a merge round without the optimizer.
+    grace = os.environ.get("MXNET_KVSTORE_RECOVERY_GRACE_MS")
+    if grace is None:
+        grace = os.environ.get("MXNET_KVSTORE_RECOVERY_BUDGET_MS", "0")
+    grace = int(grace)
+    if restored is not None and grace <= 0:
+        grace = 30000
+    if grace > 0:
+        lib.mxtpu_server_set_recovery_grace(grace)
+
+    if restored is not None and restored.get("optimizer_blob"):
+        # NOTE: optimizer STATE (momentum etc.) restarts empty — only
+        # stateless server optimizers keep exact trajectories across a
+        # restart (docs/robustness.md documents the limitation)
+        install_updater(restored["optimizer_blob"])
+
     rc = lib.mxtpu_server_start(int(port), int(num_workers))
     if rc != 0:
         raise MXNetError(f"kvstore server failed to start (rc={rc})")
 
+    if restored is not None:
+        # consumed — only now that the restored server is serving (a
+        # LATER restart must snapshot fresh state, not resurrect this)
+        try:
+            os.unlink(snap_file)
+        except OSError:
+            pass
+
     buf = ctypes.create_string_buffer(64 << 20)
-    states = {}
     while True:
         got = lib.mxtpu_server_poll(buf, len(buf), poll_ms)
         if got < 0:
@@ -398,19 +702,4 @@ def run_server(port=None, num_workers=None, poll_ms=200):
             if blob.startswith(PROF_MAGIC):
                 _apply_profiler_directive(blob[len(PROF_MAGIC):])
                 continue
-            optimizer = pickle.loads(blob)
-
-            def updater(key, recved, stored, _opt=optimizer,
-                        _states=states):
-                from ..ndarray import NDArray
-                import jax.numpy as jnp
-                with profiler.timed_region("server_update:key%d" % key,
-                                           "kvstore"):
-                    w = NDArray(jnp.asarray(stored))
-                    g = NDArray(jnp.asarray(recved))
-                    if key not in _states:
-                        _states[key] = _opt.create_state(key, w)
-                    _opt.update(key, w, g, _states[key])
-                    stored[:] = np.asarray(w._data, dtype=np.float32)
-
-            _native.set_server_updater(updater)
+            install_updater(blob)

@@ -1,0 +1,272 @@
+"""Fault-injection plans and the recovery backoff schedule.
+
+The deterministic-fault half of the KVStore robustness layer: a plan
+string in ``MXNET_KVSTORE_FAULT_PLAN`` describes WHICH faults to
+provoke at WHICH protocol points, e.g.::
+
+    drop_conn@round=3;delay_ms=500@key=0;kill_server@round=5
+
+Each ``;``-separated directive is ``kind[=arg]`` followed by
+``@cond=val`` conditions. Kinds:
+
+``drop_conn``
+    (client seam) close the connection instead of sending the matching
+    request. Without ``round=`` it fires on EVERY match — a permanent
+    fault; with ``round=N`` it fires once, at the Nth matching request.
+``delay_ms=<ms>``
+    (client or server seam) sleep before the matching request/response.
+``trunc_frame``
+    (client seam) send a torn frame — full header, half the payload —
+    then drop the connection.
+``kill_server``
+    (server seam) raise SIGTERM in the server process when a key
+    completes its Nth merge round (``round=N``; pin one key with
+    ``key=K``, else the first key to reach round N fires it — with
+    uniform BSP pushes every key's count IS the BSP round number, so
+    this is model-size independent): the graceful-death path
+    (run_server's handler snapshots state and exits, tools/launch.py
+    ``--restart-policy=server`` restarts it).
+``die_server``
+    (server seam) ``_exit(86)`` at the same per-key round point —
+    abrupt death, no snapshot.
+``reject_accept=<count>``
+    (server accept seam) close the next ``count`` accepted connections
+    before rendezvous (exercises connect retry).
+
+Conditions: ``round=N`` (Nth distinct matching request, counted PER
+RANK so interleaving across workers cannot move the firing point, and
+a resend of the same request never re-advances the count; for
+kill/die rules: a key's Nth completed merge round), ``key=K``,
+``op=<init|push|pull|pull_rows|barrier|command>``, ``rank=R`` (only
+workers with DMLC_WORKER_ID == R install the rule), ``server=S``
+(only server S installs it). A ``round=``-conditioned client rule defaults to
+``op=push`` — "round" means a BSP round, and the client opens one with
+its push. Unknown kinds or conditions raise ``MXNetError`` — a typo'd
+plan silently injecting nothing would be worse than no plan.
+
+The recovery half lives in :class:`BackoffSchedule` (exponential
+backoff with deterministic-seedable jitter under a total budget — the
+client-side retry clock, unit-testable on a fake clock) and
+:class:`RecoveryTelemetry` (what happened, surfaced through
+profiler.py so the bench supervisor can report WHY a run degraded).
+"""
+from __future__ import annotations
+
+import os
+import random
+import time
+from dataclasses import dataclass, field
+
+from ..base import MXNetError
+
+# mirror of the comm.cc constants (kFault*)
+KIND_CODES = {
+    "drop_conn": 1,
+    "delay_ms": 2,
+    "trunc_frame": 3,
+    "kill_server": 4,
+    "reject_accept": 5,
+    "die_server": 6,
+}
+SERVER_KINDS = ("kill_server", "die_server", "reject_accept")
+# wire op codes (comm.cc kInit..kPullRows)
+OP_CODES = {
+    "init": 1,
+    "push": 2,
+    "pull": 3,
+    "barrier": 4,
+    "command": 5,
+    "push_2bit": 6,
+    "pull_rows": 7,
+}
+_CONDS = ("round", "key", "op", "rank", "server")
+
+
+@dataclass
+class FaultRule:
+    kind: str
+    arg: int = 0            # delay ms / reject count
+    round: int | None = None
+    key: int | None = None
+    op: str | None = None
+    rank: int | None = None
+    server: int | None = None
+
+    @property
+    def is_server_side(self) -> bool:
+        return self.kind in SERVER_KINDS or (
+            self.kind == "delay_ms" and self.server is not None)
+
+
+def parse_fault_plan(plan: str) -> list[FaultRule]:
+    """Parse a ``MXNET_KVSTORE_FAULT_PLAN`` string into FaultRules.
+
+    Raises MXNetError on unknown kinds/conditions or malformed values —
+    fault plans exist to make tests deterministic, so a bad plan must
+    fail loudly, never silently inject nothing.
+    """
+    rules = []
+    for directive in filter(None, (d.strip() for d in plan.split(";"))):
+        head, *conds = directive.split("@")
+        kind, _, argtxt = head.partition("=")
+        kind = kind.strip()
+        if kind not in KIND_CODES:
+            raise MXNetError(
+                f"unknown fault kind {kind!r} in MXNET_KVSTORE_FAULT_PLAN "
+                f"directive {directive!r} (known: {sorted(KIND_CODES)})")
+        rule = FaultRule(kind=kind)
+        if argtxt:
+            try:
+                rule.arg = int(argtxt)
+            except ValueError:
+                raise MXNetError(
+                    f"fault {directive!r}: argument {argtxt!r} is not an "
+                    "integer") from None
+        elif kind == "delay_ms":
+            raise MXNetError(
+                f"fault {directive!r}: delay_ms needs a value, e.g. "
+                "delay_ms=500")
+        elif kind == "reject_accept":
+            rule.arg = 1
+        for cond in conds:
+            name, eq, val = cond.partition("=")
+            name = name.strip()
+            if name not in _CONDS or not eq:
+                raise MXNetError(
+                    f"unknown fault condition {cond!r} in {directive!r} "
+                    f"(known: {_CONDS})")
+            if name == "op":
+                if val not in OP_CODES:
+                    raise MXNetError(
+                        f"fault {directive!r}: unknown op {val!r} "
+                        f"(known: {sorted(OP_CODES)})")
+                rule.op = val
+            else:
+                try:
+                    setattr(rule, name, int(val))
+                except ValueError:
+                    raise MXNetError(
+                        f"fault {directive!r}: condition {name}={val!r} "
+                        "is not an integer") from None
+        if rule.kind in ("kill_server", "die_server") and rule.round is None:
+            raise MXNetError(
+                f"fault {directive!r}: {rule.kind} needs round=N (the "
+                "merge round to die at)")
+        if (rule.round is not None and rule.op is None
+                and not rule.is_server_side):
+            # "round" on a client rule means a BSP round, which the
+            # client opens with its push
+            rule.op = "push"
+        rules.append(rule)
+    return rules
+
+
+def plan_from_env() -> list[FaultRule]:
+    return parse_fault_plan(os.environ.get("MXNET_KVSTORE_FAULT_PLAN", ""))
+
+
+def install_client_rules(lib, rules, worker_rank=None):
+    """Program the native client seams with the worker-side rules.
+
+    ``worker_rank`` filters ``rank=``-conditioned rules (taken from
+    DMLC_WORKER_ID when None). Returns how many rules were installed.
+    """
+    if worker_rank is None:
+        worker_rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+    n = 0
+    for r in rules:
+        if r.is_server_side:
+            continue
+        if r.rank is not None and r.rank != worker_rank:
+            continue
+        lib.mxtpu_fault_client_add(
+            KIND_CODES[r.kind], OP_CODES.get(r.op, 0) if r.op else 0,
+            r.key if r.key is not None else -1,
+            r.round if r.round is not None else -1, r.arg)
+        n += 1
+    return n
+
+
+def install_server_rules(lib, rules, server_id=None):
+    """Program the native server seams (kill/die/reject/delay rules)."""
+    if server_id is None:
+        server_id = int(os.environ.get("DMLC_SERVER_ID", "0"))
+    n = 0
+    for r in rules:
+        if not r.is_server_side:
+            continue
+        if r.server is not None and r.server != server_id:
+            continue
+        lib.mxtpu_fault_server_add(
+            KIND_CODES[r.kind], OP_CODES.get(r.op, 0) if r.op else 0,
+            r.key if r.key is not None else -1,
+            r.round if r.round is not None else -1, r.arg)
+        n += 1
+    return n
+
+
+class BackoffSchedule:
+    """Exponential backoff with jitter under a total recovery budget.
+
+    The client-side retry clock: ``next_wait()`` returns how long to
+    sleep before the next reconnect attempt (None once the budget is
+    exhausted), growing ``base_ms * 2^attempt`` capped at ``max_ms``,
+    jittered by ±``jitter`` fraction so N workers retrying the same
+    dead server don't stampede its restart in lockstep. ``clock`` and
+    ``rng`` are injectable for tests (a fake clock makes the whole
+    schedule assertable without sleeping).
+    """
+
+    def __init__(self, budget_ms, base_ms=50, max_ms=2000, jitter=0.25,
+                 clock=time.monotonic, rng=None):
+        if budget_ms <= 0:
+            raise MXNetError("BackoffSchedule needs a positive budget")
+        self.budget_ms = float(budget_ms)
+        self.base_ms = float(base_ms)
+        self.max_ms = float(max_ms)
+        self.jitter = float(jitter)
+        self._clock = clock
+        self._rng = rng if rng is not None else random.Random()
+        self._t0 = clock()
+        self.attempts = 0
+        self.total_wait_ms = 0.0
+
+    def elapsed_ms(self):
+        return (self._clock() - self._t0) * 1000.0
+
+    def remaining_ms(self):
+        return self.budget_ms - self.elapsed_ms()
+
+    def exhausted(self):
+        return self.remaining_ms() <= 0
+
+    def next_wait(self):
+        """Seconds to sleep before the next attempt, or None when the
+        budget is spent. Waits never overshoot the budget: the last one
+        is clipped to the remaining window."""
+        remaining = self.remaining_ms()
+        if remaining <= 0:
+            return None
+        raw = min(self.base_ms * (2.0 ** self.attempts), self.max_ms)
+        jit = 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+        wait_ms = min(raw * jit, remaining)
+        self.attempts += 1
+        self.total_wait_ms += wait_ms
+        return wait_ms / 1000.0
+
+
+@dataclass
+class RecoveryTelemetry:
+    """What the recovery protocol did — the structured answer to "why
+    did this distributed run degrade". Recorded into the profiler
+    stream (category ``kvstore_recovery``) and kept on the connection
+    for direct inspection."""
+    attempts: int = 0            # resend attempts (incl. the final one)
+    reconnects: int = 0          # successful re-rendezvous count
+    backoff_wait_ms: float = 0.0
+    recovered: int = 0           # requests that eventually succeeded
+    exhausted: int = 0           # requests that burned the whole budget
+    last_op: str = ""
+    last_req_id: int = 0         # round at failure (request watermark)
+    last_error: str = ""
+    events: list = field(default_factory=list)  # (op, req_id, outcome)

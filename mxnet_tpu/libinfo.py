@@ -44,6 +44,27 @@ _ENV_VARS = {
     "MXNET_KVSTORE_REQUEST_TIMEOUT_MS": (
         "client-side dist request timeout; a dead server fails the job "
         "instead of hanging it (kvstore/dist.py)"),
+    "MXNET_KVSTORE_RECOVERY_BUDGET_MS": (
+        "total wall-clock a worker may spend recovering one failed dist "
+        "request (reconnect + idempotent resend loop); 0 = legacy "
+        "fail-fast (kvstore/dist.py, docs/robustness.md)"),
+    "MXNET_KVSTORE_RECOVERY_BACKOFF_MS": (
+        "initial reconnect backoff, doubled per attempt with ±25% "
+        "jitter (default 50; kvstore/fault.py BackoffSchedule)"),
+    "MXNET_KVSTORE_RECOVERY_BACKOFF_MAX_MS": (
+        "backoff growth cap (default 2000; kvstore/fault.py)"),
+    "MXNET_KVSTORE_RECOVERY_GRACE_MS": (
+        "server-side: how long a missing worker may stay gone before "
+        "the job degrades; defaults to the recovery budget "
+        "(kvstore/dist.py run_server)"),
+    "MXNET_KVSTORE_FAULT_PLAN": (
+        "deterministic fault-injection plan, e.g. "
+        "drop_conn@round=3;kill_server@round=5 "
+        "(kvstore/fault.py, docs/robustness.md)"),
+    "MXNET_KVSTORE_SNAPSHOT_PATH": (
+        "server-side: SIGTERM snapshots the whole server state here and "
+        "a restart restores it; set automatically by tools/launch.py "
+        "--restart-policy=server (kvstore/dist.py run_server)"),
     "DMLC_ROLE": "worker|server — set per process by tools/launch.py",
     "DMLC_PS_ROOT_URI": "rendezvous host (launch.py tracker contract)",
     "DMLC_PS_ROOT_PORT": "rendezvous port; with -s 0 it is the "

@@ -11,6 +11,13 @@ class LRScheduler:
         self.warmup_steps = warmup_steps
         self.warmup_begin_lr = warmup_begin_lr
         self.warmup_final_lr = base_lr
+        if warmup_mode not in ("linear", "constant"):
+            # the reference validates the same two modes (ref:
+            # python/mxnet/lr_scheduler.py:44); anything else silently
+            # becoming a quadratic ramp drifted every warmup
+            raise ValueError(
+                f"warmup_mode must be 'linear' or 'constant', got "
+                f"{warmup_mode!r}")
         self.warmup_mode = warmup_mode
 
     def get_warmup_lr(self, num_update):
@@ -18,7 +25,9 @@ class LRScheduler:
             inc = (self.warmup_final_lr - self.warmup_begin_lr) * \
                 num_update / self.warmup_steps
             return self.warmup_begin_lr + inc
-        return self.warmup_final_lr * (num_update / self.warmup_steps) ** 2
+        # constant: hold the warmup LR flat until warmup ends (ref:
+        # lr_scheduler.py:59 — returns warmup_begin_lr)
+        return self.warmup_begin_lr
 
     def __call__(self, num_update):
         raise NotImplementedError

@@ -143,6 +143,11 @@ class _ConfusionMatrixMetric(EvalMetric):
     def __init__(self, name, output_names=None, label_names=None,
                  average="macro"):
         super().__init__(name, output_names, label_names)
+        if average not in ("macro", "micro"):
+            # a typo'd mode silently became micro — same unvalidated-enum
+            # bug class as lr_scheduler warmup_mode
+            raise ValueError(f"average must be 'macro' or 'micro', got "
+                             f"{average!r}")
         self.average = average
         self._local = np.zeros(4)   # tp, fp, fn, tn — local window
         self._global = np.zeros(4)  # same, since last full reset()

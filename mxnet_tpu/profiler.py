@@ -196,6 +196,52 @@ def dumps(reset=False, format="table"):
     return "\n".join(lines)
 
 
+# -- kvstore recovery telemetry -------------------------------------------
+# The dist transport reports every recovery incident (reconnect storms,
+# budget exhaustions) here, independent of the run/stop profiling state —
+# the bench supervisor needs to answer "WHY did this distributed run
+# degrade" even when nobody armed the profiler. When the profiler IS
+# running, each incident also lands in the chrome trace (category
+# "kvstore_recovery") so waits line up against the op timeline.
+_recovery_incidents = []
+_RECOVERY_KEEP = 256
+
+
+def note_recovery(args):
+    """Record one recovery incident dict (op, req_id, outcome,
+    attempts, backoff_wait_ms, ...) from the kvstore transport."""
+    with _lock:
+        _recovery_incidents.append(dict(args))
+        del _recovery_incidents[:-_RECOVERY_KEEP]
+    record_event("kvstore_recovery:%s" % args.get("outcome", "?"),
+                 "kvstore_recovery", _now_us(), 0, args=dict(args))
+
+
+def recovery_incidents():
+    with _lock:
+        return [dict(a) for a in _recovery_incidents]
+
+
+def recovery_summary():
+    """Aggregate recovery telemetry: the structured 'why it degraded'
+    record the bench supervisor folds into its JSON artifact."""
+    with _lock:
+        incidents = [dict(a) for a in _recovery_incidents]
+    summary = {
+        "incidents": len(incidents),
+        "recovered": sum(1 for a in incidents
+                         if a.get("outcome") == "recovered"),
+        "exhausted": sum(1 for a in incidents
+                         if a.get("outcome") == "exhausted"),
+        "attempts": sum(int(a.get("attempts", 0)) for a in incidents),
+        "reconnects": sum(int(a.get("reconnects", 0)) for a in incidents),
+        "backoff_wait_ms": round(sum(
+            float(a.get("backoff_wait_ms", 0.0)) for a in incidents), 3),
+        "last": incidents[-1] if incidents else None,
+    }
+    return summary
+
+
 # -- user-defined instrumentation objects (ref: profiler.h:556-837) -------
 class Domain:
     def __init__(self, name):

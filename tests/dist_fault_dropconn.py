@@ -1,0 +1,42 @@
+"""Worker program: every worker's connection drops at its 2nd push
+(MXNET_KVSTORE_FAULT_PLAN=drop_conn@round=2); recovery must reconnect,
+resend idempotently, and keep every BSP sum exact."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from mxnet_tpu.kvstore import dist  # noqa: E402
+
+
+def main():
+    wid = int(os.environ.get("DMLC_WORKER_ID", "0"))
+    conn = dist.WorkerConnection()
+    if conn.rank == 0:
+        conn.set_sync_mode(True)
+    conn.barrier()
+    if conn.rank == 0:
+        conn.init(0, np.zeros(4, np.float32))
+    conn.barrier()
+    for rnd in range(1, 4):
+        conn.push(0, np.full(4, float(conn.rank + 1), np.float32))
+        out = conn.pull(0, (4,))
+        expect = sum(r + 1 for r in range(conn.num_workers))
+        assert np.all(out == np.float32(expect)), (rnd, out, expect)
+        conn.barrier()
+    tel = conn.telemetry
+    assert tel.reconnects >= 1, "fault never fired"
+    assert tel.recovered >= 1
+    print(f"[worker {wid}] DROPCONN OK reconnects={tel.reconnects}",
+          flush=True)
+    conn.barrier()
+    if conn.rank == 0:
+        conn.stop_server()
+    conn.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+"""Worker program for the permanent-fault scenario: the server dies at
+merge round 5 (kill_server fault) and is NEVER restarted (no
+--restart-policy). Survivor workers must burn their recovery budget in
+bounded time and raise ONE clean MXNetError naming the budget — not
+hang, not dump a stack of raw socket errors.
+
+Prints ``[worker R] EXHAUST OK <seconds>`` when the failure is clean
+and fast.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from mxnet_tpu.base import MXNetError  # noqa: E402
+from mxnet_tpu.kvstore import dist  # noqa: E402
+
+ROUNDS = 10
+KEY = 0
+N = 8
+
+
+def main():
+    wid = int(os.environ.get("DMLC_WORKER_ID", "0"))
+    budget_ms = dist.recovery_budget_ms()
+    assert budget_ms > 0, "scenario needs MXNET_KVSTORE_RECOVERY_BUDGET_MS"
+    conn = dist.WorkerConnection()
+    rank = conn.rank
+    if rank == 0:
+        conn.set_sync_mode(True)
+    conn.barrier()
+    if rank == 0:
+        conn.init(KEY, np.ones(N, np.float32))
+    conn.barrier()
+
+    t0 = time.monotonic()
+    try:
+        for rnd in range(1, ROUNDS + 1):
+            conn.push(KEY, np.full(N, float(rank + 1), np.float32))
+            conn.pull(KEY, (N,))
+            conn.barrier()
+    except MXNetError as e:
+        dt = time.monotonic() - t0
+        msg = str(e)
+        assert "recovery budget exhausted" in msg, msg
+        assert str(budget_ms) in msg, msg
+        # bounded: the whole run (including the rounds before the kill)
+        # must finish well inside one request timeout — a hang would
+        # blow straight past this
+        limit = (budget_ms / 1000.0) * 3 + 30
+        assert dt < limit, f"took {dt:.1f}s, budget {budget_ms}ms"
+        print(f"[worker {wid}] EXHAUST OK {dt:.1f}", flush=True)
+        return
+    raise AssertionError("run finished despite a permanently dead server")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+"""Worker program for the kill-and-restart fault scenario (run via
+tools/launch.py -n 4 --restart-policy=server with
+MXNET_KVSTORE_FAULT_PLAN=kill_server@round=5).
+
+Each worker drives 10 BSP rounds of push/pull on ONE key with a
+server-side SGD updater. Every pushed gradient is an exact small
+integer and the learning rate is a power of two, so float addition is
+associative-exact here: the final weights are BIT-IDENTICAL regardless
+of worker arrival order — which is what lets the pytest driver compare
+the faulted run against a no-fault run bitwise. With the kill plan
+armed the server SIGTERMs itself when merge round 5 applies, snapshots
+its whole state (committed store, in-flight merges, idempotency
+watermarks, the optimizer blob), and the launcher restarts it; workers
+reconnect, resend idempotently, and the job must finish with the same
+bits as if nothing happened — no lost and no double-applied gradient.
+
+Prints ``[worker R] FINAL <hex digest of final weights>`` then
+``[worker R] RECOVERY OK`` per worker.
+"""
+import hashlib
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from mxnet_tpu.kvstore import dist  # noqa: E402
+from mxnet_tpu.optimizer import SGD  # noqa: E402
+
+ROUNDS = 10
+KEY = 0
+N = 8
+
+
+def main():
+    wid = int(os.environ.get("DMLC_WORKER_ID", "0"))
+    conn = dist.WorkerConnection()
+    rank, nw = conn.rank, conn.num_workers
+    if rank == 0:
+        conn.set_sync_mode(True)
+    conn.barrier()
+    if rank == 0:
+        conn.init(KEY, np.ones(N, np.float32))
+        # lr 0.5 is a power of two: every update is exact in fp32
+        conn.send_optimizer(SGD(learning_rate=0.5))
+    conn.barrier()
+
+    for rnd in range(1, ROUNDS + 1):
+        grad = np.full(N, float((rank + 1) * rnd), np.float32)
+        conn.push(KEY, grad)
+        out = conn.pull(KEY, (N,))
+        conn.barrier()
+        # stand-in for per-round compute; also gives the injected
+        # SIGTERM (Python-level handler, ~poll_ms latency) time to land
+        # MID-JOB rather than after the last round
+        time.sleep(0.3)
+
+    # expected: w = 1 - 0.5 * sum_rnd rnd * sum_r (r+1)
+    expect = 1.0 - 0.5 * (nw * (nw + 1) / 2.0) * (ROUNDS * (ROUNDS + 1) / 2.0)
+    assert np.all(out == np.float32(expect)), (out, expect)
+    digest = hashlib.sha256(out.tobytes()).hexdigest()[:16]
+    print(f"[worker {wid}] FINAL {digest}", flush=True)
+    tel = conn.telemetry
+    print(f"[worker {wid}] RECOVERY OK reconnects={tel.reconnects} "
+          f"recovered={tel.recovered}", flush=True)
+    conn.barrier()
+    if rank == 0:
+        conn.stop_server()
+    conn.close()
+
+
+if __name__ == "__main__":
+    main()

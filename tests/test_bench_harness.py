@@ -14,6 +14,10 @@ import bench
 def _patch_last_good(tmp_path, monkeypatch):
     p = tmp_path / "BENCH_LAST_GOOD.json"
     monkeypatch.setattr(bench, "_LAST_GOOD", str(p))
+    # point the committed final tier somewhere absent too: these tests
+    # pin the RUNTIME tier rules in isolation
+    monkeypatch.setattr(bench, "_LAST_GOOD_FALLBACK",
+                        str(tmp_path / "no_committed_fallback.json"))
     return p
 
 
@@ -108,6 +112,49 @@ def test_load_rejects_corrupt(tmp_path, monkeypatch):
     p = _patch_last_good(tmp_path, monkeypatch)
     p.write_text("not json")
     assert bench._load_last_good() is None
+
+
+def test_committed_fallback_is_final_tier(tmp_path, monkeypatch):
+    """With no runtime save, the committed docs/artifacts artifact is
+    the last resort for READERS — and invisible to the save gates."""
+    monkeypatch.setattr(bench, "_LAST_GOOD",
+                        str(tmp_path / "absent_runtime.json"))
+    prior = bench._load_last_good()
+    assert prior is not None, "committed fallback artifact missing"
+    assert prior.get("stale") is True
+    assert prior.get("committed_fallback") is True
+    stale_line = json.loads(prior["line"])
+    assert stale_line["metric"] == bench.METRIC  # passes the load gate
+    assert stale_line["value"] > 0
+    # save-side gates must never see it: a fresh partial would
+    # otherwise be refused because "a full measurement exists"
+    assert bench._load_last_good(include_fallback=False) is None
+
+
+def test_runtime_save_beats_committed_fallback(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "_LAST_GOOD",
+                        str(tmp_path / "BENCH_LAST_GOOD.json"))
+    bench._save_last_good(FULL)
+    assert bench._load_last_good()["line"] == FULL
+
+
+def test_supervise_emits_committed_stale_when_nothing_else(
+        monkeypatch, tmp_path, capsys):
+    """A wedged tunnel on a fresh checkout (no runtime last-good) must
+    degrade to the committed stale artifact, never a naked 0.0."""
+    monkeypatch.setattr(bench, "_LAST_GOOD",
+                        str(tmp_path / "absent_runtime.json"))
+    monkeypatch.setattr(bench, "_probe_backend", lambda **k: False)
+    monkeypatch.setenv("MXTPU_BENCH_BUDGET", "500")
+    _fake_clock(monkeypatch)
+    rc = bench.supervise()
+    out = capsys.readouterr().out
+    assert rc == 1  # stale is never mistaken for a fresh run
+    line = bench._json_line(out.encode())
+    assert line is not None
+    parsed = json.loads(line)
+    assert parsed["stale"] is True and parsed["value"] > 0
+    assert "error" not in parsed
 
 
 def test_fail_json_prints_metric_line(capsys):

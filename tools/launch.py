@@ -14,9 +14,12 @@ from __future__ import annotations
 
 import argparse
 import os
+import shutil
 import socket
 import subprocess
 import sys
+import tempfile
+import time
 
 
 def _free_port(span=1):
@@ -56,6 +59,16 @@ def main(argv=None):
                         choices=["local"])
     parser.add_argument("--env-server", default="",
                         help="extra KEY=VAL,... env for the server")
+    parser.add_argument("--restart-policy", default="none",
+                        choices=["none", "server"],
+                        help="'server': a server process that dies while "
+                        "workers are still running is restarted (up to "
+                        "--max-server-restarts times) with "
+                        "MXNET_KVSTORE_SNAPSHOT_PATH wired so a SIGTERM'd "
+                        "server snapshots its key store and the restart "
+                        "restores it — workers reconnect and resume "
+                        "(docs/robustness.md)")
+    parser.add_argument("--max-server-restarts", type=int, default=3)
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
     if not args.command:
@@ -71,22 +84,54 @@ def main(argv=None):
         "DMLC_NUM_SERVER": str(nserv),
     })
 
-    servers = []
-    for sidx in range(nserv):
+    snap_dir = None
+    if args.restart_policy == "server" and nserv > 0:
+        # per-job snapshot directory: a SIGTERM'd server writes its
+        # state here, its restart restores it (kvstore/dist.py
+        # run_server) — the state-preserving half of server recovery
+        snap_dir = tempfile.mkdtemp(prefix="mxtpu_kvsnap_")
+
+    def spawn_server(sidx):
         server_env = dict(base_env, DMLC_ROLE="server",
                           DMLC_SERVER_ID=str(sidx))
         for kv in filter(None, args.env_server.split(",")):
             k, _, v = kv.partition("=")
             server_env[k] = v
-        servers.append(subprocess.Popen(
+        if snap_dir is not None:
+            server_env.setdefault(
+                "MXNET_KVSTORE_SNAPSHOT_PATH",
+                os.path.join(snap_dir, "server_%d.snap" % sidx))
+        return subprocess.Popen(
             [sys.executable, "-c",
              "from mxnet_tpu.kvstore import dist; dist.run_server()"],
-            env=server_env))
+            env=server_env)
+
+    servers = [spawn_server(sidx) for sidx in range(nserv)]
 
     workers = []
     for i in range(args.num_workers):
         env = dict(base_env, DMLC_ROLE="worker", DMLC_WORKER_ID=str(i))
         workers.append(subprocess.Popen(args.command, env=env))
+
+    restarts = [0] * nserv
+    if args.restart_policy == "server" and nserv > 0:
+        # supervise: a server death while workers are still running is a
+        # restartable fault, not the end of the job
+        while any(w.poll() is None for w in workers):
+            for sidx, server in enumerate(servers):
+                if server.poll() is None:
+                    continue
+                if server.returncode == 0:
+                    continue  # clean stop (end of job) — not a fault
+                if restarts[sidx] >= args.max_server_restarts:
+                    continue
+                restarts[sidx] += 1
+                print("launch.py: server %d exited rc=%s — restart %d/%d"
+                      % (sidx, server.returncode, restarts[sidx],
+                         args.max_server_restarts),
+                      file=sys.stderr, flush=True)
+                servers[sidx] = spawn_server(sidx)
+            time.sleep(0.2)
 
     rc = 0
     for w in workers:
@@ -98,6 +143,8 @@ def main(argv=None):
             server.kill()
         if rc != 0:
             server.kill()
+    if snap_dir is not None:
+        shutil.rmtree(snap_dir, ignore_errors=True)
     return rc
 
 
