@@ -87,6 +87,7 @@ from . import module as mod  # noqa: E402
 from . import rnn  # noqa: E402
 from . import subgraph  # noqa: E402
 from . import profiler  # noqa: E402
+from . import checkpoint  # noqa: E402
 from . import contrib  # noqa: E402
 from . import gluon  # noqa: E402
 from . import operator  # noqa: E402

@@ -1,0 +1,567 @@
+"""Preemption-safe checkpointing: atomic writes, CRC32 manifests, full
+training-state capture, and worker auto-resume.
+
+TPU preemption is the canonical failure mode this subsystem exists for:
+a worker can be SIGTERM'd at ANY instruction, including mid-`write(2)`
+of a `.params` file. Three layers make that survivable
+(docs/robustness.md "Worker recovery & checkpoint format"):
+
+1. :func:`atomic_write` — every checkpoint file is written to
+   ``<fname>.tmp``, flushed, ``fsync``'d, and ``os.replace``'d into
+   place, so a torn write can never be observed under the final name;
+   the file's CRC32 is recorded in a versioned ``MANIFEST.json`` next
+   to it, so silent corruption (bitrot, a torn write that somehow
+   survived, fault injection) is *detected at load* instead of being
+   deserialized into wrong weights. Adopted by ``nd.save``,
+   ``Symbol.save``, ``model.save_checkpoint``, ``Trainer.save_states``,
+   ``Module.save_checkpoint``, and the kvstore server snapshot.
+
+2. :class:`CheckpointManager` — a directory of versioned full
+   training-state checkpoints (params + optimizer/trainer states +
+   ``mxnet_tpu.random``/numpy RNG state + data-iterator position),
+   with ``latest_valid()`` resume that CRC-checks candidates newest
+   first and *skips* corrupt ones with a warning (counted in
+   ``profiler.recovery_summary()["checkpoints_rejected"]``).
+
+3. :class:`PreemptionGuard` — a SIGTERM handler that only sets a flag;
+   the training loop finishes its in-flight batch, writes one final
+   checkpoint, and exits with :data:`WORKER_RESTART_EXITCODE` so
+   ``tools/launch.py --restart-policy=worker`` respawns the worker,
+   which auto-resumes from the newest valid manifest. The
+   ``kill_worker@batch=N`` / ``trunc_checkpoint`` /
+   ``corrupt_checkpoint`` directives of ``MXNET_KVSTORE_FAULT_PLAN``
+   (kvstore/fault.py) make the whole path deterministically testable.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import shutil
+import signal
+import sys
+import zlib
+
+from .base import MXNetError, get_env
+
+MANIFEST_NAME = "MANIFEST.json"
+MANIFEST_VERSION = 1
+
+# exit code of a preempted worker that wrote its final checkpoint: tells
+# tools/launch.py --restart-policy=worker "restartable death with a
+# resumable checkpoint on disk" apart from a clean exit (0) and a crash
+# (anything else). The server-side twin is dist.SERVER_RESTART_EXITCODE
+# (17); tools/launch.py mirrors this value (it must not import the
+# package, tests/test_checkpoint.py pins the two equal).
+WORKER_RESTART_EXITCODE = 19
+
+
+def manifest_enabled():
+    """CRC manifests are on by default; MXNET_CHECKPOINT_MANIFEST=0 is
+    the escape hatch for write-once scratch files."""
+    return get_env("MXNET_CHECKPOINT_MANIFEST", True, bool)
+
+
+def file_crc32(fname, _chunk=1 << 20):
+    """CRC32 of a file's bytes (zlib polynomial, unsigned)."""
+    crc = 0
+    with open(fname, "rb") as f:
+        while True:
+            block = f.read(_chunk)
+            if not block:
+                return crc & 0xFFFFFFFF
+            crc = zlib.crc32(block, crc)
+
+
+def _manifest_path(fname):
+    return os.path.join(os.path.dirname(os.path.abspath(fname)),
+                        MANIFEST_NAME)
+
+
+def read_manifest(dirpath):
+    """The directory's MANIFEST.json dict, or None when absent or
+    undecodable (an undecodable manifest means its directory cannot be
+    validated — CheckpointManager treats that checkpoint as invalid)."""
+    path = os.path.join(dirpath, MANIFEST_NAME)
+    try:
+        with open(path, "rb") as f:
+            man = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(man, dict) or \
+            man.get("version") != MANIFEST_VERSION or \
+            not isinstance(man.get("files"), dict):
+        return None
+    return man
+
+
+def _record_in_manifest(fname, crc, size):
+    """Read-modify-write the sibling MANIFEST.json atomically. Keyed by
+    basename: the manifest travels with its directory. The superseded
+    entry is kept one generation under ``prev``: atomic_write records
+    the new entry BEFORE renaming the file into place, so a crash in
+    either half of the commit leaves a (file, manifest) pair that
+    verify() still accepts — new entry + old file via ``prev``, or new
+    entry + new file directly. Without ``prev``, a preemption between
+    the two steps would strand a perfectly good file behind a stale
+    CRC."""
+    mpath = _manifest_path(fname)
+    man = read_manifest(os.path.dirname(mpath)) or \
+        {"version": MANIFEST_VERSION, "files": {}}
+    entry = {"crc32": int(crc), "size": int(size)}
+    old = man["files"].get(os.path.basename(fname))
+    if old is not None and (old.get("crc32") != entry["crc32"]
+                            or old.get("size") != entry["size"]):
+        entry["prev"] = {"crc32": old.get("crc32"),
+                         "size": old.get("size")}
+    man["files"][os.path.basename(fname)] = entry
+    tmp = mpath + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(man, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, mpath)
+
+
+def manifest_entry(fname):
+    """This file's manifest record ({"crc32", "size"}) or None."""
+    man = read_manifest(os.path.dirname(os.path.abspath(fname)))
+    if man is None:
+        return None
+    return man["files"].get(os.path.basename(fname))
+
+
+def verify(fname, required=False):
+    """CRC-check ``fname`` against its MANIFEST.json entry.
+
+    Returns True when the entry exists and matches; False when there is
+    no entry (and ``required`` is False) or manifests are disabled. A
+    size or CRC mismatch raises ``MXNetError`` — a flipped or truncated
+    byte must never be deserialized into weights.
+    """
+    if not manifest_enabled():
+        return False
+    entry = manifest_entry(fname)
+    if entry is None:
+        if required:
+            raise MXNetError(
+                f"checkpoint {fname} has no {MANIFEST_NAME} entry — "
+                "cannot prove integrity (file predates the manifest, or "
+                "the manifest was lost)")
+        return False
+    size = os.path.getsize(fname)
+    crc = None
+    # the current entry, or — when a preemption landed between the
+    # manifest record and the rename — the superseded generation the
+    # manifest kept under "prev" (still a valid, uncorrupted file)
+    for cand in (entry, entry.get("prev")):
+        if not cand:
+            continue
+        if size != cand.get("size"):
+            continue
+        if crc is None:
+            crc = file_crc32(fname)
+        if crc == cand.get("crc32"):
+            return True
+    if crc is None:
+        crc = file_crc32(fname)
+    raise MXNetError(
+        f"checkpoint {fname} failed integrity check: size {size} / "
+        f"CRC32 {crc:#010x} match neither the manifest entry "
+        f"(size {entry.get('size')}, CRC32 "
+        f"{int(entry.get('crc32', 0)):#010x}) nor its predecessor — "
+        "torn/truncated write or corrupt bytes; refusing to load as "
+        "weights")
+
+
+# -- fault seams (the checkpoint half of MXNET_KVSTORE_FAULT_PLAN) --------
+class _CheckpointFaults:
+    """Consumes ``trunc_checkpoint``/``corrupt_checkpoint`` rules: each
+    fires once, at its Nth atomic checkpoint write (``round=N``, default
+    the next one), mutating the temp file AFTER its CRC was computed —
+    exactly the bitrot/torn-write corruption the manifest must catch."""
+
+    def __init__(self, rules=None):
+        from .kvstore import fault as fault_mod
+        if rules is None:
+            rules = fault_mod.plan_from_env()
+        rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+        self.rules = [r for r in rules
+                      if r.kind in ("trunc_checkpoint", "corrupt_checkpoint")
+                      and (r.rank is None or r.rank == rank)]
+        self.writes = 0
+
+    def apply(self, tmp_path):
+        self.writes += 1
+        for r in list(self.rules):
+            if r.round is not None and r.round != self.writes:
+                continue
+            self.rules.remove(r)  # one shot
+            size = os.path.getsize(tmp_path)
+            if r.kind == "trunc_checkpoint":
+                with open(tmp_path, "r+b") as f:
+                    f.truncate(size // 2)
+            else:  # corrupt_checkpoint: flip one mid-file byte
+                with open(tmp_path, "r+b") as f:
+                    f.seek(size // 2)
+                    b = f.read(1) or b"\x00"
+                    f.seek(size // 2)
+                    f.write(bytes([b[0] ^ 0xFF]))
+
+
+_faults = None
+
+
+def _checkpoint_faults():
+    global _faults
+    if _faults is None:
+        _faults = _CheckpointFaults()
+    return _faults
+
+
+def _reset_faults():
+    """Test hook: re-read MXNET_KVSTORE_FAULT_PLAN on next write."""
+    global _faults
+    _faults = None
+
+
+@contextlib.contextmanager
+def atomic_write(fname, mode="wb", manifest=None):
+    """Crash-safe file write: ``<fname>.tmp`` -> flush -> fsync ->
+    ``os.replace``. A preemption at any point leaves either the old file
+    or the new one under ``fname`` — never a torn hybrid. The bytes that
+    reached disk are CRC32'd and recorded in the directory's
+    MANIFEST.json (``manifest=False`` or MXNET_CHECKPOINT_MANIFEST=0
+    skips the record).
+
+        with atomic_write(path) as f:
+            f.write(payload)
+    """
+    if mode not in ("w", "wb"):
+        raise MXNetError(f"atomic_write mode must be 'w' or 'wb', "
+                         f"got {mode!r}")
+    fname = os.fspath(fname)
+    record = manifest if manifest is not None else manifest_enabled()
+    tmp = fname + ".tmp"
+    try:
+        with open(tmp, mode) as f:
+            yield f
+            f.flush()
+            os.fsync(f.fileno())
+        # CRC of what actually hit the disk, computed by reading back —
+        # honest against any buffering layer between writer and platter
+        crc = file_crc32(tmp)
+        size = os.path.getsize(tmp)
+        # fault seams fire AFTER the CRC is recorded: the injected
+        # corruption models damage the manifest must detect
+        _checkpoint_faults().apply(tmp)
+        if record:
+            # manifest first, rename second: a crash between the two
+            # leaves the OLD file under fname, which verify() still
+            # accepts through the entry's "prev" generation — no
+            # ordering strands a good file behind a stale CRC
+            _record_in_manifest(fname, crc, size)
+        os.replace(tmp, fname)
+        _fsync_dir(os.path.dirname(os.path.abspath(fname)))
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+
+
+def _fsync_dir(dirpath):
+    """Durably record the rename in the directory (best effort — some
+    filesystems refuse O_RDONLY dir fsync)."""
+    try:
+        fd = os.open(dirpath, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def write_bytes(fname, data, manifest=None):
+    """One-shot atomic write of ``bytes`` (or ``str``) to ``fname``."""
+    with atomic_write(fname, "wb" if isinstance(data, bytes) else "w",
+                      manifest=manifest) as f:
+        f.write(data)
+
+
+# -- preemption guard -----------------------------------------------------
+class PreemptionGuard:
+    """Deferred-SIGTERM handler for training loops.
+
+    The handler only sets :attr:`preempted`; the loop keeps control, so
+    the in-flight batch finishes and the final checkpoint is written by
+    ordinary (non-signal) code. ``batch_done()`` advances the global
+    batch counter and fires any armed ``kill_worker@batch=N`` fault rule
+    (``MXNET_KVSTORE_FAULT_PLAN``) by sending THIS process a real
+    SIGTERM — the exact preemption code path, no process games needed.
+    ``batch=N`` counts *global* batches: a resumed worker restores the
+    counter from its checkpoint (``guard.batches = step``), so a fired
+    kill never refires on its own recovery — the same no-refire
+    discipline the PR-1 request-id watermarks give resends.
+    """
+
+    def __init__(self, install=True, signals=(signal.SIGTERM,)):
+        from .kvstore import fault as fault_mod
+        self.preempted = False
+        self.batches = 0
+        self._signals = signals
+        self._prev = {}
+        rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+        self._kill_rules = [
+            r for r in fault_mod.plan_from_env()
+            if r.kind == "kill_worker"
+            and (r.rank is None or r.rank == rank)]
+        if install:
+            self.install()
+
+    def install(self):
+        for sig in self._signals:
+            self._prev[sig] = signal.signal(sig, self._handler)
+        return self
+
+    def restore(self):
+        for sig, prev in self._prev.items():
+            signal.signal(sig, prev)
+        self._prev = {}
+
+    def _handler(self, signum, frame):
+        self.preempted = True
+
+    def batch_done(self):
+        """Call once per finished batch. Returns True when the loop
+        should checkpoint and stop (a preemption signal arrived)."""
+        self.batches += 1
+        for r in list(self._kill_rules):
+            if r.batch == self.batches:
+                self._kill_rules.remove(r)
+                os.kill(os.getpid(), signal.SIGTERM)
+        return self.preempted
+
+    def exit_for_restart(self):
+        """Exit with the sentinel code --restart-policy=worker respawns."""
+        sys.exit(WORKER_RESTART_EXITCODE)
+
+
+# -- full training-state checkpoints --------------------------------------
+_PARAMS_FILE = "params.params"
+_TRAINER_FILE = "trainer.states"
+_RNG_FILE = "rng.state"
+_ITER_FILE = "iter.state"
+_META_FILE = "meta.json"
+
+
+class CheckpointManager:
+    """Versioned full-training-state checkpoints with newest-valid
+    resume.
+
+    Each ``save(step, ...)`` writes ``<dir>/ckpt-<step>/`` holding
+    ``params.params`` (nd.save), ``trainer.states``
+    (Trainer/Module optimizer states), ``rng.state`` (mxnet_tpu.random
+    + numpy global RNG), ``iter.state`` (DataIter ``state_dict()``),
+    and — written LAST, the commit marker — ``meta.json``; every file's
+    CRC32 lands in the directory's MANIFEST.json via atomic_write.
+
+    ``latest_valid()`` walks checkpoints newest first, CRC-validating
+    each; a torn or corrupt one is skipped with a warning and counted
+    (``profiler.recovery_summary()["checkpoints_rejected"]``), so a
+    preemption mid-save costs one checkpoint interval, never the job.
+    """
+
+    def __init__(self, dirpath, keep=3):
+        self.dir = os.fspath(dirpath)
+        self.keep = int(keep)
+        os.makedirs(self.dir, exist_ok=True)
+
+    # -- paths ----------------------------------------------------------
+    def _ckpt_dir(self, step):
+        return os.path.join(self.dir, f"ckpt-{int(step):08d}")
+
+    def steps(self):
+        out = []
+        for name in os.listdir(self.dir):
+            if name.startswith("ckpt-"):
+                try:
+                    out.append(int(name[5:]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    # -- write ----------------------------------------------------------
+    def save(self, step, params=None, trainer=None, data_iter=None,
+             extra=None):
+        """Capture full training state at global batch ``step``.
+
+        ``params``: dict name -> NDArray/numpy (nd.save rules).
+        ``trainer``: anything with ``save_states(fname)`` (gluon
+        Trainer, Module via save_optimizer_states) — optional.
+        ``data_iter``: anything with ``state_dict()`` — optional.
+        ``extra``: small JSON-able dict (epoch, lr, ...) — optional.
+        """
+        from . import random as random_mod
+        from . import ndarray as nd
+        import pickle
+
+        import numpy as np
+
+        cdir = self._ckpt_dir(step)
+        os.makedirs(cdir, exist_ok=True)
+        meta = {"version": MANIFEST_VERSION, "step": int(step),
+                "files": [], "extra": extra or {}}
+        if params is not None:
+            nd.save(os.path.join(cdir, _PARAMS_FILE), params)
+            meta["files"].append(_PARAMS_FILE)
+        if trainer is not None:
+            saver = getattr(trainer, "save_states", None) or \
+                getattr(trainer, "save_optimizer_states")
+            saver(os.path.join(cdir, _TRAINER_FILE))
+            meta["files"].append(_TRAINER_FILE)
+        rng = {"mx": random_mod.get_state(),
+               "numpy": np.random.get_state()}
+        write_bytes(os.path.join(cdir, _RNG_FILE), pickle.dumps(rng))
+        meta["files"].append(_RNG_FILE)
+        if data_iter is not None:
+            write_bytes(os.path.join(cdir, _ITER_FILE),
+                        pickle.dumps(data_iter.state_dict()))
+            meta["files"].append(_ITER_FILE)
+        # meta.json last: its manifest entry is the commit marker —
+        # a checkpoint without it is partial by construction
+        write_bytes(os.path.join(cdir, _META_FILE),
+                    json.dumps(meta, indent=1, sort_keys=True))
+        self._prune(keep_step=step)
+        return cdir
+
+    def _prune(self, keep_step):
+        if self.keep <= 0:
+            return
+        others = [s for s in self.steps() if s != keep_step]
+        n_keep = self.keep - 1
+        doomed = others[:-n_keep] if n_keep > 0 else others
+        for s in doomed:
+            shutil.rmtree(self._ckpt_dir(s), ignore_errors=True)
+
+    # -- validate / read -------------------------------------------------
+    def validate(self, step):
+        """True when the checkpoint's manifest lists meta.json and every
+        listed file CRC-verifies. Never raises. With
+        MXNET_CHECKPOINT_MANIFEST=0 no manifest exists to prove
+        integrity — a checkpoint whose meta.json commit marker parses
+        and whose listed files exist is accepted (degraded mode: resume
+        still works, torn files are caught only by decode failures)."""
+        cdir = self._ckpt_dir(step)
+        if not manifest_enabled():
+            try:
+                with open(os.path.join(cdir, _META_FILE)) as f:
+                    meta = json.load(f)
+                return all(os.path.exists(os.path.join(cdir, name))
+                           for name in meta.get("files", []))
+            except (OSError, ValueError):
+                return False
+        man = read_manifest(cdir)
+        if man is None or _META_FILE not in man["files"]:
+            return False
+        try:
+            for name in man["files"]:
+                verify(os.path.join(cdir, name), required=True)
+        except (MXNetError, OSError):
+            return False
+        return True
+
+    def latest_valid(self):
+        """Newest step whose checkpoint CRC-validates, or None. Corrupt
+        candidates are skipped with a warning and counted."""
+        from . import profiler
+        import warnings
+
+        for step in reversed(self.steps()):
+            if self.validate(step):
+                return step
+            warnings.warn(
+                f"checkpoint {self._ckpt_dir(step)} is torn or corrupt "
+                "(CRC/manifest validation failed) — skipping it for "
+                "resume", RuntimeWarning, stacklevel=2)
+            profiler.note_checkpoint_rejected({
+                "path": self._ckpt_dir(step), "step": int(step)})
+        return None
+
+    def load(self, step, _verified=False):
+        """Full state of checkpoint ``step`` (CRC-verified):
+        ``{"step", "params", "trainer_states_file", "rng", "iter_state",
+        "extra"}``. Raises MXNetError on any integrity failure.
+        ``_verified=True`` (resume_latest, right after validate())
+        skips the redundant whole-directory CRC pass — per-file loaders
+        underneath still verify what they read."""
+        from . import ndarray as nd
+        import pickle
+
+        cdir = self._ckpt_dir(step)
+        if manifest_enabled() and not _verified:
+            man = read_manifest(cdir)
+            if man is None:
+                raise MXNetError(
+                    f"checkpoint {cdir} has no readable {MANIFEST_NAME}")
+            for name in man["files"]:
+                verify(os.path.join(cdir, name), required=True)
+        meta_path = os.path.join(cdir, _META_FILE)
+        if not os.path.exists(meta_path):
+            raise MXNetError(
+                f"checkpoint {cdir} has no {_META_FILE} — partial save "
+                "(preempted mid-checkpoint)")
+        with open(meta_path) as f:
+            meta = json.load(f)
+        out = {"step": meta["step"], "extra": meta.get("extra", {}),
+               "params": None, "trainer_states_file": None,
+               "rng": None, "iter_state": None}
+        if _PARAMS_FILE in meta["files"]:
+            out["params"] = nd.load(os.path.join(cdir, _PARAMS_FILE))
+        if _TRAINER_FILE in meta["files"]:
+            out["trainer_states_file"] = os.path.join(cdir, _TRAINER_FILE)
+        if _RNG_FILE in meta["files"]:
+            with open(os.path.join(cdir, _RNG_FILE), "rb") as f:
+                out["rng"] = pickle.load(f)
+        if _ITER_FILE in meta["files"]:
+            with open(os.path.join(cdir, _ITER_FILE), "rb") as f:
+                out["iter_state"] = pickle.load(f)
+        return out
+
+    def resume_latest(self, trainer=None, data_iter=None):
+        """Auto-resume: load the newest valid checkpoint and apply it to
+        ``trainer``/``data_iter``/the RNG chain. Returns the loaded
+        state dict (caller re-installs params) or None when there is
+        nothing valid to resume from. Each successful resume is counted
+        in ``profiler.recovery_summary()["worker_resumes"]``."""
+        from . import profiler
+        from . import random as random_mod
+        import numpy as np
+
+        step = self.latest_valid()
+        if step is None:
+            return None
+        state = self.load(step, _verified=True)
+        if state["rng"] is not None:
+            random_mod.set_state(state["rng"]["mx"])
+            np.random.set_state(state["rng"]["numpy"])
+        if trainer is not None and state["trainer_states_file"]:
+            loader = getattr(trainer, "load_states", None) or \
+                getattr(trainer, "load_optimizer_states")
+            loader(state["trainer_states_file"])
+        if data_iter is not None and state["iter_state"] is not None:
+            data_iter.load_state_dict(state["iter_state"])
+        profiler.note_worker_resume({
+            "step": int(step), "path": self._ckpt_dir(step),
+            "restarts": int(os.environ.get("MXNET_WORKER_RESTARTS", "0")),
+        })
+        return state
+
+
+def worker_checkpoint_dir():
+    """The per-worker checkpoint directory tools/launch.py
+    --restart-policy=worker provisions (MXNET_WORKER_CHECKPOINT_DIR),
+    or None outside a supervised job."""
+    return os.environ.get("MXNET_WORKER_CHECKPOINT_DIR") or None

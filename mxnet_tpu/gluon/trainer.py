@@ -142,22 +142,36 @@ class Trainer:
     def save_states(self, fname):
         """Optimizer state checkpoint (ref: trainer.py save_states). When the
         optimizer runs on the kvstore, the live state is the kvstore's
-        Updater, not the local one."""
+        Updater, not the local one. Written atomically with a CRC
+        manifest entry (checkpoint.atomic_write) so a preemption
+        mid-write can never leave a torn .states file."""
         if not self._kv_initialized:
             self._init_kvstore()
         if self._kvstore is not None and self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname, dump_optimizer=False)
         else:
-            with open(fname, "wb") as f:
+            from ..checkpoint import atomic_write
+            with atomic_write(fname) as f:
                 f.write(self._updaters.get_states())
 
     def load_states(self, fname):
         if not self._kv_initialized:
             self._init_kvstore()
         if self._kvstore is not None and self._update_on_kvstore:
+            # no verify here: kvstore.load_optimizer_states CRC-checks
+            # the same file — doing it twice doubles the resume I/O
             self._kvstore.load_optimizer_states(fname)
             self._optimizer = self._kvstore._updater.optimizer
+            # mirror the loaded state into the LOCAL updater too: a
+            # later fall back to local update (kvstore torn down,
+            # update_on_kvstore flipped off) must not resume from the
+            # stale pre-load state it would otherwise still hold
+            self._updaters.set_states(
+                self._kvstore._updater.get_states(dump_optimizer=False))
+            self._updaters.optimizer = self._optimizer
         else:
+            from ..checkpoint import verify
+            verify(fname)
             with open(fname, "rb") as f:
                 self._updaters.set_states(f.read())
             # set_states may swap in a pickled optimizer (states dumped

@@ -98,6 +98,20 @@ class DataIter:
     def getpad(self):
         raise NotImplementedError
 
+    # -- checkpoint resume (mxnet_tpu/checkpoint.py) ---------------------
+    def state_dict(self):
+        """Resumable position for preemption-safe checkpoints; concrete
+        iterators that support exact resume override this."""
+        raise MXNetError(
+            f"{type(self).__name__} does not support checkpoint resume "
+            "(state_dict) — wrap the data in NDArrayIter or a record "
+            "iterator")
+
+    def load_state_dict(self, state):
+        raise MXNetError(
+            f"{type(self).__name__} does not support checkpoint resume "
+            "(load_state_dict)")
+
 
 def _init_data(data, allow_empty, default_name):
     """Canonicalize data/label into an ordered [(name, ndarray)] list."""
@@ -130,7 +144,7 @@ class NDArrayIter(DataIter):
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data",
-                 label_name="softmax_label"):
+                 label_name="softmax_label", seed=None):
         super().__init__(batch_size)
         self.data = _init_data(data, allow_empty=False,
                                default_name=data_name)
@@ -140,6 +154,14 @@ class NDArrayIter(DataIter):
         self.shuffle = shuffle
         self.last_batch_handle = last_batch_handle
         self.idx = np.arange(self.num_data)
+        # seed=None keeps the reference's global-np.random shuffle; a
+        # seed gives the iterator its OWN RandomState chain, which
+        # state_dict() captures so a resumed run replays the exact
+        # shuffle sequence of the uninterrupted one
+        self._seed = seed
+        self._shuffle_rng = (np.random.RandomState(seed)
+                             if seed is not None else None)
+        self._epochs = 0
         # roll_over: the trailing partial batch is NOT emitted; its
         # samples lead the next epoch (ref: io.py NDArrayIter
         # roll_over semantics — distinct from pad's wraparound)
@@ -158,8 +180,10 @@ class NDArrayIter(DataIter):
 
     def reset(self):
         self.cursor = -self.batch_size
+        self._epochs += 1
         if self.shuffle:
-            np.random.shuffle(self.idx)
+            (self._shuffle_rng if self._shuffle_rng is not None
+             else np.random).shuffle(self.idx)
         if self.last_batch_handle == "roll_over" and len(self._cache):
             # the cache is cleared only when a batch is actually taken,
             # so consecutive resets (bind-time + epoch-start) cannot
@@ -207,6 +231,61 @@ class NDArrayIter(DataIter):
     def getindex(self):
         end = min(self.cursor + self.batch_size, len(self._order))
         return self._order[self.cursor:end]
+
+    def state_dict(self):
+        """Exact resumable position (checkpoint.py): epoch counter,
+        cursor, this epoch's sample order, the roll_over cache, and the
+        per-iterator shuffle RNG chain (when ``seed=`` was given) so
+        every later epoch reshuffles identically to an uninterrupted
+        run. With seed=None the shuffle rides the numpy GLOBAL RNG,
+        which CheckpointManager captures/restores alongside."""
+        return {
+            "version": 1, "type": "NDArrayIter",
+            "num_data": int(self.num_data),
+            "batch_size": int(self.batch_size),
+            "shuffle": bool(self.shuffle),
+            "last_batch_handle": self.last_batch_handle,
+            "epoch": int(self._epochs),
+            "cursor": int(self.cursor),
+            "seed": self._seed,
+            "idx": self.idx.copy(),
+            "order": self._order.copy(),
+            "cache": self._cache.copy(),
+            "rng": (self._shuffle_rng.get_state()
+                    if self._shuffle_rng is not None else None),
+        }
+
+    def load_state_dict(self, state):
+        if not isinstance(state, dict) or \
+                state.get("type") != "NDArrayIter" or \
+                state.get("version") != 1:
+            raise MXNetError(
+                "load_state_dict: not a version-1 NDArrayIter state")
+        if int(state["num_data"]) != self.num_data:
+            raise MXNetError(
+                f"load_state_dict: iterator holds {self.num_data} "
+                f"samples but the state was captured over "
+                f"{state['num_data']} — not the same dataset")
+        # cursor/order are in sample units tied to the batching config:
+        # a silently different batch_size would resume on misaligned
+        # data, defeating the bit-identical guarantee with no error
+        for attr in ("batch_size", "shuffle", "last_batch_handle"):
+            if state.get(attr) != getattr(self, attr):
+                raise MXNetError(
+                    f"load_state_dict: iterator {attr}="
+                    f"{getattr(self, attr)!r} but the state was captured "
+                    f"with {attr}={state.get(attr)!r} — construct the "
+                    "iterator with the same configuration to resume")
+        self._epochs = int(state["epoch"])
+        self.cursor = int(state["cursor"])
+        self.idx = np.asarray(state["idx"])
+        self._order = np.asarray(state["order"])
+        self._cache = np.asarray(state["cache"])
+        if state.get("rng") is not None:
+            if self._shuffle_rng is None:
+                self._shuffle_rng = np.random.RandomState()
+            self._shuffle_rng.set_state(state["rng"])
+            self._seed = state.get("seed")
 
 
 class ResizeIter(DataIter):
@@ -385,6 +464,25 @@ class _WrapIter(DataIter):
             return b
         return self._inner.next()
 
+    def state_dict(self):
+        if self._cache is not None:
+            raise MXNetError(
+                f"cannot checkpoint {type(self).__name__} with an "
+                "un-consumed lookahead batch — capture state after "
+                "next()")
+        return {"version": 1, "type": type(self).__name__,
+                "inner": self._inner.state_dict()}
+
+    def load_state_dict(self, state):
+        if not isinstance(state, dict) or \
+                state.get("type") != type(self).__name__ or \
+                state.get("version") != 1:
+            raise MXNetError(
+                f"load_state_dict: not a version-1 "
+                f"{type(self).__name__} state")
+        self._cache = None
+        self._inner.load_state_dict(state["inner"])
+
 
 class CSVIter(_WrapIter):
     """CSV file iterator (ref: src/io/iter_csv.cc:218)."""
@@ -429,11 +527,14 @@ class MNISTIter(_WrapIter):
             imgs = imgs.reshape(imgs.shape[0], 1, *imgs.shape[1:])
         if input_shape:
             imgs = imgs.reshape((imgs.shape[0],) + tuple(input_shape))
+        # the seed param was silently ignored before: wire it into the
+        # inner iterator's own shuffle chain so MNIST epochs are
+        # deterministic per seed and exactly resumable (state_dict)
         self._inner = NDArrayIter({"data": imgs},
                                   {"softmax_label":
                                    lbls.astype(np.float32)},
                                   batch_size=batch_size, shuffle=shuffle,
-                                  last_batch_handle="discard")
+                                  last_batch_handle="discard", seed=seed)
 
     @staticmethod
     def _read_idx(path):
@@ -546,6 +647,12 @@ class ImageRecordIter(DataIter):
         if self.data_shape[0] == 3:
             from .._native import load_imgdec
             self._native = load_imgdec()
+        # checkpoint-resume bookkeeping: epochs begun, batches handed to
+        # the caller this epoch, and the epoch RNG state captured BEFORE
+        # the epoch's shuffle (so resume regenerates the same order)
+        self._epochs = 0
+        self._consumed = 0
+        self._rng_at_reset = self._epoch_rng.get_state()
         self.reset()
 
     def _load_offsets(self, path):
@@ -603,6 +710,9 @@ class ImageRecordIter(DataIter):
             self._producer.join(timeout=5)
             self._producer = None
         self._peek = None
+        self._rng_at_reset = self._epoch_rng.get_state()
+        self._epochs += 1
+        self._consumed = 0
         order = np.arange(len(self._offsets))
         if self.shuffle:
             self._epoch_rng.shuffle(order)
@@ -890,11 +1000,7 @@ class ImageRecordIter(DataIter):
             label = np.array([label], np.float32)
         return img, np.asarray(label, np.float32)
 
-    def next(self):
-        peek = getattr(self, "_peek", None)
-        if peek is not None:
-            self._peek = None
-            return peek
+    def _pull(self):
         item = self._queue.get()
         if item is self._SENTINEL:
             raise StopIteration
@@ -902,11 +1008,71 @@ class ImageRecordIter(DataIter):
             raise item
         return item
 
+    def next(self):
+        peek = getattr(self, "_peek", None)
+        if peek is not None:
+            self._peek = None
+        else:
+            peek = self._pull()
+        self._consumed += 1
+        return peek
+
     def iter_next(self):
         if getattr(self, "_peek", None) is not None:
             return True
         try:
-            self._peek = self.next()
+            self._peek = self._pull()
             return True
         except StopIteration:
             return False
+
+    def state_dict(self):
+        """Resumable position: epoch counter, batches consumed this
+        epoch, and the pre-shuffle epoch RNG state. Capture state at a
+        batch boundary (after next()), not between iter_next() and
+        next() — the lookahead batch cannot be rewound. Augmentation
+        randomness (rand_crop/rand_mirror) is per-decode-thread and not
+        part of the state: exact bit-resume holds for deterministic
+        pipelines (docs/robustness.md)."""
+        if getattr(self, "_peek", None) is not None:
+            raise MXNetError(
+                "cannot checkpoint ImageRecordIter with an un-consumed "
+                "lookahead batch — capture state after next()")
+        return {"version": 1, "type": "ImageRecordIter",
+                "num_records": len(self._offsets),
+                "batch_size": int(self.batch_size),
+                "shuffle": bool(self.shuffle),
+                "epoch": int(self._epochs),
+                "consumed": int(self._consumed),
+                "seed": self._aug_seed,
+                "rng": self._rng_at_reset}
+
+    def load_state_dict(self, state):
+        """Restore: rewind the epoch RNG to its pre-shuffle state,
+        regenerate the epoch order, then skip the already-consumed
+        batches (replayed through the decode pipeline — resume costs
+        ~consumed×batch decode time, never wrong data)."""
+        if not isinstance(state, dict) or \
+                state.get("type") != "ImageRecordIter" or \
+                state.get("version") != 1:
+            raise MXNetError(
+                "load_state_dict: not a version-1 ImageRecordIter state")
+        if int(state["num_records"]) != len(self._offsets):
+            raise MXNetError(
+                f"load_state_dict: iterator holds {len(self._offsets)} "
+                f"records but the state was captured over "
+                f"{state['num_records']} — not the same .rec file")
+        # "consumed" counts BATCHES: a different batch_size (or shuffle
+        # mode) would replay to a silently wrong sample position
+        for attr in ("batch_size", "shuffle"):
+            if state.get(attr) != getattr(self, attr):
+                raise MXNetError(
+                    f"load_state_dict: iterator {attr}="
+                    f"{getattr(self, attr)!r} but the state was captured "
+                    f"with {attr}={state.get(attr)!r} — construct the "
+                    "iterator with the same configuration to resume")
+        self._epoch_rng.set_state(state["rng"])
+        self.reset()
+        self._epochs = int(state["epoch"])
+        for _ in range(int(state["consumed"])):
+            self.next()

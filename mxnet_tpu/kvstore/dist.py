@@ -551,11 +551,14 @@ def _write_snapshot(lib, path, optimizer_blob):
             blob = {"version": 1, "native": buf.raw[:got],
                     "optimizer_blob": optimizer_blob,
                     "saved_at": time.time()}
-            tmp = path + ".tmp"
+            from .. import checkpoint as ckpt
             try:
-                with open(tmp, "wb") as f:
+                # atomic_write adds fsync + a CRC32 manifest entry on
+                # top of the tmp+rename this always did, so a restarted
+                # server detects a bit-rotted snapshot instead of
+                # preloading garbage state
+                with ckpt.atomic_write(path) as f:
                     pickle.dump(blob, f)
-                os.replace(tmp, path)
             except OSError:
                 # disk full / directory gone: the caller is a SIGTERM
                 # handler — it must still reach its restartable exit,
@@ -567,11 +570,24 @@ def _write_snapshot(lib, path, optimizer_blob):
 
 
 def _read_snapshot(path):
+    import sys
+
+    from .. import checkpoint as ckpt
     try:
+        # CRC gate: a snapshot whose bytes do not match the manifest
+        # entry is never preloaded as key-store state — it is logged,
+        # counted, and treated as absent (the server starts empty)
+        ckpt.verify(path)
         with open(path, "rb") as f:
             snap = pickle.load(f)
         if isinstance(snap, dict) and snap.get("version") == 1:
             return snap
+    except MXNetError as e:
+        print("kvstore server: snapshot %s failed CRC verification — "
+              "starting empty (%s)" % (path, e), file=sys.stderr,
+              flush=True)
+        profiler.note_checkpoint_rejected({"path": path,
+                                           "reason": "snapshot_crc"})
     except (OSError, ValueError, pickle.UnpicklingError, EOFError):
         pass
     return None

@@ -32,6 +32,16 @@ Each ``;``-separated directive is ``kind[=arg]`` followed by
 ``reject_accept=<count>``
     (server accept seam) close the next ``count`` accepted connections
     before rendezvous (exercises connect retry).
+``kill_worker``
+    (worker checkpoint seam, Python-side) SIGTERM THIS worker when its
+    global batch counter reaches ``batch=N`` (checkpoint.py
+    PreemptionGuard.batch_done; the counter is restored on resume, so a
+    fired kill never refires after its own recovery).
+``trunc_checkpoint`` / ``corrupt_checkpoint``
+    (checkpoint write seam, Python-side) truncate / flip one byte of
+    the Nth atomic checkpoint write (``round=N``, default the next one)
+    AFTER its CRC is recorded — the torn-write/bitrot damage the
+    MANIFEST.json must reject at load.
 
 Conditions: ``round=N`` (Nth distinct matching request, counted PER
 RANK so interleaving across workers cannot move the firing point, and
@@ -39,7 +49,9 @@ a resend of the same request never re-advances the count; for
 kill/die rules: a key's Nth completed merge round), ``key=K``,
 ``op=<init|push|pull|pull_rows|barrier|command>``, ``rank=R`` (only
 workers with DMLC_WORKER_ID == R install the rule), ``server=S``
-(only server S installs it). A ``round=``-conditioned client rule defaults to
+(only server S installs it), ``batch=N`` (kill_worker only: the
+worker's global batch counter value to preempt at).
+A ``round=``-conditioned client rule defaults to
 ``op=push`` — "round" means a BSP round, and the client opens one with
 its push. Unknown kinds or conditions raise ``MXNetError`` — a typo'd
 plan silently injecting nothing would be worse than no plan.
@@ -69,6 +81,15 @@ KIND_CODES = {
     "die_server": 6,
 }
 SERVER_KINDS = ("kill_server", "die_server", "reject_accept")
+# Python-side checkpoint/preemption faults (mxnet_tpu/checkpoint.py):
+# they never reach the native transport seams — install_client_rules /
+# install_server_rules skip them. ``kill_worker@batch=N`` raises SIGTERM
+# in the worker when its GLOBAL batch counter (PreemptionGuard, restored
+# on resume) hits N; ``trunc_checkpoint``/``corrupt_checkpoint`` mutate
+# the Nth atomic checkpoint write (``round=N``, default next) after its
+# CRC is recorded, modelling the torn-write/bitrot damage the manifest
+# must reject at load.
+CHECKPOINT_KINDS = ("kill_worker", "trunc_checkpoint", "corrupt_checkpoint")
 # wire op codes (comm.cc kInit..kPullRows)
 OP_CODES = {
     "init": 1,
@@ -79,7 +100,7 @@ OP_CODES = {
     "push_2bit": 6,
     "pull_rows": 7,
 }
-_CONDS = ("round", "key", "op", "rank", "server")
+_CONDS = ("round", "key", "op", "rank", "server", "batch")
 
 
 @dataclass
@@ -91,11 +112,16 @@ class FaultRule:
     op: str | None = None
     rank: int | None = None
     server: int | None = None
+    batch: int | None = None  # kill_worker: global batch to die at
 
     @property
     def is_server_side(self) -> bool:
         return self.kind in SERVER_KINDS or (
             self.kind == "delay_ms" and self.server is not None)
+
+    @property
+    def is_checkpoint_side(self) -> bool:
+        return self.kind in CHECKPOINT_KINDS
 
 
 def parse_fault_plan(plan: str) -> list[FaultRule]:
@@ -110,10 +136,11 @@ def parse_fault_plan(plan: str) -> list[FaultRule]:
         head, *conds = directive.split("@")
         kind, _, argtxt = head.partition("=")
         kind = kind.strip()
-        if kind not in KIND_CODES:
+        if kind not in KIND_CODES and kind not in CHECKPOINT_KINDS:
             raise MXNetError(
                 f"unknown fault kind {kind!r} in MXNET_KVSTORE_FAULT_PLAN "
-                f"directive {directive!r} (known: {sorted(KIND_CODES)})")
+                f"directive {directive!r} (known: "
+                f"{sorted(KIND_CODES) + sorted(CHECKPOINT_KINDS)})")
         rule = FaultRule(kind=kind)
         if argtxt:
             try:
@@ -152,8 +179,28 @@ def parse_fault_plan(plan: str) -> list[FaultRule]:
             raise MXNetError(
                 f"fault {directive!r}: {rule.kind} needs round=N (the "
                 "merge round to die at)")
+        if rule.kind == "kill_worker" and rule.batch is None:
+            raise MXNetError(
+                f"fault {directive!r}: kill_worker needs batch=N (the "
+                "global batch to preempt at)")
+        if rule.batch is not None and rule.kind != "kill_worker":
+            raise MXNetError(
+                f"fault {directive!r}: batch=N only applies to "
+                "kill_worker")
+        if rule.is_checkpoint_side:
+            # the contract is fail-loudly: a condition the Python-side
+            # seams never read must not be silently dropped
+            allowed = {"kill_worker": ("batch", "rank"),
+                       "trunc_checkpoint": ("round", "rank"),
+                       "corrupt_checkpoint": ("round", "rank")}[rule.kind]
+            ignored = [c for c in _CONDS
+                       if getattr(rule, c) is not None and c not in allowed]
+            if ignored:
+                raise MXNetError(
+                    f"fault {directive!r}: condition(s) {ignored} do not "
+                    f"apply to {rule.kind} (allowed: {list(allowed)})")
         if (rule.round is not None and rule.op is None
-                and not rule.is_server_side):
+                and not rule.is_server_side and not rule.is_checkpoint_side):
             # "round" on a client rule means a BSP round, which the
             # client opens with its push
             rule.op = "push"
@@ -175,7 +222,7 @@ def install_client_rules(lib, rules, worker_rank=None):
         worker_rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
     n = 0
     for r in rules:
-        if r.is_server_side:
+        if r.is_server_side or r.is_checkpoint_side:
             continue
         if r.rank is not None and r.rank != worker_rank:
             continue
@@ -193,7 +240,7 @@ def install_server_rules(lib, rules, server_id=None):
         server_id = int(os.environ.get("DMLC_SERVER_ID", "0"))
     n = 0
     for r in rules:
-        if not r.is_server_side:
+        if not r.is_server_side or r.is_checkpoint_side:
             continue
         if r.server is not None and r.server != server_id:
             continue

@@ -250,7 +250,8 @@ class KVStore:
                 "or use update_on_kvstore=False")
         if self._updater is None:
             raise MXNetError("no optimizer set")
-        with open(fname, "wb") as f:
+        from ..checkpoint import atomic_write
+        with atomic_write(fname) as f:
             f.write(self._updater.get_states(dump_optimizer))
 
     def load_optimizer_states(self, fname):
@@ -261,6 +262,8 @@ class KVStore:
                 "or use update_on_kvstore=False")
         if self._updater is None:
             raise MXNetError("no optimizer set")
+        from ..checkpoint import verify
+        verify(fname)
         with open(fname, "rb") as f:
             self._updater.set_states(f.read())
 

@@ -81,6 +81,20 @@ _ENV_VARS = {
         "1 = do NOT enter the server loop at import in a "
         "DMLC_ROLE=server process (the reference always enters; "
         "kvstore_server.py)"),
+    "MXNET_CHECKPOINT_MANIFEST": (
+        "0 disables the CRC32 MANIFEST.json that atomic checkpoint "
+        "writes record and loads verify; worker resume still works but "
+        "without CRC proof (default on; checkpoint.py, "
+        "docs/robustness.md)"),
+    "MXNET_WORKER_CHECKPOINT_DIR": (
+        "per-worker directory for CheckpointManager training-state "
+        "checkpoints; set automatically by tools/launch.py "
+        "--restart-policy=worker so a respawned worker auto-resumes "
+        "(checkpoint.py)"),
+    "MXNET_WORKER_RESTARTS": (
+        "how many times tools/launch.py has respawned this worker "
+        "after preemption (set by the launcher; recorded in resume "
+        "telemetry, checkpoint.py)"),
 }
 
 

@@ -3,6 +3,12 @@
 Format matches the reference: ``prefix-symbol.json`` (graph JSON) +
 ``prefix-####.params`` (NDArray map with ``arg:``/``aux:`` key
 prefixes), so checkpoints are structurally diffable against MXNet's.
+Both files are written through ``checkpoint.atomic_write`` (tmp +
+fsync + rename, CRC32 in the sibling MANIFEST.json) via
+``symbol.save``/``nd.save``, so a preemption mid-checkpoint leaves the
+previous epoch's files intact and a corrupted ``.params`` is rejected
+by CRC at ``load_checkpoint`` time instead of loading as wrong weights
+(docs/robustness.md "Worker recovery & checkpoint format").
 """
 from __future__ import annotations
 

@@ -357,7 +357,9 @@ class Module(BaseModule):
 
     def save_optimizer_states(self, fname):
         import pickle
-        with open(fname, "wb") as f:
+
+        from ..checkpoint import atomic_write
+        with atomic_write(fname) as f:
             states = {}
             for i, s in self._opt_states.items():
                 states[i] = _state_to_numpy(s)
@@ -365,6 +367,9 @@ class Module(BaseModule):
 
     def load_optimizer_states(self, fname):
         import pickle
+
+        from ..checkpoint import verify
+        verify(fname)
         with open(fname, "rb") as f:
             states = pickle.load(f)
         self._opt_states = {i: _state_from_numpy(s)

@@ -3,27 +3,47 @@
 Container format: numpy .npz with a key-order manifest. Not byte-compatible
 with the reference's dmlc binary format, but the API contract (list or
 str->NDArray dict round trip, used by save_checkpoint / load_parameters) is
-preserved.
+preserved. Writes are preemption-safe: every file goes through
+checkpoint.atomic_write (tmp + fsync + rename, CRC32 recorded in the
+directory's MANIFEST.json), and load() CRC-verifies against that manifest
+before deserializing — a torn or bit-flipped checkpoint raises MXNetError
+instead of loading as wrong weights (docs/robustness.md).
 """
 from __future__ import annotations
 
 import numpy as np
 
+from ..base import MXNetError
 from .ndarray import NDArray, array
 
 _LIST_PREFIX = "__list__:"
 
 
+def _coerce(key, value):
+    """NDArray/numpy -> numpy payload; anything else is a clear
+    TypeError (the reference raised a bare AttributeError from
+    v.asnumpy() on plain numpy inputs)."""
+    if isinstance(value, NDArray):
+        return value.asnumpy()
+    if isinstance(value, np.ndarray):
+        return value
+    raise TypeError(
+        f"nd.save: value for {key!r} must be an NDArray or numpy "
+        f"ndarray, got {type(value).__name__}")
+
+
 def save(fname, data):
+    from .. import checkpoint as ckpt
     if isinstance(data, NDArray):
         data = [data]
     if isinstance(data, (list, tuple)):
-        payload = {f"{_LIST_PREFIX}{i}": d.asnumpy() for i, d in enumerate(data)}
+        payload = {f"{_LIST_PREFIX}{i}": _coerce(i, d)
+                   for i, d in enumerate(data)}
     elif isinstance(data, dict):
-        payload = {k: v.asnumpy() for k, v in data.items()}
+        payload = {k: _coerce(k, v) for k, v in data.items()}
     else:
         raise TypeError("save expects NDArray, list or dict of NDArrays")
-    with open(fname, "wb") as f:
+    with ckpt.atomic_write(fname) as f:
         np.savez(f, **payload)
 
 
@@ -35,16 +55,45 @@ def _from_npz(npz):
     return {k: array(npz[k]) for k in keys}
 
 
+def _decode_error(name, head, exc):
+    """One MXNetError naming the file and the probable cause: a
+    recognizable container that failed to decode is a torn write; an
+    unrecognizable header is the wrong format."""
+    from .ref_serde import is_reference_format
+    if head[:2] == b"PK" or is_reference_format(head):
+        cause = ("probable torn/truncated write — the container header "
+                 "is valid but its contents do not decode")
+    else:
+        cause = ("not a recognized NDArray container (npz or reference "
+                 ".params) — wrong format or completely garbled")
+    return MXNetError(f"failed to load NDArray file {name}: {cause} "
+                      f"[{type(exc).__name__}: {exc}]")
+
+
 def load(fname):
+    from .. import checkpoint as ckpt
+    from .ref_serde import is_reference_format
+
+    # CRC gate first: a manifest-listed file with ANY flipped or missing
+    # byte is rejected here, before any decoder can mis-read it
+    ckpt.verify(fname)
     with open(fname, "rb") as f:
         head = f.read(8)
-    from .ref_serde import is_reference_format
     if is_reference_format(head):
         # reference-format .params checkpoints load transparently
         with open(fname, "rb") as f:
-            return load_frombuffer(f.read())
-    with np.load(fname, allow_pickle=False) as npz:
-        return _from_npz(npz)
+            buf = f.read()
+        try:
+            from .ref_serde import load_reference_buffer
+            return {k: array(v)
+                    for k, v in load_reference_buffer(buf).items()}
+        except Exception as e:  # noqa: BLE001 — surface one clean error
+            raise _decode_error(fname, head, e) from e
+    try:
+        with np.load(fname, allow_pickle=False) as npz:
+            return _from_npz(npz)
+    except Exception as e:  # noqa: BLE001 — BadZipFile/ValueError/...
+        raise _decode_error(fname, head, e) from e
 
 
 def load_frombuffer(buf):
@@ -56,6 +105,13 @@ def load_frombuffer(buf):
 
     from .ref_serde import is_reference_format, load_reference_buffer
     if is_reference_format(buf):
-        return {k: array(v) for k, v in load_reference_buffer(buf).items()}
-    with np.load(_io.BytesIO(buf), allow_pickle=False) as npz:
-        return _from_npz(npz)
+        try:
+            return {k: array(v)
+                    for k, v in load_reference_buffer(buf).items()}
+        except Exception as e:  # noqa: BLE001
+            raise _decode_error("<buffer>", bytes(buf[:8]), e) from e
+    try:
+        with np.load(_io.BytesIO(buf), allow_pickle=False) as npz:
+            return _from_npz(npz)
+    except Exception as e:  # noqa: BLE001
+        raise _decode_error("<buffer>", bytes(buf[:8]), e) from e

@@ -217,6 +217,19 @@ def note_recovery(args):
                  "kvstore_recovery", _now_us(), 0, args=dict(args))
 
 
+def note_worker_resume(args):
+    """Record one worker auto-resume (checkpoint.py
+    CheckpointManager.resume_latest): step, checkpoint path, restart
+    count — the whole-job-survivability half of recovery telemetry."""
+    note_recovery(dict(args, outcome="worker_resume"))
+
+
+def note_checkpoint_rejected(args):
+    """Record one torn/corrupt checkpoint skipped at resume time
+    (CRC/manifest validation failed)."""
+    note_recovery(dict(args, outcome="checkpoint_rejected"))
+
+
 def recovery_incidents():
     with _lock:
         return [dict(a) for a in _recovery_incidents]
@@ -237,6 +250,11 @@ def recovery_summary():
         "reconnects": sum(int(a.get("reconnects", 0)) for a in incidents),
         "backoff_wait_ms": round(sum(
             float(a.get("backoff_wait_ms", 0.0)) for a in incidents), 3),
+        "worker_resumes": sum(1 for a in incidents
+                              if a.get("outcome") == "worker_resume"),
+        "checkpoints_rejected": sum(
+            1 for a in incidents
+            if a.get("outcome") == "checkpoint_rejected"),
         "last": incidents[-1] if incidents else None,
     }
     return summary

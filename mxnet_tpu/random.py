@@ -35,6 +35,21 @@ def seed(seed_state, ctx="all"):
     _state.key = jax.random.PRNGKey(int(seed_state))
 
 
+def get_state():
+    """Snapshot the calling thread's key chain as a host array —
+    checkpointable (checkpoint.py CheckpointManager) and restorable via
+    :func:`set_state` for bit-exact resume of every later draw."""
+    import numpy as np
+    return np.asarray(_get_key()).copy()
+
+
+def set_state(state):
+    """Restore a key chain captured by :func:`get_state`."""
+    import jax.numpy as jnp
+    import numpy as np
+    _state.key = jnp.asarray(np.asarray(state, dtype=np.uint32))
+
+
 def next_key():
     stack = getattr(_state, "override", None)
     if stack:

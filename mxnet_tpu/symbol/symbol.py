@@ -441,7 +441,8 @@ class Symbol:
         }, indent=2)
 
     def save(self, fname):
-        with open(fname, "w") as f:
+        from ..checkpoint import atomic_write
+        with atomic_write(fname, mode="w") as f:
             f.write(self.tojson())
 
     # -- execution ---------------------------------------------------------

@@ -1,0 +1,82 @@
+"""Worker script for the preemption/auto-resume acceptance scenario
+(tests/test_checkpoint.py, slow half).
+
+A deterministic single-worker training loop over a SHUFFLING
+NDArrayIter: every batch does one exact-arithmetic SGD step (integer
+data, power-of-two learning rate — float addition is associative-exact,
+so any divergence is a real state bug, not rounding). Each finished
+batch writes a full CheckpointManager checkpoint (params + RNG +
+iterator position) into MXNET_WORKER_CHECKPOINT_DIR.
+
+With MXNET_KVSTORE_FAULT_PLAN=kill_worker@batch=N armed, the
+PreemptionGuard SIGTERMs this process at global batch N; the loop
+finishes the in-flight batch, the final checkpoint is already on disk,
+and the process exits with WORKER_RESTART_EXITCODE. tools/launch.py
+--restart-policy=worker respawns it; the respawn auto-resumes from the
+newest CRC-valid manifest and must print the SAME final digest an
+uninterrupted run prints.
+"""
+import hashlib
+import os
+import sys
+
+import numpy as np
+
+from mxnet_tpu import checkpoint as ckpt
+from mxnet_tpu.io import NDArrayIter
+
+TOTAL_BATCHES = 18
+BATCH = 8
+LR = np.float32(0.5)  # power of two: exact in float32
+
+
+def main():
+    ckpt_dir = ckpt.worker_checkpoint_dir()
+    if not ckpt_dir:
+        print("dist_worker_resume.py: MXNET_WORKER_CHECKPOINT_DIR unset "
+              "(run under tools/launch.py --restart-policy=worker)",
+              file=sys.stderr)
+        return 2
+
+    # exact small integers, deterministic content
+    data = (np.arange(64, dtype=np.float32) % 13).reshape(32, 2)
+    it = NDArrayIter(data, batch_size=BATCH, shuffle=True, seed=13)
+    guard = ckpt.PreemptionGuard()
+    mgr = ckpt.CheckpointManager(ckpt_dir, keep=3)
+
+    w = np.zeros(2, np.float32)
+    epoch = 0
+    state = mgr.resume_latest(data_iter=it)
+    if state is not None:
+        w = state["params"]["w"].asnumpy().copy()
+        epoch = int(state["extra"]["epoch"])
+        guard.batches = int(state["step"])
+        print("RESUMED step=%d epoch=%d" % (state["step"], epoch),
+              flush=True)
+
+    step = guard.batches
+    while step < TOTAL_BATCHES:
+        try:
+            batch = it.next()
+        except StopIteration:
+            epoch += 1
+            it.reset()
+            batch = it.next()
+        # one exact SGD step on the batch mean
+        w = w - LR * batch.data[0].asnumpy().mean(axis=0, dtype=np.float32)
+        step += 1
+        preempted = guard.batch_done()
+        mgr.save(step, params={"w": w}, data_iter=it,
+                 extra={"epoch": epoch})
+        if preempted:
+            print("PREEMPTED step=%d" % step, flush=True)
+            guard.exit_for_restart()
+
+    digest = hashlib.sha256(w.tobytes()).hexdigest()[:16]
+    print("RESUME OK", flush=True)
+    print("FINAL %s" % digest, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

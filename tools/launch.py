@@ -21,6 +21,12 @@ import sys
 import tempfile
 import time
 
+# mirror of mxnet_tpu.checkpoint.WORKER_RESTART_EXITCODE: the launcher
+# must stay importable without the package (and without jax), so the
+# value is pinned here and tests/test_checkpoint.py asserts the two
+# constants stay equal
+WORKER_RESTART_EXITCODE = 19
+
 
 def _free_port(span=1):
     """A root port with `span` consecutive free ports (servers bind
@@ -60,15 +66,23 @@ def main(argv=None):
     parser.add_argument("--env-server", default="",
                         help="extra KEY=VAL,... env for the server")
     parser.add_argument("--restart-policy", default="none",
-                        choices=["none", "server"],
+                        choices=["none", "server", "worker"],
                         help="'server': a server process that dies while "
                         "workers are still running is restarted (up to "
                         "--max-server-restarts times) with "
                         "MXNET_KVSTORE_SNAPSHOT_PATH wired so a SIGTERM'd "
                         "server snapshots its key store and the restart "
-                        "restores it — workers reconnect and resume "
-                        "(docs/robustness.md)")
+                        "restores it — workers reconnect and resume. "
+                        "'worker': a worker that exits with the "
+                        "preemption sentinel code (a SIGTERM'd worker "
+                        "that wrote its final checkpoint, "
+                        "checkpoint.WORKER_RESTART_EXITCODE) is "
+                        "respawned (up to --max-worker-restarts times) "
+                        "with MXNET_WORKER_CHECKPOINT_DIR wired so it "
+                        "auto-resumes from the newest CRC-valid "
+                        "checkpoint manifest (docs/robustness.md)")
     parser.add_argument("--max-server-restarts", type=int, default=3)
+    parser.add_argument("--max-worker-restarts", type=int, default=3)
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
     if not args.command:
@@ -91,6 +105,20 @@ def main(argv=None):
         # run_server) — the state-preserving half of server recovery
         snap_dir = tempfile.mkdtemp(prefix="mxtpu_kvsnap_")
 
+    wk_ckpt_root = None
+    own_ckpt_root = False
+    if args.restart_policy == "worker":
+        # per-job checkpoint root: each worker gets its own subdirectory
+        # (MXNET_WORKER_CHECKPOINT_DIR) where CheckpointManager writes
+        # CRC-manifested training-state checkpoints; a respawned worker
+        # auto-resumes from the newest valid one. An operator-provided
+        # MXNET_WORKER_CHECKPOINT_DIR survives the job (resume across
+        # launches); the tempdir fallback is cleaned up with the job.
+        wk_ckpt_root = os.environ.get("MXNET_WORKER_CHECKPOINT_DIR")
+        if not wk_ckpt_root:
+            wk_ckpt_root = tempfile.mkdtemp(prefix="mxtpu_wkckpt_")
+            own_ckpt_root = True
+
     def spawn_server(sidx):
         server_env = dict(base_env, DMLC_ROLE="server",
                           DMLC_SERVER_ID=str(sidx))
@@ -108,12 +136,18 @@ def main(argv=None):
 
     servers = [spawn_server(sidx) for sidx in range(nserv)]
 
-    workers = []
-    for i in range(args.num_workers):
-        env = dict(base_env, DMLC_ROLE="worker", DMLC_WORKER_ID=str(i))
-        workers.append(subprocess.Popen(args.command, env=env))
+    def spawn_worker(i, restarts=0):
+        env = dict(base_env, DMLC_ROLE="worker", DMLC_WORKER_ID=str(i),
+                   MXNET_WORKER_RESTARTS=str(restarts))
+        if wk_ckpt_root is not None:
+            env["MXNET_WORKER_CHECKPOINT_DIR"] = os.path.join(
+                wk_ckpt_root, "worker_%d" % i)
+        return subprocess.Popen(args.command, env=env)
+
+    workers = [spawn_worker(i) for i in range(args.num_workers)]
 
     restarts = [0] * nserv
+    wrestarts = [0] * args.num_workers
     if args.restart_policy == "server" and nserv > 0:
         # supervise: a server death while workers are still running is a
         # restartable fault, not the end of the job
@@ -132,6 +166,34 @@ def main(argv=None):
                       file=sys.stderr, flush=True)
                 servers[sidx] = spawn_server(sidx)
             time.sleep(0.2)
+    elif args.restart_policy == "worker":
+        # supervise: only the preemption sentinel is restartable — it
+        # means "final checkpoint written, respawn me and I resume".
+        # A crash (any other nonzero rc) left no such guarantee and
+        # fails the job as before. The respawn scan runs BEFORE the
+        # exit check so the last worker exiting with the sentinel is
+        # still restarted (a `while any(alive)` loop would quit first).
+        while True:
+            respawned = False
+            for widx, worker in enumerate(workers):
+                if worker.poll() is None:
+                    continue
+                if worker.returncode != WORKER_RESTART_EXITCODE:
+                    continue
+                if wrestarts[widx] >= args.max_worker_restarts:
+                    continue
+                wrestarts[widx] += 1
+                print("launch.py: worker %d preempted (rc=%d) — "
+                      "restart %d/%d, resuming from checkpoints"
+                      % (widx, worker.returncode, wrestarts[widx],
+                         args.max_worker_restarts),
+                      file=sys.stderr, flush=True)
+                workers[widx] = spawn_worker(widx, wrestarts[widx])
+                respawned = True
+            if not respawned and all(w.poll() is not None
+                                     for w in workers):
+                break
+            time.sleep(0.2)
 
     rc = 0
     for w in workers:
@@ -145,6 +207,8 @@ def main(argv=None):
             server.kill()
     if snap_dir is not None:
         shutil.rmtree(snap_dir, ignore_errors=True)
+    if own_ckpt_root:
+        shutil.rmtree(wk_ckpt_root, ignore_errors=True)
     return rc
 
 
