@@ -1,0 +1,33 @@
+"""Static analysis — the "check before you run" layer.
+
+The reference fork's compile-time graph passes (MKL-DNN subgraph
+partitioner, INT8 quantize_graph calibration) inspect and validate the
+NNVM graph before execution. This package is the TPU reproduction's
+analogue, with two engines:
+
+- :mod:`mxnet_tpu.analysis.lint` — a pluggable AST rule engine over the
+  package source. Each rule guards a silent performance or correctness
+  cliff of the JAX lowering (trace-time constant folding, hidden
+  device→host syncs, torn checkpoint writes, env-var/doc drift,
+  registry collisions). Rules carry stable codes (MXL001…), honor
+  ``# mxlint: disable=CODE`` inline suppressions and a committed
+  baseline (``tools/mxlint_baseline.json``) for grandfathered findings.
+
+- :mod:`mxnet_tpu.analysis.graph` — a static validator over a composed
+  :class:`~mxnet_tpu.symbol.symbol.Symbol` (the pre-bind analogue of the
+  reference's graph passes): dangling/duplicate argument names,
+  shape/dtype inference conflicts ahead of bind, unreachable serialized
+  nodes, quantize/dequantize pairing. Exposed as ``Symbol.validate()``
+  and run warn-only from ``simple_bind`` (``MXNET_GRAPH_VALIDATE``).
+
+CLI driver: ``python tools/mxlint.py`` (tier-1 gated by
+``tests/test_mxlint.py``). Catalogue: ``docs/static_analysis.md``.
+"""
+from .lint import (Finding, LintResult, Rule, baseline_hash, load_baseline,
+                   run_lint)
+from .graph import GraphFinding, validate_graph, validate_json
+
+__all__ = [
+    "Finding", "LintResult", "Rule", "baseline_hash", "load_baseline",
+    "run_lint", "GraphFinding", "validate_graph", "validate_json",
+]

@@ -1,0 +1,229 @@
+"""Symbol graph validator — the pre-bind analogue of the reference's
+compile-time graph passes (MKL-DNN partitioner legality checks, the
+INT8 quantize_graph pass; Relay's well-formedness/type checks make the
+same argument from the IR side).
+
+A composed :class:`Symbol` only fails today when ``bind`` lowers it
+through JAX — a dangling input or a mistyped edge surfaces as a deep
+tracer stack, naming nothing from the user's graph. ``validate_graph``
+walks the node DAG *statically* and reports, with node names:
+
+========  ==================================================
+GV001     duplicate node/argument names (bind dicts are keyed
+          by name — two vars named alike silently alias)
+GV002     dangling inputs: shape hints for names not in the
+          graph, and graph inputs left underdetermined
+GV003     shape-inference conflicts ahead of bind
+GV004     dtype-inference conflicts (elemwise/concat inputs of
+          differing dtypes silently promote + recompile; the
+          reference's FInferType rejects them)
+GV005     unreachable / structurally malformed serialized nodes
+GV006     quantization-pattern sanity: dequantize without a
+          quantize ancestor, int8 values escaping undequantized
+========  ==================================================
+
+Exposed as ``Symbol.validate()`` and run warn-only from
+``simple_bind`` (escalate with ``MXNET_GRAPH_VALIDATE=error``).
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+# ops whose array inputs must agree in dtype: under jnp they silently
+# promote (hidden upcast of the whole tensor + a recompile per new
+# dtype combo); the reference's FInferType fails them at bind
+_DTYPE_STRICT_PREFIXES = ("broadcast_", "elemwise_")
+_DTYPE_STRICT_OPS = {"Concat", "concat", "add_n", "stack", "dot",
+                     "batch_dot"}
+
+_QUANTIZE_OPS = {"_contrib_quantize", "_contrib_quantize_v2"}
+_DEQUANTIZE_OP = "_contrib_dequantize"
+
+
+class GraphFinding:
+    """One validator hit, anchored to a graph node by name."""
+
+    __slots__ = ("code", "node", "message")
+
+    def __init__(self, code, node, message):
+        self.code = code
+        self.node = node          # node name, or None for graph-level
+        self.message = message
+
+    def __repr__(self):
+        return f"GraphFinding({self.code}, {self.node!r}, {self.message!r})"
+
+    def __str__(self):
+        where = f" at {self.node!r}" if self.node else ""
+        return f"{self.code}{where}: {self.message}"
+
+
+def validate_graph(sym, shape_hints=None, dtype_hints=None):
+    """Statically validate a composed Symbol. ``shape_hints`` /
+    ``dtype_hints`` are the bind-time name->shape/dtype maps; passing
+    shape hints asserts bind-intent, enabling the underdetermined-input
+    check (a hint-less call runs structural checks only)."""
+    shape_hints = dict(shape_hints or {})
+    dtype_hints = dict(dtype_hints or {})
+    findings = []
+    order = sym._topo()
+    var_names = [n.name for n in order if n.op is None]
+
+    # GV001 — name collisions (two distinct nodes, one name)
+    seen = {}
+    for node in order:
+        prev = seen.get(node.name)
+        if prev is not None and prev is not node:
+            kind = ("argument" if node.op is None and prev.op is None
+                    else "node")
+            findings.append(GraphFinding(
+                "GV001", node.name,
+                f"duplicate {kind} name: bind/eval dicts are keyed by "
+                "name, so both nodes silently receive the same value"))
+        else:
+            seen[node.name] = node
+
+    # GV002 — hints that name nothing in the graph (the classic typo'd
+    # data name that today surfaces as a deep JAX trace error)
+    known = set(var_names)
+    for name in list(shape_hints) + list(dtype_hints):
+        if name not in known:
+            findings.append(GraphFinding(
+                "GV002", name,
+                f"shape/dtype hint for {name!r} matches no graph input; "
+                f"inputs are {sorted(known)}"))
+
+    # inference sweep, continuing past per-node failures
+    errors = []
+    shapes, dtypes = sym._infer(
+        shape_hints, dtype_hints, partial=False,
+        on_error=lambda node, exc, specs: errors.append((node, exc, specs)))
+
+    for node, exc, specs in errors:
+        msg = str(exc)
+        code = "GV004" if _looks_like_dtype_error(msg) else "GV003"
+        detail = ", ".join(f"{s}:{d}" for s, d in specs) if specs else "?"
+        findings.append(GraphFinding(
+            code, node.name,
+            f"{node.op} cannot infer output from inputs ({detail}): "
+            f"{msg}"))
+
+    # GV004 — silent-promotion edges (inference succeeded, dtypes mixed)
+    for node in order:
+        if node.op is None or not _dtype_strict(node.op):
+            continue
+        in_dts = {dtypes.get((id(c), k)) for c, k in node.inputs}
+        in_dts.discard(None)
+        if len(in_dts) > 1:
+            findings.append(GraphFinding(
+                "GV004", node.name,
+                f"{node.op} mixes input dtypes {sorted(in_dts)} — jnp "
+                "silently promotes (hidden upcast + recompile per "
+                "combo); insert an explicit Cast"))
+
+    # GV002 — underdetermined inputs, only when the caller asserted
+    # bind-intent by passing shape hints
+    if shape_hints:
+        for node in order:
+            if node.op is None and (id(node), 0) not in shapes:
+                findings.append(GraphFinding(
+                    "GV002", node.name,
+                    f"input {node.name!r} has no shape: not hinted, no "
+                    "__shape__ attr, and not back-inferable from its "
+                    "consumers — bind would fail inside shape inference"))
+
+    findings.extend(_check_quantization(order, sym))
+    return findings
+
+
+def _looks_like_dtype_error(msg):
+    low = msg.lower()
+    return any(t in low for t in ("dtype", "integer", "boolean", "type"))
+
+
+def _dtype_strict(op_name):
+    return op_name.startswith(_DTYPE_STRICT_PREFIXES) or \
+        op_name in _DTYPE_STRICT_OPS
+
+
+def _check_quantization(order, sym):
+    """GV006 — quantize/dequantize pairing over the node DAG (the sanity
+    half of the reference's quantize_graph pass)."""
+    if not any(node.op in _QUANTIZE_OPS or node.op == _DEQUANTIZE_OP
+               for node in order):
+        return []
+    findings = []
+    has_quant_anc = {}   # id(node) -> bool, quantize-domain ancestor
+    for node in order:
+        anc = False
+        for child, _k in node.inputs:
+            if child.op in _QUANTIZE_OPS or \
+                    has_quant_anc.get(id(child), False):
+                anc = True
+                break
+        has_quant_anc[id(node)] = anc
+        if node.op == _DEQUANTIZE_OP and not anc:
+            findings.append(GraphFinding(
+                "GV006", node.name,
+                "dequantize without a quantize ancestor — its min/max "
+                "inputs carry calibration for values that were never "
+                "quantized"))
+    # reverse sweep: does each quantize reach a dequantize?
+    consumers = {}
+    for node in order:
+        for child, _k in node.inputs:
+            consumers.setdefault(id(child), []).append(node)
+    reaches_deq = {}
+    for node in reversed(order):
+        r = any(c.op == _DEQUANTIZE_OP or reaches_deq.get(id(c), False)
+                for c in consumers.get(id(node), ()))
+        reaches_deq[id(node)] = r
+        if node.op in _QUANTIZE_OPS and not r:
+            findings.append(GraphFinding(
+                "GV006", node.name,
+                "quantize whose int8 values never reach a dequantize — "
+                "quantized outputs escape the graph uncalibrated"))
+    return findings
+
+
+def validate_json(json_str):
+    """Structural checks that need the *serialized* graph: a Symbol can
+    only hold reachable nodes, but a JSON file (hand-edited, version-
+    skewed, or truncated-then-'repaired') can carry orphans and
+    out-of-range edges. Returns GV005 findings."""
+    graph = json.loads(json_str)
+    nodes = graph.get("nodes", [])
+    heads = graph.get("heads") or [[len(nodes) - 1, 0, 0]]
+    findings = []
+    n = len(nodes)
+    for i, entry in enumerate(nodes):
+        for ref in entry.get("inputs", []):
+            if not (0 <= ref[0] < n):
+                findings.append(GraphFinding(
+                    "GV005", entry.get("name", f"#{i}"),
+                    f"input index {ref[0]} out of range (graph has {n} "
+                    "nodes) — truncated or corrupted symbol file"))
+    reachable = set()
+    stack = [h[0] for h in heads if 0 <= h[0] < n]
+    while stack:
+        i = stack.pop()
+        if i in reachable:
+            continue
+        reachable.add(i)
+        for ref in nodes[i].get("inputs", []):
+            if 0 <= ref[0] < n:
+                stack.append(ref[0])
+    for i, entry in enumerate(nodes):
+        if i not in reachable:
+            findings.append(GraphFinding(
+                "GV005", entry.get("name", f"#{i}"),
+                "node unreachable from any head — dead weight that "
+                "still participates in arg-name matching at load"))
+    return findings
+
+
+def shapes_from_args(arg_shapes):
+    """Normalize a {name: shape-like} map to tuples (CLI helper)."""
+    return {k: tuple(int(x) for x in v) for k, v in arg_shapes.items()}

@@ -1,0 +1,296 @@
+"""mxlint core — pluggable AST rule engine.
+
+Grown out of PR 2's single hard-coded atomic-write lint
+(``tests/test_atomic_write_lint.py``): same walk-the-package-AST idea,
+but with a shared parse, per-rule codes, inline suppressions and a
+committed baseline so the tier-1 gate enforces *new* findings only.
+
+Design points:
+
+- One ``ast.parse`` per module, shared by every rule (a rule sees
+  ``(path, tree, lines)`` and yields :class:`Finding`).
+- Cross-module rules (e.g. registry alias collisions) accumulate state
+  in ``check_module`` and emit from ``finalize``.
+- Suppression: ``# mxlint: disable=MXL001[,MXL002]`` (or ``all``) on
+  the finding's physical line, or on an immediately preceding
+  comment-only line (for calls that span lines).
+- Baseline entries match on ``(code, path, hash(normalized source
+  line))`` — NOT the line number — so grandfathered findings survive
+  unrelated edits above them, and a baseline entry whose line was
+  deleted is reported as stale instead of silently lingering.
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+
+# repo-root-relative default scan roots (package + tools drivers)
+DEFAULT_SCAN_DIRS = ("mxnet_tpu", "tools")
+
+_DISABLE_RE = re.compile(r"#\s*mxlint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+class Finding:
+    """One rule hit, anchored to a source line."""
+
+    __slots__ = ("code", "path", "lineno", "col", "message", "source")
+
+    def __init__(self, code, path, lineno, col, message, source=""):
+        self.code = code
+        self.path = path          # repo-root-relative, '/'-separated
+        self.lineno = lineno
+        self.col = col
+        self.message = message
+        self.source = source      # the physical source line (stripped)
+
+    def __repr__(self):
+        return (f"Finding({self.code}, {self.path}:{self.lineno}, "
+                f"{self.message!r})")
+
+    def format(self):
+        return f"{self.path}:{self.lineno}:{self.col}: {self.code} {self.message}"
+
+    @property
+    def hash(self):
+        return baseline_hash(self.source)
+
+
+class Rule:
+    """Base class for lint rules.
+
+    Subclasses set ``code``/``name``/``description`` and implement
+    ``check_module``; cross-module rules also override ``finalize``.
+    """
+
+    code = "MXL000"
+    name = "base"
+    description = ""
+
+    def check_module(self, path, tree, lines):
+        """Yield Findings for one parsed module. ``path`` is repo-root
+        relative; ``lines`` is the list of physical source lines."""
+        return ()
+
+    def finalize(self):
+        """Yield Findings that need the whole scan (cross-module state)."""
+        return ()
+
+    # -- helpers shared by rules ------------------------------------------
+    def finding(self, path, node, message, lines):
+        lineno = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        src = lines[lineno - 1].strip() if 0 < lineno <= len(lines) else ""
+        return Finding(self.code, path, lineno, col, message, src)
+
+
+def baseline_hash(source_line):
+    """Stable fingerprint of a finding's source line: whitespace-
+    normalized so reindentation doesn't invalidate baseline entries,
+    content-addressed so line-number drift doesn't either."""
+    norm = " ".join(source_line.split())
+    return hashlib.sha1(norm.encode("utf-8")).hexdigest()[:12]
+
+
+def _suppressed_codes(line):
+    m = _DISABLE_RE.search(line)
+    if not m:
+        return set()
+    return {c.strip() for c in m.group(1).split(",") if c.strip()}
+
+
+def _suppression_for(finding, lines):
+    """Codes disabled at a finding's location: its own line, plus any
+    run of comment-only lines immediately above it."""
+    codes = _suppressed_codes(finding.source)
+    i = finding.lineno - 2   # 0-based index of the preceding line
+    while i >= 0 and i < len(lines) and lines[i].lstrip().startswith("#"):
+        codes |= _suppressed_codes(lines[i])
+        i -= 1
+    return codes
+
+
+def iter_py_files(root, scan_dirs=DEFAULT_SCAN_DIRS):
+    """All .py files under the given repo-relative directories."""
+    for d in scan_dirs:
+        top = os.path.join(root, d)
+        if os.path.isfile(top) and top.endswith(".py"):
+            yield top
+            continue
+        for base, dirs, files in os.walk(top):
+            dirs[:] = [x for x in dirs if x != "__pycache__"]
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    yield os.path.join(base, name)
+
+
+def load_baseline(path):
+    """Parse a baseline file -> list of entry dicts. Missing file is an
+    empty baseline (the committed file may legitimately be empty)."""
+    if not path or not os.path.exists(path):
+        return []
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    entries = data.get("entries", [])
+    for e in entries:
+        for k in ("code", "path", "hash"):
+            if k not in e:
+                raise ValueError(
+                    f"baseline entry missing {k!r}: {e!r} (every entry "
+                    "needs code/path/hash and a justification)")
+    return entries
+
+
+def save_baseline(path, findings):
+    """Write the current findings as a fresh baseline (the
+    ``--update-baseline`` workflow). Justifications default to
+    FIXME so a blind regenerate is visible in review."""
+    entries = []
+    for f in sorted(findings, key=lambda f: (f.path, f.lineno, f.code)):
+        entries.append({
+            "code": f.code,
+            "path": f.path,
+            "hash": f.hash,
+            "line": f.source,   # informational; matching uses the hash
+            "justification": "FIXME: justify or fix",
+        })
+    # the baseline is a regenerable review artifact, not a checkpoint —
+    # atomic_write's CRC manifest would be noise  # mxlint: disable=MXL003
+    with open(path, "w", encoding="utf-8") as fp:
+        json.dump({"version": 1, "entries": entries}, fp, indent=2)
+        fp.write("\n")
+
+
+class LintResult:
+    """Outcome of a lint run, split by disposition."""
+
+    def __init__(self, findings, suppressed, baselined, stale_entries,
+                 errors):
+        self.findings = findings          # live findings (fail the run)
+        self.suppressed = suppressed      # silenced by inline disables
+        self.baselined = baselined        # matched a baseline entry
+        self.stale_entries = stale_entries  # baseline entries w/o a match
+        self.errors = errors              # [(path, message)] parse errors
+
+    @property
+    def ok(self):
+        # stale entries fail too: a baseline entry that matches nothing
+        # is either a fixed finding (delete it) or a silently weakened
+        # gate (fix it) — both want a human look
+        return not self.findings and not self.errors \
+            and not self.stale_entries
+
+    def format(self, show_baselined=False):
+        out = []
+        for f in sorted(self.findings, key=lambda f: (f.path, f.lineno)):
+            out.append(f.format())
+        for path, msg in self.errors:
+            out.append(f"{path}:1:0: MXL999 parse error: {msg}")
+        if show_baselined:
+            for f in sorted(self.baselined, key=lambda f: (f.path, f.lineno)):
+                out.append(f.format() + "  [baselined]")
+        for e in self.stale_entries:
+            out.append(
+                "%s: stale baseline entry %s %s (no longer matches any "
+                "finding — remove it)" % (e["path"], e["code"], e["hash"]))
+        return "\n".join(out)
+
+
+def run_lint(root, rules, files=None, baseline=None, changed_lines=None,
+             check_stale=None):
+    """Run ``rules`` over the package rooted at ``root``.
+
+    Parameters
+    ----------
+    root : repo root; findings carry paths relative to it.
+    rules : iterable of Rule instances.
+    files : explicit file list (defaults to DEFAULT_SCAN_DIRS walk).
+    baseline : list of baseline entries (see load_baseline).
+    changed_lines : optional {relpath: set(linenos)} filter — findings
+        outside it are dropped (the --diff mode). Baseline matching
+        still applies to what remains.
+    check_stale : report baseline entries that matched nothing. Defaults
+        to True for full scans, False when files/changed_lines narrow
+        the scan (a narrowed scan can't prove an entry stale).
+    """
+    rules = list(rules)
+    if files is None:
+        files = list(iter_py_files(root))
+        if check_stale is None:
+            check_stale = changed_lines is None
+    elif check_stale is None:
+        check_stale = False
+    raw, errors, sources = [], [], {}
+    for path in files:
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                text = f.read()
+            tree = ast.parse(text, filename=path)
+        except (OSError, SyntaxError) as e:
+            errors.append((rel, str(e)))
+            continue
+        lines = text.splitlines()
+        sources[rel] = lines
+        for rule in rules:
+            raw.extend(rule.check_module(rel, tree, lines))
+    for rule in rules:
+        raw.extend(rule.finalize())
+
+    if changed_lines is not None:
+        raw = [f for f in raw
+               if f.lineno in changed_lines.get(f.path, ())]
+
+    live, suppressed, baselined = [], [], []
+    matched = set()   # indexes of baseline entries that fired
+    baseline = baseline or []
+    for f in raw:
+        codes = _suppression_for(f, sources.get(f.path, ()))
+        if f.code in codes or "all" in codes:
+            suppressed.append(f)
+            continue
+        hit = None
+        for i, e in enumerate(baseline):
+            # each entry consumes AT MOST ONE finding: a new copy-paste
+            # of a grandfathered line is a new violation, not free —
+            # n occurrences need n entries (save_baseline writes them)
+            if (i not in matched and e["code"] == f.code
+                    and e["path"] == f.path and e["hash"] == f.hash):
+                hit = i
+                break
+        if hit is not None:
+            matched.add(hit)
+            baselined.append(f)
+            continue
+        live.append(f)
+    stale = []
+    if check_stale:
+        stale = [e for i, e in enumerate(baseline) if i not in matched]
+    return LintResult(live, suppressed, baselined, stale, errors)
+
+
+def changed_lines_since(root, rev):
+    """{relpath: set(linenos)} of lines added/modified since git ``rev``
+    (the --diff incremental-enforcement mode)."""
+    import subprocess
+    out = subprocess.run(
+        ["git", "diff", "-U0", rev, "--", "*.py"],
+        cwd=root, capture_output=True, text=True, check=True).stdout
+    changed = {}
+    path = None
+    hunk = re.compile(r"^@@ -\d+(?:,\d+)? \+(\d+)(?:,(\d+))? @@")
+    for line in out.splitlines():
+        if line.startswith("+++ b/"):
+            path = line[6:]
+        elif line.startswith("+++"):
+            path = None   # deleted file
+        else:
+            m = hunk.match(line)
+            if m and path:
+                start = int(m.group(1))
+                count = int(m.group(2)) if m.group(2) is not None else 1
+                changed.setdefault(path, set()).update(
+                    range(start, start + count))
+    return changed

@@ -1,0 +1,63 @@
+"""mxlint rule set.
+
+Each module contributes one rule class with a stable ``MXLxxx`` code.
+``all_rules()`` instantiates a fresh set (rules are stateful across a
+run — cross-module rules accumulate in ``check_module`` and emit from
+``finalize`` — so never share instances between runs).
+
+| code   | rule                | guards against                            |
+|--------|---------------------|-------------------------------------------|
+| MXL001 | tracer-purity       | host syncs / trace-time constant folding / |
+|        |                     | nondeterminism inside jitted op bodies     |
+| MXL002 | host-sync-hot-path  | device→host syncs stalling the PJRT async  |
+|        |                     | stream in train/serve hot paths            |
+| MXL003 | atomic-write        | bare write-mode open() in checkpoint paths |
+| MXL004 | env-var-registry    | env vars read but unregistered in libinfo  |
+| MXL005 | registry-hygiene    | op name/alias collisions across ops/*      |
+"""
+from __future__ import annotations
+
+import ast
+
+
+def all_rules():
+    from .tracer_purity import TracerPurityRule
+    from .host_sync import HostSyncRule
+    from .atomic_write import AtomicWriteRule
+    from .env_registry import EnvRegistryRule
+    from .registry_hygiene import RegistryHygieneRule
+    return [TracerPurityRule(), HostSyncRule(), AtomicWriteRule(),
+            EnvRegistryRule(), RegistryHygieneRule()]
+
+
+# -- shared AST helpers ------------------------------------------------------
+
+def call_name(call):
+    """Dotted name of a Call's callee: 'open', 'np.asarray',
+    'time.time' — '' when the callee is not a plain name chain."""
+    return dotted_name(call.func)
+
+
+def dotted_name(node):
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def str_const(node):
+    """The value of a string-literal node, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def keyword_value(call, name):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None

@@ -1,0 +1,69 @@
+"""MXL003 — checkpoint-class writes must go through the atomic writer.
+
+PR 2 routed every checkpoint-bearing write (``*.params``, ``*.states``,
+symbol JSON, server snapshots) through ``checkpoint.atomic_write``
+(tmp + fsync + rename + CRC manifest). A bare write-mode ``open()``
+inside a function whose name marks it as a checkpoint writer
+(save*/snapshot*/checkpoint*/*_states) silently reintroduces
+torn-checkpoint corruption under preemption. This generalizes PR 2's
+hard-coded test (tests/test_atomic_write_lint.py, now retired) to an
+mxlint rule over all of ``mxnet_tpu/`` — including ``checkpoint.py``,
+which PR 2 allowlisted wholesale: its implementation opens
+(``atomic_write``'s tmp-file write, manifest staging) live in functions
+whose names don't match the writer regex, so they pass on their own;
+a future write-mode ``open()`` inside a ``save*``-named helper there
+gets flagged like anywhere else.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..lint import Rule
+
+_CHECKPOINT_FUNC = re.compile(r"(^|_)(save|snapshot|checkpoint)|_states$")
+
+
+def write_mode(call):
+    """The mode string of an open() call when it is a literal write
+    mode, else None (same classification as PR 2's test)."""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str) \
+            and any(c in mode.value for c in "wax+"):
+        return mode.value
+    return None
+
+
+class AtomicWriteRule(Rule):
+    code = "MXL003"
+    name = "atomic-write"
+    description = ("checkpoint-writing functions must use "
+                   "checkpoint.atomic_write, not bare open()")
+
+    def check_module(self, path, tree, lines):
+        if not path.startswith("mxnet_tpu/"):
+            return
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not _CHECKPOINT_FUNC.search(node.name):
+                continue
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call):
+                    continue
+                func = call.func
+                if not (isinstance(func, ast.Name) and func.id == "open"):
+                    continue
+                mode = write_mode(call)
+                if mode is not None:
+                    yield self.finding(
+                        path, call,
+                        f"checkpoint writer {node.name!r} opens a file "
+                        f"with bare open(mode={mode!r}) — use "
+                        "checkpoint.atomic_write (tmp+fsync+rename+CRC "
+                        "manifest) so preemption can't tear it", lines)

@@ -1,0 +1,83 @@
+"""MXL004 — every MXNET_*/MXTPU_* env var read must be registered.
+
+``libinfo._ENV_VARS`` is the canonical env-var list (the
+docs/faq/env_var.md analogue, kept next to the code). A
+``get_env("MXNET_FOO")`` call site whose name is missing from the
+registry means ``mx.libinfo.env_vars()`` and ``docs/env_vars.md``
+silently drift from what the code actually honors. Leading-underscore
+names (process-internal sentinels like ``_MXTPU_DIST_JOINED``) are
+exempt; ``DMLC_*`` belong to the launcher tracker contract and are
+checked by their own registry entries.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from ..lint import Rule
+from . import dotted_name, str_const
+
+_ENV_NAME = re.compile(r"^(MXNET|MXTPU)_[A-Z0-9_]+$")
+
+_READ_CALLS = {"get_env", "base.get_env", "os.getenv", "getenv",
+               "os.environ.get", "environ.get", "os.environ.setdefault",
+               "environ.setdefault"}
+
+
+def registered_env_vars(libinfo_path=None):
+    """Keys of libinfo._ENV_VARS, read via AST (no package import — the
+    linter must run without jax initialized)."""
+    if libinfo_path is None:
+        libinfo_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "..", "..",
+            "libinfo.py")
+    with open(libinfo_path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "_ENV_VARS" \
+                        and isinstance(node.value, ast.Dict):
+                    return {str_const(k) for k in node.value.keys
+                            if str_const(k)}
+    raise ValueError(f"no _ENV_VARS dict literal found in {libinfo_path}")
+
+
+class EnvRegistryRule(Rule):
+    code = "MXL004"
+    name = "env-var-registry"
+    description = ("every MXNET_*/MXTPU_* env var read names an entry in "
+                   "libinfo._ENV_VARS")
+
+    def __init__(self, registered=None, libinfo_path=None):
+        self._registered = (set(registered) if registered is not None
+                            else registered_env_vars(libinfo_path))
+
+    def _env_name(self, node):
+        """The MXNET_*/MXTPU_* literal an expression reads, else None."""
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name in _READ_CALLS and node.args:
+                s = str_const(node.args[0])
+                if s and _ENV_NAME.match(s):
+                    return s
+        if isinstance(node, ast.Subscript):
+            if dotted_name(node.value) in ("os.environ", "environ"):
+                s = str_const(node.slice)
+                if s and _ENV_NAME.match(s):
+                    return s
+        return None
+
+    def check_module(self, path, tree, lines):
+        if path.endswith("libinfo.py"):
+            return  # the registry itself
+        for node in ast.walk(tree):
+            name = self._env_name(node)
+            if name and name not in self._registered:
+                yield self.finding(
+                    path, node,
+                    f"env var {name} is read here but not registered in "
+                    "libinfo._ENV_VARS — mx.libinfo.env_vars() and "
+                    "docs/env_vars.md drift from the code (register it "
+                    "with a one-line description)", lines)

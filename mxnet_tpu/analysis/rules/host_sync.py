@@ -1,0 +1,80 @@
+"""MXL002 — no device→host syncs in training/serving hot paths.
+
+``engine.py`` exists to keep the PJRT async stream full: eager op
+dispatch returns futures, and the device works ahead of the Python
+thread. A single ``asnumpy()``/``block_until_ready()``/``waitall()``
+inside ``Trainer.step``, ``Module.forward/backward``, an optimizer
+``update`` or a kvstore ``push/pull`` drains that stream once per
+batch — the silent 2-10x step-time cliff the reference avoided with
+its threaded engine. Sites that *must* sync (the native TCP transport
+serializes to host; profiler-gated serialization) carry a baseline
+entry or an inline disable with the justification.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..lint import Rule
+
+# (path predicate, hot method names, module-local sync helper names) —
+# the framework's per-batch paths. Per-scope extra names keep module
+# spellings (metric.py's _as_np wrapper) out of the global rule
+_SCOPES = (
+    ("mxnet_tpu/gluon/trainer.py",
+     {"step", "update", "_update", "allreduce_grads", "_allreduce_grads"},
+     set()),
+    ("mxnet_tpu/module/",
+     {"forward", "backward", "update", "forward_backward"}, set()),
+    ("mxnet_tpu/executor.py", {"forward", "backward"}, set()),
+    ("mxnet_tpu/optimizer/", {"update", "update_multi_precision"}, set()),
+    ("mxnet_tpu/kvstore/",
+     {"push", "pull", "row_sparse_pull", "pushpull"}, set()),
+    ("mxnet_tpu/metric.py", {"update"}, {"_as_np"}),
+)
+
+# calls that block on (or copy from) the device stream
+_SYNC_ATTRS = {"asnumpy", "wait_to_read", "block_until_ready", "waitall"}
+_SYNC_NAMES = {"waitall", "block_until_ready"}
+
+
+def _hot_scope(path):
+    for prefix, methods, extra in _SCOPES:
+        if path.startswith(prefix):
+            return methods, _SYNC_NAMES | extra
+    return None, None
+
+
+class HostSyncRule(Rule):
+    code = "MXL002"
+    name = "host-sync-hot-path"
+    description = ("no asnumpy/wait_to_read/block_until_ready/waitall in "
+                   "Trainer.step / Module.forward+backward / optimizer "
+                   "update / kvstore push+pull / metric update")
+
+    def check_module(self, path, tree, lines):
+        methods, sync_names = _hot_scope(path)
+        if methods is None:
+            return
+        # top-level and class-level defs whose name marks a hot path
+        for scope in ast.walk(tree):
+            if not isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if scope.name not in methods:
+                continue
+            for node in ast.walk(scope):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                sync = None
+                if isinstance(func, ast.Attribute) and \
+                        func.attr in _SYNC_ATTRS:
+                    sync = func.attr
+                elif isinstance(func, ast.Name) and func.id in sync_names:
+                    sync = func.id
+                if sync is not None:
+                    yield self.finding(
+                        path, node,
+                        f"hot path {scope.name!r} calls {sync}() — stalls "
+                        "the PJRT async stream once per batch (keep the "
+                        "value on device; sync at read/report time "
+                        "instead)", lines)

@@ -1,0 +1,123 @@
+"""MXL005 — operator registry hygiene.
+
+Static half: op names and aliases declared by ``@register(...)`` /
+``register_op(...)`` across ``mxnet_tpu/ops/*`` must be globally
+unique. The runtime registry raises on a duplicate too — but only at
+first import, which in a server process is *after* deploy; the lint
+catches the collision in review. (Registrations with computed names —
+loops over tables — are invisible to the AST and covered by the
+runtime half.)
+
+Runtime half (:func:`runtime_registry_findings`, used by
+``tools/mxlint.py`` and the tier-1 test): every name ``list_ops()``
+reports must resolve to an OpDef that ``registry.infer_output`` can
+actually drive — callable fn, introspectable signature, and an input
+arity (``arg_names``/varargs/``num_inputs``) that can accept arrays.
+An op that imports but can't infer is unreachable by the Symbol layer:
+it would fail at first ``infer_shape`` in a composed graph.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..lint import Finding, Rule
+from . import call_name, keyword_value, str_const
+
+
+class RegistryHygieneRule(Rule):
+    code = "MXL005"
+    name = "registry-hygiene"
+    description = "op names/aliases unique across mxnet_tpu/ops/*"
+
+    def __init__(self):
+        self._seen = {}   # name -> (path, lineno, source)
+
+    def _declared_names(self, node):
+        """(name, aliases) a def/call statically registers, else None."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Name) and dec.id == "register":
+                    return node.name, []
+                if isinstance(dec, ast.Call) and \
+                        call_name(dec).split(".")[-1] == "register":
+                    name = None
+                    if dec.args:
+                        name = str_const(dec.args[0])
+                    kw = keyword_value(dec, "name")
+                    if kw is not None:
+                        name = str_const(kw) or name
+                    return name or node.name, self._alias_lits(dec)
+            return None
+        if isinstance(node, ast.Call) and \
+                call_name(node).split(".")[-1] == "register_op":
+            name = str_const(node.args[0]) if node.args else None
+            if name:
+                return name, self._alias_lits(node)
+        return None
+
+    @staticmethod
+    def _alias_lits(call):
+        kw = keyword_value(call, "aliases")
+        if isinstance(kw, (ast.Tuple, ast.List)):
+            return [s for s in (str_const(e) for e in kw.elts) if s]
+        return []
+
+    def check_module(self, path, tree, lines):
+        if not path.startswith("mxnet_tpu/ops/") or \
+                path.endswith("registry.py"):
+            return
+        for node in ast.walk(tree):
+            declared = self._declared_names(node)
+            if not declared:
+                continue
+            name, aliases = declared
+            for key in [name] + aliases:
+                prev = self._seen.get(key)
+                if prev is not None:
+                    yield self.finding(
+                        path, node,
+                        f"op name/alias {key!r} already registered at "
+                        f"{prev[0]}:{prev[1]} — the registry raises "
+                        "MXNetError at import; first import in prod is "
+                        "after deploy", lines)
+                else:
+                    lineno = getattr(node, "lineno", 1)
+                    src = (lines[lineno - 1].strip()
+                           if 0 < lineno <= len(lines) else "")
+                    self._seen[key] = (path, lineno, src)
+
+
+def runtime_registry_findings():
+    """Registry-hygiene checks that need the live registry (imports
+    mxnet_tpu — callers decide whether that cost is acceptable)."""
+    import inspect
+
+    from mxnet_tpu.ops import registry as _reg
+
+    findings = []
+
+    def _finding(msg):
+        findings.append(Finding(
+            RegistryHygieneRule.code, "mxnet_tpu/ops/registry.py", 1, 0,
+            msg, source=""))
+
+    for name, op in sorted(_reg.canonical_ops().items()):
+        if not callable(op.fn):
+            _finding(f"op {name!r}: fn is not callable")
+            continue
+        try:
+            inspect.signature(op.fn)
+        except (TypeError, ValueError) as e:
+            _finding(f"op {name!r}: signature not introspectable "
+                     f"({e}) — infer_output cannot bind attrs")
+            continue
+        if not op.arg_names and not op.has_varargs and \
+                op.num_inputs not in (0, None):
+            _finding(
+                f"op {name!r}: declares num_inputs={op.num_inputs} but "
+                "exposes no array parameters — unreachable by "
+                "infer_output / the Symbol layer")
+    for alias, op in _reg.alias_map().items():
+        if _reg.find(alias) is not op:
+            _finding(f"alias {alias!r} does not resolve to its OpDef")
+    return findings

@@ -1,0 +1,187 @@
+"""MXL001 — tracer purity of registered op bodies.
+
+Ops registered via ``ops/registry.py`` with ``wrap_jit=True`` execute
+under ``jax.jit``: their array arguments are tracers. Host
+materialization (``.asnumpy()``, ``np.asarray(arr)``), scalar coercion
+(``float(arr)``/``int(arr)``), sync calls (``wait_to_read``,
+``block_until_ready``) and wall-clock/RNG nondeterminism
+(``time.time()``, ``np.random.*``) inside such a body either raise a
+TracerError at first trace, or — worse — constant-fold at trace time
+and silently bake one batch's values into the compiled executable for
+every future call. This rule rejects them statically.
+
+Attrs (keyword params with defaults) are static under the jit wrapper,
+so ``int(stride)``-style coercions of attrs stay legal; only the
+*array* parameters (the same positional-no-default + known-arrayish
+classification ``OpDef.arg_names`` uses) are protected.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from ..lint import Rule
+from . import call_name, keyword_value, str_const
+
+# fallback only — the live set is extracted from ops/registry.py's
+# ``_arrayish`` literal at rule construction so the two cannot drift
+_ARRAYISH_FALLBACK = {"bias", "gamma", "state_cell", "sequence_length",
+                      "weight"}
+
+
+def registry_arrayish(registry_path=None):
+    """The always-array param names OpDef classifies with, read from
+    ops/registry.py via AST (no package import — same pattern as
+    env_registry's libinfo extraction)."""
+    if registry_path is None:
+        registry_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "..", "..",
+            "ops", "registry.py")
+    try:
+        with open(registry_path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return set(_ARRAYISH_FALLBACK)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name) and tgt.id == "_arrayish":
+                val = node.value
+                if isinstance(val, ast.BinOp):   # {...} | set(optional)
+                    val = val.left
+                if isinstance(val, ast.Set):
+                    return {e.value for e in val.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)}
+    return set(_ARRAYISH_FALLBACK)
+
+# receiver-independent sync calls: never legal under a tracer
+_SYNC_ATTRS = {"asnumpy", "wait_to_read", "block_until_ready"}
+
+# host-materializing numpy constructors (legal on static attrs only)
+_NP_MATERIALIZE = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+
+# wall-clock / process-RNG nondeterminism: constant-folds one trace's
+# value into the cached executable
+_NONDET_CALLS = {
+    "time.time", "time.monotonic", "time.perf_counter", "time.time_ns",
+    "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+    "datetime.datetime.utcnow", "uuid.uuid4",
+}
+_NONDET_PREFIXES = ("np.random.", "numpy.random.", "random.")
+
+
+def _is_register_decorator(dec):
+    """True, wrap_jit-bool for @register(...) / @register_op(...)."""
+    if isinstance(dec, ast.Name) and dec.id in ("register", "register_op"):
+        return True, True
+    if isinstance(dec, ast.Call):
+        name = call_name(dec)
+        if name.split(".")[-1] in ("register", "register_op"):
+            wj = keyword_value(dec, "wrap_jit")
+            if isinstance(wj, ast.Constant) and wj.value is False:
+                return True, False
+            return True, True
+    return False, True
+
+
+def _array_params(fn, dec, arrayish):
+    """The names an OpDef would classify as array arguments."""
+    needs_rng = False
+    extra_arrayish = set()
+    if isinstance(dec, ast.Call):
+        nr = keyword_value(dec, "needs_rng")
+        needs_rng = isinstance(nr, ast.Constant) and nr.value is True
+        oa = keyword_value(dec, "optional_arrays")
+        if isinstance(oa, (ast.Tuple, ast.List)):
+            extra_arrayish.update(
+                s for s in (str_const(e) for e in oa.elts) if s)
+    args = fn.args
+    pos = args.posonlyargs + args.args
+    n_default = len(args.defaults)
+    names = []
+    for i, a in enumerate(pos):
+        has_default = i >= len(pos) - n_default
+        if not has_default:
+            names.append(a.arg)
+        else:
+            d = args.defaults[i - (len(pos) - n_default)]
+            if (isinstance(d, ast.Constant) and d.value is None
+                    and a.arg in (arrayish | extra_arrayish)):
+                names.append(a.arg)
+    if args.vararg is not None:
+        names.append(args.vararg.arg)
+    if needs_rng and "key" in names:
+        names.remove("key")
+    return set(names)
+
+
+class TracerPurityRule(Rule):
+    code = "MXL001"
+    name = "tracer-purity"
+    description = ("no host syncs, array->scalar coercion, numpy "
+                   "materialization or nondeterminism inside jitted op "
+                   "bodies")
+
+    def __init__(self, arrayish=None):
+        self._arrayish = (set(arrayish) if arrayish is not None
+                          else registry_arrayish())
+
+    def check_module(self, path, tree, lines):
+        if not path.startswith("mxnet_tpu/ops/"):
+            return
+        for node in tree.body:
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for dec in node.decorator_list:
+                is_reg, wrap_jit = _is_register_decorator(dec)
+                if is_reg:
+                    if wrap_jit:
+                        yield from self._check_op(path, node, dec, lines)
+                    break
+
+    def _check_op(self, path, fn, dec, lines):
+        arrays = _array_params(fn, dec, self._arrayish)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            # .asnumpy() / .wait_to_read() / block_until_ready on anything
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _SYNC_ATTRS:
+                yield self.finding(
+                    path, node,
+                    f"op body {fn.name!r} calls .{node.func.attr}() — "
+                    "forces a device->host sync inside a jitted trace",
+                    lines)
+                continue
+            # float(x)/int(x)/bool(x) on an array parameter
+            if name in ("float", "int", "bool") and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Name) and arg.id in arrays:
+                    yield self.finding(
+                        path, node,
+                        f"op body {fn.name!r} coerces array argument "
+                        f"{arg.id!r} with {name}() — concretizes the "
+                        "tracer (TracerError, or trace-time constant "
+                        "folding)", lines)
+                continue
+            # np.asarray/np.array over an array parameter
+            if name in _NP_MATERIALIZE and any(
+                    isinstance(a, ast.Name) and a.id in arrays
+                    for a in node.args):
+                yield self.finding(
+                    path, node,
+                    f"op body {fn.name!r} passes an array argument to "
+                    f"{name}() — materializes the tracer on host (use "
+                    "jnp.asarray)", lines)
+                continue
+            # nondeterminism: wall clock / process RNG
+            if name in _NONDET_CALLS or name.startswith(_NONDET_PREFIXES):
+                yield self.finding(
+                    path, node,
+                    f"op body {fn.name!r} calls {name}() — nondeterministic "
+                    "value constant-folds into the cached executable at "
+                    "trace time (thread a jax PRNG key via needs_rng "
+                    "instead)", lines)

@@ -236,6 +236,8 @@ class Executor:
             outs, aux_updates = self._jitted_forward(bool(is_train))(
                 arg_vals, aux_vals, key)
             if prof or self._serialize_steps():
+                # profiler timing / NaiveEngine determinism: the sync IS
+                # the contract here  # mxlint: disable=MXL002
                 (outs, aux_updates) = jax.block_until_ready(
                     (outs, aux_updates))
         if is_train:
@@ -334,6 +336,8 @@ class Executor:
         with self._maybe_profile("executor_backward") as prof:
             grads = self._vjp(arg_vals, aux_vals, key, cotangents)
             if prof or self._serialize_steps():
+                # profiler timing / NaiveEngine determinism: intentional
+                # sync  # mxlint: disable=MXL002
                 grads = jax.block_until_ready(grads)
         for n in grad_names:
             req = self._grad_req[n]

@@ -95,6 +95,19 @@ _ENV_VARS = {
         "how many times tools/launch.py has respawned this worker "
         "after preemption (set by the launcher; recorded in resume "
         "telemetry, checkpoint.py)"),
+    "MXNET_GRAPH_VALIDATE": (
+        "Symbol.validate() gate in simple_bind: warn (default) logs "
+        "pre-bind graph findings, error raises, 0/off disables "
+        "(symbol/symbol.py, analysis/graph.py)"),
+    "MXTPU_IO_HOST_ENGINE": (
+        "1 (default) schedules io pipeline decode/prefetch on the "
+        "native host engine; 0 = plain thread fallback (io/io.py)"),
+    "MXTPU_COMPILE_CACHE": (
+        "persistent XLA compile-cache directory so warm runs skip "
+        "recompilation (tools/mfu_probe.py sets it per run)"),
+    "MXTPU_PEAK_TFLOPS": (
+        "per-chip peak TFLOPs used as the MFU denominator "
+        "(tools/mfu_probe.py; default 197 = v5p bf16)"),
 }
 
 

@@ -1,9 +1,20 @@
 """Evaluation metrics (ref: python/mxnet/metric.py).
 
-Same global+local accumulator protocol and registry as the reference.
+Same global+local accumulator protocol and registry as the reference,
+with one TPU-native change to the hot path: ``update`` never syncs.
+
+The reference (and PR histories of every MXNet fork) computes metrics
+by pulling predictions to host numpy every batch — on this runtime
+that is a per-batch ``device→host`` copy that drains the PJRT async
+stream ``engine.py`` works to keep full (mxlint MXL002). Here
+``update`` keeps NDArray inputs on device: the per-batch statistic is
+a lazily-scheduled jax scalar accumulated into ``sum_metric``, and the
+single host sync happens at read time (``get()``/``get_global()``),
+once per logging interval instead of once per batch.
 """
 from __future__ import annotations
 
+import jax.numpy as jnp
 import numpy as np
 
 from .base import registry as _registry
@@ -42,7 +53,32 @@ def create(metric, *args, **kwargs):
 
 
 def _as_np(x):
+    """Host materialization — metric *finalization* and user-callback
+    paths only; update() hot paths use _raw/_xp to stay on device."""
     return x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+
+
+def _raw(x):
+    """The backing array without a host sync: NDArray -> its (possibly
+    still in-flight) jax array; anything else -> host numpy."""
+    if isinstance(x, NDArray):
+        return x._data
+    return np.asarray(x)
+
+
+def _xp(*arrays):
+    """numpy for all-host inputs, jax.numpy as soon as one operand
+    lives on device — keeps host-only callers (tools, tests feeding
+    plain lists) off the device entirely."""
+    if all(isinstance(a, np.ndarray) for a in arrays):
+        return np
+    return jnp
+
+
+# batches buffered on device before the oldest is folded to host. By
+# then it was dispatched dozens of steps ago, so float() is a cheap
+# ready-buffer read, not a pipeline stall
+_PENDING_WINDOW = 64
 
 
 class EvalMetric:
@@ -50,15 +86,26 @@ class EvalMetric:
         self.name = str(name)
         self.output_names = output_names
         self.label_names = label_names
+        # accumulators initialized here, not only in reset(): subclasses
+        # (Composite, user metrics like ssd's MApMetric) override
+        # reset() without super(), and _drain() reads all of these
+        self._pending = []
+        self.num_inst = 0
+        self.sum_metric = 0.0
+        self.global_num_inst = 0
+        self.global_sum_metric = 0.0
         self.reset()
 
     def reset(self):
+        self._pending = []   # [(metric, count)] — possibly device scalars
         self.num_inst = 0
         self.sum_metric = 0.0
         self.global_num_inst = 0
         self.global_sum_metric = 0.0
 
     def reset_local(self):
+        # fold pending batches first: the global accumulators keep them
+        self._drain()
         self.num_inst = 0
         self.sum_metric = 0.0
 
@@ -66,17 +113,47 @@ class EvalMetric:
         raise NotImplementedError
 
     def _update(self, metric, count):
-        self.sum_metric += metric
-        self.num_inst += count
-        self.global_sum_metric += metric
-        self.global_num_inst += count
+        """Accumulate one batch. ``metric``/``count`` may be lazy jax
+        scalars: they buffer in a bounded window and fold into exact
+        python float64/int sums — device accumulation would cap exact
+        integer counts at float32's 2^24."""
+        self._pending.append((metric, count))
+        if len(self._pending) > _PENDING_WINDOW:
+            self._fold(len(self._pending) - _PENDING_WINDOW)
+
+    def _fold(self, n):
+        for metric, count in self._pending[:n]:
+            m = float(metric)
+            c = int(count)
+            self.sum_metric += m
+            self.num_inst += c
+            self.global_sum_metric += m
+            self.global_num_inst += c
+        del self._pending[:n]
+
+    def _drain(self):
+        """The device→host sync point: fold every buffered batch into
+        the host-precision sums at read time."""
+        self._fold(len(self._pending))
+        # subclasses may assign device scalars directly (micro-averaged
+        # confusion metrics): collapse those too
+        if not isinstance(self.sum_metric, float):
+            self.sum_metric = float(self.sum_metric)
+        if not isinstance(self.global_sum_metric, float):
+            self.global_sum_metric = float(self.global_sum_metric)
+        if not isinstance(self.num_inst, int):
+            self.num_inst = int(self.num_inst)
+        if not isinstance(self.global_num_inst, int):
+            self.global_num_inst = int(self.global_num_inst)
 
     def get(self):
+        self._drain()
         if self.num_inst == 0:
             return self.name, float("nan")
         return self.name, self.sum_metric / self.num_inst
 
     def get_global(self):
+        self._drain()
         if self.global_num_inst == 0:
             return self.name, float("nan")
         return self.name, self.global_sum_metric / self.global_num_inst
@@ -93,6 +170,14 @@ class EvalMetric:
         return f"EvalMetric: {dict(self.get_name_value())}"
 
 
+def _pair_lists(labels, preds):
+    if isinstance(labels, NDArray):
+        labels = [labels]
+    if isinstance(preds, NDArray):
+        preds = [preds]
+    return labels, preds
+
+
 @register
 class Accuracy(EvalMetric):
     def __init__(self, axis=1, name="accuracy", output_names=None,
@@ -101,18 +186,15 @@ class Accuracy(EvalMetric):
         self.axis = axis
 
     def update(self, labels, preds):
-        if isinstance(labels, NDArray):
-            labels = [labels]
-        if isinstance(preds, NDArray):
-            preds = [preds]
+        labels, preds = _pair_lists(labels, preds)
         for label, pred in zip(labels, preds):
-            pred = _as_np(pred)
-            label = _as_np(label)
+            pred = _raw(pred)
+            label = _raw(label)
             if pred.ndim > label.ndim:
                 pred = pred.argmax(axis=self.axis)
-            correct = (pred.astype(np.int64).ravel()
-                       == label.astype(np.int64).ravel()).sum()
-            self._update(float(correct), len(label.ravel()))
+            correct = (pred.astype("int32").ravel()
+                       == label.astype("int32").ravel()).sum()
+            self._update(correct, int(label.size))
 
 
 @register
@@ -123,16 +205,14 @@ class TopKAccuracy(EvalMetric):
         self.top_k = top_k
 
     def update(self, labels, preds):
-        if isinstance(labels, NDArray):
-            labels = [labels]
-        if isinstance(preds, NDArray):
-            preds = [preds]
+        labels, preds = _pair_lists(labels, preds)
         for label, pred in zip(labels, preds):
-            pred = _as_np(pred)
-            label = _as_np(label).astype(np.int64)
-            topk = np.argsort(-pred, axis=1)[:, :self.top_k]
+            pred = _raw(pred)
+            label = _raw(label).astype("int32")
+            xp = _xp(pred, label)
+            topk = xp.argsort(-pred, axis=1)[:, :self.top_k]
             correct = (topk == label.reshape(-1, 1)).any(axis=1).sum()
-            self._update(float(correct), len(label))
+            self._update(correct, int(label.shape[0]))
 
 
 class _ConfusionMatrixMetric(EvalMetric):
@@ -149,53 +229,56 @@ class _ConfusionMatrixMetric(EvalMetric):
             raise ValueError(f"average must be 'macro' or 'micro', got "
                              f"{average!r}")
         self.average = average
-        self._local = np.zeros(4)   # tp, fp, fn, tn — local window
-        self._global = np.zeros(4)  # same, since last full reset()
+        # integer counts (tp, fp, fn, tn): int32 on device stays exact
+        # to 2^31 where float32 accumulation would drop counts past 2^24
+        self._local = np.zeros(4, np.int64)    # local window
+        self._global = np.zeros(4, np.int64)   # since last full reset()
 
     def reset(self):
         super().reset()
-        self._local = np.zeros(4)
-        self._global = np.zeros(4)
+        self._local = np.zeros(4, np.int64)
+        self._global = np.zeros(4, np.int64)
 
     def reset_local(self):
         super().reset_local()
-        self._local = np.zeros(4)
+        self._local = np.zeros(4, np.int64)
 
     @staticmethod
-    def _score(c):
+    def _score(c, xp):
         raise NotImplementedError
 
     def update(self, labels, preds):
-        if isinstance(labels, NDArray):
-            labels = [labels]
-        if isinstance(preds, NDArray):
-            preds = [preds]
+        labels, preds = _pair_lists(labels, preds)
         for label, pred in zip(labels, preds):
             batch = _binary_confusion(label, pred)
+            xp = np if isinstance(batch, np.ndarray) else jnp
             if self.average == "macro":
                 # per-batch score averaged over batches (ref semantics)
-                self._update(self._score(batch), 1)
+                self._update(self._score(batch, xp), 1)
             else:  # micro: pooled confusion counts
-                self._local += batch
-                self._global += batch
-                self.sum_metric = self._score(self._local)
+                self._local = self._local + batch
+                self._global = self._global + batch
+                self.sum_metric = self._score(self._local, xp)
                 self.num_inst = 1
-                self.global_sum_metric = self._score(self._global)
+                self.global_sum_metric = self._score(self._global, xp)
                 self.global_num_inst = 1
 
 
 def _binary_confusion(label, pred):
-    """Return np.array([tp, fp, fn, tn]) for a binary batch."""
-    pred = _as_np(pred)
-    label = _as_np(label).ravel().astype(np.int64)
+    """tp/fp/fn/tn counts for a binary batch — on device for device
+    inputs (a 4-vector, not a sync)."""
+    pred = _raw(pred)
+    label = _raw(label)
+    xp = _xp(pred, label)
+    label = label.ravel().astype("int32")
     if pred.ndim > 1:
         pred = pred.argmax(axis=1)
-    pred = pred.ravel().astype(np.int64)
-    return np.array([
-        float(((pred == 1) & (label == 1)).sum()),
-        float(((pred == 1) & (label == 0)).sum()),
-        float(((pred == 0) & (label == 1)).sum()),
-        float(((pred == 0) & (label == 0)).sum()),
+    pred = pred.ravel().astype("int32")
+    return xp.stack([
+        ((pred == 1) & (label == 1)).sum(),
+        ((pred == 1) & (label == 0)).sum(),
+        ((pred == 0) & (label == 1)).sum(),
+        ((pred == 0) & (label == 0)).sum(),
     ])
 
 
@@ -206,11 +289,11 @@ class F1(_ConfusionMatrixMetric):
         super().__init__(name, output_names, label_names, average)
 
     @staticmethod
-    def _score(c):
-        tp, fp, fn, _ = c
-        prec = tp / max(tp + fp, 1e-12)
-        rec = tp / max(tp + fn, 1e-12)
-        return 2 * prec * rec / max(prec + rec, 1e-12)
+    def _score(c, xp):
+        tp, fp, fn, _ = c * 1.0   # float math; counts themselves stay int
+        prec = tp / xp.maximum(tp + fp, 1e-12)
+        rec = tp / xp.maximum(tp + fn, 1e-12)
+        return 2 * prec * rec / xp.maximum(prec + rec, 1e-12)
 
 
 @register
@@ -220,10 +303,10 @@ class MCC(_ConfusionMatrixMetric):
         super().__init__(name, output_names, label_names, average)
 
     @staticmethod
-    def _score(c):
-        tp, fp, fn, tn = c
-        denom = np.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
-        return (tp * tn - fp * fn) / max(denom, 1e-12)
+    def _score(c, xp):
+        tp, fp, fn, tn = c * 1.0  # float math: count products overflow int32
+        denom = xp.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+        return (tp * tn - fp * fn) / xp.maximum(denom, 1e-12)
 
 
 @register
@@ -232,18 +315,16 @@ class MAE(EvalMetric):
         super().__init__(name, output_names, label_names)
 
     def update(self, labels, preds):
-        if isinstance(labels, NDArray):
-            labels = [labels]
-        if isinstance(preds, NDArray):
-            preds = [preds]
+        labels, preds = _pair_lists(labels, preds)
         for label, pred in zip(labels, preds):
-            label = _as_np(label)
-            pred = _as_np(pred)
+            label = _raw(label)
+            pred = _raw(pred)
+            xp = _xp(label, pred)
             if label.ndim == 1:
                 label = label.reshape(label.shape[0], 1)
             if pred.ndim == 1:
                 pred = pred.reshape(pred.shape[0], 1)
-            self._update(float(np.abs(label - pred).mean()), 1)
+            self._update(xp.abs(label - pred).mean(), 1)
 
 
 @register
@@ -252,14 +333,11 @@ class MSE(EvalMetric):
         super().__init__(name, output_names, label_names)
 
     def update(self, labels, preds):
-        if isinstance(labels, NDArray):
-            labels = [labels]
-        if isinstance(preds, NDArray):
-            preds = [preds]
+        labels, preds = _pair_lists(labels, preds)
         for label, pred in zip(labels, preds):
-            label = _as_np(label)
-            pred = _as_np(pred)
-            self._update(float(((label.reshape(pred.shape) - pred) ** 2).mean()), 1)
+            label = _raw(label)
+            pred = _raw(pred)
+            self._update(((label.reshape(pred.shape) - pred) ** 2).mean(), 1)
 
 
 @register
@@ -268,9 +346,10 @@ class RMSE(MSE):
         super().__init__(name, output_names, label_names)
 
     def get(self):
+        self._drain()
         if self.num_inst == 0:
             return self.name, float("nan")
-        return self.name, np.sqrt(self.sum_metric / self.num_inst)
+        return self.name, float(np.sqrt(self.sum_metric / self.num_inst))
 
 
 @register
@@ -281,15 +360,15 @@ class CrossEntropy(EvalMetric):
         self.eps = eps
 
     def update(self, labels, preds):
-        if isinstance(labels, NDArray):
-            labels = [labels]
-        if isinstance(preds, NDArray):
-            preds = [preds]
+        labels, preds = _pair_lists(labels, preds)
         for label, pred in zip(labels, preds):
-            label = _as_np(label).ravel().astype(np.int64)
-            pred = _as_np(pred)
-            prob = pred[np.arange(label.shape[0]), label]
-            self._update(float(-np.log(prob + self.eps).sum()), label.shape[0])
+            label = _raw(label)
+            pred = _raw(pred)
+            xp = _xp(label, pred)
+            label = label.ravel().astype("int32")
+            prob = pred[xp.arange(label.shape[0]), label]
+            self._update(-xp.log(prob + self.eps).sum(),
+                         int(label.shape[0]))
 
 
 @register
@@ -308,25 +387,25 @@ class Perplexity(EvalMetric):
         self.axis = axis
 
     def update(self, labels, preds):
-        if isinstance(labels, NDArray):
-            labels = [labels]
-        if isinstance(preds, NDArray):
-            preds = [preds]
-        loss = 0.0
-        num = 0
+        labels, preds = _pair_lists(labels, preds)
         for label, pred in zip(labels, preds):
-            label = _as_np(label).ravel().astype(np.int64)
-            pred = _as_np(pred).reshape(-1, _as_np(pred).shape[-1])
-            probs = pred[np.arange(label.shape[0]), label]
+            label = _raw(label)
+            pred = _raw(pred)
+            xp = _xp(label, pred)
+            label = label.ravel().astype("int32")
+            pred = pred.reshape(-1, pred.shape[-1])
+            probs = pred[xp.arange(label.shape[0]), label]
+            num = label.shape[0]
             if self.ignore_label is not None:
                 ignore = label == self.ignore_label
-                probs = np.where(ignore, 1.0, probs)
-                num -= int(ignore.sum())
-            loss += float(-np.log(np.maximum(probs, 1e-10)).sum())
-            num += label.shape[0]
-        self._update(loss, num)
+                probs = xp.where(ignore, 1.0, probs)
+                # count stays lazy alongside the loss — drained together
+                num = num - ignore.sum()
+            loss = -xp.log(xp.maximum(probs, 1e-10)).sum()
+            self._update(loss, num)
 
     def get(self):
+        self._drain()
         if self.num_inst == 0:
             return self.name, float("nan")
         return self.name, float(np.exp(self.sum_metric / self.num_inst))
@@ -338,15 +417,13 @@ class PearsonCorrelation(EvalMetric):
         super().__init__(name, output_names, label_names)
 
     def update(self, labels, preds):
-        if isinstance(labels, NDArray):
-            labels = [labels]
-        if isinstance(preds, NDArray):
-            preds = [preds]
+        labels, preds = _pair_lists(labels, preds)
         for label, pred in zip(labels, preds):
-            label = _as_np(label).ravel()
-            pred = _as_np(pred).ravel()
-            r = np.corrcoef(label, pred)[0, 1]
-            self._update(float(r), 1)
+            label = _raw(label).ravel()
+            pred = _raw(pred).ravel()
+            xp = _xp(label, pred)
+            r = xp.corrcoef(label, pred)[0, 1]
+            self._update(r, 1)
 
 
 @register
@@ -358,8 +435,8 @@ class Loss(EvalMetric):
         if isinstance(preds, NDArray):
             preds = [preds]
         for pred in preds:
-            pred = _as_np(pred)
-            self._update(float(pred.sum()), pred.size)
+            pred = _raw(pred)
+            self._update(pred.sum(), int(pred.size))
 
 
 class CustomMetric(EvalMetric):
@@ -370,12 +447,11 @@ class CustomMetric(EvalMetric):
         self._feval = feval
 
     def update(self, labels, preds):
-        if isinstance(labels, NDArray):
-            labels = [labels]
-        if isinstance(preds, NDArray):
-            preds = [preds]
+        labels, preds = _pair_lists(labels, preds)
         for label, pred in zip(labels, preds):
-            res = self._feval(_as_np(label), _as_np(pred))
+            # user fevals are written against host numpy (the reference
+            # contract) — the sync is the API, not an accident
+            res = self._feval(_as_np(label), _as_np(pred))  # mxlint: disable=MXL002
             if isinstance(res, tuple):
                 metric, count = res
                 self._update(metric, count)

@@ -202,6 +202,13 @@ def alias_map():
     return dict(_OPS)
 
 
+def canonical_ops():
+    """{canonical name: OpDef}, aliases collapsed — one entry per OpDef
+    (the registry-hygiene walk and parity tools iterate real ops, not
+    every spelling)."""
+    return {op.name: op for op in _OPS.values()}
+
+
 @functools.lru_cache(maxsize=None)
 def infer_output(op_name, in_shapes_dtypes, attrs_items):
     """Shape/dtype inference via abstract evaluation (FInferShape/FInferType

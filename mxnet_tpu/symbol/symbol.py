@@ -327,11 +327,18 @@ class Symbol:
                 dtypes[ck] = src
                 defaulted.discard(ck)
 
-    def _infer(self, shape_hints, dtype_hints, partial=False):
+    def _infer(self, shape_hints, dtype_hints, partial=False,
+               on_error=None):
         """Forward-propagate (shape, dtype) through the graph via
         jax.eval_shape on each node's op fn (the one-pass analogue of
         the reference's iterative fixpoint in infer_graph_attr_pass.cc —
-        a DAG needs only one forward sweep)."""
+        a DAG needs only one forward sweep).
+
+        With ``on_error`` set (the Symbol.validate path), a node whose
+        inference fails is reported via ``on_error(node, exc, in_specs)``
+        and the sweep continues with that node's outputs unknown —
+        downstream nodes degrade to the partial dtype propagation
+        instead of cascading errors."""
         shapes, dtypes = {}, {}
         # var nodes whose dtype is the float32 *default* rather than
         # user-specified: candidates for retyping when the op they feed
@@ -359,6 +366,15 @@ class Symbol:
                 continue
             self._infer_param_shapes(node, shapes, dtypes)
             self._retype_param_inputs(node, dtypes, defaulted)
+            try:
+                opdef = _reg.get(node.op)
+            except MXNetError as e:
+                # unregistered op (hand-edited/version-skewed JSON):
+                # under a validator this is a finding, not a crash
+                if on_error is not None:
+                    on_error(node, e, ())
+                    continue
+                raise
             in_specs = []
             missing = False
             for child, k in node.inputs:
@@ -368,14 +384,13 @@ class Symbol:
                     break
                 in_specs.append((shapes[ck], dtypes[ck]))
             if missing:
-                if partial:
+                if partial or on_error is not None:
                     # dtype-only propagation (type inference without
                     # shapes): for ops whose dtype attr fixes EVERY
                     # output (a curated set — topk's dtype governs only
                     # the indices output, so a blanket rule mistypes
                     # its values) use the attr; otherwise outputs take
                     # the first known input dtype
-                    opdef = _reg.get(node.op)
                     dt = None
                     if node.op in _DTYPE_FIXES_OUTPUT_OPS:
                         dt = node.attrs.get(
@@ -394,7 +409,6 @@ class Symbol:
                 raise MXNetError(
                     f"cannot infer shape at {node.op}({node.name}): "
                     f"inputs {unknown} unknown")
-            opdef = _reg.get(node.op)
             specs = tuple(in_specs)
             if opdef.needs_rng:
                 key_spec = ((2,), "uint32")
@@ -405,6 +419,9 @@ class Symbol:
                 out = _reg.infer_output(node.op, specs,
                                         tuple(sorted(attrs.items())))
             except Exception as e:  # inference must explain the node
+                if on_error is not None:
+                    on_error(node, e, tuple(in_specs))
+                    continue
                 raise MXNetError(
                     f"shape inference failed at {node.op}({node.name}): {e}"
                 ) from None
@@ -477,6 +494,43 @@ class Symbol:
         results = [env[(id(n), k)] for n, k in self._outputs]
         return results[0] if len(results) == 1 else results
 
+    def validate(self, type_dict=None, **kwargs):
+        """Static pre-bind validation (ref: the compile-time graph
+        passes; Relay's well-formedness checks). ``kwargs`` are bind
+        shape hints by input name; ``type_dict`` maps names to dtypes.
+        Returns a list of :class:`~mxnet_tpu.analysis.graph
+        .GraphFinding` — empty when the graph is bind-clean. Reports
+        dangling/duplicate argument names, shape/dtype inference
+        conflicts and quantize/dequantize pairing *with node names*,
+        before JAX lowering turns them into deep trace errors."""
+        from ..analysis.graph import validate_graph
+        shape_hints = {k: tuple(v) for k, v in kwargs.items()
+                       if v is not None}
+        dtype_hints = {k: np.dtype(v).name
+                       for k, v in (type_dict or {}).items()}
+        return validate_graph(self, shape_hints, dtype_hints)
+
+    def _auto_validate(self, type_dict, shape_hints):
+        """simple_bind's warn-only validation gate. MXNET_GRAPH_VALIDATE:
+        'warn' (default) logs findings, 'error' raises, '0'/'off'
+        disables."""
+        from ..base import get_env
+        mode = str(get_env("MXNET_GRAPH_VALIDATE", "warn")).lower()
+        if mode in ("0", "off", "false", ""):
+            return
+        try:
+            issues = self.validate(type_dict=type_dict, **shape_hints)
+        except Exception:  # noqa: BLE001 — never mask the real bind error
+            return
+        if not issues:
+            return
+        msg = ("Symbol.validate: %d issue(s) found before bind:\n  %s"
+               % (len(issues), "\n  ".join(str(i) for i in issues)))
+        if mode == "error":
+            raise MXNetError(msg)
+        import warnings
+        warnings.warn(msg, stacklevel=3)
+
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
         from ..executor import Executor
@@ -501,8 +555,11 @@ class Symbol:
             # would silently freeze them under training
             self = self._maybe_partition(os.environ.get(
                 "MXNET_SUBGRAPH_BACKEND"))
-        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
         type_dict = type_dict or {}
+        # static pre-bind validation: report dangling inputs / dtype
+        # conflicts by node name instead of a deep JAX trace error
+        self._auto_validate(type_dict, kwargs)
+        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
         arg_types, _, aux_types = self.infer_type(**{
             k: v for k, v in type_dict.items()})
         args = {}

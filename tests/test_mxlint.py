@@ -1,0 +1,622 @@
+"""Tier-1 gate for the static-analysis subsystem (ISSUE 3).
+
+Three layers:
+
+1. **The repo is lint-clean at HEAD** — runs the same
+   ``tools/mxlint.py`` entry point CI and developers use, so the gate
+   and the CLI cannot drift. Every MXL rule is live on the whole tree:
+   a regression (say, a bare ``open()`` creeping back into a save path,
+   or an unregistered env var) fails this test with code + path:line.
+2. **Each rule fires on a known-bad fixture and stays quiet on a
+   known-good one** (the atomic-write cases are ported from the retired
+   ``tests/test_atomic_write_lint.py``, so PR 2's coverage does not
+   regress), plus suppression/baseline mechanics: inline disables,
+   hash-based matching surviving line drift, stale-entry detection.
+3. **The Symbol graph validator** catches dangling inputs, duplicate
+   names, shape/dtype conflicts and broken quantize/dequantize pairing
+   *statically* — including through ``simple_bind``'s warn/error gate —
+   where the seed only failed deep inside a JAX trace.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.analysis.graph import validate_json
+from mxnet_tpu.analysis.lint import (baseline_hash, changed_lines_since,
+                                     run_lint)
+from mxnet_tpu.analysis.rules import all_rules
+from mxnet_tpu.analysis.rules.atomic_write import AtomicWriteRule
+from mxnet_tpu.analysis.rules.env_registry import EnvRegistryRule
+from mxnet_tpu.analysis.rules.host_sync import HostSyncRule
+from mxnet_tpu.analysis.rules.registry_hygiene import (
+    RegistryHygieneRule, runtime_registry_findings)
+from mxnet_tpu.analysis.rules.tracer_purity import TracerPurityRule
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.symbol.symbol import _apply, var
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MXLINT = os.path.join(REPO, "tools", "mxlint.py")
+
+
+def _write(root, rel, text):
+    path = root / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(text))
+    return str(path)
+
+
+def _lint_file(tmp_path, rel, text, rules):
+    path = _write(tmp_path, rel, text)
+    return run_lint(str(tmp_path), rules, files=[path])
+
+
+# ---------------------------------------------------------------------------
+# 1. the real tree, through the real entry point
+# ---------------------------------------------------------------------------
+
+def test_repo_is_lint_clean_via_cli():
+    """The tier-1 contract: `python tools/mxlint.py` exits 0 on HEAD
+    with an empty-or-justified baseline."""
+    proc = subprocess.run([sys.executable, MXLINT], cwd=REPO,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, (
+        "mxlint found new findings (fix them or baseline with a "
+        "justification):\n" + proc.stdout + proc.stderr)
+    assert "0 finding(s)" in proc.stdout
+
+
+def test_repo_baseline_entries_are_justified():
+    with open(os.path.join(REPO, "tools", "mxlint_baseline.json")) as f:
+        entries = json.load(f)["entries"]
+    for e in entries:
+        assert e.get("justification") and "FIXME" not in e["justification"], (
+            f"baseline entry {e['code']} {e['path']} lacks a real "
+            "justification")
+
+
+def test_cli_fails_on_known_bad_tree(tmp_path):
+    """All five rules fire through the CLI on a synthetic bad tree, and
+    the exit code + code/path:line output contract holds."""
+    _write(tmp_path, "mxnet_tpu/ops/bad.py", """\
+        import time
+
+        @register("BadOp")
+        def bad_op(data, scale=1.0):
+            data.asnumpy()
+            return data * scale * time.time()
+        """)
+    _write(tmp_path, "mxnet_tpu/ops/dup.py", """\
+        @register("BadOp")
+        def bad_op_again(data):
+            return data
+        """)
+    _write(tmp_path, "mxnet_tpu/metric.py", """\
+        class M:
+            def update(self, labels, preds):
+                return preds.asnumpy()
+        """)
+    _write(tmp_path, "mxnet_tpu/saver.py", """\
+        def save_checkpoint(fname):
+            with open(fname, 'wb') as f:
+                f.write(b'x')
+        """)
+    _write(tmp_path, "mxnet_tpu/cfg.py", """\
+        import os
+        FLAG = os.environ.get("MXNET_TOTALLY_UNREGISTERED")
+        """)
+    proc = subprocess.run(
+        [sys.executable, MXLINT, "--root", str(tmp_path),
+         "--baseline", str(tmp_path / "nonexistent.json")],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    for code in ("MXL001", "MXL002", "MXL003", "MXL004", "MXL005"):
+        assert code in proc.stdout, f"{code} missing:\n{proc.stdout}"
+    # path:line anchoring (the acceptance-criteria output contract)
+    assert "mxnet_tpu/saver.py:2:" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# 2. per-rule fixtures
+# ---------------------------------------------------------------------------
+
+def test_tracer_purity_fires_and_classifies(tmp_path):
+    res = _lint_file(tmp_path, "mxnet_tpu/ops/bad.py", """\
+        import time
+        import numpy as np
+        from .registry import register
+
+        @register("BadOp")
+        def bad_op(data, scale=1.0):
+            s = float(data)
+            data.asnumpy()
+            arr = np.asarray(data)
+            t = time.time()
+            return data * s * t
+        """, [TracerPurityRule()])
+    msgs = [f.message for f in res.findings]
+    assert len(res.findings) == 4, msgs
+    assert any("float()" in m for m in msgs)
+    assert any("asnumpy" in m for m in msgs)
+    assert any("np.asarray" in m for m in msgs)
+    assert any("time.time" in m for m in msgs)
+
+
+def test_tracer_purity_quiet_on_good_ops(tmp_path):
+    res = _lint_file(tmp_path, "mxnet_tpu/ops/good.py", """\
+        import jax.numpy as jnp
+        from .registry import register
+
+        @register("GoodOp")
+        def good_op(data, stride=1, pad=0):
+            # attr coercion is static under jit — legal
+            k = int(stride) + int(pad)
+            return jnp.asarray(data) * k
+
+        @register("EagerOp", wrap_jit=False)
+        def eager_op(data):
+            # un-jitted ops may concretize
+            return float(data)
+
+        def helper_not_an_op(data):
+            return data.asnumpy()
+        """, [TracerPurityRule()])
+    assert res.findings == [], [f.message for f in res.findings]
+
+
+def test_host_sync_fires_only_in_hot_methods(tmp_path):
+    res = _lint_file(tmp_path, "mxnet_tpu/metric.py", """\
+        class M:
+            def update(self, labels, preds):
+                return preds.asnumpy()
+
+            def get(self):
+                # read path: the sync belongs here
+                return self.v.asnumpy()
+        """, [HostSyncRule()])
+    assert len(res.findings) == 1
+    assert res.findings[0].lineno == 3
+    assert "update" in res.findings[0].message
+
+
+def test_host_sync_scopes_trainer_and_optimizer(tmp_path):
+    res = _lint_file(tmp_path, "mxnet_tpu/optimizer/optimizer.py", """\
+        class Opt:
+            def update(self, index, weight, grad, state):
+                grad.wait_to_read()
+
+            def create_state(self, index, weight):
+                return weight.asnumpy()  # not a hot path
+        """, [HostSyncRule()])
+    assert len(res.findings) == 1
+    assert "wait_to_read" in res.findings[0].message
+
+
+def test_atomic_write_rule_ports_pr2_fixtures(tmp_path):
+    """The retired test_atomic_write_lint.py cases, now as MXL003."""
+    bad = _lint_file(tmp_path, "mxnet_tpu/bad.py", """\
+        def save_checkpoint(fname):
+            with open(fname, 'wb') as f:
+                f.write(b'x')
+        """, [AtomicWriteRule()])
+    assert len(bad.findings) == 1
+    assert "save_checkpoint" in bad.findings[0].message
+    assert "'wb'" in bad.findings[0].message
+
+    ok = _lint_file(tmp_path, "mxnet_tpu/ok.py", """\
+        def save_checkpoint(fname):
+            from mxnet_tpu.checkpoint import atomic_write
+            with atomic_write(fname) as f:
+                f.write(b'x')
+
+        def load_checkpoint(fname):
+            with open(fname, 'rb') as f:
+                return f.read()
+
+        def unrelated_writer(fname):
+            with open(fname, 'w') as f:
+                f.write('not checkpoint-named')
+        """, [AtomicWriteRule()])
+    assert ok.findings == [], [f.message for f in ok.findings]
+
+
+def test_atomic_write_covers_states_and_snapshot_names(tmp_path):
+    res = _lint_file(tmp_path, "mxnet_tpu/kv.py", """\
+        def snapshot_server(path):
+            f = open(path, mode='ab')
+
+        def dump_states(path):
+            f = open(path, 'w+')
+        """, [AtomicWriteRule()])
+    assert len(res.findings) == 2
+
+
+def test_env_registry_rule(tmp_path):
+    rule = EnvRegistryRule(registered={"MXNET_GOOD"})
+    res = _lint_file(tmp_path, "mxnet_tpu/cfg.py", """\
+        import os
+        from .base import get_env
+
+        a = get_env("MXNET_GOOD")             # registered
+        b = get_env("MXNET_BAD")              # unregistered
+        c = os.environ.get("MXTPU_ALSO_BAD")  # unregistered
+        d = os.environ["MXTPU_SUBSCRIPT"]     # unregistered
+        e = os.environ.get("_MXTPU_INTERNAL") # sentinel: exempt
+        f = os.environ.get("DMLC_ROLE")       # launcher contract: exempt
+        g = os.environ.get("HOME")            # not ours
+        """, [rule])
+    names = sorted(f.message.split()[2] for f in res.findings)
+    assert names == ["MXNET_BAD", "MXTPU_ALSO_BAD", "MXTPU_SUBSCRIPT"]
+
+
+def test_env_registry_reads_real_libinfo():
+    """The default rule parses the live libinfo._ENV_VARS literal."""
+    rule = EnvRegistryRule()
+    assert "MXNET_ENGINE_TYPE" in rule._registered
+    assert "MXNET_GRAPH_VALIDATE" in rule._registered
+    assert "MXTPU_IO_HOST_ENGINE" in rule._registered
+
+
+def test_registry_hygiene_cross_module_duplicates(tmp_path):
+    a = _write(tmp_path, "mxnet_tpu/ops/a.py", """\
+        @register("UniqueOp", aliases=("shared_alias",))
+        def unique_op(x):
+            return x
+        """)
+    b = _write(tmp_path, "mxnet_tpu/ops/b.py", """\
+        @register("OtherOp", aliases=("shared_alias",))
+        def other_op(x):
+            return x
+        """)
+    res = run_lint(str(tmp_path), [RegistryHygieneRule()], files=[a, b])
+    assert len(res.findings) == 1
+    assert "shared_alias" in res.findings[0].message
+    assert "a.py" in res.findings[0].message   # points at the first site
+
+
+def test_registry_hygiene_runtime_registry_is_clean():
+    """Every live op is reachable by infer_output (the runtime half)."""
+    assert runtime_registry_findings() == []
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline mechanics
+# ---------------------------------------------------------------------------
+
+_BAD_SAVER = """\
+def save_checkpoint(fname):
+    with open(fname, 'wb') as f:
+        f.write(b'x')
+"""
+
+
+def test_inline_suppression_same_line(tmp_path):
+    res = _lint_file(tmp_path, "mxnet_tpu/s.py", """\
+        def save_checkpoint(fname):
+            with open(fname, 'wb') as f:  # mxlint: disable=MXL003
+                f.write(b'x')
+        """, [AtomicWriteRule()])
+    assert res.findings == []
+    assert len(res.suppressed) == 1
+
+
+def test_inline_suppression_preceding_comment_and_all(tmp_path):
+    res = _lint_file(tmp_path, "mxnet_tpu/s.py", """\
+        def save_checkpoint(fname):
+            # writes a scratch file, not a checkpoint
+            # mxlint: disable=MXL003
+            with open(fname, 'wb') as f:
+                f.write(b'x')
+
+        def save_other(fname):
+            with open(fname, 'wb') as f:  # mxlint: disable=all
+                f.write(b'x')
+
+        def save_wrong_code(fname):
+            with open(fname, 'wb') as f:  # mxlint: disable=MXL999
+                f.write(b'x')
+        """, [AtomicWriteRule()])
+    assert len(res.findings) == 1       # wrong code doesn't suppress
+    assert len(res.suppressed) == 2
+
+
+def test_baseline_matches_across_line_drift(tmp_path):
+    entry = {"code": "MXL003", "path": "mxnet_tpu/s.py",
+             "hash": baseline_hash("with open(fname, 'wb') as f:"),
+             "justification": "grandfathered for the test"}
+    path = _write(tmp_path, "mxnet_tpu/s.py", _BAD_SAVER)
+    res = run_lint(str(tmp_path), [AtomicWriteRule()], files=[path],
+                   baseline=[entry])
+    assert res.findings == [] and len(res.baselined) == 1
+
+    # shift the finding down 3 lines and reindent: hash still matches
+    drifted = ("import os\nimport sys\n\n\ndef save_checkpoint(fname):\n"
+               "        with open(fname, 'wb') as f:\n"
+               "            f.write(b'x')\n")
+    path = _write(tmp_path, "mxnet_tpu/s.py", drifted)
+    res = run_lint(str(tmp_path), [AtomicWriteRule()], files=[path],
+                   baseline=[entry])
+    assert res.findings == [] and len(res.baselined) == 1
+    assert res.baselined[0].lineno == 6   # really did move
+
+
+def test_baseline_entry_consumes_at_most_one_finding(tmp_path):
+    """A fresh copy-paste of a grandfathered line is a NEW violation:
+    one entry cannot silence two findings."""
+    doubled = ("def save_checkpoint(fname):\n"
+               "    with open(fname, 'wb') as f:\n"
+               "        f.write(b'x')\n"
+               "    with open(fname, 'wb') as f:\n"
+               "        f.write(b'y')\n")
+    entry = {"code": "MXL003", "path": "mxnet_tpu/s.py",
+             "hash": baseline_hash("with open(fname, 'wb') as f:"),
+             "justification": "the first one, grandfathered"}
+    path = _write(tmp_path, "mxnet_tpu/s.py", doubled)
+    res = run_lint(str(tmp_path), [AtomicWriteRule()], files=[path],
+                   baseline=[entry])
+    assert len(res.baselined) == 1
+    assert len(res.findings) == 1     # the copy is live
+    # two entries (as save_baseline would write) cover both
+    res = run_lint(str(tmp_path), [AtomicWriteRule()], files=[path],
+                   baseline=[entry, dict(entry)])
+    assert len(res.baselined) == 2 and res.findings == []
+
+
+def test_tracer_purity_arrayish_tracks_registry():
+    """The array-param classification is extracted from ops/registry.py
+    itself (AST), so the rule cannot drift from OpDef's set."""
+    from mxnet_tpu.analysis.rules.tracer_purity import registry_arrayish
+    live = registry_arrayish()
+    assert {"bias", "gamma", "weight"} <= live
+    # and it really came from the source, not the fallback: registry.py
+    # names exactly these today
+    import mxnet_tpu.ops.registry as regmod
+    import inspect
+    src = inspect.getsource(regmod)
+    for name in live:
+        assert f'"{name}"' in src
+
+
+def test_graph_cli_survives_truncated_json(tmp_path):
+    f = tmp_path / "trunc.json"
+    f.write_text('{"nodes": [{"op": "null", "na')
+    proc = subprocess.run(
+        [sys.executable, MXLINT, "--graph", str(f)],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "GV005" in proc.stdout
+    assert "Traceback" not in proc.stderr
+
+
+def test_metric_subclasses_overriding_reset_survive_reset_local():
+    """CompositeEvalMetric (and user metrics like ssd's MApMetric)
+    override reset() without super(): _pending must exist anyway."""
+    comp = mx.metric.CompositeEvalMetric(["acc"])
+    comp.reset_local()    # crashed with AttributeError before the fix
+    comp.reset()
+    assert comp._pending == []
+
+
+def test_metric_counts_exact_past_float32_window():
+    """Lazy accumulation folds into host float64 sums: a correct-count
+    beyond what float32 could represent keeps incrementing (16777216.0
+    + 1 == 16777216.0 in f32 — the bug class the pending window
+    avoids)."""
+    m = mx.metric.Accuracy()
+    big = float(2 ** 24)
+    m.sum_metric = big
+    m.global_sum_metric = big
+    m.num_inst = 2 ** 24
+    m.global_num_inst = 2 ** 24
+    from mxnet_tpu import nd
+    m.update([nd.array([1.0])],
+             [nd.array(np.array([[0.1, 0.9]], np.float32))])
+    assert m.get()[1] == (big + 1) / (2 ** 24 + 1)
+
+
+def test_stale_baseline_entry_detected(tmp_path):
+    stale = {"code": "MXL003", "path": "mxnet_tpu/s.py",
+             "hash": "deadbeef0000", "justification": "line was deleted"}
+    path = _write(tmp_path, "mxnet_tpu/s.py", _BAD_SAVER)
+    live_hash = baseline_hash("with open(fname, 'wb') as f:")
+    live = {"code": "MXL003", "path": "mxnet_tpu/s.py", "hash": live_hash,
+            "justification": "still present"}
+    res = run_lint(str(tmp_path), [AtomicWriteRule()], files=[path],
+                   baseline=[stale, live], check_stale=True)
+    assert res.stale_entries == [stale]
+    assert "stale baseline entry" in res.format()
+    assert not res.ok   # a stale entry fails the gate until removed
+
+
+def test_diff_mode_filters_to_changed_lines(tmp_path):
+    path = _write(tmp_path, "mxnet_tpu/s.py", _BAD_SAVER)
+    rule = AtomicWriteRule()
+    res = run_lint(str(tmp_path), [rule], files=[path],
+                   changed_lines={"mxnet_tpu/s.py": {2}})
+    assert len(res.findings) == 1
+    res = run_lint(str(tmp_path), [AtomicWriteRule()], files=[path],
+                   changed_lines={"mxnet_tpu/s.py": {1, 3}})
+    assert res.findings == []
+
+
+def test_changed_lines_since_parses_git_diff(tmp_path):
+    env = dict(os.environ, GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+               GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t")
+
+    def git(*args):
+        subprocess.run(["git", *args], cwd=tmp_path, env=env, check=True,
+                       capture_output=True)
+
+    git("init", "-q")
+    (tmp_path / "f.py").write_text("a = 1\nb = 2\nc = 3\n")
+    git("add", "f.py")
+    git("commit", "-qm", "seed")
+    (tmp_path / "f.py").write_text("a = 1\nb = 20\nc = 3\nd = 4\n")
+    changed = changed_lines_since(str(tmp_path), "HEAD")
+    assert changed == {"f.py": {2, 4}}
+
+
+# ---------------------------------------------------------------------------
+# 3. the Symbol graph validator
+# ---------------------------------------------------------------------------
+
+def _codes(findings):
+    return sorted({f.code for f in findings})
+
+
+def test_validate_clean_graph_is_quiet():
+    data = var("data")
+    fc = _apply("FullyConnected", [data, var("fc_weight")],
+                {"num_hidden": 16, "no_bias": True})
+    assert fc.validate(data=(4, 8)) == []
+
+
+def test_validate_dangling_hint_names_the_typo():
+    data = var("data")
+    fc = _apply("FullyConnected", [data, var("fc_weight")],
+                {"num_hidden": 16, "no_bias": True})
+    issues = fc.validate(dta=(4, 8))   # typo'd input name
+    assert any(i.code == "GV002" and i.node == "dta" for i in issues), issues
+    # the legit input is now underdetermined too — also named
+    assert any(i.code == "GV002" and i.node == "data" for i in issues)
+
+
+def test_validate_underdetermined_input_named():
+    s = var("a") + var("b")
+    issues = s.validate(a=(2, 2))
+    assert any(i.code == "GV002" and i.node == "b" for i in issues), issues
+
+
+def test_validate_duplicate_argument_name():
+    s = var("x") + var("x")    # two distinct nodes, one bind key
+    issues = s.validate()
+    assert any(i.code == "GV001" and i.node == "x" for i in issues), issues
+
+
+def test_validate_shape_conflict_names_the_node():
+    data = var("data")
+    w = var("fc_weight", shape=(16, 9))   # in_units should be 8
+    fc = _apply("FullyConnected", [data, w],
+                {"num_hidden": 16, "no_bias": True}, name="fc_bad")
+    issues = fc.validate(data=(4, 8))
+    hit = [i for i in issues if i.code == "GV003" and i.node == "fc_bad"]
+    assert hit, issues
+    assert "FullyConnected" in hit[0].message
+
+
+def test_validate_dtype_conflict_statically():
+    """Acceptance: a dtype conflict that previously surfaced (if at
+    all) as a silent jnp promotion + recompile inside bind is reported
+    statically with the node name."""
+    a = var("a", dtype="float32")
+    b = var("b", dtype="int32")
+    s = a + b                              # broadcast_add
+    issues = s.validate(a=(2, 2), b=(2, 2))
+    hit = [i for i in issues if i.code == "GV004"]
+    assert hit, issues
+    assert "float32" in hit[0].message and "int32" in hit[0].message
+
+
+def test_validate_quantization_pairing():
+    data = var("data", shape=(2, 4))
+    # the quantize pass stamps __num_outputs__ on inserted nodes
+    # (contrib/quantization.py) — mirror it
+    q = _apply("_contrib_quantize_v2", [data], {"__num_outputs__": 3})
+    deq = _apply("_contrib_dequantize", [q[0], q[1], q[2]], {})
+    assert [i for i in deq.validate() if i.code == "GV006"] == []
+
+    bare = _apply("_contrib_dequantize",
+                  [var("d"), var("dmin"), var("dmax")], {})
+    issues = bare.validate()
+    assert any(i.code == "GV006" and "quantize ancestor" in i.message
+               for i in issues), issues
+
+    dangling_q = _apply("_contrib_quantize_v2", [var("data2")],
+                        {"__num_outputs__": 3})
+    issues = dangling_q.validate()
+    assert any(i.code == "GV006" and "never reach a dequantize" in i.message
+               for i in issues), issues
+
+
+def test_validate_json_unreachable_and_corrupt():
+    graph = {
+        "nodes": [
+            {"op": "null", "name": "a", "inputs": []},
+            {"op": "null", "name": "orphan", "inputs": []},
+            {"op": "_plus_scalar", "name": "p",
+             "attrs": {"scalar": "1.0"}, "inputs": [[0, 0, 0]]},
+        ],
+        "arg_nodes": [0, 1],
+        "heads": [[2, 0, 0]],
+    }
+    findings = validate_json(json.dumps(graph))
+    assert any(f.code == "GV005" and f.node == "orphan" for f in findings)
+
+    graph["nodes"][2]["inputs"] = [[9, 0, 0]]   # out of range
+    findings = validate_json(json.dumps(graph))
+    assert any("out of range" in f.message for f in findings)
+
+
+def test_simple_bind_warns_by_default(monkeypatch):
+    monkeypatch.delenv("MXNET_GRAPH_VALIDATE", raising=False)
+    a = var("a", dtype="float32")
+    b = var("b", dtype="int32")
+    s = a + b
+    with pytest.warns(UserWarning, match="GV004"):
+        ex = s.simple_bind(a=(2, 2), b=(2, 2))
+    assert ex is not None   # warn-only: bind still succeeds
+
+
+def test_simple_bind_error_mode_catches_dangling_before_trace(monkeypatch):
+    """Acceptance: the dangling-input graph reports the offending name
+    pre-bind instead of a deep JAX trace error."""
+    monkeypatch.setenv("MXNET_GRAPH_VALIDATE", "error")
+    data = var("data")
+    fc = _apply("FullyConnected", [data, var("fc_weight")],
+                {"num_hidden": 16, "no_bias": True})
+    with pytest.raises(MXNetError, match="dta"):
+        fc.simple_bind(dta=(4, 8))
+
+
+def test_simple_bind_validate_disabled(monkeypatch):
+    monkeypatch.setenv("MXNET_GRAPH_VALIDATE", "off")
+    a = var("a", dtype="float32")
+    b = var("b", dtype="int32")
+    s = a + b
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        s.simple_bind(a=(2, 2), b=(2, 2))
+
+
+def test_graph_cli_mode(tmp_path):
+    """tools/mxlint.py --graph on a saved symbol with a quantization
+    break exits 1 and names the node."""
+    bare = _apply("_contrib_dequantize",
+                  [var("d"), var("dmin"), var("dmax")], {}, name="deq0")
+    f = tmp_path / "bad_graph.json"
+    f.write_text(bare.tojson())
+    proc = subprocess.run(
+        [sys.executable, MXLINT, "--graph", str(f)],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "GV006" in proc.stdout and "deq0" in proc.stdout
+
+
+def test_validate_runs_on_real_model_graph():
+    """End-to-end: a realistic composed network validates clean with
+    only data-shape hints (param shapes back-inferred, as simple_bind
+    does)."""
+    import mxnet_tpu.symbol as sym_api
+    data = sym_api.var("data")
+    net = sym_api.FullyConnected(data=data, num_hidden=64, name="fc1")
+    net = sym_api.Activation(data=net, act_type="relu", name="relu1")
+    net = sym_api.FullyConnected(data=net, num_hidden=10, name="fc2")
+    net = sym_api.SoftmaxOutput(data=net, name="softmax")
+    issues = net.validate(data=(32, 128), softmax_label=(32,))
+    assert issues == [], issues

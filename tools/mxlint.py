@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""mxlint — static analysis driver for the mxnet_tpu tree.
+
+    python tools/mxlint.py                     # full package lint
+    python tools/mxlint.py mxnet_tpu/metric.py # specific files
+    python tools/mxlint.py --diff HEAD~1       # findings on changed lines only
+    python tools/mxlint.py --graph model.json  # Symbol graph validation
+    python tools/mxlint.py --graph model.json --shapes data=1,3,224,224
+    python tools/mxlint.py --update-baseline   # regenerate the baseline
+    python tools/mxlint.py --runtime           # + live-registry hygiene
+
+Exit codes: 0 clean, 1 findings (new, non-baselined), 2 usage/IO error.
+
+The AST rules run without importing the package (no jax init); the
+``--runtime`` registry checks and ``--graph`` validation import
+mxnet_tpu and are skipped from the fast default path. The tier-1 gate
+(tests/test_mxlint.py) runs this same entry point, so CI and the CLI
+cannot drift.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "tools", "mxlint_baseline.json")
+sys.path.insert(0, REPO)
+
+
+def _load_analysis():
+    """Load mxnet_tpu.analysis *standalone* — without executing
+    mxnet_tpu/__init__.py, so the default AST path runs in milliseconds
+    with no jax/backend initialization (and works in stripped deploy
+    images that lack the runtime deps)."""
+    import importlib
+    import importlib.util
+    name = "_mxlint_analysis"
+    if name not in sys.modules:
+        pkg = os.path.join(REPO, "mxnet_tpu", "analysis")
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(pkg, "__init__.py"),
+            submodule_search_locations=[pkg])
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    lint = importlib.import_module(name + ".lint")
+    rules = importlib.import_module(name + ".rules")
+    return lint, rules
+
+
+def run_ast_lint(args):
+    _lint, _rules = _load_analysis()
+    root = os.path.abspath(args.root)
+    baseline = _lint.load_baseline(args.baseline)
+    files = [os.path.join(root, f) for f in args.paths] or None
+    changed = None
+    if args.diff:
+        try:
+            changed = _lint.changed_lines_since(root, args.diff)
+        except Exception as e:  # noqa: BLE001 — bad rev, no git...
+            print(f"mxlint: --diff {args.diff} failed: {e}", file=sys.stderr)
+            return 2
+    result = _lint.run_lint(root, _rules.all_rules(), files=files,
+                            baseline=baseline, changed_lines=changed)
+    if args.runtime:
+        from mxnet_tpu.analysis.rules.registry_hygiene import \
+            runtime_registry_findings
+        result.findings.extend(runtime_registry_findings())
+    out = result.format(show_baselined=args.show_baselined)
+    if out:
+        print(out)
+    n = len(result.findings)
+    print("mxlint: %d finding(s), %d suppressed, %d baselined, %d stale "
+          "baseline entr%s" % (
+              n, len(result.suppressed), len(result.baselined),
+              len(result.stale_entries),
+              "y" if len(result.stale_entries) == 1 else "ies"))
+    return 0 if result.ok else 1
+
+
+def update_baseline(args):
+    _lint, _rules = _load_analysis()
+    # findings computed with NO baseline: the new file captures the
+    # full current set, justifications left FIXME for review
+    result = _lint.run_lint(os.path.abspath(args.root), _rules.all_rules(),
+                            baseline=None)
+    _lint.save_baseline(args.baseline, result.findings)
+    print("mxlint: wrote %d entr%s to %s (fill in the justifications)"
+          % (len(result.findings),
+             "y" if len(result.findings) == 1 else "ies", args.baseline))
+    return 0
+
+
+def run_graph(args):
+    """Validate a serialized symbol: structural JSON checks plus the
+    composed-graph validator (imports mxnet_tpu)."""
+    try:
+        with open(args.graph, "r", encoding="utf-8") as f:
+            json_str = f.read()
+    except OSError as e:
+        print(f"mxlint: cannot read {args.graph}: {e}", file=sys.stderr)
+        return 2
+    from mxnet_tpu.analysis.graph import validate_json
+    try:
+        findings = list(validate_json(json_str))
+    except ValueError as e:   # truncated/garbage JSON is a finding
+        print(f"{args.graph}: GV005 symbol JSON does not parse: {e}")
+        return 1
+    from mxnet_tpu.symbol.symbol import load_json
+    shapes = {}
+    for spec in args.shapes or []:
+        name, _, dims = spec.partition("=")
+        if not dims:
+            print(f"mxlint: bad --shapes spec {spec!r} (want "
+                  "name=d0,d1,...)", file=sys.stderr)
+            return 2
+        shapes[name] = tuple(int(d) for d in dims.split(","))
+    try:
+        sym = load_json(json_str)
+        findings.extend(sym.validate(**shapes))
+    except Exception as e:  # noqa: BLE001 — unloadable graph is a finding
+        print(f"{args.graph}: GV005 symbol JSON does not load/validate: {e}")
+        return 1
+    for f in findings:
+        print(f"{args.graph}: {f}")
+    print("mxlint --graph: %d finding(s)" % len(findings))
+    return 0 if not findings else 1
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="mxlint", description=__doc__)
+    ap.add_argument("paths", nargs="*",
+                    help="files to lint (default: mxnet_tpu/ + tools/)")
+    ap.add_argument("--baseline", default=BASELINE,
+                    help="baseline file (default tools/mxlint_baseline.json)")
+    ap.add_argument("--root", default=REPO,
+                    help="tree root to lint (default: this repo; the "
+                         "test fixtures point it at synthetic trees)")
+    ap.add_argument("--diff", metavar="REV",
+                    help="only report findings on lines changed since REV")
+    ap.add_argument("--graph", metavar="JSON",
+                    help="validate a serialized symbol graph instead")
+    ap.add_argument("--shapes", action="append", metavar="NAME=D0,D1,...",
+                    help="input shape hints for --graph validation")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="regenerate the baseline from current findings")
+    ap.add_argument("--show-baselined", action="store_true",
+                    help="also print findings matched by the baseline")
+    ap.add_argument("--runtime", action="store_true",
+                    help="also run live-registry hygiene checks "
+                         "(imports mxnet_tpu)")
+    args = ap.parse_args(argv)
+    if args.graph:
+        return run_graph(args)
+    if args.update_baseline:
+        return update_baseline(args)
+    return run_ast_lint(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
