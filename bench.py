@@ -79,9 +79,61 @@ def _load_last_good(include_fallback=True):
     return None
 
 
+# rolling diagnostic context folded into the failure JSON: the r05
+# postmortem was a bare "tunnel probe 3 failed (wedged backend init?)"
+# with zero signal about WHERE init wedged — stage, recent diagnostics
+# and env now travel with every failure line
+_DIAG_RING = []
+_DIAG_KEEP = 40
+_LAST_STAGE = ["start"]
+
+
 def _diag(msg):
+    _DIAG_RING.append("%s %s" % (time.strftime("%H:%M:%S"), str(msg)[:200]))
+    del _DIAG_RING[:-_DIAG_KEEP]
     print("[bench %s] %s" % (time.strftime("%H:%M:%S"), msg),
           file=sys.stderr, flush=True)
+
+
+def _diag_snapshot(extra=None):
+    """Bounded diagnostic context for a failure line: last lifecycle
+    stage, recent diagnostics, the env knobs that steer backend init,
+    and — when the framework is already imported (child side) — its
+    recovery telemetry and the tail of the profiler event stream."""
+    env = {}
+    for k in sorted(os.environ):
+        if k in ("JAX_PLATFORMS", "XLA_FLAGS", "PYTHONPATH") or \
+                k.startswith(("MXTPU_", "MXNET_", "DMLC_")):
+            env[k] = os.environ[k][:120]
+    diag = {
+        "stage": _LAST_STAGE[0],
+        "recent": list(_DIAG_RING[-15:]),
+        "env": env,
+    }
+    if "mxnet_tpu" in sys.modules:   # child side only — the supervisor
+        try:                          # must never import the backend
+            from mxnet_tpu import profiler, telemetry
+            diag["recovery"] = profiler.recovery_summary()
+            diag["recovery"].pop("last", None)
+            with profiler._lock:
+                tail = list(profiler._events[-10:])
+            diag["profiler_tail"] = [
+                {"name": str(e.get("name"))[:80], "ts": e.get("ts")}
+                for e in tail]
+            snap = telemetry.snapshot()["metrics"]
+            diag["telemetry"] = {
+                name: [[s.get("labels"), s.get("value", s.get("sum"))]
+                       for s in fam["series"][:4]]
+                for name, fam in snap.items()
+                if name in ("mx_jit_compiles_total",
+                            "mx_op_dispatches_total",
+                            "mx_step_time_seconds_total",
+                            "mx_io_data_wait_seconds")}
+        except Exception as e:  # noqa: BLE001 — diagnostics must never
+            diag["telemetry_error"] = repr(e)[:120]   # mask the failure
+    if extra:
+        diag.update(extra)
+    return diag
 
 
 def _child_record(line):
@@ -133,6 +185,7 @@ def _hb(stage):
     sluggish tunnel) survive while a wedged backend init still dies
     fast. `_json_line` ignores anything not starting with '{'."""
     _bump_progress()
+    _LAST_STAGE[0] = str(stage)[:120]
     _emit("#hb %s %s" % (time.strftime("%H:%M:%S"), stage))
     _diag(stage)
 
@@ -160,12 +213,22 @@ def _enable_compile_cache():
         _diag("compile cache unavailable: %r" % (e,))
 
 
-def _fail_json(err):
-    """Partial JSON so the driver captures *something* on failure."""
-    print(json.dumps({
+def _fail_json(err, diag=None):
+    """Partial JSON so the driver captures *something* on failure —
+    including a bounded diagnostic snapshot (stage/env/recent events),
+    so a wedged round is debuggable from its artifact alone."""
+    line = json.dumps({
         "metric": METRIC, "value": 0.0, "unit": "img/s/chip",
         "vs_baseline": 0.0, "error": str(err)[:500],
-    }), flush=True)
+        "diag": _diag_snapshot(diag),
+    })
+    if len(line) > 16384:   # a metric line, not a log dump
+        line = json.dumps({
+            "metric": METRIC, "value": 0.0, "unit": "img/s/chip",
+            "vs_baseline": 0.0, "error": str(err)[:500],
+            "diag": {"stage": _LAST_STAGE[0], "truncated": True},
+        })
+    print(line, flush=True)
 
 
 def _json_line(raw):
@@ -359,7 +422,10 @@ def supervise():
                 # put the explicit failure JSON on stdout so a
                 # driver-side kill mid-backoff still leaves a parseable
                 # line (a live measurement later supersedes it)
-                _fail_json(last_err)
+                _fail_json(last_err, diag={
+                    "probe_failures": probe_failures,
+                    "budget_s": budget,
+                    "elapsed_s": round(time.monotonic() - t_start, 1)})
                 emitted_fail_early = True
             remain = budget - (time.monotonic() - t_start)
             if remain <= 1:
@@ -431,7 +497,11 @@ def supervise():
         # error JSON printed LAST (with the latest cause) so the driver
         # sees the real failure even when a provisional stale line or an
         # earlier early-failure line went out with an older reason
-        _fail_json(last_err)
+        _fail_json(last_err, diag={
+            "probe_failures": probe_failures,
+            "full_attempts": full_attempts,
+            "budget_s": budget,
+            "elapsed_s": round(time.monotonic() - t_start, 1)})
     return 1
 
 

@@ -43,6 +43,7 @@ if (_os.environ.get("DMLC_ROLE") == "worker"
     _os.environ["_MXTPU_DIST_JOINED"] = "1"
 
 from .base import MXNetError, get_env
+from . import telemetry
 from .context import (Context, cpu, cpu_pinned, current_context, gpu, num_gpus,
                       num_tpus, tpu)
 from . import engine

@@ -28,8 +28,18 @@ _SCOPES = (
     ("mxnet_tpu/executor.py", {"forward", "backward"}, set()),
     ("mxnet_tpu/optimizer/", {"update", "update_multi_precision"}, set()),
     ("mxnet_tpu/kvstore/",
-     {"push", "pull", "row_sparse_pull", "pushpull"}, set()),
+     {"push", "pull", "row_sparse_pull", "pushpull",
+      "_push_impl", "_pull_impl"}, set()),
     ("mxnet_tpu/metric.py", {"update"}, {"_as_np"}),
+    # the telemetry recorders themselves run inside every hot path
+    # above — a sync hiding in inc()/observe()/step_boundary() would
+    # stall each instrumented seam at once. Drains are read-time only
+    # (snapshot/value), never in these recording methods.
+    ("mxnet_tpu/telemetry/",
+     {"inc", "dec", "set", "set_max", "inc_lazy", "set_lazy",
+      "observe", "observe_lazy", "_push_lazy", "add_data_wait",
+      "add_comm", "add_compile", "step_boundary",
+      "_on_event_duration"}, set()),
 )
 
 # calls that block on (or copy from) the device stream

@@ -40,9 +40,24 @@ import os
 import shutil
 import signal
 import sys
+import time
 import zlib
 
 from .base import MXNetError, get_env
+from .telemetry import metrics as _tm
+
+_met = _tm.lazy_metrics(lambda reg: {
+    "save_s": reg.histogram(
+        "mx_checkpoint_save_seconds",
+        "CheckpointManager.save wall-clock (params + states + "
+        "rng + iterator + manifest)"),
+    "restore_s": reg.histogram(
+        "mx_checkpoint_restore_seconds",
+        "checkpoint load/resume wall-clock incl. CRC verification"),
+    "saves": reg.counter(
+        "mx_checkpoints_saved_total",
+        "training-state checkpoints committed"),
+})
 
 MANIFEST_NAME = "MANIFEST.json"
 MANIFEST_VERSION = 1
@@ -410,6 +425,7 @@ class CheckpointManager:
 
         import numpy as np
 
+        t0 = time.perf_counter()
         cdir = self._ckpt_dir(step)
         os.makedirs(cdir, exist_ok=True)
         meta = {"version": MANIFEST_VERSION, "step": int(step),
@@ -435,6 +451,10 @@ class CheckpointManager:
         write_bytes(os.path.join(cdir, _META_FILE),
                     json.dumps(meta, indent=1, sort_keys=True))
         self._prune(keep_step=step)
+        if _tm.enabled():
+            m = _met()
+            m["save_s"].observe(time.perf_counter() - t0)
+            m["saves"].inc()
         return cdir
 
     def _prune(self, keep_step):
@@ -540,6 +560,7 @@ class CheckpointManager:
         from . import random as random_mod
         import numpy as np
 
+        t0 = time.perf_counter()
         step = self.latest_valid()
         if step is None:
             return None
@@ -557,6 +578,8 @@ class CheckpointManager:
             "step": int(step), "path": self._ckpt_dir(step),
             "restarts": int(os.environ.get("MXNET_WORKER_RESTARTS", "0")),
         })
+        if _tm.enabled():
+            _met()["restore_s"].observe(time.perf_counter() - t0)
         return state
 
 

@@ -19,6 +19,20 @@ from __future__ import annotations
 import jax
 
 from .base import get_env
+from .telemetry import metrics as _tm
+
+# cached SERIES (reset-safe) — per-op cost is one lock+add
+_met = _tm.lazy_metrics(lambda reg: {
+    "eager": reg.counter(
+        "mx_engine_eager_ops_total",
+        "eager ops observed by the dispatch layer").labels(),
+    "host_ops": reg.counter(
+        "mx_host_engine_ops_total",
+        "host tasks pushed to the native dependency engine").labels(),
+    "inflight": reg.gauge(
+        "mx_host_engine_inflight",
+        "host-engine tasks submitted and not yet dispatched").labels(),
+})
 
 _naive = get_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice") == "NaiveEngine"
 # newest in-flight result PER DEVICE: device streams execute in order, so
@@ -43,6 +57,8 @@ def on_op_executed(outputs):
     import jax.core
     if any(isinstance(o, jax.core.Tracer) for o in outputs):
         return  # inside a jit trace: the compiled step is the engine op
+    if _tm.enabled():
+        _met()["eager"].inc()
     if _naive:
         for o in outputs:
             jax.block_until_ready(o)
@@ -124,6 +140,8 @@ class _HostEngine:
 
     def _dispatch(self, argp):
         fn = self._inflight.pop(int(argp or 0), None)
+        if _tm.enabled():
+            _met()["inflight"].set(len(self._inflight))
         if fn is None:
             return 2
         try:
@@ -143,6 +161,8 @@ class _HostEngine:
     def push(self, fn, read_vars=(), write_vars=()):
         """Run `fn()` on a worker thread once its var deps are satisfied.
         A raised exception poisons the write vars (rethrown at wait)."""
+        if _tm.enabled():
+            _met()["host_ops"].inc()
         if _naive:
             # determinism switch serializes host tasks too
             # (ref: src/engine/naive_engine.cc:50 executes on push)
@@ -151,6 +171,8 @@ class _HostEngine:
         ct = self._ctypes
         tag = next(self._tags)
         self._inflight[tag] = fn
+        if _tm.enabled():
+            _met()["inflight"].set(len(self._inflight))
         nr, nw = len(read_vars), len(write_vars)
         r = (ct.c_int64 * nr)(*read_vars) if nr else None
         w = (ct.c_int64 * nw)(*write_vars) if nw else None

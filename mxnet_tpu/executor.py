@@ -232,7 +232,9 @@ class Executor:
         aux_vals = {n: a._data for n, a in self.aux_dict.items()}
         key = _random.next_key()
         arg_vals, aux_vals, key = self._place(arg_vals, aux_vals, key)
-        with self._maybe_profile("executor_forward") as prof:
+        from . import telemetry as _telemetry
+        with self._maybe_profile("executor_forward") as prof, \
+                _telemetry.compile_scope("executor_forward"):
             outs, aux_updates = self._jitted_forward(bool(is_train))(
                 arg_vals, aux_vals, key)
             if prof or self._serialize_steps():
@@ -333,7 +335,9 @@ class Executor:
                 out_grads = [out_grads]
             cotangents = [g._data if isinstance(g, NDArray)
                           else jnp.asarray(g) for g in out_grads]
-        with self._maybe_profile("executor_backward") as prof:
+        from . import telemetry as _telemetry
+        with self._maybe_profile("executor_backward") as prof, \
+                _telemetry.compile_scope("executor_backward"):
             grads = self._vjp(arg_vals, aux_vals, key, cotangents)
             if prof or self._serialize_steps():
                 # profiler timing / NaiveEngine determinism: intentional

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from .. import optimizer as opt_mod
 from ..base import MXNetError
+from ..telemetry import step as _tm_step
 from .parameter import Parameter, ParameterDict
 
 
@@ -92,6 +93,13 @@ class Trainer:
         self._sync_server_rescale()
         self._allreduce_grads()
         self._update(ignore_stale_grad)
+        # one boundary per optimizer step: charges the data/comm/compile
+        # time accumulated since the previous step to this one
+        # (telemetry/step.py; wall-clock only, no host sync). Manual
+        # loops with long gaps between steps (eval phases, user pauses)
+        # should call telemetry.step.reset() at loop start so the first
+        # interval doesn't span the gap — Module.fit does this per epoch
+        _tm_step.step_boundary("trainer")
 
     def _sync_server_rescale(self):
         """Re-ship the optimizer when the batch scale changes after the

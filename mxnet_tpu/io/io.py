@@ -14,11 +14,19 @@ import os
 import queue
 import struct
 import threading
+import time
 
 import numpy as np
 
 from ..base import MXNetError
 from ..ndarray import NDArray, array
+from ..telemetry import metrics as _tm
+from ..telemetry import step as _tm_step
+
+_data_wait_hist = _tm.lazy_metrics(lambda reg: reg.histogram(
+    "mx_io_data_wait_seconds",
+    "host time per batch spent in DataIter.next (assembly or "
+    "prefetch-queue wait)").labels())   # cached series
 
 
 class DataDesc:
@@ -81,7 +89,18 @@ class DataIter:
         raise StopIteration
 
     def __next__(self):
-        return self.next()
+        # data-wait seam: every `for batch in it` loop (fit, score,
+        # user code) passes here, so this one timer feeds both the io
+        # histogram and the per-step breakdown's data_time — no matter
+        # which concrete iterator (or prefetch wrapper) is underneath
+        if not _tm.enabled():
+            return self.next()
+        t0 = time.perf_counter()
+        batch = self.next()   # StopIteration propagates untimed
+        dt = time.perf_counter() - t0
+        _data_wait_hist().observe(dt)
+        _tm_step.add_data_wait(dt)
+        return batch
 
     def iter_next(self):
         raise NotImplementedError

@@ -40,7 +40,21 @@ from .. import _native
 # import-time entry) — a lazy `from .. import profiler` inside the
 # callback would deadlock on the package's import lock
 from .. import profiler
+from ..telemetry import metrics as _tm_metrics
 from . import fault as fault_mod
+
+# server-process registry families (pulled into worker dumps via the
+# metrics_snapshot directive); update_s caches its series, per-key
+# update counters are cached in the updater closure
+_server_met = _tm_metrics.lazy_metrics(lambda reg: {
+    "updates": reg.counter(
+        "mx_server_updates_total",
+        "merge-round optimizer updates applied",
+        labelnames=("key",)),
+    "update_s": reg.histogram(
+        "mx_server_update_seconds",
+        "server-side optimizer update latency").labels(),
+})
 
 CMD_SYNC_MODE = 1
 CMD_STOP = 2
@@ -504,7 +518,12 @@ def _apply_profiler_directive(body):
     (ref: src/kvstore/kvstore_dist_server.h:199 — the reference's
     server Controller handles kSetConfig/kState/kPause/kDump by calling
     its own profiler; integration-tested 3-way by
-    tests/nightly/test_server_profiling.py)."""
+    tests/nightly/test_server_profiling.py). ``metrics_snapshot``
+    extends the same channel to the telemetry registry: the server
+    writes its metric snapshot to the requested path, which the worker
+    side polls into its own dump (telemetry.export.pull_server_metrics
+    — the 'server metrics in the worker artifact' half of
+    docs/observability.md)."""
     cmd = "?"
     try:
         d = pickle.loads(body)
@@ -519,6 +538,9 @@ def _apply_profiler_directive(body):
             profiler.resume()
         elif cmd == "dump":
             profiler.dump()
+        elif cmd == "metrics_snapshot":
+            from ..telemetry import export as _tm_export
+            _tm_export.dump(d["path"])
     except Exception as e:  # noqa: BLE001 — the worker already got its
         # ACK (the command is async by design); a malformed directive
         # must not take down the poll loop the whole job depends on
@@ -643,9 +665,12 @@ def run_server(port=None, num_workers=None, poll_ms=200):
         optimizer = pickle.loads(blob)
         current["optimizer_blob"] = blob
 
+        update_series = {}   # per-key counter series, resolved once
+
         def updater(key, recved, stored, _opt=optimizer, _states=states):
             from ..ndarray import NDArray
             import jax.numpy as jnp
+            t0 = time.perf_counter()
             with profiler.timed_region("server_update:key%d" % key,
                                        "kvstore"):
                 w = NDArray(jnp.asarray(stored))
@@ -654,6 +679,14 @@ def run_server(port=None, num_workers=None, poll_ms=200):
                     _states[key] = _opt.create_state(key, w)
                 _opt.update(key, w, g, _states[key])
                 stored[:] = np.asarray(w._data, dtype=np.float32)
+            if _tm_metrics.enabled():
+                m = _server_met()
+                s = update_series.get(key)
+                if s is None:
+                    s = update_series[key] = m["updates"].labels(
+                        key=str(key))
+                s.inc()
+                m["update_s"].observe(time.perf_counter() - t0)
 
         _native.set_server_updater(updater)
 

@@ -11,6 +11,8 @@ seam Trainer and Module depend on.
 """
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 
@@ -18,6 +20,49 @@ from ..base import MXNetError
 from ..ndarray import NDArray
 from ..ndarray.sparse import RowSparseNDArray
 from ..optimizer import Updater
+from ..telemetry import metrics as _tm
+from ..telemetry import step as _tm_step
+
+_met = _tm.lazy_metrics(lambda reg: {
+    "push_bytes": reg.counter(
+        "mx_kvstore_push_bytes_total",
+        "payload bytes pushed, by key and worker rank",
+        labelnames=("key", "rank")),
+    "pull_bytes": reg.counter(
+        "mx_kvstore_pull_bytes_total",
+        "payload bytes pulled, by key and worker rank",
+        labelnames=("key", "rank")),
+    "push_s": reg.histogram(
+        "mx_kvstore_push_seconds",
+        "host wall-clock per push call (aggregate + transport)"
+        ).labels(),   # cached series
+    "pull_s": reg.histogram(
+        "mx_kvstore_pull_seconds",
+        "host wall-clock per pull call").labels(),
+})
+
+
+def _nbytes(v):
+    """Payload size of a push/pull value without any device sync:
+    NDArrays report via their backing array's metadata, sparse values
+    via their data+indices parts, host arrays via nbytes."""
+    if v is None:
+        return 0
+    if isinstance(v, (list, tuple)):
+        return sum(_nbytes(x) for x in v)
+    if isinstance(v, RowSparseNDArray):
+        return _nbytes(v.data) + _nbytes(v.indices)
+    d = getattr(v, "_data", None)
+    if d is not None:
+        try:
+            return int(d.size) * int(d.dtype.itemsize)
+        except (AttributeError, TypeError):
+            return 0
+    n = getattr(v, "nbytes", None)
+    try:
+        return int(n)
+    except (TypeError, ValueError):
+        return 0
 
 
 def _jax_process_count():
@@ -111,8 +156,47 @@ class KVStore:
             # not-yet-initialized server key (kvstore_dist.h Init)
             self._conn.barrier()
 
+    def _rank_label(self):
+        r = self.__dict__.get("_tm_rank_cache")
+        if r is None:
+            try:
+                r = str(self.rank)
+            except Exception:  # noqa: BLE001 — backend not up yet:
+                return "?"     # report but do NOT cache the failure
+            self._tm_rank_cache = r
+        return r
+
+    def _byte_series(self, which, k):
+        """Per-(direction, key) byte-counter series, cached on the
+        instance — skips the labels() resolution per push/pull."""
+        cache = self.__dict__.setdefault("_tm_byte_series", {})
+        s = cache.get((which, k))
+        if s is None:
+            rank = self._rank_label()
+            s = _met()[which].labels(key=str(k), rank=rank)
+            if rank == "?":
+                return s   # retry the rank lookup next call
+            cache[(which, k)] = s
+        return s
+
     def push(self, key, value, priority=0):
         keys, values = self._normalize(key, value)
+        if not _tm.enabled():
+            return self._push_impl(keys, values)
+        t0 = time.perf_counter()
+        # record on SUCCESS only: a raising push moved no bytes, and a
+        # retry loop around it must not inflate the byte/latency series
+        # (failures are recovery telemetry's job, profiler.note_recovery)
+        ret = self._push_impl(keys, values)
+        dt = time.perf_counter() - t0
+        m = _met()
+        m["push_s"].observe(dt)
+        _tm_step.add_comm(dt)
+        for k, v in zip(keys, values):
+            self._byte_series("push_bytes", k).inc(_nbytes(v))
+        return ret
+
+    def _push_impl(self, keys, values):
         for k, v in zip(keys, values):
             if isinstance(v, (list, tuple)):
                 # multi-device push: aggregate (CommCPU/CommDevice Reduce)
@@ -161,6 +245,19 @@ class KVStore:
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = self._normalize(key, out)
+        if not _tm.enabled():
+            return self._pull_impl(keys, outs)
+        t0 = time.perf_counter()
+        ret = self._pull_impl(keys, outs)
+        dt = time.perf_counter() - t0
+        m = _met()
+        m["pull_s"].observe(dt)
+        _tm_step.add_comm(dt)
+        for k, o in zip(keys, outs):
+            self._byte_series("pull_bytes", k).inc(_nbytes(o))
+        return ret
+
+    def _pull_impl(self, keys, outs):
         for k, o in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError(f"key {k} not initialized in kvstore")

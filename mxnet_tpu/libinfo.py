@@ -108,6 +108,21 @@ _ENV_VARS = {
     "MXTPU_PEAK_TFLOPS": (
         "per-chip peak TFLOPs used as the MFU denominator "
         "(tools/mfu_probe.py; default 197 = v5p bf16)"),
+    "MXTPU_TELEMETRY": (
+        "0 disables the metrics registry's hot-path instrumentation "
+        "(op dispatch, io wait, kvstore bytes, step breakdown); "
+        "default on (telemetry/, docs/observability.md)"),
+    "MXTPU_TELEMETRY_FLUSH_SEC": (
+        ">0 starts a daemon thread writing a JSON metric snapshot "
+        "every N seconds to MXTPU_TELEMETRY_FILE (telemetry/__init__)"),
+    "MXTPU_TELEMETRY_FILE": (
+        "periodic-flush destination, atomically replaced each flush "
+        "(default telemetry.json, or telemetry.<role><rank>.json "
+        "inside a launch.py job so processes sharing a cwd don't "
+        "overwrite each other; telemetry/__init__)"),
+    "MXTPU_TELEMETRY_VERBOSE": (
+        "1 logs a one-line summary to stderr at every telemetry flush "
+        "(telemetry/__init__)"),
 }
 
 

@@ -19,6 +19,7 @@ from .. import metric as metric_mod
 from .. import ndarray as nd
 from ..io.io import DataDesc
 from ..model import BatchEndParam
+from ..telemetry import step as _tm_step
 
 
 class BaseModule:
@@ -208,12 +209,22 @@ class BaseModule:
             tic = time.time()
             eval_metric.reset()
             nbatch = 0
+            # close any stale step interval: without this, validation /
+            # checkpointing / inter-fit wall-clock (and its data-wait)
+            # from the previous epoch or a previous fit() would be
+            # charged to this epoch's first step
+            _tm_step.reset()
             train_data.reset()
             for data_batch in train_data:
                 if monitor is not None:
                     monitor.tic()
                 self.forward_backward(data_batch)
                 self.update()
+                # per-step telemetry boundary (telemetry/step.py):
+                # data_time accrued in DataIter.__next__, comm_time in
+                # any kvstore traffic, compile_time from the jax
+                # listener — all charged to the step that just finished
+                _tm_step.step_boundary("module_fit")
                 self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()

@@ -24,8 +24,17 @@ import inspect
 import jax
 
 from ..base import MXNetError
+from .. import telemetry as _telemetry
+from ..telemetry import _current_op as _tm_op
 
 _OPS = {}
+
+# the cached SERIES, not the family: series handles survive registry
+# resets, and skipping labels() keeps per-dispatch cost to one lock+add
+_dispatch_counter = _telemetry.metrics.lazy_metrics(
+    lambda reg: reg.counter(
+        "mx_op_dispatches_total",
+        "eager op dispatches through the jit-wrapping path").labels())
 
 
 class OpDef:
@@ -98,30 +107,47 @@ class OpDef:
     def __call__(self, *arrays, **attrs):
         """Eager execute on jax.Arrays (dispatch is async on the PJRT stream —
         the reference's threaded engine push, done by the runtime)."""
-        if self.wrap_jit:
+        if not self.wrap_jit:
+            return self.fn(*arrays, **attrs)
+        if _telemetry.enabled():
+            # compile attribution: jax's monitoring bus reports any XLA
+            # build this dispatch triggers; the thread-local names the
+            # op it gets charged to (telemetry/__init__) — the cached
+            # fast path pays two attr writes and one counter bump only.
+            # Save/restore (not clear): an enclosing compile_scope or
+            # outer op dispatch must get its attribution back
+            prev = getattr(_tm_op, "name", None)
+            _tm_op.name = self.name
             try:
-                return self.jitted(*arrays, **attrs)
-            except (TypeError, ValueError) as e:
-                try:  # classify by actually hashing the static attrs —
-                    hash(tuple(sorted(attrs.items())))  # not by message
-                    unhashable = False
-                except TypeError:
-                    unhashable = True
-                if not unhashable:
-                    raise  # a genuine op error, not a static-attr problem
-                # unhashable attr (e.g. a list or an array passed for a
-                # static param) — run un-jitted; jnp internals still hit
-                # the C++ fast path. Logged once per op so a hot path
-                # silently bypassing the XLA executable cache is visible.
-                if not self._warned_unjitted:
-                    self._warned_unjitted = True
-                    import logging
-                    logging.getLogger(__name__).warning(
-                        "op %s called with unhashable attrs %s; running "
-                        "un-jitted (warned once)", self.name,
-                        sorted(attrs))
-                return self.fn(*arrays, **attrs)
-        return self.fn(*arrays, **attrs)
+                return self._eager_jit(arrays, attrs)
+            finally:
+                _tm_op.name = prev
+                _dispatch_counter().inc()
+        return self._eager_jit(arrays, attrs)
+
+    def _eager_jit(self, arrays, attrs):
+        try:
+            return self.jitted(*arrays, **attrs)
+        except (TypeError, ValueError):
+            try:  # classify by actually hashing the static attrs —
+                hash(tuple(sorted(attrs.items())))  # not by message
+                unhashable = False
+            except TypeError:
+                unhashable = True
+            if not unhashable:
+                raise  # a genuine op error, not a static-attr problem
+            # unhashable attr (e.g. a list or an array passed for a
+            # static param) — run un-jitted; jnp internals still hit
+            # the C++ fast path. Logged once per op so a hot path
+            # silently bypassing the XLA executable cache is visible.
+            if not self._warned_unjitted:
+                self._warned_unjitted = True
+                import logging
+                logging.getLogger(__name__).warning(
+                    "op %s called with unhashable attrs %s; running "
+                    "un-jitted (warned once)", self.name,
+                    sorted(attrs))
+            return self.fn(*arrays, **attrs)
 
 
 def register_op(name, fn, aliases=(), num_inputs=None, wrap_jit=True,

@@ -22,6 +22,7 @@ import threading
 import time
 
 from .base import MXNetError
+from .telemetry import metrics as _tm
 
 _lock = threading.Lock()
 _events = []          # chrome trace event dicts
@@ -203,16 +204,47 @@ def dumps(reset=False, format="table"):
 # degrade" even when nobody armed the profiler. When the profiler IS
 # running, each incident also lands in the chrome trace (category
 # "kvstore_recovery") so waits line up against the op timeline.
+#
+# Since PR 4 the COUNTERS live on the telemetry metrics registry
+# (mx_recovery_* families, so they ride every snapshot/Prometheus
+# export); this module keeps the bounded incident list for
+# recovery_incidents()/"last" and recovery_summary() as a compatibility
+# shim over the registry.
 _recovery_incidents = []
 _RECOVERY_KEEP = 256
+
+_recovery_met = _tm.lazy_metrics(lambda reg: {
+    "incidents": reg.counter(
+        "mx_recovery_incidents_total",
+        "kvstore/checkpoint recovery incidents by outcome "
+        "(recovered/exhausted/worker_resume/checkpoint_rejected)",
+        labelnames=("outcome",)),
+    "attempts": reg.counter(
+        "mx_recovery_attempts_total",
+        "resend attempts across all recovery incidents").labels(),
+    "reconnects": reg.counter(
+        "mx_recovery_reconnects_total",
+        "successful transport reconnects during recovery").labels(),
+    "backoff_ms": reg.counter(
+        "mx_recovery_backoff_wait_ms_total",
+        "milliseconds slept in recovery backoff").labels(),
+})
 
 
 def note_recovery(args):
     """Record one recovery incident dict (op, req_id, outcome,
-    attempts, backoff_wait_ms, ...) from the kvstore transport."""
+    attempts, backoff_wait_ms, ...) from the kvstore transport.
+    Unconditional (not gated on MXTPU_TELEMETRY): recovery telemetry is
+    the 'why did this run degrade' record and must survive a disabled
+    hot-path collection."""
     with _lock:
         _recovery_incidents.append(dict(args))
         del _recovery_incidents[:-_RECOVERY_KEEP]
+    m = _recovery_met()
+    m["incidents"].labels(outcome=str(args.get("outcome", "?"))).inc()
+    m["attempts"].inc(int(args.get("attempts", 0)))
+    m["reconnects"].inc(int(args.get("reconnects", 0)))
+    m["backoff_ms"].inc(float(args.get("backoff_wait_ms", 0.0)))
     record_event("kvstore_recovery:%s" % args.get("outcome", "?"),
                  "kvstore_recovery", _now_us(), 0, args=dict(args))
 
@@ -237,27 +269,36 @@ def recovery_incidents():
 
 def recovery_summary():
     """Aggregate recovery telemetry: the structured 'why it degraded'
-    record the bench supervisor folds into its JSON artifact."""
+    record the bench supervisor folds into its JSON artifact.
+
+    Compatibility shim since PR 4: the counts come from the telemetry
+    registry's mx_recovery_* families (unbounded, exported everywhere),
+    not from re-summing the bounded incident list — only "last" still
+    reads the retained incidents."""
     with _lock:
-        incidents = [dict(a) for a in _recovery_incidents]
-    summary = {
-        "incidents": len(incidents),
-        "recovered": sum(1 for a in incidents
-                         if a.get("outcome") == "recovered"),
-        "exhausted": sum(1 for a in incidents
-                         if a.get("outcome") == "exhausted"),
-        "attempts": sum(int(a.get("attempts", 0)) for a in incidents),
-        "reconnects": sum(int(a.get("reconnects", 0)) for a in incidents),
-        "backoff_wait_ms": round(sum(
-            float(a.get("backoff_wait_ms", 0.0)) for a in incidents), 3),
-        "worker_resumes": sum(1 for a in incidents
-                              if a.get("outcome") == "worker_resume"),
-        "checkpoints_rejected": sum(
-            1 for a in incidents
-            if a.get("outcome") == "checkpoint_rejected"),
-        "last": incidents[-1] if incidents else None,
+        last = dict(_recovery_incidents[-1]) if _recovery_incidents \
+            else None
+    m = _recovery_met()
+    by_outcome = {s.labels["outcome"]: s.value
+                  for s in m["incidents"].series()}
+    if not any(by_outcome.values()):
+        # counters zeroed (registry().reset(), e.g. the before/after
+        # perf-diff workflow) while the bounded incident list survives:
+        # report a consistent all-zero summary; raw history stays
+        # available via recovery_incidents()
+        last = None
+    return {
+        "incidents": int(round(sum(by_outcome.values()))),
+        "recovered": int(round(by_outcome.get("recovered", 0))),
+        "exhausted": int(round(by_outcome.get("exhausted", 0))),
+        "attempts": int(round(m["attempts"].value)),
+        "reconnects": int(round(m["reconnects"].value)),
+        "backoff_wait_ms": round(m["backoff_ms"].value, 3),
+        "worker_resumes": int(round(by_outcome.get("worker_resume", 0))),
+        "checkpoints_rejected": int(round(
+            by_outcome.get("checkpoint_rejected", 0))),
+        "last": last,
     }
-    return summary
 
 
 # -- user-defined instrumentation objects (ref: profiler.h:556-837) -------
@@ -291,13 +332,24 @@ class Frame(Task):
 
 
 class Counter:
+    """User-visible profiler counter (ref: profiler.h:752 Counter).
+
+    Thread-safe: increment/decrement are read-modify-writes, and the
+    host engine's worker threads (engine.py _HostEngine) legitimately
+    bump one counter concurrently — unlocked ``self._value += delta``
+    loses updates under that interleaving (PR 4 audit). The per-counter
+    lock is taken BEFORE the module ``_lock`` in ``set_value``; nothing
+    acquires them in the reverse order."""
+
     def __init__(self, domain, name, value=0):
         self.name = name
         self.domain = domain
         self._value = value
+        self._vlock = threading.Lock()
 
     def set_value(self, value):
-        self._value = value
+        with self._vlock:
+            self._value = value
         if _state == "run":
             with _lock:
                 _events.append({"name": self.name, "ph": "C",
@@ -305,14 +357,22 @@ class Counter:
                                 "args": {self.name: value}})
 
     def increment(self, delta=1):
-        self.set_value(self._value + delta)
+        with self._vlock:
+            self._value += delta
+            value = self._value
+        if _state == "run":
+            with _lock:
+                _events.append({"name": self.name, "ph": "C",
+                                "ts": _now_us(), "pid": 0,
+                                "args": {self.name: value}})
 
     def decrement(self, delta=1):
-        self.set_value(self._value - delta)
+        self.increment(-delta)
 
     @property
     def value(self):
-        return self._value
+        with self._vlock:
+            return self._value
 
 
 def marker(name, scope="process"):

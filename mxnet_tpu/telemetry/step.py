@@ -1,0 +1,141 @@
+"""Per-step training breakdown: step_time / data_time / comm_time /
+compile_time, assembled WITHOUT host syncs.
+
+The cross-replica sharding literature (PAPERS.md) proves its wins with
+exactly this decomposition; here it falls out of seams the framework
+already owns, so user training loops need no changes:
+
+- io iterators call :func:`add_data_wait` from ``DataIter.__next__``
+  (time spent assembling/waiting for the host batch),
+- the kvstore data plane calls :func:`add_comm` around push/pull,
+- the jax compile listener (telemetry/__init__) calls
+  :func:`add_compile` when a dispatch triggered an XLA build,
+- ``gluon.Trainer.step`` and ``BaseModule.fit`` call
+  :func:`step_boundary` once per optimizer step.
+
+``step_boundary`` charges everything accumulated since the previous
+boundary to the finished step. All quantities are host wall-clock —
+the instrumentation never calls asnumpy/block_until_ready (mxlint
+MXL002 enforces this), so with fully-async dispatch the breakdown
+reports what the *host* spent, which is the pipeline-health signal:
+a step dominated by data_time is input-bound, by comm_time is
+transport-bound, by compile_time is retracing. Device-side kernel
+time lives in the profiler's XLA trace, not here
+(docs/observability.md explains how to read the two together).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from . import metrics as _metrics
+
+
+class _StepState:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.last_boundary = None
+        self.data_s = 0.0
+        self.comm_s = 0.0
+        self.compile_s = 0.0
+        self.last = {}
+
+
+_state = _StepState()
+
+# unlabeled entries cache the SERIES (reset-safe, one lock+add per
+# record); only the per-source step counter stays a family
+_met = _metrics.lazy_metrics(lambda reg: {
+    "steps": reg.counter(
+        "mx_steps_total", "optimizer steps observed",
+        labelnames=("source",)),
+    "step_hist": reg.histogram(
+        "mx_step_time_seconds",
+        "host wall-clock between step boundaries").labels(),
+    "step_sum": reg.counter(
+        "mx_step_time_seconds_total",
+        "total host wall-clock across steps").labels(),
+    "data_sum": reg.counter(
+        "mx_step_data_seconds_total",
+        "host time waiting on / assembling input batches").labels(),
+    "comm_sum": reg.counter(
+        "mx_step_comm_seconds_total",
+        "host time in kvstore push/pull + collectives").labels(),
+    "compile_sum": reg.counter(
+        "mx_step_compile_seconds_total",
+        "host time in XLA trace/compile charged to steps").labels(),
+    "last_step": reg.gauge(
+        "mx_last_step_time_seconds",
+        "most recent step wall-clock").labels(),
+})
+
+
+def add_data_wait(seconds):
+    with _state.lock:
+        _state.data_s += seconds
+
+
+def add_comm(seconds):
+    with _state.lock:
+        _state.comm_s += seconds
+
+
+def add_compile(seconds):
+    with _state.lock:
+        _state.compile_s += seconds
+
+
+def step_boundary(source="trainer"):
+    """Close the current step: charge accumulated data/comm/compile to
+    it and emit the breakdown. Returns the breakdown dict (None for the
+    very first boundary, which only opens the interval)."""
+    if not _metrics.enabled():
+        return None
+    now = time.perf_counter()
+    with _state.lock:
+        data_s, _state.data_s = _state.data_s, 0.0
+        comm_s, _state.comm_s = _state.comm_s, 0.0
+        compile_s, _state.compile_s = _state.compile_s, 0.0
+        prev, _state.last_boundary = _state.last_boundary, now
+    m = _met()
+    # mx_steps_total counts every optimizer step (N); the duration
+    # counters below cover only the N-1 *completed intervals* — derive
+    # mean step time from the histogram's sum/count (which agree), not
+    # from step_sum / steps_total
+    m["steps"].labels(source=source).inc()
+    if prev is None:
+        # first boundary: no interval to charge to. The pre-boundary
+        # data/comm/compile accruals (warmup, first-batch load) are
+        # DISCARDED, not banked — all four *_seconds_total counters
+        # must cover the same N-1 completed intervals or breakdown
+        # ratios exceed 100% on short runs
+        return None
+    step_s = now - prev
+    m["data_sum"].inc(data_s)
+    m["comm_sum"].inc(comm_s)
+    m["compile_sum"].inc(compile_s)
+    m["step_hist"].observe(step_s)
+    m["step_sum"].inc(step_s)
+    m["last_step"].set(step_s)
+    breakdown = {"source": source, "step_time": step_s,
+                 "data_time": data_s, "comm_time": comm_s,
+                 "compile_time": compile_s}
+    with _state.lock:
+        _state.last = breakdown
+    return breakdown
+
+
+def last_breakdown():
+    """The most recently completed step's breakdown dict ({} before
+    the second boundary)."""
+    with _state.lock:
+        return dict(_state.last)
+
+
+def reset():
+    """Drop interval state (test isolation; metrics themselves reset
+    via the registry)."""
+    with _state.lock:
+        _state.last_boundary = None
+        _state.data_s = _state.comm_s = _state.compile_s = 0.0
+        _state.last = {}

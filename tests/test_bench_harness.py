@@ -164,6 +164,26 @@ def test_fail_json_prints_metric_line(capsys):
     assert bench._json_line(line.encode()) == line
 
 
+def test_fail_json_embeds_diagnostic_snapshot(capsys, monkeypatch):
+    """A failure line must carry the debugging context the r05 round
+    lacked: last lifecycle stage, recent diagnostics, env, and any
+    caller-provided probe bookkeeping — bounded in size."""
+    monkeypatch.setenv("MXTPU_BENCH_PROBE_DEADLINE", "75")
+    bench._hb("backend-up: probing")
+    bench._diag("tunnel probe 1 failed")
+    bench._fail_json("tunnel probe 3 failed (wedged backend init?)",
+                     diag={"probe_failures": 3})
+    line = bench._json_line(capsys.readouterr().out.encode())
+    parsed = json.loads(line)
+    assert parsed["value"] == 0.0 and "wedged" in parsed["error"]
+    diag = parsed["diag"]
+    assert diag["stage"] == "backend-up: probing"
+    assert diag["probe_failures"] == 3
+    assert any("tunnel probe 1 failed" in ln for ln in diag["recent"])
+    assert "MXTPU_BENCH_PROBE_DEADLINE" in diag["env"]
+    assert len(line) <= 16384
+
+
 def _fake_clock(monkeypatch):
     """Stepping clock + recorded no-op sleeps: supervise() loops run in
     milliseconds instead of busy-spinning a real wall budget."""

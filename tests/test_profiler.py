@@ -61,6 +61,60 @@ def test_profiler_custom_objects(tmp_path):
     assert {"load_data", "batches", "epoch_end"} <= names
 
 
+def test_profiler_counter_thread_safety():
+    """Regression (PR 4 audit): Counter.increment is a read-modify-write
+    hit concurrently by the host engine's worker threads; unlocked it
+    loses updates. Exact final value proves the per-counter lock."""
+    import threading
+
+    domain = profiler.Domain("mt")
+    counter = profiler.Counter(domain, "hammer", 0)
+    N, T = 5000, 8
+
+    def work():
+        for _ in range(N):
+            counter.increment()
+
+    threads = [threading.Thread(target=work) for _ in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert counter.value == N * T
+
+
+def test_profiler_counter_events_recorded_under_contention(tmp_path):
+    """record_event/Counter emission from many threads while the
+    profiler runs must neither drop the lock nor corrupt the event
+    list (every event lands, json-serializable)."""
+    import threading
+
+    out = tmp_path / "mt.json"
+    profiler.set_config(filename=str(out))
+    profiler.set_state("run")
+    try:
+        domain = profiler.Domain("mt2")
+        counter = profiler.Counter(domain, "evts", 0)
+
+        def work():
+            for _ in range(200):
+                counter.increment()
+                profiler.record_event("w", "mt", 0.0, 1.0)
+
+        threads = [threading.Thread(target=work) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        profiler.set_state("stop")
+    profiler.dump()
+    events = json.loads(out.read_text())["traceEvents"]
+    assert len([e for e in events if e["name"] == "w"]) == 800
+    assert len([e for e in events if e["name"] == "evts"]) == 800
+    assert counter.value == 800
+
+
 def test_profiler_off_by_default(tmp_path):
     assert profiler.state() == "stop"
     # no events recorded while stopped

@@ -1,0 +1,503 @@
+"""Tier-1 tests for the runtime telemetry subsystem (PR 4).
+
+Covers: registry semantics (labels, histogram buckets, lazy drain,
+reset), snapshot format round-trips (JSON, Prometheus, chrome-trace
+merge), the per-step breakdown on a real fit loop (acceptance: nonzero
+step/data/comm and compile counts), the no-host-sync property of every
+instrumented hot path (mxlint MXL002 over the instrumented files),
+bounded enabled-vs-disabled overhead (<5%), the server-metric pull
+through the kvstore profiler-directive channel, and the
+recovery-counter migration shim.
+"""
+import json
+import pickle
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, telemetry
+from mxnet_tpu.telemetry import export, metrics, step
+
+REPO = __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Each test sees enabled telemetry and a zeroed registry."""
+    metrics.set_enabled(True)
+    metrics.registry().reset()
+    step.reset()
+    yield
+    metrics.set_enabled(True)
+    step.reset()
+
+
+# -- registry semantics -----------------------------------------------------
+def test_counter_labels_and_value():
+    reg = metrics.registry()
+    c = reg.counter("t_requests_total", "test", labelnames=("code",))
+    c.labels(code="200").inc()
+    c.labels(code="200").inc(2)
+    c.labels(code="500").inc()
+    assert c.labels(code="200").value == 3
+    assert c.labels(code="500").value == 1
+    with pytest.raises(ValueError):
+        c.labels(verb="GET")          # wrong label set
+    with pytest.raises(ValueError):
+        reg.gauge("t_requests_total")  # kind mismatch
+    with pytest.raises(ValueError):
+        reg.counter("t_requests_total", labelnames=("other",))
+    # unlabeled family rejects direct inc
+    with pytest.raises(ValueError):
+        c.inc()
+
+
+def test_gauge_set_max_high_water():
+    g = metrics.registry().gauge("t_depth", labelnames=())
+    g.set(5)
+    g.set_max(3)
+    assert g.value == 5
+    g.set_max(9)
+    assert g.value == 9
+    g.dec(2)
+    assert g.value == 7
+
+
+def test_histogram_buckets_and_quantism():
+    h = metrics.registry().histogram(
+        "t_latency", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    s = h.labels()
+    assert s.count == 4
+    assert s.sum == pytest.approx(5.555)
+    cum = dict((str(le), c) for le, c in s.cumulative_buckets())
+    assert cum["0.01"] == 1 and cum["0.1"] == 2 and cum["1.0"] == 3
+    assert cum["+Inf"] == 4
+
+
+def test_lazy_values_drain_at_read_not_at_record():
+    """inc_lazy buffers device scalars; the fold happens at value/
+    snapshot time (the metric.py accumulate-on-device pattern)."""
+    import jax.numpy as jnp
+    c = metrics.registry().counter("t_lazy_total")
+    for i in range(5):
+        c.inc_lazy(jnp.asarray(float(i)))
+    assert c.labels()._pending          # still buffered
+    assert c.value == 10.0              # drained exactly once
+    assert not c.labels()._pending
+
+
+def test_histogram_bucket_mismatch_rejected():
+    reg = metrics.registry()
+    reg.histogram("t_bm_seconds", buckets=(0.1, 1.0))
+    reg.histogram("t_bm_seconds", buckets=(1.0, 0.1))  # same set: ok
+    with pytest.raises(ValueError, match="buckets"):
+        reg.histogram("t_bm_seconds", buckets=(0.5, 5.0))
+
+
+def test_failed_kvstore_call_records_no_bytes():
+    """A raising push/pull moved no payload — byte/latency series must
+    not inflate (retry loops would otherwise count phantom traffic)."""
+    kv = mx.kv.create("local")
+    with pytest.raises(mx.MXNetError):
+        kv.push("never_initialized", mx.nd.ones((4,)))
+    snap = export.snapshot()["metrics"]
+    fam = snap.get("mx_kvstore_push_bytes_total", {"series": []})
+    assert not any(s["labels"].get("key") == "never_initialized"
+                   for s in fam["series"])
+
+
+def test_registry_reset_zeroes_but_keeps_schema():
+    reg = metrics.registry()
+    c = reg.counter("t_reset_total", labelnames=("k",))
+    c.labels(k="a").inc(7)
+    reg.reset()
+    assert c.labels(k="a").value == 0
+    assert reg.find("t_reset_total") is c
+
+
+# -- snapshot formats -------------------------------------------------------
+def test_snapshot_json_round_trip(tmp_path):
+    reg = metrics.registry()
+    reg.counter("t_a_total", "help a").inc(3)
+    reg.histogram("t_b_seconds", buckets=(0.1, 1.0)).observe(0.05)
+    snap = export.snapshot()
+    text = export.to_json(snap, indent=1)
+    back = export.from_json(text)
+    assert back["metrics"]["t_a_total"]["series"][0]["value"] == 3
+    assert back["metrics"]["t_b_seconds"]["series"][0]["count"] == 1
+    # file dump is atomic and re-readable
+    p = tmp_path / "snap.json"
+    export.dump(str(p))
+    assert export.from_json(p.read_text())["version"] == 1
+
+
+def test_prometheus_exposition():
+    reg = metrics.registry()
+    reg.counter("t_p_total", "help text",
+                labelnames=("op",)).labels(op='do"t').inc(2)
+    reg.histogram("t_p_seconds", buckets=(0.5,)).observe(0.1)
+    text = export.to_prometheus()
+    assert "# TYPE t_p_total counter" in text
+    assert 't_p_total{op="do\\"t"} 2' in text
+    assert 't_p_seconds_bucket{le="0.5"} 1' in text
+    assert 't_p_seconds_bucket{le="+Inf"} 1' in text
+    assert "t_p_seconds_count 1" in text
+
+
+def test_chrome_trace_merge_carries_both_halves():
+    from mxnet_tpu import profiler
+    metrics.registry().counter("t_m_total").inc(4)
+    ev = [{"name": "opX", "cat": "operator", "ph": "X",
+           "ts": 1.0, "dur": 2.0, "pid": 0, "tid": 0}]
+    trace = export.merge_chrome_trace(events=ev)
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert "opX" in names and "t_m_total" in names
+    assert trace["metadata"]["telemetry"]["metrics"][
+        "t_m_total"]["series"][0]["value"] == 4
+    assert profiler is not None  # clock source imported lazily
+
+
+def test_snapshot_diff():
+    reg = metrics.registry()
+    c = reg.counter("t_d_total")
+    c.inc(1)
+    a = export.snapshot()
+    c.inc(4)
+    b = export.snapshot()
+    d = export.diff(a, b)
+    entry = d["t_d_total"]["{}"]
+    assert entry["before"] == 1 and entry["after"] == 5
+    assert entry["delta"] == 4
+
+
+# -- instrumented fit loop (acceptance criterion a) -------------------------
+def _tiny_fit(num_epoch=2, batch=16, n=64, feat=8, out=4,
+              clock=time.perf_counter):
+    net = gluon.nn.Dense(out)
+    net.initialize(force_reinit=True)
+    kv = mx.kv.create("local")
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05}, kvstore=kv)
+    rs = np.random.RandomState(7)
+    X = rs.rand(n, feat).astype("float32")
+    Y = rs.rand(n, out).astype("float32")
+    it = mx.io.NDArrayIter(X, Y, batch_size=batch)
+    loss_fn = gluon.loss.L2Loss()
+    t0 = clock()
+    for _ in range(num_epoch):
+        it.reset()
+        for b in it:
+            with autograd.record():
+                out = net(b.data[0])
+                loss = loss_fn(out, b.label[0])
+            loss.backward()
+            trainer.step(batch)
+    return clock() - t0
+
+
+def test_fit_loop_emits_step_breakdown_and_compile_counts():
+    # unique layer dims: the jit cache is process-wide, so shapes shared
+    # with other tests (the overhead gate reuses _tiny_fit defaults)
+    # would already be compiled and the compile-count assertion below
+    # would see nothing fresh under reordered execution
+    _tiny_fit(feat=9, out=5)
+    snap = export.snapshot()["metrics"]
+
+    def total(name):
+        fam = snap.get(name, {"series": []})
+        return sum(s.get("value", s.get("sum", 0.0))
+                   for s in fam["series"])
+
+    assert total("mx_step_time_seconds_total") > 0
+    assert total("mx_step_data_seconds_total") > 0
+    assert total("mx_step_comm_seconds_total") > 0
+    assert total("mx_steps_total") >= 8
+    # compile-count metrics: the jitted ops of the step compiled at
+    # least once and were attributed to named ops
+    compiles = snap.get("mx_jit_compiles_total", {"series": []})
+    assert sum(s["value"] for s in compiles["series"]) > 0
+    assert all(s["labels"].get("op") for s in compiles["series"])
+    assert total("mx_kvstore_push_bytes_total") > 0
+    bd = step.last_breakdown()
+    assert bd["step_time"] > 0 and bd["data_time"] >= 0
+    assert bd["comm_time"] > 0
+
+
+def test_module_fit_path_emits_steps():
+    from mxnet_tpu import sym
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    rs = np.random.RandomState(3)
+    X = rs.rand(32, 8).astype("float32")
+    Y = rs.randint(0, 4, (32,)).astype("float32")
+    it = mx.io.NDArrayIter(X, Y, batch_size=8, label_name="softmax_label")
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.fit(it, num_epoch=2, batch_end_callback=None)
+    snap = export.snapshot()["metrics"]
+    series = snap["mx_steps_total"]["series"]
+    by_source = {s["labels"]["source"]: s["value"] for s in series}
+    assert by_source.get("module_fit", 0) >= 8
+
+
+# -- no-host-sync property (acceptance criterion b) -------------------------
+def test_instrumentation_introduces_no_hot_path_syncs():
+    """mxlint MXL002 over every file this PR instrumented: the only
+    findings allowed are the pre-existing baselined transport syncs."""
+    sys.path.insert(0, REPO)
+    try:
+        from mxnet_tpu.analysis import lint as lint_mod
+        from mxnet_tpu.analysis.rules.host_sync import HostSyncRule
+    finally:
+        sys.path.pop(0)
+    import os
+    files = [os.path.join(REPO, p) for p in (
+        "mxnet_tpu/gluon/trainer.py",
+        "mxnet_tpu/module/base_module.py",
+        "mxnet_tpu/kvstore/kvstore.py",
+        "mxnet_tpu/kvstore/dist.py",
+        "mxnet_tpu/metric.py",
+        "mxnet_tpu/telemetry/__init__.py",
+        "mxnet_tpu/telemetry/metrics.py",
+        "mxnet_tpu/telemetry/step.py",
+        "mxnet_tpu/telemetry/export.py",
+    )]
+    baseline = lint_mod.load_baseline(
+        os.path.join(REPO, "tools", "mxlint_baseline.json"))
+    result = lint_mod.run_lint(REPO, [HostSyncRule()], files=files,
+                               baseline=baseline)
+    assert not result.findings, \
+        "new hot-path host syncs:\n" + result.format()
+    assert not result.errors
+
+
+def test_full_mxlint_gate_over_telemetry_subsystem():
+    """MXL001-MXL005 over the new subsystem via the real CLI — the
+    day-one gate the tooling satellite wires."""
+    proc = subprocess.run(
+        [sys.executable, "tools/mxlint.py",
+         "mxnet_tpu/telemetry/__init__.py",
+         "mxnet_tpu/telemetry/metrics.py",
+         "mxnet_tpu/telemetry/step.py",
+         "mxnet_tpu/telemetry/export.py",
+         "tools/telemetry_dump.py"],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- overhead bound (acceptance criterion c) --------------------------------
+def test_enabled_overhead_bounded():
+    """Telemetry-enabled step time within 5% of disabled on the CPU
+    harness. Measured on process CPU time, not wall-clock: the
+    instrumentation's entire cost IS cpu work (locks, adds, timers), so
+    process_time captures it exactly while staying immune to the
+    scheduler noise of a loaded CI box (a wall-clock version of this
+    gate flaked at 8x trial variance). Interleaved min-of-N trials with
+    a retry: noise and GC only ever ADD time, so min estimates the true
+    cost of each mode; real overhead is ~2% (docs/observability.md)."""
+    # warm both paths (XLA executable cache is shared)
+    metrics.set_enabled(False)
+    _tiny_fit(num_epoch=1)
+    metrics.set_enabled(True)
+    _tiny_fit(num_epoch=1)
+    best = None
+    for _ in range(3):
+        on, off = [], []
+        for _ in range(4):
+            metrics.set_enabled(True)
+            step.reset()
+            on.append(_tiny_fit(num_epoch=2, clock=time.process_time))
+            metrics.set_enabled(False)
+            step.reset()
+            off.append(_tiny_fit(num_epoch=2, clock=time.process_time))
+        ratio = min(on) / min(off)
+        best = ratio if best is None else min(best, ratio)
+        if best < 1.05:
+            break
+    metrics.set_enabled(True)
+    assert best < 1.05, \
+        "telemetry overhead %.1f%% across retries (last on=%s off=%s)" \
+        % ((best - 1) * 100, on, off)
+
+
+def test_disabled_records_nothing_on_hot_paths():
+    metrics.set_enabled(False)
+    metrics.registry().reset()
+    _tiny_fit(num_epoch=1)
+    snap = export.snapshot()["metrics"]
+    for name in ("mx_op_dispatches_total", "mx_io_data_wait_seconds",
+                 "mx_kvstore_push_seconds", "mx_steps_total"):
+        fam = snap.get(name)
+        if fam is None:
+            continue
+        assert sum(s.get("value", s.get("count", 0))
+                   for s in fam["series"]) == 0, name
+
+
+# -- cross-process: server-metric pull through the directive channel --------
+def test_server_metrics_snapshot_directive(tmp_path):
+    """The server side of pull_server_metrics: a metrics_snapshot
+    directive arriving over the profiler command channel writes this
+    process's registry snapshot to the requested path."""
+    from mxnet_tpu.kvstore import dist
+    metrics.registry().counter("t_server_total").inc(11)
+    p = tmp_path / "server_metrics.json"
+    dist._apply_profiler_directive(pickle.dumps(
+        {"cmd": "metrics_snapshot", "path": str(p)}))
+    snap = export.from_json(p.read_text())
+    assert snap["metrics"]["t_server_total"]["series"][0]["value"] == 11
+
+
+def test_pull_server_metrics_round_trip(tmp_path):
+    """Worker-side pull against a stand-in connection that executes the
+    directive exactly like the server poll loop does."""
+    from mxnet_tpu.kvstore import dist
+    metrics.registry().counter("t_pull_total").inc(5)
+    p = tmp_path / "pulled.json"
+
+    class FakeConn:
+        def send_profiler_command(self, directive):
+            dist._apply_profiler_directive(pickle.dumps(directive))
+
+    class FakeKV:
+        _conn = FakeConn()
+
+    snap = export.pull_server_metrics(FakeKV(), str(p), timeout=5.0)
+    assert snap["metrics"]["t_pull_total"]["series"][0]["value"] == 5
+
+
+def test_pull_server_metrics_times_out_cleanly(tmp_path):
+    class DeafConn:
+        def send_profiler_command(self, directive):
+            pass
+
+    with pytest.raises(mx.MXNetError, match="did not appear"):
+        export.pull_server_metrics(
+            DeafConn(), str(tmp_path / "never.json"),
+            timeout=0.2, poll=0.05)
+
+
+# -- recovery-counter migration (compatibility shim) ------------------------
+def test_recovery_summary_reads_registry_counters():
+    from mxnet_tpu import profiler
+    before = profiler.recovery_summary()
+    profiler.note_recovery({"op": "push", "req_id": 1,
+                            "outcome": "recovered", "attempts": 3,
+                            "reconnects": 2, "backoff_wait_ms": 12.5})
+    after = profiler.recovery_summary()
+    assert after["incidents"] == before["incidents"] + 1
+    assert after["recovered"] == before["recovered"] + 1
+    assert after["attempts"] == before["attempts"] + 3
+    assert after["reconnects"] == before["reconnects"] + 2
+    assert after["backoff_wait_ms"] == pytest.approx(
+        before["backoff_wait_ms"] + 12.5)
+    assert after["last"]["op"] == "push"
+    # and the same numbers are visible as ordinary metrics
+    snap = export.snapshot()["metrics"]
+    series = snap["mx_recovery_incidents_total"]["series"]
+    outcomes = {s["labels"]["outcome"]: s["value"] for s in series}
+    assert outcomes.get("recovered", 0) >= 1
+
+
+def test_worker_resume_and_rejection_counters_ride_registry():
+    from mxnet_tpu import profiler
+    b = profiler.recovery_summary()
+    profiler.note_worker_resume({"step": 4, "path": "x"})
+    profiler.note_checkpoint_rejected({"path": "y", "step": 3})
+    a = profiler.recovery_summary()
+    assert a["worker_resumes"] == b["worker_resumes"] + 1
+    assert a["checkpoints_rejected"] == b["checkpoints_rejected"] + 1
+
+
+# -- registry thread-safety under concurrent writers ------------------------
+def test_registry_counters_are_thread_safe():
+    c = metrics.registry().counter("t_mt_total")
+    h = metrics.registry().histogram("t_mt_seconds", buckets=(1.0,))
+    N, T = 2000, 8
+
+    def work():
+        for _ in range(N):
+            c.inc()
+            h.observe(0.5)
+
+    threads = [threading.Thread(target=work) for _ in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == N * T
+    assert h.labels().count == N * T
+
+
+# -- checkpoint durations ---------------------------------------------------
+def test_checkpoint_save_restore_metrics(tmp_path):
+    from mxnet_tpu.checkpoint import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    params = {"w": mx.nd.ones((4, 4))}
+    mgr.save(1, params=params)
+    mgr.save(2, params=params)
+    assert mgr.resume_latest() is not None
+    snap = export.snapshot()["metrics"]
+    assert snap["mx_checkpoints_saved_total"]["series"][0]["value"] == 2
+    assert snap["mx_checkpoint_save_seconds"]["series"][0]["count"] == 2
+    assert snap["mx_checkpoint_restore_seconds"]["series"][0]["count"] == 1
+
+
+# -- flusher ---------------------------------------------------------------
+def test_periodic_flusher_writes_snapshots(tmp_path):
+    p = tmp_path / "flush.json"
+    metrics.registry().counter("t_flush_total").inc()
+    fl = telemetry.start_flusher(period=0.05, path=str(p), verbose=False)
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not p.exists():
+            time.sleep(0.02)
+        assert p.exists()
+        snap = export.from_json(p.read_text())
+        assert "t_flush_total" in snap["metrics"]
+    finally:
+        telemetry.stop_flusher()
+
+
+# -- env registration -------------------------------------------------------
+def test_telemetry_env_vars_registered():
+    ev = mx.libinfo.env_vars()
+    for name in ("MXTPU_TELEMETRY", "MXTPU_TELEMETRY_FLUSH_SEC",
+                 "MXTPU_TELEMETRY_FILE", "MXTPU_TELEMETRY_VERBOSE"):
+        assert name in ev, name
+
+
+# -- CLI --------------------------------------------------------------------
+def test_telemetry_dump_cli_pretty_and_diff(tmp_path):
+    reg = metrics.registry()
+    c = reg.counter("t_cli_total")
+    c.inc(2)
+    a = tmp_path / "a.json"
+    export.dump(str(a))
+    c.inc(3)
+    b = tmp_path / "b.json"
+    export.dump(str(b))
+    out = subprocess.run(
+        [sys.executable, "tools/telemetry_dump.py", str(b)],
+        cwd=REPO, capture_output=True, text=True)
+    assert out.returncode == 0 and "t_cli_total" in out.stdout
+    out = subprocess.run(
+        [sys.executable, "tools/telemetry_dump.py", "--diff",
+         str(a), str(b)],
+        cwd=REPO, capture_output=True, text=True)
+    assert out.returncode == 0
+    assert "t_cli_total" in out.stdout and "+3" in out.stdout
+    out = subprocess.run(
+        [sys.executable, "tools/telemetry_dump.py", "--prom", str(b)],
+        cwd=REPO, capture_output=True, text=True)
+    assert out.returncode == 0
+    assert "# TYPE t_cli_total counter" in out.stdout

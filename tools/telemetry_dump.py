@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""telemetry_dump — pretty-print or diff telemetry snapshots.
+
+    python tools/telemetry_dump.py telemetry.json          # table
+    python tools/telemetry_dump.py --prom telemetry.json   # Prometheus text
+    python tools/telemetry_dump.py --diff before.json after.json
+    python tools/telemetry_dump.py --json telemetry.json   # normalized JSON
+
+The before/after diff is the intended workflow for perf PRs: dump a
+snapshot on main, dump one on the branch, and attach the diff (step
+time, compile counts, kvstore bytes) as the PR's proof
+(docs/observability.md "Proving a perf change").
+
+Exit codes: 0 ok, 2 usage/IO error. Loads the telemetry package
+standalone (no mxnet_tpu import, no jax init) so it runs in
+milliseconds anywhere the repo is checked out.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_telemetry():
+    """Load mxnet_tpu.telemetry without executing mxnet_tpu/__init__.py
+    (which initializes the jax backend). MXTPU_TELEMETRY=0 in this
+    process keeps the package import side-effect free (no monitoring
+    listener, no flusher)."""
+    import importlib
+    os.environ["MXTPU_TELEMETRY"] = "0"
+    name = "_tdump_mxtpu"
+    if name not in sys.modules:
+        pkg = types.ModuleType(name)
+        pkg.__path__ = [os.path.join(REPO, "mxnet_tpu")]
+        sys.modules[name] = pkg
+    return importlib.import_module(name + ".telemetry.export")
+
+
+def _read(path):
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            snap = json.load(f)
+    except (OSError, ValueError) as e:
+        print("telemetry_dump: cannot read %s: %s" % (path, e),
+              file=sys.stderr)
+        raise SystemExit(2)
+    if not isinstance(snap, dict) or "metrics" not in snap:
+        print("telemetry_dump: %s is not a telemetry snapshot "
+              "(no 'metrics' key)" % path, file=sys.stderr)
+        raise SystemExit(2)
+    return snap
+
+
+def _fmt_labels(labels):
+    if not labels:
+        return ""
+    return "{%s}" % ",".join("%s=%s" % (k, labels[k])
+                             for k in sorted(labels))
+
+
+def _quantile(buckets, count, q):
+    """Approximate quantile from cumulative histogram buckets."""
+    if not count:
+        return float("nan")
+    target = q * count
+    for le, cum in buckets:
+        if cum >= target:
+            return float("inf") if le == "+Inf" else float(le)
+    return float("inf")
+
+
+def _fmt_num(v):
+    if isinstance(v, float):
+        if v != v or v in (float("inf"), float("-inf")):
+            return str(v)   # nan/inf: empty-histogram quantiles
+        if v == int(v) and abs(v) < 1e12:
+            return str(int(v))
+    return "%.6g" % v
+
+
+def pretty(snap):
+    lines = []
+    ts = snap.get("ts")
+    if ts:
+        import time
+        lines.append("# snapshot at %s" % time.strftime(
+            "%Y-%m-%d %H:%M:%S", time.localtime(ts)))
+    for name, fam in sorted(snap["metrics"].items()):
+        if not fam["series"]:
+            continue
+        lines.append("%s (%s)%s" % (
+            name, fam["type"],
+            "  — " + fam["help"] if fam.get("help") else ""))
+        for s in fam["series"]:
+            lab = _fmt_labels(s.get("labels", {}))
+            if "count" in s:
+                mean = s["sum"] / s["count"] if s["count"] else 0.0
+                lines.append(
+                    "  %-40s count=%d sum=%s mean=%s p50<=%s p99<=%s"
+                    % (lab or "(all)", s["count"], _fmt_num(s["sum"]),
+                       _fmt_num(mean),
+                       _fmt_num(_quantile(s["buckets"], s["count"], .5)),
+                       _fmt_num(_quantile(s["buckets"], s["count"],
+                                          .99))))
+            else:
+                lines.append("  %-40s %s"
+                             % (lab or "(all)", _fmt_num(s["value"])))
+    return "\n".join(lines)
+
+
+def pretty_diff(before, after, d):
+    lines = ["# delta: %s -> %s" % (_fmt_num(before.get("ts", 0)),
+                                    _fmt_num(after.get("ts", 0)))]
+    rows = []
+    for name, series in d.items():
+        for key, entry in series.items():
+            if entry["delta"] == 0 and not entry.get("count_delta"):
+                continue
+            labels = json.loads(key)
+            rows.append((abs(entry["delta"]), name, labels, entry))
+    if not rows:
+        return "no metric changed between the two snapshots"
+    for _, name, labels, entry in sorted(rows, reverse=True,
+                                         key=lambda r: r[0]):
+        extra = ""
+        if "count_delta" in entry:
+            extra = "  (count %+d)" % entry["count_delta"]
+        lines.append("%-48s %12s -> %-12s (%+g)%s" % (
+            name + _fmt_labels(labels), _fmt_num(entry["before"]),
+            _fmt_num(entry["after"]), entry["delta"], extra))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="telemetry_dump",
+                                 description=__doc__)
+    ap.add_argument("paths", nargs="+", help="snapshot file(s)")
+    ap.add_argument("--diff", action="store_true",
+                    help="diff two snapshots (before after)")
+    ap.add_argument("--prom", action="store_true",
+                    help="emit Prometheus text exposition")
+    ap.add_argument("--json", action="store_true",
+                    help="emit normalized JSON")
+    args = ap.parse_args(argv)
+    if args.diff:
+        if len(args.paths) != 2:
+            print("telemetry_dump: --diff takes exactly two snapshots",
+                  file=sys.stderr)
+            return 2
+        export = _load_telemetry()
+        before, after = _read(args.paths[0]), _read(args.paths[1])
+        d = export.diff(before, after)
+        if args.json:
+            print(json.dumps(d, indent=1, sort_keys=True))
+        else:
+            print(pretty_diff(before, after, d))
+        return 0
+    if len(args.paths) != 1:
+        print("telemetry_dump: exactly one snapshot unless --diff",
+              file=sys.stderr)
+        return 2
+    snap = _read(args.paths[0])
+    if args.prom:
+        export = _load_telemetry()
+        sys.stdout.write(export.to_prometheus(snap))
+    elif args.json:
+        print(json.dumps(snap, indent=1, sort_keys=True))
+    else:
+        print(pretty(snap))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
