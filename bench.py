@@ -87,6 +87,13 @@ _DIAG_RING = []
 _DIAG_KEEP = 40
 _LAST_STAGE = ["start"]
 
+# flight-recorder dump file shared by supervisor, probe and bench child
+# (the child's hang watchdog and a wedged probe's faulthandler both
+# write here; every _fail_json embeds it) — the causal record the
+# r01-r05 "tunnel probe N failed (wedged backend init?)" tails lacked
+_FLIGHT_PATH = os.environ.get("MXTPU_FLIGHT_PATH") or os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".bench_flight.json")
+
 
 def _diag(msg):
     _DIAG_RING.append("%s %s" % (time.strftime("%H:%M:%S"), str(msg)[:200]))
@@ -110,9 +117,50 @@ def _diag_snapshot(extra=None):
         "recent": list(_DIAG_RING[-15:]),
         "env": env,
     }
+    # flight-recorder dump left by the child's hang watchdog (JSON at
+    # _FLIGHT_PATH) and/or a wedged probe's faulthandler stacks (raw
+    # text at its own .probe file, so an eager probe open can never
+    # truncate a real hang dump): embed the essentials — this is the
+    # "what was in flight when it wedged" record
+    try:
+        if os.path.exists(_FLIGHT_PATH):
+            with open(_FLIGHT_PATH, "r", encoding="utf-8",
+                      errors="replace") as f:
+                raw = f.read()
+            if raw.strip():       # a zero-byte file is no evidence
+                try:
+                    fdoc = json.loads(raw)
+                    diag["flight_file"] = {
+                        "reason": fdoc.get("reason"),
+                        "idle_ms": fdoc.get("idle_ms"),
+                        "in_flight": [
+                            t.get("in_flight") for t in fdoc.get(
+                                "threads", []) if t.get("in_flight")][:4],
+                        "stacks": {k: v[-800:] for k, v in list(
+                            fdoc.get("stacks", {}).items())[:6]},
+                    }
+                except ValueError:
+                    diag["flight_file"] = {"raw_tail": raw[-1500:]}
+    except OSError:
+        pass
+    try:
+        probe_path = _FLIGHT_PATH + ".probe"
+        if os.path.exists(probe_path):
+            with open(probe_path, "r", encoding="utf-8",
+                      errors="replace") as f:
+                raw = f.read()
+            if raw.strip():
+                # faulthandler's where-init-wedged thread stacks
+                diag["flight_probe"] = {"raw_tail": raw[-1500:]}
+    except OSError:
+        pass
     if "mxnet_tpu" in sys.modules:   # child side only — the supervisor
         try:                          # must never import the backend
             from mxnet_tpu import profiler, telemetry
+            from mxnet_tpu.tracing import flight as _flight
+            # live in-flight span view of THIS process (bounded;
+            # snapshot() carries no stacks — dump() adds those)
+            diag["flight"] = _flight.snapshot(max_spans=5)
             diag["recovery"] = profiler.recovery_summary()
             diag["recovery"].pop("last", None)
             with profiler._lock:
@@ -186,6 +234,15 @@ def _hb(stage):
     fast. `_json_line` ignores anything not starting with '{'."""
     _bump_progress()
     _LAST_STAGE[0] = str(stage)[:120]
+    if "mxnet_tpu" in sys.modules:
+        try:
+            # a stage boundary is forward progress: keep the hang
+            # watchdog quiet through long pure-C++ phases (cold XLA
+            # compiles close no spans for minutes)
+            from mxnet_tpu.tracing import flight as _flight
+            _flight.heartbeat()
+        except Exception:  # noqa: BLE001 — heartbeat is best-effort
+            pass
     _emit("#hb %s %s" % (time.strftime("%H:%M:%S"), stage))
     _diag(stage)
 
@@ -267,17 +324,42 @@ def _probe_backend(deadline=None):
     wedge costs one probe, not a full attempt budget."""
     if deadline is None:
         deadline = int(os.environ.get("MXTPU_BENCH_PROBE_DEADLINE", "75"))
-    code = ("import jax; d = jax.devices(); "
-            "print('PROBE_OK', len(d), d[0].platform)")
+    # a probe that is about to be killed leaves its thread stacks at its
+    # OWN .probe file (faulthandler fires `deadline-5` seconds in, i.e.
+    # only on the wedged path) — _fail_json embeds them, so "tunnel
+    # probe N failed" now says WHERE init wedged (grpc dial, plugin
+    # load, ...). The file is probe-specific so the eager open here can
+    # never truncate a real hang dump at _FLIGHT_PATH, and a clean probe
+    # removes its (empty) file again.
+    probe_path = _FLIGHT_PATH + ".probe"
+    code = (
+        "import faulthandler, os\n"
+        "try:\n"
+        "    _ff = open(os.environ['MXTPU_FLIGHT_PATH'], 'w')\n"
+        "    faulthandler.dump_traceback_later(%d, file=_ff)\n"
+        "except (OSError, KeyError):\n"
+        "    pass\n"
+        "import jax; d = jax.devices()\n"
+        "faulthandler.cancel_dump_traceback_later()\n"
+        "print('PROBE_OK', len(d), d[0].platform)\n"
+        % max(deadline - 5, 5))
+    env = _bench_env()
+    env["MXTPU_FLIGHT_PATH"] = probe_path
     try:
         proc = subprocess.run(
             [sys.executable, "-c", code], timeout=deadline,
-            env=_bench_env(),
+            env=env,
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
     except subprocess.TimeoutExpired:
         return False
     out = (proc.stdout or b"").decode(errors="replace")
-    return proc.returncode == 0 and "PROBE_OK" in out
+    ok = proc.returncode == 0 and "PROBE_OK" in out
+    if ok:
+        try:
+            os.unlink(probe_path)
+        except OSError:
+            pass
+    return ok
 
 
 def supervise():
@@ -299,6 +381,14 @@ def supervise():
     """
     env = _bench_env()
     env[_CHILD_SENTINEL] = "1"
+    env.setdefault("MXTPU_FLIGHT_PATH", _FLIGHT_PATH)
+    # a stale dump from a previous round must never masquerade as this
+    # round's hang evidence
+    for stale in (_FLIGHT_PATH, _FLIGHT_PATH + ".probe"):
+        try:
+            os.unlink(stale)
+        except OSError:
+            pass
     budget = float(os.environ.get("MXTPU_BENCH_BUDGET", "2700"))
     max_full_attempts = 4
     last_err = "unknown"
@@ -681,6 +771,18 @@ def main():
         raise TimeoutError("TPU backend init timed out after 150s")
 
     _enable_compile_cache()
+    try:
+        # arm the flight recorder around every stage of this child: a
+        # wedged step dumps the in-flight span tree + thread stacks to
+        # MXTPU_FLIGHT_PATH, which the supervisor embeds in the failure
+        # JSON. 240s default: the dump must land BEFORE the supervisor's
+        # 300s silence kill. _hb() heartbeats keep long compiles quiet.
+        from mxnet_tpu.tracing import flight as _flight
+        os.environ.setdefault("MXTPU_HANG_TIMEOUT_SEC", "240")
+        os.environ.setdefault("MXTPU_FLIGHT_PATH", _FLIGHT_PATH)
+        _flight.install()
+    except Exception as e:  # noqa: BLE001 — diagnostics must never
+        _diag("flight recorder unavailable: %r" % (e,))  # block a run
     _diag("initializing backend")
     signal.signal(signal.SIGALRM, _alarm)
     signal.alarm(150)  # fail fast: a healthy init takes seconds

@@ -110,6 +110,13 @@ def load_comm():
     lib.mxtpu_client_command.restype = ctypes.c_int
     lib.mxtpu_client_close.argtypes = [ctypes.c_void_p]
     lib.mxtpu_client_set_timeout.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    # tracing layer: wire-v2 context stamping + server-side span sink
+    lib.mxtpu_client_set_trace.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_uint64,
+                                           ctypes.c_uint64]
+    lib.mxtpu_server_set_trace_sink.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_server_current_trace.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64)]
     _comm_lib = lib
     return lib
 
@@ -262,6 +269,27 @@ def set_server_updater(py_fn):
     cb = UPDATER_CFUNC(trampoline)
     _updater_keepalive.append(cb)
     lib.mxtpu_server_set_updater(ctypes.cast(cb, ctypes.c_void_p))
+
+
+# per-traced-request server callback (comm.cc TraceSinkFn):
+# (op, key, req_id, rank, trace_id, span_id, recv_ns, done_ns)
+TRACE_SINK_CFUNC = ctypes.CFUNCTYPE(
+    None, ctypes.c_uint8, ctypes.c_uint32, ctypes.c_uint64, ctypes.c_int,
+    ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64)
+
+_trace_sink_keepalive = []
+
+
+def set_server_trace_sink(py_fn, lib=None):
+    """Install a tracing sink on the native transport: ``py_fn`` is
+    invoked once per traced request (see TRACE_SINK_CFUNC) from the
+    server's connection threads. The callback object is kept alive for
+    the library's lifetime (same contract as set_server_updater)."""
+    if lib is None:
+        lib = load_comm()
+    cb = TRACE_SINK_CFUNC(py_fn)
+    _trace_sink_keepalive.append(cb)
+    lib.mxtpu_server_set_trace_sink(ctypes.cast(cb, ctypes.c_void_p))
 
 
 _core_lib = None

@@ -17,7 +17,7 @@
 //   * every request carries a per-client monotonically increasing
 //     request id, so a RESEND after a reconnect is idempotent at the
 //     server (pushes merge once, barriers complete once);
-//   * a worker may reconnect and reclaim its rank ("MXTWr" rendezvous),
+//   * a worker may reconnect and reclaim its rank ("MXT2r" rendezvous),
 //     resuming the in-flight BSP round — its parked pulls are purged on
 //     disconnect and simply resent;
 //   * with a recovery grace window armed (mxtpu_server_set_recovery_
@@ -32,9 +32,19 @@
 //     the server at exact protocol points — driven by the Python-side
 //     MXNET_KVSTORE_FAULT_PLAN parser (kvstore/fault.py).
 //
-// Wire protocol (little-endian):
-//   request:  u8 op | u32 key | u64 req_id | u64 nbytes | payload
+// Wire protocol v2 (little-endian):
+//   request:  u8 op | u32 key | u64 req_id | u64 nbytes
+//             | u64 trace_id | u64 span_id | payload
 //   response: u8 ok | u64 nbytes | payload
+// trace_id/span_id carry the caller's tracing context (0 = untraced);
+// the server reports each traced request to an optional host-language
+// sink (mxtpu_server_set_trace_sink) with CLOCK_MONOTONIC recv/done
+// timestamps, and exposes the in-flight request's context to the host
+// updater via mxtpu_server_current_trace. Both sides build from THIS
+// file, so there is no version-skew window; a future header change
+// must bump the rendezvous magic again (v1 was "MXTW", this
+// 16-byte header growth bumped it to "MXT2" so a mixed v1/v2 pair
+// fails fast at handshake instead of desyncing the stream).
 // Ops: 1=INIT 2=PUSH 3=PULL 4=BARRIER 5=COMMAND 6=PUSH_2BIT 7=PULL_ROWS
 // Commands (key field): 1=set_sync_mode(payload u8) 2=stop
 //   3=server_profiler(opaque directive blob, enqueued for the host
@@ -43,8 +53,8 @@
 //   ack deferred until the host loop installs the updater). Both blob
 //   commands share one FIFO drained by mxtpu_server_poll; the host
 //   side distinguishes them by payload prefix.
-// Rendezvous: client sends 5 magic bytes — "MXTWw" fresh worker (rank
-//   assigned), "MXTWp" probe (no rank), "MXTWr" reconnect (followed by
+// Rendezvous: client sends 5 magic bytes — "MXT2w" fresh worker (rank
+//   assigned), "MXT2p" probe (no rank), "MXT2r" reconnect (followed by
 //   a u32 rank to reclaim); server answers u32 rank | u32 num_workers.
 //
 // Build: g++ -O2 -shared -fPIC -pthread comm.cc -o libmxtpu_comm.so
@@ -75,10 +85,54 @@ struct Header {
   uint32_t key;
   uint64_t req_id;
   uint64_t nbytes;
+  uint64_t trace_id;  // tracing context (0 = untraced)
+  uint64_t span_id;
 } __attribute__((packed));
 
 constexpr uint8_t kInit = 1, kPush = 2, kPull = 3, kBarrier = 4,
                   kCommand = 5, kPush2Bit = 6, kPullRows = 7;
+
+// ------------------------------------------------------------ trace sink
+// Host-language tracing callback: invoked once per traced request after
+// its handling completes (queued pulls report recv->parked). Timestamps
+// are CLOCK_MONOTONIC ns — the same clock Python's time.monotonic_ns()
+// reads on Linux, so worker spans and these nest on one axis.
+typedef void (*TraceSinkFn)(uint8_t op, uint32_t key, uint64_t req_id,
+                            int rank, uint64_t trace_id, uint64_t span_id,
+                            uint64_t recv_ns, uint64_t done_ns);
+TraceSinkFn g_trace_sink = nullptr;
+// context of the request THIS connection thread is handling, so a host
+// updater running inside it can parent its span to the worker's push
+thread_local uint64_t t_cur_trace = 0;
+thread_local uint64_t t_cur_span = 0;
+
+uint64_t mono_ns() {
+  return static_cast<uint64_t>(
+      std::chrono::duration_cast<std::chrono::nanoseconds>(
+          std::chrono::steady_clock::now().time_since_epoch())
+          .count());
+}
+
+// RAII per-request scope: sets the thread-local context for the host
+// updater and fires the sink on every exit path (continue/break/return)
+struct TraceScope {
+  const Header& h;
+  int rank;
+  uint64_t recv_ns = 0;
+  TraceScope(const Header& hh, int r) : h(hh), rank(r) {
+    t_cur_trace = hh.trace_id;
+    t_cur_span = hh.span_id;
+    if (hh.trace_id != 0 && g_trace_sink != nullptr) recv_ns = mono_ns();
+  }
+  ~TraceScope() {
+    t_cur_trace = 0;
+    t_cur_span = 0;
+    TraceSinkFn sink = g_trace_sink;
+    if (recv_ns != 0 && sink != nullptr)
+      sink(h.op, h.key, h.req_id, rank, h.trace_id, h.span_id, recv_ns,
+           mono_ns());
+  }
+};
 
 bool read_full(int fd, void* buf, size_t n) {
   char* p = static_cast<char*>(buf);
@@ -541,13 +595,13 @@ void worker_reconnected(Server* s, int rank) {
 void handle_conn(Server* s, int fd) {
   int rank = -1;
   {
-    // rendezvous: the client first identifies itself ("MXTWw" worker /
-    // "MXTWp" probe / "MXTWr" reconnect+rank); stray TCP connects never
+    // rendezvous: the client first identifies itself ("MXT2w" worker /
+    // "MXT2p" probe / "MXT2r" reconnect+rank); stray TCP connects never
     // consume a worker rank (a 5s deadline bounds the wait)
     timeval tv{5, 0};
     ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
     char magic[5];
-    if (!read_full(fd, magic, 5) || std::memcmp(magic, "MXTW", 4) != 0) {
+    if (!read_full(fd, magic, 5) || std::memcmp(magic, "MXT2", 4) != 0) {
       ::close(fd);
       return;
     }
@@ -593,6 +647,9 @@ void handle_conn(Server* s, int fd) {
     if (!read_full(fd, &h, sizeof(h))) break;
     payload.resize(h.nbytes);
     if (h.nbytes > 0 && !read_full(fd, payload.data(), h.nbytes)) break;
+    // per-request tracing scope: thread-local context for the host
+    // updater + sink report on every exit path of this iteration
+    TraceScope trace_scope(h, rank);
     // server-seam fault rules (delayed responses etc.) fire per request
     long long delay_ms = 0;
     int fault = fault_match(&g_server_faults, rank, h.op, h.key, h.req_id,
@@ -1019,6 +1076,19 @@ void mxtpu_server_set_recovery_grace(int grace_ms) {
   start_watchdog_locked(g_server);
 }
 
+// host-language tracing sink for traced requests (wire v2 trace ids).
+// Installable any time (pointer store); nullptr disables.
+void mxtpu_server_set_trace_sink(TraceSinkFn fn) { g_trace_sink = fn; }
+
+// tracing context of the request the CURRENT connection thread is
+// handling — (0, 0) outside a request or for untraced ones. Lets the
+// host updater parent its span to the worker push it is applying.
+void mxtpu_server_current_trace(unsigned long long* trace_id,
+                                unsigned long long* span_id) {
+  if (trace_id) *trace_id = t_cur_trace;
+  if (span_id) *span_id = t_cur_span;
+}
+
 // likewise stageable pre-start: a restored server's first merge round
 // must run the restored optimizer, not a plain sum
 void mxtpu_server_set_updater(UpdaterFn fn) {
@@ -1146,6 +1216,14 @@ struct Client {
   std::mutex mu;
 };
 
+// tracing context stamped on the next request ISSUED BY THIS THREAD
+// (consumed by it); 0 = untraced. Thread-local, NOT per-client: the
+// transport supports concurrent callers on one connection, and a
+// set-then-send stash on the handle would let caller B's request()
+// consume caller A's context between A's set_trace and A's send.
+thread_local uint64_t t_next_trace_id = 0;
+thread_local uint64_t t_next_span_id = 0;
+
 static void* connect_common(const char* host, int port, const char* magic,
                             const uint32_t* claim_rank) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
@@ -1187,14 +1265,14 @@ static void* connect_common(const char* host, int port, const char* magic,
 }
 
 void* mxtpu_client_connect(const char* host, int port) {
-  return connect_common(host, port, "MXTWw", nullptr);
+  return connect_common(host, port, "MXT2w", nullptr);
 }
 
 // reconnect after a transport failure, reclaiming a previously assigned
 // rank (the rendezvous re-run of the recovery protocol)
 void* mxtpu_client_connect_as(const char* host, int port, int rank) {
   uint32_t r = static_cast<uint32_t>(rank);
-  return connect_common(host, port, "MXTWr", &r);
+  return connect_common(host, port, "MXT2r", &r);
 }
 
 // per-request deadline: a request outliving this fails with rc -1
@@ -1227,6 +1305,17 @@ void mxtpu_client_set_next_req_id(void* h, unsigned long long id) {
   c->next_req_id = id;
 }
 
+// stamp the tracing context on this thread's next request (consumed by
+// it; call again before a recovery resend — the Python span wrapper
+// does). The handle parameter is kept for ABI symmetry; the stash is
+// thread-local, so set_trace and the request it decorates must run on
+// the same thread (they do: the span wrapper calls both inline).
+void mxtpu_client_set_trace(void* /*h*/, unsigned long long trace_id,
+                            unsigned long long span_id) {
+  t_next_trace_id = trace_id;
+  t_next_span_id = span_id;
+}
+
 static int request(Client* c, uint8_t op, uint32_t key, const void* payload,
                    uint64_t nbytes, void* out, uint64_t out_cap,
                    uint64_t* out_n) {
@@ -1236,8 +1325,10 @@ static int request(Client* c, uint8_t op, uint32_t key, const void* payload,
   // handle must still own a fresh id — resending a PREVIOUS request's
   // id would be deduped by the server's watermark into a silent no-op
   uint64_t rid = c->next_req_id++;
+  uint64_t tid = t_next_trace_id, sid = t_next_span_id;
+  t_next_trace_id = t_next_span_id = 0;
   if (c->broken) return -1;
-  Header h{op, key, rid, nbytes};
+  Header h{op, key, rid, nbytes, tid, sid};
   // client-seam fault rules: drop/delay/truncate at the exact request
   long long delay_ms = 0;
   int fault = fault_match(&g_client_faults, c->rank, op, key, h.req_id,

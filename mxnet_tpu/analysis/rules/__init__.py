@@ -14,6 +14,8 @@ run — cross-module rules accumulate in ``check_module`` and emit from
 | MXL003 | atomic-write        | bare write-mode open() in checkpoint paths |
 | MXL004 | env-var-registry    | env vars read but unregistered in libinfo  |
 | MXL005 | registry-hygiene    | op name/alias collisions across ops/*      |
+| MXL006 | trace-attr-sync     | host syncs computing span attributes in    |
+|        |                     | hot paths (tracing instrumentation)        |
 """
 from __future__ import annotations
 
@@ -26,8 +28,10 @@ def all_rules():
     from .atomic_write import AtomicWriteRule
     from .env_registry import EnvRegistryRule
     from .registry_hygiene import RegistryHygieneRule
+    from .trace_attrs import TraceAttrSyncRule
     return [TracerPurityRule(), HostSyncRule(), AtomicWriteRule(),
-            EnvRegistryRule(), RegistryHygieneRule()]
+            EnvRegistryRule(), RegistryHygieneRule(),
+            TraceAttrSyncRule()]
 
 
 # -- shared AST helpers ------------------------------------------------------

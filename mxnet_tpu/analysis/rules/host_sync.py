@@ -40,6 +40,11 @@ _SCOPES = (
       "observe", "observe_lazy", "_push_lazy", "add_data_wait",
       "add_comm", "add_compile", "step_boundary",
       "_on_event_duration"}, set()),
+    # the tracing recorders run inside every instrumented seam above;
+    # a sync in span open/close would stall each traced hot path
+    ("mxnet_tpu/tracing/",
+     {"__enter__", "__exit__", "span", "span_at", "record_span",
+      "set_attr", "heartbeat", "_touch", "_observe_span"}, set()),
 )
 
 # calls that block on (or copy from) the device stream

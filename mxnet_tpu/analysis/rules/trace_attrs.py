@@ -1,0 +1,89 @@
+"""MXL006 — no blocking host syncs in span attribute computation on
+hot paths.
+
+The tracing layer (PR 5) is designed so a span costs a clock read and
+a ring append; that budget is blown the moment a call site computes an
+attribute with ``asnumpy()``/``wait_to_read()``/``float(arr)``, e.g.::
+
+    with span("step", loss=float(loss_nd)):   # syncs EVERY step
+        ...
+
+MXL002 polices hot-path method bodies in general; this rule pins the
+specific failure mode that tracing invites — device reads smuggled
+into ``span(...)``/``traced(...)``/``set_attr(...)`` argument lists —
+over the same hot-path scope list, so instrumentation-heavy PRs get a
+targeted message (attach the value AFTER the sync point, or log ids/
+shapes instead of values).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..lint import Rule
+from . import call_name, dotted_name
+from .host_sync import _SYNC_ATTRS, _hot_scope
+
+# call-expression heads that open/annotate spans
+_SPAN_CALLEES = {"span", "span_at", "traced", "record_span", "set_attr"}
+
+# bare-name calls that fold a device value to host when fed an array
+_FOLD_NAMES = {"float", "int", "bool"}
+
+
+class TraceAttrSyncRule(Rule):
+    code = "MXL006"
+    name = "trace-attr-sync"
+    description = ("span()/traced()/set_attr() arguments in hot paths "
+                   "must not compute attributes via host syncs "
+                   "(asnumpy/wait_to_read/float(array)/np.asarray)")
+
+    def _sync_in(self, expr, sync_names):
+        """The first sync-looking call inside an attribute expression,
+        else None."""
+        for sub in ast.walk(expr):
+            if not isinstance(sub, ast.Call):
+                continue
+            func = sub.func
+            if isinstance(func, ast.Attribute) and \
+                    func.attr in _SYNC_ATTRS:
+                return func.attr
+            name = dotted_name(func)
+            if name in ("np.asarray", "numpy.asarray"):
+                return name
+            if isinstance(func, ast.Name):
+                if func.id in sync_names:
+                    return func.id
+                if func.id in _FOLD_NAMES and sub.args and \
+                        not isinstance(sub.args[0], ast.Constant):
+                    return "%s()" % func.id
+        return None
+
+    def check_module(self, path, tree, lines):
+        methods, sync_names = _hot_scope(path)
+        if methods is None:
+            return
+        for scope in ast.walk(tree):
+            if not isinstance(scope,
+                              (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if scope.name not in methods:
+                continue
+            for node in ast.walk(scope):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = call_name(node)
+                if callee.rsplit(".", 1)[-1] not in _SPAN_CALLEES:
+                    continue
+                args = list(node.args) + [kw.value
+                                          for kw in node.keywords]
+                for arg in args:
+                    sync = self._sync_in(arg, sync_names)
+                    if sync is not None:
+                        yield self.finding(
+                            path, node,
+                            f"span attribute in hot path {scope.name!r} "
+                            f"computed via {sync} — this syncs the "
+                            "device stream once per span; record ids/"
+                            "shapes, or attach the value after the "
+                            "sync point", lines)
+                        break

@@ -45,6 +45,7 @@ import zlib
 
 from .base import MXNetError, get_env
 from .telemetry import metrics as _tm
+from . import tracing as _tracing
 
 _met = _tm.lazy_metrics(lambda reg: {
     "save_s": reg.histogram(
@@ -409,6 +410,7 @@ class CheckpointManager:
         return sorted(out)
 
     # -- write ----------------------------------------------------------
+    @_tracing.traced(name="checkpoint_save", cat="checkpoint")
     def save(self, step, params=None, trainer=None, data_iter=None,
              extra=None):
         """Capture full training state at global batch ``step``.
@@ -550,6 +552,7 @@ class CheckpointManager:
                 out["iter_state"] = pickle.load(f)
         return out
 
+    @_tracing.traced(name="checkpoint_restore", cat="checkpoint")
     def resume_latest(self, trainer=None, data_iter=None):
         """Auto-resume: load the newest valid checkpoint and apply it to
         ``trainer``/``data_iter``/the RNG chain. Returns the loaded

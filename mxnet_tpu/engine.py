@@ -20,6 +20,7 @@ import jax
 
 from .base import get_env
 from .telemetry import metrics as _tm
+from . import tracing as _tracing
 
 # cached SERIES (reset-safe) — per-op cost is one lock+add
 _met = _tm.lazy_metrics(lambda reg: {
@@ -163,6 +164,18 @@ class _HostEngine:
         A raised exception poisons the write vars (rethrown at wait)."""
         if _tm.enabled():
             _met()["host_ops"].inc()
+        if _tracing.enabled():
+            # the async push→exec edge: capture the pusher's context
+            # here, reopen it as the exec span's parent on whichever
+            # engine worker thread runs the task
+            ctx = _tracing.context()
+            if ctx[0]:
+                task, label = fn, getattr(fn, "__qualname__", "task")
+
+                def fn(_task=task, _ctx=ctx, _label=label):
+                    with _tracing.span_at(_ctx, "host_engine_exec",
+                                          cat="engine", task=_label):
+                        _task()
         if _naive:
             # determinism switch serializes host tasks too
             # (ref: src/engine/naive_engine.cc:50 executes on push)

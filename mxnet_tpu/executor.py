@@ -233,7 +233,9 @@ class Executor:
         key = _random.next_key()
         arg_vals, aux_vals, key = self._place(arg_vals, aux_vals, key)
         from . import telemetry as _telemetry
+        from . import tracing as _tracing
         with self._maybe_profile("executor_forward") as prof, \
+                _tracing.span("executor_forward", cat="compute"), \
                 _telemetry.compile_scope("executor_forward"):
             outs, aux_updates = self._jitted_forward(bool(is_train))(
                 arg_vals, aux_vals, key)
@@ -336,7 +338,9 @@ class Executor:
             cotangents = [g._data if isinstance(g, NDArray)
                           else jnp.asarray(g) for g in out_grads]
         from . import telemetry as _telemetry
+        from . import tracing as _tracing
         with self._maybe_profile("executor_backward") as prof, \
+                _tracing.span("executor_backward", cat="compute"), \
                 _telemetry.compile_scope("executor_backward"):
             grads = self._vjp(arg_vals, aux_vals, key, cotangents)
             if prof or self._serialize_steps():

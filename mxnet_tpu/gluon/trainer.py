@@ -8,6 +8,7 @@ kept for the update_on_kvstore policy and the dist/sparse paths.
 from __future__ import annotations
 
 from .. import optimizer as opt_mod
+from .. import tracing as _tracing
 from ..base import MXNetError
 from ..telemetry import step as _tm_step
 from .parameter import Parameter, ParameterDict
@@ -88,11 +89,15 @@ class Trainer:
         # optimizer to the server on first step, and the server must see
         # the batch scaling or dist updates explode by batch_size
         self._optimizer.rescale_grad = self._scale / batch_size
-        if not self._kv_initialized:
-            self._init_kvstore()
-        self._sync_server_rescale()
-        self._allreduce_grads()
-        self._update(ignore_stale_grad)
+        # root span per optimizer step: the comm/compute children under
+        # it are what trace_merge's straggler report groups by step
+        n = self._step_count = getattr(self, "_step_count", -1) + 1
+        with _tracing.span("trainer_step", cat="step", step=n):
+            if not self._kv_initialized:
+                self._init_kvstore()
+            self._sync_server_rescale()
+            self._allreduce_grads()
+            self._update(ignore_stale_grad)
         # one boundary per optimizer step: charges the data/comm/compile
         # time accumulated since the previous step to this one
         # (telemetry/step.py; wall-clock only, no host sync). Manual

@@ -22,6 +22,7 @@ from ..base import MXNetError
 from ..ndarray import NDArray, array
 from ..telemetry import metrics as _tm
 from ..telemetry import step as _tm_step
+from .. import tracing as _tracing
 
 _data_wait_hist = _tm.lazy_metrics(lambda reg: reg.histogram(
     "mx_io_data_wait_seconds",
@@ -92,15 +93,18 @@ class DataIter:
         # data-wait seam: every `for batch in it` loop (fit, score,
         # user code) passes here, so this one timer feeds both the io
         # histogram and the per-step breakdown's data_time — no matter
-        # which concrete iterator (or prefetch wrapper) is underneath
-        if not _tm.enabled():
-            return self.next()
-        t0 = time.perf_counter()
-        batch = self.next()   # StopIteration propagates untimed
-        dt = time.perf_counter() - t0
-        _data_wait_hist().observe(dt)
-        _tm_step.add_data_wait(dt)
-        return batch
+        # which concrete iterator (or prefetch wrapper) is underneath.
+        # The span is the causal record of the same wait (tracing).
+        with _tracing.span("data_next", cat="io",
+                           iter=type(self).__name__):
+            if not _tm.enabled():
+                return self.next()
+            t0 = time.perf_counter()
+            batch = self.next()   # StopIteration propagates untimed
+            dt = time.perf_counter() - t0
+            _data_wait_hist().observe(dt)
+            _tm_step.add_data_wait(dt)
+            return batch
 
     def iter_next(self):
         raise NotImplementedError

@@ -40,6 +40,7 @@ from .. import _native
 # import-time entry) — a lazy `from .. import profiler` inside the
 # callback would deadlock on the package's import lock
 from .. import profiler
+from .. import tracing as _tracing
 from ..telemetry import metrics as _tm_metrics
 from . import fault as fault_mod
 
@@ -180,6 +181,30 @@ class WorkerConnection:
 
     # -- recovery core -----------------------------------------------------
     def _call(self, op, invoke):
+        """Span + wire-context wrapper around :meth:`_call_impl`: every
+        dist request runs inside a ``kv.<op>`` span whose (trace_id,
+        span_id) is stamped into the request header (wire v2) — the
+        server opens the matching ``server_recv:<op>`` child span, the
+        cross-process edge tools/trace_merge.py aligns clocks with."""
+        if not _tracing.enabled():
+            return self._call_impl(op, invoke)
+        # optional on the transport: a stub/legacy lib without the
+        # wire-v2 entry point simply sends untraced requests
+        set_trace = getattr(self._lib, "mxtpu_client_set_trace", None)
+        with _tracing.span("kv.%s" % op, cat="comm",
+                           rank=self.rank) as sp:
+            def stamped(h, _invoke=invoke, _sp=sp):
+                # re-stamped per attempt: a recovery resend on a fresh
+                # connection must carry the same span context
+                if set_trace is not None:
+                    set_trace(h, _sp.trace_id, _sp.span_id)
+                return _invoke(h)
+            rc = self._call_impl(op, stamped)
+            if rc < 0:   # pull-style calls return positive sizes
+                sp.set_attr("rc", int(rc))
+            return rc
+
+    def _call_impl(self, op, invoke):
         """Run ``invoke(handle) -> rc``; on a transport failure (rc -1)
         with a recovery budget armed, reconnect with the reclaimed rank,
         pin the failed request id, and resend until the budget is spent.
@@ -352,6 +377,22 @@ class WorkerConnection:
         self.command(CMD_SERVER_PROFILER,
                      PROF_MAGIC + pickle.dumps(directive))
 
+    def trace_clock_sync(self, rounds=5):
+        """Emit ``rounds`` traced no-op directives over the existing
+        directive channel. Each one is a worker-side ``kv.clock_sync``
+        span whose server-side ``server_recv:command`` child carries the
+        SERVER clock's recv timestamp — the (send, recv, ack) triples
+        tools/trace_merge.py estimates per-rank clock offsets from.
+        Cheap (an empty blob the server's poll loop discards); a no-op
+        when tracing is disabled."""
+        if not _tracing.enabled():
+            return
+        body = PROF_MAGIC + pickle.dumps({"cmd": "noop"})
+        for _ in range(max(int(rounds), 1)):
+            self._call("clock_sync",
+                       lambda h: self._lib.mxtpu_client_command(
+                           h, CMD_SERVER_PROFILER, body, len(body)))
+
     def stop_server(self):
         self.command(CMD_STOP)
 
@@ -496,6 +537,10 @@ class ShardedConnection:
         self.command(CMD_SERVER_PROFILER,
                      PROF_MAGIC + pickle.dumps(directive))
 
+    def trace_clock_sync(self, rounds=5):
+        for c in self._conns:
+            c.trace_clock_sync(rounds)
+
     def stop_server(self):
         self.command(CMD_STOP)
 
@@ -541,6 +586,12 @@ def _apply_profiler_directive(body):
         elif cmd == "metrics_snapshot":
             from ..telemetry import export as _tm_export
             _tm_export.dump(d["path"])
+        elif cmd == "trace_dump":
+            # worker-requested server trace file (the tracing analogue
+            # of metrics_snapshot: trace_merge wants one file per rank)
+            _tracing.export.write_trace(d["path"])
+        elif cmd == "noop":
+            pass   # clock-sync probe: the traced request IS the payload
     except Exception as e:  # noqa: BLE001 — the worker already got its
         # ACK (the command is async by design); a malformed directive
         # must not take down the poll loop the whole job depends on
@@ -635,6 +686,13 @@ def run_server(port=None, num_workers=None, poll_ms=200):
     if num_workers is None:
         num_workers = num_workers_env()
 
+    if _tracing.enabled():
+        # traced worker requests become server_recv:* child spans in
+        # THIS process's rings (dumped via the trace_dump directive or
+        # MXTPU_TRACE_FILE at exit)
+        from ..tracing import wire as _tw
+        _tw.install_server_sink(lib)
+
     rules = fault_mod.plan_from_env()
     if rules:
         fault_mod.install_server_rules(lib, rules)
@@ -671,8 +729,20 @@ def run_server(port=None, num_workers=None, poll_ms=200):
             from ..ndarray import NDArray
             import jax.numpy as jnp
             t0 = time.perf_counter()
-            with profiler.timed_region("server_update:key%d" % key,
-                                       "kvstore"):
+            tr = _tracing.NOOP
+            if _tracing.enabled():
+                # parent the update span to the worker push that
+                # completed the round (thread-local set by the native
+                # connection thread handling that push, comm.cc);
+                # untraced pushes (ctx 0,0) record nothing
+                from ..tracing import wire as _tw
+                ctx = _tw.server_parent_ctx(_native.load_comm())
+                if ctx[0]:
+                    tr = _tracing.span_at(ctx, "server_update",
+                                          cat="comm", key=key,
+                                          role="server")
+            with tr, profiler.timed_region("server_update:key%d" % key,
+                                           "kvstore"):
                 w = NDArray(jnp.asarray(stored))
                 g = NDArray(jnp.asarray(recved))
                 if key not in _states:

@@ -22,6 +22,7 @@ from ..ndarray.sparse import RowSparseNDArray
 from ..optimizer import Updater
 from ..telemetry import metrics as _tm
 from ..telemetry import step as _tm_step
+from .. import tracing as _tracing
 
 _met = _tm.lazy_metrics(lambda reg: {
     "push_bytes": reg.counter(
@@ -181,20 +182,24 @@ class KVStore:
 
     def push(self, key, value, priority=0):
         keys, values = self._normalize(key, value)
-        if not _tm.enabled():
-            return self._push_impl(keys, values)
-        t0 = time.perf_counter()
-        # record on SUCCESS only: a raising push moved no bytes, and a
-        # retry loop around it must not inflate the byte/latency series
-        # (failures are recovery telemetry's job, profiler.note_recovery)
-        ret = self._push_impl(keys, values)
-        dt = time.perf_counter() - t0
-        m = _met()
-        m["push_s"].observe(dt)
-        _tm_step.add_comm(dt)
-        for k, v in zip(keys, values):
-            self._byte_series("push_bytes", k).inc(_nbytes(v))
-        return ret
+        # span attrs are static (no host syncs — mxlint MXL006); the
+        # dist transport opens kv.push children that ride the wire
+        with _tracing.span("kvstore_push", cat="comm",
+                           nkeys=len(keys)):
+            if not _tm.enabled():
+                return self._push_impl(keys, values)
+            t0 = time.perf_counter()
+            # record on SUCCESS only: a raising push moved no bytes —
+            # a retry loop around it must not inflate the byte/latency
+            # series (failures are profiler.note_recovery's job)
+            ret = self._push_impl(keys, values)
+            dt = time.perf_counter() - t0
+            m = _met()
+            m["push_s"].observe(dt)
+            _tm_step.add_comm(dt)
+            for k, v in zip(keys, values):
+                self._byte_series("push_bytes", k).inc(_nbytes(v))
+            return ret
 
     def _push_impl(self, keys, values):
         for k, v in zip(keys, values):
@@ -245,17 +250,19 @@ class KVStore:
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = self._normalize(key, out)
-        if not _tm.enabled():
-            return self._pull_impl(keys, outs)
-        t0 = time.perf_counter()
-        ret = self._pull_impl(keys, outs)
-        dt = time.perf_counter() - t0
-        m = _met()
-        m["pull_s"].observe(dt)
-        _tm_step.add_comm(dt)
-        for k, o in zip(keys, outs):
-            self._byte_series("pull_bytes", k).inc(_nbytes(o))
-        return ret
+        with _tracing.span("kvstore_pull", cat="comm",
+                           nkeys=len(keys)):
+            if not _tm.enabled():
+                return self._pull_impl(keys, outs)
+            t0 = time.perf_counter()
+            ret = self._pull_impl(keys, outs)
+            dt = time.perf_counter() - t0
+            m = _met()
+            m["pull_s"].observe(dt)
+            _tm_step.add_comm(dt)
+            for k, o in zip(keys, outs):
+                self._byte_series("pull_bytes", k).inc(_nbytes(o))
+            return ret
 
     def _pull_impl(self, keys, outs):
         for k, o in zip(keys, outs):

@@ -123,6 +123,25 @@ _ENV_VARS = {
     "MXTPU_TELEMETRY_VERBOSE": (
         "1 logs a one-line summary to stderr at every telemetry flush "
         "(telemetry/__init__)"),
+    "MXTPU_TRACE_SAMPLE": (
+        "trace-level sampling probability for the span layer, 0..1 "
+        "(default 1; 0 disables span recording entirely — the flight "
+        "recorder then has nothing to dump; tracing/)"),
+    "MXTPU_TRACE_RING": (
+        "closed spans retained per thread ring (default 2048; "
+        "tracing/)"),
+    "MXTPU_TRACE_FILE": (
+        "tracing.export.write_trace default path (default trace.json, "
+        "or trace.<role><rank>.json inside a launch.py job; "
+        "tracing/export.py)"),
+    "MXTPU_HANG_TIMEOUT_SEC": (
+        ">0 arms the hang watchdog at flight-recorder install: a step "
+        "with no span activity for this long dumps in-flight spans + "
+        "thread stacks (tracing/flight.py; bench.py arms it per run)"),
+    "MXTPU_FLIGHT_PATH": (
+        "flight-recorder dump destination (atomic file write; default "
+        "stderr). bench.py points it at a per-run file it embeds in "
+        "failure JSON (tracing/flight.py)"),
 }
 
 

@@ -19,10 +19,10 @@ from __future__ import annotations
 import json
 import os
 import threading
-import time
 
 from .base import MXNetError
 from .telemetry import metrics as _tm
+from .tracing import clock as _clock
 
 _lock = threading.Lock()
 _events = []          # chrome trace event dicts
@@ -38,12 +38,15 @@ _config = {
     "aggregate_stats": False,
     "xla_trace_dir": None,
 }
-_t0 = time.perf_counter()
 _xla_session = None
 
 
 def _now_us():
-    return (time.perf_counter() - _t0) * 1e6
+    # ONE clock source for every timeline: tracing spans and these
+    # chrome-trace events share tracing.clock's process epoch, so a
+    # merged Perfetto artifact never interleaves two time axes
+    # (a private perf_counter offset here did exactly that pre-PR 5)
+    return _clock.rel_us(_clock.now_ns())
 
 
 # dist kvstore whose servers remote profiler commands reach; installed

@@ -105,19 +105,25 @@ def dump(path, fmt="json", snap=None):
     return snap
 
 
-def merge_chrome_trace(snap=None, events=None):
-    """One chrome://tracing document carrying both halves of the
-    observability spine: the profiler's trace events plus the metric
-    snapshot — counters/gauges as 'C' samples on the same clock, the
-    full snapshot under metadata. Loadable by Perfetto next to the op
-    timeline."""
+def merge_chrome_trace(snap=None, events=None, spans=None):
+    """One chrome://tracing document carrying every observability
+    layer: the profiler's trace events, the tracing spans (causal
+    layer, PR 5), and the metric snapshot — counters/gauges as 'C'
+    samples on the same clock, the full snapshot under metadata.
+    All three share tracing.clock's process epoch, so they land on one
+    Perfetto time axis. ``spans`` defaults to the process's recorded
+    spans; pass [] to omit them."""
     snap = snap if snap is not None else snapshot()
     from .. import profiler
+    from .. import tracing as _tracing
     if events is None:
         with profiler._lock:
             events = list(profiler._events)
+    if spans is None:
+        spans = _tracing.spans_snapshot()
     ts = profiler._now_us()
     merged = list(events)
+    merged.extend(_tracing.export.chrome_events(spans))
     for name, fam in sorted(snap["metrics"].items()):
         if fam["type"] == "histogram":
             continue

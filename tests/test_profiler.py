@@ -123,3 +123,36 @@ def test_profiler_off_by_default(tmp_path):
     profiler.dump()
     events = json.loads((tmp_path / "x.json").read_text())["traceEvents"]
     assert events == []
+
+
+def test_profiler_and_spans_share_one_clock_epoch(tmp_path):
+    """PR 5 regression: profiler chrome-trace timestamps and tracing
+    spans must share ONE monotonic epoch (tracing.clock), or a merged
+    Perfetto artifact interleaves two time axes. A profiler event and a
+    span recorded back-to-back must land within a second of each other
+    on the merged timeline (with separate epochs they drift by the
+    module-import time delta, unbounded under lazy imports)."""
+    from mxnet_tpu import tracing
+    from mxnet_tpu.tracing import clock
+
+    # epoch identity: the profiler's "now" IS the tracing-relative now
+    a = profiler._now_us()
+    b = clock.rel_us(clock.now_ns())
+    assert abs(b - a) < 50_000, "profiler uses a different clock epoch"
+
+    tracing.set_sample(1.0)
+    profiler.set_state("run")
+    try:
+        with profiler.timed_region("clockpair_prof"):
+            pass
+        with tracing.span("clockpair_span", cat="compute"):
+            pass
+    finally:
+        profiler.set_state("stop")
+    trace = mx.telemetry.export.merge_chrome_trace()
+    ts = {}
+    for e in trace["traceEvents"]:
+        if e.get("name") in ("clockpair_prof", "clockpair_span"):
+            ts[e["name"]] = e["ts"]
+    assert set(ts) == {"clockpair_prof", "clockpair_span"}
+    assert abs(ts["clockpair_span"] - ts["clockpair_prof"]) < 1_000_000

@@ -1,0 +1,662 @@
+"""Tier-1 gate for the tracing + flight-recorder subsystem (ISSUE 5).
+
+Covers the acceptance criteria end to end, in process:
+
+- span nesting/parenting, cross-thread propagation, sampling=0;
+- kvstore wire propagation: an in-process 2-rank run (real socket
+  server + two ranked worker connections) produces per-rank trace
+  files that ``tools/trace_merge.py`` stitches into one valid
+  chrome-trace JSON where a worker ``kv.push`` span and its
+  server-side child share a trace_id and nest after clock alignment,
+  and the straggler report names the artificially-delayed rank;
+- merge/clock-offset determinism on synthetic skewed traces;
+- flight recorder: the watchdog fires on a simulated hang and the dump
+  contains the deliberately stuck span + thread stacks;
+- tracing-disabled overhead < 5% (process-CPU, min-of-N — the
+  test_telemetry.py methodology);
+- mxlint MXL006 fires on sync-computed span attrs and stays quiet on
+  clean instrumentation.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import _native, tracing
+from mxnet_tpu.kvstore import dist
+from mxnet_tpu.tracing import export as texp
+from mxnet_tpu.tracing import flight
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRACE_MERGE = os.path.join(REPO, "tools", "trace_merge.py")
+TELEMETRY_DUMP = os.path.join(REPO, "tools", "telemetry_dump.py")
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import trace_merge  # noqa: E402
+
+sys.path.pop(0)
+
+
+@pytest.fixture(autouse=True)
+def _trace_isolation():
+    tracing.set_sample(1.0)
+    tracing.reset()
+    yield
+    flight.disarm()
+    tracing.set_sample(1.0)
+
+
+# ---------------------------------------------------------------- span core
+def test_span_nesting_and_parenting():
+    with tracing.span("outer", cat="step", step=3) as o:
+        assert o.trace_id != 0 and o.span_id != 0
+        assert tracing.current() is o
+        with tracing.span("inner", cat="io") as i:
+            assert i.trace_id == o.trace_id
+            assert i.parent_id == o.span_id
+    assert tracing.current() is None
+    spans = {s["name"]: s for s in tracing.spans_snapshot()}
+    assert spans["inner"]["parent"] == spans["outer"]["span"]
+    assert spans["outer"]["parent"] is None
+    assert spans["outer"]["attrs"]["step"] == 3
+    # children close before parents: inner interval nested in outer
+    assert spans["outer"]["start_ns"] <= spans["inner"]["start_ns"]
+    assert (spans["inner"]["start_ns"] + spans["inner"]["dur_ns"]
+            <= spans["outer"]["start_ns"] + spans["outer"]["dur_ns"])
+
+
+def test_span_parenting_across_threads():
+    got = {}
+
+    def worker(ctx):
+        with tracing.span_at(ctx, "child_on_thread") as c:
+            got["trace"], got["parent"] = c.trace_id, c.parent_id
+
+    with tracing.span("root") as r:
+        ctx = tracing.context()
+        t = threading.Thread(target=worker, args=(ctx,))
+        t.start()
+        t.join()
+    assert got == {"trace": r.trace_id, "parent": r.span_id}
+    by_name = {s["name"]: s for s in tracing.spans_snapshot()}
+    # the child lives in the worker thread's ring, with a different tid
+    assert by_name["child_on_thread"]["tid"] != by_name["root"]["tid"]
+
+
+def test_traced_decorator_and_error_attr():
+    @tracing.traced(name="boom", cat="compute")
+    def boom():
+        raise ValueError("x")
+
+    with pytest.raises(ValueError):
+        boom()
+    (s,) = [s for s in tracing.spans_snapshot() if s["name"] == "boom"]
+    assert s["attrs"]["error"] == "ValueError"
+
+
+def test_sampling_zero_records_nothing():
+    tracing.set_sample(0.0)
+    assert not tracing.enabled()
+    with tracing.span("invisible") as s:
+        assert s.trace_id == 0 and s.span_id == 0
+        assert tracing.current() is None   # noop never enters context
+    assert tracing.record_span("also_invisible", 1, 0, 0, 1) == 0
+    assert tracing.spans_snapshot() == []
+
+
+def test_sampling_decision_inherited_by_children(monkeypatch):
+    """The trace-level sampling contract: the ROOT span takes the roll
+    and its descendants inherit it — an unsampled root must not let
+    children re-roll into orphan parentless traces."""
+    tracing.set_sample(0.5)
+    monkeypatch.setattr(tracing._rng, "random", lambda: 0.99)  # lose
+    with tracing.span("root") as r:
+        assert r.trace_id == 0
+        with tracing.span("child") as c:
+            assert c is tracing.NOOP          # inherited, not re-rolled
+    assert tracing.spans_snapshot() == []
+    monkeypatch.setattr(tracing._rng, "random", lambda: 0.0)   # win
+    with tracing.span("root2") as r2:
+        assert r2.trace_id != 0
+        with tracing.span("child2") as c2:
+            assert c2.trace_id == r2.trace_id
+    names = {s["name"] for s in tracing.spans_snapshot()}
+    assert {"root2", "child2"} <= names
+
+
+def test_watchdog_refuses_when_tracing_disabled(capsys):
+    """With MXTPU_TRACE_SAMPLE=0 no span ever resets the activity
+    clock, so arming would cry hang on every healthy quiet stretch —
+    arm() must refuse with a warning instead."""
+    tracing.set_sample(0.0)
+    assert flight.arm(0.05) is None
+    assert "NOT armed" in capsys.readouterr().err
+
+
+def test_host_engine_push_exec_edge():
+    eng = mx.engine.host_engine()
+    ran = threading.Event()
+    with tracing.span("pusher") as p:
+        eng.push(ran.set)
+        eng.wait_all()
+    assert ran.is_set()
+    execs = [s for s in tracing.spans_snapshot()
+             if s["name"] == "host_engine_exec"]
+    assert execs, "no host_engine_exec span recorded"
+    assert execs[-1]["trace"] == p.trace_id
+    assert execs[-1]["parent"] == p.span_id
+
+
+def test_data_iter_span():
+    it = mx.io.NDArrayIter(np.zeros((8, 2), np.float32), batch_size=4)
+    next(iter(it))
+    names = [s["name"] for s in tracing.spans_snapshot()]
+    assert "data_next" in names
+
+
+# ---------------------------------------------------- wire propagation (2-rank)
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _span_docs_by_rank(spans):
+    """Split one process's drained spans into per-rank worker docs +
+    a server doc (the in-process stand-in for per-process trace
+    files)."""
+    server, workers = [], {}
+    for s in spans:
+        attrs = s.get("attrs") or {}
+        if attrs.get("role") == "server":
+            server.append(s)
+        elif attrs.get("rank") is not None:
+            workers.setdefault(int(attrs["rank"]), []).append(s)
+    return workers, server
+
+
+def test_kvstore_wire_propagation_merge_and_straggler(tmp_path):
+    """The acceptance scenario: 2 ranked workers against a real socket
+    server in-process; rank 1 artificially delayed; per-rank trace
+    files -> trace_merge -> one chrome trace with cross-process
+    nesting + straggler attribution."""
+    lib = _native.load_comm()
+    lib.mxtpu_server_shutdown()     # defensive: another test's server
+    port = _free_port()
+    assert lib.mxtpu_server_start(port, 2) == 0
+    from mxnet_tpu.tracing import wire
+    wire.install_server_sink(lib)
+    conns = []
+    try:
+        conns = [dist.WorkerConnection("127.0.0.1", port)
+                 for _ in range(2)]
+        assert sorted(c.rank for c in conns) == [0, 1]
+        conns[0].set_sync_mode(True)
+        conns[0].init(0, np.zeros(8, np.float32))
+        for c in conns:
+            c.trace_clock_sync(3)
+
+        def work(c):
+            for step_n in range(3):
+                with tracing.span("step", cat="step", step=step_n,
+                                  rank=c.rank):
+                    if c.rank == 1:
+                        time.sleep(0.04)   # the injected straggler
+                    c.push(0, np.full(8, 1.0 + c.rank, np.float32))
+                    c.pull(0, (8,))
+
+        ts = [threading.Thread(target=work, args=(c,)) for c in conns]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    finally:
+        for c in conns:
+            c.close()
+        lib.mxtpu_server_shutdown()
+
+    workers, server = _span_docs_by_rank(tracing.drain())
+    assert set(workers) == {0, 1} and server, "missing span sources"
+    paths = []
+    for r, spans in sorted(workers.items()):
+        p = str(tmp_path / ("trace.worker%d.json" % r))
+        texp.write_trace(p, spans=spans, meta={"role": "worker",
+                                               "rank": r})
+        paths.append(p)
+    sp = str(tmp_path / "trace.server0.json")
+    texp.write_trace(sp, spans=server, meta={"role": "server",
+                                             "rank": 0})
+    paths.append(sp)
+
+    merged_path = str(tmp_path / "merged.json")
+    proc = subprocess.run(
+        [sys.executable, TRACE_MERGE, *paths, "-o", merged_path,
+         "--report"], capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "worker1" in proc.stdout   # report names the delayed rank
+
+    merged = json.load(open(merged_path))
+    events = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    assert events and all(
+        {"name", "ts", "dur", "pid", "tid"} <= set(e) for e in events)
+    # a worker push span and its server-side child share a trace id and
+    # nest correctly after clock alignment
+    pushes = {e["args"]["span"]: e for e in events
+              if e["name"] == "kv.push"}
+    kids = [e for e in events if e["name"] == "server_recv:push"
+            and e["args"].get("parent") in pushes]
+    assert kids, "no server child matched a worker push span"
+    # 500us slack: the estimated per-rank offset (same-host clocks, so
+    # truly ~0) may shift worker spans by up to ~rtt/2
+    eps = 500.0
+    for kid in kids:
+        parent = pushes[kid["args"]["parent"]]
+        assert kid["args"]["trace"] == parent["args"]["trace"]
+        assert parent["ts"] - eps <= kid["ts"]
+        assert (kid["ts"] + kid["dur"]
+                <= parent["ts"] + parent["dur"] + eps)
+    rep = merged["metadata"]["straggler_report"]
+    # the artificially-delayed rank is named: BSP equalizes wall-clock
+    # (worker0 parks in comm waiting for worker1's push), so the report
+    # attributes by non-comm work — deterministically worker1 here
+    assert rep["overall"]["straggler_rank"] == "worker1"
+    assert len(rep["steps"]) == 3
+    for st in rep["steps"]:
+        assert set(st["ranks"]) == {"worker0", "worker1"}
+        assert st["straggler"] == "worker1"
+        assert st["slowest_by_stage"]["compute"] == "worker1"
+        # BSP: critical path == the slowest rank's duration
+        assert st["critical_path_ms"] == max(
+            v["dur_ms"] for v in st["ranks"].values())
+    # the fast rank's wait shows up as comm, the straggler's as compute
+    slow = rep["steps"][1]["ranks"]["worker1"]
+    assert slow["compute_ms"] > 30
+
+
+def test_server_update_span_parents_to_push():
+    """mxtpu_server_current_trace: an updater running on the native
+    connection thread can parent its span to the in-flight push."""
+    lib = _native.load_comm()
+    lib.mxtpu_server_shutdown()
+    port = _free_port()
+    assert lib.mxtpu_server_start(port, 1) == 0
+    from mxnet_tpu.tracing import wire
+    wire.install_server_sink(lib)
+
+    def updater(key, recved, stored):
+        ctx = wire.server_parent_ctx(lib)
+        with tracing.span_at(ctx, "server_update", cat="comm", key=key,
+                             role="server"):
+            stored[:] = stored + recved
+
+    _native.set_server_updater(updater)
+    conn = None
+    try:
+        conn = dist.WorkerConnection("127.0.0.1", port)
+        conn.set_sync_mode(True)
+        conn.init(7, np.zeros(4, np.float32))
+        with tracing.span("step", cat="step", rank=0):
+            conn.push(7, np.ones(4, np.float32))
+            out = conn.pull(7, (4,))
+        np.testing.assert_allclose(out, np.ones(4))
+    finally:
+        if conn is not None:
+            conn.close()
+        lib.mxtpu_server_shutdown()
+        lib.mxtpu_server_set_updater(None)
+    spans = tracing.spans_snapshot()
+    pushes = {s["span"]: s for s in spans if s["name"] == "kv.push"}
+    ups = [s for s in spans if s["name"] == "server_update"]
+    assert ups, "no server_update span"
+    assert any(u["parent"] in pushes and
+               u["trace"] == pushes[u["parent"]]["trace"] for u in ups)
+
+
+# ---------------------------------------------------- merge determinism
+def _synthetic_docs(skew_ns):
+    """Worker/server docs describing the same 3 requests, with the
+    worker's clock skewed by ``skew_ns``."""
+    wspans, sspans = [], []
+    for i in range(3):
+        t0 = 1_000_000_000 + i * 10_000_000          # true time, ns
+        rtt = 2_000_000
+        wspans.append({
+            "name": "kv.clock_sync", "cat": "comm", "trace": 42,
+            "span": 100 + i, "parent": None,
+            "start_ns": t0 + skew_ns, "dur_ns": rtt,
+            "tid": 1, "thread": "w", "attrs": {"rank": 0}})
+        sspans.append({
+            "name": "server_recv:command", "cat": "comm", "trace": 42,
+            "span": 500 + i, "parent": 100 + i,
+            "start_ns": t0 + rtt // 2, "dur_ns": 100_000,
+            "tid": 2, "thread": "s", "attrs": {"role": "server"}})
+    wdoc = {"version": 1, "clock": "monotonic_ns",
+            "meta": {"role": "worker", "rank": 0, "pid": 10},
+            "spans": wspans}
+    sdoc = {"version": 1, "clock": "monotonic_ns",
+            "meta": {"role": "server", "rank": 0, "pid": 11},
+            "spans": sspans}
+    return wdoc, sdoc
+
+
+@pytest.mark.parametrize("skew_ns", [0, 5_000_000_000, -3_000_000_000])
+def test_clock_alignment_recovers_synthetic_skew(skew_ns):
+    wdoc, sdoc = _synthetic_docs(skew_ns)
+    offsets = trace_merge.estimate_offsets([wdoc, sdoc])
+    assert offsets[id(sdoc)] == 0.0
+    # midpoint estimate: offset ~ -skew (exact here: symmetric rtt)
+    assert abs(offsets[id(wdoc)] + skew_ns) < 1_000
+    merged, _ = trace_merge.merge([wdoc, sdoc])
+    # after alignment every server recv lands inside its worker span
+    ev = {(e["name"], e["args"].get("span")): e
+          for e in merged["traceEvents"] if e.get("ph") == "X"}
+    for i in range(3):
+        w = ev[("kv.clock_sync", "%016x" % (100 + i))]
+        s = ev[("server_recv:command", "%016x" % (500 + i))]
+        assert w["ts"] <= s["ts"] <= w["ts"] + w["dur"]
+
+
+def test_merge_is_deterministic():
+    wdoc, sdoc = _synthetic_docs(7_000_000_000)
+    a, _ = trace_merge.merge([wdoc, sdoc])
+    b, _ = trace_merge.merge([json.loads(json.dumps(wdoc)),
+                              json.loads(json.dumps(sdoc))])
+    assert json.dumps(a, sort_keys=True) == json.dumps(b,
+                                                       sort_keys=True)
+
+
+def test_merge_cli_rejects_garbage(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text("{\"nope\": 1}")
+    proc = subprocess.run(
+        [sys.executable, TRACE_MERGE, str(bad)],
+        capture_output=True, text=True)
+    assert proc.returncode == 2
+    assert "not a trace file" in proc.stderr
+
+
+# ---------------------------------------------------- flight recorder
+def test_flight_watchdog_fires_on_simulated_hang(tmp_path):
+    dump_path = str(tmp_path / "flight.json")
+    release = threading.Event()
+
+    def stuck_worker():
+        with tracing.span("wedged_backend_init", cat="comm",
+                          stage="grpc_dial"):
+            release.wait(10)
+
+    t = threading.Thread(target=stuck_worker, daemon=True)
+    t.start()
+    time.sleep(0.05)            # span is open; no more ring activity
+    fired = threading.Event()
+    w = flight.arm(0.3, path=dump_path, on_fire=lambda doc: fired.set())
+    try:
+        assert fired.wait(8), "watchdog did not fire on the stall"
+        doc = json.load(open(dump_path))
+        assert "hang: no span activity" in doc["reason"]
+        in_flight = [sp for th in doc["threads"]
+                     for sp in th["in_flight"]]
+        names = [sp["name"] for sp in in_flight]
+        assert "wedged_backend_init" in names, names
+        (sp,) = [s for s in in_flight
+                 if s["name"] == "wedged_backend_init"]
+        assert sp["attrs"]["stage"] == "grpc_dial"
+        assert sp["open_ms"] > 250
+        # thread stacks captured, including the stuck frame
+        assert any("stuck_worker" in v for v in doc["stacks"].values())
+        # one dump per stall: no refire while the stall persists
+        n = w.fired
+        time.sleep(0.7)
+        assert w.fired == n
+    finally:
+        release.set()
+        flight.disarm()
+        t.join()
+
+
+def test_flight_watchdog_rearms_after_activity(tmp_path):
+    fired = []
+    w = flight.arm(0.2, path=str(tmp_path / "f.json"),
+                   on_fire=lambda doc: fired.append(1))
+    try:
+        deadline = time.monotonic() + 5
+        while not fired and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert fired, "first stall not detected"
+        with tracing.span("progress"):
+            pass                      # activity resumes -> re-arm
+        deadline = time.monotonic() + 5
+        while len(fired) < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert len(fired) >= 2, "watchdog did not re-arm"
+    finally:
+        flight.disarm()
+
+
+def test_flight_dump_with_rings_lock_held(capsys):
+    # SIGTERM can interrupt a frame that already holds the tracing
+    # _rings_lock (registration, drain, export); the handler's dump
+    # runs on the SAME thread, so rings() must not block on the
+    # non-reentrant lock — it falls back to a lock-free copy
+    with tracing.span("held"):
+        assert tracing._rings_lock.acquire(timeout=1)
+        try:
+            t0 = time.monotonic()
+            doc = flight.dump("signal-under-lock", path=None)
+        finally:
+            tracing._rings_lock.release()
+    assert time.monotonic() - t0 < 5, "dump blocked on _rings_lock"
+    assert doc["reason"] == "signal-under-lock"
+    assert any(s["name"] == "held"
+               for t in doc["threads"] for s in t["in_flight"])
+
+
+def test_flight_dump_to_stderr_is_bounded(capsys):
+    with tracing.span("ctx"):
+        doc = flight.dump("unit-test", path=None)
+    err = capsys.readouterr().err
+    assert "MXTPU FLIGHT RECORDER (unit-test)" in err
+    assert doc["reason"] == "unit-test"
+    assert doc["threads"] and doc["stacks"]
+
+
+# ---------------------------------------------------- exports and tools
+def test_write_trace_roundtrip_and_dump_tool(tmp_path):
+    with tracing.span("step", cat="step", step=0):
+        with tracing.span("kvstore_push", cat="comm"):
+            pass
+    p = str(tmp_path / "t.json")
+    doc = texp.write_trace(p, spans=tracing.drain(),
+                           meta={"role": "worker", "rank": 0})
+    assert doc["version"] == 1 and doc["meta"]["role"] == "worker"
+    loaded = texp.load_trace(p)
+    assert [s["name"] for s in loaded["spans"]] == \
+        [s["name"] for s in doc["spans"]]
+    proc = subprocess.run(
+        [sys.executable, TELEMETRY_DUMP, "--trace", p],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "kvstore_push" in proc.stdout
+    assert "self=" in proc.stdout and "top" in proc.stdout
+    # --trace on a telemetry snapshot (wrong kind) is a clean usage error
+    snap = str(tmp_path / "m.json")
+    from mxnet_tpu.telemetry import export as tm_export
+    tm_export.dump(snap)
+    proc = subprocess.run(
+        [sys.executable, TELEMETRY_DUMP, "--trace", snap],
+        capture_output=True, text=True)
+    assert proc.returncode == 2
+
+
+def test_chrome_merge_includes_spans():
+    with tracing.span("merge_me", cat="io"):
+        pass
+    trace = mx.telemetry.export.merge_chrome_trace()
+    names = {e.get("name") for e in trace["traceEvents"]}
+    assert "merge_me" in names
+
+
+def test_span_durations_feed_telemetry_histogram():
+    mx.telemetry.metrics.set_enabled(True)
+    with tracing.span("seam_span", cat="comm"):
+        pass
+    fam = mx.telemetry.registry().find("mx_span_seconds")
+    assert fam is not None
+    vals = {s.labels["name"]: s for s in fam.series()}
+    assert vals["seam_span"].count >= 1
+    # cat-less (user) spans do NOT feed the histogram (label cardinality)
+    with tracing.span("user_span_no_cat"):
+        pass
+    vals = {s.labels["name"] for s in fam.series()}
+    assert "user_span_no_cat" not in vals
+
+
+# ---------------------------------------------------- overhead + mxlint
+def _loop_fit(clock):
+    from mxnet_tpu import autograd, gluon
+    net = gluon.nn.Dense(5)
+    net.initialize(force_reinit=True)
+    kv = mx.kv.create("local")
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05}, kvstore=kv)
+    rs = np.random.RandomState(11)
+    X = rs.rand(64, 7).astype("float32")
+    Y = rs.rand(64, 5).astype("float32")
+    it = mx.io.NDArrayIter(X, Y, batch_size=16)
+    loss_fn = gluon.loss.L2Loss()
+    t0 = clock()
+    # 3 epochs x 4 batches: big enough that a trial spans many
+    # process_time clock ticks (the 5% bound is meaningless on a
+    # sample comparable to the ~10ms clock granularity)
+    for _ in range(3):
+        it.reset()
+        for b in it:
+            with autograd.record():
+                loss = loss_fn(net(b.data[0]), b.label[0])
+            loss.backward()
+            trainer.step(16)
+    return clock() - t0
+
+
+def test_tracing_disabled_overhead_bounded():
+    """Span layer enabled-vs-disabled within 5% on process CPU time
+    (min-of-N interleaved with retries — test_telemetry.py's
+    methodology; wall-clock variants flake on loaded CI hosts)."""
+    tracing.set_sample(1.0)
+    _loop_fit(time.process_time)      # warm the jit caches
+    tracing.set_sample(0.0)
+    _loop_fit(time.process_time)
+    best = None
+    for _ in range(5):     # noise only ADDS time; retry through spikes
+        on, off = [], []
+        for _ in range(4):
+            tracing.set_sample(1.0)
+            on.append(_loop_fit(time.process_time))
+            tracing.set_sample(0.0)
+            off.append(_loop_fit(time.process_time))
+        ratio = min(on) / min(off)
+        best = ratio if best is None else min(best, ratio)
+        if best < 1.05:
+            break
+    tracing.set_sample(1.0)
+    assert best < 1.05, \
+        "tracing overhead %.1f%% (on=%s off=%s)" \
+        % ((best - 1) * 100, on, off)
+
+
+def test_bench_fail_json_embeds_flight_dump(tmp_path, capsys,
+                                            monkeypatch):
+    """The satellite: a bench failure line carries the flight-recorder
+    dump (in-flight spans + stacks) left by a wedged child/probe — the
+    'tunnel probe N failed' tail becomes self-diagnosing."""
+    import bench
+
+    with tracing.span("wedged_backend_init", cat="comm"):
+        doc = flight.dump("hang: no span activity for 240.0s",
+                          path=str(tmp_path / "flight.json"))
+    assert doc["threads"]
+    monkeypatch.setattr(bench, "_FLIGHT_PATH",
+                        str(tmp_path / "flight.json"))
+    bench._fail_json("tunnel probe 3 failed (wedged backend init?)")
+    line = bench._json_line(capsys.readouterr().out.encode())
+    parsed = json.loads(line)
+    ff = parsed["diag"]["flight_file"]
+    assert "hang: no span activity" in ff["reason"]
+    flat = json.dumps(ff["in_flight"])
+    assert "wedged_backend_init" in flat
+    assert ff["stacks"]
+    assert len(line) <= 16384
+    # live child-side snapshot also rides along (mxnet_tpu imported)
+    assert "flight" in parsed["diag"]
+    # a probe's raw faulthandler text (not JSON) embeds as a tail
+    (tmp_path / "flight.json").write_text(
+        "Thread 0x01 (most recent call first):\n  File \"x.py\"...")
+    bench._fail_json("tunnel probe 4 failed")
+    line = bench._json_line(capsys.readouterr().out.encode())
+    ff = json.loads(line)["diag"]["flight_file"]
+    assert "most recent call first" in ff["raw_tail"]
+
+
+def test_mxl006_fires_on_synced_span_attrs(tmp_path):
+    import textwrap
+
+    from mxnet_tpu.analysis.lint import run_lint
+    from mxnet_tpu.analysis.rules.trace_attrs import TraceAttrSyncRule
+
+    bad = tmp_path / "mxnet_tpu" / "gluon" / "trainer.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(textwrap.dedent("""\
+        def step(self, batch_size):
+            with span("step", loss=float(self._loss)):
+                pass
+            with span("step2", arr=grad.asnumpy()):
+                pass
+            sp.set_attr("w", np.asarray(w))
+    """))
+    res = run_lint(str(tmp_path), [TraceAttrSyncRule()],
+                   files=[str(bad)])
+    codes = sorted((f.code, f.lineno) for f in res.findings)
+    assert codes == [("MXL006", 2), ("MXL006", 4), ("MXL006", 6)], \
+        res.format()
+    assert any("float()" in f.message for f in res.findings)
+
+    good = tmp_path / "mxnet_tpu" / "gluon" / "good_trainer.py"
+    good.write_text(textwrap.dedent("""\
+        def step(self, batch_size):
+            with span("step", step=self._n, key=int(3)):
+                pass
+            # cold path (not a hot-scope method): syncs allowed
+        def report(self):
+            with span("report", loss=float(self._loss)):
+                pass
+    """))
+    res = run_lint(str(tmp_path), [TraceAttrSyncRule()],
+                   files=[str(good)])
+    assert not res.findings, res.format()
+
+
+def test_instrumented_seams_are_mxl006_clean():
+    """The rule over every file this PR instrumented: zero findings."""
+    from mxnet_tpu.analysis.lint import run_lint
+    from mxnet_tpu.analysis.rules.trace_attrs import TraceAttrSyncRule
+    files = [os.path.join(REPO, p) for p in (
+        "mxnet_tpu/gluon/trainer.py",
+        "mxnet_tpu/kvstore/kvstore.py",
+        "mxnet_tpu/kvstore/dist.py",
+        "mxnet_tpu/io/io.py",
+        "mxnet_tpu/executor.py",
+        "mxnet_tpu/tracing/__init__.py",
+        "mxnet_tpu/tracing/flight.py",
+    )]
+    res = run_lint(REPO, [TraceAttrSyncRule()], files=files)
+    assert not res.findings, res.format()
+    assert not res.errors

@@ -5,6 +5,7 @@
     python tools/telemetry_dump.py --prom telemetry.json   # Prometheus text
     python tools/telemetry_dump.py --diff before.json after.json
     python tools/telemetry_dump.py --json telemetry.json   # normalized JSON
+    python tools/telemetry_dump.py --trace trace.json      # span tree
 
 The before/after diff is the intended workflow for perf PRs: dump a
 snapshot on main, dump one on the branch, and attach the diff (step
@@ -136,6 +137,50 @@ def pretty_diff(before, after, d):
     return "\n".join(lines)
 
 
+def pretty_trace(doc, top=10):
+    """Span tree (indentation = parent links, per trace in start
+    order), self-time per span, and the top-N spans by duration."""
+    spans = sorted(doc.get("spans", []), key=lambda s: s["start_ns"])
+    meta = doc.get("meta", {})
+    lines = ["# trace file: %d spans, role=%s rank=%s pid=%s"
+             % (len(spans), meta.get("role", "?"), meta.get("rank", "?"),
+                meta.get("pid", "?"))]
+    by_id = {s["span"]: s for s in spans}
+    children = {}
+    for s in spans:
+        children.setdefault(s.get("parent"), []).append(s)
+    # self time = duration minus the union-free sum of child durations
+    self_ns = {}
+    for s in spans:
+        kids = children.get(s["span"], [])
+        self_ns[s["span"]] = max(
+            s["dur_ns"] - sum(k["dur_ns"] for k in kids), 0)
+
+    def emit(s, depth):
+        attrs = s.get("attrs") or {}
+        extra = " ".join("%s=%s" % (k, v) for k, v in sorted(
+            attrs.items()) if k not in ("role",))
+        lines.append("%s%-*s %9.3fms self=%.3fms%s" % (
+            "  " * depth, 40 - 2 * depth, s["name"],
+            s["dur_ns"] / 1e6, self_ns[s["span"]] / 1e6,
+            ("  [" + extra + "]") if extra else ""))
+        for k in sorted(children.get(s["span"], []),
+                        key=lambda x: x["start_ns"]):
+            emit(k, depth + 1)
+
+    for s in spans:
+        if s.get("parent") not in by_id:   # root (or orphaned) span
+            emit(s, 0)
+    ranked = sorted(spans, key=lambda s: -s["dur_ns"])[:top]
+    if ranked:
+        lines.append("# top %d by duration" % len(ranked))
+        for s in ranked:
+            lines.append("  %-40s %9.3fms (%s)"
+                         % (s["name"], s["dur_ns"] / 1e6,
+                            s.get("cat") or "span"))
+    return "\n".join(lines)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="telemetry_dump",
                                  description=__doc__)
@@ -146,7 +191,31 @@ def main(argv=None):
                     help="emit Prometheus text exposition")
     ap.add_argument("--json", action="store_true",
                     help="emit normalized JSON")
+    ap.add_argument("--trace", action="store_true",
+                    help="pretty-print a tracing span file "
+                         "(tracing.export.write_trace output)")
     args = ap.parse_args(argv)
+    if args.trace:
+        if len(args.paths) != 1:
+            print("telemetry_dump: --trace takes exactly one file",
+                  file=sys.stderr)
+            return 2
+        try:
+            with open(args.paths[0], "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print("telemetry_dump: cannot read %s: %s"
+                  % (args.paths[0], e), file=sys.stderr)
+            return 2
+        if not isinstance(doc, dict) or "spans" not in doc:
+            print("telemetry_dump: %s is not a trace file (no 'spans' "
+                  "key)" % args.paths[0], file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(doc, indent=1, sort_keys=True))
+        else:
+            print(pretty_trace(doc))
+        return 0
     if args.diff:
         if len(args.paths) != 2:
             print("telemetry_dump: --diff takes exactly two snapshots",

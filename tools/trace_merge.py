@@ -1,0 +1,321 @@
+#!/usr/bin/env python
+"""trace_merge — stitch per-rank trace files into one timeline.
+
+    python tools/trace_merge.py trace.worker0.json trace.worker1.json \\
+        trace.server0.json -o merged.json --report
+
+Input files are ``mxnet_tpu.tracing.export`` trace documents (one per
+process of a distributed job; workers write their own, a worker pulls
+the server's via the ``trace_dump`` directive). Output is a single
+chrome-trace JSON (load in Perfetto / chrome://tracing) in which each
+process is a pid and a worker ``kv.push`` span visually contains its
+server-side ``server_recv:push`` child.
+
+Clock alignment: every process stamps CLOCK_MONOTONIC, whose epoch is
+per-host (boot time), so cross-host (or skew-injected test) files need
+per-rank offsets. Each traced kvstore request gives one sample: the
+worker span's midpoint and the server's recv timestamp name the same
+instant on two clocks (symmetric-RTT assumption — the classic
+NTP/Cristian estimate), so
+
+    offset(rank -> server) = median over samples of
+        server_recv_start - (worker_start + worker_dur/2)
+
+``kv.clock_sync`` spans (dist.py trace_clock_sync, riding the existing
+directive channel) are preferred samples — they are tiny, so the
+symmetric assumption is tightest — with all matched kv.* pairs as
+fallback. Everything is shifted onto the server clock; with no server
+file, offsets are 0 (same-host processes already share the clock).
+
+The straggler report groups worker spans by their enclosing step span
+(cat="step", attrs.step): per step and rank it unions comm-cat and
+io-cat intervals inside the step (union, so nested kvstore_push/kv.push
+pairs are not double-counted), derives compute as the remainder, and
+names the slowest rank per stage plus the BSP critical path (the
+slowest rank IS the round's duration).
+
+Standalone: stdlib only, no mxnet_tpu/jax import. Exit 0 ok, 2 usage.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+TRACE_VERSION = 1
+
+
+def load_trace(path):
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "spans" not in doc:
+        raise ValueError("%s is not a trace file (no 'spans' key)" % path)
+    if doc.get("version", 0) > TRACE_VERSION:
+        raise ValueError("%s: trace version %s > supported %d"
+                         % (path, doc.get("version"), TRACE_VERSION))
+    doc.setdefault("meta", {})
+    doc["meta"].setdefault("_path", path)
+    return doc
+
+
+def proc_label(doc):
+    meta = doc.get("meta", {})
+    role = meta.get("role")
+    if role:
+        return "%s%s" % (role, meta.get("rank", 0))
+    base = os.path.basename(meta.get("_path", "proc"))
+    return base.rsplit(".json", 1)[0]
+
+
+def is_server(doc):
+    return doc.get("meta", {}).get("role") == "server"
+
+
+# ---------------------------------------------------------------- alignment
+def _offset_samples(worker_doc, server_index):
+    """[(is_clock_sync, offset_ns)] for every worker span whose server
+    child appears in ``server_index`` (span_id -> server span)."""
+    out = []
+    for s in worker_doc["spans"]:
+        child = server_index.get(s.get("span"))
+        if child is None:
+            continue
+        mid = s["start_ns"] + s["dur_ns"] / 2.0
+        out.append((s.get("name") == "kv.clock_sync",
+                    child["start_ns"] - mid))
+    return out
+
+
+def _median(vals):
+    vals = sorted(vals)
+    n = len(vals)
+    return vals[n // 2] if n % 2 else (vals[n // 2 - 1] + vals[n // 2]) / 2
+
+
+def estimate_offsets(docs):
+    """{id(doc): offset_ns} moving every file onto the server clock.
+    Servers get 0; a worker with no matched server spans gets 0 (same
+    clock assumed). Deterministic: pure function of the span data."""
+    servers = [d for d in docs if is_server(d)]
+    server_index = {}
+    for d in servers:
+        for s in d["spans"]:
+            # only the native sink's recv spans: their start IS the
+            # server-clock receive instant. server_update shares the
+            # same parent (the worker push) but starts when the ROUND
+            # completes — using it would inflate a fast rank's offset
+            # by the whole straggler wait
+            if s.get("parent") and \
+                    str(s.get("name", "")).startswith("server_recv:"):
+                server_index[s["parent"]] = s
+    offsets = {}
+    for d in docs:
+        if is_server(d) or not server_index:
+            offsets[id(d)] = 0.0
+            continue
+        samples = _offset_samples(d, server_index)
+        sync = [o for is_cs, o in samples if is_cs]
+        use = sync if sync else [o for _, o in samples]
+        offsets[id(d)] = _median(use) if use else 0.0
+    return offsets
+
+
+# ---------------------------------------------------------------- chrome out
+def chrome_events(doc, pid, offset_ns, base_ns):
+    out = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": proc_label(doc)}}]
+    for s in doc["spans"]:
+        args = {"trace": "%016x" % (s.get("trace") or 0),
+                "span": "%016x" % (s.get("span") or 0)}
+        if s.get("parent"):
+            args["parent"] = "%016x" % s["parent"]
+        for k, v in (s.get("attrs") or {}).items():
+            args.setdefault(k, v)
+        out.append({
+            "name": s["name"], "cat": s.get("cat") or "span", "ph": "X",
+            "ts": (s["start_ns"] + offset_ns - base_ns) / 1e3,
+            "dur": s["dur_ns"] / 1e3,
+            "pid": pid, "tid": s.get("tid", 0) % 100000, "args": args})
+    return out
+
+
+def merge(docs):
+    """(chrome_trace_dict, offsets_by_label). Timestamps are aligned to
+    the server clock and re-based so the earliest event is ts=0."""
+    offsets = estimate_offsets(docs)
+    base = None
+    for d in docs:
+        for s in d["spans"]:
+            t = s["start_ns"] + offsets[id(d)]
+            base = t if base is None else min(base, t)
+    base = base or 0
+    events = []
+    by_label = {}
+    for pid, d in enumerate(docs):
+        events.extend(chrome_events(d, pid, offsets[id(d)], base))
+        by_label[proc_label(d)] = offsets[id(d)]
+    report = straggler_report(docs, offsets)
+    return ({"traceEvents": events, "displayTimeUnit": "ms",
+             "metadata": {"clock_offsets_ns": by_label,
+                          "straggler_report": report}},
+            by_label)
+
+
+# ---------------------------------------------------------------- straggler
+def _union_ms(intervals):
+    """Total length of the union of (start, end) intervals, in ms."""
+    total, cur_s, cur_e = 0.0, None, None
+    for s, e in sorted(intervals):
+        if cur_e is None or s > cur_e:
+            if cur_e is not None:
+                total += cur_e - cur_s
+            cur_s, cur_e = s, e
+        else:
+            cur_e = max(cur_e, e)
+    if cur_e is not None:
+        total += cur_e - cur_s
+    return total / 1e6
+
+
+def straggler_report(docs, offsets=None):
+    """Per-step, per-rank breakdown with slowest-rank attribution.
+
+    Returns {"steps": [{"step", "ranks": {label: {dur_ms, comm_ms,
+    data_ms, compute_ms}}, "slowest_rank", "critical_path_ms",
+    "skew_ms", "slowest_by_stage"}], "overall": {...}} — ranks are
+    process labels ("worker0"). Steps with a single rank still report
+    (trivially naming it)."""
+    if offsets is None:
+        offsets = estimate_offsets(docs)
+    steps = {}
+    for d in docs:
+        if is_server(d):
+            continue
+        label = proc_label(d)
+        off = offsets[id(d)]
+        spans = d["spans"]
+        for st in spans:
+            if st.get("cat") != "step":
+                continue
+            n = (st.get("attrs") or {}).get("step", 0)
+            s0 = st["start_ns"] + off
+            s1 = s0 + st["dur_ns"]
+            comm, data = [], []
+            for s in spans:
+                if s.get("cat") not in ("comm", "io") or s is st:
+                    continue
+                # clip to the step window: a comm wait that spills past
+                # the step close still spent its in-step portion on
+                # comm, not compute
+                a = max(s["start_ns"] + off, s0)
+                b = min(s["start_ns"] + off + s["dur_ns"], s1)
+                if b > a:
+                    (comm if s["cat"] == "comm" else data).append((a, b))
+            comm_ms = _union_ms(comm)
+            data_ms = _union_ms(data)
+            dur_ms = st["dur_ns"] / 1e6
+            steps.setdefault(n, {})[label] = {
+                "dur_ms": round(dur_ms, 3),
+                "comm_ms": round(comm_ms, 3),
+                "data_ms": round(data_ms, 3),
+                "compute_ms": round(max(dur_ms - comm_ms - data_ms, 0.0),
+                                    3),
+            }
+    out_steps = []
+    slow_count, strag_count = {}, {}
+    for n in sorted(steps):
+        ranks = steps[n]
+        durs = {r: v["dur_ms"] for r, v in ranks.items()}
+        slowest = max(durs, key=durs.get)
+        # BSP equalizes raw durations (fast ranks park in comm waiting
+        # for the round), so the STRAGGLER is the rank doing the most
+        # non-comm work — the one everyone else's comm-wait points at
+        work = {r: v["dur_ms"] - v["comm_ms"] for r, v in ranks.items()}
+        straggler = max(work, key=work.get)
+        slow_count[slowest] = slow_count.get(slowest, 0) + 1
+        strag_count[straggler] = strag_count.get(straggler, 0) + 1
+        out_steps.append({
+            "step": n, "ranks": ranks, "slowest_rank": slowest,
+            "straggler": straggler,
+            # BSP: the round takes as long as its slowest rank
+            "critical_path_ms": round(max(durs.values()), 3),
+            "skew_ms": round(max(durs.values()) - min(durs.values()), 3),
+            "slowest_by_stage": {
+                stage: max(ranks, key=lambda r: ranks[r][stage + "_ms"])
+                for stage in ("comm", "data", "compute")},
+        })
+    overall = {}
+    if out_steps:
+        overall = {
+            "steps": len(out_steps),
+            "slowest_rank": max(slow_count, key=slow_count.get),
+            "slowest_rank_step_count": max(slow_count.values()),
+            "straggler_rank": max(strag_count, key=strag_count.get),
+            "straggler_step_count": max(strag_count.values()),
+            "critical_path_ms": round(sum(s["critical_path_ms"]
+                                          for s in out_steps), 3),
+            "comm_wait_ms": round(sum(
+                max(v["comm_ms"] for v in s["ranks"].values())
+                for s in out_steps), 3),
+            "data_wait_ms": round(sum(
+                max(v["data_ms"] for v in s["ranks"].values())
+                for s in out_steps), 3),
+        }
+    return {"steps": out_steps, "overall": overall}
+
+
+def format_report(report):
+    lines = []
+    ov = report.get("overall") or {}
+    if ov:
+        lines.append(
+            "straggler: %s (most non-comm work in %d/%d steps; "
+            "slowest wall-clock: %s) | critical path %.1fms "
+            "(comm-wait %.1fms, data-wait %.1fms)"
+            % (ov["straggler_rank"], ov["straggler_step_count"],
+               ov["steps"], ov["slowest_rank"], ov["critical_path_ms"],
+               ov["comm_wait_ms"], ov["data_wait_ms"]))
+    for s in report.get("steps", []):
+        parts = ", ".join(
+            "%s %.1fms (comm %.1f, data %.1f, compute %.1f)"
+            % (r, v["dur_ms"], v["comm_ms"], v["data_ms"],
+               v["compute_ms"])
+            for r, v in sorted(s["ranks"].items()))
+        lines.append("step %s: straggler=%s skew=%.1fms | %s"
+                     % (s["step"], s["straggler"], s["skew_ms"],
+                        parts))
+    return "\n".join(lines) or "no step spans found"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="trace_merge", description=__doc__.splitlines()[0])
+    ap.add_argument("files", nargs="+", help="per-rank trace files")
+    ap.add_argument("-o", "--out", default="merged_trace.json",
+                    help="merged chrome-trace output path")
+    ap.add_argument("--report", action="store_true",
+                    help="print the straggler report to stdout")
+    args = ap.parse_args(argv)
+    try:
+        docs = [load_trace(p) for p in args.files]
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print("trace_merge: %s" % e, file=sys.stderr)
+        return 2
+    trace, offsets = merge(docs)
+    tmp = "%s.tmp.%d" % (args.out, os.getpid())
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(trace, f)
+    os.replace(tmp, args.out)
+    n = sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
+    print("merged %d spans from %d files -> %s" % (n, len(docs),
+                                                   args.out))
+    for label, off in sorted(offsets.items()):
+        print("  clock offset %s -> server: %+.3f ms" % (label, off / 1e6))
+    if args.report:
+        print(format_report(trace["metadata"]["straggler_report"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
