@@ -94,6 +94,88 @@ _LAST_STAGE = ["start"]
 _FLIGHT_PATH = os.environ.get("MXTPU_FLIGHT_PATH") or os.path.join(
     os.path.dirname(os.path.abspath(__file__)), ".bench_flight.json")
 
+# cost-ledger pass: a CPU-pinned subprocess compiles the bench stage
+# programs and prices them per-op (mxnet_tpu/profiling/bench_ledger.py)
+# so EVERY round — including a wedged-tunnel 0.0 — carries a cost-model
+# MFU estimate and top-10 op table. The supervisor launches it at
+# entry; supervisor failure lines, the child's failure lines, stale
+# re-emissions and the final result all embed whatever has landed at
+# _LEDGER_PATH by their emit time.
+_LEDGER_PATH = os.environ.get("MXTPU_LEDGER_OUT") or \
+    _FLIGHT_PATH + ".ledger.json"
+_LEDGER_PROC = [None]
+
+
+def _ledger_start():
+    """Spawn the cost-ledger subprocess (CPU backend, axon-scrubbed
+    env). Never raises — attribution must not block a bench round."""
+    try:
+        # stale pass must not masquerade — also when attribution is
+        # disabled, where _ledger_snapshot() would otherwise pick up a
+        # previous run's table and embed it in this round's artifacts
+        os.unlink(_LEDGER_PATH)
+    except OSError:
+        pass
+    if os.environ.get("MXTPU_PROFILE_ATTRIB", "1") == "0":
+        return None
+    try:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and "axon_site" not in p)
+        env["MXTPU_LEDGER_OUT"] = _LEDGER_PATH
+        env.setdefault("MXTPU_TELEMETRY", "0")
+        # lowest scheduling priority (nice prefix, not preexec_fn —
+        # fork handlers deadlock under jax's threads): the pass shares
+        # the host with the measured bench child, and an all-core XLA
+        # compile stealing cycles from the child's dispatch loop would
+        # depress the very number the round exists to report
+        argv = [sys.executable, "-m", "mxnet_tpu.profiling.bench_ledger"]
+        if os.name == "posix":
+            argv = ["nice", "-n", "19"] + argv
+        proc = subprocess.Popen(
+            argv, cwd=os.path.dirname(os.path.abspath(__file__)),
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        _LEDGER_PROC[0] = proc
+        _diag("cost-ledger pass started (pid %d)" % proc.pid)
+        return proc
+    except Exception as e:  # noqa: BLE001 — diagnostics never block
+        _diag("cost-ledger pass unavailable: %r" % (e,))
+        return None
+
+
+def _ledger_finish(wait_s=None):
+    """Reap the ledger subprocess, waiting up to ``wait_s`` (defaults
+    to MXTPU_LEDGER_DEADLINE_SEC) for it to finish its stages."""
+    proc, _LEDGER_PROC[0] = _LEDGER_PROC[0], None
+    if proc is None:
+        return
+    if wait_s is None:
+        wait_s = float(os.environ.get("MXTPU_LEDGER_DEADLINE_SEC",
+                                      "300"))
+    try:
+        proc.wait(timeout=max(wait_s, 0))
+    except subprocess.TimeoutExpired:
+        _diag("cost-ledger pass over deadline; killing")
+        proc.kill()
+        proc.wait()
+
+
+def _ledger_snapshot():
+    """The bench_cost_ledger document on disk (stages completed so
+    far), or None. Bounded by construction: the writer only stores
+    per-stage summaries (MFU estimate + top-10)."""
+    try:
+        with open(_LEDGER_PATH, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        if isinstance(doc, dict) and doc.get("stages"):
+            return doc
+    except (OSError, ValueError):
+        pass
+    return None
+
 
 def _diag(msg):
     _DIAG_RING.append("%s %s" % (time.strftime("%H:%M:%S"), str(msg)[:200]))
@@ -192,8 +274,16 @@ def _child_record(line):
     supervisor: a full-size on-chip COMPLETE line always saves; a
     partial (headline-only) line saves only over nothing/another
     partial. CPU smoke runs never save."""
-    onchip = ('"backend": "tpu"' in line or '"backend": "axon"' in line)
-    if not onchip or ("bs%d" % BATCH) not in line or '"error"' in line:
+    try:
+        parsed = json.loads(line)
+    except ValueError:
+        return
+    if not isinstance(parsed, dict):
+        return
+    onchip = parsed.get("backend") in ("tpu", "axon")
+    # top-level "error" key only: an embedded diagnostic (cost_ledger
+    # stage failures, flight dumps) must not veto a real measurement
+    if not onchip or ("bs%d" % BATCH) not in line or "error" in parsed:
         return
     if '"partial"' not in line:
         _save_last_good(line)
@@ -272,19 +362,36 @@ def _enable_compile_cache():
 
 def _fail_json(err, diag=None):
     """Partial JSON so the driver captures *something* on failure —
-    including a bounded diagnostic snapshot (stage/env/recent events),
-    so a wedged round is debuggable from its artifact alone."""
-    line = json.dumps({
+    including a bounded diagnostic snapshot (stage/env/recent events)
+    and the CPU cost-model ledger, so a wedged round is debuggable
+    AND perf-attributable from its artifact alone (no more
+    signal-free 0.0s: BENCH_r04/r05 postmortem)."""
+    ledger = _ledger_snapshot()
+    doc = {
         "metric": METRIC, "value": 0.0, "unit": "img/s/chip",
         "vs_baseline": 0.0, "error": str(err)[:500],
         "diag": _diag_snapshot(diag),
-    })
+    }
+    if ledger is not None:
+        doc["cost_ledger"] = ledger
+    line = json.dumps(doc)
     if len(line) > 16384:   # a metric line, not a log dump
-        line = json.dumps({
+        fallback = {
             "metric": METRIC, "value": 0.0, "unit": "img/s/chip",
             "vs_baseline": 0.0, "error": str(err)[:500],
             "diag": {"stage": _LAST_STAGE[0], "truncated": True},
-        })
+        }
+        if ledger is not None:
+            # keep the headline attribution numbers + top-3 even when
+            # the full diag had to go
+            fallback["cost_ledger"] = {
+                "stages": {
+                    k: {"mfu_at_roofline": v.get("mfu_at_roofline"),
+                        "gflops_total": v.get("gflops_total"),
+                        "top": v.get("top", [])[:3]}
+                    if isinstance(v, dict) else v
+                    for k, v in ledger.get("stages", {}).items()}}
+        line = json.dumps(fallback)
     print(line, flush=True)
 
 
@@ -382,6 +489,7 @@ def supervise():
     env = _bench_env()
     env[_CHILD_SENTINEL] = "1"
     env.setdefault("MXTPU_FLIGHT_PATH", _FLIGHT_PATH)
+    env["MXTPU_LEDGER_OUT"] = _LEDGER_PATH
     # a stale dump from a previous round must never masquerade as this
     # round's hang evidence
     for stale in (_FLIGHT_PATH, _FLIGHT_PATH + ".probe"):
@@ -389,6 +497,9 @@ def supervise():
             os.unlink(stale)
         except OSError:
             pass
+    # cost-ledger pass: unconditional per round, so the attribution
+    # table exists before the first probe can even fail
+    _ledger_start()
     budget = float(os.environ.get("MXTPU_BENCH_BUDGET", "2700"))
     max_full_attempts = 4
     last_err = "unknown"
@@ -475,6 +586,11 @@ def supervise():
             stale["measured_at"] = prior.get("measured_at")
             if provisional:
                 stale["provisional"] = True
+            ledger = _ledger_snapshot()
+            if ledger is not None and "cost_ledger" not in stale:
+                # stale throughput + fresh cost model: the round still
+                # commits a current attribution table
+                stale["cost_ledger"] = ledger
             print(json.dumps(stale), flush=True)
             return True
         except ValueError:
@@ -535,19 +651,35 @@ def supervise():
             last_err = "bench child " + why
             _diag(last_err)
         line = _json_line(out)
+
+        def _is_error_line(ln):
+            # same top-level-key rule as _child_record/_onchip_fullsize:
+            # embedded diagnostics (cost_ledger stage errors, flight
+            # dumps) must not make a rescued measurement look failed
+            try:
+                parsed = json.loads(ln)
+            except ValueError:
+                return True
+            return not isinstance(parsed, dict) or "error" in parsed
+
         # accept the line on clean exit, or (timeout/crash rescue) when it
         # is a real measurement rather than the child's own _fail_json —
         # error lines must still go through the retry loop
-        if line is not None and (rc == 0 or '"error"' not in line):
+        if line is not None and (rc == 0 or not _is_error_line(line)):
             print(line, flush=True)
 
             def _onchip_fullsize(ln):
                 # a CPU smoke run (tiny batch, cpu backend) must never
-                # masquerade as a chip number
-                return (('"backend": "tpu"' in ln
-                         or '"backend": "axon"' in ln)
+                # masquerade as a chip number; only a TOP-LEVEL error
+                # key disqualifies (embedded ledger diagnostics don't)
+                try:
+                    parsed = json.loads(ln)
+                except ValueError:
+                    return False
+                return (isinstance(parsed, dict)
+                        and parsed.get("backend") in ("tpu", "axon")
                         and ("bs%d" % BATCH) in ln
-                        and '"error"' not in ln)
+                        and "error" not in parsed)
 
             if _onchip_fullsize(line):
                 if '"partial"' not in line:
@@ -564,6 +696,7 @@ def supervise():
                     if saved is None or '"partial"' in saved.get(
                             "line", ""):
                         _save_last_good(line)
+            _ledger_finish(wait_s=0)  # reap; the line is already out
             return 0
         if rc >= 0:
             last_err = ("child rc=%d, stdout tail: %r"
@@ -575,6 +708,9 @@ def supervise():
             # must not mask it as "environment was down"
             code_failure = True
         time.sleep(30)
+    # final lines below must carry the completed cost-model stages:
+    # give the ledger pass its deadline to finish, then read the file
+    _ledger_finish()
     if prior is not None and not code_failure:
         # never reached a healthy backend (or every contact died silent)
         # — an environment failure, not a code failure. Emit the last
@@ -891,9 +1027,11 @@ def main():
             jax.profiler.start_trace(profile_dir)
             started = True
             out = None
+            t_prof0 = time.perf_counter()
             for _ in range(10):
                 out = fwd(pvals, data)
             sync(out)
+            prof_wall = time.perf_counter() - t_prof0
             jax.profiler.stop_trace()
             started = False
             _hb("profile captured: %s" % profile_dir)
@@ -909,6 +1047,51 @@ def main():
         finally:
             signal.alarm(0)
             signal.signal(signal.SIGALRM, old_h)
+    if profile_dir and os.environ.get("MXTPU_PROFILE_ATTRIB",
+                                      "1") != "0":
+        # a live capture exists: join measured per-op device time
+        # against the cost ledger of the SAME executable and commit
+        # the attribution artifact — THE op-level breakdown ROADMAP
+        # item 3 is blocked on ("nobody knows where 73% goes"). Own
+        # alarm, after the headline is out: attribution must never
+        # cost the round its number.
+        def _attr_alarm(signum, frame):
+            raise TimeoutError("xplane attribution timed out")
+        old_h = signal.signal(signal.SIGALRM, _attr_alarm)
+        signal.alarm(180)
+        try:
+            from mxnet_tpu import profiling as _profiling
+            compiled = fwd.lower(pvals, data).compile()  # jit-cached
+            attrib = _profiling.analyze_dir(
+                profile_dir, compiled=compiled,
+                step_wall_s=prof_wall, steps=10)
+            attrib_path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "docs",
+                "profiles",
+                "attrib_%s.json" % time.strftime("%Y%m%d_%H%M"))
+            os.makedirs(os.path.dirname(attrib_path), exist_ok=True)
+            with open(attrib_path + ".tmp", "w") as f:
+                json.dump(attrib, f)
+            os.replace(attrib_path + ".tmp", attrib_path)
+            extra_attrib = {
+                "attribution_artifact": os.path.relpath(
+                    attrib_path,
+                    os.path.dirname(os.path.abspath(__file__))),
+                "attribution_reconciled": attrib.get("reconciled"),
+                "attribution_ratio": (attrib.get("reconciliation")
+                                      or {}).get("ratio"),
+                "mfu_attributed": attrib.get("mfu"),
+            }
+            _hb("attribution committed: %s (ratio %s)"
+                % (attrib_path, extra_attrib["attribution_ratio"]))
+        except Exception as e:  # noqa: BLE001 — attribution is optional
+            _diag("xplane attribution failed: %r" % (e,))
+            extra_attrib = {}
+        finally:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old_h)
+    else:
+        extra_attrib = {}
     del fwd, pvals
 
     def _aux_section(name, seconds, fn):
@@ -1111,6 +1294,12 @@ def main():
         result["train_layout"] = _best_layout()
         result["train_stem"] = _best_stem()
     result.update(extra)
+    result.update(extra_attrib)
+    ledger = _ledger_snapshot()
+    if ledger is not None:
+        # the cost-model table rides the success artifact too, so a
+        # perf PR's before/after diff always has both sides
+        result["cost_ledger"] = ledger
     final = json.dumps(result)
     _emit(final)
     _child_record(final)

@@ -45,6 +45,7 @@ if (_os.environ.get("DMLC_ROLE") == "worker"
 from .base import MXNetError, get_env
 from . import telemetry
 from . import tracing
+from . import profiling
 from .context import (Context, cpu, cpu_pinned, current_context, gpu, num_gpus,
                       num_tpus, tpu)
 from . import engine

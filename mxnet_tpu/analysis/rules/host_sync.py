@@ -45,6 +45,15 @@ _SCOPES = (
     ("mxnet_tpu/tracing/",
      {"__enter__", "__exit__", "span", "span_at", "record_span",
       "set_attr", "heartbeat", "_touch", "_observe_span"}, set()),
+    # profiling recorders: ledger pricing and the xplane join run on
+    # artifacts AFTER measurement — a device sync creeping into them
+    # would perturb the very steps they attribute (attribution_run's
+    # per-step fence is the one sanctioned sync, and lives outside
+    # these methods)
+    ("mxnet_tpu/profiling/",
+     {"build_ledger", "instr_cost", "measure_ops", "join",
+      "summarize", "mfu_estimate", "attribute_op_name",
+      "group_by_op"}, set()),
 )
 
 # calls that block on (or copy from) the device stream

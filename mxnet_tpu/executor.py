@@ -140,13 +140,20 @@ class Executor:
                     node.attrs.get("__ctx_group__"))
                 if dev is not None:
                     ins = [jax.device_put(x, dev) for x in ins]
-                if training and opdef.name in ("BatchNorm",
-                                               "_contrib_SyncBatchNorm") \
-                        and not attrs.get("use_global_stats"):
-                    out = self._bn_train(node, opdef, ins, attrs,
-                                         aux_updates)
-                else:
-                    out = opdef.fn(*ins, **attrs)
+                # trace-time only: the scope stamps every lowered HLO
+                # instruction's op_name metadata with "mx.<OpName>",
+                # which is how the profiling cost ledger keys compiled
+                # ops back to framework names (profiling/ledger.py);
+                # zero runtime cost — the jitted executable never sees
+                # the context manager
+                with jax.named_scope("mx." + opdef.name):
+                    if training and opdef.name in (
+                            "BatchNorm", "_contrib_SyncBatchNorm") \
+                            and not attrs.get("use_global_stats"):
+                        out = self._bn_train(node, opdef, ins, attrs,
+                                             aux_updates)
+                    else:
+                        out = opdef.fn(*ins, **attrs)
                 outs = (list(out) if isinstance(out, (tuple, list))
                         else [out])
                 for k, o in enumerate(outs):

@@ -142,6 +142,34 @@ _ENV_VARS = {
         "flight-recorder dump destination (atomic file write; default "
         "stderr). bench.py points it at a per-run file it embeds in "
         "failure JSON (tracing/flight.py)"),
+    "MXTPU_PROFILE_ATTRIB": (
+        "0 disables the performance-attribution passes: bench.py's "
+        "CPU cost-ledger subprocess and the post-capture xplane join "
+        "(default on; profiling/, bench.py)"),
+    "MXTPU_PROFILE_DIR": (
+        "base directory for jax.profiler attribution captures "
+        "(default <tmp>/mxtpu_profile; profiling/capture.py, "
+        "tools/mfu_report.py --capture)"),
+    "MXTPU_PEAK_HBM_GBS": (
+        "per-chip HBM bandwidth in GB/s — the roofline's memory "
+        "ceiling next to MXTPU_PEAK_TFLOPS (default 819 = v5e; "
+        "profiling/ledger.py)"),
+    "MXTPU_BENCH_BATCH": (
+        "bench harness batch size; the cost-ledger pass compiles its "
+        "stage programs at this batch (default 128; bench.py, "
+        "profiling/bench_ledger.py)"),
+    "MXTPU_LEDGER_OUT": (
+        "cost-ledger pass output path; bench.py points it at a "
+        "per-run file whose stage summaries every artifact embeds "
+        "(profiling/bench_ledger.py)"),
+    "MXTPU_LEDGER_STAGES": (
+        "comma-separated bench_ledger stages to compile+price "
+        "(default infer_bf16,train_bf16; 'tiny' is the seconds-fast "
+        "test stage; profiling/bench_ledger.py)"),
+    "MXTPU_LEDGER_DEADLINE_SEC": (
+        "how long bench.py waits for the cost-ledger subprocess "
+        "before killing it at final-artifact time (default 300; "
+        "bench.py)"),
 }
 
 

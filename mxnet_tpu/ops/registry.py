@@ -235,6 +235,21 @@ def canonical_ops():
     return {op.name: op for op in _OPS.values()}
 
 
+def fn_name_map():
+    """{implementing python function name: canonical op name}.
+
+    The dispatch layer wraps each op's fn in ``jax.jit``, so every HLO
+    instruction an op lowers to carries ``jit(<fn name>)`` in its
+    op_name metadata — this map is how the profiling cost ledger turns
+    that back into the framework op (e.g. ``convolution`` ->
+    ``Convolution``, ``sg_xla_conv`` -> ``_sg_xla_conv``). Ops sharing
+    one implementation function collapse onto the canonical name
+    registered last; the ledger only needs a stable, recognizable
+    attribution key."""
+    return {op.fn.__name__: name
+            for name, op in canonical_ops().items()}
+
+
 @functools.lru_cache(maxsize=None)
 def infer_output(op_name, in_shapes_dtypes, attrs_items):
     """Shape/dtype inference via abstract evaluation (FInferShape/FInferType

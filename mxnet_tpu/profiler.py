@@ -99,7 +99,38 @@ def set_state(state="stop", profile_process="worker"):
         import jax
         jax.profiler.stop_trace()
         _xla_session = None
+        # the capture is now on disk: remember where, so
+        # last_xplane_dir()/op_attribution() can analyze it without
+        # the caller re-plumbing the directory
+        _last_xplane_dir[0] = _config["xla_trace_dir"]
     _state = state
+
+
+_last_xplane_dir = [None]
+
+
+def last_xplane_dir():
+    """The most recent completed ``xla_trace_dir`` capture (set_config
+    + set_state('run'→'stop')), or None."""
+    return _last_xplane_dir[0]
+
+
+def op_attribution(compiled=None, hlo_text=None, profile_dir=None,
+                   **kwargs):
+    """Measured per-op attribution for the last (or given) xplane
+    capture, joined against a cost ledger built from ``compiled`` /
+    ``hlo_text`` — the profiler-side door into
+    ``mxnet_tpu.profiling`` (docs/observability.md "MFU accounting &
+    roofline")."""
+    from . import profiling
+    profile_dir = profile_dir or last_xplane_dir()
+    if profile_dir is None:
+        raise MXNetError(
+            "no xplane capture recorded: run with "
+            "set_config(xla_trace_dir=...) + set_state('run'/'stop'), "
+            "or pass profile_dir=")
+    return profiling.analyze_dir(profile_dir, compiled=compiled,
+                                 hlo_text=hlo_text, **kwargs)
 
 
 def state():

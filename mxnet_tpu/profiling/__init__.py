@@ -1,0 +1,38 @@
+"""Performance attribution: *where* the chip time goes.
+
+The third observability layer (telemetry = how much, tracing =
+why/when): per-op/per-fusion FLOPs, HBM bytes and time, keyed back to
+framework op names and fusion rules, reconciled against measured
+reality.
+
+- :mod:`~mxnet_tpu.profiling.hlo` — optimized-HLO parser + analytic
+  per-instruction cost model (stdlib-only),
+- :mod:`~mxnet_tpu.profiling.ledger` — the cost ledger: build, price,
+  attribute, summarize, diff,
+- :mod:`~mxnet_tpu.profiling.xplane` — ``jax.profiler`` xplane
+  protobuf reader (stdlib-only) + measured per-op device time,
+- :mod:`~mxnet_tpu.profiling.capture` — run-under-capture harness
+  joining measured time onto the ledger with a >= 90% reconciliation
+  gate against telemetry ``mx_step_time_seconds``,
+- :mod:`~mxnet_tpu.profiling.bench_ledger` — the ``python -m``
+  subprocess ``bench.py`` uses to compute a CPU cost-model ledger even
+  when the TPU tunnel is wedged.
+
+CLI: ``tools/mfu_report.py`` (table / --diff / --capture / --chrome).
+Env: ``MXTPU_PROFILE_ATTRIB``, ``MXTPU_PROFILE_DIR``,
+``MXTPU_PEAK_HBM_GBS`` (+ the existing ``MXTPU_PEAK_TFLOPS``) —
+registered in ``libinfo._ENV_VARS``, documented in
+``docs/observability.md`` ("MFU accounting & roofline").
+"""
+from __future__ import annotations
+
+from . import hlo
+from . import ledger
+from . import xplane
+from . import capture
+from .capture import analyze_dir, attribution_run
+from .ledger import build_ledger, from_compiled, from_fn, mfu_estimate
+
+__all__ = ["hlo", "ledger", "xplane", "capture", "build_ledger",
+           "from_compiled", "from_fn", "mfu_estimate",
+           "analyze_dir", "attribution_run"]

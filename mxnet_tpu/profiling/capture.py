@@ -1,0 +1,273 @@
+"""Capture harness: measured per-op time joined against the ledger.
+
+The loop every perf investigation needs, packaged:
+
+1. compile the step function and price it (``ledger.from_compiled``),
+2. run N steps under ``jax.profiler`` with a telemetry
+   ``step_boundary`` per step (so ``mx_step_time_seconds`` accrues the
+   wall-clock truth the attribution must reconcile against),
+3. parse the xplane artifact, join measured per-op device time onto
+   the ledger rows, classify each op on the measured roofline, and
+4. report reconciliation: the union of attributed device intervals
+   must cover >= 90% of the telemetry step wall-time, or the table is
+   lying about where the time goes (``reconciled`` carries the ratio;
+   callers/tests gate on it).
+
+Works identically on the CPU backend (per-thunk tracemes) and on TPU
+("XLA Ops" lines) — the join key is HLO instruction names either way.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+
+from . import hlo, ledger, xplane
+
+ATTRIBUTION_VERSION = 1
+
+
+def profile_dir_default():
+    return os.environ.get("MXTPU_PROFILE_DIR") or os.path.join(
+        tempfile.gettempdir(), "mxtpu_profile")
+
+
+def _telemetry_step_total():
+    """(sum_s, count) of completed telemetry step intervals, 0s when
+    telemetry is disabled or absent."""
+    try:
+        from .. import telemetry
+        snap = telemetry.snapshot()["metrics"]
+        fam = snap.get("mx_step_time_seconds", {"series": []})
+        for s in fam["series"]:
+            return float(s.get("sum", 0.0)), int(s.get("count", 0))
+    except Exception:  # noqa: BLE001 — reconciliation degrades to wall
+        pass
+    return 0.0, 0
+
+
+def attribution_run(step_fn, args=(), steps=3, profile_dir=None,
+                    items_per_step=None, source="profiling",
+                    warmup=True):
+    """Run ``step_fn(*args)`` ``steps`` times under capture and return
+    the joined attribution document.
+
+    ``step_fn`` must be a jitted callable (``jax.jit`` output) of
+    device arrays; its result is block_until_ready'd per step so each
+    telemetry interval is a true device step. When the step returns
+    donated updates ``(new_args..., aux)`` matching ``args`` in
+    prefix, pass ``args`` positionally and the harness threads them.
+    """
+    import jax
+
+    from ..telemetry import step as _tstep
+
+    if profile_dir is None:
+        profile_dir = os.path.join(
+            profile_dir_default(), "attrib_%d" % os.getpid())
+    compiled = step_fn.lower(*args).compile() \
+        if hasattr(step_fn, "lower") else None
+    if compiled is None:
+        step_fn = jax.jit(step_fn)
+        compiled = step_fn.lower(*args).compile()
+    doc = ledger.from_compiled(compiled)
+
+    def _ready(out):
+        leaves = jax.tree_util.tree_leaves(out)
+        for leaf in leaves:
+            if hasattr(leaf, "block_until_ready"):
+                leaf.block_until_ready()
+        return out
+
+    cur = tuple(args)
+    if warmup:
+        out = _ready(step_fn(*cur))
+        cur = _thread(cur, out)
+    # drop any interval state an earlier training loop left open: the
+    # harness's first step_boundary must OPEN a fresh interval, not
+    # close a stale one into the measurement window
+    _tstep.reset()
+    base_sum, base_count = _telemetry_step_total()
+    wall0 = time.perf_counter()
+    jax.profiler.start_trace(profile_dir)
+    try:
+        _tstep.step_boundary(source=source)  # opens the first interval
+        for _ in range(steps):
+            out = _ready(step_fn(*cur))
+            cur = _thread(cur, out)
+            _tstep.step_boundary(source=source)
+    finally:
+        jax.profiler.stop_trace()
+    wall_s = time.perf_counter() - wall0
+    tele_sum, tele_count = _telemetry_step_total()
+    step_wall_s = tele_sum - base_sum
+    step_count = tele_count - base_count
+    if step_count <= 0 or step_wall_s <= 0:
+        # telemetry disabled: fall back to the harness wall clock so
+        # the reconciliation ratio still means something
+        step_wall_s = wall_s
+        step_count = steps
+    planes = xplane.load_xspace(profile_dir)
+    return join(doc, planes, step_wall_s=step_wall_s,
+                steps=step_count, items_per_step=items_per_step,
+                profile_dir=profile_dir)
+
+
+def _thread(cur, out):
+    """Thread donated outputs back as next-step inputs when the step
+    returns a tuple prefix-shaped like its inputs (bench-style
+    ``step(params, moms, ...) -> (params, moms, loss)``)."""
+    if not isinstance(out, tuple) or not cur:
+        return cur
+    n = min(len(out), len(cur))
+    k = 0
+    try:
+        while k < n and _treedef_like(out[k], cur[k]):
+            k += 1
+    except Exception:  # noqa: BLE001 — threading is best-effort
+        return cur
+    return tuple(out[:k]) + tuple(cur[k:])
+
+
+def _treedef_like(a, b):
+    import jax
+    if jax.tree_util.tree_structure(a) != \
+            jax.tree_util.tree_structure(b):
+        return False
+    for la, lb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        if getattr(la, "shape", None) != getattr(lb, "shape", None) \
+                or getattr(la, "dtype", None) != getattr(lb, "dtype",
+                                                         None):
+            return False
+    return True
+
+
+def join(ledger_doc, planes, step_wall_s=None, steps=None,
+         items_per_step=None, profile_dir=None):
+    """Join measured xplane op times onto a ledger document.
+
+    The join key is ENTRY instruction names: a ``while`` body's inner
+    thunks and a call's fused computation re-emit events under inner
+    names that match no entry row, so their time reaches the table
+    only through the enclosing entry-level event — one nanosecond,
+    one row. Interval union (not the per-row sum) is what reconciles
+    against step wall-time, so concurrently-executing thunks don't
+    overcount either.
+    """
+    mod_names = {row["instr"] for row in ledger_doc["rows"]}
+    measured = xplane.measure_ops(planes, mod_names)
+    per_step = float(steps) if steps else 1.0
+    peak_fs = ledger_doc["peak_tflops"] * 1e12
+    peak_bs = ledger_doc["peak_hbm_gbs"] * 1e9
+    rows = []
+    attributed_s = 0.0
+    for row in ledger_doc["rows"]:
+        m = measured["ops"].get(row["instr"])
+        out = dict(row)
+        if m:
+            self_s = m["self_s"] / per_step
+            out["measured_s"] = self_s
+            out["measured_count"] = m["count"]
+            attributed_s += self_s
+            if self_s > 0:
+                achieved_fs = row["flops"] / self_s
+                achieved_bs = row["bytes"] / self_s
+                out["mfu"] = round(achieved_fs / peak_fs, 4)
+                out["hbm_util"] = round(achieved_bs / peak_bs, 4)
+                out["bound_measured"] = (
+                    "comms" if row["bound"] == "comms" else
+                    "compute" if achieved_fs / peak_fs
+                    >= achieved_bs / peak_bs else "hbm")
+        rows.append(out)
+    rows.sort(key=lambda r: -(r.get("measured_s", 0.0)
+                              or r.get("est_s", 0.0)))
+    step_wall = step_wall_s if step_wall_s else None
+    covered_per_step = measured["covered_s"] / per_step
+    window_per_step = measured["window_s"] / per_step
+    unattributed = max(window_per_step - covered_per_step, 0.0)
+    doc = dict(ledger_doc)
+    doc["kind"] = "mfu_attribution"
+    doc["version"] = ATTRIBUTION_VERSION
+    doc["rows"] = rows
+    by_op = _merge_measured(doc, rows)
+    if unattributed > 0:
+        # device busy time no named event claims (on CPU: Eigen
+        # pool-offloaded conv work) — an explicit row, never silence
+        by_op.append({
+            "op": "_unattributed", "instrs": 0, "flops": 0, "bytes": 0,
+            "est_s": 0.0, "bound": "?",
+            "measured_s": round(unattributed, 6)})
+        by_op.sort(key=lambda g: -(g.get("measured_s", 0.0)
+                                   or g.get("est_s", 0.0)))
+    doc["by_op"] = by_op
+    doc["measured"] = {
+        "steps": steps,
+        "matched_events": measured["matched_events"],
+        "named_s_per_step": round(covered_per_step, 6),
+        "attributed_s_per_step": round(attributed_s, 6),
+        "device_window_s_per_step": round(window_per_step, 6),
+        "unattributed_s_per_step": round(unattributed, 6),
+    }
+    if profile_dir:
+        doc["profile_dir"] = profile_dir
+    if step_wall:
+        per_step_wall = step_wall / per_step
+        doc["reconciliation"] = {
+            "step_wall_s": round(per_step_wall, 6),
+            # the table's total claim on the step: named rows plus the
+            # explicit _unattributed row = the device-busy window
+            "attributed_s": round(window_per_step, 6),
+            "named_s": round(covered_per_step, 6),
+            "ratio": round(window_per_step / per_step_wall, 4)
+            if per_step_wall > 0 else 0.0,
+            "idle_s": round(max(per_step_wall - window_per_step, 0.0),
+                            6),
+        }
+        doc["reconciled"] = doc["reconciliation"]["ratio"] >= 0.9
+        flops_step = doc["totals"]["flops"]
+        doc["mfu"] = round(flops_step / (per_step_wall * peak_fs), 4)
+        if items_per_step:
+            doc["items_per_s"] = round(
+                items_per_step / per_step_wall, 2)
+    return doc
+
+
+def _merge_measured(doc, rows):
+    """by_op regrouped with measured seconds + measured MFU."""
+    groups = ledger.group_by_op(
+        [{k: r[k] for k in ("op", "hlo_op", "flops", "bytes", "est_s",
+                            "bound") if k in r} | (
+            {"rule": r["rule"]} if r.get("rule") else {})
+         for r in rows],
+        doc["peak_tflops"], doc["peak_hbm_gbs"])
+    meas = {}
+    for r in rows:
+        if "measured_s" in r:
+            key = r.get("op") or r["hlo_op"]
+            meas[key] = meas.get(key, 0.0) + r["measured_s"]
+    peak_fs = doc["peak_tflops"] * 1e12
+    for g in groups:
+        if g["op"] in meas:
+            g["measured_s"] = round(meas[g["op"]], 6)
+            if g["measured_s"] > 0:
+                g["mfu"] = round(
+                    g["flops"] / g["measured_s"] / peak_fs, 4)
+    groups.sort(key=lambda g: -(g.get("measured_s", 0.0)
+                                or g.get("est_s", 0.0)))
+    return groups
+
+
+def analyze_dir(profile_dir, compiled=None, hlo_text=None,
+                step_wall_s=None, steps=None, **kwargs):
+    """Join an existing capture directory against a ledger built from
+    ``compiled`` (or raw ``hlo_text``)."""
+    if compiled is not None:
+        doc = ledger.from_compiled(compiled, **kwargs)
+    elif hlo_text is not None:
+        doc = ledger.build_ledger(hlo_text, **kwargs)
+    else:
+        raise ValueError("analyze_dir needs compiled= or hlo_text=")
+    planes = xplane.load_xspace(profile_dir)
+    return join(doc, planes, step_wall_s=step_wall_s, steps=steps,
+                profile_dir=profile_dir)

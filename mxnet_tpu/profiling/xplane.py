@@ -1,0 +1,258 @@
+"""Minimal xplane (``*.xplane.pb``) reader — measured per-op time.
+
+``jax.profiler.start_trace`` writes TensorBoard's XSpace protobuf. The
+schema is stable and small (XSpace > XPlane > XLine > XEvent with
+interned event-metadata names), so rather than depending on tensorflow
+for the generated bindings this decodes the protobuf wire format
+directly with the stdlib: ~80 lines, no imports, runs anywhere the
+repo is checked out.
+
+Observed layouts this reader handles:
+
+- XSpace.planes = field 1
+- XPlane: id=1, name=2, lines=3, event_metadata map=4 (key=1,
+  value=2 -> XEventMetadata{id=1, name=2})
+- XLine: id=1, name=2, timestamp_ns=3, events=4, display_name=11
+- XEvent: metadata_id=1, offset_ps=2, duration_ps=3
+
+On the CPU backend the per-HLO-thunk events land on ``/host:CPU``
+lines (``tf_XLAEigen/...``); on TPU they land on ``/device:TPU:N``
+"XLA Ops" lines. Either way the event *names are HLO instruction
+names* (modulo a ``.clone``/``.remat`` suffix from thunk splitting),
+which is exactly the cost ledger's join key — see
+:func:`measure_ops`.
+"""
+from __future__ import annotations
+
+import glob
+import os
+
+
+def _varint(buf, i):
+    r = 0
+    s = 0
+    while True:
+        b = buf[i]
+        i += 1
+        r |= (b & 0x7F) << s
+        if not b & 0x80:
+            return r, i
+        s += 7
+
+
+def _fields(buf):
+    i = 0
+    n = len(buf)
+    while i < n:
+        tag, i = _varint(buf, i)
+        fn, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, i = _varint(buf, i)
+        elif wt == 2:
+            ln, i = _varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = buf[i:i + 4]
+            i += 4
+        elif wt == 1:
+            v = buf[i:i + 8]
+            i += 8
+        else:
+            raise ValueError("unsupported protobuf wire type %d" % wt)
+        yield fn, wt, v
+
+
+def _parse_event_metadata(buf):
+    key = None
+    name = None
+    for fn, wt, v in _fields(buf):
+        if fn == 1 and wt == 0:
+            key = v
+        elif fn == 2 and wt == 2:
+            for f2, w2, v2 in _fields(v):
+                if f2 == 1 and w2 == 0 and key is None:
+                    key = v2
+                elif f2 == 2 and w2 == 2:
+                    name = v2.decode("utf-8", "replace")
+    return key, name
+
+
+def _parse_line(buf):
+    line = {"name": None, "timestamp_ns": 0, "events": []}
+    for fn, wt, v in _fields(buf):
+        if fn == 2 and wt == 2 and line["name"] is None:
+            line["name"] = v.decode("utf-8", "replace")
+        elif fn == 11 and wt == 2:
+            line["name"] = v.decode("utf-8", "replace")
+        elif fn == 3 and wt == 0:
+            line["timestamp_ns"] = v
+        elif fn == 4 and wt == 2:
+            mid = None
+            off_ps = 0
+            dur_ps = 0
+            for f2, w2, v2 in _fields(v):
+                if f2 == 1 and w2 == 0:
+                    mid = v2
+                elif f2 == 2 and w2 == 0:
+                    off_ps = v2
+                elif f2 == 3 and w2 == 0:
+                    dur_ps = v2
+            line["events"].append((mid, off_ps, dur_ps))
+    return line
+
+
+def parse_xspace(data):
+    """bytes -> [{"name", "event_metadata": {id: name},
+    "lines": [{"name", "timestamp_ns", "events": [(mid, off_ps,
+    dur_ps)]}]}]."""
+    planes = []
+    for fn, wt, v in _fields(data):
+        if fn != 1 or wt != 2:
+            continue
+        plane = {"name": None, "event_metadata": {}, "lines": []}
+        for f2, w2, v2 in _fields(v):
+            if f2 == 2 and w2 == 2:
+                plane["name"] = v2.decode("utf-8", "replace")
+            elif f2 == 4 and w2 == 2:
+                key, name = _parse_event_metadata(v2)
+                if key is not None:
+                    plane["event_metadata"][key] = name
+            elif f2 == 3 and w2 == 2:
+                plane["lines"].append(_parse_line(v2))
+        planes.append(plane)
+    return planes
+
+
+def find_xplane_files(profile_dir):
+    """The ``*.xplane.pb`` artifacts under a ``jax.profiler`` capture
+    directory (``<dir>/plugins/profile/<run>/<host>.xplane.pb``),
+    newest run first."""
+    pats = (os.path.join(profile_dir, "plugins", "profile", "*",
+                         "*.xplane.pb"),
+            os.path.join(profile_dir, "*.xplane.pb"))
+    found = []
+    for p in pats:
+        found.extend(glob.glob(p))
+    return sorted(found, key=lambda p: os.path.getmtime(p),
+                  reverse=True)
+
+
+def load_xspace(profile_dir_or_file):
+    path = profile_dir_or_file
+    if os.path.isdir(path):
+        files = find_xplane_files(path)
+        if not files:
+            raise FileNotFoundError(
+                "no .xplane.pb under %s (did the capture succeed?)"
+                % path)
+        path = files[0]
+    with open(path, "rb") as f:
+        return parse_xspace(f.read())
+
+
+def normalize_event_name(name):
+    """Thunk-split suffixes back to the HLO instruction name."""
+    if not name:
+        return name
+    for suffix in (".clone", ".remat", ".remat2"):
+        while name.endswith(suffix):
+            name = name[:-len(suffix)]
+    return name
+
+
+def _union_ps(intervals):
+    total = 0
+    cur_s = cur_e = None
+    for s, e in sorted(intervals):
+        if cur_e is None or s > cur_e:
+            if cur_e is not None:
+                total += cur_e - cur_s
+            cur_s, cur_e = s, e
+        else:
+            cur_e = max(cur_e, e)
+    if cur_e is not None:
+        total += cur_e - cur_s
+    return total
+
+
+def _is_device_line(plane_name, line_name):
+    """Lines carrying XLA execution: on TPU the ``/device:TPU:N``
+    planes ("XLA Ops"/"Steps"); on CPU the ``tf_XLA*`` thunk-executor
+    lines of ``/host:CPU`` (both the per-thunk Eigen lines and the
+    client line whose 'wait for completion' event covers the
+    pool-offloaded work that carries no per-op name)."""
+    if (plane_name or "").startswith("/device:"):
+        return True
+    return (line_name or "").startswith("tf_XLA")
+
+
+def measure_ops(planes, instr_names):
+    """Join captured events against HLO instruction names.
+
+    Per-op attribution uses SELF time: a ``call.N`` thunk event wraps
+    the fused computation's own event on the same line, and a
+    ``while`` body re-emits its inner thunks every trip — nested
+    matched intervals are subtracted from their enclosing event so one
+    nanosecond of device time lands on exactly one row.
+
+    The reconciliation quantity is ``window_s``: the interval union of
+    every event on device/executor lines (timestamp-rebased so lines
+    share one axis). On TPU those events are all named per-op; on the
+    CPU backend Eigen offloads convolutions to pool threads that emit
+    no per-op traceme, so ``window_s > covered_s`` and the difference
+    is reported as unattributed executor time rather than silently
+    dropped.
+
+    Returns ``{"ops": {instr_name: {"count", "total_s", "self_s"}},
+    "covered_s", "window_s", "matched_events"}`` (times in seconds,
+    per capture — divide by step count for per-step numbers).
+    """
+    names = set(instr_names)
+    ops = {}
+    covered = []
+    window = []
+    matched_events = 0
+    for plane in planes:
+        metas = plane["event_metadata"]
+        for line in plane["lines"]:
+            base_ps = line["timestamp_ns"] * 1000
+            device_line = _is_device_line(plane["name"], line["name"])
+            evs = []
+            for mid, off_ps, dur_ps in line["events"]:
+                s, e = base_ps + off_ps, base_ps + off_ps + dur_ps
+                if device_line:
+                    window.append((s, e))
+                name = normalize_event_name(metas.get(mid))
+                if name in names:
+                    evs.append((s, e, name))
+            if not evs:
+                continue
+            matched_events += len(evs)
+            covered.extend((s, e) for s, e, _ in evs)
+            evs.sort(key=lambda t: (t[0], -t[1]))
+            # nesting sweep: [start, end, name, child_ps]
+            stack = []
+
+            def close(frame):
+                s, e, name, child = frame
+                rec = ops.setdefault(
+                    name, {"count": 0, "total_s": 0.0, "self_s": 0.0})
+                rec["count"] += 1
+                rec["total_s"] += (e - s) / 1e12
+                rec["self_s"] += max(e - s - child, 0) / 1e12
+                if stack:
+                    stack[-1][3] += e - s
+
+            for s, e, name in evs:
+                while stack and s >= stack[-1][1]:
+                    close(stack.pop())
+                stack.append([s, e, name, 0])
+            while stack:
+                close(stack.pop())
+    return {
+        "ops": ops,
+        "covered_s": _union_ps(covered) / 1e12,
+        "window_s": _union_ps(window) / 1e12,
+        "matched_events": matched_events,
+    }

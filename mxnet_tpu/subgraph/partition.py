@@ -68,6 +68,13 @@ def register_subgraph_property(name, prop):
     return prop
 
 
+def registered_properties():
+    """{backend name: property} — read-only view for tooling (the
+    profiling ledger maps each property's op_name back to its fusion
+    rule for cost attribution)."""
+    return dict(_PROPERTIES)
+
+
 def get_subgraph_property(name):
     try:
         return _PROPERTIES[name]

@@ -122,6 +122,13 @@ class XlaConvSelector(SubgraphSelector):
 
 class XlaConvProperty(SubgraphProperty):
     op_name = "_sg_xla_conv"
+    # the rule identity cost attribution reports: every HLO instruction
+    # a fused cluster lowers to is charged to "XLA/conv_bn_add_relu" in
+    # the profiling ledger (profiling/ledger.fusion_rule_map), so a
+    # fusion decision's win or regression shows up as a ranked diff row
+    # (tools/mfu_report.py --diff), not a guess — the TVM/Relay
+    # cost-attributed-partitioning stance (PAPERS.md)
+    rule_name = "conv_bn_add_relu"
 
     def create_selector(self):
         return XlaConvSelector()

@@ -105,14 +105,18 @@ def dump(path, fmt="json", snap=None):
     return snap
 
 
-def merge_chrome_trace(snap=None, events=None, spans=None):
+def merge_chrome_trace(snap=None, events=None, spans=None,
+                       attribution=None):
     """One chrome://tracing document carrying every observability
     layer: the profiler's trace events, the tracing spans (causal
-    layer, PR 5), and the metric snapshot — counters/gauges as 'C'
-    samples on the same clock, the full snapshot under metadata.
-    All three share tracing.clock's process epoch, so they land on one
-    Perfetto time axis. ``spans`` defaults to the process's recorded
-    spans; pass [] to omit them."""
+    layer, PR 5), the metric snapshot — counters/gauges as 'C'
+    samples on the same clock, the full snapshot under metadata —
+    and, when ``attribution`` is a profiling ledger/attribution
+    document (PR 6), its ranked per-op rows as a flame strip on a
+    dedicated pid plus the raw document under metadata. All layers
+    share tracing.clock's process epoch, so they land on one Perfetto
+    time axis. ``spans`` defaults to the process's recorded spans;
+    pass [] to omit them."""
     snap = snap if snap is not None else snapshot()
     from .. import profiler
     from .. import tracing as _tracing
@@ -131,12 +135,20 @@ def merge_chrome_trace(snap=None, events=None, spans=None):
             ev_name = name + _prom_labels(s.get("labels", {}))
             merged.append({"name": ev_name, "ph": "C", "ts": ts,
                            "pid": 0, "args": {name: s["value"]}})
+    metadata = {"telemetry": snap}
+    if attribution is not None:
+        merged.extend(_tracing.export.attribution_events(attribution))
+        metadata["attribution"] = {
+            k: attribution.get(k)
+            for k in ("kind", "module", "totals", "reconciliation",
+                      "mfu", "peak_tflops", "peak_hbm_gbs")
+            if k in attribution}
     return {"traceEvents": merged, "displayTimeUnit": "ms",
-            "metadata": {"telemetry": snap}}
+            "metadata": metadata}
 
 
-def dump_chrome_trace(path, snap=None, events=None):
-    trace = merge_chrome_trace(snap, events)
+def dump_chrome_trace(path, snap=None, events=None, attribution=None):
+    trace = merge_chrome_trace(snap, events, attribution=attribution)
     _atomic_text(path, json.dumps(trace))
     return trace
 

@@ -124,6 +124,35 @@ def pull_server_trace(kv, path, timeout=10.0, poll=0.05):
         % (nonce_path, timeout))
 
 
+def attribution_events(attrib_doc, pid=90, tid=0):
+    """Cost-attribution rows (a ``profiling`` ledger/attribution
+    document) rendered as a chrome-trace flame strip: one 'X' event
+    per op, laid end-to-end in rank order on a dedicated pid, sized by
+    measured (preferred) or roofline-estimated per-step seconds. Not a
+    timeline — a proportional-width ranking that sits next to the real
+    spans in the same Perfetto view, so "where does the step go" and
+    "when did it go there" read off one artifact."""
+    events = [{"name": "process_name", "ph": "M", "pid": pid, "tid": tid,
+               "args": {"name": "op attribution (per step)"}}]
+    cursor = 0.0
+    for g in attrib_doc.get("by_op", []):
+        dur_us = (g.get("measured_s") or g.get("est_s") or 0.0) * 1e6
+        if dur_us <= 0:
+            continue
+        args = {"flops": g.get("flops", 0), "bytes": g.get("bytes", 0),
+                "bound": g.get("bound", "?")}
+        if g.get("rule"):
+            args["rule"] = g["rule"]
+        if g.get("mfu") is not None:
+            args["mfu"] = g.get("mfu")
+        events.append({
+            "name": g.get("op") or "?", "cat": "attribution", "ph": "X",
+            "ts": cursor, "dur": dur_us, "pid": pid, "tid": tid,
+            "args": args})
+        cursor += dur_us
+    return events
+
+
 def chrome_events(spans, pid=0, offset_ns=0, base_ns=None):
     """Span dicts -> chrome-trace 'X' events. ``offset_ns`` is added to
     every timestamp (clock alignment); ``base_ns`` is the zero point

@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""perf_gate — fail CI on benchmark regressions and signal-free zeros.
+
+    python tools/perf_gate.py BENCH_r06.json
+    python tools/perf_gate.py bench_out.json --tolerance 0.2 \\
+        --tol mfu_bf16=0.1 --tol resnet50_inference_int8_bs128=0.3
+
+Compares a bench artifact against the committed last-good measurement
+(``docs/artifacts/BENCH_LAST_GOOD.json`` unless ``--last-good``) with
+per-metric tolerances. The artifact may be any of the shapes the
+bench pipeline produces: a driver round file ({"parsed": {...}}), a
+raw result line (dict), or a last-good wrapper ({"line": "..."}).
+
+Exit codes:
+  0  within tolerance (stale artifacts pass with a warning — the
+     driver already knows the round was wedged, and the stale line
+     repeats a measurement that DID pass),
+  1  regression: headline or a compared metric fell more than its
+     tolerance below last-good, or a zero-value artifact that at
+     least carries diagnostics,
+  2  usage / unreadable artifact,
+  3  bare-zero: value 0.0 with NO diag and NO cost_ledger — the
+     signal-free artifact shape PR 6 exists to abolish (BENCH_r04/r05
+     shipped exactly this).
+
+Stdlib only; wired as a tier-1 test over the committed artifacts
+(tests/test_profiling.py), so the gate itself cannot rot.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_LAST_GOOD = os.path.join(REPO, "docs", "artifacts",
+                                 "BENCH_LAST_GOOD.json")
+
+# metrics compared when both sides carry them; values are "bigger is
+# better" throughputs/ratios
+_DEFAULT_METRICS = (
+    "mfu_bf16",
+    "resnet50_inference_fp32_bs128",
+    "resnet50_inference_int8_bs128",
+    "resnet50_train_bf16_bs128",
+    "allreduce_gbps",
+    "transformer_train_tokens_per_s",
+)
+
+
+def parse_artifact(doc):
+    """Normalize any bench artifact shape to the result dict."""
+    if not isinstance(doc, dict):
+        raise ValueError("artifact is not a JSON object")
+    if isinstance(doc.get("parsed"), dict):     # driver round file
+        doc = doc["parsed"]
+    if isinstance(doc.get("line"), str):        # last-good wrapper
+        doc = json.loads(doc["line"])
+    if "metric" not in doc or "value" not in doc:
+        raise ValueError("no metric/value in artifact")
+    return doc
+
+
+def load_artifact(path):
+    with open(path, "r", encoding="utf-8") as f:
+        return parse_artifact(json.load(f))
+
+
+def gate(candidate, last_good, tolerance=0.25, per_metric=None,
+         metrics=_DEFAULT_METRICS):
+    """(exit_code, [messages]) for a candidate vs last-good pair."""
+    per_metric = per_metric or {}
+    msgs = []
+    value = float(candidate.get("value") or 0.0)
+    if value == 0.0:
+        has_signal = bool(candidate.get("diag")
+                          or candidate.get("cost_ledger"))
+        if not has_signal:
+            return 3, ["bare-zero artifact: value=0.0 with no diag "
+                       "and no cost_ledger (signal-free — rejected)"]
+        return 1, ["zero-value artifact (diagnosed: %s)"
+                   % ("error=" + str(candidate.get("error"))[:120]
+                      if candidate.get("error") else "see diag")]
+    if candidate.get("stale"):
+        msgs.append("warning: stale artifact (reason: %s) — gating "
+                    "the repeated last-good value"
+                    % str(candidate.get("stale_reason"))[:120])
+    rc = 0
+    good_value = float(last_good.get("value") or 0.0)
+    tol = per_metric.get("value", per_metric.get(
+        str(candidate.get("metric")), tolerance))
+    if good_value > 0 and value < (1.0 - tol) * good_value:
+        rc = 1
+        msgs.append(
+            "REGRESSION %s: %.2f < %.2f (last good %.2f, tolerance "
+            "%.0f%%)" % (candidate.get("metric"), value,
+                         (1.0 - tol) * good_value, good_value,
+                         tol * 100))
+    else:
+        msgs.append("headline %s: %.2f vs last good %.2f (ok)"
+                    % (candidate.get("metric"), value, good_value))
+    for key in metrics:
+        a, b = last_good.get(key), candidate.get(key)
+        if not isinstance(a, (int, float)) or \
+                not isinstance(b, (int, float)) or a <= 0:
+            continue
+        tol = per_metric.get(key, tolerance)
+        if b < (1.0 - tol) * a:
+            rc = 1
+            msgs.append("REGRESSION %s: %.4g < %.4g (tolerance %.0f%%)"
+                        % (key, b, (1.0 - tol) * a, tol * 100))
+        else:
+            msgs.append("%s: %.4g vs %.4g (ok)" % (key, b, a))
+    return rc, msgs
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="perf_gate",
+                                 description=__doc__.splitlines()[0])
+    ap.add_argument("artifact", help="bench artifact JSON to gate")
+    ap.add_argument("--last-good", default=DEFAULT_LAST_GOOD,
+                    help="reference artifact (default: committed "
+                         "docs/artifacts/BENCH_LAST_GOOD.json)")
+    ap.add_argument("--tolerance", type=float, default=0.25,
+                    help="default allowed fractional drop (0.25)")
+    ap.add_argument("--tol", action="append", default=[],
+                    metavar="METRIC=FRAC",
+                    help="per-metric tolerance override (repeatable)")
+    args = ap.parse_args(argv)
+    per_metric = {}
+    for spec in args.tol:
+        if "=" not in spec:
+            print("perf_gate: --tol wants METRIC=FRAC, got %r" % spec,
+                  file=sys.stderr)
+            return 2
+        k, v = spec.split("=", 1)
+        try:
+            per_metric[k] = float(v)
+        except ValueError:
+            print("perf_gate: bad tolerance %r" % spec,
+                  file=sys.stderr)
+            return 2
+    try:
+        candidate = load_artifact(args.artifact)
+    except (OSError, ValueError) as e:
+        print("perf_gate: cannot read artifact %s: %s"
+              % (args.artifact, e), file=sys.stderr)
+        return 2
+    try:
+        last_good = load_artifact(args.last_good)
+    except (OSError, ValueError) as e:
+        print("perf_gate: cannot read last-good %s: %s"
+              % (args.last_good, e), file=sys.stderr)
+        return 2
+    rc, msgs = gate(candidate, last_good, tolerance=args.tolerance,
+                    per_metric=per_metric)
+    for m in msgs:
+        print(m)
+    print("perf_gate: %s"
+          % {0: "PASS", 1: "REGRESSION", 3: "BARE-ZERO"}.get(rc, rc))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -188,6 +188,9 @@ def straggler_report(docs, offsets=None):
     (trivially naming it)."""
     if offsets is None:
         offsets = estimate_offsets(docs)
+    worker_labels = sorted(proc_label(d) for d in docs
+                           if not is_server(d))
+    single_rank = len(worker_labels) <= 1
     steps = {}
     for d in docs:
         if is_server(d):
@@ -230,9 +233,11 @@ def straggler_report(docs, offsets=None):
         slowest = max(durs, key=durs.get)
         # BSP equalizes raw durations (fast ranks park in comm waiting
         # for the round), so the STRAGGLER is the rank doing the most
-        # non-comm work — the one everyone else's comm-wait points at
+        # non-comm work — the one everyone else's comm-wait points at.
+        # With one rank there are no peers to point: attribution is
+        # "n/a", not a degenerate self-accusation.
         work = {r: v["dur_ms"] - v["comm_ms"] for r, v in ranks.items()}
-        straggler = max(work, key=work.get)
+        straggler = "n/a" if single_rank else max(work, key=work.get)
         slow_count[slowest] = slow_count.get(slowest, 0) + 1
         strag_count[straggler] = strag_count.get(straggler, 0) + 1
         out_steps.append({
@@ -251,8 +256,10 @@ def straggler_report(docs, offsets=None):
             "steps": len(out_steps),
             "slowest_rank": max(slow_count, key=slow_count.get),
             "slowest_rank_step_count": max(slow_count.values()),
-            "straggler_rank": max(strag_count, key=strag_count.get),
-            "straggler_step_count": max(strag_count.values()),
+            "straggler_rank": "n/a" if single_rank
+            else max(strag_count, key=strag_count.get),
+            "straggler_step_count": 0 if single_rank
+            else max(strag_count.values()),
             "critical_path_ms": round(sum(s["critical_path_ms"]
                                           for s in out_steps), 3),
             "comm_wait_ms": round(sum(
@@ -262,13 +269,21 @@ def straggler_report(docs, offsets=None):
                 max(v["data_ms"] for v in s["ranks"].values())
                 for s in out_steps), 3),
         }
+        if single_rank:
+            overall["single_rank"] = True
     return {"steps": out_steps, "overall": overall}
 
 
 def format_report(report):
     lines = []
     ov = report.get("overall") or {}
-    if ov:
+    if ov and ov.get("single_rank"):
+        lines.append(
+            "straggler: n/a (single rank %s — no peers to compare) | "
+            "critical path %.1fms (comm-wait %.1fms, data-wait %.1fms)"
+            % (ov["slowest_rank"], ov["critical_path_ms"],
+               ov["comm_wait_ms"], ov["data_wait_ms"]))
+    elif ov:
         lines.append(
             "straggler: %s (most non-comm work in %d/%d steps; "
             "slowest wall-clock: %s) | critical path %.1fms "
@@ -310,8 +325,16 @@ def main(argv=None):
     n = sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
     print("merged %d spans from %d files -> %s" % (n, len(docs),
                                                    args.out))
+    has_server = any(is_server(d) for d in docs)
     for label, off in sorted(offsets.items()):
-        print("  clock offset %s -> server: %+.3f ms" % (label, off / 1e6))
+        if not has_server:
+            # no clock-offset peers: alignment is the identity, and
+            # saying so beats printing a fake 0.000 estimate
+            print("  clock offset %s: identity (no server peer)"
+                  % label)
+        else:
+            print("  clock offset %s -> server: %+.3f ms"
+                  % (label, off / 1e6))
     if args.report:
         print(format_report(trace["metadata"]["straggler_report"]))
     return 0
