@@ -94,6 +94,31 @@ _LAST_STAGE = ["start"]
 _FLIGHT_PATH = os.environ.get("MXTPU_FLIGHT_PATH") or os.path.join(
     os.path.dirname(os.path.abspath(__file__)), ".bench_flight.json")
 
+# OOM postmortem destination for the bench child: an allocation
+# failure on-chip leaves the ranked peak-liveness table + role census
+# + flight dump here (profiling/memory.py), and _diag_snapshot embeds
+# its headline in the failure artifact
+_OOM_DUMP_PATH = _FLIGHT_PATH + ".oom.json"
+
+
+def _memory_summary(_memory):
+    """Bounded live-memory summary for artifacts: census role totals
+    (MB) + per-device allocator/census footprints. Child side only."""
+    doc = _memory.live_census()
+    out = {"live_mb": round(doc["total_bytes"] / 1e6, 2),
+           "by_role_mb": {role: round(r["bytes"] / 1e6, 2)
+                          for role, r in doc["by_role"].items()}}
+    devices = {dev: round(d["total_bytes"] / 1e6, 2)
+               for dev, d in sorted(doc["by_device"].items())[:8]}
+    if devices:
+        out["by_device_mb"] = devices
+    stats = _memory._device_stats()
+    if stats:
+        out["device_peak_mb"] = {
+            dev: round(s.get("peak_bytes_in_use", 0) / 1e6, 2)
+            for dev, s in sorted(stats.items())[:8]}
+    return out
+
 # cost-ledger pass: a CPU-pinned subprocess compiles the bench stage
 # programs and prices them per-op (mxnet_tpu/profiling/bench_ledger.py)
 # so EVERY round — including a wedged-tunnel 0.0 — carries a cost-model
@@ -236,13 +261,41 @@ def _diag_snapshot(extra=None):
                 diag["flight_probe"] = {"raw_tail": raw[-1500:]}
     except OSError:
         pass
+    # OOM postmortem left by a child allocation failure (JSON written
+    # by profiling/memory.py at MXTPU_OOM_DUMP_PATH) — embed the
+    # headline: the failure cause plus where the bytes were
+    try:
+        oom_path = os.environ.get("MXTPU_OOM_DUMP_PATH",
+                                  _OOM_DUMP_PATH)
+        if os.path.exists(oom_path):
+            with open(oom_path, "r", encoding="utf-8",
+                      errors="replace") as f:
+                odoc = json.loads(f.read())
+            led = odoc.get("memory_ledger") or {}
+            diag["oom"] = {
+                "source": odoc.get("source"),
+                "error": str(odoc.get("error"))[:200],
+                "peak_live_mb": round(
+                    led.get("peak_live_bytes", 0) / 1e6, 2),
+                "top": [{"op": g.get("op"),
+                         "mb": round(g.get("bytes", 0) / 1e6, 2)}
+                        for g in led.get("by_op", [])[:3]],
+                "census_by_role": {
+                    role: round(r.get("bytes", 0) / 1e6, 2)
+                    for role, r in (odoc.get("census", {})
+                                    .get("by_role", {})).items()},
+            }
+    except (OSError, ValueError):
+        pass
     if "mxnet_tpu" in sys.modules:   # child side only — the supervisor
         try:                          # must never import the backend
             from mxnet_tpu import profiler, telemetry
+            from mxnet_tpu.profiling import memory as _memory
             from mxnet_tpu.tracing import flight as _flight
             # live in-flight span view of THIS process (bounded;
             # snapshot() carries no stacks — dump() adds those)
             diag["flight"] = _flight.snapshot(max_spans=5)
+            diag["memory"] = _memory_summary(_memory)
             diag["recovery"] = profiler.recovery_summary()
             diag["recovery"].pop("last", None)
             with profiler._lock:
@@ -489,10 +542,12 @@ def supervise():
     env = _bench_env()
     env[_CHILD_SENTINEL] = "1"
     env.setdefault("MXTPU_FLIGHT_PATH", _FLIGHT_PATH)
+    env.setdefault("MXTPU_OOM_DUMP_PATH", _OOM_DUMP_PATH)
     env["MXTPU_LEDGER_OUT"] = _LEDGER_PATH
     # a stale dump from a previous round must never masquerade as this
-    # round's hang evidence
-    for stale in (_FLIGHT_PATH, _FLIGHT_PATH + ".probe"):
+    # round's hang/OOM evidence
+    for stale in (_FLIGHT_PATH, _FLIGHT_PATH + ".probe",
+                  _OOM_DUMP_PATH):
         try:
             os.unlink(stale)
         except OSError:
@@ -1300,6 +1355,14 @@ def main():
         # the cost-model table rides the success artifact too, so a
         # perf PR's before/after diff always has both sides
         result["cost_ledger"] = ledger
+    try:
+        # bounded live-memory summary (census role totals + per-device
+        # footprint) — the success-side HBM record next to the static
+        # peak in cost_ledger.stages.*.memory
+        from mxnet_tpu.profiling import memory as _memory_mod
+        result["memory"] = _memory_summary(_memory_mod)
+    except Exception:  # noqa: BLE001 — diagnostics never block a result
+        pass
     final = json.dumps(result)
     _emit(final)
     _child_record(final)
@@ -1400,8 +1463,9 @@ def _bench_allreduce(sync, size=int(os.environ.get(
     n = len(devs)
     nbytes = size * 4
     if n > 1:
+        from mxnet_tpu.parallel import shard_map as _shard_map
         mesh = Mesh(np.array(devs), ("x",))
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(_shard_map(
             lambda t: jax.lax.psum(t, "x"), mesh=mesh,
             in_specs=P("x"), out_specs=P()))
         x = jax.device_put(jnp.ones((n, size), jnp.float32),

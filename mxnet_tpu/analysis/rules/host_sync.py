@@ -49,11 +49,16 @@ _SCOPES = (
     # artifacts AFTER measurement — a device sync creeping into them
     # would perturb the very steps they attribute (attribution_run's
     # per-step fence is the one sanctioned sync, and lives outside
-    # these methods)
+    # these methods). The PR 7 memory recorders join the list: role
+    # tagging runs inside optimizer updates and io __next__, and the
+    # census reads shard METADATA only — an asnumpy in either would
+    # stall every tagged hot path at once
     ("mxnet_tpu/profiling/",
      {"build_ledger", "instr_cost", "measure_ops", "join",
       "summarize", "mfu_estimate", "attribute_op_name",
-      "group_by_op"}, set()),
+      "group_by_op", "tag_role", "tag_tree", "role_of",
+      "live_census", "buffer_intervals", "build_memory_ledger",
+      "group_buffers_by_op", "_sweep_peak"}, set()),
 )
 
 # calls that block on (or copy from) the device stream

@@ -81,11 +81,13 @@ class Executor:
                                               "args_grad",
                                               allow_missing=True)
         else:
+            from .profiling import memory as _mem
             for n in self.arg_names:
                 if self._grad_req.get(n, "null") != "null":
                     a = self.arg_dict[n]
-                    self.grad_dict[n] = NDArray(jnp.zeros(a.shape,
-                                                          a._data.dtype))
+                    self.grad_dict[n] = _mem.tag_role(
+                        NDArray(jnp.zeros(a.shape, a._data.dtype)),
+                        "gradient")
         self._monitor = None
         self._monitor_all = False
         self._fwd_cache = {}
@@ -244,8 +246,23 @@ class Executor:
         with self._maybe_profile("executor_forward") as prof, \
                 _tracing.span("executor_forward", cat="compute"), \
                 _telemetry.compile_scope("executor_forward"):
-            outs, aux_updates = self._jitted_forward(bool(is_train))(
-                arg_vals, aux_vals, key)
+            try:
+                outs, aux_updates = self._jitted_forward(bool(is_train))(
+                    arg_vals, aux_vals, key)
+            except Exception as e:
+                # allocation failures leave a ranked-buffer postmortem
+                # before propagating (profiling/memory.py); anything
+                # else re-raises untouched. Everything the provider
+                # does — including fetching the jitted fn, which may
+                # itself raise when the BUILD was what failed — stays
+                # inside the lazy lambda, guarded by the postmortem
+                from .profiling import memory as _mem
+                _mem.maybe_oom_postmortem(
+                    e, source="executor_forward",
+                    hlo_text=lambda: self._jitted_forward(
+                        bool(is_train)).lower(
+                        arg_vals, aux_vals, key).compile().as_text())
+                raise
             if prof or self._serialize_steps():
                 # profiler timing / NaiveEngine determinism: the sync IS
                 # the contract here  # mxlint: disable=MXL002
@@ -349,11 +366,22 @@ class Executor:
         with self._maybe_profile("executor_backward") as prof, \
                 _tracing.span("executor_backward", cat="compute"), \
                 _telemetry.compile_scope("executor_backward"):
-            grads = self._vjp(arg_vals, aux_vals, key, cotangents)
+            try:
+                grads = self._vjp(arg_vals, aux_vals, key, cotangents)
+            except Exception as e:
+                from .profiling import memory as _mem
+                vjp = self._vjp
+                _mem.maybe_oom_postmortem(
+                    e, source="executor_backward",
+                    hlo_text=lambda: vjp.lower(
+                        arg_vals, aux_vals, key,
+                        cotangents).compile().as_text())
+                raise
             if prof or self._serialize_steps():
                 # profiler timing / NaiveEngine determinism: intentional
                 # sync  # mxlint: disable=MXL002
                 grads = jax.block_until_ready(grads)
+        from .profiling import memory as _mem
         for n in grad_names:
             req = self._grad_req[n]
             g = self.grad_dict.get(n)
@@ -363,6 +391,8 @@ class Executor:
                 g._data = g._data + grads[n]
             else:
                 g._data = grads[n]
+            # fresh jax arrays per backward: re-stamp the census role
+            _mem.tag_role(g, "gradient")
 
     @property
     def grad_arrays(self):

@@ -83,11 +83,12 @@ class Parameter:
         self._finish_init(init, default_init)
 
     def _finish_init(self, init, default_init):
+        from ..profiling import memory as _mem
         data = zeros(self.shape, dtype=self.dtype)
         initializer = init_mod.create(init or self.init or default_init)
         desc = init_mod.InitDesc(self.name)
         initializer(desc, data)
-        self._data = data
+        self._data = _mem.tag_role(data, "parameter")
         self._deferred_init = None
         if self._grad_req != "null":
             self._init_grad()
@@ -156,6 +157,7 @@ class Parameter:
             self._data.grad._data = self._data.grad._data * 0
 
     def set_data(self, data):
+        from ..profiling import memory as _mem
         data = data if isinstance(data, NDArray) else array(data)
         if self.shape is not None and not self._shape_incomplete() and \
                 tuple(data.shape) != tuple(self.shape):
@@ -173,6 +175,7 @@ class Parameter:
             self._data._data = data._data
             self._data.grad = grad
             self._data._grad_req = req
+        _mem.tag_role(self._data, "parameter")
 
     def _load_init(self, data, ctx=None):
         self.set_data(data)

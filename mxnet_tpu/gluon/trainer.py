@@ -93,11 +93,19 @@ class Trainer:
         # it are what trace_merge's straggler report groups by step
         n = self._step_count = getattr(self, "_step_count", -1) + 1
         with _tracing.span("trainer_step", cat="step", step=n):
-            if not self._kv_initialized:
-                self._init_kvstore()
-            self._sync_server_rescale()
-            self._allreduce_grads()
-            self._update(ignore_stale_grad)
+            try:
+                if not self._kv_initialized:
+                    self._init_kvstore()
+                self._sync_server_rescale()
+                self._allreduce_grads()
+                self._update(ignore_stale_grad)
+            except Exception as e:
+                # an allocation failure mid-step leaves the combined
+                # memory postmortem (ranked buffers + census + flight
+                # dump) before propagating
+                from ..profiling import memory as _mem
+                _mem.maybe_oom_postmortem(e, source="trainer_step")
+                raise
         # one boundary per optimizer step: charges the data/comm/compile
         # time accumulated since the previous step to this one
         # (telemetry/step.py; wall-clock only, no host sync). Manual

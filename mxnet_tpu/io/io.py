@@ -98,13 +98,25 @@ class DataIter:
         with _tracing.span("data_next", cat="io",
                            iter=type(self).__name__):
             if not _tm.enabled():
-                return self.next()
+                return self._tag_batch(self.next())
             t0 = time.perf_counter()
             batch = self.next()   # StopIteration propagates untimed
             dt = time.perf_counter() - t0
             _data_wait_hist().observe(dt)
             _tm_step.add_data_wait(dt)
-            return batch
+            return self._tag_batch(batch)
+
+    @staticmethod
+    def _tag_batch(batch):
+        """Stamp the batch arrays with the io_buffer census role (the
+        memory-attribution layer; a weakref-table write per array)."""
+        from ..profiling import memory as _mem
+        if _mem.census_enabled():
+            for arrs in (getattr(batch, "data", None) or (),
+                         getattr(batch, "label", None) or ()):
+                for a in arrs:
+                    _mem.tag_role(a, "io_buffer")
+        return batch
 
     def iter_next(self):
         raise NotImplementedError

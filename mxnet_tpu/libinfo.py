@@ -170,6 +170,17 @@ _ENV_VARS = {
         "how long bench.py waits for the cost-ledger subprocess "
         "before killing it at final-artifact time (default 300; "
         "bench.py)"),
+    "MXTPU_MEMORY_CENSUS": (
+        "0 disables the live-array memory census: role tagging at the "
+        "NDArray/optimizer/io seams and the mx_memory_* snapshot "
+        "collector (default on; profiling/memory.py, "
+        "docs/observability.md)"),
+    "MXTPU_OOM_DUMP_PATH": (
+        "OOM postmortem destination — an XLA RESOURCE_EXHAUSTED at "
+        "the executor/trainer/sharded-step seams writes the ranked "
+        "peak-liveness table + census + flight dump here (default "
+        "oom_postmortem.json; bench.py points it at a per-run file "
+        "it embeds in failure artifacts; profiling/memory.py)"),
 }
 
 

@@ -216,10 +216,14 @@ class NDArray:
     # -- autograd ----------------------------------------------------------
     def attach_grad(self, grad_req="write", stype=None):
         from .. import autograd
+        from ..profiling import memory as _mem
         self.grad = NDArray(_materialize(
             np.zeros(self._data.shape, self._data.dtype)
             if not isinstance(self._data, jax.core.Tracer)
             else jnp.zeros_like(self._data)))
+        # census role for the memory attribution layer (a weakref
+        # side-table write, no device work; profiling/memory.py)
+        _mem.tag_role(self.grad, "gradient")
         self._grad_req = grad_req
         autograd._mark_variable(self)
 

@@ -617,11 +617,20 @@ class Updater:
         self.states = {}
 
     def __call__(self, index, grad, weight):
+        from ..profiling import memory as _mem
         if index not in self.states:
             self.states[index] = \
-                self.optimizer.create_state_multi_precision(index, weight)
+                self.optimizer.create_state_multi_precision(index,
+                                                            weight)
         self.optimizer.update_multi_precision(index, weight, grad,
                                               self.states[index])
+        if _mem.census_enabled():
+            # updates are functional (fresh jax arrays land in the
+            # NDArray wrappers), so the census roles are re-stamped
+            # here — one weakref-table write per array, no device work
+            _mem.tag_tree(self.states[index], "optimizer_state")
+            _mem.tag_role(weight, "parameter")
+            _mem.tag_role(grad, "gradient")
 
     def get_states(self, dump_optimizer=False):
         import pickle

@@ -20,7 +20,8 @@ Two composition styles, used where each is idiomatic:
   `ppermute`/`all_to_all`/`psum` where the communication schedule IS the
   algorithm (ring attention, MoE dispatch, pipeline).
 """
-from .mesh import create_mesh, auto_mesh_shape, mesh_sharding, shard_batch
+from .mesh import (create_mesh, auto_mesh_shape, mesh_sharding,
+                   shard_batch, shard_map)
 from .collectives import (allreduce, allgather, alltoall, axis_index,
                           axis_size, ppermute_next, reduce_scatter)
 from .ring_attention import ring_attention
@@ -34,6 +35,7 @@ from .train_step import (make_sharded_train_step,
 
 __all__ = [
     "create_mesh", "auto_mesh_shape", "mesh_sharding", "shard_batch",
+    "shard_map",
     "allreduce", "allgather", "alltoall", "axis_index", "axis_size",
     "ppermute_next", "reduce_scatter",
     "ring_attention", "ulysses_attention",

@@ -82,5 +82,6 @@ def ring_attention_sharded(q, k, v, mesh, axis="sp", causal=False,
     spec = P(None, None, axis, None)
     fn = functools.partial(ring_attention, axis_name=axis, causal=causal,
                            scale=scale)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+    from .mesh import shard_map
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)

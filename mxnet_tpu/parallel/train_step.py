@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..profiling import memory as _mem
+
 
 def sgd_update(params, grads, lr, momentum=None, state=None):
     """Plain / momentum SGD as a pure pytree update
@@ -87,7 +89,7 @@ def make_sharded_train_step(loss_fn, mesh, param_example, batch_example,
         in_shardings=(p_sh, o_sh, b_sh),
         out_shardings=(p_sh, o_sh, NamedSharding(mesh, P())),
         donate_argnums=(0, 1) if donate else ())
-    def step(params, opt_state, batch):
+    def jit_step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         if g_sh is not None:
             grads = jax.lax.with_sharding_constraint(grads, g_sh)
@@ -95,17 +97,38 @@ def make_sharded_train_step(loss_fn, mesh, param_example, batch_example,
                                        opt_state)
         return params, opt_state, loss
 
-    if on_cpu:
-        # XLA's CPU in-process communicator can deadlock its collective
-        # rendezvous when async dispatch lets consecutive step executions
-        # overlap and the program contains subgroup (non-world)
-        # collectives (e.g. a dp×tp mesh). Serialize steps on the host
-        # backend; the TPU runtime orders executions itself.
-        jit_step = step
+    def step(params, opt_state, batch):
+        try:
+            out = jit_step(params, opt_state, batch)
+        except Exception as e:
+            # a sharded step is the seam where a pod-scale OOM lands;
+            # leave the ranked-buffer + per-device-census postmortem
+            _mem.maybe_oom_postmortem(
+                e, source="sharded_train_step",
+                hlo_text=lambda: jit_step.lower(
+                    params, opt_state, batch).compile().as_text())
+            raise
+        if on_cpu:
+            # XLA's CPU in-process communicator can deadlock its
+            # collective rendezvous when async dispatch lets
+            # consecutive step executions overlap and the program
+            # contains subgroup (non-world) collectives (e.g. a dp×tp
+            # mesh). Serialize steps on the host backend; the TPU
+            # runtime orders executions itself.
+            out = jax.block_until_ready(out)
+        if _mem.census_enabled():
+            # donation hands fresh arrays back every step: re-stamp
+            # their census roles (host-side weakref writes only)
+            _mem.tag_tree(out[0], "parameter")
+            _mem.tag_tree(out[1], "optimizer_state")
+        return out
 
-        def step(params, opt_state, batch):
-            return jax.block_until_ready(jit_step(params, opt_state, batch))
+    # keep the jitted callable reachable for tests/tools that lower
+    # the step (test_parallel reads __wrapped__ / the closure)
+    step.__wrapped__ = jit_step
 
+    _mem.tag_tree(params0, "parameter")
+    _mem.tag_tree(opt0, "optimizer_state")
     return step, params0, opt0
 
 

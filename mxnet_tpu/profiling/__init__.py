@@ -14,15 +14,22 @@ reality.
 - :mod:`~mxnet_tpu.profiling.capture` — run-under-capture harness
   joining measured time onto the ledger with a >= 90% reconciliation
   gate against telemetry ``mx_step_time_seconds``,
+- :mod:`~mxnet_tpu.profiling.memory` — the memory axis: static
+  liveness ledger over compiled HLO (peak live bytes + ranked buffer
+  table), live-array census with role tagging (per device shard), and
+  the OOM postmortem artifact,
 - :mod:`~mxnet_tpu.profiling.bench_ledger` — the ``python -m``
   subprocess ``bench.py`` uses to compute a CPU cost-model ledger even
   when the TPU tunnel is wedged.
 
-CLI: ``tools/mfu_report.py`` (table / --diff / --capture / --chrome).
+CLI: ``tools/mfu_report.py`` (table / --diff / --capture / --chrome)
+and ``tools/memory_report.py`` (table / --diff / --capture / --hlo).
 Env: ``MXTPU_PROFILE_ATTRIB``, ``MXTPU_PROFILE_DIR``,
-``MXTPU_PEAK_HBM_GBS`` (+ the existing ``MXTPU_PEAK_TFLOPS``) —
+``MXTPU_PEAK_HBM_GBS``, ``MXTPU_MEMORY_CENSUS``,
+``MXTPU_OOM_DUMP_PATH`` (+ the existing ``MXTPU_PEAK_TFLOPS``) —
 registered in ``libinfo._ENV_VARS``, documented in
-``docs/observability.md`` ("MFU accounting & roofline").
+``docs/observability.md`` ("MFU accounting & roofline", "Memory
+accounting").
 """
 from __future__ import annotations
 
@@ -30,9 +37,14 @@ from . import hlo
 from . import ledger
 from . import xplane
 from . import capture
+from . import memory
 from .capture import analyze_dir, attribution_run
 from .ledger import build_ledger, from_compiled, from_fn, mfu_estimate
+from .memory import (build_memory_ledger, live_census, tag_role,
+                     tag_tree, maybe_oom_postmortem, oom_postmortem)
 
-__all__ = ["hlo", "ledger", "xplane", "capture", "build_ledger",
-           "from_compiled", "from_fn", "mfu_estimate",
-           "analyze_dir", "attribution_run"]
+__all__ = ["hlo", "ledger", "xplane", "capture", "memory",
+           "build_ledger", "from_compiled", "from_fn", "mfu_estimate",
+           "analyze_dir", "attribution_run", "build_memory_ledger",
+           "live_census", "tag_role", "tag_tree",
+           "maybe_oom_postmortem", "oom_postmortem"]

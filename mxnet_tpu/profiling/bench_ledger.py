@@ -91,7 +91,9 @@ def main(argv=None):
     if repo not in sys.path:
         sys.path.insert(0, repo)
 
+    from mxnet_tpu.profiling import hlo as _hlo
     from mxnet_tpu.profiling import ledger
+    from mxnet_tpu.profiling import memory as _memory
 
     doc = {"version": 1, "kind": "bench_cost_ledger",
            "backend": "cpu", "batch": batch, "stages": {}}
@@ -104,11 +106,26 @@ def main(argv=None):
         stage_t0 = time.time()
         try:
             compiled, items = _stage_compiled(stage, batch)
-            led = ledger.from_compiled(compiled)
+            # serialize + parse the (megabytes of) optimized HLO once;
+            # the flop and memory passes share it
+            txt = compiled.as_text()
+            mod = _hlo.parse_module(txt)
+            led = ledger.from_compiled(compiled, hlo_text=txt,
+                                       module=mod)
             summary = ledger.summarize(led)
             summary["gflops_per_item"] = round(
                 led["totals"]["flops"] / items / 1e9, 3)
             summary["compile_s"] = round(time.time() - stage_t0, 1)
+            try:
+                # bounded memory summary (peak live bytes + top-3
+                # buffers): rides the same stage record into every
+                # bench artifact — success, stale, or failure
+                summary["memory"] = _memory.summarize(
+                    _memory.from_compiled(compiled, hlo_text=txt,
+                                          module=mod), top=3)
+            except Exception as e:  # noqa: BLE001 — memory must not
+                summary["memory"] = {   # sink the flop ledger
+                    "stage_error": repr(e)[:120]}
             doc["stages"][stage] = summary
         except Exception as e:  # noqa: BLE001 — a failed stage must not
             # "stage_error", not "error": bench.py line-level gates

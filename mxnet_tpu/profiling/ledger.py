@@ -193,10 +193,14 @@ def group_by_op(rows, peak_tflops=None, peak_hbm_gbs=None):
     return out
 
 
-def from_compiled(compiled, **kwargs):
+def from_compiled(compiled, hlo_text=None, **kwargs):
     """Ledger from a ``jax.stages.Compiled`` — folds in XLA's own
-    aggregate ``cost_analysis`` as a cross-check."""
-    doc = build_ledger(compiled.as_text(), **kwargs)
+    aggregate ``cost_analysis`` as a cross-check. Pass ``hlo_text``/
+    ``module=`` to share one serialization/parse with other passes
+    over the same executable (bench_ledger prices flops AND memory)."""
+    if hlo_text is None:
+        hlo_text = compiled.as_text()
+    doc = build_ledger(hlo_text, **kwargs)
     try:
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):

@@ -129,15 +129,28 @@ def _install_compile_listener():
 
 
 # -- device memory high-water ----------------------------------------------
+# devices whose allocator reported stats in the last collection pass;
+# the census collector below backfills the others (CPU meshes report
+# memory_stats()=None on every device — PR 7 regression fix: those
+# meshes used to report NOTHING, and a multi-process job iterating
+# jax.devices() would try non-addressable remote devices). One-element
+# list rebound atomically: snapshots can run concurrently (flusher
+# daemon + a user dump), and a clear()+add() window would let the
+# census pass overwrite an allocator-reported gauge
+_devices_with_stats = [frozenset()]
+
+
 def _device_memory_collector(reg):
     """Snapshot-time pull of per-device allocator stats. Never triggers
-    backend init: only reads when jax is already imported, and CPU
-    backends that report no memory_stats() contribute nothing."""
+    backend init: only reads when jax is already imported. Only
+    ADDRESSABLE devices are polled — on a multi-host mesh the remote
+    devices' stats belong to their own process's telemetry, and
+    querying them raises."""
     if "jax" not in sys.modules:
         return
     import jax
     try:
-        devs = jax.devices()
+        devs = jax.local_devices()
     except Exception:  # noqa: BLE001 — backend init can fail headless
         return
     peak = reg.gauge("mx_device_mem_peak_bytes",
@@ -146,6 +159,7 @@ def _device_memory_collector(reg):
     used = reg.gauge("mx_device_mem_bytes_in_use",
                      "allocator bytes currently live per device",
                      labelnames=("device",))
+    found = set()
     for d in devs:
         stats_fn = getattr(d, "memory_stats", None)
         try:
@@ -155,9 +169,62 @@ def _device_memory_collector(reg):
         if not stats:
             continue
         dev = "%s:%d" % (d.platform, d.id)
+        found.add(dev)
         peak.labels(device=dev).set_max(
             stats.get("peak_bytes_in_use", 0))
         used.labels(device=dev).set(stats.get("bytes_in_use", 0))
+    _devices_with_stats[0] = frozenset(found)
+
+
+# -- live-array census ------------------------------------------------------
+def _memory_census_collector(reg):
+    """Snapshot-time live-array census: per-device, per-role live
+    bytes from ``profiling.memory.live_census`` (shard metadata only —
+    no device sync). Devices whose allocator exposes no stats (every
+    CPU-mesh device) additionally get their ``mx_device_mem_*`` gauges
+    backfilled from the census, so a multi-device mesh reports true
+    per-device values instead of nothing or a process aggregate."""
+    if "jax" not in sys.modules:
+        return
+    from ..profiling import memory as _mem
+    stats_devs = _devices_with_stats[0]
+    # zero existing census-fed series FIRST, before the enabled gate:
+    # a role/device that emptied since the last snapshot — or a gate
+    # flipped off mid-run — must read 0, not its stale value. find()
+    # (not gauge()) so a disabled process never creates the families
+    for name in ("mx_memory_live_bytes", "mx_memory_live_arrays"):
+        fam = reg.find(name)
+        if fam is not None:
+            for s in fam.series():
+                s.set(0)
+    fam = reg.find("mx_device_mem_bytes_in_use")
+    if fam is not None:
+        for s in fam.series():
+            if s.labels.get("device") not in stats_devs:
+                s.set(0)  # backfilled device: same staleness rule
+    if not _mem.census_enabled():
+        return
+    doc = _mem.live_census()
+    live = reg.gauge("mx_memory_live_bytes",
+                     "live device-array bytes per device and census "
+                     "role", labelnames=("device", "role"))
+    cnt = reg.gauge("mx_memory_live_arrays",
+                    "live device arrays per census role",
+                    labelnames=("role",))
+    for role, r in doc["by_role"].items():
+        cnt.labels(role=role).set(r["arrays"])
+    peak = reg.gauge("mx_device_mem_peak_bytes",
+                     "allocator high-water mark per device",
+                     labelnames=("device",))
+    used = reg.gauge("mx_device_mem_bytes_in_use",
+                     "allocator bytes currently live per device",
+                     labelnames=("device",))
+    for dev, d in doc["by_device"].items():
+        for role, nb in d["by_role"].items():
+            live.labels(device=dev, role=role).set(nb)
+        if dev not in stats_devs:
+            used.labels(device=dev).set(d["total_bytes"])
+            peak.labels(device=dev).set_max(d["total_bytes"])
 
 
 # -- periodic flush ---------------------------------------------------------
@@ -231,9 +298,12 @@ def stop_flusher():
         fl.stop()
 
 
-# the collector is pull-only and jax-free until devices exist — always
-# registered so a late set_enabled(True) still reports memory
+# the collectors are pull-only and jax-free until devices exist —
+# always registered so a late set_enabled(True) still reports memory.
+# Order matters: the allocator pass records which devices have real
+# stats, then the census pass backfills the rest
 registry().register_collector(_device_memory_collector)
+registry().register_collector(_memory_census_collector)
 if enabled():
     # listener import touches jax; a disabled start (MXTPU_TELEMETRY=0,
     # e.g. tools/telemetry_dump.py's standalone load) must stay light

@@ -106,17 +106,19 @@ def dump(path, fmt="json", snap=None):
 
 
 def merge_chrome_trace(snap=None, events=None, spans=None,
-                       attribution=None):
+                       attribution=None, memory=None):
     """One chrome://tracing document carrying every observability
     layer: the profiler's trace events, the tracing spans (causal
     layer, PR 5), the metric snapshot — counters/gauges as 'C'
     samples on the same clock, the full snapshot under metadata —
     and, when ``attribution`` is a profiling ledger/attribution
     document (PR 6), its ranked per-op rows as a flame strip on a
-    dedicated pid plus the raw document under metadata. All layers
-    share tracing.clock's process epoch, so they land on one Perfetto
-    time axis. ``spans`` defaults to the process's recorded spans;
-    pass [] to omit them."""
+    dedicated pid plus the raw document under metadata. ``memory``
+    (PR 7) takes a live-array census document — or ``True`` to take
+    one now — rendered as per-role/per-device counter tracks. All
+    layers share tracing.clock's process epoch, so they land on one
+    Perfetto time axis. ``spans`` defaults to the process's recorded
+    spans; pass [] to omit them."""
     snap = snap if snap is not None else snapshot()
     from .. import profiler
     from .. import tracing as _tracing
@@ -143,12 +145,24 @@ def merge_chrome_trace(snap=None, events=None, spans=None,
             for k in ("kind", "module", "totals", "reconciliation",
                       "mfu", "peak_tflops", "peak_hbm_gbs")
             if k in attribution}
+    if memory is not None:
+        if memory is True:
+            from ..profiling import memory as _mem
+            memory = _mem.live_census(top=10)
+        merged.extend(_tracing.export.memory_counter_events(
+            memory, ts=ts))
+        metadata["memory"] = {
+            k: memory.get(k)
+            for k in ("kind", "total_bytes", "arrays", "by_role",
+                      "by_device") if k in memory}
     return {"traceEvents": merged, "displayTimeUnit": "ms",
             "metadata": metadata}
 
 
-def dump_chrome_trace(path, snap=None, events=None, attribution=None):
-    trace = merge_chrome_trace(snap, events, attribution=attribution)
+def dump_chrome_trace(path, snap=None, events=None, attribution=None,
+                      memory=None):
+    trace = merge_chrome_trace(snap, events, attribution=attribution,
+                               memory=memory)
     _atomic_text(path, json.dumps(trace))
     return trace
 

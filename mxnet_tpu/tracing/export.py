@@ -153,6 +153,30 @@ def attribution_events(attrib_doc, pid=90, tid=0):
     return events
 
 
+def memory_counter_events(census_doc, pid=91, ts=0.0):
+    """A live-array census (``profiling.memory.live_census`` document)
+    rendered as Perfetto counter tracks: one stacked 'C' counter of
+    live bytes by role, plus one counter per device with its total —
+    the memory analogue of :func:`attribution_events`. ``ts`` places
+    the sample on the shared clock (callers pass the profiler's
+    now)."""
+    events = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+               "args": {"name": "HBM live bytes (census)"}}]
+    by_role = census_doc.get("by_role", {})
+    if by_role:
+        events.append({
+            "name": "mx_memory_live_bytes", "ph": "C", "ts": ts,
+            "pid": pid,
+            "args": {role: r.get("bytes", 0)
+                     for role, r in sorted(by_role.items())}})
+    for dev, d in sorted(census_doc.get("by_device", {}).items()):
+        events.append({
+            "name": "mx_memory_live_bytes[%s]" % dev, "ph": "C",
+            "ts": ts, "pid": pid,
+            "args": {"bytes": d.get("total_bytes", 0)}})
+    return events
+
+
 def chrome_events(spans, pid=0, offset_ns=0, base_ns=None):
     """Span dicts -> chrome-trace 'X' events. ``offset_ns`` is added to
     every timestamp (clock alignment); ``base_ns`` is the zero point

@@ -63,9 +63,9 @@ def test_sync_batch_norm_cross_device_matches_global():
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
 
     from mxnet_tpu.ops import registry
+    from mxnet_tpu.parallel import shard_map
 
     sbn = registry.get("_contrib_SyncBatchNorm").fn
     ndev = jax.device_count()

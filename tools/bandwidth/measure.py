@@ -32,8 +32,9 @@ def measure_allreduce(size, iters=20, warmup=3):
     def local_sum(x):
         return jax.lax.psum(x, "x")
 
-    fn = jax.jit(jax.shard_map(local_sum, mesh=mesh,
-                               in_specs=P("x"), out_specs=P()))
+    from mxnet_tpu.parallel import shard_map
+    fn = jax.jit(shard_map(local_sum, mesh=mesh,
+                           in_specs=P("x"), out_specs=P()))
     reduce_fn = jax.jit(lambda t: jnp.sum(t))
 
     x = jax.device_put(jnp.ones((n, size), jnp.float32),

@@ -10,6 +10,9 @@ Compares a bench artifact against the committed last-good measurement
 per-metric tolerances. The artifact may be any of the shapes the
 bench pipeline produces: a driver round file ({"parsed": {...}}), a
 raw result line (dict), or a last-good wrapper ({"line": "..."}).
+A ``memory`` section additionally gates the per-stage static peak
+live bytes embedded by the cost-ledger pass (growth beyond
+``--mem-tol`` is the regression — direction inverted vs throughput).
 
 Exit codes:
   0  within tolerance (stale artifacts pass with a warning — the
@@ -67,8 +70,50 @@ def load_artifact(path):
         return parse_artifact(json.load(f))
 
 
+def _stage_memory(doc):
+    """{stage: peak_live_mb} from an artifact's embedded cost-ledger
+    stage summaries (PR 7: bench_ledger attaches a bounded memory
+    section per stage)."""
+    out = {}
+    stages = (doc.get("cost_ledger") or {}).get("stages") or {}
+    for stage, s in stages.items():
+        if not isinstance(s, dict):
+            continue
+        memory = s.get("memory")
+        if isinstance(memory, dict) and \
+                isinstance(memory.get("peak_live_mb"), (int, float)):
+            out[stage] = float(memory["peak_live_mb"])
+    return out
+
+
+def gate_memory(candidate, last_good, mem_tolerance=0.15):
+    """(rc, [messages]) for the memory section: per-stage static peak
+    live bytes must not GROW beyond tolerance (direction inverted vs
+    the throughput metrics — more resident bytes is the regression;
+    arXiv 2004.13336's point is exactly that the bytes, not the math,
+    are the scaling ceiling)."""
+    rc = 0
+    msgs = []
+    mine, good = _stage_memory(candidate), _stage_memory(last_good)
+    for stage in sorted(set(mine) & set(good)):
+        a, b = good[stage], mine[stage]
+        if a <= 0:
+            continue
+        if b > (1.0 + mem_tolerance) * a:
+            rc = 1
+            msgs.append(
+                "REGRESSION memory[%s]: peak live %.2fMB > %.2fMB "
+                "(last good %.2fMB, tolerance %.0f%%)"
+                % (stage, b, (1.0 + mem_tolerance) * a, a,
+                   mem_tolerance * 100))
+        else:
+            msgs.append("memory[%s]: peak live %.2fMB vs %.2fMB (ok)"
+                        % (stage, b, a))
+    return rc, msgs
+
+
 def gate(candidate, last_good, tolerance=0.25, per_metric=None,
-         metrics=_DEFAULT_METRICS):
+         metrics=_DEFAULT_METRICS, mem_tolerance=0.15):
     """(exit_code, [messages]) for a candidate vs last-good pair."""
     per_metric = per_metric or {}
     msgs = []
@@ -112,6 +157,10 @@ def gate(candidate, last_good, tolerance=0.25, per_metric=None,
                         % (key, b, (1.0 - tol) * a, tol * 100))
         else:
             msgs.append("%s: %.4g vs %.4g (ok)" % (key, b, a))
+    mem_rc, mem_msgs = gate_memory(candidate, last_good,
+                                   mem_tolerance=mem_tolerance)
+    rc = rc or mem_rc
+    msgs.extend(mem_msgs)
     return rc, msgs
 
 
@@ -127,6 +176,9 @@ def main(argv=None):
     ap.add_argument("--tol", action="append", default=[],
                     metavar="METRIC=FRAC",
                     help="per-metric tolerance override (repeatable)")
+    ap.add_argument("--mem-tol", type=float, default=0.15,
+                    help="allowed fractional GROWTH of per-stage peak "
+                         "live bytes (memory section; 0.15)")
     args = ap.parse_args(argv)
     per_metric = {}
     for spec in args.tol:
@@ -154,7 +206,7 @@ def main(argv=None):
               % (args.last_good, e), file=sys.stderr)
         return 2
     rc, msgs = gate(candidate, last_good, tolerance=args.tolerance,
-                    per_metric=per_metric)
+                    per_metric=per_metric, mem_tolerance=args.mem_tol)
     for m in msgs:
         print(m)
     print("perf_gate: %s"
