@@ -156,6 +156,22 @@ def load_imgdec():
         ctypes.c_int,                               # nthreads
         ctypes.c_char_p, ctypes.c_int,              # errbuf
     ]
+    # slice variant: decode records [i0, i1) into an absolutely-indexed
+    # out buffer (several pools can fill disjoint slices of one batch)
+    lib.mxtpu_decode_batch_slice.restype = ctypes.c_int
+    lib.mxtpu_decode_batch_slice.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p),            # bufs
+        ctypes.POINTER(ctypes.c_int64),             # lens
+        ctypes.c_int, ctypes.c_int,                 # i0, i1
+        ctypes.c_int, ctypes.c_int,                 # th, tw
+        ctypes.POINTER(ctypes.c_float),             # rand_uv
+        ctypes.POINTER(ctypes.c_uint8),             # mirror
+        ctypes.POINTER(ctypes.c_float),             # mean
+        ctypes.POINTER(ctypes.c_float),             # std
+        ctypes.POINTER(ctypes.c_float),             # out
+        ctypes.c_int,                               # nthreads
+        ctypes.c_char_p, ctypes.c_int,              # errbuf
+    ]
     lib.mxtpu_jpeg_dims.restype = ctypes.c_int
     lib.mxtpu_jpeg_dims.argtypes = [
         ctypes.c_char_p, ctypes.c_int64,
@@ -196,7 +212,8 @@ def decode_jpeg(payload):
     return out
 
 
-def decode_batch(payloads, th, tw, uv, mirror, mean, std, nthreads=None):
+def decode_batch(payloads, th, tw, uv, mirror, mean, std, nthreads=None,
+                 out=None, start=0, stop=None):
     """Decode+crop+mirror+normalize a whole batch of JPEG payloads
     through the C++ libjpeg thread pool into (n, 3, th, tw) float32 —
     the reference's OMP batch pipeline shape (ref:
@@ -206,7 +223,12 @@ def decode_batch(payloads, th, tw, uv, mirror, mean, std, nthreads=None):
 
     ``uv``: (n, 2) float32 crop offsets in [0,1), negative = center;
     ``mirror``: (n,) uint8; ``mean``/``std``: 3 floats each applied to
-    the RAW 0..255 pixel values."""
+    the RAW 0..255 pixel values.
+
+    ``out`` lets the caller own the destination (e.g. a shared-memory
+    ring slot) instead of a fresh pooled buffer; ``start``/``stop``
+    decode only records ``[start, stop)`` — out is indexed absolutely,
+    so disjoint slices of one batch can be filled by separate calls."""
     import numpy as np
 
     from ..base import MXNetError
@@ -215,6 +237,10 @@ def decode_batch(payloads, th, tw, uv, mirror, mean, std, nthreads=None):
     if lib is None:
         return None
     n = len(payloads)
+    stop = n if stop is None else int(stop)
+    if not 0 <= start <= stop <= n:
+        raise MXNetError(f"decode_batch: invalid slice [{start}, {stop}) "
+                         f"of {n} records")
     if nthreads is None:
         nthreads = int(os.environ.get("MXNET_CPU_WORKER_NTHREADS",
                                       str(os.cpu_count() or 4)))
@@ -222,15 +248,20 @@ def decode_batch(payloads, th, tw, uv, mirror, mean, std, nthreads=None):
     mirror = np.ascontiguousarray(mirror, np.uint8)
     mean = np.ascontiguousarray(mean, np.float32).ravel()
     std = np.ascontiguousarray(std, np.float32).ravel()
-    out = pooled_empty((n, 3, th, tw), np.float32)
+    if out is None:
+        out = pooled_empty((n, 3, th, tw), np.float32)
+    elif out.shape != (n, 3, th, tw) or out.dtype != np.float32 \
+            or not out.flags["C_CONTIGUOUS"]:
+        raise MXNetError("decode_batch: out must be C-contiguous "
+                         f"float32 {(n, 3, th, tw)}")
     bufs = (ctypes.c_char_p * n)(*payloads)
     lens = (ctypes.c_int64 * n)(*[len(p) for p in payloads])
     errbuf = ctypes.create_string_buffer(512)
     fptr = ctypes.POINTER(ctypes.c_float)
-    rc = lib.mxtpu_decode_batch(
+    rc = lib.mxtpu_decode_batch_slice(
         ctypes.cast(bufs, ctypes.POINTER(ctypes.c_char_p)),
         ctypes.cast(lens, ctypes.POINTER(ctypes.c_int64)),
-        n, th, tw,
+        int(start), int(stop), th, tw,
         uv.ctypes.data_as(fptr),
         mirror.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         mean.ctypes.data_as(fptr),

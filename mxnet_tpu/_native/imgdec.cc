@@ -72,12 +72,17 @@ bool decode_rgb(const uint8_t* buf, size_t len, std::vector<uint8_t>* px,
 
 }  // namespace
 
-extern "C" int mxtpu_decode_batch(
-    const uint8_t* const* bufs, const int64_t* lens, int n,
-    int th, int tw, const float* rand_uv, const uint8_t* mirror,
-    const float* mean, const float* stdv, float* out, int nthreads,
-    char* errbuf, int errbuf_len) {
-  std::atomic<int> next(0);
+// Decode records [i0, i1) of a batch into `out`, which is indexed
+// ABSOLUTELY by record position — several pools (or a retry) can fill
+// disjoint slices of one batch buffer concurrently. This is the seam
+// the sharded pipeline's worker processes call with the slot view of
+// their shared-memory ring as `out`.
+static int decode_slice(const uint8_t* const* bufs, const int64_t* lens,
+                        int i0, int i1, int th, int tw,
+                        const float* rand_uv, const uint8_t* mirror,
+                        const float* mean, const float* stdv, float* out,
+                        int nthreads, char* errbuf, int errbuf_len) {
+  std::atomic<int> next(i0);
   std::atomic<bool> failed(false);
   std::string first_err;
   std::mutex err_mu;
@@ -86,7 +91,7 @@ extern "C" int mxtpu_decode_batch(
     std::vector<uint8_t> px;
     while (true) {
       int i = next.fetch_add(1);
-      if (i >= n || failed.load()) return;
+      if (i >= i1 || failed.load()) return;
       int ih = 0, iw = 0;
       std::string err;
       if (!decode_rgb(bufs[i], size_t(lens[i]), &px, &ih, &iw, &err)) {
@@ -131,7 +136,7 @@ extern "C" int mxtpu_decode_batch(
   };
 
   int nt = nthreads < 1 ? 1 : nthreads;
-  if (nt > n) nt = n;
+  if (nt > i1 - i0) nt = i1 - i0;
   std::vector<std::thread> pool;
   pool.reserve(nt);
   for (int t = 0; t < nt; ++t) pool.emplace_back(worker);
@@ -142,6 +147,28 @@ extern "C" int mxtpu_decode_batch(
     return -1;
   }
   return 0;
+}
+
+extern "C" int mxtpu_decode_batch(
+    const uint8_t* const* bufs, const int64_t* lens, int n,
+    int th, int tw, const float* rand_uv, const uint8_t* mirror,
+    const float* mean, const float* stdv, float* out, int nthreads,
+    char* errbuf, int errbuf_len) {
+  return decode_slice(bufs, lens, 0, n, th, tw, rand_uv, mirror, mean,
+                      stdv, out, nthreads, errbuf, errbuf_len);
+}
+
+extern "C" int mxtpu_decode_batch_slice(
+    const uint8_t* const* bufs, const int64_t* lens, int i0, int i1,
+    int th, int tw, const float* rand_uv, const uint8_t* mirror,
+    const float* mean, const float* stdv, float* out, int nthreads,
+    char* errbuf, int errbuf_len) {
+  if (i0 < 0 || i1 < i0) {
+    snprintf(errbuf, errbuf_len, "invalid slice [%d, %d)", i0, i1);
+    return -1;
+  }
+  return decode_slice(bufs, lens, i0, i1, th, tw, rand_uv, mirror, mean,
+                      stdv, out, nthreads, errbuf, errbuf_len);
 }
 
 // ---------------------------------------------------------------------------

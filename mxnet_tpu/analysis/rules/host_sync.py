@@ -31,6 +31,13 @@ _SCOPES = (
      {"push", "pull", "row_sparse_pull", "pushpull",
       "_push_impl", "_pull_impl"}, set()),
     ("mxnet_tpu/metric.py", {"update"}, {"_as_np"}),
+    # the input pipeline's per-batch paths: parent-side ring pulls and
+    # the device feeder run once per training batch — a sync here
+    # serializes host decode against device compute, the exact overlap
+    # the pipeline exists to create (io/pipeline.py)
+    ("mxnet_tpu/io/pipeline.py",
+     {"next", "_pull", "_release", "iter_next", "get", "_feed",
+      "_to_device", "to_device"}, set()),
     # the telemetry recorders themselves run inside every hot path
     # above — a sync hiding in inc()/observe()/step_boundary() would
     # stall each instrumented seam at once. Drains are read-time only

@@ -78,11 +78,26 @@ def default_batchify_fn(data):
 
 
 class DataLoader:
+    """``num_workers`` with the default ``thread_pool=True`` keeps the
+    in-process executor above; ``thread_pool=False`` routes
+    native-mappable datasets to the multi-process sharded decode
+    pipeline (io/pipeline.py) — worker PROCESSES with private libjpeg
+    pools feeding a shared-memory ring, the production path for
+    many-core hosts where the GIL caps the thread loader.
+
+    ``prefetch_to_device=True`` double-buffers device transfer: a
+    feeder thread ``jax.device_put``s batch k+1 while step k runs
+    (defaults to the ``MXTPU_IO_PREFETCH_DEVICE`` knob).
+    ``pin_memory=True`` routes to the same feeder — on TPU hosts the
+    honest meaning of "pin" is staging the batch onto the device ahead
+    of the step; it was previously accepted and silently ignored."""
+
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, prefetch=None,
-                 thread_pool=True):
+                 thread_pool=True, prefetch_to_device=None, sharding=None):
         self._dataset = dataset
+        custom_order = sampler is not None or batch_sampler is not None
         if batch_sampler is None:
             if batch_size is None:
                 raise ValueError("batch_size required when no batch_sampler")
@@ -101,6 +116,25 @@ class DataLoader:
         self._native = None
         if batchify_fn is None:
             self._native = self._compile_native(dataset)
+        from ...base import get_env
+        if pin_memory and prefetch_to_device is None:
+            import warnings
+            warnings.warn(
+                "DataLoader(pin_memory=True) routes to the device "
+                "feeder on this backend (prefetch_to_device): batches "
+                "are staged onto the device ahead of the step instead "
+                "of into pinned host pages", stacklevel=2)
+            prefetch_to_device = True
+        if prefetch_to_device is None:
+            prefetch_to_device = get_env("MXTPU_IO_PREFETCH_DEVICE",
+                                         False, bool)
+        self._prefetch_device = bool(prefetch_to_device)
+        self._sharding = sharding
+        self._mp_pipeline = None
+        self._mp_config = None
+        if not thread_pool and self._num_workers > 0 and not custom_order:
+            self._mp_config = self._compile_multiprocess(
+                dataset, batch_size, shuffle)
 
     def _compile_native(self, dataset):
         """(source dataset, plan) when the dataset chain is
@@ -121,6 +155,51 @@ class DataLoader:
         if plan is None:
             return None
         return src, plan
+
+    def _compile_multiprocess(self, dataset, batch_size, shuffle):
+        """Pipeline construction kwargs when the dataset shape maps
+        onto the sharded decode pipeline exactly; None falls back to
+        the thread executor. Requirements: a native-mappable
+        ImageRecordDataset chain (same check as the C++ batch path), the
+        auto-built sequential/random sampler (a custom sampler owns its
+        own order — the pipeline shards its own), and a record count
+        divisible by workers*batch so every record is delivered exactly
+        once per epoch (the pipeline's discard-tail semantics would
+        otherwise diverge from last_batch="keep")."""
+        if self._native is None or batch_size is None:
+            return None
+        src, plan = self._native
+        n = len(dataset)
+        if n % (self._num_workers * batch_size) != 0:
+            return None
+        # seed DERIVED from (not drawn from) the global RNG state:
+        # deterministic under np.random.seed like RandomSampler, but
+        # constructing the loader consumes no draws — an mp loader and
+        # a thread loader leave the user's RNG stream identical
+        seed = int(np.random.get_state()[1][0]) & 0x7FFFFFFF
+        return {
+            "path_imgrec": src._record.uri,
+            "data_shape": (3, plan["th"], plan["tw"]),
+            "batch_size": int(batch_size),
+            "num_workers": self._num_workers,
+            "shuffle": bool(shuffle),
+            "rand_mirror": bool(plan["flip"]),
+            "mean": plan["mean"], "std": plan["std"],
+            "seed": seed,
+        }
+
+    def close(self):
+        """Tear down the worker processes + shared memory (also runs
+        from __del__; iterating again respawns them)."""
+        if self._mp_pipeline is not None:
+            self._mp_pipeline.close()
+            self._mp_pipeline = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter shutdown
+            pass
 
     def __len__(self):
         return len(self._batch_sampler)
@@ -171,7 +250,21 @@ class DataLoader:
             lab = lab[:, 0]    # per-item path
         return [array(out), array(lab)]
 
-    def __iter__(self):
+    def _iter_multiprocess(self):
+        """One epoch off the sharded pipeline: [data, label] batches,
+        worker processes kept alive across epochs."""
+        from ...io.pipeline import ShardedRecordPipeline
+        if self._mp_pipeline is None:
+            self._mp_pipeline = ShardedRecordPipeline(**self._mp_config)
+        else:
+            self._mp_pipeline.reset()
+        for batch in self._mp_pipeline:
+            yield [batch.data[0], batch.label[0]]
+
+    def _iter_batches(self):
+        if self._mp_config is not None:
+            yield from self._iter_multiprocess()
+            return
         if self._num_workers == 0:
             for indices in self._batch_sampler:
                 yield self._load_batch(indices)
@@ -191,3 +284,23 @@ class DataLoader:
                 except StopIteration:
                     pass
                 yield batch
+
+    def __iter__(self):
+        if not self._prefetch_device:
+            yield from self._iter_batches()
+            return
+        # double-buffered device prefetch: the feeder thread device_puts
+        # batch k+1 while the training step consumes batch k; the
+        # residual queue wait is charged to the step breakdown's
+        # data_time (io/pipeline.py DeviceFeeder)
+        from ...io.pipeline import DeviceFeeder
+        feeder = DeviceFeeder(self._iter_batches(),
+                              sharding=self._sharding)
+        try:
+            while True:
+                try:
+                    yield feeder.get()
+                except StopIteration:
+                    return
+        finally:
+            feeder.close()

@@ -2,3 +2,4 @@
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
                  PrefetchingIter, CSVIter, MNISTIter, LibSVMIter,
                  ImageRecordIter)
+from .pipeline import DeviceFeeder, ShardedRecordPipeline

@@ -381,10 +381,19 @@ class PrefetchingIter(DataIter):
     """Background-thread prefetch over one or more iterators
     (ref: io.py PrefetchingIter; C++ PrefetcherIter
     src/io/iter_prefetcher.h:47). Overlaps host-side batch assembly
-    with device compute."""
+    with device compute.
+
+    ``prefetch_to_device=True`` turns the producer into a DEVICE
+    feeder: batch k+1 is ``jax.device_put`` (honoring ``sharding``
+    when given) while step k executes — double-buffered H2D proven by
+    the per-step telemetry breakdown (``mx_step_data_seconds``
+    collapses when the overlap works; docs/io.md shows the
+    ``telemetry_dump --diff`` recipe).
+    """
 
     def __init__(self, iters, rename_data=None, rename_label=None,
-                 prefetch_depth=2):
+                 prefetch_depth=2, prefetch_to_device=False,
+                 sharding=None):
         if not isinstance(iters, (list, tuple)):
             iters = [iters]
         super().__init__(iters[0].batch_size)
@@ -392,14 +401,31 @@ class PrefetchingIter(DataIter):
         self.rename_data = rename_data
         self.rename_label = rename_label
         self._depth = prefetch_depth
+        self._to_device = prefetch_to_device
+        self._sharding = sharding
         self._queue = None
         self._thread = None
+        # checkpoint passthrough: the producer runs AHEAD of the
+        # consumer, so the inner iterators' own positions overcount by
+        # the in-flight batches. Resume state is therefore (inner state
+        # at epoch start, batches DELIVERED to the caller); resume
+        # replays the delivered count through the same machinery
+        self._inner_state0 = self._capture_inner()
+        self._delivered = 0
         self._start()
+
+    def _capture_inner(self):
+        try:
+            return [it.state_dict() for it in self.iters]
+        except MXNetError:
+            return None   # inner doesn't checkpoint; state_dict raises
 
     def _start(self):
         q = queue.Queue(maxsize=self._depth)
         stop = threading.Event()
         self._queue, self._stop = q, stop
+        to_device = self._to_device
+        sharding = self._sharding
 
         def producer():
             # closes over ITS OWN queue/stop — a lingering producer from
@@ -407,8 +433,14 @@ class PrefetchingIter(DataIter):
             while not stop.is_set():
                 try:
                     batches = [it.next() for it in self.iters]
+                    if to_device:
+                        from .pipeline import to_device as _put
+                        batches = [_put(b, sharding) for b in batches]
                 except StopIteration:
                     q.put(None)
+                    return
+                except Exception as e:  # noqa: BLE001 — surface at next()
+                    q.put(e)
                     return
                 q.put(batches)
 
@@ -431,7 +463,7 @@ class PrefetchingIter(DataIter):
                      for d in i.provide_label]
                     for r, i in zip(self.rename_label, self.iters)], [])
 
-    def reset(self):
+    def _stop_producer(self):
         self._stop.set()
         # drain until the producer exits — it may be blocked on put()
         while self._thread.is_alive():
@@ -441,14 +473,22 @@ class PrefetchingIter(DataIter):
             except queue.Empty:
                 pass
             self._thread.join(timeout=0.2)
+
+    def reset(self):
+        self._stop_producer()
         for it in self.iters:
             it.reset()
+        self._inner_state0 = self._capture_inner()
+        self._delivered = 0
         self._start()
 
     def next(self):
         batches = self._queue.get()
         if batches is None:
             raise StopIteration
+        if isinstance(batches, Exception):
+            raise batches
+        self._delivered += 1
         if len(batches) == 1:
             return batches[0]
         return DataBatch(
@@ -458,6 +498,41 @@ class PrefetchingIter(DataIter):
 
     def iter_next(self):
         raise NotImplementedError("use next()")
+
+    def state_dict(self):
+        """Resumable position with in-flight prefetched batches
+        accounted for: inner state from the LAST epoch boundary plus
+        the count of batches the caller actually received. The
+        producer's lookahead is deliberately NOT part of the state —
+        those batches were never consumed, and resume regenerates them
+        exactly (same inner state, same delivery order)."""
+        if self._inner_state0 is None:
+            raise MXNetError(
+                "PrefetchingIter cannot checkpoint: the wrapped "
+                f"iterator {type(self.iters[0]).__name__} does not "
+                "support state_dict")
+        return {"version": 1, "type": "PrefetchingIter",
+                "inner0": self._inner_state0,
+                "delivered": int(self._delivered)}
+
+    def load_state_dict(self, state):
+        if not isinstance(state, dict) or \
+                state.get("type") != "PrefetchingIter" or \
+                state.get("version") != 1:
+            raise MXNetError(
+                "load_state_dict: not a version-1 PrefetchingIter state")
+        self._stop_producer()
+        delivered = int(state["delivered"])
+        for it, st in zip(self.iters, state["inner0"]):
+            it.load_state_dict(st)
+        self._inner_state0 = state["inner0"]
+        self._delivered = 0
+        self._start()
+        # replay the delivered prefix through the normal path: the
+        # discarded batches are the ones the pre-checkpoint run already
+        # trained on, so the next() after this resumes bit-identically
+        for _ in range(delivered):
+            self.next()
 
 
 
@@ -637,12 +712,54 @@ class ImageRecordIter(DataIter):
 
     _SENTINEL = object()
 
+    def __new__(cls, path_imgrec=None, data_shape=None, batch_size=1,
+                label_width=1, shuffle=False, rand_crop=False,
+                rand_mirror=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                std_r=1.0, std_g=1.0, std_b=1.0, resize=-1,
+                round_batch=True, preprocess_threads=4, prefetch_buffer=2,
+                seed=0, num_workers=None, **kwargs):
+        """``num_workers > 0`` (or ``MXTPU_IO_WORKERS``) routes to the
+        multi-process sharded decode pipeline — same record format and
+        augment semantics, N worker processes each driving a private
+        libjpeg pool into a shared-memory ring (io/pipeline.py). The
+        in-process iterator below remains the resize= / num_workers=0
+        path."""
+        from .pipeline import ShardedRecordPipeline, io_workers_default
+        if num_workers is None:
+            num_workers = io_workers_default()
+        if num_workers and int(num_workers) > 0 and resize <= 0:
+            from ..recordio import load_record_offsets
+            offsets = load_record_offsets(path_imgrec)
+            if len(offsets) % (int(num_workers) * batch_size) == 0:
+                return ShardedRecordPipeline(
+                    path_imgrec, data_shape, batch_size=batch_size,
+                    num_workers=int(num_workers),
+                    label_width=label_width,
+                    shuffle=shuffle, rand_crop=rand_crop,
+                    rand_mirror=rand_mirror,
+                    mean=(mean_r, mean_g, mean_b),
+                    std=(std_r, std_g, std_b),
+                    seed=seed,
+                    streaming=bool(kwargs.get("streaming", False)),
+                    readahead_mb=kwargs.get("readahead_mb"),
+                    ring_batches=kwargs.get("ring_batches"),
+                    offsets=offsets)
+            import warnings
+            warnings.warn(
+                f"ImageRecordIter: {len(offsets)} records do not divide "
+                f"into num_workers={num_workers} x batch_size="
+                f"{batch_size} — the sharded pipeline would silently "
+                "drop the remainder each epoch, falling back to the "
+                "in-process iterator (pad the .rec or adjust "
+                "workers/batch to engage the pipeline)", stacklevel=2)
+        return super().__new__(cls)
+
     def __init__(self, path_imgrec, data_shape, batch_size=1,
                  label_width=1, shuffle=False, rand_crop=False,
                  rand_mirror=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
                  std_r=1.0, std_g=1.0, std_b=1.0, resize=-1,
                  round_batch=True, preprocess_threads=4, prefetch_buffer=2,
-                 seed=0, **kwargs):
+                 seed=0, num_workers=None, **kwargs):
         super().__init__(batch_size)
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
@@ -691,33 +808,10 @@ class ImageRecordIter(DataIter):
         self.reset()
 
     def _load_offsets(self, path):
-        """Record offsets from the .idx sidecar when present, else one
-        framing scan (seeks only — no payloads are retained)."""
-        idx_path = os.path.splitext(path)[0] + ".idx"
-        if os.path.isfile(idx_path):
-            offs = []
-            with open(idx_path) as f:
-                for line in f:
-                    parts = line.strip().split("\t")
-                    if len(parts) >= 2:
-                        offs.append(int(parts[1]))
-            if offs:
-                return offs
-        from ..recordio import _LFLAG_MASK, _MAGIC
-        offs = []
-        f = self._file
-        f.seek(0, 2)
-        end = f.tell()
-        pos = 0
-        while pos + 8 <= end:
-            f.seek(pos)
-            magic, lrec = struct.unpack("<II", f.read(8))
-            if magic != _MAGIC:
-                raise MXNetError(f"invalid RecordIO magic at {pos}")
-            offs.append(pos)
-            length = lrec & _LFLAG_MASK
-            pos += 8 + length + (4 - length % 4) % 4
-        return offs
+        """Record offsets: .idx sidecar or one framing scan (the
+        shared index loader the sharded pipeline also builds on)."""
+        from ..recordio import load_record_offsets
+        return load_record_offsets(path)
 
     def _read_at(self, off):
         from ..recordio import _LFLAG_MASK, _MAGIC

@@ -1,0 +1,620 @@
+"""Production input pipeline: multi-process sharded decode over a
+shared-memory batch ring, plus the double-buffered device feeder.
+
+The reference feeds its trainers from ONE fused OMP
+decode+augment+batch pipeline (src/io/iter_image_recordio_2.cc);
+a single Python process cannot reproduce that on a many-core host —
+the GIL serializes everything around the decode pool. This layer goes
+production-shaped instead:
+
+  ``ShardedRecordPipeline``  N decode WORKER PROCESSES, each owning a
+      disjoint shard of the record index and its own libjpeg pool,
+      writing decoded+augmented batches into a per-worker
+      shared-memory ring (``multiprocessing.shared_memory``) the
+      parent maps as zero-copy numpy views. Workers are plain
+      subprocesses running ``_pipeline_worker.py`` — they never import
+      jax or touch a PJRT client (fork/inherit hazards), and they
+      self-exit when the parent dies. A crashed worker is respawned
+      with its shard resumed from the last parent-acked batch; epoch
+      permutations and augment draws derive from ``(seed, epoch)`` so
+      the respawn is bit-exact.
+
+  ``DeviceFeeder``  double-buffered device prefetch: a feeder thread
+      ``jax.device_put``s batch k+1 (honoring an optional sharding)
+      while step k executes. The overlap is *measured* by the per-step
+      telemetry breakdown (``mx_step_data_seconds``), not asserted:
+      the feeder charges its queue-wait to the same seam
+      ``DataIter.__next__`` uses.
+
+Wired under ``io.ImageRecordIter(num_workers=N)`` and
+``gluon.data.DataLoader`` (``thread_pool=False`` + ``num_workers`` /
+``prefetch_to_device=True`` / ``pin_memory``). Knobs:
+``MXTPU_IO_WORKERS``, ``MXTPU_IO_RING_BATCHES``,
+``MXTPU_IO_READAHEAD_MB``, ``MXTPU_IO_PREFETCH_DEVICE``
+(libinfo._ENV_VARS, docs/io.md).
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import queue
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import weakref
+
+import numpy as np
+
+from ..base import MXNetError, get_env
+from ..ndarray import array
+from ..telemetry import metrics as _tm
+from . import _pipeline_worker as _pw
+from .io import DataBatch, DataDesc, DataIter
+
+_pipe_metrics = _tm.lazy_metrics(lambda reg: {
+    "batches": reg.counter(
+        "mx_io_pipeline_batches_total",
+        "batches consumed from the sharded decode ring").labels(),
+    "respawns": reg.counter(
+        "mx_io_pipeline_worker_respawns_total",
+        "decode worker processes respawned after a crash").labels(),
+    "ring_wait": reg.histogram(
+        "mx_io_pipeline_ring_wait_seconds",
+        "parent time blocked waiting for a ring slot").labels(),
+})
+
+_WORKER_SCRIPT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "_pipeline_worker.py")
+
+
+def io_workers_default():
+    """Worker-process count when the caller didn't choose: the
+    ``MXTPU_IO_WORKERS`` knob (0 = stay in-process)."""
+    return max(0, get_env("MXTPU_IO_WORKERS", 0, int))
+
+
+_device_put_aliases = None
+
+
+def device_put_aliases():
+    """Whether this backend's host->device conversion may still READ a
+    PAGE-ALIGNED host buffer after ``array()`` returns. Ring slots are
+    recycled, so any such backend forces one defensive host copy per
+    batch; only a provably-detaching backend keeps the ring zero-copy
+    end-to-end. Probed once, through the SAME ``ndarray.array`` path
+    ``next()`` uses (jnp.asarray and jax.device_put have different
+    zero-copy rules), with an mmap-backed view — a heap array would
+    probe the wrong alignment class. Two failure modes are checked:
+    outright aliasing (CPU jax zero-copies aligned arrays — a mutation
+    shows through) and a RETAINED REFERENCE (an async transfer may
+    borrow the source until the copy lands; if jax still holds the
+    buffer we must not recycle it)."""
+    global _device_put_aliases
+    if _device_put_aliases is None:
+        import mmap
+        import sys
+
+        mm = mmap.mmap(-1, 4096)
+        probe = np.frombuffer(mm, np.float32, count=512)
+        probe.flags.writeable = True
+        probe[:] = 0.0
+        refs0 = sys.getrefcount(probe)
+        dev = array(probe)._data
+        dev.block_until_ready()
+        probe[0] = 1.0
+        aliased = bool(np.asarray(dev[0]) == 1.0)
+        retained = sys.getrefcount(probe) > refs0
+        _device_put_aliases = aliased or retained
+        del dev, probe
+    return _device_put_aliases
+
+
+class _Worker:
+    """Parent-side handle for one decode worker: its shm ring, spec
+    file, process, and the consumed (acked) counter that doubles as
+    the respawn resume point."""
+
+    def __init__(self, wid, shm, views, spec_path):
+        self.wid = wid
+        self.shm = shm
+        self.views = views
+        self.spec_path = spec_path
+        self.proc = None
+        self.acked = 0        # batches this worker produced AND parent released
+
+
+class ShardedRecordPipeline(DataIter):
+    """Multi-process decode pipeline over a RecordIO file.
+
+    Shards are BATCH-striped over the per-epoch permutation: epoch
+    batch ``g`` covers ``perm[g*B:(g+1)*B]`` and belongs to worker
+    ``g % num_workers`` — disjoint, together covering every record
+    when ``n % (num_workers * batch_size) == 0`` (a remainder tail is
+    dropped — "discard" semantics), and round-robin delivery
+    reproduces the exact batch order a single-process iterator with
+    the same seed would emit, independent of worker count.
+
+    ``streaming=True`` switches workers to contiguous byte-range
+    shards read via chunked background readahead
+    (``MXTPU_IO_READAHEAD_MB``) — epoch-scale datasets stream from
+    disk/remote without local materialization, with shuffle applied
+    inside the readahead window.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 num_workers=None, label_width=1, shuffle=False,
+                 rand_crop=False, rand_mirror=False, mean=None, std=None,
+                 seed=0, ring_batches=None, streaming=False,
+                 readahead_mb=None, nthreads=None, decode_sleep=0.0,
+                 offsets=None):
+        super().__init__(batch_size)
+        if num_workers is None:
+            num_workers = io_workers_default() or 1
+        if num_workers < 1:
+            raise MXNetError("ShardedRecordPipeline needs num_workers >= 1")
+        c, th, tw = tuple(data_shape)
+        if c != 3:
+            raise MXNetError("pipeline decodes RGB only (data_shape[0]=3)")
+        self.data_shape = (c, th, tw)
+        self.label_width = int(label_width)
+        self.shuffle = bool(shuffle)
+        self._seed = int(seed)
+        self._path = path_imgrec
+        self._streaming = bool(streaming)
+        if offsets is None:
+            from ..recordio import load_record_offsets
+            offsets = load_record_offsets(path_imgrec)
+        self._num_records = len(offsets)
+        self._W = int(num_workers)
+        # batches per worker per epoch — batch-striped in random-access
+        # mode (delivery order == the single-process order), contiguous
+        # record ranges in streaming mode (must match _pipeline_worker
+        # _Shard exactly)
+        if self._streaming:
+            self._bw = (self._num_records // self._W) // batch_size
+        else:
+            self._bw = (self._num_records // batch_size) // self._W
+        if self._bw < 1:
+            raise MXNetError(
+                f"{self._num_records} records cannot fill one "
+                f"batch_size={batch_size} batch per worker with "
+                f"{self._W} workers")
+        self._epoch_batches = self._bw * self._W
+        nslots = ring_batches if ring_batches is not None else \
+            get_env("MXTPU_IO_RING_BATCHES", 3, int)
+        self._nslots = max(2, int(nslots))
+        self._layout = _pw.ring_layout(self._nslots, batch_size, th, tw,
+                                       self.label_width)
+        if nthreads is None:
+            nthreads = per_worker_pool_threads(self._W)
+        self._tmpdir = tempfile.mkdtemp(prefix="mxtpu_io_")
+        offsets_path = os.path.join(self._tmpdir, "offsets.npy")
+        np.save(offsets_path, np.asarray(offsets, np.int64))
+        mean = np.zeros(3, np.float32) if mean is None else \
+            np.broadcast_to(np.asarray(mean, np.float32).ravel(), (3,))
+        std = np.ones(3, np.float32) if std is None else \
+            np.broadcast_to(np.asarray(std, np.float32).ravel(), (3,))
+        self._spec_base = {
+            "rec_path": os.path.abspath(path_imgrec),
+            "offsets_path": offsets_path,
+            "num_workers": self._W, "batch_size": int(batch_size),
+            "ring_batches": self._nslots, "th": th, "tw": tw,
+            "label_width": self.label_width,
+            "shuffle": self.shuffle, "seed": self._seed,
+            "rand_crop": bool(rand_crop),
+            "rand_mirror": bool(rand_mirror),
+            "mean": [float(x) for x in mean],
+            "std": [float(x) for x in std],
+            "imgdec_lib": _imgdec_lib_path(),
+            "nthreads": int(nthreads),
+            "streaming": self._streaming,
+            "readahead_mb": float(
+                readahead_mb if readahead_mb is not None
+                else get_env("MXTPU_IO_READAHEAD_MB", 64, int)),
+            "decode_sleep": float(decode_sleep),
+            "parent_pid": os.getpid(),
+        }
+        self._workers = []
+        self._closed = False
+        self._epoch = 0          # epochs completed before the current one
+        self._cursor = 0         # batches delivered this epoch
+        self.respawns = 0
+        self._copy_views = device_put_aliases()
+        for w in range(self._W):
+            self._workers.append(self._make_worker(w))
+        for w in self._workers:
+            self._spawn(w, start_batch=0)
+        # LIFO atexit runs before the interpreter tears down threading
+        # primitives; a weakref keeps the hook from pinning the iterator
+        wr = weakref.ref(self)
+        self._atexit = lambda: wr() and wr().close()
+        atexit.register(self._atexit)
+
+    # ------------------------------------------------------------ setup
+
+    def _make_worker(self, wid):
+        from multiprocessing import shared_memory
+        shm = shared_memory.SharedMemory(
+            create=True, size=self._layout["total"])
+        views = _pw.map_views(shm.buf, self._layout)
+        views["header"][:] = 0
+        views["header"][_pw.H_MAGIC] = _pw.MAGIC
+        views["meta"][:] = 0
+        spec_path = os.path.join(self._tmpdir, f"worker{wid}.json")
+        return _Worker(wid, shm, views, spec_path)
+
+    def _spawn(self, worker, start_batch):
+        spec = dict(self._spec_base)
+        spec.update(worker_id=worker.wid, shm_name=worker.shm.name,
+                    start_batch=int(start_batch))
+        with open(worker.spec_path, "w") as f:
+            json.dump(spec, f)
+        h = worker.views["header"]
+        h[_pw.H_STOP] = 0
+        h[_pw.H_PRODUCED] = 0
+        worker.views["meta"][:, _pw.M_STATE] = _pw.EMPTY
+        worker.acked = int(start_batch)
+        # a plain subprocess, not multiprocessing: no fork of a process
+        # that may hold a PJRT client, no pickling, no inherited locks
+        worker.proc = subprocess.Popen(
+            [sys.executable, _WORKER_SCRIPT, worker.spec_path],
+            stdin=subprocess.DEVNULL)
+
+    # ---------------------------------------------------------- protocol
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = ((self.batch_size,) if self.label_width == 1
+                 else (self.batch_size, self.label_width))
+        return [DataDesc("softmax_label", shape)]
+
+    def __len__(self):
+        return self._epoch_batches
+
+    def reset(self):
+        """Open the next epoch. Workers stream batches continuously —
+        a reset at the epoch boundary costs nothing; an ABANDONING
+        reset (mid-epoch) realigns every worker to the next epoch's
+        start by respawn."""
+        if self._closed:
+            raise MXNetError("pipeline is closed")
+        if self._cursor == 0:
+            return
+        if self._cursor >= self._epoch_batches:
+            self._epoch += 1
+            self._cursor = 0
+            return
+        self._epoch += 1
+        self._cursor = 0
+        for w in self._workers:
+            self._stop_worker(w)
+            self._spawn(w, start_batch=self._epoch * self._bw)
+
+    def next(self):
+        if self._cursor >= self._epoch_batches:
+            raise StopIteration
+        w = self._workers[self._cursor % self._W]
+        gidx = self._epoch * self._bw + self._cursor // self._W
+        slot, data, label = self._pull(w, gidx)
+        if self.label_width == 1:
+            label = label[:, 0]
+        # device copy happens HERE (array -> device_put); only then may
+        # the ring slot be recycled — releasing first would let the
+        # worker overwrite bytes mid-transfer
+        batch = DataBatch(data=[array(data)], label=[array(label)],
+                          pad=0)
+        self._release(w, slot, gidx)
+        self._cursor += 1
+        if _tm.enabled():
+            _pipe_metrics()["batches"].inc()
+        return batch
+
+    def iter_next(self):
+        return self._cursor < self._epoch_batches
+
+    def _pull(self, worker, gidx, timeout=120.0):
+        """Wait for worker's ring slot holding global batch ``gidx``
+        and hand back ``(slot, data_view, label_view)``; the caller
+        releases the slot after the device copy. Crashed workers are
+        respawned with the shard resumed at the last acked batch."""
+        slot = gidx % self._nslots
+        meta, views = worker.views["meta"], worker.views
+        deadline = time.perf_counter() + timeout
+        t0 = time.perf_counter()
+        burst = 0
+        while True:
+            state = int(meta[slot, _pw.M_STATE])
+            if state == _pw.ERROR and int(meta[slot, _pw.M_GIDX]) == gidx:
+                n = int(meta[slot, _pw.M_ERRLEN])
+                msg = views["data"][slot].reshape(-1).view(np.uint8)[:n] \
+                    .tobytes().decode(errors="replace")
+                raise MXNetError(f"decode worker failed: {msg}")
+            if state == _pw.READY and int(meta[slot, _pw.M_GIDX]) == gidx:
+                break
+            if worker.proc.poll() is not None:
+                burst += 1
+                if burst > 5:
+                    raise MXNetError(
+                        f"io pipeline worker {worker.wid} crashed "
+                        f"{burst} times in a row without producing "
+                        f"batch {gidx} — giving up (see worker stderr)")
+                self._respawn(worker)
+            if time.perf_counter() > deadline:
+                h = worker.views["header"]
+                hb = int(h[_pw.H_HEARTBEAT])
+                hb_age = ((time.monotonic_ns() - hb) / 1e9 if hb
+                          else float("inf"))
+                raise MXNetError(
+                    f"io pipeline stalled: worker {worker.wid} produced "
+                    f"no batch {gidx} in {timeout:.0f}s (ring slot "
+                    f"state={state}, worker produced "
+                    f"{int(h[_pw.H_PRODUCED])} batches since spawn, "
+                    f"last heartbeat {hb_age:.1f}s ago)")
+            time.sleep(0.0005)
+        if _tm.enabled():
+            _pipe_metrics()["ring_wait"].observe(time.perf_counter() - t0)
+        data = views["data"][slot]
+        label = views["label"][slot]
+        if self._copy_views:
+            # this backend's device_put aliases host buffers: the ring
+            # slot will be rewritten, so take the one defensive copy
+            data, label = data.copy(), label.copy()
+        return slot, data, label
+
+    def _release(self, worker, slot, gidx):
+        meta = worker.views["meta"]
+        meta[slot, _pw.M_STATE] = _pw.EMPTY
+        worker.acked = gidx + 1
+
+    def _respawn(self, worker):
+        """A worker died (crash/OOM-kill): restart its shard from the
+        last acked batch. Slots are swept EMPTY first — partially
+        written batches beyond the ack point are redecoded."""
+        if self._closed:
+            raise MXNetError("pipeline is closed")
+        rc = worker.proc.poll()
+        self.respawns += 1
+        if _tm.enabled():
+            _pipe_metrics()["respawns"].inc()
+        import logging
+        logging.getLogger("mxnet_tpu.io").warning(
+            "io pipeline worker %d exited rc=%s — respawning at "
+            "batch %d", worker.wid, rc, worker.acked)
+        self._spawn(worker, start_batch=worker.acked)
+
+    # ------------------------------------------------------- checkpoints
+
+    def state_dict(self):
+        """Exact resumable position: (epoch, cursor). Everything else
+        — permutations, augment draws, shard layout — derives from the
+        constructor seed, so resume needs no replay decode: workers
+        respawn directly at the target batch."""
+        return {"version": 1, "type": "ShardedRecordPipeline",
+                "num_records": self._num_records,
+                "batch_size": int(self.batch_size),
+                "num_workers": self._W,
+                "shuffle": self.shuffle,
+                "seed": self._seed,
+                "streaming": self._streaming,
+                "epoch": self._epoch,
+                "cursor": self._cursor}
+
+    def load_state_dict(self, state):
+        if not isinstance(state, dict) or \
+                state.get("type") != "ShardedRecordPipeline" or \
+                state.get("version") != 1:
+            raise MXNetError(
+                "load_state_dict: not a version-1 ShardedRecordPipeline "
+                "state")
+        for attr, mine in (("num_records", self._num_records),
+                           ("batch_size", self.batch_size),
+                           ("num_workers", self._W),
+                           ("shuffle", self.shuffle),
+                           ("seed", self._seed),
+                           ("streaming", self._streaming)):
+            if state.get(attr) != mine:
+                raise MXNetError(
+                    f"load_state_dict: pipeline {attr}={mine!r} but the "
+                    f"state was captured with {attr}={state.get(attr)!r} "
+                    "— construct the pipeline with the same "
+                    "configuration to resume")
+        self._epoch = int(state["epoch"])
+        self._cursor = int(state["cursor"])
+        # per-worker resume point: with round-robin delivery, worker w
+        # has been consumed ceil((cursor - w) / W) batches this epoch
+        for w in self._workers:
+            done = (self._cursor - w.wid + self._W - 1) // self._W
+            self._stop_worker(w)
+            self._spawn(w, start_batch=self._epoch * self._bw + done)
+
+    # ----------------------------------------------------------- teardown
+
+    def _stop_worker(self, worker, timeout=5.0):
+        if worker.proc is None:
+            return
+        worker.views["header"][_pw.H_STOP] = 1
+        worker.proc.terminate()
+        try:
+            worker.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            worker.proc.kill()
+            worker.proc.wait(timeout=timeout)
+        worker.proc = None
+
+    def close(self):
+        """Stop workers, unlink shared memory, remove spec files. Safe
+        to call twice; runs from ``__del__``, ``atexit``, and the
+        launcher's SIGTERM path."""
+        if self._closed:
+            return
+        self._closed = True
+        for w in self._workers:
+            try:
+                self._stop_worker(w)
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        for w in self._workers:
+            # drop numpy views, then unlink FIRST (name removal never
+            # fails on exported buffers) and close best-effort: jax may
+            # briefly hold the last batch's source view after an async
+            # device_put, which would make mmap.close() throw
+            # BufferError — the mapping is reclaimed when those refs
+            # die, the /dev/shm name is already gone
+            w.views = None
+            try:
+                w.shm.unlink()
+            except FileNotFoundError:
+                pass
+            try:
+                w.shm.close()
+            except BufferError:
+                pass
+        import shutil
+        shutil.rmtree(self._tmpdir, ignore_errors=True)
+        try:
+            atexit.unregister(self._atexit)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter shutdown
+            pass
+
+
+def per_worker_pool_threads(num_workers):
+    """Decode-pool threads per worker process: the host's cores split
+    across worker processes (N workers x full-size pools would
+    oversubscribe and thrash)."""
+    total = get_env("MXNET_CPU_WORKER_NTHREADS",
+                    os.cpu_count() or 4, int)
+    return max(1, total // max(1, num_workers))
+
+
+def _imgdec_lib_path():
+    """Build (if needed) and locate the libjpeg decoder for workers to
+    dlopen by path; None lets workers fall back to PIL."""
+    from .._native import load_imgdec
+    if load_imgdec() is None:
+        return None
+    from .._native import _HERE
+    return os.path.join(_HERE, "libmxtpu_imgdec.so")
+
+
+# --------------------------------------------------------------- feeder
+
+def to_device(batch, sharding=None):
+    """Move one batch to device eagerly: host numpy leaves become
+    device NDArrays (``jax.device_put`` inside ``array``), and an
+    explicit ``sharding`` re-places already-device arrays so the batch
+    lands in the layout the step expects (the ``DataDesc``/mesh
+    contract). Structure-preserving over DataBatch / list / tuple."""
+    from ..ndarray import NDArray
+
+    def put(x):
+        if isinstance(x, NDArray):
+            dev = x
+        elif isinstance(x, np.ndarray):
+            dev = array(x)
+        else:
+            return x
+        if sharding is not None:
+            import jax
+            dev._data = jax.device_put(dev._data, sharding)
+        return dev
+
+    if isinstance(batch, DataBatch):
+        batch.data = [put(d) for d in (batch.data or [])]
+        batch.label = [put(lb) for lb in (batch.label or [])]
+        return batch
+    if isinstance(batch, (list, tuple)):
+        return type(batch)(put(x) for x in batch)
+    return put(batch)
+
+
+class DeviceFeeder:
+    """Double-buffered device prefetch over any batch source.
+
+    A feeder thread pulls batch k+1 from ``source`` (an iterator of
+    batches) and moves it to device — ``jax.device_put`` under
+    ``ndarray.array``, honoring ``sharding`` when given — while the
+    consumer runs step k. Queue depth 2 = classic double buffering:
+    one batch on device waiting, one in flight.
+
+    The consumer-side wait is charged to the io data-wait seam
+    (``mx_io_data_wait_seconds`` + the per-step breakdown's
+    ``mx_step_data_seconds``), so ``telemetry_dump --diff`` shows the
+    overlap instead of the caller asserting it.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, source, depth=2, sharding=None, convert=None):
+        self._source = source
+        self._convert = convert or \
+            (lambda batch: to_device(batch, sharding))
+        self._queue = queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._feed, daemon=True)
+        self._thread.start()
+
+    def _feed(self):
+        while not self._stop.is_set():
+            try:
+                batch = next(self._source)
+                item = self._convert(batch)
+            except StopIteration:
+                item = self._SENTINEL
+            except Exception as e:  # noqa: BLE001 — surface at get()
+                item = e
+            while not self._stop.is_set():
+                try:
+                    self._queue.put(item, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+            if item is self._SENTINEL or isinstance(item, Exception):
+                return
+
+    def get(self, timed=True):
+        """Next device-resident batch; raises StopIteration at source
+        exhaustion. The blocking wait here IS the residual input wait
+        the step breakdown reports."""
+        if timed and _tm.enabled():
+            from .io import _data_wait_hist
+            from ..telemetry import step as _tm_step
+            t0 = time.perf_counter()
+            item = self._queue.get()
+            dt = time.perf_counter() - t0
+            _data_wait_hist().observe(dt)
+            _tm_step.add_data_wait(dt)
+        else:
+            item = self._queue.get()
+        if item is self._SENTINEL:
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.get()
+
+    def close(self):
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)

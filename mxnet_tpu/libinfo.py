@@ -102,6 +102,25 @@ _ENV_VARS = {
     "MXTPU_IO_HOST_ENGINE": (
         "1 (default) schedules io pipeline decode/prefetch on the "
         "native host engine; 0 = plain thread fallback (io/io.py)"),
+    "MXTPU_IO_WORKERS": (
+        "decode worker PROCESSES for the sharded input pipeline; the "
+        "default num_workers of ImageRecordIter and the "
+        "ShardedRecordPipeline (0 = stay in-process; io/pipeline.py, "
+        "docs/io.md)"),
+    "MXTPU_IO_RING_BATCHES": (
+        "batch slots per worker in the shared-memory ring (default 3; "
+        "bounds decode run-ahead and host memory: "
+        "workers x slots x batch bytes; io/pipeline.py)"),
+    "MXTPU_IO_READAHEAD_MB": (
+        "raw-byte readahead per streaming shard reader (default 64); "
+        "background chunk reads overlap record parse + decode "
+        "(recordio.RecordIOStreamReader, io/_pipeline_worker.py)"),
+    "MXTPU_IO_PREFETCH_DEVICE": (
+        "1 = double-buffered device prefetch by default: "
+        "gluon DataLoader and Module.fit wrap their batch streams in "
+        "the device feeder (jax.device_put of batch k+1 during step "
+        "k); per-call prefetch_to_device= overrides (io/pipeline.py, "
+        "docs/io.md)"),
     "MXTPU_COMPILE_CACHE": (
         "persistent XLA compile-cache directory so warm runs skip "
         "recompilation (tools/mfu_probe.py sets it per run)"),

@@ -11,7 +11,9 @@ from __future__ import annotations
 import ctypes
 import io
 import os
+import queue
 import struct
+import threading
 from collections import namedtuple
 
 import numpy as np
@@ -225,3 +227,146 @@ def decode_payload(payload, iscolor=-1):
 def unpack_img(s, iscolor=-1):
     header, payload = unpack(s)
     return header, decode_payload(payload, iscolor)
+
+
+def load_record_offsets(path):
+    """Byte offsets of every record in a .rec file: from the ``.idx``
+    sidecar when present, else one framing scan (seeks only — payloads
+    are never retained). The shared index the random-access iterators
+    and the sharded pipeline both build on."""
+    idx_path = os.path.splitext(path)[0] + ".idx"
+    if os.path.isfile(idx_path):
+        offs = []
+        with open(idx_path) as f:
+            for line in f:
+                parts = line.strip().split("\t")
+                if len(parts) >= 2:
+                    offs.append(int(parts[1]))
+        if offs:
+            return offs
+    offs = []
+    with open(path, "rb") as f:
+        f.seek(0, 2)
+        end = f.tell()
+        pos = 0
+        while pos + 8 <= end:
+            f.seek(pos)
+            magic, lrec = struct.unpack("<II", f.read(8))
+            if magic != _MAGIC:
+                raise MXNetError(f"invalid RecordIO magic at {pos}")
+            offs.append(pos)
+            length = lrec & _LFLAG_MASK
+            pos += 8 + length + (4 - length % 4) % 4
+    return offs
+
+
+class RecordIOStreamReader:
+    """Streaming shard reader: a background thread reads ahead
+    ``readahead_mb`` of raw bytes in large chunks while the caller
+    consumes parsed records — so epoch-scale datasets stream from
+    disk/remote mounts without local materialization, and read I/O
+    overlaps decode (the io pipeline's decode workers sit downstream).
+
+    Records are framed on the wire (kMagic + length word), so a record
+    may straddle a chunk boundary; the parser carries the partial tail
+    into the next chunk. Iterating yields ``(byte_offset, record)``
+    pairs for the byte range ``[start, stop)`` of ``uri`` (``stop=None``
+    = end of file). ``start`` must sit on a record boundary.
+    """
+
+    #: one read() granularity; readahead_mb bounds how many of these
+    #: may sit parsed-ahead in the queue
+    CHUNK_BYTES = 4 << 20
+
+    def __init__(self, uri, start=0, stop=None, readahead_mb=None,
+                 chunk_bytes=None):
+        from .base import get_env
+        if readahead_mb is None:
+            readahead_mb = get_env("MXTPU_IO_READAHEAD_MB", 64, int)
+        self._chunk = int(chunk_bytes or self.CHUNK_BYTES)
+        depth = max(1, (int(readahead_mb) << 20) // self._chunk)
+        self._uri = uri
+        self._start = int(start)
+        self._stop = stop
+        self._queue = queue.Queue(maxsize=depth)
+        self._stop_evt = threading.Event()
+        self._thread = threading.Thread(target=self._read_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def _read_loop(self):
+        try:
+            with open(self._uri, "rb") as f:
+                if self._stop is None:
+                    f.seek(0, 2)
+                    stop = f.tell()
+                else:
+                    stop = int(self._stop)
+                f.seek(self._start)
+                pos = self._start
+                while pos < stop and not self._stop_evt.is_set():
+                    chunk = f.read(min(self._chunk, stop - pos))
+                    if not chunk:
+                        break
+                    pos += len(chunk)
+                    self._put(chunk)
+        except Exception as e:  # noqa: BLE001 — surface at the consumer
+            self._put(e)
+            return
+        self._put(None)
+
+    def _put(self, item):
+        while not self._stop_evt.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    def __iter__(self):
+        buf = b""
+        pos = self._start
+        while True:
+            item = self._queue.get()
+            if item is None:
+                break
+            if isinstance(item, Exception):
+                raise item
+            buf = buf + item if buf else item
+            # drain complete frames by cursor (no per-record buffer
+            # reslicing); a partial frame tail carries into the next
+            # chunk (chunk-boundary records)
+            off = 0
+            while len(buf) - off >= 8:
+                magic, lrec = struct.unpack_from("<II", buf, off)
+                if magic != _MAGIC:
+                    raise MXNetError(
+                        f"invalid RecordIO magic at {pos}")
+                length = lrec & _LFLAG_MASK
+                framed = 8 + length + (4 - length % 4) % 4
+                if len(buf) - off < framed:
+                    break
+                yield pos, buf[off + 8:off + 8 + length]
+                off += framed
+                pos += framed
+            buf = buf[off:]
+        if buf:
+            raise MXNetError(
+                f"truncated record at byte {pos} (stream ended inside "
+                "a frame)")
+
+    def close(self):
+        self._stop_evt.set()
+        # unblock a producer stuck on put()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter shutdown
+            pass

@@ -685,11 +685,15 @@ def test_resume_restores_rng_chain(tmp_path):
 
 
 # --------------------------------- headline: in-process kill/resume slice
-def _train_loop(ckpt_dir, guard, total_batches=12):
+def _train_loop(ckpt_dir, guard, total_batches=12, wrap=None):
     """Deterministic SGD over a shuffling iterator; checkpoint every
-    batch; stop early (preempted) when the guard says so."""
+    batch; stop early (preempted) when the guard says so. ``wrap``
+    optionally decorates the iterator (e.g. PrefetchingIter) — resume
+    must stay bit-identical with in-flight prefetched batches."""
     data = (np.arange(64, dtype=np.float32) % 13).reshape(32, 2)
     it = NDArrayIter(data, batch_size=8, shuffle=True, seed=13)
+    if wrap is not None:
+        it = wrap(it)
     mgr = ckpt.CheckpointManager(ckpt_dir, keep=3)
     w = np.zeros(2, np.float32)
     epoch = 0
@@ -749,6 +753,47 @@ def test_kill_worker_resume_bitwise_identical(tmp_path, fresh_faults):
     assert status == "done"
     assert w_resumed.tobytes() == w_clean.tobytes()
     assert profiler.recovery_summary()["worker_resumes"] == before + 1
+
+
+def test_kill_worker_resume_bitwise_identical_prefetching(tmp_path,
+                                                         fresh_faults):
+    """The PR 2 headline extended to a PREFETCHING iterator: the
+    producer thread runs batches ahead of the checkpoint, so resume
+    state is (inner epoch-start state, delivered count) and replay
+    must discard exactly the in-flight lookahead — final weights
+    bit-identical to the uninterrupted prefetching run."""
+    from mxnet_tpu.io import PrefetchingIter
+
+    def wrap(it):
+        return PrefetchingIter(it, prefetch_to_device=True)
+
+    fresh_faults.delenv("MXNET_KVSTORE_FAULT_PLAN", raising=False)
+    guard = ckpt.PreemptionGuard()
+    try:
+        status, w_clean = _train_loop(str(tmp_path / "clean"), guard,
+                                      wrap=wrap)
+    finally:
+        guard.restore()
+    assert status == "done"
+
+    fresh_faults.setenv("MXNET_KVSTORE_FAULT_PLAN", "kill_worker@batch=7")
+    guard = ckpt.PreemptionGuard()
+    try:
+        status, w_part = _train_loop(str(tmp_path / "faulted"), guard,
+                                     wrap=wrap)
+    finally:
+        guard.restore()
+    assert status == "preempted"
+    assert w_part.tobytes() != w_clean.tobytes()
+
+    guard = ckpt.PreemptionGuard()
+    try:
+        status, w_resumed = _train_loop(str(tmp_path / "faulted"), guard,
+                                        wrap=wrap)
+    finally:
+        guard.restore()
+    assert status == "done"
+    assert w_resumed.tobytes() == w_clean.tobytes()
 
 
 # ------------------------------------------- server snapshot CRC adoption

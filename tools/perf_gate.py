@@ -4,6 +4,15 @@
     python tools/perf_gate.py BENCH_r06.json
     python tools/perf_gate.py bench_out.json --tolerance 0.2 \\
         --tol mfu_bf16=0.1 --tol resnet50_inference_int8_bs128=0.3
+    python tools/perf_gate.py io_bench.json --io
+
+``--io`` gates a tools/io_bench.py version-2 artifact instead: every
+stage's img/s must stay within tolerance of the committed last-good
+(``docs/artifacts/IO_LAST_GOOD.json``), the multi-process pipeline
+must hold its ratio over the single-process DataLoader baseline, and
+the train-loop input-wait fraction with device prefetch must stay
+under ``--io-max-wait`` (the "input wait < 5% of step" contract,
+measured by mx_step_data_seconds — ROADMAP item 4).
 
 Compares a bench artifact against the committed last-good measurement
 (``docs/artifacts/BENCH_LAST_GOOD.json`` unless ``--last-good``) with
@@ -39,6 +48,8 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_LAST_GOOD = os.path.join(REPO, "docs", "artifacts",
                                  "BENCH_LAST_GOOD.json")
+DEFAULT_IO_LAST_GOOD = os.path.join(REPO, "docs", "artifacts",
+                                    "IO_LAST_GOOD.json")
 
 # metrics compared when both sides carry them; values are "bigger is
 # better" throughputs/ratios
@@ -164,6 +175,77 @@ def gate(candidate, last_good, tolerance=0.25, per_metric=None,
     return rc, msgs
 
 
+def _io_stage_rates(doc):
+    """{stage: img_per_s} from an io_bench v2 artifact."""
+    out = {}
+    for stage, s in (doc.get("stages") or {}).items():
+        if isinstance(s, dict) and \
+                isinstance(s.get("img_per_s"), (int, float)):
+            out[stage] = float(s["img_per_s"])
+    return out
+
+
+def gate_io(candidate, last_good, tolerance=0.25, min_ratio=3.0,
+            max_wait=0.05, min_native_ratio=1.0):
+    """(exit_code, [messages]) for an io_bench artifact pair: stage
+    throughputs vs last-good, the pipeline/single-process ratio floors
+    (>= min_ratio over the per-item Python DataLoader; >=
+    min_native_ratio over the native batch path — 1.0 by default
+    because a saturated few-core host cannot scale past its own
+    in-process decode ceiling, but the pipeline must never LOSE to
+    it), and the prefetch-on train input-wait ceiling."""
+    msgs = []
+    rc = 0
+    if candidate.get("tool") != "io_bench" or \
+            candidate.get("version") != 2:
+        return 2, ["not a version-2 io_bench artifact"]
+    mine = _io_stage_rates(candidate)
+    good = _io_stage_rates(last_good)
+    if not mine:
+        return 3, ["io artifact carries no stage throughputs "
+                   "(signal-free — rejected)"]
+    for stage in sorted(set(mine) & set(good)):
+        a, b = good[stage], mine[stage]
+        if a <= 0:
+            continue
+        if b < (1.0 - tolerance) * a:
+            rc = 1
+            msgs.append("REGRESSION io[%s]: %.0f img/s < %.0f (last "
+                        "good %.0f, tolerance %.0f%%)"
+                        % (stage, b, (1.0 - tolerance) * a, a,
+                           tolerance * 100))
+        else:
+            msgs.append("io[%s]: %.0f img/s vs %.0f (ok)"
+                        % (stage, b, a))
+    for key, floor in (("pipeline_vs_python_1proc", min_ratio),
+                       ("pipeline_vs_native_1proc", min_native_ratio)):
+        ratio = (candidate.get("ratios") or {}).get(key)
+        if not isinstance(ratio, (int, float)):
+            continue
+        if ratio < floor:
+            rc = 1
+            msgs.append("REGRESSION io ratio: %s %.2fx < required "
+                        "%.1fx" % (key, ratio, floor))
+        else:
+            msgs.append("io ratio: %s %.2fx (>= %.1fx ok)"
+                        % (key, ratio, floor))
+    wait = (candidate.get("train") or {}).get("input_wait_frac_prefetch")
+    if isinstance(wait, (int, float)):
+        if wait > max_wait:
+            rc = 1
+            msgs.append("REGRESSION io train: input wait %.1f%% of "
+                        "step with prefetch > %.1f%% budget"
+                        % (wait * 100, max_wait * 100))
+        else:
+            msgs.append("io train: input wait %.1f%% of step with "
+                        "prefetch (<= %.1f%% ok)"
+                        % (wait * 100, max_wait * 100))
+    else:
+        rc = rc or 1
+        msgs.append("io train: missing input_wait_frac_prefetch")
+    return rc, msgs
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="perf_gate",
                                  description=__doc__.splitlines()[0])
@@ -179,7 +261,45 @@ def main(argv=None):
     ap.add_argument("--mem-tol", type=float, default=0.15,
                     help="allowed fractional GROWTH of per-stage peak "
                          "live bytes (memory section; 0.15)")
+    ap.add_argument("--io", action="store_true",
+                    help="gate a tools/io_bench.py v2 artifact "
+                         "(stages + pipeline ratio + input-wait)")
+    ap.add_argument("--io-min-ratio", type=float, default=3.0,
+                    help="required pipeline / single-process per-item "
+                         "Python DataLoader img/s ratio (3.0)")
+    ap.add_argument("--io-min-native-ratio", type=float, default=1.0,
+                    help="required pipeline / single-process NATIVE "
+                         "DataLoader ratio (1.0 — must not lose to "
+                         "the in-process path; raise on many-core "
+                         "hosts)")
+    ap.add_argument("--io-max-wait", type=float, default=0.05,
+                    help="max input-wait fraction of step time with "
+                         "device prefetch on (0.05)")
     args = ap.parse_args(argv)
+    if args.io:
+        last_good_path = args.last_good
+        if last_good_path == DEFAULT_LAST_GOOD:
+            last_good_path = DEFAULT_IO_LAST_GOOD
+        try:
+            with open(args.artifact, "r", encoding="utf-8") as f:
+                candidate = json.load(f)
+            with open(last_good_path, "r", encoding="utf-8") as f:
+                last_good = json.load(f)
+        except (OSError, ValueError) as e:
+            print("perf_gate: cannot read io artifact: %s" % e,
+                  file=sys.stderr)
+            return 2
+        rc, msgs = gate_io(candidate, last_good,
+                           tolerance=args.tolerance,
+                           min_ratio=args.io_min_ratio,
+                           max_wait=args.io_max_wait,
+                           min_native_ratio=args.io_min_native_ratio)
+        for m in msgs:
+            print(m)
+        print("perf_gate: %s"
+              % {0: "PASS", 1: "REGRESSION", 2: "UNREADABLE",
+                 3: "BARE-ZERO"}.get(rc, rc))
+        return rc
     per_metric = {}
     for spec in args.tol:
         if "=" not in spec:
