@@ -66,6 +66,16 @@ _SCOPES = (
       "group_by_op", "tag_role", "tag_tree", "role_of",
       "live_census", "buffer_intervals", "build_memory_ledger",
       "group_buffers_by_op", "_sweep_peak"}, set()),
+    # the serving gateway's per-request paths: admission + enqueue run
+    # in every client thread, coalescing + reply recording in every
+    # replica scheduler — a sync in any of them serializes the whole
+    # request stream behind one device read. (Replica._run_batch's
+    # np.asarray IS the reply's host transfer and lives outside this
+    # list by design.)
+    ("mxnet_tpu/serving/",
+     {"submit", "infer", "_admit", "put", "take_batch", "requeue",
+      "_scoop", "depth", "pending_rows", "_reply", "_observe_rate",
+      "estimate_latency_s", "pad_batch", "pick_bucket"}, set()),
 )
 
 # calls that block on (or copy from) the device stream

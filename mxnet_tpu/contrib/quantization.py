@@ -469,6 +469,31 @@ def _offline_quantize_params(qsym, arg_params):
     return qsym, new_params
 
 
+def dequantize_offline_params(qarg_params):
+    """Inverse of ``_offlineQuantizeParams`` for weight-only execution
+    lowerings (serving/variants.py): every ``<w>_int8`` constant (with
+    its ``_min``/``_max`` scale pair) folds back to an fp32 ``<w>``
+    through the calibrated symmetric scale. The round-trip keeps the
+    quantization's accuracy effect while letting a backend without
+    fast int8 compute serve the quantized model at fp32 speed.
+    Returns ``{base_name: NDArray}`` for exactly the params the
+    QuantizeGraph pass quantized offline."""
+    def _np(v):
+        return v.asnumpy() if isinstance(v, nd.NDArray) \
+            else np.asarray(v)
+
+    out = {}
+    for k, v in qarg_params.items():
+        if not k.endswith("_int8"):
+            continue
+        amax = qarg_params.get(k + "_max")
+        if amax is None:
+            continue
+        out[k[:-len("_int8")]] = nd.array(
+            _np(v).astype(np.float32) * (float(_np(amax)) / INT8_RANGE))
+    return out
+
+
 def quantize_model(sym, arg_params, aux_params, ctx=None,
                    excluded_sym_names=None, calib_mode="entropy",
                    calib_data=None, num_calib_examples=None,

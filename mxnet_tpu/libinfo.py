@@ -200,6 +200,28 @@ _ENV_VARS = {
         "peak-liveness table + census + flight dump here (default "
         "oom_postmortem.json; bench.py points it at a per-run file "
         "it embeds in failure artifacts; profiling/memory.py)"),
+    "MXTPU_SERVING_MAX_WAIT_MS": (
+        "default continuous-batcher coalescing window per model: a "
+        "request never waits longer than this for batch-mates before "
+        "dispatching partial, so bs=1 latency is bounded (default 5; "
+        "serving/batcher.py, docs/serving.md)"),
+    "MXTPU_SERVING_MAX_QUEUE": (
+        "default per-model queue-depth limit; submissions beyond it "
+        "fast-reject with reason queue_full (default 256; "
+        "serving/gateway.py)"),
+    "MXTPU_SERVING_SLO_MS": (
+        "default per-model latency budget: a request whose estimated "
+        "e2e latency (EWMA service rate x backlog) would exceed it "
+        "fast-rejects with reason slo; 0 disables (default 0; "
+        "serving/gateway.py)"),
+    "MXTPU_SERVING_REPLICAS": (
+        "default replica count per registered model; degrades "
+        "gracefully when fewer local devices exist (default 1; "
+        "serving/gateway.py)"),
+    "MXTPU_SERVING_HEALTH_SEC": (
+        ">0 starts the gateway health-probe daemon at this period: "
+        "failed replicas drain, recovered ones rejoin (default 0 = "
+        "manual check_health(); serving/gateway.py)"),
 }
 
 

@@ -104,6 +104,20 @@ def shard_map(f, mesh=None, in_specs=None, out_specs=None,
                 **kwargs)
 
 
+def replica_devices(n, devices=None):
+    """Device assignment for ``n`` replicas (serving lanes, ensemble
+    members), degrading gracefully when the local mesh is smaller than
+    asked — the SNIPPETS [2] mesh-shape fallback applied to a 1-D
+    replica axis: replicas wrap around the available devices, so the
+    same registration code serves a pod slice and a single chip.
+    Returns ``(devices_list, degraded)`` where ``degraded`` is True
+    when replicas had to share devices."""
+    devs = list(devices if devices is not None else jax.local_devices())
+    if not devs:
+        raise ValueError("replica_devices: no local devices")
+    return [devs[i % len(devs)] for i in range(n)], n > len(devs)
+
+
 def shard_batch(batch, mesh, axis="dp"):
     """Place a host batch onto the mesh, sharded along the leading dim.
 

@@ -10,24 +10,79 @@ machinery and an optionally AOT-compiled executable).
 """
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from .base import MXNetError
+
+
+def compile_symbol_forward(symbol, bindings, device=None, cast=None):
+    """The one symbol→executable lowering both deployment layers use
+    (Predictor._build and the serving VariantSet — a fix here reaches
+    both): commit ``bindings`` (params/aux, NDArray or array-like) to
+    ``device`` as a sorted tuple and return ``(jitted, param_vals)``
+    where ``jitted(param_vals, inputs_dict)`` evaluates the symbol and
+    returns a tuple of jax arrays.
+
+    ``cast`` (e.g. ``"bfloat16"``) builds a reduced-precision variant:
+    float params are cast offline, float inputs at the graph edge, and
+    float outputs cast back to fp32 (replies stay fp32-typed).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .ndarray.ndarray import NDArray
+
+    names = sorted(bindings)
+    cast_dt = jnp.dtype(cast) if cast is not None else None
+
+    def _cast(a):
+        if cast_dt is not None and jnp.issubdtype(a.dtype,
+                                                  jnp.floating):
+            return a.astype(cast_dt)
+        return a
+
+    vals = tuple(
+        _cast(bindings[n]._data if isinstance(bindings[n], NDArray)
+              else jnp.asarray(np.asarray(bindings[n])))
+        for n in names)
+
+    def fwd(param_vals, inputs):
+        b = {n: NDArray(v) for n, v in zip(names, param_vals)}
+        for k, v in inputs.items():
+            b[k] = NDArray(_cast(jnp.asarray(v)))
+        out = symbol.eval_dict(b)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        res = []
+        for o in outs:
+            a = o._data
+            if cast_dt is not None and \
+                    jnp.issubdtype(a.dtype, jnp.floating):
+                a = a.astype(jnp.float32)
+            res.append(a)
+        return tuple(res)
+
+    pvals = jax.device_put(vals, device) if device is not None \
+        else jax.device_put(vals)
+    return jax.jit(fwd), pvals
 
 
 class Predictor:
     """MXPredCreate/SetInput/Forward/GetOutput rolled into one object."""
 
     def __init__(self, symbol, arg_params, aux_params, input_shapes,
-                 dev_type=None, dev_id=0):
+                 dev_type=None, dev_id=0, device=None):
         import jax
 
         from .ndarray.ndarray import NDArray
 
         # MXPredCreate's dev_type/dev_id select the device; None = the
-        # backend default (the TPU under axon)
-        self._device = None
-        if dev_type is not None:
+        # backend default (the TPU under axon). ``device`` takes a jax
+        # device object directly — the serving gateway pins one
+        # replica's executables per device this way (serving/gateway.py)
+        self._device = device
+        if device is None and dev_type is not None:
             matching = []
             for backend in (dev_type, "axon" if dev_type == "tpu" else None):
                 if backend is None:
@@ -53,6 +108,10 @@ class Predictor:
         for k, v in list(arg_params.items()) + list(aux_params.items()):
             self._bindings[k] = v if isinstance(v, NDArray) else NDArray(v)
         self._jitted = None
+        # guards the lazy _build: the serving gateway's worker threads
+        # race the first forward(); without this, two threads half-
+        # initialize (_jitted set, _param_vals missing) and one crashes
+        self._lock = threading.Lock()
 
     @classmethod
     def from_checkpoint(cls, prefix, epoch, input_shapes, **kwargs):
@@ -64,25 +123,14 @@ class Predictor:
         return cls(symbol, arg_params, aux_params, input_shapes, **kwargs)
 
     def _build(self):
-        import jax
-
-        from .ndarray.ndarray import NDArray
-
-        names = sorted(self._bindings)
-        vals = tuple(self._bindings[n]._data for n in names)
-
-        def fwd(param_vals, inputs):
-            b = {n: NDArray(v) for n, v in zip(names, param_vals)}
-            for k, v in inputs.items():
-                b[k] = NDArray(v)
-            out = self._symbol.eval_dict(b)
-            outs = out if isinstance(out, (list, tuple)) else [out]
-            return tuple(o._data for o in outs)
-
-        self._jitted = jax.jit(fwd)
-        # committed params pin the computation to the selected device
-        self._param_vals = jax.device_put(vals, self._device) \
-            if self._device is not None else jax.device_put(vals)
+        jitted, pvals = compile_symbol_forward(
+            self._symbol, self._bindings, self._device)
+        # committed params pin the computation to the selected device.
+        # _param_vals is published BEFORE _jitted: forward()'s unlocked
+        # fast path reads _jitted first, so it must never observe a
+        # jitted fn without the params it closes over
+        self._param_vals = pvals
+        self._jitted = jitted
 
     def forward(self, **inputs):
         """Run one forward; numpy (or NDArray) in, list of numpy out
@@ -91,8 +139,17 @@ class Predictor:
 
         from .ndarray.ndarray import NDArray
 
-        if self._jitted is None:
-            self._build()
+        jitted = self._jitted
+        if jitted is None:
+            with self._lock:          # double-checked: concurrent first
+                if self._jitted is None:   # calls build exactly once
+                    self._build()
+                jitted = self._jitted
+        # local snapshots: a concurrent reshape() nulls _jitted under
+        # the lock — this call then runs the pre-reshape executable
+        # (jit retraces per input shape, so even a racing new shape
+        # computes correctly) instead of crashing on a None read
+        pvals = self._param_vals
         feed = {}
         for k, v in inputs.items():
             if k not in self._shapes:
@@ -107,13 +164,14 @@ class Predictor:
                     f"declared {tuple(self._shapes[k])} (reshape with a "
                     "new Predictor, as MXPredReshape does)")
             feed[k] = arr
-        outs = self._jitted(self._param_vals, feed)
+        outs = jitted(pvals, feed)
         return [np.asarray(o) for o in outs]
 
     def reshape(self, new_input_shapes):
         """New shapes -> new compiled executable (MXPredReshape)."""
-        self._shapes.update(new_input_shapes)
-        self._jitted = None
+        with self._lock:
+            self._shapes.update(new_input_shapes)
+            self._jitted = None
         return self
 
     def output_shapes(self, dtypes=None):
@@ -241,6 +299,7 @@ class _CPredictor:
         p._shapes = shapes
         p._bindings = self._pred._bindings  # weights shared, not copied
         p._jitted = None
+        p._lock = threading.Lock()
         clone._pred = p
         clone._inputs = {}
         clone._outputs = None

@@ -1,0 +1,41 @@
+"""Multi-tenant inference serving over the Predictor stack.
+
+The deployment half the reference exposes as the MXPred C ABI
+(``c_predict_api.h`` / predictor.py) under *production load*: many
+models, many concurrent request streams, bounded tail latency.
+
+Pieces (ROADMAP item 1, the "millions of users" direction):
+
+- **continuous batcher** (:mod:`.batcher`) — per-model queues feed
+  replica schedulers that coalesce in-flight requests into padded
+  shape buckets, with a ``max_wait`` knob bounding bs=1 latency;
+- **model registry + variants** (:mod:`.variants`) — each model loads
+  fp32/bf16/INT8 executables (INT8 via the ``contrib/quantization.py``
+  KL-calibration flow), AOT-compiled per bucket at registration so
+  steady-state serving never retraces;
+- **admission control + SLOs** (:mod:`.gateway`) — queue-depth and
+  latency-budget fast-reject (429-style), ``mx_serving_*`` telemetry
+  families, and a ``serving.request → queue → batch → execute →
+  reply`` span chain per request through the PR 5 trace machinery;
+- **N-replica scale-out** — request streams shard across per-device
+  replicas, degrading gracefully to a single chip (SNIPPETS [2]'s
+  mesh fallback), with health probes that drain and redistribute on
+  failure.
+
+Env knobs (libinfo._ENV_VARS / docs/env_vars.md):
+``MXTPU_SERVING_MAX_WAIT_MS``, ``MXTPU_SERVING_MAX_QUEUE``,
+``MXTPU_SERVING_SLO_MS``, ``MXTPU_SERVING_REPLICAS``,
+``MXTPU_SERVING_HEALTH_SEC``. Bench + CI gate: tools/serving_bench.py
+and ``tools/perf_gate.py --serving`` over
+docs/artifacts/SERVING_LAST_GOOD.json. Guide: docs/serving.md.
+"""
+from __future__ import annotations
+
+from .batcher import (ModelQueue, RejectedError, Request, ServingError,
+                      pad_batch)
+from .gateway import Gateway, Model, ModelRegistry, Replica
+from .variants import VariantSet, default_buckets, pick_bucket
+
+__all__ = ["Gateway", "Model", "ModelQueue", "ModelRegistry",
+           "RejectedError", "Replica", "Request", "ServingError",
+           "VariantSet", "default_buckets", "pad_batch", "pick_bucket"]

@@ -1,0 +1,689 @@
+"""Multi-tenant inference gateway: model registry + variants,
+continuous batching over N replicas, SLO admission control.
+
+One :class:`Gateway` serves many registered models concurrently. Each
+model gets:
+
+- a :class:`~mxnet_tpu.serving.batcher.ModelQueue` fed by client
+  threads through :meth:`Gateway.submit` (admission-controlled), and
+- N :class:`Replica` lanes — each a device + a per-(variant, bucket)
+  compiled :class:`~mxnet_tpu.serving.variants.VariantSet` + one
+  scheduler thread pulling coalesced batches from the shared queue.
+
+Replica placement degrades gracefully from a multi-device mesh to a
+single chip (SNIPPETS [2]'s mesh-shape fallback): asking for more
+replicas than ``jax.local_devices()`` offers serves with what exists
+(several replicas then share a device — still useful on CPU where XLA
+runs them on pool threads) and logs the degradation.
+
+Admission control is fast-reject (the 429 analogue): a request that
+would blow the queue-depth limit or the model's latency budget
+(``slo_ms``, estimated from EWMA service rates) raises
+:class:`RejectedError` in the caller's thread without ever entering
+the queue — overload sheds load in microseconds instead of timing
+every client out.
+
+Every request carries a trace context; at reply time the gateway
+records the ``serving.request → queue / batch / execute / reply`` span
+chain into the PR 5 ring (one tree per request, parented to the
+client's enclosing span when there is one) and lands per-stage
+latencies in the ``mx_serving_*`` telemetry families.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+
+import numpy as np
+
+from .. import tracing
+from ..base import get_env
+from ..telemetry import metrics as _tm
+from ..tracing import clock
+from .batcher import (ModelQueue, RejectedError, Request, ServingError,
+                      pad_batch)
+from .variants import VariantSet, default_buckets, pick_bucket
+
+logger = logging.getLogger(__name__)
+
+# EWMA weight for service-rate estimates (recent batches dominate so
+# admission reacts to the current load shape within ~10 batches)
+_EWMA = 0.2
+
+_met = _tm.lazy_metrics(lambda reg: {
+    "requests": reg.counter(
+        "mx_serving_requests_total",
+        "admitted inference requests", labelnames=("model", "variant")),
+    "rejected": reg.counter(
+        "mx_serving_rejected_total",
+        "fast-rejected requests at admission",
+        labelnames=("model", "reason")),
+    "batches": reg.counter(
+        "mx_serving_batches_total",
+        "executed batches", labelnames=("model", "variant")),
+    "pad_rows": reg.counter(
+        "mx_serving_padding_rows_total",
+        "zero rows added to fill shape buckets", labelnames=("model",)),
+    "depth": reg.gauge(
+        "mx_serving_queue_depth",
+        "requests pending in the model queue", labelnames=("model",)),
+    "healthy": reg.gauge(
+        "mx_serving_replica_healthy",
+        "1 = replica serving, 0 = drained",
+        labelnames=("model", "replica")),
+    "failures": reg.counter(
+        "mx_serving_replica_failures_total",
+        "replica executions that failed and drained the replica",
+        labelnames=("model",)),
+    "batch_rows": reg.histogram(
+        "mx_serving_batch_rows",
+        "coalesced rows per executed batch", labelnames=("model",),
+        buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256)),
+    "latency": reg.histogram(
+        "mx_serving_latency_seconds",
+        "per-stage + end-to-end request latency",
+        labelnames=("model", "stage")),
+})
+
+
+class Replica:
+    """One serving lane: a device-pinned VariantSet + the scheduler
+    thread that pulls coalesced batches for it."""
+
+    def __init__(self, model, idx, device, variant_set):
+        self._model = model
+        self.idx = idx
+        self.device = device
+        self.variant_set = variant_set
+        self.healthy = True
+        self.last_error = None
+        self._thread = None
+        # lane generation: bumped by every start(); a scheduler thread
+        # serves only its own generation, so a revive can always spawn
+        # a fresh lane without racing a parked-but-still-alive one
+        # (the stale lane hands back its next batch and exits)
+        self._gen = 0
+
+    def start(self):
+        self._gen += 1
+        self._thread = threading.Thread(
+            target=self._loop, args=(self._gen,), daemon=True,
+            name=f"mxtpu-serve-{self._model.name}-r{self.idx}")
+        self._thread.start()
+
+    def join(self, timeout=None):
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def probe(self):
+        """Health check: a minimum-bucket zeros batch through every
+        variant, off-queue. Healthy = all succeed."""
+        vs = self.variant_set
+        b = self._model.buckets[0]
+        try:
+            for variant in vs.variants:
+                vs.run(variant, np.zeros((b,) + vs.feature_shape,
+                                         vs.input_dtype))
+        except Exception as e:  # noqa: BLE001 — any failure = unhealthy
+            self.last_error = e
+            return False
+        return True
+
+    # -- scheduler loop ------------------------------------------------------
+    def _loop(self, gen):
+        m = self._model
+        while self.healthy and self._gen == gen and \
+                not m.queue.closed:
+            item = m.queue.take_batch()
+            if item is None:        # closed empty
+                break
+            variant, batch = item
+            if not self.healthy or self._gen != gen:
+                # drained by a health probe (or superseded by a revived
+                # lane) while blocked in take_batch: requeue instead of
+                # serving on a stale/bad lane — any live lane takes it,
+                # INCLUDING this replica's own fresh generation. Fail
+                # directly only when nothing will ever serve it.
+                err = self.last_error or ServingError("replica drained")
+                if m.queue.closed:
+                    for r in batch:
+                        r._set_error(ServingError(
+                            f"serving: model {m.name!r} shut down "
+                            "before the request executed"))
+                else:
+                    m.queue.requeue(batch)
+                    if not any(r.healthy for r in m.replicas):
+                        for r in m.queue.drain():
+                            r._set_error(ServingError(
+                                f"serving: no healthy replica left "
+                                f"for {m.name!r} (last error: "
+                                f"{err!r})"))
+                break
+            try:
+                self._run_batch(variant, batch)
+            except Exception as e:  # noqa: BLE001 — one bad execution
+                # drains THIS replica; the batch redistributes
+                self._fail(batch, e)
+        _met()["depth"].labels(model=m.name).set(m.queue.depth())
+
+    def _run_batch(self, variant, batch):
+        m = self._model
+        met = _met()
+        t_deq = clock.now_ns()
+        rows = sum(r.rows for r in batch)
+        bucket = pick_bucket(m.buckets, rows)
+        for r in batch:
+            r.dequeue_ns = t_deq
+            r.attempts += 1
+        padded, _ = pad_batch(batch, bucket,
+                              self.variant_set.feature_shape,
+                              self.variant_set.input_dtype)
+        t0 = clock.now_ns()
+        outs = self.variant_set.run(variant, padded)
+        t1 = clock.now_ns()
+        met["depth"].labels(model=m.name).set(m.queue.depth())
+        met["batches"].labels(model=m.name, variant=variant).inc()
+        met["batch_rows"].labels(model=m.name).observe(rows)
+        met["pad_rows"].labels(model=m.name).inc(bucket - rows)
+        off = 0
+        for r in batch:
+            r.exec_start_ns, r.exec_end_ns = t0, t1
+            r._set_result([o[off:off + r.rows] for o in outs])
+            off += r.rows
+            m._reply(r, bucket=bucket, batch_size=len(batch),
+                     replica=self.idx)
+        m._observe_rate(rows, (t1 - t0) / 1e9)
+
+    def _fail(self, batch, err):
+        m = self._model
+        self.healthy = False
+        self.last_error = err
+        met = _met()
+        met["failures"].labels(model=m.name).inc()
+        met["healthy"].labels(model=m.name, replica=str(self.idx)).set(0)
+        logger.error("serving: replica %d of %r drained after: %r — "
+                     "redistributing %d request(s)",
+                     self.idx, m.name, err, len(batch))
+        self._redistribute(batch, err)
+
+    def _redistribute(self, batch, err):
+        m = self._model
+        survivors = [r for r in m.replicas
+                     if r.healthy and r is not self]
+        # a request that has failed on every replica is the poison
+        # pill, not the victim — fail it instead of cycling forever
+        retry = [r for r in batch if r.attempts <= len(m.replicas)]
+        poison = [r for r in batch if r.attempts > len(m.replicas)]
+        for r in poison:
+            r._set_error(ServingError(
+                f"serving: request failed on every replica of "
+                f"{m.name!r}: {err!r}"))
+        if survivors and retry:
+            m.queue.requeue(retry)
+        else:
+            for r in retry:
+                r._set_error(ServingError(
+                    f"serving: no healthy replica left for {m.name!r} "
+                    f"(last error: {err!r})"))
+        # if this was the last live lane — or the survivor(s) died in
+        # the same window (two replicas failing concurrently each see
+        # the other as alive) — drain-fail everything still queued
+        # rather than stranding it in a queue no scheduler serves
+        if not any(r.healthy for r in m.replicas):
+            for r in m.queue.drain():
+                r._set_error(ServingError(
+                    f"serving: no healthy replica left for "
+                    f"{m.name!r} (last error: {err!r})"))
+
+
+class Model:
+    """One registered model: config + queue + replicas + service-rate
+    estimates (the admission controller's inputs)."""
+
+    def __init__(self, name, buckets, max_wait_s, max_queue, slo_s,
+                 variants):
+        self.name = name
+        self.buckets = buckets
+        self.max_queue = max_queue
+        self.slo_s = slo_s
+        self.variants = variants
+        self.queue = ModelQueue(max_rows=buckets[-1],
+                                max_wait_s=max_wait_s)
+        self.replicas = []
+        self._rate_lock = threading.Lock()
+        self._exec_s = None       # EWMA seconds per executed batch
+        self._rows_per_s = None   # EWMA serviced rows/s
+        self.warmup_seconds = 0.0
+        self.executables = 0
+
+    # -- service-rate estimation --------------------------------------------
+    def _observe_rate(self, rows, exec_s):
+        if exec_s <= 0:
+            return
+        with self._rate_lock:
+            self._exec_s = exec_s if self._exec_s is None else \
+                (1 - _EWMA) * self._exec_s + _EWMA * exec_s
+            rate = rows / exec_s
+            self._rows_per_s = rate if self._rows_per_s is None else \
+                (1 - _EWMA) * self._rows_per_s + _EWMA * rate
+
+    def estimate_latency_s(self, rows):
+        """Predicted e2e latency for a new request: queued work drained
+        at the observed rate (scaled by healthy replicas) + one
+        execution. None until the first batch lands (no data = admit)."""
+        with self._rate_lock:
+            exec_s, rate = self._exec_s, self._rows_per_s
+        if exec_s is None or not rate:
+            return None
+        healthy = sum(1 for r in self.replicas if r.healthy)
+        if not healthy:
+            return None
+        backlog = self.queue.pending_rows() + rows
+        return backlog / (rate * healthy) + exec_s
+
+    # -- reply-side recording ------------------------------------------------
+    def _reply(self, req, bucket, batch_size, replica):
+        t_reply = clock.now_ns()
+        met = _met()
+        lat = met["latency"]
+        name = self.name
+        lat.labels(model=name, stage="queue").observe(
+            (req.dequeue_ns - req.submit_ns) / 1e9)
+        lat.labels(model=name, stage="batch").observe(
+            (req.exec_start_ns - req.dequeue_ns) / 1e9)
+        lat.labels(model=name, stage="execute").observe(
+            (req.exec_end_ns - req.exec_start_ns) / 1e9)
+        lat.labels(model=name, stage="e2e").observe(
+            (t_reply - req.submit_ns) / 1e9)
+        trace_id, parent = req.trace_ctx
+        if not trace_id:
+            return
+        root = tracing.record_span(
+            "serving.request", trace_id, parent, req.submit_ns, t_reply,
+            cat="serving",
+            attrs={"model": name, "variant": req.variant,
+                   "rows": req.rows})
+        tracing.record_span("serving.queue", trace_id, root,
+                            req.submit_ns, req.dequeue_ns,
+                            cat="serving")
+        tracing.record_span("serving.batch", trace_id, root,
+                            req.dequeue_ns, req.exec_start_ns,
+                            cat="serving",
+                            attrs={"bucket": bucket,
+                                   "requests": batch_size})
+        tracing.record_span("serving.execute", trace_id, root,
+                            req.exec_start_ns, req.exec_end_ns,
+                            cat="serving",
+                            attrs={"bucket": bucket, "replica": replica,
+                                   "variant": req.variant})
+        tracing.record_span("serving.reply", trace_id, root,
+                            req.exec_end_ns, t_reply, cat="serving")
+
+
+class ModelRegistry:
+    """Name -> :class:`Model`, with get-or-error semantics."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._models = {}
+
+    def add(self, model):
+        with self._lock:
+            if model.name in self._models:
+                raise ServingError(
+                    f"serving: model {model.name!r} already registered")
+            self._models[model.name] = model
+
+    def get(self, name):
+        with self._lock:
+            m = self._models.get(name)
+        if m is None:
+            raise ServingError(
+                f"serving: unknown model {name!r} (registered: "
+                f"{self.names()})")
+        return m
+
+    def pop(self, name):
+        with self._lock:
+            return self._models.pop(name, None)
+
+    def names(self):
+        with self._lock:
+            return sorted(self._models)
+
+    def models(self):
+        with self._lock:
+            return list(self._models.values())
+
+
+class Gateway:
+    """The serving front. See the module docstring; quickstart::
+
+        gw = serving.Gateway()
+        gw.register("resnet", symbol, arg_params, aux_params,
+                    input_shapes={"data": (3, 224, 224)},
+                    variants=("fp32", "bf16", "int8"),
+                    calib_data=calib_batch, max_batch=32)
+        out = gw.infer("resnet", x, variant="int8")   # numpy in/out
+        gw.close()
+    """
+
+    def __init__(self, devices=None):
+        self.registry = ModelRegistry()
+        self._devices = list(devices) if devices is not None else None
+        self._closed = False
+        self._health_thread = None
+        self._health_stop = threading.Event()
+        period = get_env("MXTPU_SERVING_HEALTH_SEC", 0.0, float)
+        if period > 0:
+            self._health_thread = threading.Thread(
+                target=self._health_loop, args=(period,), daemon=True,
+                name="mxtpu-serve-health")
+            self._health_thread.start()
+
+    # -- registration --------------------------------------------------------
+    def _pick_devices(self, n):
+        from ..parallel.mesh import replica_devices
+        # self._devices None = the full local mesh, re-read per
+        # registration (a constructor-pinned pool stays pinned)
+        picked, degraded = replica_devices(n, devices=self._devices)
+        if degraded:
+            # SNIPPETS [2] degrade pattern (parallel/mesh.py): serve
+            # with the mesh that exists instead of refusing — replicas
+            # wrap around onto shared devices
+            logger.warning(
+                "serving: %d replicas requested but only %d local "
+                "device(s); degrading (replicas share devices)",
+                n, len(set(map(str, picked))))
+        return picked
+
+    def register(self, name, symbol, arg_params, aux_params,
+                 input_shapes, variants=("fp32",), calib_data=None,
+                 calib_mode="naive", excluded_sym_names=None,
+                 buckets=None, max_batch=None, max_wait_ms=None,
+                 max_queue=None, slo_ms=None, replicas=None,
+                 input_dtype="float32", int8_lowering="auto",
+                 warmup=True):
+        """Register a model and AOT-compile its serving executables.
+
+        ``input_shapes`` is ``{input_name: feature_shape}`` for the ONE
+        data input — feature shape WITHOUT the batch dim (the batch dim
+        is the gateway's: requests are coalesced along it).
+        ``max_batch`` defaults to the largest of ``buckets`` (or 32).
+        ``slo_ms`` of 0/None disables latency-budget rejection;
+        ``max_wait_ms``/``max_queue``/``replicas`` default from the
+        ``MXTPU_SERVING_*`` env knobs.
+        """
+        if self._closed:
+            raise ServingError("serving: gateway is closed")
+        if len(input_shapes) != 1:
+            raise ServingError(
+                "serving: exactly one data input per model (got "
+                f"{sorted(input_shapes)}); bake constants into params")
+        (input_name, feature_shape), = input_shapes.items()
+        if buckets is None:
+            buckets = default_buckets(max_batch or 32)
+        else:
+            buckets = tuple(sorted(set(int(b) for b in buckets)))
+            if max_batch is not None and buckets[-1] != int(max_batch):
+                raise ServingError(
+                    f"serving: max_batch {max_batch} != largest bucket "
+                    f"{buckets[-1]}")
+        if max_wait_ms is None:
+            max_wait_ms = get_env("MXTPU_SERVING_MAX_WAIT_MS", 5.0,
+                                  float)
+        if max_queue is None:
+            max_queue = int(get_env("MXTPU_SERVING_MAX_QUEUE", 256,
+                                    int))
+        if slo_ms is None:
+            slo_ms = get_env("MXTPU_SERVING_SLO_MS", 0.0, float)
+        if replicas is None:
+            replicas = int(get_env("MXTPU_SERVING_REPLICAS", 1, int))
+        if replicas < 1:
+            raise ServingError(
+                f"serving: replicas must be >= 1, got {replicas}")
+        if name in self.registry.names():
+            # fail BEFORE paying replicas x variants x buckets of
+            # compilation (and before health gauges record phantom
+            # replicas); registry.add re-checks authoritatively
+            raise ServingError(
+                f"serving: model {name!r} already registered")
+        model = Model(name, buckets, max_wait_s=max_wait_ms / 1e3,
+                      max_queue=max_queue,
+                      slo_s=(slo_ms / 1e3) if slo_ms else None,
+                      variants=tuple(variants))
+        t0 = clock.now_ns()
+        met = _met()
+        for idx, device in enumerate(self._pick_devices(replicas)):
+            vs = VariantSet(symbol, arg_params, aux_params, input_name,
+                            feature_shape, variants=variants,
+                            device=device, calib_data=calib_data,
+                            calib_mode=calib_mode,
+                            excluded_sym_names=excluded_sym_names,
+                            input_dtype=input_dtype,
+                            int8_lowering=int8_lowering)
+            rep = Replica(model, idx, device, vs)
+            if warmup:
+                model.executables += vs.warmup(buckets)
+            model.replicas.append(rep)
+        model.warmup_seconds = (clock.now_ns() - t0) / 1e9
+        self.registry.add(model)
+        # gauges + lanes only once registration is committed: a build
+        # failing on replica k must not leave phantom healthy=1 series
+        # for a model that never existed
+        for rep in model.replicas:
+            met["healthy"].labels(model=name,
+                                  replica=str(rep.idx)).set(1)
+            rep.start()
+        logger.info(
+            "serving: registered %r — %d replica(s) x %d variant(s) x "
+            "%d bucket(s), warmup %.1fs", name, len(model.replicas),
+            len(model.variants), len(buckets), model.warmup_seconds)
+        return model
+
+    def register_checkpoint(self, name, prefix, epoch, input_shapes,
+                            **kwargs):
+        """Register from ``prefix-symbol.json`` + ``prefix-NNNN.params``
+        (the MXPredCreate file contract predictor.py follows)."""
+        from ..model import load_checkpoint
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return self.register(name, symbol, arg_params, aux_params,
+                             input_shapes, **kwargs)
+
+    def unregister(self, name):
+        model = self.registry.pop(name)
+        if model is None:
+            return
+        self._shutdown_model(model)
+
+    # -- request path --------------------------------------------------------
+    def submit(self, model, data, variant="fp32"):
+        """Admit + enqueue one request; returns the :class:`Request`
+        future. Raises :class:`RejectedError` (fast, in the caller's
+        thread) when admission sheds it."""
+        m = self.registry.get(model)
+        met = _met()
+        if variant not in m.variants:
+            raise ServingError(
+                f"serving: model {model!r} has no {variant!r} variant "
+                f"(registered: {m.variants})")
+        arr = np.asarray(data)
+        vs = m.replicas[0].variant_set
+        if arr.ndim == len(vs.feature_shape):    # single sample
+            arr = arr[None]
+        if tuple(arr.shape[1:]) != vs.feature_shape:
+            raise ServingError(
+                f"serving: input shape {tuple(arr.shape)} does not "
+                f"match (rows,) + {vs.feature_shape}")
+        if arr.shape[0] < 1 or arr.shape[0] > m.buckets[-1]:
+            raise ServingError(
+                f"serving: request rows {arr.shape[0]} outside "
+                f"[1, {m.buckets[-1]}] (split large batches client-"
+                "side)")
+        arr = arr.astype(vs.input_dtype, copy=False)
+        reason = self._admit(m, arr.shape[0])
+        if reason is not None:
+            met["rejected"].labels(model=model, reason=reason).inc()
+            raise RejectedError(reason, self._reject_msg(m, reason))
+        ctx = tracing.context()
+        if not ctx[0]:
+            ctx = tracing.new_context()
+        req = Request(model, variant, arr, ctx)
+        try:
+            m.queue.put(req)
+        except RejectedError:
+            met["rejected"].labels(model=model, reason="closed").inc()
+            raise
+        # counted only once actually enqueued: a closed-race request
+        # must not show up as both admitted and rejected
+        met["requests"].labels(model=model, variant=variant).inc()
+        if not any(r.healthy for r in m.replicas):
+            # the last lane died between admission and enqueue: its
+            # _redistribute drain already ran, so nothing will ever
+            # serve this queue — drain-fail (covers our request too)
+            for r in m.queue.drain():
+                r._set_error(ServingError(
+                    f"serving: no healthy replica left for {model!r}"))
+        met["depth"].labels(model=model).set(m.queue.depth())
+        return req
+
+    def _admit(self, m, rows):
+        """None to admit, or the rejection reason. Pure bookkeeping —
+        no locks beyond the queue's counters, no device work: overload
+        is shed in microseconds."""
+        if self._closed or m.queue.closed:
+            return "closed"
+        if not any(r.healthy for r in m.replicas):
+            return "no_replica"
+        if m.queue.depth() >= m.max_queue:
+            return "queue_full"
+        if m.slo_s:
+            est = m.estimate_latency_s(rows)
+            if est is not None and est > m.slo_s:
+                return "slo"
+        return None
+
+    def _reject_msg(self, m, reason):
+        if reason == "queue_full":
+            return (f"serving: {m.name!r} queue at depth limit "
+                    f"{m.max_queue} — shed (retry with backoff)")
+        if reason == "slo":
+            return (f"serving: {m.name!r} backlog would exceed the "
+                    f"{m.slo_s * 1e3:.0f}ms latency budget — shed")
+        if reason == "no_replica":
+            return f"serving: {m.name!r} has no healthy replica"
+        return f"serving: {m.name!r} is shutting down"
+
+    def infer(self, model, data, variant="fp32", timeout=30.0):
+        """Blocking request: numpy in, list-of-numpy out."""
+        return self.submit(model, data, variant=variant).result(timeout)
+
+    # -- health / introspection ---------------------------------------------
+    def check_health(self, model=None, revive=True):
+        """Probe every replica off-queue; drained replicas whose probe
+        passes rejoin when ``revive``. Returns {model: [bool, ...]}."""
+        models = [self.registry.get(model)] if model is not None \
+            else self.registry.models()
+        out = {}
+        met = _met()
+        for m in models:
+            states = []
+            for rep in m.replicas:
+                ok = rep.probe()
+                if ok and not rep.healthy and revive and \
+                        not m.queue.closed:
+                    rep.healthy = True
+                    # always a FRESH lane: the generation bump retires
+                    # any parked old scheduler (it hands back its next
+                    # batch and exits), so revive can't race a thread
+                    # that is mid-exit — nor leak one that isn't
+                    rep.start()
+                    logger.info("serving: replica %d of %r revived",
+                                rep.idx, m.name)
+                elif not ok and rep.healthy:
+                    rep.healthy = False
+                    logger.warning(
+                        "serving: replica %d of %r failed its health "
+                        "probe — drained", rep.idx, m.name)
+                met["healthy"].labels(
+                    model=m.name, replica=str(rep.idx)).set(
+                        1 if rep.healthy else 0)
+                states.append(rep.healthy)
+            if not m.queue.closed and not any(states):
+                # the probe drained the LAST lane — schedulers that
+                # exit between batches never touch the queue, so
+                # pending requests must drain-fail here (every other
+                # no-replica path already does)
+                for req in m.queue.drain():
+                    req._set_error(ServingError(
+                        f"serving: no healthy replica left for "
+                        f"{m.name!r} (health probe drained the last "
+                        "lane)"))
+            out[m.name] = states
+        return out
+
+    def _health_loop(self, period):
+        while not self._health_stop.wait(period):
+            try:
+                self.check_health()
+            except Exception:  # noqa: BLE001 — health must not crash
+                pass           # the gateway
+
+    def health(self):
+        """{model: [replica healthy flags]} without probing."""
+        return {m.name: [r.healthy for r in m.replicas]
+                for m in self.registry.models()}
+
+    def stats(self):
+        """Bounded per-model snapshot (queue depth, service-rate
+        estimates, replica states, executables compiled)."""
+        out = {}
+        for m in self.registry.models():
+            with m._rate_lock:
+                exec_s, rate = m._exec_s, m._rows_per_s
+            out[m.name] = {
+                "queue_depth": m.queue.depth(),
+                "pending_rows": m.queue.pending_rows(),
+                "buckets": list(m.buckets),
+                "variants": list(m.variants),
+                "max_queue": m.max_queue,
+                "slo_ms": m.slo_s * 1e3 if m.slo_s else None,
+                "max_wait_ms": m.queue.max_wait_s * 1e3,
+                "replicas": [
+                    {"idx": r.idx, "device": str(r.device),
+                     "healthy": r.healthy} for r in m.replicas],
+                "int8_lowering": (m.replicas[0].variant_set
+                                  .int8_lowering if m.replicas
+                                  else None),
+                "ewma_exec_s": exec_s,
+                "ewma_rows_per_s": rate,
+                "executables": m.executables,
+                "warmup_seconds": round(m.warmup_seconds, 3),
+            }
+        return out
+
+    # -- shutdown ------------------------------------------------------------
+    def _shutdown_model(self, model):
+        model.queue.close()
+        for rep in model.replicas:
+            rep.join(timeout=5.0)
+        for req in model.queue.drain():
+            req._set_error(ServingError(
+                f"serving: model {model.name!r} shut down before the "
+                "request executed"))
+
+    def close(self):
+        """Drain and stop everything; pending requests fail cleanly."""
+        if self._closed:
+            return
+        self._closed = True
+        self._health_stop.set()
+        for name in self.registry.names():
+            self.unregister(name)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -38,8 +38,9 @@ from ..base import get_env
 from . import clock
 
 __all__ = ["span", "span_at", "traced", "record_span", "current",
-           "context", "enabled", "set_sample", "drain", "spans_snapshot",
-           "reset", "clock", "flight", "export", "rings"]
+           "context", "new_context", "enabled", "set_sample", "drain",
+           "spans_snapshot", "reset", "clock", "flight", "export",
+           "rings"]
 
 _SAMPLE = [get_env("MXTPU_TRACE_SAMPLE", 1.0, float)]
 _RING_CAP = max(int(get_env("MXTPU_TRACE_RING", 2048, int)), 16)
@@ -305,6 +306,24 @@ def context():
     if cur is None:
         return (0, 0)
     return (cur.trace_id, cur.span_id)
+
+
+def new_context():
+    """Mint a fresh ``(trace_id, 0)`` context for a root that will be
+    recorded externally via :func:`record_span` — e.g. a serving
+    request entering the gateway with no enclosing span still needs a
+    trace id to carry through queue → batch → execute → reply.
+    ``(0, 0)`` when tracing is disabled — and the fractional
+    MXTPU_TRACE_SAMPLE roll applies exactly as it does to a root
+    :func:`span` (record_span records unconditionally for a nonzero
+    trace id, so skipping the dice here would trace 100%% of serving
+    requests at a 1%% sampling setting)."""
+    s = _SAMPLE[0]
+    if s <= 0.0:
+        return (0, 0)
+    if s < 1.0 and _rng.random() >= s:
+        return (0, 0)
+    return (_new_id(), 0)
 
 
 def traced(fn=None, name=None, cat=None):

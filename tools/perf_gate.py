@@ -5,6 +5,7 @@
     python tools/perf_gate.py bench_out.json --tolerance 0.2 \\
         --tol mfu_bf16=0.1 --tol resnet50_inference_int8_bs128=0.3
     python tools/perf_gate.py io_bench.json --io
+    python tools/perf_gate.py serving_bench.json --serving
 
 ``--io`` gates a tools/io_bench.py version-2 artifact instead: every
 stage's img/s must stay within tolerance of the committed last-good
@@ -13,6 +14,16 @@ must hold its ratio over the single-process DataLoader baseline, and
 the train-loop input-wait fraction with device prefetch must stay
 under ``--io-max-wait`` (the "input wait < 5% of step" contract,
 measured by mx_step_data_seconds — ROADMAP item 4).
+
+``--serving`` gates a tools/serving_bench.py version-1 artifact
+against ``docs/artifacts/SERVING_LAST_GOOD.json``: per-stage req/s
+within tolerance, the concurrent stage's p99 must not GROW beyond
+tolerance, dynamic batching must hold ``--serving-min-gain`` (3x)
+over serial bs=1 dispatch, the bs=1 INT8 variant must not lose to
+fp32 (``--serving-int8-max``), the gateway's padded/batched fp32
+output must be bitwise identical to direct Predictor.forward, and
+the dispatch-overhead probe must be present (VERDICT Missing #4's
+committed number).
 
 Compares a bench artifact against the committed last-good measurement
 (``docs/artifacts/BENCH_LAST_GOOD.json`` unless ``--last-good``) with
@@ -50,6 +61,8 @@ DEFAULT_LAST_GOOD = os.path.join(REPO, "docs", "artifacts",
                                  "BENCH_LAST_GOOD.json")
 DEFAULT_IO_LAST_GOOD = os.path.join(REPO, "docs", "artifacts",
                                     "IO_LAST_GOOD.json")
+DEFAULT_SERVING_LAST_GOOD = os.path.join(REPO, "docs", "artifacts",
+                                         "SERVING_LAST_GOOD.json")
 
 # metrics compared when both sides carry them; values are "bigger is
 # better" throughputs/ratios
@@ -246,6 +259,128 @@ def gate_io(candidate, last_good, tolerance=0.25, min_ratio=3.0,
     return rc, msgs
 
 
+def _serving_stage_rates(doc):
+    """{stage: req_per_s} from a serving_bench v1 artifact."""
+    out = {}
+    for stage, s in (doc.get("stages") or {}).items():
+        if isinstance(s, dict) and \
+                isinstance(s.get("req_per_s"), (int, float)):
+            out[stage] = float(s["req_per_s"])
+    return out
+
+
+def gate_serving(candidate, last_good, tolerance=0.25, min_gain=3.0,
+                 int8_max=1.05):
+    """(exit_code, [messages]) for a serving_bench artifact pair.
+
+    Directions: stage req/s falls -> regression; concurrent p99 GROWS
+    beyond tolerance -> regression (latency is a ceiling, not a
+    floor); batching_gain and the int8<=fp32 contract are absolute
+    floors/ceilings, not relative to last-good. Divergence is binary:
+    the gateway's padded execution must be bitwise identical to
+    direct Predictor.forward — any epsilon means padding leaked into
+    live rows. ``int8_max`` defaults to 1.05 (5% timer noise on a
+    fresh run); the tier-1 self-test pins the COMMITTED artifact to
+    the strict 1.0."""
+    msgs = []
+    rc = 0
+    if candidate.get("tool") != "serving_bench" or \
+            candidate.get("version") != 1:
+        return 2, ["not a version-1 serving_bench artifact"]
+    mine = _serving_stage_rates(candidate)
+    good = _serving_stage_rates(last_good)
+    if not mine:
+        return 3, ["serving artifact carries no stage throughputs "
+                   "(signal-free — rejected)"]
+    for stage in sorted(set(mine) & set(good)):
+        a, b = good[stage], mine[stage]
+        if a <= 0:
+            continue
+        if b < (1.0 - tolerance) * a:
+            rc = 1
+            msgs.append("REGRESSION serving[%s]: %.0f req/s < %.0f "
+                        "(last good %.0f, tolerance %.0f%%)"
+                        % (stage, b, (1.0 - tolerance) * a, a,
+                           tolerance * 100))
+        else:
+            msgs.append("serving[%s]: %.0f req/s vs %.0f (ok)"
+                        % (stage, b, a))
+    conc = (candidate.get("stages") or {}).get(
+        "gateway_concurrent_fp32") or {}
+    good_conc = (last_good.get("stages") or {}).get(
+        "gateway_concurrent_fp32") or {}
+    p99, good_p99 = conc.get("p99_ms"), good_conc.get("p99_ms")
+    if isinstance(p99, (int, float)) and \
+            isinstance(good_p99, (int, float)) and good_p99 > 0:
+        if p99 > (1.0 + tolerance) * good_p99:
+            rc = 1
+            msgs.append("REGRESSION serving p99: %.1fms > %.1fms "
+                        "(last good %.1fms, tolerance %.0f%%)"
+                        % (p99, (1.0 + tolerance) * good_p99,
+                           good_p99, tolerance * 100))
+        else:
+            msgs.append("serving p99: %.1fms vs %.1fms (ok)"
+                        % (p99, good_p99))
+    elif isinstance(good_p99, (int, float)) and good_p99 > 0:
+        # the concurrent stage completed zero requests (lat_stats
+        # skipped) — latency collapsed entirely; the ceiling must not
+        # silently un-enforce exactly then
+        rc = 1
+        msgs.append("REGRESSION serving p99: candidate carries no "
+                    "p99_ms for gateway_concurrent_fp32 (last good "
+                    "%.1fms)" % good_p99)
+    ratios = candidate.get("ratios") or {}
+    gain = ratios.get("batching_gain")
+    if not isinstance(gain, (int, float)):
+        rc = 1
+        msgs.append("REGRESSION serving: missing batching_gain")
+    elif gain < min_gain:
+        rc = 1
+        msgs.append("REGRESSION serving: batching gain %.2fx < "
+                    "required %.1fx over serial bs=1 dispatch"
+                    % (gain, min_gain))
+    else:
+        msgs.append("serving batching gain: %.2fx (>= %.1fx ok)"
+                    % (gain, min_gain))
+    int8 = ratios.get("int8_vs_fp32_bs1")
+    if not isinstance(int8, (int, float)):
+        rc = 1
+        msgs.append("REGRESSION serving: missing int8_vs_fp32_bs1")
+    elif int8 > int8_max:
+        rc = 1
+        msgs.append("REGRESSION serving: int8 bs=1 latency %.4fx "
+                    "fp32 > allowed %.2fx (lowering: %s)"
+                    % (int8, int8_max,
+                       candidate.get("int8_lowering")))
+    else:
+        msgs.append("serving int8 bs=1: %.4fx fp32 (<= %.2fx ok, "
+                    "lowering: %s)"
+                    % (int8, int8_max, candidate.get("int8_lowering")))
+    div = candidate.get("divergence") or {}
+    if div.get("bitwise_equal") is True and \
+            div.get("max_abs_fp32") == 0.0:
+        msgs.append("serving divergence: batched == direct, bitwise "
+                    "(ok)")
+    else:
+        rc = 1
+        msgs.append("REGRESSION serving: batched output diverges "
+                    "from direct Predictor.forward (max_abs=%s, "
+                    "bitwise=%s)" % (div.get("max_abs_fp32"),
+                                     div.get("bitwise_equal")))
+    disp = (candidate.get("stages") or {}).get("dispatch_overhead_bs1")
+    if isinstance(disp, dict) and \
+            isinstance(disp.get("python_dispatch_ms"), (int, float)):
+        msgs.append("serving dispatch probe: %.3fms python / "
+                    "%.3fms wall at bs=1 (recorded)"
+                    % (disp["python_dispatch_ms"],
+                       disp.get("wall_ms_per_call", 0.0)))
+    else:
+        rc = 1
+        msgs.append("REGRESSION serving: missing dispatch_overhead_"
+                    "bs1 probe (the VERDICT Missing #4 number)")
+    return rc, msgs
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="perf_gate",
                                  description=__doc__.splitlines()[0])
@@ -275,7 +410,42 @@ def main(argv=None):
     ap.add_argument("--io-max-wait", type=float, default=0.05,
                     help="max input-wait fraction of step time with "
                          "device prefetch on (0.05)")
+    ap.add_argument("--serving", action="store_true",
+                    help="gate a tools/serving_bench.py v1 artifact "
+                         "(stage req/s + p99 ceiling + batching gain "
+                         "+ int8<=fp32 + zero divergence)")
+    ap.add_argument("--serving-min-gain", type=float, default=3.0,
+                    help="required gateway-concurrent / serial-bs1 "
+                         "throughput ratio (3.0)")
+    ap.add_argument("--serving-int8-max", type=float, default=1.05,
+                    help="max allowed int8/fp32 bs=1 latency ratio "
+                         "(1.05 = 5%% timer noise on fresh runs; the "
+                         "committed artifact is pinned to 1.0 by the "
+                         "tier-1 self-test)")
     args = ap.parse_args(argv)
+    if args.serving:
+        last_good_path = args.last_good
+        if last_good_path == DEFAULT_LAST_GOOD:
+            last_good_path = DEFAULT_SERVING_LAST_GOOD
+        try:
+            with open(args.artifact, "r", encoding="utf-8") as f:
+                candidate = json.load(f)
+            with open(last_good_path, "r", encoding="utf-8") as f:
+                last_good = json.load(f)
+        except (OSError, ValueError) as e:
+            print("perf_gate: cannot read serving artifact: %s" % e,
+                  file=sys.stderr)
+            return 2
+        rc, msgs = gate_serving(candidate, last_good,
+                                tolerance=args.tolerance,
+                                min_gain=args.serving_min_gain,
+                                int8_max=args.serving_int8_max)
+        for m in msgs:
+            print(m)
+        print("perf_gate: %s"
+              % {0: "PASS", 1: "REGRESSION", 2: "UNREADABLE",
+                 3: "BARE-ZERO"}.get(rc, rc))
+        return rc
     if args.io:
         last_good_path = args.last_good
         if last_good_path == DEFAULT_LAST_GOOD:
