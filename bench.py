@@ -132,6 +132,14 @@ def _serving_summary():
                             or {}).get("p50_ms"),
         "dispatch": stages.get("dispatch_overhead_bs1"),
     }
+    gen = stages.get("generate") or {}
+    if gen:
+        out["generate"] = {
+            "tokens_per_s": gen.get("tokens_per_s"),
+            "inter_token_p99_ms": gen.get("inter_token_p99_ms"),
+            "cache_mean_used_frac": (gen.get("cache_occupancy")
+                                     or {}).get("mean_used_frac"),
+        }
     return out
 
 

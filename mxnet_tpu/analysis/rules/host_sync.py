@@ -66,6 +66,20 @@ _SCOPES = (
       "group_by_op", "tag_role", "tag_tree", "role_of",
       "live_census", "buffer_intervals", "build_memory_ledger",
       "group_buffers_by_op", "_sweep_peak"}, set()),
+    # the generative decode plane's hot paths run once per TOKEN, not
+    # per request: scheduler step + prefill, cache alloc/free/
+    # reservation, token emission, and admission. A sync in any of
+    # them serializes every in-flight generation stream at once.
+    # (GenLane._host_tokens IS the token reply transfer — generated
+    # ids must reach the host to stream to clients — and lives outside
+    # this list by design, exactly like Replica._run_batch's reply.)
+    # NOTE: listed before the general serving/ scope — first prefix
+    # match wins.
+    ("mxnet_tpu/serving/generate/",
+     {"submit_generate", "try_admit", "_step", "_prefill", "_emit",
+      "_observe_pool", "ensure_position", "extend", "alloc", "free",
+      "reserve", "unreserve", "blocks_for", "used_blocks",
+      "reserved_blocks", "swap", "prefill", "decode"}, set()),
     # the serving gateway's per-request paths: admission + enqueue run
     # in every client thread, coalescing + reply recording in every
     # replica scheduler — a sync in any of them serializes the whole
@@ -75,7 +89,8 @@ _SCOPES = (
     ("mxnet_tpu/serving/",
      {"submit", "infer", "_admit", "put", "take_batch", "requeue",
       "_scoop", "depth", "pending_rows", "_reply", "_observe_rate",
-      "estimate_latency_s", "pad_batch", "pick_bucket"}, set()),
+      "estimate_latency_s", "pad_batch", "pick_bucket",
+      "submit_generate"}, set()),
 )
 
 # calls that block on (or copy from) the device stream

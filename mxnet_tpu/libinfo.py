@@ -222,6 +222,20 @@ _ENV_VARS = {
         ">0 starts the gateway health-probe daemon at this period: "
         "failed replicas drain, recovered ones rejoin (default 0 = "
         "manual check_health(); serving/gateway.py)"),
+    "MXTPU_GEN_BLOCK_TOKENS": (
+        "default KV-cache block size in tokens for registered "
+        "generators — the paged-attention page granularity (default "
+        "16; serving/generate/, docs/serving.md)"),
+    "MXTPU_GEN_MAX_BLOCKS": (
+        "default KV block-pool size per generator replica lane; "
+        "block 0 is the reserved pad sink, and admission fast-rejects "
+        "kv_cache_full when the pool cannot cover a request's token "
+        "budget (default 256; serving/generate/kvcache.py)"),
+    "MXTPU_GEN_MAX_NEW_TOKENS": (
+        "default + cap for a generation request's max_new_tokens — "
+        "bounds the block-table width the compiled decode step is "
+        "traced with (default 64; serving/gateway.py "
+        "register_generator)"),
 }
 
 

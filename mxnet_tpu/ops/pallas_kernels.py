@@ -200,6 +200,142 @@ def _flash_diff_bwd(causal, scale, block_q, block_k, interpret, res, g):
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 
 
+# ---------------------------------------------------------------------------
+# paged decode attention (serving/generate/ — the KV cache lives in a
+# block pool, not a contiguous (B, T, H, D) array)
+# ---------------------------------------------------------------------------
+
+def _paged_gather_reference(q, k_cache, v_cache, block_tables, seq_lens,
+                            scale):
+    """jnp fallback + numerics oracle for the paged kernel: gather each
+    sequence's blocks back into a contiguous view and run dense masked
+    single-query attention.
+
+    q: (B, H, D) — ONE query token per sequence (the decode step).
+    k_cache/v_cache: (num_blocks, block_tokens, H, D) — the pool.
+    block_tables: (B, max_blocks) int32 — pool block ids per sequence,
+    padded with any valid id (masked out by seq_lens).
+    seq_lens: (B,) int32 — tokens visible per sequence (0 = padding
+    row: output is garbage and must be discarded by the caller).
+    """
+    b, n_max = block_tables.shape
+    bt = k_cache.shape[1]
+    k = jnp.take(k_cache, block_tables, axis=0)     # (B, NB, BT, H, D)
+    v = jnp.take(v_cache, block_tables, axis=0)
+    k = k.reshape(b, n_max * bt, *k.shape[3:])      # (B, S, H, D)
+    v = v.reshape(b, n_max * bt, *v.shape[3:])
+    s = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32) * scale,
+                   k.astype(jnp.float32))
+    pos = jnp.arange(n_max * bt)[None, None, :]
+    s = jnp.where(pos < seq_lens[:, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhs,bshd->bhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_ref, l_ref, acc_ref, *, block_tokens, scale):
+    """One (sequence, block) program: the grid's second axis walks the
+    sequence's block table (scalar-prefetched, so the BlockSpec index
+    map gathers the right pool block into VMEM), folding each block
+    into an online-softmax accumulator — flash attention's streaming
+    trick applied across non-contiguous pool blocks."""
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.float32) * scale           # (H, D)
+    k_blk = k_ref[0].astype(jnp.float32)               # (BT, H, D)
+    v_blk = v_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k_blk, (((1,), (2,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32)            # (H, BT)
+    pos = i * block_tokens + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 1)
+    s = jnp.where(pos < lens_ref[b], s, NEG_INF)
+    m_prev = m_ref[...]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+    p = jnp.exp(s - m_new[:, None])
+    alpha = jnp.exp(m_prev - m_new)
+    l_ref[...] = alpha * l_ref[...] + p.sum(axis=-1)
+    acc_ref[...] = alpha[:, None] * acc_ref[...] + jnp.einsum(
+        "ht,thd->hd", p, v_blk)
+    m_ref[...] = m_new
+
+    @pl.when(i == pl.num_programs(1) - 1)
+    def _flush():
+        o_ref[0] = (acc_ref[...] /
+                    jnp.maximum(l_ref[...], 1e-30)[:, None]
+                    ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def _paged_call(q, k_cache, v_cache, block_tables, seq_lens, scale,
+                interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, d = q.shape
+    bt = k_cache.shape[1]
+    n_max = block_tables.shape[1]
+    kernel = functools.partial(_paged_kernel, block_tokens=bt,
+                               scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, n_max),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda s, i, t, sl: (s, 0, 0)),
+            pl.BlockSpec((1, bt, h, d),
+                         lambda s, i, t, sl: (t[s, i], 0, 0, 0)),
+            pl.BlockSpec((1, bt, h, d),
+                         lambda s, i, t, sl: (t[s, i], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda s, i, t, sl: (s, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((h,), jnp.float32),
+                        pltpu.VMEM((h,), jnp.float32),
+                        pltpu.VMEM((h, d), jnp.float32)],
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      q, k_cache, v_cache)
+
+
+def paged_attention(q, k_cache, v_cache, block_tables, seq_lens,
+                    scale=None, interpret=None, force=False):
+    """Single-query attention over a paged KV cache (the decode-step
+    kernel of serving/generate/, sibling of :func:`flash_attention`).
+
+    q: (B, H, D) — the current token's query per in-flight sequence.
+    k_cache/v_cache: (num_blocks, block_tokens, H, D) block pool.
+    block_tables: (B, max_blocks) int32 pool block ids per sequence
+    (rows padded with any valid block id). seq_lens: (B,) int32
+    visible tokens; a 0 row is batch padding — its output is garbage
+    by contract and the caller discards it.
+
+    Dispatches to the Pallas kernel on chip backends (the block gather
+    is the HBM-bound half of decode; one program per (sequence, block)
+    streams exactly the live blocks through VMEM) and to the jnp
+    gather fallback on CPU unless ``force`` (parity tests run the
+    kernel in interpret mode).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    if force or not interpret:
+        return _paged_call(q, k_cache, v_cache, block_tables, seq_lens,
+                           float(scale), bool(interpret))
+    return _paged_gather_reference(q, k_cache, v_cache, block_tables,
+                                   seq_lens, float(scale))
+
+
 def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
                     block_k=512, interpret=None, force=False):
     """Blockwise attention, O(T) memory. q, k, v: (B, H, T, D) or

@@ -19,7 +19,7 @@ is answered in three cooperating layers:
    CPU/TPU-identical in shape, so the whole ledger is tier-1 testable.
 
 2. **Runtime census**: classify ``jax.live_arrays()`` into parameter /
-   gradient / optimizer_state / io_buffer / activation roles via
+   gradient / optimizer_state / io_buffer / kv_cache / activation roles via
    NDArray-layer tagging (weakref side table — ``jax.Array`` objects
    are immutable, the tag lives next to them, never on them), reported
    **per device shard** via ``addressable_shards`` so a ZeRO-3 run
@@ -55,9 +55,13 @@ POSTMORTEM_VERSION = 1
 
 # the role taxonomy (docs/observability.md "Memory accounting").
 # "activation" is the default for any live array nothing tagged —
-# intermediates, eval results, user temporaries.
+# intermediates, eval results, user temporaries. "kv_cache" is the
+# serving decode plane's paged block pool (serving/generate/kvcache.py
+# tags both pool arrays and re-tags them after every donated step), so
+# the census, per-device gauges and the OOM postmortem name the cache
+# that dominates generative-serving HBM by its actual bytes.
 ROLES = ("parameter", "gradient", "optimizer_state", "io_buffer",
-         "activation")
+         "kv_cache", "activation")
 
 # ---------------------------------------------------------------------------
 # static liveness ledger

@@ -20,7 +20,14 @@ Pieces (ROADMAP item 1, the "millions of users" direction):
 - **N-replica scale-out** — request streams shard across per-device
   replicas, degrading gracefully to a single chip (SNIPPETS [2]'s
   mesh fallback), with health probes that drain and redistribute on
-  failure.
+  failure;
+- **generative decode plane** (:mod:`.generate`) — paged KV-cache
+  block pools (census role ``kv_cache``), iteration-level continuous
+  batching (requests join/leave the in-flight decode batch every
+  token), the single-query ``paged_attention`` Pallas kernel, and
+  ``Gateway.generate()`` streaming replies with ``kv_cache_full``
+  admission (knobs ``MXTPU_GEN_BLOCK_TOKENS`` /
+  ``MXTPU_GEN_MAX_BLOCKS`` / ``MXTPU_GEN_MAX_NEW_TOKENS``).
 
 Env knobs (libinfo._ENV_VARS / docs/env_vars.md):
 ``MXTPU_SERVING_MAX_WAIT_MS``, ``MXTPU_SERVING_MAX_QUEUE``,
@@ -34,8 +41,12 @@ from __future__ import annotations
 from .batcher import (ModelQueue, RejectedError, Request, ServingError,
                       pad_batch)
 from .gateway import Gateway, Model, ModelRegistry, Replica
+from .generate import (BlockPool, BlockTable, GenerativeDecoder,
+                       GenModel, GenRequest, reference_generate)
 from .variants import VariantSet, default_buckets, pick_bucket
 
-__all__ = ["Gateway", "Model", "ModelQueue", "ModelRegistry",
-           "RejectedError", "Replica", "Request", "ServingError",
-           "VariantSet", "default_buckets", "pad_batch", "pick_bucket"]
+__all__ = ["BlockPool", "BlockTable", "Gateway", "GenerativeDecoder",
+           "GenModel", "GenRequest", "Model", "ModelQueue",
+           "ModelRegistry", "RejectedError", "Replica", "Request",
+           "ServingError", "VariantSet", "default_buckets",
+           "pad_batch", "pick_bucket", "reference_generate"]

@@ -370,6 +370,8 @@ class Gateway:
 
     def __init__(self, devices=None):
         self.registry = ModelRegistry()
+        self._generators = {}          # name -> generate.GenModel
+        self._gen_lock = threading.Lock()
         self._devices = list(devices) if devices is not None else None
         self._closed = False
         self._health_thread = None
@@ -448,6 +450,10 @@ class Gateway:
             # replicas); registry.add re-checks authoritatively
             raise ServingError(
                 f"serving: model {name!r} already registered")
+        with self._gen_lock:
+            if name in self._generators:
+                raise ServingError(
+                    f"serving: generator {name!r} already registered")
         model = Model(name, buckets, max_wait_s=max_wait_ms / 1e3,
                       max_queue=max_queue,
                       slo_s=(slo_ms / 1e3) if slo_ms else None,
@@ -491,10 +497,150 @@ class Gateway:
                              input_shapes, **kwargs)
 
     def unregister(self, name):
+        with self._gen_lock:
+            gen = self._generators.pop(name, None)
+        if gen is not None:
+            gen.close()
         model = self.registry.pop(name)
         if model is None:
             return
         self._shutdown_model(model)
+
+    # -- generative decode ---------------------------------------------------
+    def register_generator(self, name, decoder, block_tokens=None,
+                           max_blocks=None, max_new_tokens=None,
+                           max_decode_batch=8, max_queue=None,
+                           replicas=None, warmup=True):
+        """Register a decoder LM for token-granular generation.
+
+        ``decoder`` is a :class:`~.generate.GenerativeDecoder` (gluon
+        transformer LM + config). Each replica lane gets a device (via
+        the same ``parallel`` placement the one-shot path uses), a
+        paged KV block pool of ``max_blocks`` x ``block_tokens``-token
+        blocks (census role ``kv_cache``), and AOT-warmed prefill /
+        decode executables — steady-state decode never retraces.
+        ``max_new_tokens`` is the per-request generation cap (and the
+        default for requests that don't pass one); the knob defaults
+        come from ``MXTPU_GEN_BLOCK_TOKENS`` / ``MXTPU_GEN_MAX_BLOCKS``
+        / ``MXTPU_GEN_MAX_NEW_TOKENS``.
+        """
+        from .generate.scheduler import GenModel
+
+        if self._closed:
+            raise ServingError("serving: gateway is closed")
+        if block_tokens is None:
+            block_tokens = int(get_env("MXTPU_GEN_BLOCK_TOKENS", 16,
+                                       int))
+        if max_blocks is None:
+            max_blocks = int(get_env("MXTPU_GEN_MAX_BLOCKS", 256, int))
+        if max_new_tokens is None:
+            max_new_tokens = int(get_env("MXTPU_GEN_MAX_NEW_TOKENS",
+                                         64, int))
+        if max_queue is None:
+            max_queue = int(get_env("MXTPU_SERVING_MAX_QUEUE", 256,
+                                    int))
+        if replicas is None:
+            replicas = int(get_env("MXTPU_SERVING_REPLICAS", 1, int))
+        if replicas < 1:
+            raise ServingError(
+                f"serving: replicas must be >= 1, got {replicas}")
+        with self._gen_lock:
+            if name in self._generators:
+                raise ServingError(
+                    f"serving: generator {name!r} already registered")
+        if name in self.registry.names():
+            raise ServingError(
+                f"serving: model {name!r} already registered")
+        gen = GenModel(name, decoder,
+                       devices=self._pick_devices(replicas),
+                       block_tokens=block_tokens,
+                       max_blocks=max_blocks,
+                       max_new_tokens=max_new_tokens,
+                       max_decode_batch=max_decode_batch,
+                       max_queue=max_queue, warmup=warmup)
+        # re-check BOTH namespaces at insert: a concurrent register()
+        # or register_generator() of the same name can have landed
+        # while this one paid warmup
+        racing = name in self.registry.names()
+        if not racing:
+            with self._gen_lock:
+                if name in self._generators:
+                    racing = True
+                else:
+                    self._generators[name] = gen
+        if racing:
+            gen.close()
+            raise ServingError(
+                f"serving: model {name!r} already registered")
+        logger.info(
+            "serving: registered generator %r — %d lane(s), %d-token "
+            "blocks x %d, %d executables, warmup %.1fs", name,
+            len(gen.lanes), block_tokens, max_blocks, gen.executables,
+            gen.warmup_seconds)
+        return gen
+
+    def _get_generator(self, name):
+        with self._gen_lock:
+            gen = self._generators.get(name)
+        if gen is None:
+            raise ServingError(
+                f"serving: unknown generator {name!r} (registered: "
+                f"{sorted(self._generators)})")
+        return gen
+
+    def submit_generate(self, model, prompt, max_new_tokens=None):
+        """Admit one generation request; returns the streaming
+        :class:`~.generate.GenRequest` future. Fast-rejects with
+        :class:`RejectedError` (reason ``kv_cache_full`` when the
+        block pool cannot cover the request's token budget)."""
+        from .generate.scheduler import GenRequest, _met as _gen_met
+
+        gen = self._get_generator(model)
+        met = _gen_met()
+        if max_new_tokens is None:
+            max_new_tokens = gen.max_new_tokens
+        prompt = np.asarray(prompt, np.int32).ravel()
+        if len(prompt) < 1 or len(prompt) > gen.decoder.max_prompt_tokens:
+            raise ServingError(
+                f"serving: prompt of {len(prompt)} tokens outside "
+                f"[1, {gen.decoder.max_prompt_tokens}] for {model!r}")
+        if max_new_tokens < 1 or max_new_tokens > gen.max_new_tokens:
+            raise ServingError(
+                f"serving: max_new_tokens {max_new_tokens} outside "
+                f"[1, {gen.max_new_tokens}] for {model!r}")
+        ctx = tracing.context()
+        if not ctx[0]:
+            ctx = tracing.new_context()
+        req = GenRequest(model, prompt, max_new_tokens, ctx)
+        reason = "closed" if self._closed else gen.try_admit(req)
+        if reason is not None:
+            met["rejected"].labels(model=model, reason=reason).inc()
+            raise RejectedError(reason, self._gen_reject_msg(
+                gen, reason, len(prompt), max_new_tokens))
+        met["requests"].labels(model=model).inc()
+        return req
+
+    def _gen_reject_msg(self, gen, reason, plen, max_new):
+        if reason == "kv_cache_full":
+            need = gen.lanes[0].pool.blocks_for(plen + max_new)
+            return (f"serving: {gen.name!r} KV block pool cannot cover "
+                    f"{plen}+{max_new} tokens ({need} blocks) — shed "
+                    "(retry with backoff, or lower max_new_tokens)")
+        if reason == "queue_full":
+            return (f"serving: {gen.name!r} generation queue at depth "
+                    f"limit {gen.max_queue} — shed")
+        return f"serving: {gen.name!r} is shutting down"
+
+    def generate(self, model, prompt, max_new_tokens=None,
+                 stream=False, timeout=120.0):
+        """Greedy generation: token-id prompt in, generated token ids
+        out. ``stream=True`` returns the request itself — iterate
+        ``req.stream()`` for tokens as they decode."""
+        req = self.submit_generate(model, prompt,
+                                   max_new_tokens=max_new_tokens)
+        if stream:
+            return req
+        return req.result(timeout)
 
     # -- request path --------------------------------------------------------
     def submit(self, model, data, variant="fp32"):
@@ -660,6 +806,10 @@ class Gateway:
                 "executables": m.executables,
                 "warmup_seconds": round(m.warmup_seconds, 3),
             }
+        with self._gen_lock:
+            gens = list(self._generators.values())
+        for g in gens:
+            out[g.name] = {"generator": True, **g.stats()}
         return out
 
     # -- shutdown ------------------------------------------------------------
@@ -678,6 +828,10 @@ class Gateway:
             return
         self._closed = True
         self._health_stop.set()
+        with self._gen_lock:
+            gen_names = sorted(self._generators)
+        for name in gen_names:
+            self.unregister(name)
         for name in self.registry.names():
             self.unregister(name)
 

@@ -1,0 +1,40 @@
+"""Token-granular generative decode on the serving gateway.
+
+The PR 10 gateway serves fixed-shape one-shot inference; this package
+is the autoregressive half of the "millions of users" workload
+(ROADMAP item 1): requests with wildly different lengths, where the
+KV cache — not the weights — dominates HBM.
+
+Three pieces, all routed through the framework's own stack:
+
+- :mod:`.kvcache` — a **paged block pool** per replica lane: the
+  cache is fixed-size token blocks + per-request block tables (the
+  vLLM move), byte-accounted through the PR 7 memory census as role
+  ``kv_cache`` so gauges and the OOM postmortem name it;
+- :mod:`.model` — the **decoder model path**: a gluon transformer LM
+  whose compiled prefill/decode steps run the framework's registered
+  ops plus the Pallas kernels (causal ``flash_attention`` for
+  prefill, the new single-query ``paged_attention`` for decode);
+- :mod:`.scheduler` — **iteration-level continuous batching**
+  (Orca-style): the in-flight decode batch is re-formed every token,
+  requests join after prefill and leave at EOS/budget mid-batch, and
+  admission fast-rejects ``kv_cache_full`` when the block pool cannot
+  cover a request's ``max_new_tokens`` budget.
+
+Entry points: ``Gateway.register_generator`` / ``Gateway.generate``
+(serving/gateway.py). Env knobs: ``MXTPU_GEN_BLOCK_TOKENS``,
+``MXTPU_GEN_MAX_BLOCKS``, ``MXTPU_GEN_MAX_NEW_TOKENS``. Bench + gate:
+the ``generate`` stage of tools/serving_bench.py, gated by
+``tools/perf_gate.py --serving``. Guide: docs/serving.md
+"Generative decode".
+"""
+from __future__ import annotations
+
+from .kvcache import PAD_BLOCK, BlockPool, BlockTable
+from .model import (CompiledDecodeSteps, GenerativeDecoder,
+                    reference_generate)
+from .scheduler import GenLane, GenModel, GenRequest
+
+__all__ = ["PAD_BLOCK", "BlockPool", "BlockTable",
+           "CompiledDecodeSteps", "GenerativeDecoder", "GenLane",
+           "GenModel", "GenRequest", "reference_generate"]

@@ -1,0 +1,214 @@
+"""Paged KV-cache block pool (the vLLM move, framework-native).
+
+Autoregressive decode is bounded by the KV cache, not the weights: a
+request's cache grows one token per step and lives until the request
+retires, so contiguous per-request buffers fragment HBM and force
+worst-case reservations. The pool below carves each replica's cache
+into fixed-size **token blocks** — one five-dim array pair per lane,
+``(layers, max_blocks, block_tokens, heads, head_dim)`` — and gives
+every request a *block table* of pool indices instead of contiguous
+storage. The decode kernel (:func:`~mxnet_tpu.ops.pallas_kernels.
+paged_attention`) gathers K/V straight through the table.
+
+Accounting is the point: the two arrays are tagged role=``kv_cache``
+through :mod:`mxnet_tpu.profiling.memory`, so the PR 7 census,
+``mx_memory_live_bytes{role="kv_cache"}`` per-device gauges, and the
+OOM postmortem all name the cache by its actual bytes — tokens/s and
+occupancy measure the product, not a side-channel estimate.
+
+Block 0 is the **pad sink**: batch-padding rows and unused prefill
+tail blocks point at it, so their scatter writes land in storage no
+live request reads. It is never allocated (``usable = max_blocks-1``).
+
+Admission integration: :meth:`BlockPool.reserve` commits the
+worst-case block budget of a request (``blocks_for(prompt +
+max_new_tokens)``) at submit time; allocation itself is incremental
+(prefill takes the prompt's blocks, decode takes one more each time a
+position crosses a block boundary), so occupancy reflects live tokens
+while admission can still fast-reject with ``kv_cache_full`` the
+moment the pool cannot cover a request's budget.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ...base import MXNetError
+
+PAD_BLOCK = 0
+
+
+class BlockPool:
+    """One replica lane's paged KV storage + free-list + reservation
+    ledger. Thread-safe: the lane scheduler allocates/frees, client
+    threads reserve/unreserve at admission."""
+
+    def __init__(self, num_layers, num_heads, head_dim, block_tokens,
+                 max_blocks, device=None, dtype="float32"):
+        import jax
+        import jax.numpy as jnp
+
+        from ...profiling import memory as _mem
+
+        if max_blocks < 2:
+            raise MXNetError(
+                "generate: max_blocks must be >= 2 (block 0 is the "
+                f"reserved pad sink), got {max_blocks}")
+        if block_tokens < 1:
+            raise MXNetError(
+                f"generate: block_tokens must be >= 1, got {block_tokens}")
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.block_tokens = int(block_tokens)
+        self.max_blocks = int(max_blocks)
+        self.device = device
+        self.dtype = np.dtype(dtype)
+        shape = (self.num_layers, self.max_blocks, self.block_tokens,
+                 self.num_heads, self.head_dim)
+        # two separate allocations: device_put of one zeros array
+        # twice returns the SAME buffer, which would alias K onto V
+        # (and halve the real footprint vs the claimed one)
+        self.k = jax.device_put(jnp.zeros(shape, self.dtype), device)
+        self.v = jax.device_put(jnp.zeros(shape, self.dtype), device)
+        _mem.tag_role(self.k, "kv_cache")
+        _mem.tag_role(self.v, "kv_cache")
+        self._lock = threading.Lock()
+        # LIFO free list: recently-freed blocks are re-issued first
+        # (their pool pages are the warmest)
+        self._free = list(range(self.max_blocks - 1, 0, -1))
+        self._reserved = 0
+
+    # -- sizes ---------------------------------------------------------------
+    @property
+    def usable_blocks(self):
+        return self.max_blocks - 1
+
+    @property
+    def bytes_total(self):
+        """Actual device bytes of the pool (both arrays) — the number
+        the census must agree with."""
+        return int(self.k.nbytes) + int(self.v.nbytes)
+
+    @property
+    def bytes_per_block(self):
+        return 2 * self.block_tokens * self.num_heads * self.head_dim \
+            * self.num_layers * self.dtype.itemsize
+
+    def blocks_for(self, tokens):
+        """Blocks covering ``tokens`` cache slots (ceil division)."""
+        t = int(tokens)
+        return max((t + self.block_tokens - 1) // self.block_tokens, 0)
+
+    # -- admission reservation ----------------------------------------------
+    def reserve(self, nblocks):
+        """Commit ``nblocks`` of worst-case budget; False when the pool
+        cannot cover it (the caller fast-rejects ``kv_cache_full``)."""
+        n = int(nblocks)
+        with self._lock:
+            if self._reserved + n > self.usable_blocks:
+                return False
+            self._reserved += n
+            return True
+
+    def unreserve(self, nblocks):
+        with self._lock:
+            self._reserved = max(self._reserved - int(nblocks), 0)
+
+    # -- allocation ----------------------------------------------------------
+    def alloc(self, n=1):
+        """Pop ``n`` block ids. A reservation-covered request can never
+        see an empty free list; hitting one is a ledger bug, not load."""
+        with self._lock:
+            if n > len(self._free):
+                raise MXNetError(
+                    "generate: block pool exhausted (%d asked, %d free) "
+                    "despite reservation — accounting bug" %
+                    (n, len(self._free)))
+            out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, block_ids):
+        with self._lock:
+            for b in block_ids:
+                b = int(b)
+                if b == PAD_BLOCK:
+                    continue
+                self._free.append(b)
+
+    # -- state ---------------------------------------------------------------
+    def used_blocks(self):
+        with self._lock:
+            return self.usable_blocks - len(self._free)
+
+    def reserved_blocks(self):
+        with self._lock:
+            return self._reserved
+
+    def occupancy(self):
+        """Bounded snapshot for stats()/bench artifacts."""
+        with self._lock:
+            free = len(self._free)
+            reserved = self._reserved
+        used = self.usable_blocks - free
+        return {
+            "block_tokens": self.block_tokens,
+            "usable_blocks": self.usable_blocks,
+            "used_blocks": used,
+            "free_blocks": free,
+            "reserved_blocks": reserved,
+            "used_frac": used / self.usable_blocks,
+            "bytes_total": self.bytes_total,
+            "bytes_per_block": self.bytes_per_block,
+        }
+
+    def swap(self, k, v):
+        """Adopt the cache arrays a (donating) jitted step returned,
+        re-tagging them — donation hands back fresh jax.Array objects
+        each step, and an untagged swap would silently reclassify the
+        whole cache as 'activation' in the census."""
+        from ...profiling import memory as _mem
+        self.k = _mem.tag_role(k, "kv_cache")
+        self.v = _mem.tag_role(v, "kv_cache")
+
+
+class BlockTable:
+    """One request's view of the pool: orderd block ids + the fixed-
+    width int32 row the decode step's gather reads (padded with the
+    pad sink)."""
+
+    __slots__ = ("pool", "blocks", "row")
+
+    def __init__(self, pool, width):
+        self.pool = pool
+        self.blocks = []
+        self.row = np.zeros(int(width), np.int32)
+
+    def extend(self, n):
+        """Append ``n`` freshly-allocated blocks. Capacity is checked
+        BEFORE allocating, so an overflow leaves no partial state —
+        freeing mid-append would return already-tracked blocks to the
+        pool twice and hand one block to two requests later."""
+        if n <= 0:
+            return self
+        if len(self.blocks) + n > len(self.row):
+            raise MXNetError(
+                "generate: block table overflow (%d blocks, width %d) "
+                "— admission should have rejected this request"
+                % (len(self.blocks) + n, len(self.row)))
+        for b in self.pool.alloc(n):
+            self.row[len(self.blocks)] = b
+            self.blocks.append(b)
+        return self
+
+    def ensure_position(self, pos):
+        """Grow the table so cache position ``pos`` has a block."""
+        need = pos // self.pool.block_tokens + 1 - len(self.blocks)
+        if need > 0:
+            self.extend(need)
+
+    def release(self):
+        self.pool.free(self.blocks)
+        self.blocks = []
+        self.row[:] = PAD_BLOCK

@@ -1,0 +1,509 @@
+"""Iteration-level continuous batching for generative decode
+(Orca-style: the batch is re-formed every *token*, not every request).
+
+The PR 10 gateway batches one-shot requests: a request joins exactly
+one executed batch. Generation breaks that — a 500-token request and
+a 5-token request in the same fixed batch would chain the short one
+to the long one's tail. Here each replica lane re-forms its in-flight
+batch every decode step:
+
+- **join**: waiting requests prefill (one padded prompt each through
+  the causal stack, K/V scattered into their pool blocks) and enter
+  the running set *between* steps — the very next decode step carries
+  them;
+- **step**: one token for every running request — tokens/positions/
+  block tables stacked to the smallest warmed batch bucket, one
+  compiled ``decode`` call, next greedy tokens back;
+- **leave**: a request that hits EOS or its ``max_new_tokens`` budget
+  retires immediately — its blocks return to the pool *that step*,
+  its reply stream closes, and the batch shrinks without stalling
+  anyone else.
+
+Admission is the gateway's fast-reject doctrine extended to cache
+bytes: a request reserves its worst-case block budget
+(``blocks_for(prompt + max_new_tokens)``) at submit; when no lane can
+cover it the request raises :class:`RejectedError` with reason
+``kv_cache_full`` — in the caller's thread, in microseconds, before
+anything queues.
+
+Host syncs: the scheduler's per-step device read is
+:meth:`GenLane._host_tokens` — the token *reply transfer*, which by
+definition must reach the host (the streaming iterator hands tokens
+to clients). Everything else on the step path is host bookkeeping —
+the MXL002 lint scope covers it.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+import numpy as np
+
+from ... import tracing
+from ...telemetry import metrics as _tm
+from ...tracing import clock
+from ..batcher import ServingError
+from ..variants import default_buckets, pick_bucket
+from .kvcache import BlockPool, BlockTable
+
+_met = _tm.lazy_metrics(lambda reg: {
+    "requests": reg.counter(
+        "mx_serving_generate_requests_total",
+        "admitted generation requests", labelnames=("model",)),
+    "rejected": reg.counter(
+        "mx_serving_generate_rejected_total",
+        "fast-rejected generation requests",
+        labelnames=("model", "reason")),
+    "tokens": reg.counter(
+        "mx_serving_generate_tokens_total",
+        "tokens through the decode plane (prefill = prompt tokens "
+        "consumed, decode = tokens generated)",
+        labelnames=("model", "phase")),
+    "steps": reg.counter(
+        "mx_serving_generate_steps_total",
+        "compiled step executions", labelnames=("model", "phase")),
+    "inflight": reg.gauge(
+        "mx_serving_generate_inflight",
+        "requests in the running decode batch",
+        labelnames=("model", "lane")),
+    "batch_rows": reg.histogram(
+        "mx_serving_generate_batch_rows",
+        "running requests per decode step", labelnames=("model",),
+        buckets=(1, 2, 4, 8, 16, 32, 64)),
+    "ttft": reg.histogram(
+        "mx_serving_generate_ttft_seconds",
+        "submit -> first token (prefill + queue)",
+        labelnames=("model",)),
+    "inter_token": reg.histogram(
+        "mx_serving_generate_inter_token_seconds",
+        "gap between consecutive streamed tokens of one request",
+        labelnames=("model",)),
+    "cache_blocks": reg.gauge(
+        "mx_serving_generate_cache_blocks",
+        "block-pool state per lane",
+        labelnames=("model", "lane", "state")),
+    "occupancy": reg.histogram(
+        "mx_serving_generate_cache_occupancy",
+        "used fraction of the block pool, sampled at every decode "
+        "step", labelnames=("model",),
+        buckets=(0.1, 0.25, 0.5, 0.75, 0.9, 1.0)),
+})
+
+
+class GenRequest:
+    """One generation request + its streaming reply.
+
+    ``stream()`` yields token ids as the scheduler emits them;
+    ``result(timeout)`` blocks for the full greedy completion. Either
+    raises the serving-side error if the request failed."""
+
+    __slots__ = ("model", "prompt", "max_new_tokens", "trace_ctx",
+                 "submit_ns", "first_token_ns", "last_token_ns",
+                 "tokens", "token_spans", "table", "next_pos",
+                 "reserved_blocks", "finish_reason", "_cv", "_done",
+                 "_error")
+
+    def __init__(self, model, prompt, max_new_tokens, trace_ctx):
+        self.model = model
+        self.prompt = np.asarray(prompt, np.int32).ravel()
+        self.max_new_tokens = int(max_new_tokens)
+        self.trace_ctx = trace_ctx
+        self.submit_ns = clock.now_ns()
+        self.first_token_ns = 0
+        self.last_token_ns = 0
+        self.tokens = []
+        self.token_spans = []
+        self.table = None
+        self.next_pos = 0
+        self.reserved_blocks = 0
+        self.finish_reason = None
+        self._cv = threading.Condition(threading.Lock())
+        self._done = threading.Event()
+        self._error = None
+
+    def done(self):
+        return self._done.is_set()
+
+    def stream(self):
+        """Iterate token ids as they are generated (the streaming
+        reply). Replayable: every consumer streams from the first
+        token, so a late (or second) reader sees the whole completion
+        instead of hanging. Raises on serving-side failure."""
+        i = 0
+        while True:
+            with self._cv:
+                while i >= len(self.tokens) and not self._done.is_set():
+                    self._cv.wait()
+                if i >= len(self.tokens):
+                    if self._error is not None:
+                        raise self._error
+                    return
+                tok = self.tokens[i]
+            yield tok
+            i += 1
+
+    def result(self, timeout=None):
+        """Block for the full completion: list of generated token ids."""
+        if not self._done.wait(timeout):
+            raise ServingError(
+                f"generate: request on {self.model!r} timed out after "
+                f"{timeout}s (still queued or decoding)")
+        if self._error is not None:
+            raise self._error
+        return list(self.tokens)
+
+    def _push_token(self, tok):
+        with self._cv:
+            self.tokens.append(tok)
+            self._cv.notify_all()
+
+    def _finish(self, error=None):
+        self._error = error
+        self._done.set()
+        with self._cv:
+            self._cv.notify_all()
+
+
+class GenLane:
+    """One decode lane: a device-pinned compiled model + block pool +
+    the scheduler thread that re-forms its batch every step."""
+
+    def __init__(self, model, idx, device, steps, pool):
+        self._model = model
+        self.idx = idx
+        self.device = device
+        self.steps = steps
+        self.pool = pool
+        self.waiting = deque()
+        self.running = []
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"mxtpu-generate-{self._model.name}-l{self.idx}")
+        self._thread.start()
+
+    def join(self, timeout=None):
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # -- scheduler loop ------------------------------------------------------
+    def _loop(self):
+        m = self._model
+        while True:
+            with m.cond:
+                while not self.waiting and not self.running \
+                        and not m.closed:
+                    m.cond.wait()
+                if m.closed:
+                    break
+                admit = []
+                while self.waiting and \
+                        len(self.running) + len(admit) < \
+                        m.max_decode_batch:
+                    admit.append(self.waiting.popleft())
+            try:
+                for req in admit:
+                    self._prefill(req)
+                if self.running:
+                    self._step()
+            except Exception as e:  # noqa: BLE001 — a failed step
+                # fails ITS requests; the lane survives for new work
+                self._fail_inflight(admit, e)
+        # shutdown: nothing new executes — fail whatever is left
+        err = ServingError(
+            f"generate: model {m.name!r} shut down before the request "
+            "completed")
+        self._fail_inflight([], err)
+
+    def _fail_inflight(self, extra, err):
+        m = self._model
+        with m.cond:
+            doomed = list(self.running) + list(self.waiting) + \
+                [r for r in extra if not r.done()]
+            self.running = []
+            self.waiting.clear()
+        # the gauge was last set with a live batch — a failed/closed
+        # lane must read 0, not its final batch size forever
+        _met()["inflight"].labels(model=m.name,
+                                  lane=str(self.idx)).set(0)
+        seen = set()
+        for req in doomed:
+            # an admitted request can sit in both `running` and
+            # `extra` — retire (and close the stream of) each once
+            if id(req) in seen or req.done():
+                continue
+            seen.add(id(req))
+            self._retire(req, error=err)
+
+    # -- phases --------------------------------------------------------------
+    def _prefill(self, req):
+        """One request's padded prompt through the causal stack; emits
+        the first greedy token and joins the running set."""
+        m = self._model
+        met = _met()
+        plen = len(req.prompt)
+        tpad = pick_bucket(m.prompt_buckets, plen)
+        req.table = BlockTable(self.pool, m.table_width)
+        req.table.extend(self.pool.blocks_for(plen))
+        tokens = np.zeros(tpad, np.int32)
+        tokens[:plen] = req.prompt
+        t0 = clock.now_ns()
+        tok_dev = self.steps.prefill(
+            tokens, plen, req.table.row[:tpad // self.pool.block_tokens])
+        tok = int(self._host_tokens(tok_dev))
+        req.next_pos = plen
+        met["tokens"].labels(model=m.name, phase="prefill").inc(plen)
+        met["steps"].labels(model=m.name, phase="prefill").inc()
+        self._emit(req, tok, t0, clock.now_ns())
+        if req.finish_reason is None:
+            self.running.append(req)
+            met["inflight"].labels(model=m.name,
+                                   lane=str(self.idx)).set(
+                len(self.running))
+        else:
+            self._retire(req)
+
+    def _step(self):
+        """One iteration-level decode step over the running batch."""
+        m = self._model
+        met = _met()
+        live = self.running
+        bucket = pick_bucket(m.decode_buckets, len(live))
+        tokens = np.zeros(bucket, np.int32)
+        positions = np.zeros(bucket, np.int32)
+        tables = np.zeros((bucket, m.table_width), np.int32)
+        for i, req in enumerate(live):
+            req.table.ensure_position(req.next_pos)
+            tokens[i] = req.tokens[-1]
+            positions[i] = req.next_pos
+            tables[i] = req.table.row
+        t0 = clock.now_ns()
+        toks = self._host_tokens(
+            self.steps.decode(tokens, positions, tables))
+        t1 = clock.now_ns()
+        met["steps"].labels(model=m.name, phase="decode").inc()
+        met["tokens"].labels(model=m.name, phase="decode").inc(len(live))
+        met["batch_rows"].labels(model=m.name).observe(len(live))
+        self._observe_pool()
+        finished = []
+        for i, req in enumerate(live):
+            req.next_pos += 1
+            self._emit(req, int(toks[i]), t0, t1)
+            if req.finish_reason is not None:
+                finished.append(req)
+        for req in finished:
+            live.remove(req)
+            self._retire(req)
+        met["inflight"].labels(model=m.name, lane=str(self.idx)).set(
+            len(live))
+
+    def _host_tokens(self, tok_dev):
+        """The token reply transfer: generated ids must reach the host
+        to be streamed to clients (and to drive stopping + the next
+        step's feed). The ONE sanctioned device read per step —
+        everything else on the step path is host bookkeeping."""
+        return np.asarray(tok_dev)
+
+    def _emit(self, req, tok, step_start_ns, now_ns):
+        """Record + stream one generated token; marks the request
+        finished when it hits EOS or its budget."""
+        m = self._model
+        met = _met()
+        if not req.tokens:
+            req.first_token_ns = now_ns
+            met["ttft"].labels(model=m.name).observe(
+                (now_ns - req.submit_ns) / 1e9)
+        else:
+            met["inter_token"].labels(model=m.name).observe(
+                (now_ns - req.last_token_ns) / 1e9)
+        req.last_token_ns = now_ns
+        req.token_spans.append((step_start_ns, now_ns))
+        req._push_token(tok)
+        if m.eos_id is not None and tok == m.eos_id:
+            req.finish_reason = "eos"
+        elif len(req.tokens) >= req.max_new_tokens:
+            req.finish_reason = "length"
+
+    def _observe_pool(self):
+        m = self._model
+        met = _met()
+        occ = self.pool.occupancy()
+        lane = str(self.idx)
+        for state in ("used", "free", "reserved"):
+            met["cache_blocks"].labels(
+                model=m.name, lane=lane, state=state).set(
+                occ["%s_blocks" % state])
+        met["occupancy"].labels(model=m.name).observe(occ["used_frac"])
+
+    # -- retirement ----------------------------------------------------------
+    def _retire(self, req, error=None):
+        if req.table is not None:
+            req.table.release()
+            req.table = None
+        if req.reserved_blocks:
+            self.pool.unreserve(req.reserved_blocks)
+            req.reserved_blocks = 0
+        self._observe_pool()
+        self._record_spans(req, error)
+        req._finish(error)
+
+    def _record_spans(self, req, error):
+        m = self._model
+        trace_id, parent = req.trace_ctx
+        if not trace_id:
+            return
+        end = req.last_token_ns or clock.now_ns()
+        root = tracing.record_span(
+            "serving.generate", trace_id, parent, req.submit_ns, end,
+            cat="serving",
+            attrs={"model": m.name, "lane": self.idx,
+                   "prompt_tokens": len(req.prompt),
+                   "new_tokens": len(req.tokens),
+                   "finish": ("error" if error is not None
+                              else req.finish_reason)})
+        if req.first_token_ns:
+            tracing.record_span(
+                "generate.prefill", trace_id, root, req.submit_ns,
+                req.first_token_ns, cat="serving",
+                attrs={"prompt_tokens": len(req.prompt)})
+        for j, (s, e) in enumerate(req.token_spans):
+            tracing.record_span("generate.token", trace_id, root, s, e,
+                                cat="serving", attrs={"index": j})
+
+
+class GenModel:
+    """One registered generator: decoder + N lanes + admission state.
+    Built by ``Gateway.register_generator``; requests enter through
+    :meth:`submit` (usually via the gateway, which owns the reject
+    metrics + error messages)."""
+
+    def __init__(self, name, decoder, devices, block_tokens,
+                 max_blocks, max_new_tokens, max_decode_batch,
+                 max_queue, warmup=True):
+        self.name = name
+        self.decoder = decoder
+        self.eos_id = decoder.eos_id
+        self.block_tokens = int(block_tokens)
+        self.max_blocks = int(max_blocks)
+        self.max_new_tokens = int(max_new_tokens)
+        self.max_decode_batch = int(max_decode_batch)
+        self.max_queue = int(max_queue)
+        self.closed = False
+        self.cond = threading.Condition(threading.Lock())
+        bt = self.block_tokens
+        max_prompt_pad = _ceil_mul(decoder.max_prompt_tokens, bt)
+        # prompt pads: the PR 10 bucket ladder in units of blocks —
+        # <2x pad waste, O(log n) prefill executables
+        self.prompt_buckets = tuple(
+            b * bt for b in default_buckets(max_prompt_pad // bt))
+        self.decode_buckets = default_buckets(self.max_decode_batch)
+        self.table_width = (max_prompt_pad + _ceil_mul(
+            self.max_new_tokens, bt)) // bt
+        capacity = self.table_width  # blocks a maximal request needs
+        if capacity > self.max_blocks - 1:
+            raise ServingError(
+                f"generate: model {name!r} needs up to {capacity} "
+                f"blocks per request but the pool only has "
+                f"{self.max_blocks - 1} usable (raise "
+                "MXTPU_GEN_MAX_BLOCKS or lower max_prompt_tokens/"
+                "max_new_tokens)")
+        self.lanes = []
+        self.warmup_seconds = 0.0
+        self.executables = 0
+        t0 = clock.now_ns()
+        from .model import CompiledDecodeSteps
+        for idx, device in enumerate(devices):
+            pool = BlockPool(decoder.num_layers, decoder.num_heads,
+                             decoder.head_dim, bt, self.max_blocks,
+                             device=device, dtype=decoder.dtype)
+            steps = CompiledDecodeSteps(decoder, pool,
+                                        self.table_width, device)
+            lane = GenLane(self, idx, device, steps, pool)
+            if warmup:
+                self.executables += self._warmup(lane)
+            self.lanes.append(lane)
+        self.warmup_seconds = (clock.now_ns() - t0) / 1e9
+        for lane in self.lanes:
+            lane.start()
+
+    def _warmup(self, lane):
+        """AOT-compile every (prefill pad, decode bucket) executable
+        with pad-sink-only writes — after this, steady-state decode
+        never retraces."""
+        n = 0
+        bt = self.block_tokens
+        for tpad in self.prompt_buckets:
+            lane.steps.prefill(np.zeros(tpad, np.int32), 1,
+                               np.zeros(tpad // bt, np.int32))
+            n += 1
+        for b in self.decode_buckets:
+            lane.steps.decode(np.zeros(b, np.int32),
+                              np.zeros(b, np.int32),
+                              np.zeros((b, self.table_width), np.int32))
+            n += 1
+        return n
+
+    # -- admission -----------------------------------------------------------
+    def try_admit(self, req):
+        """None and an assigned lane on success, else the rejection
+        reason (pure bookkeeping — fast-reject in the caller's
+        thread)."""
+        if self.closed:
+            return "closed"
+        with self.cond:
+            depth = sum(len(ln.waiting) for ln in self.lanes)
+        if depth >= self.max_queue:
+            return "queue_full"
+        need = self.lanes[0].pool.blocks_for(
+            len(req.prompt) + req.max_new_tokens)
+        # most-headroom lane first; reservation is atomic per pool, so
+        # a racing submit simply falls through to the next lane
+        order = sorted(
+            self.lanes,
+            key=lambda ln: ln.pool.reserved_blocks())
+        for lane in order:
+            if lane.pool.reserve(need):
+                req.reserved_blocks = need
+                with self.cond:
+                    if self.closed:
+                        lane.pool.unreserve(need)
+                        req.reserved_blocks = 0
+                        return "closed"
+                    lane.waiting.append(req)
+                    self.cond.notify_all()
+                return None
+        return "kv_cache_full"
+
+    # -- lifecycle -----------------------------------------------------------
+    def close(self):
+        with self.cond:
+            self.closed = True
+            self.cond.notify_all()
+        for lane in self.lanes:
+            lane.join(timeout=5.0)
+
+    def stats(self):
+        with self.cond:
+            waiting = sum(len(ln.waiting) for ln in self.lanes)
+            running = sum(len(ln.running) for ln in self.lanes)
+        return {
+            "waiting": waiting,
+            "running": running,
+            "max_decode_batch": self.max_decode_batch,
+            "max_new_tokens": self.max_new_tokens,
+            "max_queue": self.max_queue,
+            "prompt_buckets": list(self.prompt_buckets),
+            "decode_buckets": list(self.decode_buckets),
+            "table_width": self.table_width,
+            "executables": self.executables,
+            "warmup_seconds": round(self.warmup_seconds, 3),
+            "lanes": [
+                {"idx": ln.idx, "device": str(ln.device),
+                 "pool": ln.pool.occupancy()} for ln in self.lanes],
+        }
+
+
+def _ceil_mul(n, m):
+    return ((int(n) + m - 1) // m) * m

@@ -62,7 +62,14 @@ def test_shuffle_and_reset(recfile):
         sorted((np.arange(1000) % 10).tolist())
 
 
+@pytest.mark.slow
 def test_multithread_decode_faster(tmp_path):
+    # slow: a wall-clock A/B race (native libjpeg pool vs GIL-bound
+    # PIL) that flakes on 1-2 core CI hosts whenever a background
+    # thread steals the core mid-measurement — pre-existing since the
+    # seed (CHANGES PR 8). The native-path correctness + parallel
+    # decode coverage stays tier-1 in the other tests here; the
+    # timing CLAIM runs where timing is measurable.
     # decode must dominate for threading to show: use 256x256 JPEGs
     prefix = str(tmp_path / "big")
     rec = MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")

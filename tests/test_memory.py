@@ -603,6 +603,46 @@ def test_perf_gate_memory_section(tmp_path):
     assert rc == 0
 
 
+# ------------------------------------------------------------ kv_cache
+def test_kv_cache_role_in_taxonomy_and_census():
+    """The serving decode plane's paged block pool is a first-class
+    census role: pool bytes classify as kv_cache byte-exactly, and
+    swap() (the per-step donation adoption) keeps the tag."""
+    from mxnet_tpu.serving.generate import BlockPool
+
+    assert "kv_cache" in memory.ROLES
+    pool = BlockPool(num_layers=2, num_heads=2, head_dim=4,
+                     block_tokens=4, max_blocks=8)
+    doc = memory.live_census(arrays=[pool.k, pool.v])
+    assert doc["by_role"]["kv_cache"]["bytes"] == pool.bytes_total
+    assert doc["by_role"]["kv_cache"]["arrays"] == 2
+    # a donated-step swap re-tags the fresh arrays
+    import jax.numpy as jnp
+    pool.swap(jnp.asarray(pool.k) + 0, jnp.asarray(pool.v) + 0)
+    assert memory.role_of(pool.k) == "kv_cache"
+    doc = memory.live_census(arrays=[pool.k, pool.v])
+    assert doc["by_role"]["kv_cache"]["bytes"] == pool.bytes_total
+
+
+def test_oom_postmortem_names_kv_cache(tmp_path, monkeypatch):
+    """An OOM during a decode run must name the cache: the postmortem
+    census carries the kv_cache role with the pool's actual bytes."""
+    from mxnet_tpu.serving.generate import BlockPool
+
+    path = str(tmp_path / "oom_kv.json")
+    monkeypatch.setenv("MXTPU_OOM_DUMP_PATH", path)
+    memory._LAST_POSTMORTEM[0] = -10.0
+    pool = BlockPool(num_layers=2, num_heads=2, head_dim=4,
+                     block_tokens=4, max_blocks=8)
+    err = RuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory allocating 123 bytes")
+    doc = memory.maybe_oom_postmortem(err, source="decode_step")
+    assert doc is not None
+    saved = json.loads(open(path).read())
+    kv = saved["census"]["by_role"]["kv_cache"]
+    assert kv["bytes"] >= pool.bytes_total
+
+
 # ------------------------------------------------------ env registration
 def test_new_env_vars_registered():
     from mxnet_tpu import libinfo

@@ -1,0 +1,467 @@
+"""Generative decode plane tests (serving/generate/): paged KV block
+pool accounting, paged-attention kernel/fallback parity, iteration-
+level continuous batching with mid-flight joins, gateway greedy decode
+vs the unpaged reference (token-exact), kv_cache_full fast-reject,
+streaming replies, census role integration, telemetry + per-token
+trace spans, and the perf_gate --serving generate-stage checks over
+the committed artifact."""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serving import Gateway, RejectedError, ServingError
+from mxnet_tpu.serving.generate import (BlockPool, BlockTable,
+                                        GenerativeDecoder,
+                                        reference_generate)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SERVING_ARTIFACT = os.path.join(REPO, "docs", "artifacts",
+                                "SERVING_LAST_GOOD.json")
+
+VOCAB = 50
+
+
+@pytest.fixture(scope="module")
+def decoder():
+    mx.random.seed(0)
+    return GenerativeDecoder(vocab_size=VOCAB, d_model=32,
+                             num_layers=2, num_heads=4,
+                             max_prompt_tokens=12)
+
+
+@pytest.fixture(scope="module")
+def gateway(decoder):
+    gw = Gateway()
+    gw.register_generator("lm", decoder, block_tokens=4,
+                          max_blocks=64, max_new_tokens=12,
+                          max_decode_batch=4)
+    yield gw
+    gw.close()
+
+
+# -- block pool units --------------------------------------------------------
+def test_block_pool_alloc_free_accounting():
+    pool = BlockPool(num_layers=1, num_heads=2, head_dim=4,
+                     block_tokens=4, max_blocks=8)
+    assert pool.usable_blocks == 7          # block 0 = pad sink
+    assert pool.blocks_for(1) == 1
+    assert pool.blocks_for(4) == 1
+    assert pool.blocks_for(5) == 2
+    got = pool.alloc(3)
+    assert len(got) == 3 and 0 not in got
+    assert pool.used_blocks() == 3
+    occ = pool.occupancy()
+    assert occ["used_blocks"] == 3 and occ["free_blocks"] == 4
+    assert occ["bytes_total"] == pool.k.nbytes + pool.v.nbytes
+    pool.free(got)
+    assert pool.used_blocks() == 0
+    # over-allocation past the free list is a ledger bug, not load
+    with pytest.raises(MXNetError):
+        pool.alloc(8)
+
+
+def test_block_pool_reservation():
+    pool = BlockPool(num_layers=1, num_heads=2, head_dim=4,
+                     block_tokens=4, max_blocks=8)
+    assert pool.reserve(5)
+    assert pool.reserve(2)
+    assert not pool.reserve(1)              # 7 usable, 7 reserved
+    pool.unreserve(2)
+    assert pool.reserve(2)
+    pool.unreserve(100)
+    assert pool.reserved_blocks() == 0
+
+
+def test_block_table_grow_and_overflow():
+    pool = BlockPool(num_layers=1, num_heads=2, head_dim=4,
+                     block_tokens=4, max_blocks=16)
+    t = BlockTable(pool, width=3)
+    t.ensure_position(0)
+    assert len(t.blocks) == 1
+    t.ensure_position(7)                    # positions 0..7 -> 2 blocks
+    assert len(t.blocks) == 2
+    t.ensure_position(8)
+    assert len(t.blocks) == 3
+    assert list(t.row[:3]) == t.blocks
+    with pytest.raises(MXNetError):
+        t.ensure_position(12)               # width 3 exceeded
+    # overflow must leave NO partial state: a mid-append free would
+    # return tracked blocks to the pool twice (double-free) and later
+    # hand one block to two requests
+    assert len(t.blocks) == 3
+    t.release()
+    assert pool.used_blocks() == 0 and not t.blocks
+    # the free list is exactly the usable set — no duplicates
+    assert sorted(pool.alloc(pool.usable_blocks)) == \
+        list(range(1, pool.max_blocks))
+
+
+# -- paged attention kernel --------------------------------------------------
+def _paged_case(seed=0, b=3, h=2, d=8, bt=4, nb=6, nmax=3):
+    rng = np.random.default_rng(seed)
+    q = rng.normal(size=(b, h, d)).astype(np.float32)
+    kc = rng.normal(size=(nb, bt, h, d)).astype(np.float32)
+    vc = rng.normal(size=(nb, bt, h, d)).astype(np.float32)
+    tables = np.array([[1, 2, 3], [4, 0, 0], [5, 2, 0]], np.int32)
+    lens = np.array([10, 3, 1], np.int32)
+    return q, kc, vc, tables, lens
+
+
+def test_paged_gather_fallback_matches_dense_per_sequence():
+    """The fallback is the oracle: per-sequence dense softmax over the
+    gathered contiguous K/V must match it closely."""
+    from mxnet_tpu.ops import pallas_kernels as pk
+    q, kc, vc, tables, lens = _paged_case()
+    got = np.asarray(pk.paged_attention(q, kc, vc, tables, lens))
+    scale = q.shape[-1] ** -0.5
+    for i in range(q.shape[0]):
+        k = kc[tables[i]].reshape(-1, *kc.shape[2:])    # (S, H, D)
+        v = vc[tables[i]].reshape(-1, *vc.shape[2:])
+        s = np.einsum("hd,thd->ht", q[i] * scale,
+                      k).astype(np.float64)
+        s[:, lens[i]:] = -np.inf
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        want = np.einsum("ht,thd->hd", p, v.astype(np.float64))
+        np.testing.assert_allclose(got[i], want, atol=2e-5)
+
+
+def test_paged_kernel_interpret_parity_with_fallback():
+    """The Pallas kernel (interpret mode = the CPU test mesh) against
+    the jnp gather fallback — the same contract flash_attention's
+    kernel tests pin."""
+    from mxnet_tpu.ops import pallas_kernels as pk
+    q, kc, vc, tables, lens = _paged_case()
+    fb = np.asarray(pk.paged_attention(q, kc, vc, tables, lens))
+    kn = np.asarray(pk.paged_attention(q, kc, vc, tables, lens,
+                                       force=True))
+    # online-softmax accumulation vs two-pass: ulp-level only
+    assert np.abs(fb - kn).max() < 2e-6
+
+
+def test_paged_kernel_zero_len_row_is_discardable():
+    """A padding row (seq_len 0) must not poison other rows."""
+    from mxnet_tpu.ops import pallas_kernels as pk
+    q, kc, vc, tables, lens = _paged_case()
+    lens2 = lens.copy()
+    lens2[2] = 0
+    a = np.asarray(pk.paged_attention(q, kc, vc, tables, lens))
+    b = np.asarray(pk.paged_attention(q, kc, vc, tables, lens2))
+    np.testing.assert_array_equal(a[:2], b[:2])
+
+
+# -- greedy correctness vs the unpaged reference -----------------------------
+def test_gateway_greedy_equals_unpaged_reference(gateway, decoder):
+    prompt = [3, 7, 11, 2, 9]
+    want = reference_generate(decoder, prompt, 8)
+    got = gateway.generate("lm", prompt, max_new_tokens=8)
+    assert got == want                       # token-exact, no slack
+
+
+def test_midflight_join_keeps_streams_token_exact(gateway, decoder):
+    """Iteration-level batching: B joins while A decodes; both must
+    still match their SOLO unpaged references exactly — batch-mates
+    must never bleed into each other."""
+    ra = gateway.submit_generate("lm", [2, 4, 6], max_new_tokens=12)
+    # let A's prefill + a few decode steps land, then join B
+    deadline = time.time() + 5.0
+    while not ra.tokens and time.time() < deadline:
+        time.sleep(0.001)
+    rb = gateway.submit_generate("lm", [3, 5, 7], max_new_tokens=5)
+    got_a, got_b = ra.result(30), rb.result(30)
+    assert got_a == reference_generate(decoder, [2, 4, 6], 12)
+    assert got_b == reference_generate(decoder, [3, 5, 7], 5)
+
+
+def test_streaming_iterator_yields_incrementally(gateway):
+    req = gateway.generate("lm", [1, 2, 3], max_new_tokens=6,
+                           stream=True)
+    seen = list(req.stream())
+    assert seen == req.result(1.0)
+    assert len(seen) == 6
+    # streams are replayable: a late/second consumer sees the whole
+    # completion instead of hanging on a drained queue
+    assert list(req.stream()) == seen
+
+
+def test_eos_stops_generation(decoder):
+    """EOS retires the request mid-batch (leave-early half of
+    iteration-level scheduling)."""
+    free = reference_generate(decoder, [5, 9, 1], 10)
+    eos = free[3]                            # force a stop at step 4
+    mx.random.seed(0)
+    dec2 = GenerativeDecoder(vocab_size=VOCAB, d_model=32,
+                             num_layers=2, num_heads=4,
+                             max_prompt_tokens=12, eos_id=eos)
+    gw = Gateway()
+    try:
+        gw.register_generator("lm_eos", dec2, block_tokens=4,
+                              max_blocks=32, max_new_tokens=10,
+                              max_decode_batch=2, warmup=False)
+        out = gw.generate("lm_eos", [5, 9, 1], max_new_tokens=10)
+        assert out == free[:4]               # emitted UP TO eos
+        assert out[-1] == eos
+    finally:
+        gw.close()
+
+
+# -- admission ---------------------------------------------------------------
+def test_kv_cache_full_fast_reject(decoder):
+    gw = Gateway()
+    try:
+        # table width = (pad(12)+pad(12))/4 = 6; pool of 8 -> 7 usable:
+        # one max-budget request reserves 6, a second cannot fit
+        gw.register_generator("lm_small", decoder, block_tokens=4,
+                              max_blocks=8, max_new_tokens=12,
+                              max_decode_batch=2, warmup=False)
+        r1 = gw.submit_generate("lm_small", list(range(1, 12)),
+                                max_new_tokens=12)
+        t0 = time.perf_counter()
+        with pytest.raises(RejectedError) as ei:
+            gw.submit_generate("lm_small", list(range(1, 12)),
+                               max_new_tokens=12)
+        assert ei.value.reason == "kv_cache_full"
+        assert time.perf_counter() - t0 < 0.1   # fast-reject
+        r1.result(60.0)
+        # retirement returns the budget: admission recovers
+        out = gw.generate("lm_small", [1, 2, 3], max_new_tokens=2)
+        assert len(out) == 2
+    finally:
+        gw.close()
+
+
+def test_bad_requests_raise_not_reject(gateway):
+    with pytest.raises(ServingError):
+        gateway.submit_generate("lm", list(range(100)))   # > max_prompt
+    with pytest.raises(ServingError):
+        gateway.submit_generate("lm", [1], max_new_tokens=999)
+    with pytest.raises(ServingError):
+        gateway.submit_generate("nope", [1])
+
+
+def test_pool_too_small_for_one_request_fails_registration(decoder):
+    gw = Gateway()
+    try:
+        with pytest.raises(ServingError):
+            gw.register_generator("lm_tiny", decoder, block_tokens=4,
+                                  max_blocks=4, max_new_tokens=12,
+                                  warmup=False)
+    finally:
+        gw.close()
+
+
+# -- memory accounting -------------------------------------------------------
+def test_census_kv_cache_matches_pool_bytes(gateway):
+    """The pool's arrays are the census role kv_cache, byte-exact."""
+    from mxnet_tpu.profiling import memory as pmem
+    gen = gateway._get_generator("lm")
+    pool = gen.lanes[0].pool
+    doc = pmem.live_census(arrays=[pool.k, pool.v])
+    assert doc["by_role"]["kv_cache"]["bytes"] == pool.bytes_total
+    assert doc["by_role"]["kv_cache"]["arrays"] == 2
+
+
+def test_memory_gauge_kv_cache_per_device(gateway):
+    """mx_memory_live_bytes{role="kv_cache"} per device must match the
+    block-pool accounting after a decode run (the decode steps DONATE
+    and swap the cache arrays — the re-tag in BlockPool.swap is what
+    this pins)."""
+    import gc
+    gc.collect()        # closed gateways from earlier tests hold
+    # their pools in GenModel<->GenLane cycles until collected
+    gateway.generate("lm", [4, 8, 2], max_new_tokens=4)
+    gen = gateway._get_generator("lm")
+    pools = {}
+    for lane in gen.lanes:
+        dev = lane.pool.k.devices().pop()
+        key = "%s:%d" % (dev.platform, dev.id)
+        pools[key] = pools.get(key, 0) + lane.pool.bytes_total
+    reg = mx.telemetry.registry()
+    reg.snapshot()                           # runs the census collector
+    fam = reg.find("mx_memory_live_bytes")
+    got = {}
+    for s in fam.series():
+        if s.labels.get("role") == "kv_cache" and s.value:
+            got[s.labels["device"]] = int(s.value)
+    assert got == pools
+
+
+def test_warmup_compiles_ladder_and_stats(gateway):
+    gen = gateway._get_generator("lm")
+    st = gateway.stats()["lm"]
+    assert st["generator"] is True
+    assert st["executables"] == len(gen.prompt_buckets) + \
+        len(gen.decode_buckets)
+    assert st["lanes"][0]["pool"]["usable_blocks"] == 63
+    assert st["prompt_buckets"][-1] >= 12
+
+
+# -- telemetry + tracing -----------------------------------------------------
+def test_generate_telemetry_families(gateway):
+    reg = mx.telemetry.registry()
+    gateway.generate("lm", [6, 2, 8], max_new_tokens=4)
+    assert reg.value("mx_serving_generate_requests_total",
+                     model="lm") >= 1
+    assert reg.value("mx_serving_generate_tokens_total", model="lm",
+                     phase="prefill") >= 3
+    assert reg.value("mx_serving_generate_tokens_total", model="lm",
+                     phase="decode") >= 3
+    assert reg.value("mx_serving_generate_steps_total", model="lm",
+                     phase="decode") >= 3
+    occ = reg.find("mx_serving_generate_cache_occupancy")
+    assert occ is not None and occ.labels(model="lm").count >= 3
+    ttft = reg.find("mx_serving_generate_ttft_seconds")
+    assert ttft.labels(model="lm").count >= 1
+    inter = reg.find("mx_serving_generate_inter_token_seconds")
+    assert inter.labels(model="lm").count >= 3
+
+
+def test_per_token_trace_spans(gateway):
+    from mxnet_tpu import tracing
+    with tracing.span("client_gen") as client:
+        trace_id = client.trace_id
+        out = gateway.generate("lm", [9, 1, 7], max_new_tokens=5)
+    spans = tracing.spans_snapshot()
+    mine = [s for s in spans if s["trace"] == trace_id]
+    root = next(s for s in mine if s["name"] == "serving.generate")
+    assert root["parent"] == client.span_id
+    assert root["attrs"]["new_tokens"] == len(out)
+    prefill = next(s for s in mine if s["name"] == "generate.prefill")
+    assert prefill["parent"] == root["span"]
+    tok = [s for s in mine if s["name"] == "generate.token"]
+    assert len(tok) == len(out)              # one span per token
+    assert {s["attrs"]["index"] for s in tok} == set(range(len(out)))
+    assert all(s["parent"] == root["span"] for s in tok)
+
+
+def test_rejected_metric_reason_label(decoder):
+    gw = Gateway()
+    try:
+        gw.register_generator("lm_rej", decoder, block_tokens=4,
+                              max_blocks=8, max_new_tokens=12,
+                              max_decode_batch=2, warmup=False)
+        reg = mx.telemetry.registry()
+        r1 = gw.submit_generate("lm_rej", list(range(1, 12)),
+                                max_new_tokens=12)
+        with pytest.raises(RejectedError):
+            gw.submit_generate("lm_rej", list(range(1, 12)),
+                               max_new_tokens=12)
+        assert reg.value("mx_serving_generate_rejected_total",
+                         model="lm_rej", reason="kv_cache_full") == 1
+        r1.result(60.0)
+    finally:
+        gw.close()
+
+
+def test_close_fails_pending_cleanly(decoder):
+    gw = Gateway()
+    gw.register_generator("lm_close", decoder, block_tokens=4,
+                          max_blocks=32, max_new_tokens=8,
+                          max_decode_batch=2, warmup=False)
+    req = gw.submit_generate("lm_close", [1, 2, 3], max_new_tokens=8)
+    gw.close()
+    # either it finished before the drain or it fails CLEANLY — it
+    # must never hang
+    try:
+        out = req.result(10.0)
+        assert len(out) <= 8
+    except ServingError:
+        pass
+    with pytest.raises(ServingError):
+        gw.submit_generate("lm_close", [1], max_new_tokens=1)
+
+
+# -- lint scope --------------------------------------------------------------
+def test_mxl002_scope_covers_decode_hot_paths():
+    from mxnet_tpu.analysis.rules.host_sync import _hot_scope
+    methods, _ = _hot_scope("mxnet_tpu/serving/generate/scheduler.py")
+    assert {"_step", "_prefill", "_emit", "try_admit"} <= methods
+    methods, _ = _hot_scope("mxnet_tpu/serving/generate/kvcache.py")
+    assert {"alloc", "free", "reserve", "ensure_position"} <= methods
+    # the token reply transfer is excluded by design
+    assert "_host_tokens" not in methods
+
+
+# -- env registration --------------------------------------------------------
+def test_gen_env_vars_registered():
+    from mxnet_tpu import libinfo
+    doc = open(os.path.join(REPO, "docs", "env_vars.md"),
+               encoding="utf-8").read()
+    for var in ("MXTPU_GEN_BLOCK_TOKENS", "MXTPU_GEN_MAX_BLOCKS",
+                "MXTPU_GEN_MAX_NEW_TOKENS"):
+        assert var in libinfo._ENV_VARS
+        assert var in doc
+
+
+# -- perf gate ---------------------------------------------------------------
+def test_perf_gate_generate_stage_over_committed_artifact(capsys):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import perf_gate
+    rc = perf_gate.main([SERVING_ARTIFACT, "--serving",
+                         "--serving-int8-max", "1.0"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "serving generate" in out and "tokens/s" in out
+
+
+def test_perf_gate_generate_stage_regressions():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import perf_gate
+    with open(SERVING_ARTIFACT, encoding="utf-8") as f:
+        good = json.load(f)
+    assert "generate" in good["stages"]
+
+    # a candidate that silently DROPS the stage is the regression
+    bad = json.loads(json.dumps(good))
+    del bad["stages"]["generate"]
+    rc, msgs = perf_gate.gate_serving(bad, good)
+    assert rc == 1 and any("no generate stage" in m for m in msgs)
+
+    bad = json.loads(json.dumps(good))
+    bad["stages"]["generate"]["tokens_per_s"] /= 10.0
+    rc, msgs = perf_gate.gate_serving(bad, good)
+    assert rc == 1 and any("tokens/s" in m for m in msgs)
+
+    bad = json.loads(json.dumps(good))
+    bad["stages"]["generate"]["inter_token_p99_ms"] *= 10.0
+    rc, msgs = perf_gate.gate_serving(bad, good)
+    assert rc == 1 and any("inter-token p99" in m for m in msgs)
+
+    bad = json.loads(json.dumps(good))
+    bad["stages"]["generate"]["greedy_equals_reference"] = False
+    rc, msgs = perf_gate.gate_serving(bad, good)
+    assert rc == 1 and any("unpaged reference" in m for m in msgs)
+
+    bad = json.loads(json.dumps(good))
+    bad["stages"]["generate"]["cache_occupancy"] = {}
+    rc, msgs = perf_gate.gate_serving(bad, good)
+    assert rc == 1 and any("occupancy" in m for m in msgs)
+
+    # a NEW stage with no last-good baseline passes (forward compat)
+    old_good = json.loads(json.dumps(good))
+    del old_good["stages"]["generate"]
+    rc, msgs = perf_gate.gate_serving(good, old_good)
+    assert rc == 0 and any("no last-good baseline" in m for m in msgs)
+
+
+def test_committed_artifact_generate_stage_contract():
+    """The ISSUE's acceptance numbers live IN the committed artifact:
+    tokens/s, inter-token p50/p99, the occupancy histogram, kernel
+    parity, and the greedy pin."""
+    with open(SERVING_ARTIFACT, encoding="utf-8") as f:
+        doc = json.load(f)
+    g = doc["stages"]["generate"]
+    assert g["tokens_per_s"] > 0
+    assert g["inter_token_p50_ms"] > 0
+    assert g["inter_token_p99_ms"] >= g["inter_token_p50_ms"]
+    assert g["greedy_equals_reference"] is True
+    assert g["cache_occupancy"]["samples"] > 0
+    assert g["paged_kernel"]["interpret_checked"] is True
+    assert g["paged_kernel"]["parity_max_abs_vs_fallback"] < 2e-6
+    assert g["concurrent"]["tokens"] > 0

@@ -23,7 +23,11 @@ over serial bs=1 dispatch, the bs=1 INT8 variant must not lose to
 fp32 (``--serving-int8-max``), the gateway's padded/batched fp32
 output must be bitwise identical to direct Predictor.forward, and
 the dispatch-overhead probe must be present (VERDICT Missing #4's
-committed number).
+committed number). The ``generate`` stage (decode plane) adds:
+tokens/s floor vs last-good, inter-token p99 growth inverted, paged
+greedy == unpaged reference, the cache-occupancy histogram present —
+and an artifact that DROPS the stage while last-good carries it is
+itself a regression.
 
 Compares a bench artifact against the committed last-good measurement
 (``docs/artifacts/BENCH_LAST_GOOD.json`` unless ``--last-good``) with
@@ -378,6 +382,84 @@ def gate_serving(candidate, last_good, tolerance=0.25, min_gain=3.0,
         rc = 1
         msgs.append("REGRESSION serving: missing dispatch_overhead_"
                     "bs1 probe (the VERDICT Missing #4 number)")
+    gen_rc, gen_msgs = gate_generate(candidate, last_good, tolerance)
+    rc = rc or gen_rc
+    msgs.extend(gen_msgs)
+    return rc, msgs
+
+
+def gate_generate(candidate, last_good, tolerance=0.25):
+    """(rc, [messages]) for the serving artifact's ``generate`` stage
+    (the token-granular decode plane). Directions mirror the one-shot
+    stages: tokens/s falls -> regression, inter-token p99 GROWS beyond
+    tolerance -> regression (latency ceiling). A candidate that simply
+    DROPS the stage while last-good carries it is itself the
+    regression — a collapsed decode plane must not skip its own gate.
+    The greedy-vs-reference pin and the occupancy histogram are
+    presence/truth contracts, not relative comparisons."""
+    msgs = []
+    rc = 0
+    gen = (candidate.get("stages") or {}).get("generate")
+    good = (last_good.get("stages") or {}).get("generate")
+    if not isinstance(good, dict):
+        if isinstance(gen, dict):
+            msgs.append("serving generate: %s tokens/s (new stage — "
+                        "no last-good baseline yet)"
+                        % gen.get("tokens_per_s"))
+        return rc, msgs
+    if not isinstance(gen, dict):
+        return 1, ["REGRESSION serving: artifact carries no generate "
+                   "stage (last good has one — the decode plane "
+                   "cannot silently drop out of the gate)"]
+    tps, good_tps = gen.get("tokens_per_s"), good.get("tokens_per_s")
+    if not isinstance(tps, (int, float)):
+        rc = 1
+        msgs.append("REGRESSION serving generate: missing tokens_per_s")
+    elif isinstance(good_tps, (int, float)) and good_tps > 0:
+        if tps < (1.0 - tolerance) * good_tps:
+            rc = 1
+            msgs.append("REGRESSION serving generate: %.0f tokens/s < "
+                        "%.0f (last good %.0f, tolerance %.0f%%)"
+                        % (tps, (1.0 - tolerance) * good_tps, good_tps,
+                           tolerance * 100))
+        else:
+            msgs.append("serving generate: %.0f tokens/s vs %.0f (ok)"
+                        % (tps, good_tps))
+    p99 = gen.get("inter_token_p99_ms")
+    good_p99 = good.get("inter_token_p99_ms")
+    if isinstance(good_p99, (int, float)) and good_p99 > 0:
+        if not isinstance(p99, (int, float)):
+            rc = 1
+            msgs.append("REGRESSION serving generate: candidate "
+                        "carries no inter_token_p99_ms (last good "
+                        "%.1fms)" % good_p99)
+        elif p99 > (1.0 + tolerance) * good_p99:
+            rc = 1
+            msgs.append("REGRESSION serving generate: inter-token p99 "
+                        "%.1fms > %.1fms (last good %.1fms, tolerance "
+                        "%.0f%%)" % (p99, (1.0 + tolerance) * good_p99,
+                                     good_p99, tolerance * 100))
+        else:
+            msgs.append("serving generate: inter-token p99 %.1fms vs "
+                        "%.1fms (ok)" % (p99, good_p99))
+    if gen.get("greedy_equals_reference") is not True:
+        rc = 1
+        msgs.append("REGRESSION serving generate: paged greedy decode "
+                    "diverges from the unpaged reference (greedy_"
+                    "equals_reference=%s)"
+                    % gen.get("greedy_equals_reference"))
+    else:
+        msgs.append("serving generate: greedy == unpaged reference "
+                    "(ok)")
+    occ = gen.get("cache_occupancy") or {}
+    if not isinstance(occ.get("samples"), int) or occ["samples"] < 1:
+        rc = 1
+        msgs.append("REGRESSION serving generate: missing cache-"
+                    "occupancy histogram (the pool is unobserved)")
+    else:
+        msgs.append("serving generate: cache occupancy %s samples, "
+                    "mean used %s (recorded)"
+                    % (occ["samples"], occ.get("mean_used_frac")))
     return rc, msgs
 
 
