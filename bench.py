@@ -1410,9 +1410,41 @@ def main():
         # bounded serving headline (last-good copy, provenance marked)
         # so one training artifact answers "and how does it serve?"
         result["serving"] = serving
+    kernels = _kernels_summary()
+    if kernels is not None:
+        # bounded Pallas-fleet headline (parity + fallback timings)
+        result["kernels"] = kernels
     final = json.dumps(result)
     _emit(final)
     _child_record(final)
+
+
+def _kernels_summary():
+    """Bounded Pallas-fleet headline from the committed last-good
+    kernel artifact (docs/artifacts/KERNELS_LAST_GOOD.json) — parity
+    state + fallback timings per kernel, provenance explicit. Refresh
+    path: tools/kernel_bench.py + perf_gate --kernels."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "docs", "artifacts", "KERNELS_LAST_GOOD.json")
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if doc.get("tool") != "kernel_bench":
+        return None
+    out = {"source": "last_good_artifact",
+           "generated": doc.get("generated"),
+           "backend": doc.get("backend"), "kernels": {}}
+    for name, e in (doc.get("kernels") or {}).items():
+        if not isinstance(e, dict):
+            continue
+        out["kernels"][name] = {
+            "parity_ok": e.get("parity_ok"),
+            "fallback_ms": e.get("fallback_ms"),
+            "kernel_vs_fallback": e.get("kernel_vs_fallback"),
+        }
+    return out
 
 
 def build_train(batch, layout="NCHW", stem="standard"):

@@ -66,6 +66,16 @@ _SCOPES = (
       "group_by_op", "tag_role", "tag_tree", "role_of",
       "live_census", "buffer_intervals", "build_memory_ledger",
       "group_buffers_by_op", "_sweep_peak"}, set()),
+    # the cost-tracked partitioner runs at TRACE/bind time: selector
+    # growth, cluster pricing (abstract lowering only — ShapeDtype
+    # structs, never arrays) and the gate decision. A device sync here
+    # would execute real work during graph partitioning and stall
+    # every costed bind; pricing must stay purely abstract
+    ("mxnet_tpu/subgraph/",
+     {"select", "select_input", "select_output", "filter",
+      "partition_graph", "_partition_one", "create_subgraph_node",
+      "price_program", "price_cluster", "__call__", "_memo_key",
+      "build_report", "partition_graph_costed"}, set()),
     # the generative decode plane's hot paths run once per TOKEN, not
     # per request: scheduler step + prefill, cache alloc/free/
     # reservation, token emission, and admission. A sync in any of

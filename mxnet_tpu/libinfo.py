@@ -236,6 +236,36 @@ _ENV_VARS = {
         "bounds the block-table width the compiled decode step is "
         "traced with (default 64; serving/gateway.py "
         "register_generator)"),
+    "MXTPU_FUSE_COST": (
+        "0 disables cost-tracked partitioning at bind: "
+        "MXNET_SUBGRAPH_BACKEND then applies the always-fire pattern "
+        "pass instead of pricing each cluster with the flop/byte + "
+        "liveness ledgers (default on when shapes are known; "
+        "subgraph/cost.py, docs/architecture.md)"),
+    "MXTPU_FUSE_MIN_SAVE": (
+        "fractional roofline-time saving a candidate cluster must "
+        "show to fuse (default 0.02 — a rewrite that buys <2% of the "
+        "cluster's est_s stays unfused; subgraph/cost.py CostGate)"),
+    "MXTPU_FUSE_MEM_SLACK_MB": (
+        "absolute peak-live-bytes growth (MB) a fusing cluster may "
+        "cost before the memory currency rejects it; the gate always "
+        "tolerates 1% relative noise on top (default 0; "
+        "subgraph/cost.py CostGate)"),
+    "MXTPU_FUSE_REPORT": (
+        "path: every cost-tracked partition pass writes its decision "
+        "trail (the partition cost report, rendered by "
+        "tools/mfu_report.py) here (default unset; subgraph/cost.py)"),
+    "MXTPU_KERNEL_FUSED_OPT": (
+        "route sgd_mom_update/adam_update through the fused Pallas "
+        "one-pass update kernel: 1/0/auto (default auto = chip "
+        "backends only; the jnp path is the CPU hot path and the "
+        "kernel's numerics oracle; ops/optimizer_ops.py, "
+        "ops/pallas_kernels.py)"),
+    "MXTPU_KERNEL_INT8_EPILOGUE": (
+        "0 routes the fused INT8 conv epilogue (_sg_xla_quant_conv) "
+        "through plain ops/quantized.py requantize+act instead of "
+        "ops/pallas_kernels.quantized_conv_epilogue (default auto — "
+        "the wrapper itself falls back off-chip; subgraph/rules.py)"),
 }
 
 

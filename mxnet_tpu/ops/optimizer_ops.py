@@ -18,6 +18,8 @@ Clip convention throughout (matching dmlc param docs): clip_gradient
 """
 from __future__ import annotations
 
+import os
+
 import jax.numpy as jnp
 
 from .registry import register
@@ -28,6 +30,22 @@ def _clip(g, c):
     if c is None or c < 0:
         return g
     return jnp.clip(g, -c, c)
+
+
+def _fused_kernel_enabled():
+    """MXTPU_KERNEL_FUSED_OPT: route sgd_mom/adam through the Pallas
+    one-pass update kernel (ops/pallas_kernels.fused_*). ``auto``
+    (default) = chip backends only — the jnp path below IS the CPU hot
+    path and the kernel's numerics oracle, so behavior off-chip is
+    unchanged. Resolves at trace time (static env read, no tracer
+    impurity)."""
+    v = os.environ.get("MXTPU_KERNEL_FUSED_OPT", "auto").lower()
+    if v in ("0", "off", "false", "no"):
+        return False
+    if v in ("1", "on", "true", "yes"):
+        return True
+    import jax
+    return jax.default_backend() in ("tpu", "axon")
 
 
 # ---------------------------------------------------------------------------
@@ -47,6 +65,12 @@ def sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
 def sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
     """mom' = mu*mom - lr*wd*w - lr*clip(rescale*g); out = w + mom'."""
+    if _fused_kernel_enabled():
+        from .pallas_kernels import fused_sgd_mom
+        return fused_sgd_mom(weight, grad, mom, lr=lr,
+                             momentum=momentum, wd=wd,
+                             rescale_grad=rescale_grad,
+                             clip_gradient=clip_gradient)
     g = _clip(rescale_grad * grad, clip_gradient)
     mom = momentum * mom - lr * wd * weight - lr * g
     return weight + mom, mom
@@ -104,6 +128,12 @@ def adam_update(weight, grad, mean, var, lr=0.01, beta1=0.9, beta2=0.999,
                 lazy_update=True):
     """No in-kernel bias correction — the Python optimizer folds it into
     lr, matching the reference kernel exactly."""
+    if _fused_kernel_enabled():
+        from .pallas_kernels import fused_adam
+        return fused_adam(weight, grad, mean, var, lr=lr, beta1=beta1,
+                          beta2=beta2, epsilon=epsilon, wd=wd,
+                          rescale_grad=rescale_grad,
+                          clip_gradient=clip_gradient)
     g = _clip(rescale_grad * grad + wd * weight, clip_gradient)
     mean = beta1 * mean + (1.0 - beta1) * g
     var = beta2 * var + (1.0 - beta2) * jnp.square(g)

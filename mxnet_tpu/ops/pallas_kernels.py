@@ -336,6 +336,263 @@ def paged_attention(q, k_cache, v_cache, block_tables, seq_lens,
                                    seq_lens, float(scale))
 
 
+# ---------------------------------------------------------------------------
+# INT8 conv/FC epilogue: requantize(+relu) over int32 MXU accumulators
+# (the compute body of the serving `native` INT8 lowering and of the
+# subgraph rule `XLA/quantize_conv_requantize` — ops/quantized.py
+# requantize + quantized_act is the numerics oracle)
+# ---------------------------------------------------------------------------
+
+# the quantization range constants ARE ops/quantized.py's — one
+# source, so the kernel and its oracle cannot drift
+from .quantized import INT8_RANGE, INT32_RANGE  # noqa: E402
+
+
+def _int8_epilogue_reference(acc2d, in_scale, out_scale, relu):
+    """jnp fallback + numerics oracle body: EXACTLY requantize-inl.h's
+    `clip(rint(acc_f32 * in_scale * out_scale))` (same multiply order
+    as ops/quantized.requantize, so parity is bitwise), then the int8
+    relu passthrough of quantized_act."""
+    q = jnp.clip(jnp.rint(acc2d.astype(jnp.float32) * in_scale
+                          * out_scale),
+                 -INT8_RANGE, INT8_RANGE).astype(jnp.int8)
+    if relu:
+        q = jnp.maximum(q, 0)
+    return q
+
+
+def _int8_epilogue_kernel(in_s_ref, out_s_ref, acc_ref, o_ref, *, relu):
+    """One row-block program: int32 accumulators stream HBM→VMEM once,
+    the requantize multiply + round + clip (+relu) runs on the VPU, and
+    only int8 leaves — a quarter of the f32 write traffic the unfused
+    dequantize/quantize round-trip pays."""
+    a = acc_ref[...].astype(jnp.float32)
+    q = jnp.rint(a * in_s_ref[0, 0] * out_s_ref[0, 0])
+    q = jnp.clip(q, -INT8_RANGE, INT8_RANGE)
+    if relu:
+        q = jnp.maximum(q, 0.0)
+    o_ref[...] = q.astype(jnp.int8)
+
+
+def _row_block(m, candidates=(2048, 1024, 512, 256, 128, 64, 32, 16, 8)):
+    for bm in candidates:
+        if m % bm == 0:
+            return bm
+    return None
+
+
+@functools.partial(jax.jit, static_argnames=("relu", "interpret"))
+def _int8_epilogue_call(acc2d, in_scale, out_scale, relu, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    m, n = acc2d.shape
+    bm = _row_block(m) or m
+    kernel = functools.partial(_int8_epilogue_kernel, relu=relu)
+    mem = {} if interpret else {"memory_space": pltpu.VMEM}
+    smem = {} if interpret else {"memory_space": pltpu.SMEM}
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.int8),
+        grid=(m // bm,),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), **smem),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), **smem),
+            pl.BlockSpec((bm, n), lambda i: (i, 0), **mem),
+        ],
+        out_specs=pl.BlockSpec((bm, n), lambda i: (i, 0), **mem),
+        interpret=interpret,
+    )(in_scale.reshape(1, 1).astype(jnp.float32),
+      out_scale.reshape(1, 1).astype(jnp.float32), acc2d)
+
+
+def int8_conv_epilogue(acc, in_scale, out_scale, relu=False,
+                       interpret=None, force=False):
+    """Elementwise requantize(+relu) of int32 accumulators to int8.
+
+    acc: any-shape int32. in_scale/out_scale: f32 scalars (float or
+    0-d array; in_scale = one int32 ulp in fp, out_scale = 127 / the
+    calibrated output range — the requantize-inl.h convention).
+    Dispatches to the Pallas kernel on chip backends (or ``force`` —
+    parity tests run it in interpret mode) and to the jnp reference
+    otherwise; shapes whose trailing dims don't flatten to a multiple
+    of 128 always take the reference path.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    in_scale = jnp.asarray(in_scale, jnp.float32)
+    out_scale = jnp.asarray(out_scale, jnp.float32)
+    size = acc.size
+    # a row count no block candidate divides would make the whole
+    # array ONE block — unbounded VMEM; take the reference instead
+    tiles = (size % 128 == 0 and size >= 1024
+             and _row_block(size // 128) is not None)
+    if tiles and (force or not interpret):
+        q2d = _int8_epilogue_call(acc.reshape(-1, 128), in_scale,
+                                  out_scale, bool(relu),
+                                  bool(interpret))
+        return q2d.reshape(acc.shape)
+    return _int8_epilogue_reference(acc, in_scale, out_scale,
+                                    bool(relu))
+
+
+def quantized_conv_epilogue(acc, min_range, max_range,
+                            min_calib_range=None, max_calib_range=None,
+                            relu=False, interpret=None, force=False):
+    """The full requantize(+int8 relu) epilogue with range plumbing:
+    the drop-in tail of ``_sg_xla_quant_conv`` and the serving native
+    lowering, returning ``(int8, min, max)`` exactly like
+    ops/quantized.requantize (+quantized_act). The scale bookkeeping
+    mirrors requantize-inl.h; the elementwise body dispatches through
+    :func:`int8_conv_epilogue`."""
+    real_range = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    in_scale = real_range / INT32_RANGE
+    if min_calib_range is not None:
+        out_max = jnp.float32(max(abs(float(min_calib_range)),
+                                  abs(float(max_calib_range))))
+    else:
+        out_max = jnp.max(jnp.abs(acc)).astype(jnp.float32) * in_scale
+    out_scale = INT8_RANGE / jnp.maximum(out_max, 1e-30)
+    q = int8_conv_epilogue(acc, in_scale, out_scale, relu=relu,
+                           interpret=interpret, force=force)
+    omin, omax = -out_max, out_max
+    if relu:
+        zero = jnp.zeros((), jnp.float32)
+        omin, omax = jnp.maximum(omin, zero), jnp.maximum(omax, zero)
+    return q, omin, omax
+
+
+# ---------------------------------------------------------------------------
+# fused optimizer updates: one kernel = one HBM pass over
+# weight/grad/state for sgd_mom and adam (ops/optimizer_ops.py is the
+# numerics oracle; the jnp fallback below restates its exact formulas)
+# ---------------------------------------------------------------------------
+
+
+def _clip_grad(g, clip):
+    # clip_gradient < 0 disables (the dmlc param convention)
+    if clip is not None and clip >= 0:
+        return jnp.clip(g, -clip, clip)
+    return g
+
+
+def _sgd_mom_reference(weight, grad, mom, lr, momentum, wd, rescale,
+                       clip):
+    """= ops/optimizer_ops.sgd_mom_update, restated for the fallback
+    (kept in lockstep by the tier-1 parity test)."""
+    g = _clip_grad(rescale * grad, clip)
+    mom = momentum * mom - lr * wd * weight - lr * g
+    return weight + mom, mom
+
+
+def _adam_reference(weight, grad, mean, var, lr, beta1, beta2, eps,
+                    wd, rescale, clip):
+    """= ops/optimizer_ops.adam_update (no in-kernel bias correction —
+    the Python optimizer folds it into lr)."""
+    g = _clip_grad(rescale * grad + wd * weight, clip)
+    mean = beta1 * mean + (1.0 - beta1) * g
+    var = beta2 * var + (1.0 - beta2) * jnp.square(g)
+    out = weight - lr * mean / (jnp.sqrt(var) + eps)
+    return out, mean, var
+
+
+def _sgd_mom_kernel(w_ref, g_ref, m_ref, ow_ref, om_ref, *, lr,
+                    momentum, wd, rescale, clip):
+    w = w_ref[...]
+    g = _clip_grad(rescale * g_ref[...], clip)
+    m = momentum * m_ref[...] - lr * wd * w - lr * g
+    ow_ref[...] = w + m
+    om_ref[...] = m
+
+
+def _adam_kernel(w_ref, g_ref, mean_ref, var_ref, ow_ref, omean_ref,
+                 ovar_ref, *, lr, beta1, beta2, eps, wd, rescale, clip):
+    w = w_ref[...]
+    g = _clip_grad(rescale * g_ref[...] + wd * w, clip)
+    mean = beta1 * mean_ref[...] + (1.0 - beta1) * g
+    var = beta2 * var_ref[...] + (1.0 - beta2) * jnp.square(g)
+    ow_ref[...] = w - lr * mean / (jnp.sqrt(var) + eps)
+    omean_ref[...] = mean
+    ovar_ref[...] = var
+
+
+@functools.partial(jax.jit, static_argnames=("kind", "hyper",
+                                             "interpret"))
+def _fused_opt_call(kind, arrays2d, hyper, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    m, n = arrays2d[0].shape
+    bm = _row_block(m) or m
+    h = dict(hyper)
+    if kind == "sgd_mom":
+        kernel = functools.partial(_sgd_mom_kernel, **h)
+        n_out = 2
+    else:
+        kernel = functools.partial(_adam_kernel, **h)
+        n_out = 3
+    mem = {} if interpret else {"memory_space": pltpu.VMEM}
+    spec = pl.BlockSpec((bm, n), lambda i: (i, 0), **mem)
+    return pl.pallas_call(
+        kernel,
+        out_shape=[jax.ShapeDtypeStruct((m, n), arrays2d[0].dtype)
+                   for _ in range(n_out)],
+        grid=(m // bm,),
+        in_specs=[spec] * len(arrays2d),
+        out_specs=[spec] * n_out,
+        interpret=interpret,
+    )(*arrays2d)
+
+
+def _fused_opt_dispatch(kind, weight, arrays, hyper, reference,
+                        interpret, force):
+    """Common wrapper: flatten to (rows, 128) f32, run one kernel pass,
+    reshape back; anything that doesn't tile (or a non-f32 master
+    dtype) takes the jnp reference — the CPU hot path and the oracle."""
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    f32 = all(a.dtype == jnp.float32 for a in arrays)
+    # see int8_conv_epilogue: an undividable row count must fall back,
+    # never become one whole-array VMEM block
+    tiles = (f32 and weight.size % 128 == 0 and weight.size >= 1024
+             and _row_block(weight.size // 128) is not None)
+    if tiles and (force or not interpret):
+        shape = weight.shape
+        arrays2d = tuple(a.reshape(-1, 128) for a in arrays)
+        outs = _fused_opt_call(kind, arrays2d,
+                               tuple(sorted(hyper.items())),
+                               bool(interpret))
+        return tuple(o.reshape(shape) for o in outs)
+    return reference(*arrays, **hyper)
+
+
+def fused_sgd_mom(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, interpret=None,
+                  force=False):
+    """sgd_mom_update as ONE memory pass: w/g/mom stream HBM→VMEM once
+    and (w', mom') stream back — instead of the elementwise chain's
+    multiple reads under op-granular dispatch. Exact formula of
+    ops/optimizer_ops.sgd_mom_update (the oracle)."""
+    hyper = {"lr": float(lr), "momentum": float(momentum),
+             "wd": float(wd), "rescale": float(rescale_grad),
+             "clip": float(clip_gradient)}
+    return _fused_opt_dispatch("sgd_mom", weight, (weight, grad, mom),
+                               hyper, _sgd_mom_reference, interpret,
+                               force)
+
+
+def fused_adam(weight, grad, mean, var, lr=0.01, beta1=0.9,
+               beta2=0.999, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, interpret=None, force=False):
+    """adam_update as ONE memory pass over weight/grad/mean/var.
+    Exact formula of ops/optimizer_ops.adam_update (the oracle)."""
+    hyper = {"lr": float(lr), "beta1": float(beta1),
+             "beta2": float(beta2), "eps": float(epsilon),
+             "wd": float(wd), "rescale": float(rescale_grad),
+             "clip": float(clip_gradient)}
+    return _fused_opt_dispatch("adam", weight,
+                               (weight, grad, mean, var), hyper,
+                               _adam_reference, interpret, force)
+
+
 def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
                     block_k=512, interpret=None, force=False):
     """Blockwise attention, O(T) memory. q, k, v: (B, H, T, D) or

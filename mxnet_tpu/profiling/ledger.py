@@ -73,8 +73,13 @@ def fusion_rule_map():
         return {}
     out = {}
     for backend, prop in _part.registered_properties().items():
-        rule = getattr(prop, "rule_name", None)
-        out[prop.op_name] = "%s/%s" % (backend, rule) if rule else backend
+        # a backend may be one property or a whole rule fleet; either
+        # way every rule's fused op name attributes to "backend/rule"
+        props = prop if isinstance(prop, (list, tuple)) else (prop,)
+        for p in props:
+            rule = getattr(p, "rule_name", None)
+            out[p.op_name] = ("%s/%s" % (backend, rule) if rule
+                             else backend)
     return out
 
 

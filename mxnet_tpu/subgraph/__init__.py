@@ -1,7 +1,11 @@
 """Subgraph partitioning + backend fusion properties
 (ref: src/operator/subgraph/)."""
-from .partition import (SubgraphSelector, SubgraphProperty,
-                        register_subgraph_property, get_subgraph_property,
-                        partition_graph, list_backends)
-from . import xla_fuse  # registers the "XLA" property
+from .partition import (ChainPattern, ChainSelector, Stage,
+                        SubgraphSelector, SubgraphProperty,
+                        backend_rules, register_subgraph_property,
+                        get_subgraph_property, partition_graph,
+                        list_backends, registered_properties)
+from . import xla_fuse  # the conv rule of the "XLA" fleet
+from . import rules  # FC + INT8 rules; registers the "XLA" fleet
 from . import default_property  # registers the "default" property
+from .cost import partition_graph_costed  # cost-tracked partitioning

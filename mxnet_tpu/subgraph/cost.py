@@ -1,0 +1,423 @@
+"""Cost-tracked partitioning: every candidate cluster is *priced*
+before it is claimed, in two currencies, and every decision leaves a
+record.
+
+The PR-6 analytic cost ledger (``profiling/ledger.py`` — per-HLO
+flop/byte pricing against the chip's roofline) and the PR-7 static
+liveness ledger (``profiling/memory.py`` — peak-live bytes over the
+compiled program) stop being read-only observability here and become
+*decision inputs*, the TVM/Relay move (PAPERS.md: arxiv 1802.04799,
+1810.00952): instead of a hand-written pattern that always fires, the
+partitioner lowers each candidate cluster twice —
+
+- **unfused**: one XLA program *per node* — op-granular dispatch, the
+  eager engine's execution model and the granularity the attribution
+  ledger keys its rows to, where every op's output round-trips HBM
+  between programs (the reference's interpreter-dispatched graph that
+  MKL-DNN subgraph fusion exists to collapse);
+- **fused**: the whole cluster as the property's replacement op in ONE
+  program over the same external inputs — intermediates never land in
+  HBM, and the algebraic rewrite (BN→weight fold, requantize collapse)
+  is priced at its real traffic,
+
+prices both through the analytic ledger (``est_s`` = roofline time,
+``bytes`` = HBM traffic) and the liveness ledger (``peak_live_bytes``),
+and fuses only clusters that measurably pay in BOTH currencies:
+roofline time must drop by at least ``MXTPU_FUSE_MIN_SAVE`` (fractional,
+default 0.02) AND peak live bytes must not grow beyond
+``MXTPU_FUSE_MEM_SLACK_MB`` (default 0). A conv whose weights outweigh
+its activations — where folding BN into the weights costs more traffic
+per call than the normalize it removes — is *rejected on cost grounds*,
+decision on record.
+
+The per-partition cost report (one dict per candidate, accepted or
+rejected, structural or priced, ranked by |est saving|) is the decision
+trail ``tools/mfu_report.py`` renders and docs/observability.md's
+"reading a fusion PR" workflow starts from.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from ..base import MXNetError
+from . import partition as _part
+
+COST_REPORT_VERSION = 1
+
+_OFF = ("0", "off", "false", "no")
+
+
+def _env_float(name, default):
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return float(default)
+    return float(v)
+
+
+def cost_enabled():
+    """MXTPU_FUSE_COST gate: default ON — bind-time partitioning prices
+    clusters whenever shapes are known (set 0 to fall back to the
+    always-fire pattern pass)."""
+    return os.environ.get("MXTPU_FUSE_COST", "1").lower() not in _OFF
+
+
+def _aval_bytes(aval):
+    import numpy as np
+    n = 1
+    for d in aval.shape:
+        n *= int(d)
+    return n * np.dtype(aval.dtype).itemsize
+
+
+def price_program(fn, avals, peak_tflops=None, peak_hbm_gbs=None):
+    """Lower+compile ``fn`` over abstract inputs (no execution, no
+    device transfer — trace-time only) and price it with the PR-6
+    flop/byte ledger and the PR-7 liveness ledger."""
+    import jax
+
+    from ..profiling import hlo as _hlo
+    from ..profiling import ledger as _ledger
+    from ..profiling import memory as _memory
+
+    compiled = jax.jit(fn).lower(*avals).compile()
+    text = compiled.as_text()
+    mod = _hlo.parse_module(text)
+    led = _ledger.build_ledger(text, module=mod,
+                               peak_tflops=peak_tflops,
+                               peak_hbm_gbs=peak_hbm_gbs)
+    mem = _memory.build_memory_ledger(text, module=mod)
+    return {
+        "flops": led["totals"]["flops"],
+        "bytes": led["totals"]["bytes"],
+        "est_s": led["totals"]["est_s"],
+        "peak_live_bytes": mem["peak_live_bytes"],
+    }
+
+
+def _node_callable(node):
+    """The op body a graph node dispatches to, with inference-mode
+    static attrs bound (mirrors Executor._build's per-node call)."""
+    from ..ops import registry as _reg
+
+    opdef = _reg.get(node.op)
+    if opdef.needs_rng:
+        raise MXNetError(f"{node.op} draws RNG — unpriceable")
+    attrs = {k: v for k, v in node.attrs.items()
+             if not k.startswith("__")}
+    if "training" in opdef._kwarg_names and "training" not in attrs:
+        attrs["training"] = False
+    return lambda *ins: opdef.fn(*ins, **attrs)
+
+
+def _fused_fn(prop, group_topo, sink, ext_inputs):
+    """The fused cluster as one callable over the UNIQUE external
+    input buffers. The replacement node takes one argument per USE
+    (positional), but at runtime a tensor feeding two cluster edges —
+    the ``x + conv(x)`` self-residual — binds the SAME buffer to both
+    parameters; pricing the program with duplicated parameters would
+    double-count that buffer in the liveness peak and wrongly reject
+    every self-residual cluster on memory grounds. So the pricing
+    program takes each distinct edge once and fans it out per use."""
+    uniq, index_of, expand = [], {}, []
+    for c, k in ext_inputs:
+        key = (id(c), k)
+        if key not in index_of:
+            index_of[key] = len(uniq)
+            uniq.append((c, k))
+        expand.append(index_of[key])
+    fused_node = prop.create_subgraph_node(group_topo, ext_inputs, 0)
+    fused_call = _node_callable(fused_node)
+
+    def fused(*arrays):
+        out = fused_call(*(arrays[i] for i in expand))
+        return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+    return fused, fused_node, uniq
+
+
+def _aval_for(avals, c, k):
+    a = avals.get((id(c), k))
+    if a is None:
+        raise MXNetError(f"no inferred shape for edge {c.name}[{k}]")
+    return a
+
+
+def price_cluster(prop, group_topo, sink, ext_inputs, avals,
+                  peak_tflops=None, peak_hbm_gbs=None):
+    """{"unfused": costs, "fused": costs, deltas} for one candidate.
+
+    Unfused = sum of per-node programs plus a resident-set sweep for
+    the peak (a program's own liveness peak + whatever cluster edges
+    are parked in HBM while it runs). Fused = the one replacement
+    program's ledger + liveness peak.
+    """
+    import jax
+
+    in_group = {id(n) for n in group_topo}
+    step_of = {id(n): i for i, n in enumerate(group_topo)}
+
+    # --- unfused: one program per node ---------------------------------
+    unfused = {"flops": 0, "bytes": 0, "est_s": 0.0}
+    prog_peaks = []
+    node_args = []
+    for n in group_topo:
+        structs = [jax.ShapeDtypeStruct(_aval_for(avals, c, k).shape,
+                                        _aval_for(avals, c, k).dtype)
+                   for c, k in n.inputs]
+        costs = price_program(_node_callable(n), structs,
+                              peak_tflops=peak_tflops,
+                              peak_hbm_gbs=peak_hbm_gbs)
+        for key in ("flops", "bytes", "est_s"):
+            unfused[key] += costs[key]
+        prog_peaks.append(costs["peak_live_bytes"])
+        node_args.append({(id(c), k) for c, k in n.inputs})
+
+    # resident cluster edges while each program runs: deduped external
+    # inputs + internal intermediates born earlier and not yet dead,
+    # minus whatever the running program already counts as its own args
+    ext_edges = {}
+    for c, k in ext_inputs:
+        ext_edges[(id(c), k)] = _aval_bytes(_aval_for(avals, c, k))
+    last = len(group_topo) - 1
+    internal = {}  # edge -> (born, dies, bytes)
+    for i, n in enumerate(group_topo):
+        for k in range(n.num_outputs()):
+            e = (id(n), k)
+            dies = last if n is sink else -1
+            for m in group_topo:
+                if e in {(id(c), kk) for c, kk in m.inputs}:
+                    dies = max(dies, step_of[id(m)])
+            if dies >= 0:
+                a = avals.get(e)
+                if a is not None:
+                    internal[e] = (i, dies, _aval_bytes(a))
+    peak_unfused = 0
+    for i in range(len(group_topo)):
+        extra = sum(b for e, b in ext_edges.items()
+                    if e not in node_args[i])
+        extra += sum(b for e, (born, dies, b) in internal.items()
+                     if born < i <= dies and e not in node_args[i])
+        peak_unfused = max(peak_unfused, prog_peaks[i] + extra)
+    unfused["peak_live_bytes"] = peak_unfused
+
+    # --- fused: the cluster as one program -----------------------------
+    fused_fn, _fnode, uniq = _fused_fn(prop, group_topo, sink,
+                                       ext_inputs)
+    structs = [jax.ShapeDtypeStruct(_aval_for(avals, c, k).shape,
+                                    _aval_for(avals, c, k).dtype)
+               for c, k in uniq]
+    fused = price_program(fused_fn, structs,
+                          peak_tflops=peak_tflops,
+                          peak_hbm_gbs=peak_hbm_gbs)
+    saving_s = unfused["est_s"] - fused["est_s"]
+    return {
+        "unfused": unfused,
+        "fused": fused,
+        "est_saving_s": saving_s,
+        "est_saving_frac": (saving_s / unfused["est_s"]
+                            if unfused["est_s"] > 0 else 0.0),
+        "hbm_bytes_saved": unfused["bytes"] - fused["bytes"],
+        "peak_delta_bytes": (fused["peak_live_bytes"]
+                             - unfused["peak_live_bytes"]),
+    }
+
+
+class CostGate:
+    """The ``gate=`` callback for :func:`partition.partition_graph`:
+    prices each structurally-valid cluster and admits it only when it
+    pays in both currencies; the returned info dict is the decision
+    record the partitioner hands to ``on_decision``. Identical
+    clusters (same rule, fused attrs, input avals) are priced once per
+    pass (ResNet repeats its block shapes)."""
+
+    def __init__(self, avals, min_save_frac=None,
+                 mem_slack_bytes=None, peak_tflops=None,
+                 peak_hbm_gbs=None):
+        self.avals = avals
+        self.min_save_frac = (
+            _env_float("MXTPU_FUSE_MIN_SAVE", 0.02)
+            if min_save_frac is None else float(min_save_frac))
+        self.mem_slack_bytes = (
+            _env_float("MXTPU_FUSE_MEM_SLACK_MB", 0.0) * 1e6
+            if mem_slack_bytes is None else float(mem_slack_bytes))
+        self.peak_tflops = peak_tflops
+        self.peak_hbm_gbs = peak_hbm_gbs
+        self._memo = {}
+
+    def _memo_key(self, prop, group_topo, ext_inputs):
+        fused_node = prop.create_subgraph_node(group_topo, ext_inputs, 0)
+        attrs = tuple(sorted((k, str(v))
+                             for k, v in fused_node.attrs.items()))
+        shapes = tuple((self.avals[(id(c), k)].shape,
+                        str(self.avals[(id(c), k)].dtype))
+                       for c, k in ext_inputs
+                       if (id(c), k) in self.avals)
+        ops = tuple(n.op for n in group_topo)
+        return (fused_node.op, attrs, ops, shapes)
+
+    def __call__(self, prop, group_topo, sink, ext_inputs):
+        rule = getattr(prop, "rule_name", None) or prop.op_name
+        rec = {
+            "rule": rule,
+            "op_name": prop.op_name,
+            "nodes": [n.name for n in group_topo],
+            "sink": sink.name,
+        }
+        try:
+            key = self._memo_key(prop, group_topo, ext_inputs)
+            costs = self._memo.get(key)
+            if costs is None:
+                costs = price_cluster(
+                    prop, group_topo, sink, ext_inputs, self.avals,
+                    peak_tflops=self.peak_tflops,
+                    peak_hbm_gbs=self.peak_hbm_gbs)
+                self._memo[key] = costs
+        except Exception as e:  # noqa: BLE001 — unpriceable = unfused
+            rec["accepted"] = False
+            rec["reason"] = f"unpriceable: {e}"
+            return False, rec
+        rec.update(costs)
+        pays_time = costs["est_saving_frac"] >= self.min_save_frac
+        # the peak ceiling tolerates 1% relative noise (tiny scalar/
+        # layout buffers shift between lowerings) on top of the
+        # absolute slack knob — a real growth (e.g. a folded weight
+        # copy next to the original) still rejects
+        slack = max(self.mem_slack_bytes,
+                    0.01 * costs["unfused"]["peak_live_bytes"])
+        pays_mem = costs["peak_delta_bytes"] <= slack
+        accepted = pays_time and pays_mem
+        rec["accepted"] = accepted
+        if accepted:
+            rec["reason"] = "pays"
+        elif not pays_time:
+            rec["reason"] = (
+                "est_s saving %.4f below the %.4f floor"
+                % (costs["est_saving_frac"], self.min_save_frac))
+        else:
+            rec["reason"] = (
+                "peak live bytes grow %+d beyond the %d-byte slack"
+                % (costs["peak_delta_bytes"], int(slack)))
+        return accepted, rec
+
+
+# rejection reasons produced by the partitioner's structural checks —
+# everything else (priced rejections, unpriceable clusters) is the
+# cost gate's doing
+_STRUCTURAL_REASONS = frozenset(
+    ("not_convex", "no_unique_sink", "internal_output_escapes"))
+
+
+def build_report(backend, decisions, min_save_frac, mem_slack_bytes,
+                 peak_tflops=None, peak_hbm_gbs=None):
+    """The partition cost report document: the full decision trail
+    ranked by |est saving|, plus per-rule aggregates."""
+    from ..profiling.ledger import _peaks
+
+    peak_tflops, peak_hbm_gbs = _peaks(peak_tflops, peak_hbm_gbs)
+    ranked = sorted(decisions,
+                    key=lambda d: -abs(d.get("est_saving_s", 0.0)))
+    by_rule = {}
+    summary = {
+        "clusters": len(decisions),
+        "accepted": 0,
+        "rejected_cost": 0,
+        "rejected_structural": 0,
+        "est_saved_s": 0.0,
+        "hbm_bytes_saved": 0,
+        "peak_delta_bytes": 0,
+    }
+    for d in decisions:
+        rule = d.get("rule", "?")
+        r = by_rule.setdefault(rule, {"accepted": 0, "rejected": 0,
+                                      "est_saved_s": 0.0})
+        if d.get("accepted"):
+            summary["accepted"] += 1
+            r["accepted"] += 1
+            summary["est_saved_s"] += d.get("est_saving_s", 0.0)
+            r["est_saved_s"] += d.get("est_saving_s", 0.0)
+            summary["hbm_bytes_saved"] += d.get("hbm_bytes_saved", 0)
+            summary["peak_delta_bytes"] += d.get("peak_delta_bytes", 0)
+        else:
+            r["rejected"] += 1
+            if d.get("reason") in _STRUCTURAL_REASONS:
+                summary["rejected_structural"] += 1
+            else:
+                summary["rejected_cost"] += 1
+    return {
+        "version": COST_REPORT_VERSION,
+        "kind": "partition_cost_report",
+        "backend": backend,
+        "peak_tflops": peak_tflops,
+        "peak_hbm_gbs": peak_hbm_gbs,
+        "min_save_frac": min_save_frac,
+        "mem_slack_bytes": mem_slack_bytes,
+        "summary": summary,
+        "by_rule": by_rule,
+        "decisions": ranked,
+    }
+
+
+def partition_graph_costed(symbol, backend="XLA", shapes=None,
+                           dtypes=None, min_save_frac=None,
+                           mem_slack_bytes=None, report_path=None,
+                           peak_tflops=None, peak_hbm_gbs=None):
+    """Apply a backend's rule fleet with the cost gate engaged.
+
+    ``shapes`` maps input/var names to shapes (the simple_bind kwargs);
+    parameter shapes back-infer exactly as simple_bind does. Returns
+    ``(fused_symbol, report)`` and writes the report to
+    ``report_path`` (or $MXTPU_FUSE_REPORT) when given. Rule passes
+    re-infer shapes over the running graph, so rule N+1 prices the
+    graph rule N already rewrote.
+    """
+    import jax
+
+    shapes = {k: tuple(v) for k, v in (shapes or {}).items()}
+    dtypes = dict(dtypes or {})
+    decisions = []
+    min_save = (_env_float("MXTPU_FUSE_MIN_SAVE", 0.02)
+                if min_save_frac is None else float(min_save_frac))
+    mem_slack = (_env_float("MXTPU_FUSE_MEM_SLACK_MB", 0.0) * 1e6
+                 if mem_slack_bytes is None else float(mem_slack_bytes))
+    out = symbol
+    for prop in _part.backend_rules(backend):
+        sh, dt = out._infer(shapes, dtypes, partial=True)
+        avals = {}
+        for key, s in sh.items():
+            if s is None:
+                continue
+            avals[key] = jax.ShapeDtypeStruct(
+                tuple(s), dt.get(key) or "float32")
+        gate = CostGate(avals, min_save_frac=min_save,
+                        mem_slack_bytes=mem_slack,
+                        peak_tflops=peak_tflops,
+                        peak_hbm_gbs=peak_hbm_gbs)
+        out = _part._partition_one(out, prop, gate=gate,
+                                   on_decision=decisions.append)
+    name = backend if isinstance(backend, str) else \
+        getattr(backend, "rule_name", None) or "<property>"
+    report = build_report(name, decisions, min_save, mem_slack,
+                          peak_tflops=peak_tflops,
+                          peak_hbm_gbs=peak_hbm_gbs)
+    path = report_path or os.environ.get("MXTPU_FUSE_REPORT")
+    if path:
+        dump_report(report, path)
+    return out, report
+
+
+def dump_report(report, path):
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=1)
+    os.replace(tmp, path)
+    return report
+
+
+def load_report(path):
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or \
+            doc.get("kind") != "partition_cost_report":
+        raise ValueError(f"{path} is not a partition cost report")
+    return doc

@@ -11,13 +11,82 @@ different from MKL-DNN's: XLA already fuses elementwise chains, so
 properties here do *algebraic* rewrites the compiler can't — BN folding
 into conv weights, requantize collapsing — and hand the result to XLA
 as a single op.
+
+Two generalizations over the reference pass (the TVM/Relay move,
+PAPERS.md 1802.04799 / 1810.00952):
+
+- a *backend* may register a whole fleet of rules (``register_subgraph_
+  property`` with a sequence), applied as sequential passes in a
+  deterministic order — sorted by ``(-priority, rule_name)`` — so
+  multi-rule partitioning cannot depend on dict-insertion order and two
+  rules can never double-claim a node (pass N+1 only sees the graph
+  pass N already rewrote, and within one pass the claimed-set check
+  stands);
+- every candidate cluster can be routed through a ``gate`` callback
+  before it is claimed, and every accept/reject (structural or gated)
+  reported through ``on_decision`` — the seam ``subgraph/cost.py`` uses
+  to price clusters with the PR-6 flop/byte ledger and the PR-7
+  liveness ledger and to build the partition cost report.
+
+The declarative :class:`ChainPattern` / :class:`ChainSelector`
+vocabulary expresses the common "seed op + ordered epilogue stages +
+input-producer pulls" shape all current rules share, replacing the
+per-rule hand-written state machines.
 """
 from __future__ import annotations
+
+import ast
 
 from ..base import MXNetError
 from ..symbol.symbol import Symbol, _Node
 
 _PROPERTIES = {}
+
+
+# ---------------------------------------------------------------------------
+# attr coercion — JSON-deserialized / externally-imported symbols carry
+# STRING attr values (MXNet's C++ serializer spells booleans "true"/
+# "false" and tuples "(3, 3)"); every rule that does arithmetic on an
+# attr must coerce first. ``"false"`` is truthy as a raw string — the
+# exact bug class these helpers exist to kill.
+# ---------------------------------------------------------------------------
+
+_FALSE_STRINGS = frozenset(("false", "0", "no", "off", ""))
+
+
+def as_bool(v, default=False):
+    if v is None:
+        return default
+    if isinstance(v, str):
+        return v.strip().lower() not in _FALSE_STRINGS
+    return bool(v)
+
+
+def as_float(v, default=0.0):
+    if v is None:
+        return default
+    return float(v)
+
+
+def as_int(v, default=0):
+    if v is None:
+        return default
+    if isinstance(v, str):
+        return int(float(v))
+    return int(v)
+
+
+def as_tuple(v, default=()):
+    if v is None:
+        return tuple(default)
+    if isinstance(v, str):
+        try:
+            v = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            raise MXNetError(f"cannot parse tuple attr {v!r}") from None
+    if isinstance(v, (int, float)):
+        return (int(v),)
+    return tuple(int(x) for x in v)
 
 
 class SubgraphSelector:
@@ -41,9 +110,17 @@ class SubgraphSelector:
 
 
 class SubgraphProperty:
-    """Backend fusion policy (ref: subgraph_property.h:93)."""
+    """Backend fusion policy (ref: subgraph_property.h:93).
+
+    ``rule_name`` identifies the fusion decision for cost attribution
+    (profiling/ledger.fusion_rule_map) and the partition cost report;
+    ``priority`` orders rules within a backend fleet (higher first,
+    ties broken by rule_name — deterministic by construction).
+    """
 
     op_name = "_subgraph"
+    rule_name = None
+    priority = 0
 
     def create_selector(self):
         return SubgraphSelector()
@@ -63,16 +140,170 @@ class SubgraphProperty:
         raise NotImplementedError
 
 
+# ---------------------------------------------------------------------------
+# declarative pattern vocabulary
+# ---------------------------------------------------------------------------
+
+
+class Stage:
+    """One optional consumer-chain stage of a :class:`ChainPattern`.
+
+    ops : op names that match this stage.
+    guard : ``fn(chain, node) -> bool`` extra admission check (e.g. the
+        BN-normalizes-the-conv-channel-axis test); ``chain`` is the
+        matched node list so far, ``chain[0]`` the seed.
+    required : a chain that ends without matching this stage is
+        discarded by ``filter`` (quantize chains *must* requantize).
+    terminal : once matched, the chain stops growing (relu is always
+        the last post-op: the fused ops apply sum before act).
+    """
+
+    __slots__ = ("name", "ops", "guard", "required", "terminal")
+
+    def __init__(self, name, ops, guard=None, required=False,
+                 terminal=False):
+        self.name = name
+        self.ops = frozenset(ops)
+        self.guard = guard
+        self.required = required
+        self.terminal = terminal
+
+
+class ChainPattern:
+    """seed op + ordered epilogue stages + producer pulls.
+
+    seed_ops : op names a chain may start at.
+    stages : ordered ``Stage`` list; the chain may skip optional stages
+        but never goes back (the kStart→kBN→kSum→kSuccess state machine
+        of mkldnn_conv_property.cc, said declaratively).
+    input_pulls : ``{(node_op, arg_index): producer_op}`` — grow from a
+        matched node to the producer of its ``arg_index``-th input when
+        the producer has that op (quantize feeding a quantized conv).
+    """
+
+    def __init__(self, seed_ops, stages=(), input_pulls=None):
+        self.seed_ops = frozenset(seed_ops)
+        self.stages = tuple(stages)
+        self.input_pulls = dict(input_pulls or {})
+
+
+class ChainSelector(SubgraphSelector):
+    """Execute a :class:`ChainPattern` under the seed-grow protocol."""
+
+    def __init__(self, pattern):
+        self.pattern = pattern
+        self.chain = []
+        self._stages = []            # per-chain-node stage index (seed=-1)
+        self.done = False
+        self.failed = True
+        self.pulled = []             # producers pulled via input_pulls
+
+    @property
+    def stage_idx(self):
+        return self._stages[-1] if self._stages else -1
+
+    def select(self, node):
+        if node.op in self.pattern.seed_ops:
+            self.chain = [node]
+            self._stages = [-1]
+            self.done = False
+            self.failed = False
+            self.pulled = []
+            return True
+        return False
+
+    def select_input(self, node, input_node):
+        if self.failed:
+            return False
+        for i, (child, _k) in enumerate(node.inputs):
+            want = self.pattern.input_pulls.get((node.op, i))
+            if want and child is input_node and input_node.op == want:
+                self.pulled.append(input_node)
+                return True
+        return False
+
+    def select_output(self, node, output_node):
+        if self.failed or self.done:
+            return False
+        if self.chain[-1] is not node:
+            if node in self.chain:
+                # internal branch: truncate behind `node` and stop
+                while self.chain[-1] is not node:
+                    self.chain.pop()
+                    self._stages.pop()
+                self.done = True
+            # a pulled producer's other consumers never grow the chain
+            return False
+        for i in range(self.stage_idx + 1, len(self.pattern.stages)):
+            st = self.pattern.stages[i]
+            if output_node.op not in st.ops:
+                continue
+            if st.guard is not None and not st.guard(self.chain,
+                                                    output_node):
+                self.done = True
+                return False
+            self.chain.append(output_node)
+            self._stages.append(i)
+            if st.terminal:
+                self.done = True
+            return True
+        self.done = True
+        return False
+
+    def filter(self, candidates):
+        if self.failed:
+            return []
+        matched = set(self._stages)
+        for i, st in enumerate(self.pattern.stages):
+            if st.required and i not in matched:
+                return []
+        keep = set(map(id, self.chain)) | set(map(id, self.pulled))
+        return [n for n in candidates if id(n) in keep]
+
+    def optional_ids(self):
+        """Pulled producers are optional: if one's outputs escape the
+        cluster the partitioner drops it instead of rejecting."""
+        return {id(n) for n in self.pulled}
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+def _rule_sort_key(prop):
+    return (-int(getattr(prop, "priority", 0) or 0),
+            str(getattr(prop, "rule_name", None) or prop.op_name))
+
+
 def register_subgraph_property(name, prop):
-    _PROPERTIES[name] = prop
+    """Register a backend: one property, or a whole rule fleet (any
+    sequence of properties). Fleets are stored in their deterministic
+    application order — sorted by ``(-priority, rule_name)`` — so
+    multi-rule partitioning never depends on registration order."""
+    if isinstance(prop, (list, tuple)):
+        _PROPERTIES[name] = tuple(sorted(prop, key=_rule_sort_key))
+    else:
+        _PROPERTIES[name] = prop
     return prop
 
 
 def registered_properties():
-    """{backend name: property} — read-only view for tooling (the
+    """{backend name: property-or-tuple} in sorted-backend order —
+    a read-only, deterministically ordered view for tooling (the
     profiling ledger maps each property's op_name back to its fusion
     rule for cost attribution)."""
-    return dict(_PROPERTIES)
+    return {name: _PROPERTIES[name] for name in sorted(_PROPERTIES)}
+
+
+def backend_rules(prop_or_name):
+    """Resolve a backend name / property / fleet to the ordered tuple
+    of rule properties one partition call will apply."""
+    prop = (get_subgraph_property(prop_or_name)
+            if isinstance(prop_or_name, str) else prop_or_name)
+    if isinstance(prop, (list, tuple)):
+        return tuple(sorted(prop, key=_rule_sort_key))
+    return (prop,)
 
 
 def get_subgraph_property(name):
@@ -96,16 +327,58 @@ def _consumers(order):
     return cons
 
 
-def partition_graph(symbol, prop_or_name):
-    """Apply one property over the whole graph
-    (ref: partition_graph.cc PartitionGraph pass)."""
-    prop = (get_subgraph_property(prop_or_name)
-            if isinstance(prop_or_name, str) else prop_or_name)
+def _external_inputs(group_topo, in_group):
+    """External inputs in first-use positional order, one entry PER
+    USE (no dedup): fused ops unpack inputs positionally, so a tensor
+    feeding two group edges (e.g. x + conv(x)) must appear twice."""
+    ext = []
+    for n in group_topo:
+        for c, k in n.inputs:
+            if id(c) not in in_group:
+                ext.append((c, k))
+    return ext
+
+
+def partition_graph(symbol, prop_or_name, gate=None, on_decision=None):
+    """Apply a backend (one property or its whole rule fleet) over the
+    graph (ref: partition_graph.cc PartitionGraph pass).
+
+    gate : optional ``fn(prop, group_topo, sink, ext_inputs) ->
+        (accept, info)`` consulted after the structural checks; a
+        gated-out cluster stays unfused (and unclaimed, so smaller
+        later seeds may still match).
+    on_decision : optional callback receiving one dict per candidate
+        cluster — accepted or rejected, structural or gated — the
+        partition-cost-report feed (subgraph/cost.py).
+    """
+    out = symbol
+    for prop in backend_rules(prop_or_name):
+        out = _partition_one(out, prop, gate=gate,
+                             on_decision=on_decision)
+    return out
+
+
+def _decide(on_decision, prop, group, accepted, reason, info=None):
+    if on_decision is None:
+        return
+    rec = {
+        "rule": getattr(prop, "rule_name", None) or prop.op_name,
+        "op_name": prop.op_name,
+        "nodes": [n.name for n in group],
+        "accepted": bool(accepted),
+        "reason": reason,
+    }
+    if info:
+        rec.update(info)
+    on_decision(rec)
+
+
+def _partition_one(symbol, prop, gate=None, on_decision=None):
     order = symbol._topo()
     consumers = _consumers(order)
     out_ids = {id(n) for n, _ in symbol._outputs}
     claimed = set()
-    groups = []  # list[list[_Node]]
+    groups = []  # list[(group_topo, sink, ext_inputs)]
 
     for seed in order:
         if seed.op is None or id(seed) in claimed:
@@ -136,14 +409,38 @@ def partition_graph(symbol, prop_or_name):
         group = selector.filter(group)
         if not group:
             continue
+        # optional members (pulled producers) whose outputs escape the
+        # group are dropped rather than failing the whole cluster — a
+        # quantize node shared with another consumer stays outside and
+        # the conv→requantize core still fuses
+        opt_ids = set()
+        if hasattr(selector, "optional_ids"):
+            opt_ids = set(selector.optional_ids())
+        if opt_ids:
+            changed = True
+            while changed:
+                changed = False
+                in_group = {id(n) for n in group}
+                for n in list(group):
+                    if id(n) not in opt_ids:
+                        continue
+                    ext = [c for c in consumers.get(id(n), ())
+                           if id(c) not in in_group]
+                    if ext or id(n) in out_ids:
+                        group.remove(n)
+                        changed = True
+        if not group:
+            continue
         in_group = {id(n) for n in group}
         if not _is_convex(group, in_group, consumers):
+            _decide(on_decision, prop, group, False, "not_convex")
             continue
         # intermediate outputs consumed outside the group (except the
         # group's sink) make the rewrite invalid — reject (the branch
         # negative case, ref: test_neg_conv_bn)
         sink = _find_sink(group, in_group, consumers, out_ids)
         if sink is None:
+            _decide(on_decision, prop, group, False, "no_unique_sink")
             continue
         ok = True
         for n in group:
@@ -155,52 +452,50 @@ def partition_graph(symbol, prop_or_name):
                 ok = False
                 break
         if not ok:
+            _decide(on_decision, prop, group, False,
+                    "internal_output_escapes")
             continue
+        group_topo = _topo_of(group, in_group)
+        ext_inputs = _external_inputs(group_topo, in_group)
+        if gate is not None:
+            accept, info = gate(prop, group_topo, sink, ext_inputs)
+            _decide(on_decision, prop, group_topo, accept,
+                    (info or {}).get("reason", "gated"), info)
+            if not accept:
+                # stays unclaimed: a cheaper sub-cluster seeded later
+                # may still pay
+                continue
+        elif on_decision is not None:
+            _decide(on_decision, prop, group_topo, True, "ungated")
         claimed |= in_group
-        groups.append((group, sink))
+        groups.append((group_topo, sink, ext_inputs))
 
     if not groups:
         return symbol
 
     # rewrite: topo-copy the graph, splicing in subgraph nodes
-    group_of = {}     # id(original node) -> (group, sink)
-    for group, sink in groups:
+    group_of = {}     # id(original node) -> (group, sink, ext)
+    for group, sink, ext in groups:
         for n in group:
-            group_of[id(n)] = (group, sink)
+            group_of[id(n)] = (group, sink, ext)
 
     memo = {}
+    sub_idx = [0]
 
     def copy(node):
         if id(node) in memo:
             return memo[id(node)]
         if id(node) in group_of:
-            group, sink = group_of[id(node)]
-            new = _build_subgraph_node(prop, group, sink, memo, copy)
+            group, sink, ext = group_of[id(node)]
+            new = prop.create_subgraph_node(group, ext, sub_idx[0])
+            sub_idx[0] += 1
             for n in group:
                 memo[id(n)] = new
+            new.inputs = [(copy(c), k) for c, k in ext]
             return new
         new = _Node(node.op, node.name, node.attrs)
         memo[id(node)] = new
         new.inputs = [(copy(c), k) for c, k in node.inputs]
-        return new
-
-    sub_idx = [0]
-
-    def _build_subgraph_node(prop, group, sink, memo, copy):
-        # external inputs in first-use positional order, one entry PER
-        # USE (no dedup): fused ops unpack inputs positionally, so a
-        # tensor feeding two group edges (e.g. x + conv(x)) must appear
-        # twice
-        in_group = {id(n) for n in group}
-        ext_inputs = []
-        for n in _topo_of(group, in_group):
-            for c, k in n.inputs:
-                if id(c) not in in_group:
-                    ext_inputs.append((c, k))
-        new = prop.create_subgraph_node(
-            _topo_of(group, in_group), ext_inputs, sub_idx[0])
-        sub_idx[0] += 1
-        new.inputs = [(copy(c), k) for c, k in ext_inputs]
         return new
 
     outs = [(copy(n), k) for n, k in symbol._outputs]

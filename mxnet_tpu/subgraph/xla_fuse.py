@@ -9,6 +9,13 @@ is algebraic: BatchNorm's affine transform folds into the convolution
 weights *before* the matmul (w' = w·γ/√(σ²+ε), b' = β+(b−μ)·γ/√(σ²+ε)),
 removing the BN entirely from the lowered HLO; the residual add and
 ReLU ride the conv's epilogue fusion on the MXU output.
+
+Since the cost-tracked-partitioner PR this is ONE RULE of the "XLA"
+backend fleet (``subgraph/rules.py`` adds the FC epilogue and the
+INT8 quantize-conv-requantize rules); the hand-written state machine
+became a declarative :class:`~.partition.ChainPattern`. All attr reads
+coerce through ``partition.as_*`` — JSON-deserialized / imported
+symbols carry string attr values, and ``"false"`` is truthy raw.
 """
 from __future__ import annotations
 
@@ -19,10 +26,11 @@ from jax import lax
 from ..ops import registry as _reg
 from ..ops.nn import convolution
 from ..symbol.symbol import _Node
-from .partition import (SubgraphProperty, SubgraphSelector,
-                        register_subgraph_property)
+from .partition import (ChainPattern, ChainSelector, Stage,
+                        SubgraphProperty, as_bool, as_float, as_int,
+                        as_tuple)
 
-_K_START, _K_BN, _K_SUM, _K_SUCCESS, _K_FAIL = range(5)
+_SUM_OPS = ("elemwise_add", "broadcast_add", "_add")
 
 
 @_reg.register("_sg_xla_conv")
@@ -35,6 +43,12 @@ def sg_xla_conv(data, weight, *rest, kernel=(), stride=(), dilate=(),
     Input order after (data, weight): [bias], [gamma, beta, moving_mean,
     moving_var], [sum_input] — presence controlled by attrs.
     """
+    no_bias = as_bool(no_bias)
+    with_bn = as_bool(with_bn)
+    with_sum = as_bool(with_sum)
+    with_act = as_bool(with_act)
+    bn_eps = as_float(bn_eps, 1e-3)
+    bn_fix_gamma = as_bool(bn_fix_gamma, True)
     rest = list(rest)
     bias = rest.pop(0) if not no_bias else None
     if with_bn:
@@ -56,68 +70,44 @@ def sg_xla_conv(data, weight, *rest, kernel=(), stride=(), dilate=(),
     return out
 
 
-class XlaConvSelector(SubgraphSelector):
+def _bn_foldable(chain, bn_node):
+    """The executor's training hook can't see through the fused node,
+    so only global-stats (inference-semantics) BN or fix_gamma'd BN
+    folds; training graphs keep BN separate. The BN must normalize the
+    conv's channel axis (NCHW→1, channel-last→last), else folding into
+    weights is wrong."""
+    conv = chain[0]
+    layout = str(conv.attrs.get("layout") or "")
+    nd = len(as_tuple(conv.attrs.get("kernel", ()))) or 2
+    c_axis = ((nd + 1) if layout and not layout.startswith("NC")
+              else 1)
+    bn_axis = as_int(bn_node.attrs.get("axis", 1), 1)
+    return bn_axis % (nd + 2) == c_axis
+
+
+def _is_relu(chain, act_node):
+    return act_node.attrs.get("act_type") == "relu"
+
+
+_CONV_PATTERN = ChainPattern(
+    seed_ops=("Convolution",),
+    stages=(
+        Stage("bn", ("BatchNorm",), guard=_bn_foldable),
+        Stage("sum", _SUM_OPS),
+        # relu is always the last post-op: sg_xla_conv applies sum
+        # before act, so nothing may fuse after the relu
+        Stage("act", ("Activation",), guard=_is_relu, terminal=True),
+    ),
+)
+
+
+class XlaConvSelector(ChainSelector):
     """conv → [BN] → [add] → [relu] along the consumer chain
-    (same state machine as SgMKLDNNConvSelector)."""
+    (same shape as SgMKLDNNConvSelector's state machine, declared as a
+    ChainPattern)."""
 
     def __init__(self):
-        self.status = _K_FAIL
-        self.matched = []
-
-    def select(self, node):
-        if node.op == "Convolution":
-            self.status = _K_START
-            self.matched = [node]
-            return True
-        return False
-
-    def select_output(self, node, output_node):
-        if self.status in (_K_FAIL, _K_SUCCESS):
-            return False
-        if self.matched[-1] is not node:
-            # internal branch: truncate behind `node` and stop
-            while self.matched[-1] is not node:
-                self.matched.pop()
-            self.status = _K_SUCCESS
-            return False
-        op = output_node.op
-        if self.status == _K_START and op == "BatchNorm":
-            # the executor's training hook can't see through the fused
-            # node, so only global-stats (inference-semantics) BN or
-            # fix_gamma'd BN folds; training graphs keep BN separate.
-            # The BN must normalize the conv's channel axis (NCHW→1,
-            # channel-last→last), else folding into weights is wrong.
-            conv = self.matched[0]
-            layout = str(conv.attrs.get("layout") or "")
-            nd = len(tuple(conv.attrs.get("kernel", ()))) or 2
-            c_axis = ((nd + 1) if layout and not layout.startswith("NC")
-                      else 1)
-            bn_axis = int(output_node.attrs.get("axis", 1))
-            if bn_axis % (nd + 2) != c_axis:
-                self.status = _K_SUCCESS
-                return False
-            self.matched.append(output_node)
-            self.status = _K_BN
-            return True
-        if self.status in (_K_START, _K_BN) and \
-                op in ("elemwise_add", "broadcast_add", "_add"):
-            self.matched.append(output_node)
-            self.status = _K_SUM
-            return True
-        if op == "Activation" and \
-                output_node.attrs.get("act_type") == "relu":
-            self.matched.append(output_node)
-            # relu is always the last post-op: sg_xla_conv applies
-            # sum before act, so nothing may fuse after the relu
-            self.status = _K_SUCCESS
-            return True
-        self.status = _K_SUCCESS
-        return False
-
-    def filter(self, candidates):
-        if self.status == _K_FAIL:
-            return []
-        return [n for n in candidates if n in self.matched]
+        super().__init__(_CONV_PATTERN)
 
 
 class XlaConvProperty(SubgraphProperty):
@@ -129,6 +119,7 @@ class XlaConvProperty(SubgraphProperty):
     # (tools/mfu_report.py --diff), not a guess — the TVM/Relay
     # cost-attributed-partitioning stance (PAPERS.md)
     rule_name = "conv_bn_add_relu"
+    priority = 100
 
     def create_selector(self):
         return XlaConvSelector()
@@ -136,8 +127,7 @@ class XlaConvProperty(SubgraphProperty):
     def create_subgraph_node(self, nodes, external_inputs, idx):
         conv = next(n for n in nodes if n.op == "Convolution")
         bn = next((n for n in nodes if n.op == "BatchNorm"), None)
-        has_sum = any(n.op in ("elemwise_add", "broadcast_add", "_add")
-                      for n in nodes)
+        has_sum = any(n.op in _SUM_OPS for n in nodes)
         has_act = any(n.op == "Activation" for n in nodes)
         keep = ("kernel", "stride", "dilate", "pad", "num_filter",
                 "num_group", "no_bias", "layout")
@@ -146,8 +136,9 @@ class XlaConvProperty(SubgraphProperty):
         attrs["with_sum"] = has_sum
         attrs["with_act"] = has_act
         if bn is not None:
-            attrs["bn_eps"] = bn.attrs.get("eps", 1e-3)
-            attrs["bn_fix_gamma"] = bn.attrs.get("fix_gamma", True)
+            attrs["bn_eps"] = as_float(bn.attrs.get("eps", 1e-3), 1e-3)
+            attrs["bn_fix_gamma"] = as_bool(
+                bn.attrs.get("fix_gamma", True), True)
         name = f"sg_xla_conv_{conv.name}_{idx}"
         return _Node("_sg_xla_conv", name, attrs)
 
@@ -155,26 +146,25 @@ class XlaConvProperty(SubgraphProperty):
 def _sg_conv_shapes(ins, attrs):
     """Back-infer parameter shapes for the fused node (weight/bias +
     folded BN vectors + the sum input at conv-output shape)."""
-    from ..symbol import symbol as _sym
     data = ins[0]
     if data is None:
         return None
-    kernel = tuple(attrs.get("kernel", ()))
-    stride = tuple(attrs.get("stride", ())) or (1,) * len(kernel)
-    dilate = tuple(attrs.get("dilate", ())) or (1,) * len(kernel)
-    pad = tuple(attrs.get("pad", ())) or (0,) * len(kernel)
-    nf = int(attrs.get("num_filter", 0))
-    ng = int(attrs.get("num_group", 1))
+    kernel = as_tuple(attrs.get("kernel", ()))
+    stride = as_tuple(attrs.get("stride", ())) or (1,) * len(kernel)
+    dilate = as_tuple(attrs.get("dilate", ())) or (1,) * len(kernel)
+    pad = as_tuple(attrs.get("pad", ())) or (0,) * len(kernel)
+    nf = as_int(attrs.get("num_filter", 0))
+    ng = as_int(attrs.get("num_group", 1), 1)
     layout = str(attrs.get("layout") or "")
     channel_last = bool(layout) and not layout.startswith("NC")
     cin = int(data[-1] if channel_last else data[1])
     sp0 = 1 if channel_last else 2
     out = [None, (nf, cin // ng) + kernel]
-    if not attrs.get("no_bias", False):
+    if not as_bool(attrs.get("no_bias", False)):
         out.append((nf,))
-    if attrs.get("with_bn"):
+    if as_bool(attrs.get("with_bn")):
         out.extend([(nf,)] * 4)
-    if attrs.get("with_sum"):
+    if as_bool(attrs.get("with_sum")):
         spatial = tuple(
             (data[sp0 + i] + 2 * pad[i] - (dilate[i] * (kernel[i] - 1) + 1))
             // stride[i] + 1 for i in range(len(kernel)))
@@ -189,4 +179,6 @@ def _register_shape_infer():
 
 
 _register_shape_infer()
-register_subgraph_property("XLA", XlaConvProperty())
+# registered as a FLEET together with rules.py's properties — see the
+# bottom of subgraph/rules.py (imported after this module) for the
+# single register_subgraph_property("XLA", (...)) call.

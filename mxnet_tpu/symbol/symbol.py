@@ -554,7 +554,7 @@ class Symbol:
             # inference binds only: fused BN folds moving stats, which
             # would silently freeze them under training
             self = self._maybe_partition(os.environ.get(
-                "MXNET_SUBGRAPH_BACKEND"))
+                "MXNET_SUBGRAPH_BACKEND"), shapes=kwargs)
         type_dict = type_dict or {}
         # static pre-bind validation: report dangling inputs / dtype
         # conflicts by node name instead of a deep JAX trace error
@@ -579,9 +579,18 @@ class Symbol:
                         aux_states=aux, mesh=mesh, arg_specs=arg_specs,
                         group2ctx=group2ctx)
 
-    def _maybe_partition(self, backend):
+    def _maybe_partition(self, backend, shapes=None):
         if not backend:
             return self
+        from ..subgraph import cost as _cost
+        if shapes and _cost.cost_enabled():
+            # bind-time shapes are known: price every candidate cluster
+            # with the flop/byte + liveness ledgers and fuse only what
+            # pays (MXTPU_FUSE_COST=0 restores the always-fire pass;
+            # MXTPU_FUSE_REPORT=path keeps the decision trail)
+            fused, _report = _cost.partition_graph_costed(
+                self, backend, shapes=shapes)
+            return fused
         from ..subgraph import partition_graph
         return partition_graph(self, backend)
 

@@ -1,0 +1,774 @@
+"""Cost-tracked fusion partitioner + Pallas kernel fleet tests.
+
+Covers the compiler layer grown in the fusion-partitioner PR:
+
+- the new rules of the "XLA" fleet (FC epilogue, INT8
+  quantize-conv-requantize) — fused == unfused numerics, bitwise for
+  the int8 chain;
+- the cost gate: accepts clusters that pay in both currencies
+  (flop/byte roofline time via the PR-6 ledger, peak-live-bytes via
+  the PR-7 liveness ledger), REJECTS weight-dominated clusters and
+  no-saving clusters, records every decision in the partition cost
+  report;
+- deterministic multi-rule partitioning (stable order, no
+  double-claim) and the structural convexity/multi-consumer corner
+  cases as standalone fixtures;
+- string-attr coercion (JSON-deserialized / imported symbols carry
+  strings; ``"false"`` is truthy raw) with a save/load round-trip
+  regression;
+- Pallas kernel fleet parity vs the registered-op oracles
+  (ops/quantized.py, ops/optimizer_ops.py) in interpret mode;
+- the committed kernel-bench artifact + perf_gate --kernels
+  self-test with synthetic regressions;
+- committed partition cost report / mfu before-after ledger
+  contracts, env registration, MXL002 scope.
+"""
+import copy
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.subgraph import (backend_rules, partition_graph,
+                                partition_graph_costed,
+                                registered_properties)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def _op_counts(s):
+    counts = {}
+    for node in s._topo():
+        if node.op:
+            counts[node.op] = counts.get(node.op, 0) + 1
+    return counts
+
+
+def _args_for(net, rng=None, scale=1.0, **shape_hints):
+    arg_shapes, _, aux_shapes = net.infer_shape(**shape_hints)
+    rng = rng or np.random.default_rng(0)
+    args = {n: mx.nd.array(
+        rng.standard_normal(sh).astype("float32") * scale)
+        for n, sh in zip(net.list_arguments(), arg_shapes)}
+    aux = {}
+    for n, sh in zip(net.list_auxiliary_states(), aux_shapes):
+        if n.endswith("var"):
+            aux[n] = mx.nd.array(
+                rng.uniform(0.5, 1.5, sh).astype("float32"))
+        else:
+            aux[n] = mx.nd.array(
+                rng.standard_normal(sh).astype("float32"))
+    return args, aux
+
+
+# ---------------------------------------------------------------- new rules
+
+
+def test_fc_add_act_rule_fuses_and_matches():
+    data = sym.var("data")
+    fc = sym.FullyConnected(data, name="fc0", num_hidden=8)
+    other = sym.var("other")
+    net = sym.Activation(sym.elemwise_add(fc, other), act_type="relu")
+    args, _ = _args_for(net, data=(4, 16), other=(4, 8))
+    ref = net.bind(args=args, grad_req="null").forward()[0]
+    fused = partition_graph(net, "XLA")
+    counts = _op_counts(fused)
+    assert counts.get("_sg_xla_fc", 0) == 1
+    assert counts.get("FullyConnected", 0) == 0
+    assert counts.get("elemwise_add", 0) == 0
+    assert counts.get("Activation", 0) == 0
+    out = fused.bind(args=args, grad_req="null").forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fc_act_only_rule():
+    """FC → sigmoid (no sum) fuses with the act_type carried over."""
+    data = sym.var("data")
+    fc = sym.FullyConnected(data, name="fc0", num_hidden=6)
+    net = sym.Activation(fc, act_type="sigmoid")
+    args, _ = _args_for(net, data=(3, 5))
+    ref = net.bind(args=args, grad_req="null").forward()[0]
+    fused = partition_graph(net, "XLA")
+    counts = _op_counts(fused)
+    assert counts.get("_sg_xla_fc", 0) == 1
+    out = fused.bind(args=args, grad_req="null").forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def _quantized_conv_net(two_convs=False):
+    from mxnet_tpu.contrib import quantization as Q
+
+    data = sym.var("data")
+    c = sym.Convolution(data, name="conv0", kernel=(3, 3),
+                        num_filter=8, pad=(1, 1))
+    r = sym.Activation(c, act_type="relu")
+    if two_convs:
+        c2 = sym.Convolution(data, name="convB", kernel=(1, 1),
+                             num_filter=8)
+        fp32 = sym.Group([r, sym.Activation(c2, act_type="relu")])
+    else:
+        fp32 = r
+    qsym, _ = Q._quantize_symbol(fp32)
+    return fp32, qsym
+
+
+def test_quant_chain_rule_fuses_bitwise():
+    """quantize → quantized_conv → requantize → int8 relu collapses to
+    one _sg_xla_quant_conv whose output is BITWISE the unfused chain
+    (ops/quantized.py is the oracle, and the Pallas epilogue's jnp
+    fallback restates its exact formula)."""
+    fp32, qsym = _quantized_conv_net()
+    args, _ = _args_for(fp32, scale=0.5, data=(2, 3, 8, 8))
+    ref = qsym.bind(args=args, grad_req="null").forward()[0]
+    fused = partition_graph(qsym, "XLA")
+    counts = _op_counts(fused)
+    assert counts.get("_sg_xla_quant_conv", 0) == 1
+    assert counts.get("_contrib_quantized_conv", 0) == 0
+    assert counts.get("_contrib_requantize", 0) == 0
+    # weight/bias quantizes stay OUTSIDE the cluster (external
+    # producers); only the data quantize is pulled in
+    assert counts.get("_contrib_quantize_v2", 0) == 2
+    out = fused.bind(args=args, grad_req="null").forward()[0]
+    np.testing.assert_array_equal(out.asnumpy(), ref.asnumpy())
+
+
+def test_quant_chain_shared_quantize_stays_outside():
+    """A data quantize shared by two convs has external consumers: the
+    optional pull drops it and both conv→requantize cores still fuse
+    (with_quantize=False arity), numerics bitwise."""
+    fp32, qsym = _quantized_conv_net(two_convs=True)
+    args, _ = _args_for(fp32, scale=0.5, data=(2, 3, 8, 8))
+    refs = qsym.bind(args=args, grad_req="null").forward()
+    fused = partition_graph(qsym, "XLA")
+    counts = _op_counts(fused)
+    assert counts.get("_sg_xla_quant_conv", 0) == 2
+    # the shared data quantize survives outside both clusters
+    assert counts.get("_contrib_quantize_v2", 0) == 5
+    outs = fused.bind(args=args, grad_req="null").forward()
+    for a, b in zip(refs, outs):
+        np.testing.assert_array_equal(b.asnumpy(), a.asnumpy())
+
+
+def test_fused_rules_json_roundtrip_execute():
+    """Fused _sg_xla_fc / _sg_xla_quant_conv nodes survive a tojson →
+    load_json round trip (attrs stringify) and still execute
+    identically — the save/load regression of the attr-coercion
+    satellite."""
+    data = sym.var("data")
+    fc = sym.FullyConnected(data, name="fc0", num_hidden=8)
+    net = sym.Activation(fc, act_type="relu")
+    args, _ = _args_for(net, data=(4, 16))
+    fused = partition_graph(net, "XLA")
+    rt = sym.load_json(fused.tojson())
+    assert _op_counts(rt) == _op_counts(fused)
+    o1 = fused.bind(args=args, grad_req="null").forward()[0]
+    o2 = rt.bind(args=args, grad_req="null").forward()[0]
+    np.testing.assert_array_equal(o1.asnumpy(), o2.asnumpy())
+
+    fp32, qsym = _quantized_conv_net()
+    qargs, _ = _args_for(fp32, scale=0.5, data=(2, 3, 8, 8))
+    qfused = partition_graph(qsym, "XLA")
+    qrt = sym.load_json(qfused.tojson())
+    assert _op_counts(qrt) == _op_counts(qfused)
+    q1 = qfused.bind(args=qargs, grad_req="null").forward()[0]
+    q2 = qrt.bind(args=qargs, grad_req="null").forward()[0]
+    np.testing.assert_array_equal(q1.asnumpy(), q2.asnumpy())
+
+
+def test_string_attrs_coerced_before_arithmetic():
+    """MXNet-style STRING attr values (the C++ serializer spells
+    booleans "true"/"false") must coerce before arithmetic: a BN with
+    fix_gamma="false" must fold with the real gamma — the raw string
+    is truthy and used to silently select the fix-gamma branch."""
+    data = sym.var("data")
+    c = sym.Convolution(data, name="conv0", kernel=(3, 3),
+                        num_filter=4, pad=(1, 1))
+    b_str = sym.BatchNorm(c, name="bn0", eps="2e-3",
+                          fix_gamma="false", axis="1")
+    net_str = sym.Activation(b_str, act_type="relu")
+    # numeric-attr twin = the ground truth
+    c2 = sym.Convolution(data, name="conv0", kernel=(3, 3),
+                         num_filter=4, pad=(1, 1))
+    b_num = sym.BatchNorm(c2, name="bn0", eps=2e-3, fix_gamma=False,
+                          axis=1)
+    net_num = sym.Activation(b_num, act_type="relu")
+    args, aux = _args_for(net_num, data=(2, 3, 8, 8))
+    ref = net_num.bind(args=args, aux_states=aux,
+                       grad_req="null").forward()[0]
+    fused = partition_graph(net_str, "XLA")
+    assert _op_counts(fused).get("_sg_xla_conv", 0) == 1
+    out = fused.bind(args=args, aux_states=aux,
+                     grad_req="null").forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                               rtol=2e-4, atol=2e-5)
+    # and the fused node round-trips through JSON
+    rt = sym.load_json(fused.tojson())
+    out2 = rt.bind(args=args, aux_states=aux,
+                   grad_req="null").forward()[0]
+    np.testing.assert_array_equal(out.asnumpy(), out2.asnumpy())
+
+
+# ----------------------------------------------------- determinism / fleet
+
+
+def test_backend_rules_deterministic_order():
+    rules = backend_rules("XLA")
+    names = [p.rule_name for p in rules]
+    # (-priority, rule_name): conv(100) -> quant(90) -> fc(80)
+    assert names == ["conv_bn_add_relu", "quantize_conv_requantize",
+                     "fc_add_act"]
+    props = registered_properties()
+    assert list(props) == sorted(props)
+    from mxnet_tpu.subgraph import list_backends
+    assert list_backends() == sorted(list_backends())
+
+
+def test_multi_rule_partition_no_double_claim():
+    """Two rules on one graph: every original node lands in at most
+    one fused cluster, and the whole fleet pass is deterministic."""
+    data = sym.var("data")
+    c = sym.Convolution(data, name="conv0", kernel=(3, 3),
+                        num_filter=4, pad=(1, 1))
+    b = sym.BatchNorm(c, name="bn0", fix_gamma=False)
+    r = sym.Activation(b, act_type="relu")
+    flat = sym.Flatten(r)
+    fc = sym.FullyConnected(flat, name="fc0", num_hidden=8)
+    net = sym.Activation(fc, act_type="relu")
+    claimed = []
+    fused = partition_graph(
+        net, "XLA",
+        on_decision=lambda d: claimed.append(d))
+    counts = _op_counts(fused)
+    assert counts.get("_sg_xla_conv", 0) == 1
+    assert counts.get("_sg_xla_fc", 0) == 1
+    assert counts.get("Convolution", 0) == 0
+    assert counts.get("FullyConnected", 0) == 0
+    accepted = [d for d in claimed if d["accepted"]]
+    seen = set()
+    for d in accepted:
+        for name in d["nodes"]:
+            assert name not in seen, f"{name} claimed twice"
+            seen.add(name)
+    # determinism: a second pass yields the identical decision list
+    claimed2 = []
+    partition_graph(net, "XLA",
+                    on_decision=lambda d: claimed2.append(d))
+    assert [d["nodes"] for d in claimed2] == \
+        [d["nodes"] for d in claimed]
+
+
+# ------------------------------------------- convexity / multi-consumer
+
+
+def test_sum_input_consumed_outside_cluster():
+    """add's other operand is produced from the cluster's own BN
+    output through an external op — fusing the add would create a
+    cycle; the convexity check must reject and numerics survive."""
+    data = sym.var("data")
+    c = sym.Convolution(data, name="conv0", kernel=(3, 3),
+                        num_filter=4, pad=(1, 1))
+    b = sym.BatchNorm(c, name="bn0", fix_gamma=False)
+    outside = sym.tanh(b)                       # external path from bn
+    s = sym.elemwise_add(b, outside)
+    net = sym.Activation(s, act_type="relu")
+    args, aux = _args_for(net, data=(2, 3, 8, 8))
+    ref = net.bind(args=args, aux_states=aux,
+                   grad_req="null").forward()[0]
+    fused = partition_graph(net, "XLA")
+    counts = _op_counts(fused)
+    # the selector retreats to conv+bn (a valid sink with two external
+    # consumers); the add and the external tanh path must survive
+    assert counts.get("tanh", 0) == 1
+    assert counts.get("elemwise_add", 0) == 1
+    assert counts.get("_sg_xla_conv", 0) == 1
+    out = fused.bind(args=args, aux_states=aux,
+                     grad_req="null").forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_non_convex_cluster_rejected_with_decision():
+    """conv → tanh → add(conv, tanh): a greedy whitelist selector
+    grows {conv, add}, but the external tanh path re-enters the
+    cluster — fusing would create a cycle. The convexity check must
+    reject AND record the decision."""
+    from mxnet_tpu.subgraph.default_property import \
+        DefaultSubgraphProperty
+
+    data = sym.var("data")
+    c = sym.Convolution(data, name="conv0", kernel=(1, 1),
+                        num_filter=3)
+    t = sym.tanh(c)
+    net = sym.elemwise_add(c, t)
+    prop = DefaultSubgraphProperty(["Convolution", "elemwise_add"])
+    decisions = []
+    fused = partition_graph(net, prop,
+                            on_decision=decisions.append)
+    counts = _op_counts(fused)
+    assert counts.get("elemwise_add", 0) == 1
+    assert counts.get("_subgraph_exec", 0) == 0
+    rejected = [d for d in decisions if not d["accepted"]]
+    assert any(d["reason"] == "not_convex" for d in rejected)
+    args, _ = _args_for(net, data=(2, 3, 4, 4))
+    ref = net.bind(args=args, grad_req="null").forward()[0]
+    out = fused.bind(args=args, grad_req="null").forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_conv_two_consumers_add_not_fused():
+    """conv output feeds the add AND a pooling head: the add must not
+    fold into the conv epilogue (the conv's output escapes)."""
+    data = sym.var("data")
+    other = sym.var("other")
+    c = sym.Convolution(data, name="conv0", kernel=(3, 3),
+                        num_filter=4, pad=(1, 1))
+    s = sym.elemwise_add(c, other)
+    pool = sym.Pooling(c, kernel=(2, 2), stride=(2, 2),
+                       pool_type="avg")
+    net = sym.Group([sym.Activation(s, act_type="relu"), pool])
+    args, _ = _args_for(net, data=(2, 3, 8, 8), other=(2, 4, 8, 8))
+    refs = net.bind(args=args, grad_req="null").forward()
+    fused = partition_graph(net, "XLA")
+    counts = _op_counts(fused)
+    assert counts.get("elemwise_add", 0) == 1
+    outs = fused.bind(args=args, grad_req="null").forward()
+    for a, b in zip(refs, outs):
+        np.testing.assert_allclose(b.asnumpy(), a.asnumpy(),
+                                   rtol=2e-4, atol=2e-5)
+
+
+# ------------------------------------------------------------- cost gate
+
+
+def test_cost_gate_accepts_paying_cluster():
+    data = sym.var("data")
+    c = sym.Convolution(data, name="conv0", kernel=(3, 3),
+                        num_filter=8, pad=(1, 1))
+    b = sym.BatchNorm(c, name="bn0", fix_gamma=False)
+    net = sym.Activation(b, act_type="relu")
+    fused, report = partition_graph_costed(
+        net, "XLA", shapes={"data": (2, 3, 16, 16)})
+    assert _op_counts(fused).get("_sg_xla_conv", 0) == 1
+    acc = [d for d in report["decisions"] if d["accepted"]]
+    assert len(acc) == 1 and acc[0]["reason"] == "pays"
+    # both currencies priced and on record
+    assert acc[0]["unfused"]["est_s"] > acc[0]["fused"]["est_s"]
+    assert "peak_live_bytes" in acc[0]["unfused"]
+    assert acc[0]["est_saving_frac"] >= 0.02
+
+
+def test_cost_gate_rejects_weight_dominated_cluster():
+    """A wide 1x1 conv over a (N, C, 1, 1) vector: folding BN into the
+    weights costs a folded-weight copy at peak and more traffic than
+    the normalize it removes — the gate must leave it unfused with
+    the decision on record."""
+    data = sym.var("data")
+    c = sym.Convolution(data, name="se_conv", kernel=(1, 1),
+                        num_filter=512)
+    b = sym.BatchNorm(c, name="se_bn", fix_gamma=False)
+    net = sym.Activation(b, act_type="relu")
+    fused, report = partition_graph_costed(
+        net, "XLA", shapes={"data": (1, 256, 1, 1)})
+    counts = _op_counts(fused)
+    assert counts.get("_sg_xla_conv", 0) == 0
+    assert counts.get("BatchNorm", 0) == 1
+    rej = [d for d in report["decisions"] if not d["accepted"]]
+    assert len(rej) == 1
+    # rejected on COST grounds: both currencies were priced
+    assert "unfused" in rej[0] and "fused" in rej[0]
+    assert report["summary"]["rejected_cost"] == 1
+
+
+def test_cost_gate_rejects_no_saving_cluster():
+    """A bare FC (no epilogue) prices identical fused and unfused —
+    below the min-save floor, stays unfused."""
+    data = sym.var("data")
+    net = sym.FullyConnected(data, name="fc0", num_hidden=8)
+    fused, report = partition_graph_costed(
+        net, "XLA", shapes={"data": (4, 16)})
+    assert _op_counts(fused).get("_sg_xla_fc", 0) == 0
+    rej = [d for d in report["decisions"] if not d["accepted"]]
+    assert len(rej) == 1 and "floor" in rej[0]["reason"]
+
+
+def test_cost_gate_min_save_knob(monkeypatch):
+    """MXTPU_FUSE_MIN_SAVE high enough rejects everything."""
+    monkeypatch.setenv("MXTPU_FUSE_MIN_SAVE", "0.99")
+    data = sym.var("data")
+    c = sym.Convolution(data, name="conv0", kernel=(3, 3),
+                        num_filter=8, pad=(1, 1))
+    b = sym.BatchNorm(c, name="bn0", fix_gamma=False)
+    net = sym.Activation(b, act_type="relu")
+    fused, report = partition_graph_costed(
+        net, "XLA", shapes={"data": (2, 3, 16, 16)})
+    assert _op_counts(fused).get("_sg_xla_conv", 0) == 0
+    assert report["summary"]["accepted"] == 0
+
+
+def test_costed_bind_writes_report(monkeypatch, tmp_path):
+    """simple_bind under MXNET_SUBGRAPH_BACKEND routes through the
+    cost gate and MXTPU_FUSE_REPORT captures the decision trail."""
+    path = str(tmp_path / "fuse_report.json")
+    monkeypatch.setenv("MXNET_SUBGRAPH_BACKEND", "XLA")
+    monkeypatch.setenv("MXTPU_FUSE_REPORT", path)
+    data = sym.var("data")
+    c = sym.Convolution(data, name="conv0", kernel=(3, 3),
+                        num_filter=4, pad=(1, 1))
+    b = sym.BatchNorm(c, name="bn0", fix_gamma=False)
+    net = sym.Activation(b, act_type="relu")
+    ex = net.simple_bind(data=(2, 3, 16, 16), grad_req="null")
+    assert "_sg_xla_conv" in _op_counts(ex._symbol)
+    doc = json.load(open(path))
+    assert doc["kind"] == "partition_cost_report"
+    assert doc["summary"]["accepted"] >= 1
+
+
+def test_report_ranked_and_versioned():
+    data = sym.var("data")
+    c = sym.Convolution(data, name="conv0", kernel=(3, 3),
+                        num_filter=8, pad=(1, 1))
+    b = sym.BatchNorm(c, name="bn0", fix_gamma=False)
+    r = sym.Activation(b, act_type="relu")
+    flat = sym.Flatten(r)
+    net = sym.FullyConnected(flat, name="fc0", num_hidden=4)
+    _, report = partition_graph_costed(
+        net, "XLA", shapes={"data": (2, 3, 16, 16)})
+    assert report["version"] == 1
+    assert report["kind"] == "partition_cost_report"
+    savings = [abs(d.get("est_saving_s", 0.0))
+               for d in report["decisions"]]
+    assert savings == sorted(savings, reverse=True)
+    assert set(report["by_rule"]) <= {
+        "conv_bn_add_relu", "quantize_conv_requantize", "fc_add_act"}
+
+
+def test_fusion_rule_map_covers_fleet():
+    from mxnet_tpu.profiling.ledger import fusion_rule_map
+
+    m = fusion_rule_map()
+    assert m["_sg_xla_conv"] == "XLA/conv_bn_add_relu"
+    assert m["_sg_xla_quant_conv"] == "XLA/quantize_conv_requantize"
+    assert m["_sg_xla_fc"] == "XLA/fc_add_act"
+
+
+# -------------------------------------------------------- kernel parity
+
+
+def test_int8_epilogue_parity_bitwise():
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import pallas_kernels as pk
+    from mxnet_tpu.ops import quantized as q8
+
+    rng = np.random.default_rng(0)
+    acc = jnp.asarray(rng.integers(-2 ** 20, 2 ** 20, (2, 8, 8, 8)),
+                      jnp.int32)
+    mn, mx_ = jnp.float32(-3.2e6), jnp.float32(3.2e6)
+    for relu in (False, True):
+        for calib in (None, 2.5):
+            kw = (dict(min_calib_range=-calib, max_calib_range=calib)
+                  if calib else {})
+            ref, rmin, rmax = q8.requantize(acc, mn, mx_, **kw)
+            if relu:
+                ref, rmin, rmax = q8.quantized_act(ref, rmin, rmax)
+            out, omin, omax = pk.quantized_conv_epilogue(
+                acc, mn, mx_, relu=relu, force=True, interpret=True,
+                **kw)
+            assert out.dtype == jnp.int8
+            np.testing.assert_array_equal(np.asarray(out),
+                                          np.asarray(ref))
+            assert float(omin) == float(rmin)
+            assert float(omax) == float(rmax)
+
+
+def test_int8_epilogue_zero_range_guard():
+    """All-zero accumulators with zero ranges must quantize to zeros,
+    not 0*inf=NaN (the _range_scale guard, mirrored)."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import pallas_kernels as pk
+
+    acc = jnp.zeros((4, 256), jnp.int32)
+    out, omin, omax = pk.quantized_conv_epilogue(
+        acc, jnp.float32(0.0), jnp.float32(0.0), relu=True,
+        force=True, interpret=True)
+    assert int(np.abs(np.asarray(out)).max()) == 0
+    assert np.isfinite(float(omax))
+
+
+def test_fused_sgd_mom_parity():
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import optimizer_ops as oo
+    from mxnet_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.default_rng(0)
+    n = 16 * 128
+    w, g, m = (jnp.asarray(rng.standard_normal(n), jnp.float32)
+               for _ in range(3))
+    for clip in (-1.0, 0.5):
+        ref = oo.sgd_mom_update(w, g, m, lr=0.05, momentum=0.9,
+                                wd=1e-4, rescale_grad=1 / 32,
+                                clip_gradient=clip)
+        out = pk.fused_sgd_mom(w, g, m, lr=0.05, momentum=0.9,
+                               wd=1e-4, rescale_grad=1 / 32,
+                               clip_gradient=clip, force=True,
+                               interpret=True)
+        for a, b in zip(ref, out):
+            assert float(jnp.abs(a - b).max()) <= 2e-6
+
+
+def test_fused_adam_parity():
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import optimizer_ops as oo
+    from mxnet_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.default_rng(1)
+    n = 16 * 128
+    w, g, m = (jnp.asarray(rng.standard_normal(n), jnp.float32)
+               for _ in range(3))
+    v = jnp.abs(jnp.asarray(rng.standard_normal(n), jnp.float32))
+    ref = oo.adam_update(w, g, m, v, lr=0.01, wd=1e-4,
+                         rescale_grad=1 / 32, clip_gradient=1.0)
+    out = pk.fused_adam(w, g, m, v, lr=0.01, wd=1e-4,
+                        rescale_grad=1 / 32, clip_gradient=1.0,
+                        force=True, interpret=True)
+    for a, b in zip(ref, out):
+        assert float(jnp.abs(a - b).max()) <= 2e-6
+
+
+def test_fused_opt_nontiling_fallback_is_oracle():
+    """A size that doesn't tile (not a 128 multiple) silently takes
+    the jnp reference — identical to the registered op."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import optimizer_ops as oo
+    from mxnet_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.default_rng(2)
+    w, g, m = (jnp.asarray(rng.standard_normal(37), jnp.float32)
+               for _ in range(3))
+    ref = oo.sgd_mom_update(w, g, m, lr=0.1, momentum=0.9)
+    out = pk.fused_sgd_mom(w, g, m, lr=0.1, momentum=0.9, force=True,
+                           interpret=True)
+    for a, b in zip(ref, out):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fused_opt_undividable_rows_fall_back():
+    """size % 128 == 0 but a row count no block candidate divides
+    (e.g. 9999 rows) must take the jnp reference — one whole-array
+    VMEM block would blow the compile on chip."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import optimizer_ops as oo
+    from mxnet_tpu.ops import pallas_kernels as pk
+
+    assert pk._row_block(9999) is None
+    rng = np.random.default_rng(4)
+    n = 9999 * 128
+    w, g, m = (jnp.asarray(rng.standard_normal(n), jnp.float32)
+               for _ in range(3))
+    ref = oo.sgd_mom_update(w, g, m, lr=0.1, momentum=0.9)
+    out = pk.fused_sgd_mom(w, g, m, lr=0.1, momentum=0.9, force=True,
+                           interpret=True)
+    for a, b in zip(ref, out):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fused_opt_env_knob(monkeypatch):
+    """MXTPU_KERNEL_FUSED_OPT=1 routes the registered op through the
+    fused wrapper even on CPU (where it falls back to the identical
+    jnp formula) — and =0 forces the plain path."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import optimizer_ops as oo
+
+    rng = np.random.default_rng(3)
+    n = 8 * 128
+    w, g, m = (jnp.asarray(rng.standard_normal(n), jnp.float32)
+               for _ in range(3))
+    monkeypatch.setenv("MXTPU_KERNEL_FUSED_OPT", "0")
+    plain = oo.sgd_mom_update(w, g, m, lr=0.05, momentum=0.9)
+    monkeypatch.setenv("MXTPU_KERNEL_FUSED_OPT", "1")
+    routed = oo.sgd_mom_update(w, g, m, lr=0.05, momentum=0.9)
+    for a, b in zip(plain, routed):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_quant_fused_op_epilogue_knob(monkeypatch):
+    """_sg_xla_quant_conv produces identical int8 with the Pallas
+    epilogue wrapper on or off (MXTPU_KERNEL_INT8_EPILOGUE=0)."""
+    fp32, qsym = _quantized_conv_net()
+    args, _ = _args_for(fp32, scale=0.5, data=(2, 3, 8, 8))
+    fused = partition_graph(qsym, "XLA")
+    out_on = fused.bind(args=args, grad_req="null").forward()[0]
+    monkeypatch.setenv("MXTPU_KERNEL_INT8_EPILOGUE", "0")
+    out_off = fused.bind(args=args, grad_req="null").forward()[0]
+    np.testing.assert_array_equal(out_on.asnumpy(), out_off.asnumpy())
+
+
+# --------------------------------------- committed artifacts + perf_gate
+
+
+def _load(path):
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def test_committed_kernel_artifact_contract():
+    doc = _load(os.path.join(REPO, "docs", "artifacts",
+                             "KERNELS_LAST_GOOD.json"))
+    assert doc["tool"] == "kernel_bench" and doc["version"] == 1
+    kernels = doc["kernels"]
+    for name in ("flash_attention", "paged_attention",
+                 "int8_conv_epilogue", "fused_sgd_mom", "fused_adam"):
+        e = kernels[name]
+        assert e["parity_ok"] is True
+        assert isinstance(e["parity_max_abs"], (int, float))
+        assert e["fallback_ms"] > 0
+    # integer-output kernel parity is EXACT
+    assert kernels["int8_conv_epilogue"]["parity_max_abs"] == 0.0
+
+
+def test_perf_gate_kernels_committed_and_regressions(tmp_path):
+    import perf_gate
+
+    committed = os.path.join(REPO, "docs", "artifacts",
+                             "kernel_bench_20260804.json")
+    assert perf_gate.main([committed, "--kernels"]) == 0
+
+    good = _load(os.path.join(REPO, "docs", "artifacts",
+                              "KERNELS_LAST_GOOD.json"))
+
+    def gate(mutate):
+        cand = copy.deepcopy(good)
+        mutate(cand)
+        p = tmp_path / "cand.json"
+        p.write_text(json.dumps(cand))
+        return perf_gate.main([str(p), "--kernels"])
+
+    # parity flips false -> regression
+    def bad_parity(c):
+        c["kernels"]["fused_adam"]["parity_ok"] = False
+    assert gate(bad_parity) == 1
+    # dropped kernel -> regression
+    def dropped(c):
+        del c["kernels"]["int8_conv_epilogue"]
+    assert gate(dropped) == 1
+    # fallback inflation beyond tolerance -> regression
+    def slow(c):
+        c["kernels"]["flash_attention"]["fallback_ms"] *= 10
+    assert gate(slow) == 1
+    # compiled kernel losing to its fallback -> regression
+    def losing(c):
+        c["kernels"]["fused_sgd_mom"]["kernel_ms"] = 9.9
+        c["kernels"]["fused_sgd_mom"]["kernel_vs_fallback"] = 0.5
+    assert gate(losing) == 1
+    # empty kernels -> signal-free
+    def empty(c):
+        c["kernels"] = {}
+    assert gate(empty) == 3
+    # wrong version -> unreadable
+    def wrong(c):
+        c["version"] = 99
+    assert gate(wrong) == 2
+
+
+def test_committed_partition_report_contract():
+    doc = _load(os.path.join(REPO, "docs", "artifacts",
+                             "partition_cost_20260804.json"))
+    assert doc["kind"] == "partition_cost_report"
+    by_rule = doc["by_rule"]
+    # the acceptance bar: conv rule + >=2 new rules accepted, and at
+    # least one cluster rejected on COST grounds with both currencies
+    # on record
+    assert by_rule["conv_bn_add_relu"]["accepted"] >= 1
+    assert by_rule["quantize_conv_requantize"]["accepted"] >= 1
+    assert by_rule["fc_add_act"]["accepted"] >= 1
+    cost_rejects = [d for d in doc["decisions"]
+                    if not d["accepted"] and "unfused" in d]
+    assert cost_rejects, "no cost-ground rejection in the report"
+    for d in cost_rejects:
+        assert "est_s" in d["unfused"]
+        assert "peak_live_bytes" in d["fused"]
+
+
+def test_committed_mfu_diff_shows_rule_attribution():
+    from mxnet_tpu.profiling import ledger
+
+    before = _load(os.path.join(REPO, "docs", "artifacts",
+                                "mfu_resnet_sym_unfused.json"))
+    after = _load(os.path.join(REPO, "docs", "artifacts",
+                               "mfu_resnet_sym_fused.json"))
+    rules_after = {g["op"]: g.get("rule")
+                   for g in after["by_op"] if g.get("rule")}
+    assert rules_after.get("_sg_xla_conv") == "XLA/conv_bn_add_relu"
+    assert rules_after.get("_sg_xla_quant_conv") == \
+        "XLA/quantize_conv_requantize"
+    assert rules_after.get("_sg_xla_fc") == "XLA/fc_add_act"
+    assert not any(g.get("rule") for g in before["by_op"])
+    rows = ledger.diff(before, after)
+    delta = {r["op"]: r for r in rows}
+    # fused rows appear, swallowed ops drop to zero
+    assert delta["_sg_xla_conv"]["after_s"] > 0
+    assert delta["_sg_xla_conv"]["before_s"] == 0
+    assert delta["Convolution"]["after_s"] == 0
+
+
+def test_mfu_report_renders_partition_report(capsys):
+    import mfu_report
+
+    path = os.path.join(REPO, "docs", "artifacts",
+                        "partition_cost_20260804.json")
+    assert mfu_report.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "partition_cost_report" in out
+    assert "ACCEPT" in out and "reject" in out
+    assert "conv_bn_add_relu" in out
+
+
+# --------------------------------------------- env registration / lint
+
+
+def test_new_env_vars_registered():
+    from mxnet_tpu import libinfo
+
+    new = ("MXTPU_FUSE_COST", "MXTPU_FUSE_MIN_SAVE",
+           "MXTPU_FUSE_MEM_SLACK_MB", "MXTPU_FUSE_REPORT",
+           "MXTPU_KERNEL_FUSED_OPT", "MXTPU_KERNEL_INT8_EPILOGUE")
+    docs = open(os.path.join(REPO, "docs", "env_vars.md")).read()
+    for name in new:
+        assert name in libinfo._ENV_VARS, name
+        assert name in docs, "%s missing from docs/env_vars.md" % name
+
+
+def test_mxl002_scope_covers_partitioner(tmp_path):
+    """The host-sync rule patrols the partitioner's trace-time paths:
+    a device sync planted in price_cluster or select_output must be
+    flagged."""
+    from mxnet_tpu.analysis.lint import run_lint
+    from mxnet_tpu.analysis.rules.host_sync import HostSyncRule
+
+    bad = tmp_path / "mxnet_tpu" / "subgraph"
+    bad.mkdir(parents=True)
+    f = bad / "evil.py"
+    f.write_text(
+        "def price_cluster(prop, group, sink, ext, avals):\n"
+        "    x.asnumpy()\n"
+        "    return {}\n"
+        "def select_output(self, node, out):\n"
+        "    node.wait_to_read()\n"
+        "    return False\n")
+    result = run_lint(str(tmp_path), [HostSyncRule()], files=[str(f)])
+    codes = [fd.code for fd in result.findings]
+    assert codes.count("MXL002") >= 2

@@ -142,14 +142,29 @@ def test_fused_graph_json_roundtrip():
 
 
 def test_env_var_backend(monkeypatch):
+    """simple_bind honors MXNET_SUBGRAPH_BACKEND and routes through the
+    cost-tracked partitioner: a conv+BN+relu cluster whose activation
+    traffic dwarfs its weights pays and fuses. (A bare conv+relu no
+    longer fuses by default — the cost gate prices it at zero saving,
+    since XLA fuses that epilogue anyway; MXTPU_FUSE_COST=0 restores
+    the always-fire pattern pass.)"""
     monkeypatch.setenv("MXNET_SUBGRAPH_BACKEND", "XLA")
     data = sym.var("data")
     c = sym.Convolution(data, name="conv0", kernel=(3, 3), num_filter=4,
                         pad=(1, 1))
-    net = sym.Activation(c, act_type="relu")
-    ex = net.simple_bind(data=(1, 3, 6, 6), grad_req="null")
+    b = sym.BatchNorm(c, name="bn0", fix_gamma=False)
+    net = sym.Activation(b, act_type="relu")
+    ex = net.simple_bind(data=(2, 3, 16, 16), grad_req="null")
     assert "sg_xla_conv" in " ".join(
         n.name for n in ex._symbol._topo() if n.op)
+    # the always-fire pass is still reachable for a non-paying cluster
+    monkeypatch.setenv("MXTPU_FUSE_COST", "0")
+    net2 = sym.Activation(
+        sym.Convolution(sym.var("data"), name="c1", kernel=(3, 3),
+                        num_filter=4, pad=(1, 1)), act_type="relu")
+    ex2 = net2.simple_bind(data=(1, 3, 6, 6), grad_req="null")
+    assert "sg_xla_conv" in " ".join(
+        n.name for n in ex2._symbol._topo() if n.op)
 
 
 def test_default_property_op_name_set():

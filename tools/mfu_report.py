@@ -66,9 +66,11 @@ def _read_doc(path):
         print("mfu_report: cannot read %s: %s" % (path, e),
               file=sys.stderr)
         raise SystemExit(2)
-    if not isinstance(doc, dict) or "rows" not in doc:
-        print("mfu_report: %s is not a ledger/attribution document "
-              "(no 'rows' key)" % path, file=sys.stderr)
+    if not isinstance(doc, dict) or (
+            "rows" not in doc
+            and doc.get("kind") != "partition_cost_report"):
+        print("mfu_report: %s is not a ledger/attribution/partition-"
+              "cost document (no 'rows' key)" % path, file=sys.stderr)
         raise SystemExit(2)
     return doc
 
@@ -80,8 +82,46 @@ def _fmt_bytes(n):
     return "%dB" % n
 
 
+def format_partition_report(doc, top=25):
+    """Ranked fusion-decision table from a subgraph/cost.py partition
+    cost report — the decision trail of a cost-tracked partitioning
+    pass (docs/observability.md "Reading a fusion PR")."""
+    s = doc.get("summary", {})
+    lines = [
+        "# partition_cost_report: backend %s  (peak %.0f TFLOP/s, "
+        "%.0f GB/s HBM)" % (doc.get("backend"),
+                            doc.get("peak_tflops", 0.0),
+                            doc.get("peak_hbm_gbs", 0.0)),
+        "# clusters %d: %d accepted, %d rejected on cost, %d rejected "
+        "structurally; est saved %.4f ms, HBM saved %s/step, peak "
+        "delta %+d bytes"
+        % (s.get("clusters", 0), s.get("accepted", 0),
+           s.get("rejected_cost", 0), s.get("rejected_structural", 0),
+           s.get("est_saved_s", 0.0) * 1e3,
+           _fmt_bytes(max(s.get("hbm_bytes_saved", 0), 0)),
+           s.get("peak_delta_bytes", 0)),
+        "%-28s %-8s %10s %10s %10s %s" % (
+            "rule", "verdict", "save_ms", "save_frac", "peak_delta",
+            "cluster / reason"),
+    ]
+    for d in doc.get("decisions", [])[:top]:
+        cluster = ",".join(d.get("nodes", []))[:40]
+        reason = d.get("reason", "")
+        lines.append("%-28s %-8s %10.4f %9.1f%% %10d %s" % (
+            d.get("rule", "?")[:28],
+            "ACCEPT" if d.get("accepted") else "reject",
+            d.get("est_saving_s", 0.0) * 1e3,
+            d.get("est_saving_frac", 0.0) * 100,
+            d.get("peak_delta_bytes", 0),
+            cluster if d.get("accepted") else
+            "%s [%s]" % (cluster, reason)))
+    return "\n".join(lines)
+
+
 def format_table(doc, top=25):
     """Ranked per-op attribution table + reconciliation footer."""
+    if doc.get("kind") == "partition_cost_report":
+        return format_partition_report(doc, top=top)
     lines = []
     measured = "measured" in doc or any(
         "measured_s" in g for g in doc.get("by_op", []))

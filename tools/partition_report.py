@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""partition_report — run the cost-tracked partitioner over a bench
+graph and commit its decision trail + whole-graph before/after ledgers.
+
+    python tools/partition_report.py \\
+        -o docs/artifacts/partition_cost.json \\
+        --ledger-before docs/artifacts/mfu_resnet_sym_unfused.json \\
+        --ledger-after  docs/artifacts/mfu_resnet_sym_fused.json
+
+The bench graph is a symbol-level ResNet-style tower (stem + two
+residual blocks + an SE-style 1x1 conv head + FC classifier) with an
+INT8-quantized conv branch grafted on — one graph that exercises every
+rule of the "XLA" fleet AND contains a cluster the cost model must
+REJECT (the SE head convolves a (N, C, 1, 1) vector with a wide filter
+bank: folding BN into those weights costs more traffic per call than
+the normalize it removes).
+
+Three artifacts:
+
+- the **partition cost report** (``subgraph/cost.py`` format): one
+  ranked decision per candidate cluster, accepted or rejected, with
+  both currencies priced (render with ``mfu_report.py REPORT``);
+- **before/after cost-ledger documents** of the whole forward program
+  (``predictor.compile_symbol_forward`` lowering), where the fused
+  clusters' rows attribute to their rules — ``mfu_report.py --diff
+  before after`` is the fusion-PR review artifact
+  (docs/observability.md "Reading a fusion PR").
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def build_bench_graph():
+    """(symbol, shape hints, param-shape source symbols)."""
+    from mxnet_tpu import sym
+    from mxnet_tpu.contrib import quantization as Q
+
+    data = sym.var("data")
+
+    def conv_bn_relu(x, name, nf, kernel=(3, 3), pad=(1, 1), act=True):
+        c = sym.Convolution(x, name=f"{name}_conv", kernel=kernel,
+                            num_filter=nf, pad=pad)
+        b = sym.BatchNorm(c, name=f"{name}_bn", fix_gamma=False)
+        return sym.Activation(b, act_type="relu") if act else b
+
+    # stem + two residual blocks (the fused-conv bread and butter)
+    x = conv_bn_relu(data, "stem", 16)
+    for i in range(2):
+        y = conv_bn_relu(x, f"b{i}a", 16)
+        y = conv_bn_relu(y, f"b{i}b", 16, act=False)
+        x = sym.Activation(sym.elemwise_add(y, x), act_type="relu")
+    # SE-style head: global pool to (N, C, 1, 1), then a WIDE 1x1 conv
+    # + BN — weights dwarf the vector activation, the fold cannot pay:
+    # the cluster the cost gate must reject
+    pooled = sym.Pooling(x, global_pool=True, pool_type="avg",
+                         kernel=(1, 1))
+    se = sym.Convolution(pooled, name="se_conv", kernel=(1, 1),
+                         num_filter=512)
+    se = sym.BatchNorm(se, name="se_bn", fix_gamma=False)
+    se = sym.Activation(se, act_type="relu")
+    flat = sym.Flatten(se)
+    # FC epilogue rule target
+    fc1 = sym.FullyConnected(flat, name="fc1", num_hidden=64)
+    fc1 = sym.Activation(fc1, act_type="relu")
+    out = sym.FullyConnected(fc1, name="fc_out", num_hidden=10)
+
+    # INT8 branch: a quantized conv tower grafted onto the same data
+    # var (the serving native lowering's compute body)
+    qc = sym.Convolution(data, name="q0_conv", kernel=(3, 3),
+                         num_filter=16, pad=(1, 1))
+    qr = sym.Activation(qc, act_type="relu")
+    qsym, _calib = Q._quantize_symbol(qr)
+
+    net = sym.Group([out, qsym])
+    # fp32 twin of the whole graph (quantized branch pre-quantization)
+    # — the shape-inference source for parameter bindings
+    return net, (sym.Group([out, qr]),)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="partition_report",
+                                 description=__doc__.splitlines()[0])
+    ap.add_argument("-o", "--out",
+                    default=os.path.join(REPO, "docs", "artifacts",
+                                         "partition_cost.json"))
+    ap.add_argument("--ledger-before")
+    ap.add_argument("--ledger-after")
+    ap.add_argument("--data", default="8,3,32,32",
+                    help="data shape (default 8,3,32,32)")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    import mxnet_tpu as mx  # noqa: F401 — registers ops
+    from mxnet_tpu.predictor import compile_symbol_forward
+    from mxnet_tpu.profiling import ledger
+    from mxnet_tpu.subgraph.cost import partition_graph_costed
+
+    shape = tuple(int(x) for x in args.data.split(","))
+    net, fp32_twins = build_bench_graph()
+    # full var-shape hints from the fp32 twin: the quantized branch's
+    # weights hide behind quantize nodes, where back-inference can't
+    # reach them
+    shapes = {"data": shape}
+    for src in fp32_twins:
+        arg_shapes, _, aux_shapes = src.infer_shape(data=shape)
+        shapes.update({n: sh for n, sh in
+                       zip(src.list_arguments(), arg_shapes) if sh})
+        shapes.update({n: sh for n, sh in
+                       zip(src.list_auxiliary_states(), aux_shapes)
+                       if sh})
+    fused, report = partition_graph_costed(
+        net, "XLA", shapes=shapes, report_path=args.out)
+    print("wrote", args.out)
+    s = report["summary"]
+    print("clusters %d: %d accepted / %d rejected-cost / %d "
+          "rejected-structural" % (s["clusters"], s["accepted"],
+                                   s["rejected_cost"],
+                                   s["rejected_structural"]))
+    for rule, r in sorted(report["by_rule"].items()):
+        print("  %-30s accepted=%d rejected=%d est_saved=%.4fms"
+              % (rule, r["accepted"], r["rejected"],
+                 r["est_saved_s"] * 1e3))
+
+    if not (args.ledger_before or args.ledger_after):
+        return 0
+
+    # bindings: infer param shapes from the fp32 graphs (the quantized
+    # branch's weights hide behind quantize nodes)
+    rng = np.random.default_rng(0)
+    bindings = {}
+    for src in (net,) + tuple(fp32_twins):
+        try:
+            arg_shapes, _, aux_shapes = src.infer_shape(data=shape)
+        except Exception:  # noqa: BLE001 — quantized heads can't back-infer
+            continue
+        for n, sh in zip(src.list_arguments(), arg_shapes):
+            if n != "data" and sh is not None:
+                bindings.setdefault(
+                    n, rng.standard_normal(sh).astype("float32") * 0.1)
+        for n, sh in zip(src.list_auxiliary_states(), aux_shapes):
+            val = (rng.uniform(0.5, 1.5, sh).astype("float32")
+                   if n.endswith("var") else
+                   rng.standard_normal(sh).astype("float32") * 0.1)
+            bindings.setdefault(n, val)
+    data = rng.standard_normal(shape).astype("float32")
+
+    for path, graph in ((args.ledger_before, net),
+                        (args.ledger_after, fused)):
+        if not path:
+            continue
+        jitted, pvals = compile_symbol_forward(graph, bindings)
+        compiled = jitted.lower(pvals, {"data": data}).compile()
+        doc = ledger.from_compiled(compiled)
+        ledger.dump(doc, path)
+        est = ledger.mfu_estimate(doc)
+        print("wrote %s  (est %.4f ms, %.3f GFLOP, mfu@roofline %.4f)"
+              % (path, est["est_step_s"] * 1e3, est["gflops_total"],
+                 est["mfu_at_roofline"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

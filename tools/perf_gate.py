@@ -6,6 +6,7 @@
         --tol mfu_bf16=0.1 --tol resnet50_inference_int8_bs128=0.3
     python tools/perf_gate.py io_bench.json --io
     python tools/perf_gate.py serving_bench.json --serving
+    python tools/perf_gate.py kernel_bench.json --kernels
 
 ``--io`` gates a tools/io_bench.py version-2 artifact instead: every
 stage's img/s must stay within tolerance of the committed last-good
@@ -28,6 +29,17 @@ tokens/s floor vs last-good, inter-token p99 growth inverted, paged
 greedy == unpaged reference, the cache-occupancy histogram present —
 and an artifact that DROPS the stage while last-good carries it is
 itself a regression.
+
+``--kernels`` gates a tools/kernel_bench.py version-1 artifact
+against ``docs/artifacts/KERNELS_LAST_GOOD.json``: every kernel the
+last-good artifact carries must be present (a dropped kernel cannot
+silently leave the gate), every kernel must PIN its parity
+(``parity_ok`` true with the max-abs error recorded — the interpret-
+mode kernel vs its jnp oracle), the jitted-fallback timing must stay
+within tolerance of last-good, and where a compiled kernel timing
+exists the kernel/fallback speedup must hold ``--kernels-min-ratio``
+(a compiled kernel that LOSES to its fallback is a regression; a CPU
+artifact records ``null`` and the ratio gate notes it).
 
 Compares a bench artifact against the committed last-good measurement
 (``docs/artifacts/BENCH_LAST_GOOD.json`` unless ``--last-good``) with
@@ -67,6 +79,8 @@ DEFAULT_IO_LAST_GOOD = os.path.join(REPO, "docs", "artifacts",
                                     "IO_LAST_GOOD.json")
 DEFAULT_SERVING_LAST_GOOD = os.path.join(REPO, "docs", "artifacts",
                                          "SERVING_LAST_GOOD.json")
+DEFAULT_KERNELS_LAST_GOOD = os.path.join(REPO, "docs", "artifacts",
+                                         "KERNELS_LAST_GOOD.json")
 
 # metrics compared when both sides carry them; values are "bigger is
 # better" throughputs/ratios
@@ -463,6 +477,85 @@ def gate_generate(candidate, last_good, tolerance=0.25):
     return rc, msgs
 
 
+def gate_kernels(candidate, last_good, tolerance=0.25, min_ratio=1.0):
+    """(exit_code, [messages]) for a kernel_bench artifact pair.
+
+    Directions: parity is a truth contract (parity_ok must be true and
+    the error recorded — an artifact without it is signal-free);
+    fallback_ms GROWING beyond tolerance is the regression (it is a
+    latency, not a throughput); kernel_vs_fallback is an absolute
+    floor where a compiled timing exists; and a kernel present in
+    last-good but missing from the candidate is itself a regression
+    (the fleet cannot silently shrink out of its own gate)."""
+    msgs = []
+    rc = 0
+    if candidate.get("tool") != "kernel_bench" or \
+            candidate.get("version") != 1:
+        return 2, ["not a version-1 kernel_bench artifact"]
+    mine = candidate.get("kernels") or {}
+    good = last_good.get("kernels") or {}
+    if not mine:
+        return 3, ["kernel artifact carries no kernels "
+                   "(signal-free — rejected)"]
+    for name in sorted(good):
+        if name not in mine:
+            rc = 1
+            msgs.append("REGRESSION kernels[%s]: kernel dropped from "
+                        "the artifact (last good carries it)" % name)
+    for name in sorted(mine):
+        e = mine[name]
+        if not isinstance(e, dict):
+            rc = 1
+            msgs.append("REGRESSION kernels[%s]: malformed entry"
+                        % name)
+            continue
+        if not isinstance(e.get("parity_max_abs"), (int, float)) or \
+                e.get("parity_ok") is not True:
+            rc = 1
+            msgs.append("REGRESSION kernels[%s]: parity missing or "
+                        "failed (parity_ok=%s, max_abs=%s)"
+                        % (name, e.get("parity_ok"),
+                           e.get("parity_max_abs")))
+        else:
+            msgs.append("kernels[%s]: parity %.3g <= %.3g (ok)"
+                        % (name, e["parity_max_abs"],
+                           e.get("parity_tol", 0.0)))
+        fb, good_fb = e.get("fallback_ms"), (good.get(name)
+                                             or {}).get("fallback_ms")
+        if isinstance(fb, (int, float)) and \
+                isinstance(good_fb, (int, float)) and good_fb > 0:
+            if fb > (1.0 + tolerance) * good_fb:
+                rc = 1
+                msgs.append("REGRESSION kernels[%s]: fallback %.3fms "
+                            "> %.3fms (last good %.3fms, tolerance "
+                            "%.0f%%)" % (name, fb,
+                                         (1.0 + tolerance) * good_fb,
+                                         good_fb, tolerance * 100))
+            else:
+                msgs.append("kernels[%s]: fallback %.3fms vs %.3fms "
+                            "(ok)" % (name, fb, good_fb))
+        ratio = e.get("kernel_vs_fallback")
+        if isinstance(ratio, (int, float)):
+            if ratio < min_ratio:
+                rc = 1
+                msgs.append("REGRESSION kernels[%s]: kernel/fallback "
+                            "%.2fx < required %.1fx" % (name, ratio,
+                                                        min_ratio))
+            else:
+                msgs.append("kernels[%s]: kernel %.2fx fallback "
+                            "(>= %.1fx ok)" % (name, ratio, min_ratio))
+        elif isinstance((good.get(name) or {}).get(
+                "kernel_vs_fallback"), (int, float)):
+            msgs.append("kernels[%s]: no compiled timing in candidate "
+                        "(last good has %.2fx — re-measure on a chip "
+                        "window)" % (name, good[name]
+                                     ["kernel_vs_fallback"]))
+        else:
+            msgs.append("kernels[%s]: compiled timing pending a chip "
+                        "window (parity + fallback gated)" % name)
+    return rc, msgs
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="perf_gate",
                                  description=__doc__.splitlines()[0])
@@ -504,7 +597,37 @@ def main(argv=None):
                          "(1.05 = 5%% timer noise on fresh runs; the "
                          "committed artifact is pinned to 1.0 by the "
                          "tier-1 self-test)")
+    ap.add_argument("--kernels", action="store_true",
+                    help="gate a tools/kernel_bench.py v1 artifact "
+                         "(parity presence/truth + fallback timing "
+                         "+ kernel/fallback ratio floor)")
+    ap.add_argument("--kernels-min-ratio", type=float, default=1.0,
+                    help="required compiled-kernel / fallback speedup "
+                         "where a compiled timing exists (1.0 — a "
+                         "kernel must never LOSE to its fallback)")
     args = ap.parse_args(argv)
+    if args.kernels:
+        last_good_path = args.last_good
+        if last_good_path == DEFAULT_LAST_GOOD:
+            last_good_path = DEFAULT_KERNELS_LAST_GOOD
+        try:
+            with open(args.artifact, "r", encoding="utf-8") as f:
+                candidate = json.load(f)
+            with open(last_good_path, "r", encoding="utf-8") as f:
+                last_good = json.load(f)
+        except (OSError, ValueError) as e:
+            print("perf_gate: cannot read kernel artifact: %s" % e,
+                  file=sys.stderr)
+            return 2
+        rc, msgs = gate_kernels(candidate, last_good,
+                                tolerance=args.tolerance,
+                                min_ratio=args.kernels_min_ratio)
+        for m in msgs:
+            print(m)
+        print("perf_gate: %s"
+              % {0: "PASS", 1: "REGRESSION", 2: "UNREADABLE",
+                 3: "BARE-ZERO"}.get(rc, rc))
+        return rc
     if args.serving:
         last_good_path = args.last_good
         if last_good_path == DEFAULT_LAST_GOOD:
