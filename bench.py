@@ -143,6 +143,39 @@ def _serving_summary():
     return out
 
 
+# params fingerprint of the most recently trained stage (set by
+# _bench_train; the health embed carries it so perf_gate --health can
+# pin "training ran and produced these exact bits")
+_TRAIN_FINGERPRINT = [None]
+
+
+def _health_summary():
+    """Bounded model-health embed for artifacts (success AND failure):
+    sentry verdict, loss EWMA, anomaly count, params fingerprint.
+    Child side only; folds the pending sentry/loss state (read time —
+    the run is over)."""
+    from mxnet_tpu.profiling import health as _health
+    doc = _health.flush()
+    loss = doc.get("loss", {})
+    out = {
+        "verdict": doc["sentry"]["verdict"],
+        "nonfinite_total": doc["sentry"]["nonfinite_total"],
+        "first_trip": doc["sentry"].get("first_trip"),
+        "steps": doc.get("steps", 0),
+        "loss_ewma": loss.get("ewma"),
+        "loss_last": loss.get("last"),
+        "loss_anomalies": loss.get("anomalies_total", 0),
+        "fingerprint": _TRAIN_FINGERPRINT[0],
+    }
+    gn = doc.get("norms", {}).get("grad_norm")
+    if gn is not None:
+        out["grad_norm"] = gn
+    # artifacts must stay strict JSON: a poisoned run's NaN EWMA lands
+    # as the string "nan" (perf_gate --health flags it either way)
+    from mxnet_tpu.profiling.health import _json_sanitize
+    return _json_sanitize(out)
+
+
 def _memory_summary(_memory):
     """Bounded live-memory summary for artifacts: census role totals
     (MB) + per-device allocator/census footprints. Child side only."""
@@ -469,6 +502,12 @@ def _fail_json(err, diag=None):
     }
     if ledger is not None:
         doc["cost_ledger"] = ledger
+    try:
+        # the health verdict rides failures too: "did the model NaN
+        # before the wedge" answers itself from the artifact
+        doc["health"] = _health_summary()
+    except Exception:  # noqa: BLE001 — diagnostics never block a report
+        pass
     line = json.dumps(doc)
     if len(line) > 16384:   # a metric line, not a log dump
         fallback = {
@@ -1405,6 +1444,13 @@ def main():
         result["memory"] = _memory_summary(_memory_mod)
     except Exception:  # noqa: BLE001 — diagnostics never block a result
         pass
+    try:
+        # model-health embed (sentry verdict + loss EWMA + params
+        # fingerprint) next to the ledger/census embeds; gated by
+        # perf_gate --health against last-good
+        result["health"] = _health_summary()
+    except Exception:  # noqa: BLE001 — diagnostics never block a result
+        pass
     serving = _serving_summary()
     if serving is not None:
         # bounded serving headline (last-good copy, provenance marked)
@@ -1501,6 +1547,8 @@ def _bench_train(host_data, sync, iters=20, layout="NCHW",
     import jax.numpy as jnp
     import numpy as np
 
+    from mxnet_tpu.profiling import health as _health
+
     step, params, moms = build_train(BATCH, layout=layout, stem=stem)
     rng = np.random.default_rng(1)
     labels = jnp.asarray(rng.integers(0, 1000, BATCH).astype(np.int32))
@@ -1517,10 +1565,20 @@ def _bench_train(host_data, sync, iters=20, layout="NCHW",
         t0 = time.perf_counter()
         for _ in range(iters):
             params, moms, loss = step(params, moms, data, labels)
+            # sentry + loss feed per step (lazy; folded at boundary)
+            _health.check_scalar("bench_train", loss)
+            _health.observe_loss(loss)
+            _health.step_boundary("bench_train")
         sync(loss)
         dt = time.perf_counter() - t0
         best = dt if best is None else min(best, dt)
         _hb("train: trial %.2fs" % dt)
+    # end-of-stage health evidence: params swept once by the sentry,
+    # and the drift fingerprint of the trained weights pinned for the
+    # artifact's health embed (perf_gate --health asserts both)
+    _health.check("bench_train_params", params)
+    _TRAIN_FINGERPRINT[0] = _health.fingerprint_params(
+        {"p%d" % i: v for i, v in enumerate(params)})
     return BATCH * iters / best
 
 

@@ -31,6 +31,10 @@ _SCOPES = (
      {"push", "pull", "row_sparse_pull", "pushpull",
       "_push_impl", "_pull_impl"}, set()),
     ("mxnet_tpu/metric.py", {"update"}, {"_as_np"}),
+    # the Monitor tap runs inside every monitored executor forward —
+    # a sync in stat_helper would stall each tapped tensor; toc() is
+    # the sanctioned read point and stays off this list
+    ("mxnet_tpu/monitor.py", {"stat_helper", "tic", "install"}, set()),
     # the input pipeline's per-batch paths: parent-side ring pulls and
     # the device feeder run once per training batch — a sync here
     # serializes host decode against device compute, the exact overlap
@@ -59,11 +63,20 @@ _SCOPES = (
     # these methods). The PR 7 memory recorders join the list: role
     # tagging runs inside optimizer updates and io __next__, and the
     # census reads shard METADATA only — an asnumpy in either would
-    # stall every tagged hot path at once
+    # stall every tagged hot path at once. The model-health sentry's
+    # recording methods (check / observe_loss / norm add+commit /
+    # step_boundary) run inside executor forward/backward, Trainer
+    # _update and the sharded step — they dispatch lazy reduces ONLY;
+    # folding reads long-retired buffers, and the sanctioned read
+    # points (flush, snapshot_doc, nan_postmortem, the first-NaN
+    # localizer) stay off this list by design
     ("mxnet_tpu/profiling/",
      {"build_ledger", "instr_cost", "measure_ops", "join",
       "summarize", "mfu_estimate", "attribute_op_name",
       "group_by_op", "tag_role", "tag_tree", "role_of",
+      "check", "check_scalar", "observe_loss", "_nonfinite_count",
+      "_accumulate", "add", "commit", "step_probe", "step_boundary",
+      "_fold_entries", "_fold_loss", "_trip",
       "live_census", "buffer_intervals", "build_memory_ledger",
       "group_buffers_by_op", "_sweep_peak"}, set()),
     # the cost-tracked partitioner runs at TRACE/bind time: selector

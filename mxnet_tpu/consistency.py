@@ -254,6 +254,7 @@ def _run_one(name, fn, builder, tol, dtype, seed=0):
         out = fn(_nd, *arrs)
         outs.append(np.asarray(out.astype("float32").asnumpy()))
     np.testing.assert_allclose(outs[0], outs[1], **base)
+    return outs
 
 
 def _is_index_input(x):
@@ -264,25 +265,42 @@ def _is_index_input(x):
 
 def run_sweep(dtype="float32", ops=None, seed=0):
     """Run the table on cpu-vs-accelerator contexts; returns a summary
-    dict {"total", "pass", "fail", "failures": [(name, err), ...]}.
+    dict {"total", "pass", "fail", "failures": [(name, err), ...],
+    "rows": [{"name", "ok", "fingerprint"}, ...]}.
+
+    Each passing row is stamped with the CPU-side output's drift
+    fingerprint (``profiling.health.fingerprint_params``) — one
+    vocabulary with the bit-identical-resume tests and the chaos
+    suite's bounded-drift checks, so two chip windows (or two
+    backends) can diff per-op numerics without re-running the peer.
 
     On a CPU-only host both contexts resolve to the same device and the
     sweep degenerates to a harness self-test (exactly how the reference's
     gpu suite behaves when run on a CPU-only build)."""
+    from .profiling.health import fingerprint_params
+
     table = OP_TABLE if ops is None else [
         row for row in OP_TABLE if row[0] in ops]
     failures = []
+    rows = []
     for name, fn, builder, tol in table:
+        row = {"name": name, "ok": True, "fingerprint": None}
         try:
-            _run_one(name, fn, builder, tol, dtype, seed=seed)
+            outs = _run_one(name, fn, builder, tol, dtype, seed=seed)
+            # fingerprint the REFERENCE (cpu-context) output: the
+            # stable side a later chip row is compared against
+            row["fingerprint"] = fingerprint_params({"out": outs[0]})
         except Exception as e:  # noqa: BLE001 — tally, don't abort sweep
+            row["ok"] = False
             failures.append((name, str(e).splitlines()[0][:160]
                              if str(e) else repr(e)))
+        rows.append(row)
     return {
         "total": len(table),
         "pass": len(table) - len(failures),
         "fail": len(failures),
         "failures": failures,
+        "rows": rows,
     }
 
 

@@ -273,6 +273,17 @@ class Executor:
         for n, v in aux_updates.items():
             self.aux_dict[n]._data = v
         self.outputs = [NDArray(o) for o in outs]
+        from .profiling import health as _health
+        if _health.enabled():
+            # sync-free nonfinite sentry: one lazy device reduce over
+            # the outputs, folded at the step boundary. The localizer
+            # closure replays this exact (args, key) through the
+            # per-op monitor pass only if the fold trips.
+            _health.check(
+                "executor_forward", outs,
+                localize=lambda: _health.localize_first_nonfinite(
+                    self, arg_vals, aux_vals, key,
+                    training=bool(is_train)))
         if self._monitor is not None and self._monitor_active():
             # tap every op's outputs, as the reference's
             # ExecuteMonCallback does (graph_executor.cc:1294) — a
@@ -393,6 +404,16 @@ class Executor:
                 g._data = grads[n]
             # fresh jax arrays per backward: re-stamp the census role
             _mem.tag_role(g, "gradient")
+        from .profiling import health as _health
+        if _health.enabled():
+            # backward sentry: a NaN born in the vjp (not visible in
+            # any forward internal) still trips here; the forward
+            # replay then reports first_op=None and the postmortem
+            # names the seam
+            _health.check(
+                "executor_backward", grads,
+                localize=lambda: _health.localize_first_nonfinite(
+                    self, arg_vals, aux_vals, key, training=True))
 
     @property
     def grad_arrays(self):

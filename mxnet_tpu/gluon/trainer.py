@@ -92,7 +92,8 @@ class Trainer:
         # root span per optimizer step: the comm/compute children under
         # it are what trace_merge's straggler report groups by step
         n = self._step_count = getattr(self, "_step_count", -1) + 1
-        with _tracing.span("trainer_step", cat="step", step=n):
+        from ..profiling import health as _health
+        with _tracing.span("trainer_step", cat="step", step=n) as sp:
             try:
                 if not self._kv_initialized:
                     self._init_kvstore()
@@ -106,6 +107,11 @@ class Trainer:
                 from ..profiling import memory as _mem
                 _mem.maybe_oom_postmortem(e, source="trainer_step")
                 raise
+            # health boundary INSIDE the step span: lagged loss-EWMA /
+            # grad-norm / nonfinite attrs land on the span so
+            # trace_merge can name the rank that went unhealthy; a
+            # MXTPU_HEALTH=raise trip surfaces here, at the boundary
+            _health.step_boundary("trainer", span=sp)
         # one boundary per optimizer step: charges the data/comm/compile
         # time accumulated since the previous step to this one
         # (telemetry/step.py; wall-clock only, no host sync). Manual
@@ -146,19 +152,41 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
-        for i, p in enumerate(self._params):
-            if p.grad_req == "null":
-                continue
-            if p._data is None:
-                if not ignore_stale_grad:
-                    raise MXNetError(
-                        f"parameter {p.name} not initialized before step()")
-                continue
-            if self._kvstore is not None and self._update_on_kvstore:
-                self._kvstore.push(i, p.grad())
-                self._kvstore.pull(i, p.data())
-            else:
-                self._updaters(i, p.grad(), p.data())
+        from ..profiling import health as _health
+        # one probe per step: the post-allreduce gradients, updated
+        # weights and (for update-to-weight ratios) the pre-update
+        # weights — updates are functional, so the old array stays
+        # reachable with no copy. commit() is ONE cached jitted
+        # dispatch covering the sentry counts AND the norm telemetry;
+        # the per-call Updater check is suppressed underneath it.
+        probe = _health.step_probe()
+        with _health.updater_covered():
+            for i, p in enumerate(self._params):
+                if p.grad_req == "null":
+                    continue
+                if p._data is None:
+                    if not ignore_stale_grad:
+                        raise MXNetError(
+                            f"parameter {p.name} not initialized "
+                            "before step()")
+                    continue
+                # pre-update weights only when the probe computes
+                # update ratios: with MXTPU_HEALTH_NORMS=0 holding
+                # them would pin a superseded copy of every weight
+                # through the loop for nothing
+                old = p.data()._data if probe is not None \
+                    and probe.wants_norms else None
+                if self._kvstore is not None and \
+                        self._update_on_kvstore:
+                    self._kvstore.push(i, p.grad())
+                    self._kvstore.pull(i, p.data())
+                else:
+                    self._updaters(i, p.grad(), p.data())
+                if probe is not None:
+                    probe.add(p.name, p.data(), p.grad(),
+                              weight_before=old)
+        if probe is not None:
+            probe.commit()
 
     def save_states(self, fname):
         """Optimizer state checkpoint (ref: trainer.py save_states). When the

@@ -261,6 +261,25 @@ _ENV_VARS = {
         "backends only; the jnp path is the CPU hot path and the "
         "kernel's numerics oracle; ops/optimizer_ops.py, "
         "ops/pallas_kernels.py)"),
+    "MXTPU_HEALTH": (
+        "model-health plane gate/policy: 0 = every hook a no-op, "
+        "1/warn (default) = sentry + telemetry + postmortem then "
+        "continue, raise = a nonfinite fold raises NonfiniteError at "
+        "the step boundary (profiling/health.py, "
+        "docs/observability.md)"),
+    "MXTPU_HEALTH_DUMP_PATH": (
+        "first-NaN postmortem destination — a sentry trip writes the "
+        "offending-op localization + ranked grad norms + loss state "
+        "+ RNG + flight dump here (default nan_postmortem.json; "
+        "profiling/health.py)"),
+    "MXTPU_HEALTH_NORMS": (
+        "0 drops the norm half of the per-step probe program "
+        "(per-group weight/grad norms + update-to-weight ratios and "
+        "the pre-update weight capture); the nonfinite sentry stays "
+        "on (default on; profiling/health.py, gluon/trainer.py)"),
+    "MXTPU_HEALTH_ANOMALY_Z": (
+        "z-score threshold for the loss-spike anomaly detector over "
+        "the folded loss EWMA (default 6; profiling/health.py)"),
     "MXTPU_KERNEL_INT8_EPILOGUE": (
         "0 routes the fused INT8 conv epilogue (_sg_xla_quant_conv) "
         "through plain ops/quantized.py requantize+act instead of "

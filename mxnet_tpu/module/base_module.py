@@ -215,55 +215,84 @@ class BaseModule:
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
-        for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
-            eval_metric.reset()
-            nbatch = 0
-            # close any stale step interval: without this, validation /
-            # checkpointing / inter-fit wall-clock (and its data-wait)
-            # from the previous epoch or a previous fit() would be
-            # charged to this epoch's first step
-            _tm_step.reset()
-            train_data.reset()
-            for data_batch in train_data:
-                if monitor is not None:
-                    monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                # per-step telemetry boundary (telemetry/step.py):
-                # data_time accrued in DataIter.__next__, comm_time in
-                # any kvstore traffic, compile_time from the jax
-                # listener — all charged to the step that just finished
-                _tm_step.step_boundary("module_fit")
-                self.update_metric(eval_metric, data_batch.label)
-                if monitor is not None:
-                    monitor.toc_print()
-                if batch_end_callback is not None:
-                    params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                           eval_metric=eval_metric,
-                                           locals=locals())
-                    for cb in _as_list(batch_end_callback):
-                        cb(params)
-                nbatch += 1
+        # a NaN postmortem fired anywhere inside this fit should carry
+        # the batch position (the same iterator state a
+        # CheckpointManager.save would capture) — registered for the
+        # duration of the loop, unhooked on the way out. The epoch
+        # loop stays INLINE in fit(): BatchEndParam(locals=locals())
+        # must keep exposing fit's full argument scope to callbacks
+        # (the reference contract).
+        from ..profiling import health as _health
+        registered_iter_ctx = hasattr(train_data, "state_dict")
+        prev_iter_ctx = None
+        if registered_iter_ctx:
+            # save any caller-installed provider so it can be put
+            # back on the way out — fit's registration is scoped to
+            # the loop, not a permanent takeover
+            prev_iter_ctx = _health._context_providers.get("iter_state")
+            _health.register_postmortem_context(
+                "iter_state", train_data.state_dict)
+        try:
+            for epoch in range(begin_epoch, num_epoch):
+                tic = time.time()
+                eval_metric.reset()
+                nbatch = 0
+                # close any stale step interval: without this, validation /
+                # checkpointing / inter-fit wall-clock (and its data-wait)
+                # from the previous epoch or a previous fit() would be
+                # charged to this epoch's first step
+                _tm_step.reset()
+                train_data.reset()
+                for data_batch in train_data:
+                    if monitor is not None:
+                        monitor.tic()
+                    self.forward_backward(data_batch)
+                    self.update()
+                    # per-step telemetry boundary (telemetry/step.py):
+                    # data_time accrued in DataIter.__next__, comm_time in
+                    # any kvstore traffic, compile_time from the jax
+                    # listener — all charged to the step that just finished
+                    _tm_step.step_boundary("module_fit")
+                    # health boundary: fold the executor/updater sentry
+                    # buckets this step dispatched (profiling/health.py)
+                    _health.step_boundary("module_fit")
+                    self.update_metric(eval_metric, data_batch.label)
+                    if monitor is not None:
+                        monitor.toc_print()
+                    if batch_end_callback is not None:
+                        params = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                               eval_metric=eval_metric,
+                                               locals=locals())
+                        for cb in _as_list(batch_end_callback):
+                            cb(params)
+                    nbatch += 1
 
-            for name, val in eval_metric.get_name_value():
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
-                             time.time() - tic)
+                for name, val in eval_metric.get_name_value():
+                    self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+                self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                                 time.time() - tic)
 
-            arg_p, aux_p = self.get_params()
-            if epoch_end_callback is not None:
-                for cb in _as_list(epoch_end_callback):
-                    cb(epoch, self.symbol, arg_p, aux_p)
+                arg_p, aux_p = self.get_params()
+                if epoch_end_callback is not None:
+                    for cb in _as_list(epoch_end_callback):
+                        cb(epoch, self.symbol, arg_p, aux_p)
 
-            if eval_data is not None:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f",
-                                     epoch, name, val)
+                if eval_data is not None:
+                    res = self.score(eval_data, validation_metric,
+                                     score_end_callback=eval_end_callback,
+                                     batch_end_callback=eval_batch_end_callback,
+                                     epoch=epoch)
+                    for name, val in res:
+                        self.logger.info("Epoch[%d] Validation-%s=%f",
+                                         epoch, name, val)
+
+        finally:
+            if registered_iter_ctx:
+                # restore whatever was there before this fit (None
+                # unregisters): a caller-installed iter_state
+                # provider survives
+                _health.register_postmortem_context(
+                    "iter_state", prev_iter_ctx)
 
     def install_monitor(self, mon):
         raise NotImplementedError

@@ -5,6 +5,17 @@ Taps every operator output through the executor's monitor callback
 (Executor.forward runs a second jitted pass returning all internals —
 the reference's ExecuteMonCallback, graph_executor.cc:1294) and
 aggregates a statistic per tensor every ``interval`` batches.
+
+TPU-native change (the metric.py MXL002 pattern): ``stat_helper`` and
+the default ``stat_func`` never touch the host. The statistic is a
+lazily-dispatched device scalar queued as-is; the ONE host transfer
+happens at ``toc()`` — a single batched ``jax.device_get`` over the
+whole interval's queue, not one ``asnumpy()`` per tensor. The
+reference's default stat (``|x|.mean()``) synced per tensor per
+interval; here an armed Monitor adds zero syncs to ``Trainer.step`` /
+``Executor.forward`` (regression-tested in tests/test_health.py), and
+the same property carries to the INT8 calibration collector built on
+this tap.
 """
 from __future__ import annotations
 
@@ -37,6 +48,8 @@ class Monitor:
         exe.set_monitor_callback(self.stat_helper, monitor_all)
 
     def stat_helper(self, name, arr):
+        """Per-tensor tap: dispatch the statistic, queue the (lazy)
+        device scalar. Hot path — never reads the value (MXL002)."""
         if not self.activated or not self.re_prog.match(name):
             return
         arr = arr if isinstance(arr, NDArray) else NDArray(arr)
@@ -50,17 +63,29 @@ class Monitor:
         self.step += 1
 
     def toc(self):
-        """Finish the batch; returns [(step, tensor_name, stat_str)]."""
+        """Finish the batch; returns [(step, tensor_name, stat_str)].
+
+        THE read point: the whole interval's queued device scalars
+        fold in one batched transfer (they were dispatched during
+        forward, so the buffers are ready — this is a fetch, not a
+        stall)."""
         if not self.activated:
             return []
         self.activated = False
-        res = []
         if self.sort:
             self.queue.sort(key=lambda x: x[1])
-        for step, name, stat in self.queue:
-            arr = stat if isinstance(stat, NDArray) else NDArray(stat)
-            res.append((step, name, str(arr.asnumpy().ravel())))
-        self.queue = []
+        queue, self.queue = self.queue, []
+        raw = [s._data if isinstance(s, NDArray) else s
+               for _step, _name, s in queue]
+        if raw:
+            import jax
+            host = jax.device_get(raw)   # ONE fold for the interval
+        else:
+            host = []
+        res = []
+        for (step, name, _s), val in zip(queue, host):
+            import numpy as np
+            res.append((step, name, str(np.asarray(val).ravel())))
         return res
 
     def toc_print(self):

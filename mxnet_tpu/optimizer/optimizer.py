@@ -617,6 +617,7 @@ class Updater:
         self.states = {}
 
     def __call__(self, index, grad, weight):
+        from ..profiling import health as _health
         from ..profiling import memory as _mem
         if index not in self.states:
             self.states[index] = \
@@ -624,6 +625,14 @@ class Updater:
                                                             weight)
         self.optimizer.update_multi_precision(index, weight, grad,
                                               self.states[index])
+        if _health.enabled() and not _health.updater_is_covered():
+            # optimizer in/out sentry: the incoming gradient and the
+            # updated weight in ONE lazy reduce per call — kvstore
+            # servers and Module.update get the same coverage as a
+            # local Trainer (whose StepProbe covers its whole loop in
+            # one program and suppresses this per-call check)
+            name = self.optimizer.idx2name.get(index, str(index))
+            _health.check("optimizer/%s" % name, [grad, weight])
         if _mem.census_enabled():
             # updates are functional (fresh jax arrays land in the
             # NDArray wrappers), so the census roles are re-stamped

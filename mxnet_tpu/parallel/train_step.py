@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..profiling import health as _health
 from ..profiling import memory as _mem
 
 
@@ -121,6 +122,13 @@ def make_sharded_train_step(loss_fn, mesh, param_example, batch_example,
             # their census roles (host-side weakref writes only)
             _mem.tag_tree(out[0], "parameter")
             _mem.tag_tree(out[1], "optimizer_state")
+        if _health.enabled():
+            # sharded-step sentry + loss feed: the loss scalar is
+            # already dp-reduced, so one lazy isfinite reduce covers
+            # every replica; folded at the health boundary below
+            _health.check_scalar("sharded_train_step", out[2])
+            _health.observe_loss(out[2])
+            _health.step_boundary("sharded_train_step")
         return out
 
     # keep the jitted callable reachable for tests/tools that lower

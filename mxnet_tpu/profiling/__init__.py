@@ -20,16 +20,22 @@ reality.
   the OOM postmortem artifact,
 - :mod:`~mxnet_tpu.profiling.bench_ledger` — the ``python -m``
   subprocess ``bench.py`` uses to compute a CPU cost-model ledger even
-  when the TPU tunnel is wedged.
+  when the TPU tunnel is wedged,
+- :mod:`~mxnet_tpu.profiling.health` — the numerics axis: sync-free
+  nonfinite sentry at the framework seams, gradient/update-ratio
+  telemetry, loss-anomaly detection, the first-NaN postmortem, and
+  drift fingerprints.
 
-CLI: ``tools/mfu_report.py`` (table / --diff / --capture / --chrome)
-and ``tools/memory_report.py`` (table / --diff / --capture / --hlo).
+CLI: ``tools/mfu_report.py`` (table / --diff / --capture / --chrome),
+``tools/memory_report.py`` (table / --diff / --capture / --hlo) and
+``tools/health_report.py`` (table / --diff / --postmortem).
 Env: ``MXTPU_PROFILE_ATTRIB``, ``MXTPU_PROFILE_DIR``,
 ``MXTPU_PEAK_HBM_GBS``, ``MXTPU_MEMORY_CENSUS``,
-``MXTPU_OOM_DUMP_PATH`` (+ the existing ``MXTPU_PEAK_TFLOPS``) —
-registered in ``libinfo._ENV_VARS``, documented in
-``docs/observability.md`` ("MFU accounting & roofline", "Memory
-accounting").
+``MXTPU_OOM_DUMP_PATH``, ``MXTPU_HEALTH``, ``MXTPU_HEALTH_DUMP_PATH``,
+``MXTPU_HEALTH_NORMS``, ``MXTPU_HEALTH_ANOMALY_Z`` (+ the existing
+``MXTPU_PEAK_TFLOPS``) — registered in ``libinfo._ENV_VARS``,
+documented in ``docs/observability.md`` ("MFU accounting & roofline",
+"Memory accounting", "Model health").
 """
 from __future__ import annotations
 
@@ -38,13 +44,18 @@ from . import ledger
 from . import xplane
 from . import capture
 from . import memory
+from . import health
 from .capture import analyze_dir, attribution_run
 from .ledger import build_ledger, from_compiled, from_fn, mfu_estimate
 from .memory import (build_memory_ledger, live_census, tag_role,
                      tag_tree, maybe_oom_postmortem, oom_postmortem)
+from .health import (fingerprint_params, nan_postmortem,
+                     localize_first_nonfinite, NonfiniteError)
 
-__all__ = ["hlo", "ledger", "xplane", "capture", "memory",
+__all__ = ["hlo", "ledger", "xplane", "capture", "memory", "health",
            "build_ledger", "from_compiled", "from_fn", "mfu_estimate",
            "analyze_dir", "attribution_run", "build_memory_ledger",
            "live_census", "tag_role", "tag_tree",
-           "maybe_oom_postmortem", "oom_postmortem"]
+           "maybe_oom_postmortem", "oom_postmortem",
+           "fingerprint_params", "nan_postmortem",
+           "localize_first_nonfinite", "NonfiniteError"]

@@ -105,8 +105,21 @@ def dump(path, fmt="json", snap=None):
     return snap
 
 
+def _json_safe(v):
+    """Replace nonfinite floats with their repr so json.dumps emits
+    valid JSON ("nan"/"inf" strings) instead of bare literals."""
+    if isinstance(v, float) and (
+            v != v or v in (float("inf"), float("-inf"))):
+        return repr(v)
+    if isinstance(v, dict):
+        return {k: _json_safe(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    return v
+
+
 def merge_chrome_trace(snap=None, events=None, spans=None,
-                       attribution=None, memory=None):
+                       attribution=None, memory=None, health=None):
     """One chrome://tracing document carrying every observability
     layer: the profiler's trace events, the tracing spans (causal
     layer, PR 5), the metric snapshot — counters/gauges as 'C'
@@ -115,10 +128,13 @@ def merge_chrome_trace(snap=None, events=None, spans=None,
     document (PR 6), its ranked per-op rows as a flame strip on a
     dedicated pid plus the raw document under metadata. ``memory``
     (PR 7) takes a live-array census document — or ``True`` to take
-    one now — rendered as per-role/per-device counter tracks. All
-    layers share tracing.clock's process epoch, so they land on one
-    Perfetto time axis. ``spans`` defaults to the process's recorded
-    spans; pass [] to omit them."""
+    one now — rendered as per-role/per-device counter tracks.
+    ``health`` takes a model-health summary (``profiling.health
+    .snapshot_doc``) — or ``True`` to fold one now — rendered as
+    loss/grad-norm/nonfinite counter tracks beside the memory track.
+    All layers share tracing.clock's process epoch, so they land on
+    one Perfetto time axis. ``spans`` defaults to the process's
+    recorded spans; pass [] to omit them."""
     snap = snap if snap is not None else snapshot()
     from .. import profiler
     from .. import tracing as _tracing
@@ -134,9 +150,15 @@ def merge_chrome_trace(snap=None, events=None, spans=None,
         if fam["type"] == "histogram":
             continue
         for s in fam["series"]:
+            v = s["value"]
+            if v != v or v in (float("inf"), float("-inf")):
+                # a NaN gauge (e.g. mx_health_loss on a poisoned run)
+                # would serialize as a bare NaN literal and make
+                # Perfetto reject the whole trace
+                continue
             ev_name = name + _prom_labels(s.get("labels", {}))
             merged.append({"name": ev_name, "ph": "C", "ts": ts,
-                           "pid": 0, "args": {name: s["value"]}})
+                           "pid": 0, "args": {name: v}})
     metadata = {"telemetry": snap}
     if attribution is not None:
         merged.extend(_tracing.export.attribution_events(attribution))
@@ -155,14 +177,31 @@ def merge_chrome_trace(snap=None, events=None, spans=None,
             k: memory.get(k)
             for k in ("kind", "total_bytes", "arrays", "by_role",
                       "by_device") if k in memory}
-    return {"traceEvents": merged, "displayTimeUnit": "ms",
-            "metadata": metadata}
+    if health is not None:
+        if health is True:
+            from ..profiling import health as _health
+            health = _health.snapshot_doc()
+        merged.extend(_tracing.export.health_counter_events(
+            health, ts=ts))
+        metadata["health"] = {
+            k: health.get(k)
+            for k in ("kind", "sentry", "loss", "norms")
+            if k in health}
+    # nonfinite floats ANYWHERE in the document (a NaN loss gauge or a
+    # NaN span attr IS the unhealthy run's payload) would serialize as
+    # bare NaN/Infinity literals and make Perfetto reject the whole
+    # trace — stringify them in place. One pass over the merged events
+    # at export time; the sources also guard (health span attrs,
+    # health_counter_events) so the sweep is the backstop.
+    return {"traceEvents": _json_safe(merged),
+            "displayTimeUnit": "ms",
+            "metadata": _json_safe(metadata)}
 
 
 def dump_chrome_trace(path, snap=None, events=None, attribution=None,
-                      memory=None):
+                      memory=None, health=None):
     trace = merge_chrome_trace(snap, events, attribution=attribution,
-                               memory=memory)
+                               memory=memory, health=health)
     _atomic_text(path, json.dumps(trace))
     return trace
 

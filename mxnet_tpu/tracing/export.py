@@ -177,6 +177,49 @@ def memory_counter_events(census_doc, pid=91, ts=0.0):
     return events
 
 
+def health_counter_events(health_doc, pid=92, ts=0.0):
+    """A model-health summary (``profiling.health.snapshot_doc``
+    document) rendered as Perfetto counter tracks beside the PR 7
+    memory track: loss + loss EWMA, global grad norm, and cumulative
+    nonfinite count (stacked by seam) on the shared clock."""
+    def _finite(v):
+        # a NaN/Inf loss is exactly what an unhealthy run carries, and
+        # json.dumps would emit bare NaN/Infinity literals that make
+        # Perfetto reject the whole trace — drop the sample, keep the
+        # nonfinite-count track as the signal. (Local copy by design:
+        # tracing/ must import standalone, without telemetry; the
+        # sibling guards live in telemetry/export._json_safe and
+        # tools/perf_gate._is_finite_number.)
+        return isinstance(v, (int, float)) and not isinstance(v, bool) \
+            and v == v and v not in (float("inf"), float("-inf"))
+
+    events = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+               "args": {"name": "model health (sentry/loss/norms)"}}]
+    loss = health_doc.get("loss", {})
+    args = {}
+    if _finite(loss.get("last")):
+        args["loss"] = loss["last"]
+    if _finite(loss.get("ewma")):
+        args["ewma"] = loss["ewma"]
+    if args:
+        events.append({"name": "mx_health_loss", "ph": "C", "ts": ts,
+                       "pid": pid, "args": args})
+    norms = health_doc.get("norms", {})
+    if _finite(norms.get("grad_norm")):
+        events.append({"name": "mx_health_grad_norm", "ph": "C",
+                       "ts": ts, "pid": pid,
+                       "args": {"l2": norms["grad_norm"]}})
+    sentry = health_doc.get("sentry", {})
+    by_source = sentry.get("by_source") or {}
+    events.append({
+        "name": "mx_health_nonfinite_total", "ph": "C", "ts": ts,
+        "pid": pid,
+        "args": ({src: n for src, n in sorted(by_source.items())}
+                 if by_source
+                 else {"total": sentry.get("nonfinite_total", 0)})})
+    return events
+
+
 def chrome_events(spans, pid=0, offset_ns=0, base_ns=None):
     """Span dicts -> chrome-trace 'X' events. ``offset_ns`` is added to
     every timestamp (clock alignment); ``base_ns`` is the zero point

@@ -40,3 +40,20 @@ def test_sweep_reports_failures():
 
 def test_model_forward_consistency():
     assert model_forward_consistency()
+
+
+def test_sweep_rows_stamped_with_drift_fingerprints():
+    """Every op row carries the CPU-reference output's drift
+    fingerprint (profiling.health vocabulary) — the table a chip
+    window diffs against without re-running the CPU side."""
+    res = run_sweep("float32")
+    assert len(res["rows"]) == len(OP_TABLE)
+    assert [r["name"] for r in res["rows"]] == \
+        [row[0] for row in OP_TABLE]
+    for r in res["rows"]:
+        assert r["ok"], r
+        assert isinstance(r["fingerprint"], str) and \
+            len(r["fingerprint"]) == 32, r
+    # distinct ops fingerprint distinctly (the digest carries signal)
+    fps = [r["fingerprint"] for r in res["rows"]]
+    assert len(set(fps)) == len(fps)

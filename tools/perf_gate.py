@@ -30,6 +30,17 @@ greedy == unpaged reference, the cache-occupancy histogram present —
 and an artifact that DROPS the stage while last-good carries it is
 itself a regression.
 
+``--health`` adds the model-health section to the default bench
+gate: the ``health`` embed (profiling/health.py — sentry verdict,
+loss EWMA, params drift fingerprint) must be present whenever the
+last-good artifact carries one, any run that trained must be
+nonfinite-free with its fingerprint pinned, and a disabled sentry is
+itself a regression (an ungated artifact cannot claim clean
+numerics). The committed health-bearing artifact lives at
+``docs/artifacts/HEALTH_LAST_GOOD.json`` and the example first-NaN
+postmortem at ``docs/artifacts/NAN_POSTMORTEM_EXAMPLE.json``
+(tier-1 self-tested in tests/test_health.py).
+
 ``--kernels`` gates a tools/kernel_bench.py version-1 artifact
 against ``docs/artifacts/KERNELS_LAST_GOOD.json``: every kernel the
 last-good artifact carries must be present (a dropped kernel cannot
@@ -154,8 +165,78 @@ def gate_memory(candidate, last_good, mem_tolerance=0.15):
     return rc, msgs
 
 
+def _is_finite_number(v):
+    return isinstance(v, (int, float)) and not isinstance(v, bool) \
+        and v == v and v not in (float("inf"), float("-inf"))
+
+
+def gate_health(candidate, last_good):
+    """(rc, [messages]) for the model-health section: the ``health``
+    embed (profiling/health.py + bench.py) must be PRESENT when
+    last-good carries one (a dropped verdict cannot silently leave
+    the gate), the sentry verdict must be nonfinite-free for any run
+    that trained (steps > 0), the trained-params drift fingerprint
+    must be pinned whenever a training stage produced a number, and
+    the loss EWMA — when carried — must be finite."""
+    rc = 0
+    msgs = []
+    mine = candidate.get("health")
+    good = last_good.get("health")
+    if not isinstance(mine, dict):
+        if isinstance(good, dict):
+            return 1, ["REGRESSION health: artifact carries no "
+                       "'health' embed but last-good does (the "
+                       "model-health verdict cannot silently drop "
+                       "out of the artifact chain)"]
+        return 0, ["health: no embed on either side (pre-health "
+                   "artifacts — ok)"]
+    verdict = mine.get("verdict")
+    nonfinite = mine.get("nonfinite_total", 0)
+    steps = mine.get("steps", 0)
+    if verdict == "nonfinite" or (isinstance(nonfinite, (int, float))
+                                  and nonfinite > 0):
+        rc = 1
+        trip = mine.get("first_trip") or {}
+        msgs.append(
+            "REGRESSION health: training went nonfinite (%s values, "
+            "first at seam %s step %s) — a number measured on NaN "
+            "weights is not a measurement"
+            % (nonfinite, trip.get("source"), trip.get("step")))
+    elif verdict == "disabled":
+        rc = 1
+        msgs.append("REGRESSION health: sentry was DISABLED for the "
+                    "run (verdict 'disabled') — an ungated artifact "
+                    "cannot claim nonfinite-free training")
+    else:
+        msgs.append("health: verdict %s, %s nonfinite across %s "
+                    "steps (ok)" % (verdict, nonfinite, steps))
+    trained = steps and steps > 0
+    good_fp = isinstance(good, dict) and good.get("fingerprint")
+    fp = mine.get("fingerprint")
+    if trained or good_fp:
+        if not (isinstance(fp, str) and fp):
+            rc = 1
+            msgs.append(
+                "REGRESSION health: params fingerprint missing (%r) "
+                "— the drift vocabulary (resume/chaos/consistency) "
+                "requires every trained artifact to pin its weights"
+                % (fp,))
+        else:
+            msgs.append("health: params fingerprint %s (pinned)" % fp)
+    ewma = mine.get("loss_ewma")
+    if ewma is not None and not _is_finite_number(ewma):
+        rc = 1
+        msgs.append("REGRESSION health: loss EWMA %r is not finite"
+                    % (ewma,))
+    elif ewma is not None:
+        msgs.append("health: loss ewma %.6g (%s anomalies)"
+                    % (ewma, mine.get("loss_anomalies", 0)))
+    return rc, msgs
+
+
 def gate(candidate, last_good, tolerance=0.25, per_metric=None,
-         metrics=_DEFAULT_METRICS, mem_tolerance=0.15):
+         metrics=_DEFAULT_METRICS, mem_tolerance=0.15,
+         health=False):
     """(exit_code, [messages]) for a candidate vs last-good pair."""
     per_metric = per_metric or {}
     msgs = []
@@ -203,6 +284,10 @@ def gate(candidate, last_good, tolerance=0.25, per_metric=None,
                                    mem_tolerance=mem_tolerance)
     rc = rc or mem_rc
     msgs.extend(mem_msgs)
+    if health:
+        h_rc, h_msgs = gate_health(candidate, last_good)
+        rc = rc or h_rc
+        msgs.extend(h_msgs)
     return rc, msgs
 
 
@@ -605,6 +690,11 @@ def main(argv=None):
                     help="required compiled-kernel / fallback speedup "
                          "where a compiled timing exists (1.0 — a "
                          "kernel must never LOSE to its fallback)")
+    ap.add_argument("--health", action="store_true",
+                    help="additionally gate the model-health embed: "
+                         "presence vs last-good, nonfinite-free "
+                         "training, pinned params fingerprint, "
+                         "finite loss EWMA (profiling/health.py)")
     args = ap.parse_args(argv)
     if args.kernels:
         last_good_path = args.last_good
@@ -701,7 +791,8 @@ def main(argv=None):
               % (args.last_good, e), file=sys.stderr)
         return 2
     rc, msgs = gate(candidate, last_good, tolerance=args.tolerance,
-                    per_metric=per_metric, mem_tolerance=args.mem_tol)
+                    per_metric=per_metric, mem_tolerance=args.mem_tol,
+                    health=args.health)
     for m in msgs:
         print(m)
     print("perf_gate: %s"
