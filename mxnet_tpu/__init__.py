@@ -86,6 +86,7 @@ from . import callback  # noqa: E402
 from . import model  # noqa: E402
 from . import predictor  # noqa: E402
 from . import serving  # noqa: E402
+from . import elastic  # noqa: E402
 from . import module  # noqa: E402
 from . import module as mod  # noqa: E402
 from . import rnn  # noqa: E402

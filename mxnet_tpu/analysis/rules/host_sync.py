@@ -100,9 +100,22 @@ _SCOPES = (
     # match wins.
     ("mxnet_tpu/serving/generate/",
      {"submit_generate", "try_admit", "_step", "_prefill", "_emit",
-      "_observe_pool", "ensure_position", "extend", "alloc", "free",
-      "reserve", "unreserve", "blocks_for", "used_blocks",
-      "reserved_blocks", "swap", "prefill", "decode"}, set()),
+      "_observe_pool", "_observe_depth", "ensure_position", "extend",
+      "alloc", "free", "reserve", "unreserve", "blocks_for",
+      "used_blocks", "reserved_blocks", "swap", "prefill",
+      "decode"}, set()),
+    # the elasticity plane's hot paths: the membership poll runs
+    # BETWEEN training steps (a sync there would fence the pipeline
+    # every boundary just to read a directory), and the autoscaler's
+    # decision loop must read host-side EWMAs and histogram bucket
+    # counts ONLY — never device arrays (a decision that synced would
+    # stall serving to decide how to serve). The reshape path itself
+    # (quiesce/gather/census) is sanctioned sync territory by design
+    # and stays off this list.
+    ("mxnet_tpu/elastic/",
+     {"poll", "view", "announce", "leave", "mark_dead",
+      "observe", "decide", "tick", "_queue_depth", "_latency_stats",
+      "_ceiling", "train_step", "histogram_window_p99"}, set()),
     # the serving gateway's per-request paths: admission + enqueue run
     # in every client thread, coalescing + reply recording in every
     # replica scheduler — a sync in any of them serializes the whole

@@ -1,0 +1,31 @@
+"""Elasticity plane: the fleet changes size; the job does not care.
+
+Two halves over one membership/generation vocabulary
+(docs/robustness.md "Elasticity"):
+
+- **Training** — :mod:`.membership` (generation-numbered views over a
+  file seam workers announce into) + :mod:`.reshard`
+  (:class:`ElasticTrainer`: quiesce at a step boundary, checkpoint,
+  rebuild the dp mesh for the new world, re-shard the ZeRO optimizer
+  state onto the new 1/dp partitioning, census-verify, carry the
+  iterator — no batch dropped or duplicated).
+- **Serving** — :mod:`.autoscale` (:class:`Autoscaler`: replicas
+  follow the ``mx_serving_*`` queue-depth/latency telemetry between
+  min/max, drain-before-retire through ``Gateway.scale``).
+
+:mod:`.chaos` proves both under injected failure (preemption storms,
+stragglers, replica kills, autoscale cycles) — committed as a
+``chaos_bench`` artifact gated by ``perf_gate --chaos``.
+"""
+from .membership import Membership, MemberView, default_dir
+from .reshard import (ElasticTrainer, devices_for_members,
+                      named_leaves, place_like, to_host,
+                      unflatten_like, zero_shard_spec)
+from .autoscale import Autoscaler, histogram_window_p99
+
+__all__ = [
+    "Membership", "MemberView", "default_dir",
+    "ElasticTrainer", "devices_for_members", "named_leaves",
+    "place_like", "to_host", "unflatten_like", "zero_shard_spec",
+    "Autoscaler", "histogram_window_p99",
+]

@@ -1,0 +1,290 @@
+"""Telemetry-driven serving autoscaler: replicas follow the load.
+
+The PR-10 gateway serves a FIXED number of replica lanes per model;
+this module closes ROADMAP item 4(b) by making that number a control
+output. The :class:`Autoscaler` is pure *policy* — the mechanism is
+``Gateway.scale`` (drain-before-retire lanes, KV pools released and
+census-verified on generator retire) — and its inputs are exclusively
+the ``mx_serving_*`` telemetry the gateway already emits:
+
+- **queue pressure**: an EWMA over the ``mx_serving_queue_depth``
+  gauge, compared against a per-replica high watermark. Sustained
+  growth (``sustain`` consecutive hot ticks) scales out.
+- **latency pressure**: a windowed p99 estimated from the
+  ``mx_serving_latency_seconds{stage="e2e"}`` histogram (cumulative
+  bucket DELTAS between ticks, so the estimate reflects the current
+  window, not the process's whole history), compared against the
+  p99 budget. Budget pressure also scales out.
+- **cooldown scale-in**: when both pressures stay cold for
+  ``sustain`` ticks AND ``cooldown_s`` has passed since the last
+  scale event, one replica drains and retires — hysteresis so a
+  bursty load cannot flap the fleet.
+
+Every decision reads host-side floats only (EWMAs, bucket counts) —
+never device arrays; the decision loop is in the MXL002 host-sync
+lint scope. The degraded-wrap flag from ``Gateway.stats()`` caps
+scale-out at the real device count (``allow_degraded=True`` opts back
+into wrapped lanes), so the autoscaler stops *asking* for lanes the
+hardware cannot isolate instead of re-triggering the wrap warning.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from .. import tracing
+from ..base import MXNetError, get_env
+from ..telemetry import metrics as _tm
+
+logger = logging.getLogger(__name__)
+
+_met = _tm.lazy_metrics(lambda reg: {
+    "decisions": reg.counter(
+        "mx_elastic_decisions_total",
+        "autoscaler decisions", labelnames=("model", "decision")),
+    "scale_events": reg.counter(
+        "mx_elastic_scale_events_total",
+        "applied scale events", labelnames=("model", "direction")),
+    "replicas": reg.gauge(
+        "mx_elastic_replicas",
+        "serving lanes the autoscaler currently maintains",
+        labelnames=("model",)),
+    "queue_ewma": reg.gauge(
+        "mx_elastic_queue_ewma",
+        "autoscaler's smoothed queue depth", labelnames=("model",)),
+    "p99_ms": reg.gauge(
+        "mx_elastic_window_p99_ms",
+        "autoscaler's windowed e2e p99 estimate",
+        labelnames=("model",)),
+})
+
+
+def histogram_window_p99(prev_stats, cur_stats, q=0.99):
+    """Quantile estimate over the observations BETWEEN two cumulative
+    histogram reads (``HistogramSeries.stats()`` tuples). Both bucket
+    lists are CUMULATIVE, so the window's cumulative count at each
+    edge is simply ``cur_cum - prev_cum`` — summing those deltas
+    again would double-count every bucket below the edge and pull the
+    estimate toward zero. Linear interpolation inside the winning
+    bucket; the +Inf bucket reports the last finite edge (a ceiling
+    estimate). None when the window saw no observations."""
+    if prev_stats is None or cur_stats is None:
+        return None
+    (c0, _, b0), (c1, _, b1) = prev_stats, cur_stats
+    n = c1 - c0
+    if n <= 0 or len(b0) != len(b1):
+        return None
+    target = q * n
+    prev_le = 0.0
+    prev_win = 0.0
+    for i, ((le, cur_cum), (_, old_cum)) in enumerate(zip(b1, b0)):
+        win_cum = cur_cum - old_cum   # window obs <= this edge
+        if le == "+Inf":
+            # beyond every finite edge: report the last finite edge
+            return float(b1[i - 1][0]) if i else None
+        le = float(le)
+        if win_cum >= target:
+            density = win_cum - prev_win
+            frac = (target - prev_win) / density if density > 0 \
+                else 1.0
+            return prev_le + frac * (le - prev_le)
+        prev_le, prev_win = le, win_cum
+    return prev_le if prev_win > 0 else None
+
+
+class Autoscaler:
+    """Scale one registered model between ``min_replicas`` and
+    ``max_replicas`` from telemetry alone. Drive it with
+    :meth:`tick` (deterministic, fake-clock-testable) or
+    :meth:`start` (daemon thread at ``period_s``)."""
+
+    def __init__(self, gateway, model, min_replicas=None,
+                 max_replicas=None, queue_high=None, queue_low=None,
+                 p99_budget_ms=None, sustain=3, cooldown_s=None,
+                 period_s=None, ewma=0.3, allow_degraded=False,
+                 clock=time.monotonic):
+        self.gateway = gateway
+        self.model = model
+        if min_replicas is None:
+            min_replicas = int(get_env("MXTPU_ELASTIC_MIN_REPLICAS",
+                                       1, int))
+        if max_replicas is None:
+            max_replicas = int(get_env("MXTPU_ELASTIC_MAX_REPLICAS",
+                                       4, int))
+        if queue_high is None:
+            queue_high = get_env("MXTPU_ELASTIC_QUEUE_HIGH", 8.0,
+                                 float)
+        if queue_low is None:
+            queue_low = queue_high / 4.0
+        if p99_budget_ms is None:
+            p99_budget_ms = get_env("MXTPU_ELASTIC_P99_BUDGET_MS",
+                                    0.0, float) or None
+        if cooldown_s is None:
+            cooldown_s = get_env("MXTPU_ELASTIC_COOLDOWN_SEC", 30.0,
+                                 float)
+        if period_s is None:
+            period_s = get_env("MXTPU_ELASTIC_POLL_SEC", 2.0, float)
+        if not 1 <= min_replicas <= max_replicas:
+            raise MXNetError(
+                f"elastic: need 1 <= min_replicas <= max_replicas, "
+                f"got [{min_replicas}, {max_replicas}]")
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.queue_high = float(queue_high)
+        self.queue_low = float(queue_low)
+        self.p99_budget_ms = p99_budget_ms
+        self.sustain = int(sustain)
+        self.cooldown_s = float(cooldown_s)
+        self.period_s = float(period_s)
+        self.ewma = float(ewma)
+        self.allow_degraded = bool(allow_degraded)
+        self._clock = clock
+        self._depth_ewma = None
+        self._hot = 0
+        self._cold = 0
+        self._last_scale_t = None
+        self._prev_hist = None
+        self.events = []        # bounded [(t, direction, replicas)]
+        self._thread = None
+        self._stop = threading.Event()
+
+    # -- telemetry reads (host floats only — MXL002 scope) -------------------
+    def _queue_depth(self):
+        reg = _tm.registry()
+        return float(reg.value("mx_serving_queue_depth", 0.0,
+                               model=self.model))
+
+    def _latency_stats(self):
+        fam = _tm.registry().find("mx_serving_latency_seconds")
+        if fam is None:
+            return None
+        return fam.labels(model=self.model, stage="e2e").stats()
+
+    def observe(self):
+        """One telemetry sample: EWMA'd queue depth + windowed p99."""
+        depth = self._queue_depth()
+        self._depth_ewma = depth if self._depth_ewma is None else \
+            (1 - self.ewma) * self._depth_ewma + self.ewma * depth
+        cur = self._latency_stats()
+        p99_s = histogram_window_p99(self._prev_hist, cur)
+        self._prev_hist = cur
+        replicas = self.gateway.replica_count(self.model)
+        sample = {
+            "depth": depth,
+            "depth_ewma": self._depth_ewma,
+            "p99_ms": p99_s * 1e3 if p99_s is not None else None,
+            "replicas": replicas,
+        }
+        met = _met()
+        met["queue_ewma"].labels(model=self.model).set(
+            self._depth_ewma)
+        met["replicas"].labels(model=self.model).set(replicas)
+        if sample["p99_ms"] is not None:
+            met["p99_ms"].labels(model=self.model).set(
+                sample["p99_ms"])
+        return sample
+
+    # -- policy --------------------------------------------------------------
+    def _ceiling(self):
+        if self.allow_degraded:
+            return self.max_replicas
+        # stop ASKING for lanes the hardware cannot isolate: the
+        # degraded flag in stats() is this cap's read-back
+        return min(self.max_replicas, self.gateway.device_count())
+
+    def decide(self, sample):
+        """(decision, reason) from one sample: 'scale_out' /
+        'scale_in' / 'hold' / 'capped'. Pure bookkeeping."""
+        replicas = sample["replicas"]
+        hot_queue = sample["depth_ewma"] > self.queue_high * replicas
+        hot_p99 = (self.p99_budget_ms is not None
+                   and sample["p99_ms"] is not None
+                   and sample["p99_ms"] > self.p99_budget_ms)
+        cold = (sample["depth_ewma"] < self.queue_low
+                * max(replicas - 1, 1)) and not hot_p99
+        if hot_queue or hot_p99:
+            self._hot += 1
+            self._cold = 0
+        elif cold:
+            self._cold += 1
+            self._hot = 0
+        else:
+            self._hot = 0
+            self._cold = 0
+        if self._hot >= self.sustain:
+            ceiling = self._ceiling()
+            if replicas >= ceiling:
+                return "capped", (
+                    f"pressure sustained but at ceiling {ceiling} "
+                    f"({'max_replicas' if ceiling == self.max_replicas else 'device count (degraded wrap refused)'})")
+            reason = "queue ewma %.1f > %.1f x %d replicas" % (
+                sample["depth_ewma"], self.queue_high, replicas) \
+                if hot_queue else "p99 %.1fms > budget %.1fms" % (
+                    sample["p99_ms"], self.p99_budget_ms)
+            return "scale_out", reason
+        if self._cold >= self.sustain and replicas > self.min_replicas:
+            now = self._clock()
+            if self._last_scale_t is not None and \
+                    now - self._last_scale_t < self.cooldown_s:
+                return "hold", "cold but inside cooldown"
+            return "scale_in", (
+                "queue ewma %.2f < %.1f with p99 in budget for %d "
+                "ticks" % (sample["depth_ewma"], self.queue_low,
+                           self._cold))
+        return "hold", "no sustained pressure"
+
+    def tick(self):
+        """observe -> decide -> (maybe) Gateway.scale. Returns
+        (decision, sample) — the unit the chaos suite and tests
+        drive."""
+        sample = self.observe()
+        decision, reason = self.decide(sample)
+        met = _met()
+        met["decisions"].labels(model=self.model,
+                                decision=decision).inc()
+        if decision in ("scale_out", "scale_in"):
+            direction = "out" if decision == "scale_out" else "in"
+            target = sample["replicas"] + \
+                (1 if direction == "out" else -1)
+            with tracing.span("elastic.autoscale", cat="elastic",
+                              model=self.model, direction=direction,
+                              replicas_to=target, reason=reason):
+                self.gateway.scale(self.model, target)
+            self._last_scale_t = self._clock()
+            self._hot = 0
+            self._cold = 0
+            met["scale_events"].labels(model=self.model,
+                                       direction=direction).inc()
+            met["replicas"].labels(model=self.model).set(target)
+            self.events.append((self._last_scale_t, direction, target))
+            del self.events[:-64]
+        return decision, sample
+
+    # -- daemon --------------------------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"mxtpu-autoscale-{self.model}")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout=5.0):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def _loop(self):
+        while not self._stop.wait(self.period_s):
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 — the autoscaler
+                # must never take down serving itself, but a broken
+                # tick must be VISIBLE, not a silent spin
+                logger.warning(
+                    "elastic: autoscaler tick for %r failed: %r",
+                    self.model, e)

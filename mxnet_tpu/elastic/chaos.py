@@ -1,0 +1,684 @@
+"""Chaos SLO suite: prove elasticity under injected failure.
+
+ROADMAP item 4's closing move — the robustness stack stops being
+"tested once" and becomes an SLO the framework *advertises*, re-proven
+by ``tools/chaos_bench.py`` and gated by ``perf_gate --chaos``. Four
+scenario runners, one per advertised behavior:
+
+``preemption_storm``
+    Kill ``kill`` of ``members`` workers mid-epoch (membership files
+    flip dead, exactly what SIGKILL leaves behind). The survivors'
+    driver detects the change at the next step boundary, checkpoints,
+    reshapes the dp mesh through elastic/reshard.py, re-shards the
+    ZeRO state, carries the iterator, and finishes the epoch.
+    Asserts: recovery-time budget; census 1/dp re-verified at the new
+    world; NO batch dropped or duplicated (phase-2 batch hashes equal
+    a planned-reshape twin's); fingerprints **bit-identical** to the
+    planned twin; drift vs the uninterrupted full-world run bounded
+    (XLA re-associates the batch reduction across partitionings, so
+    zero is not honest there — the bound is).
+
+``straggler``
+    2 ranked workers against a real in-process socket kvstore server,
+    with ``slow_worker=<ms>@rank=1`` in the fault plan consumed by
+    :func:`~mxnet_tpu.kvstore.fault.apply_straggler` inside each step
+    span. Asserts: PR 5's trace_merge straggler report NAMES that
+    exact rank (the fast rank's matching wait shows as comm).
+
+``replica_kill``
+    Open-loop load on a 2-replica gateway model; one replica is
+    killed mid-stream (the PR-10 drain path — its batch redistributes
+    to the survivor). Asserts: zero lost requests, held p99 over the
+    WHOLE window (kill included), recovery-time budget for the
+    drain -> health-probe -> revive cycle, and a probe output
+    bitwise-identical before/after recovery.
+
+``autoscale_cycle``
+    Open-loop overload against a 1-replica model with a live
+    :class:`~mxnet_tpu.elastic.autoscale.Autoscaler`: sustained queue
+    growth must scale OUT, and the post-load cold window must scale
+    back IN after the cooldown — from ``mx_serving_*`` telemetry
+    alone. Asserts both events, held p99, recovery budget.
+
+Everything runs chip-free on the CPU mesh (the same doctrine as every
+committed artifact: scenario structure + host numbers now, chip
+numbers when a live window opens).
+"""
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import os
+import shutil
+import socket
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+from .. import tracing
+from ..base import MXNetError
+from ..telemetry import metrics as _tm
+from .membership import Membership
+from .reshard import ElasticTrainer, devices_for_members, to_host
+
+_met = _tm.lazy_metrics(lambda reg: {
+    "recovery_s": reg.histogram(
+        "mx_elastic_recovery_seconds",
+        "failure-detected -> capacity-restored, per chaos scenario",
+        labelnames=("scenario",)),
+})
+
+FAMILIES = ("preemption_storm", "straggler", "replica_kill",
+            "autoscale_cycle")
+
+
+def _repo_root():
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _load_tool(name):
+    """Import a tools/ script (stdlib-only modules) by path."""
+    import importlib.util
+    path = os.path.join(_repo_root(), "tools", name + ".py")
+    spec = importlib.util.spec_from_file_location("_chaos_" + name,
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _batch_hash(*arrays):
+    h = hashlib.blake2b(digest_size=12)
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+@contextlib.contextmanager
+def _scratch_dir(workdir, name):
+    if workdir is not None:
+        path = os.path.join(os.fspath(workdir), name)
+        os.makedirs(path, exist_ok=True)
+        yield path
+    else:
+        path = tempfile.mkdtemp(prefix=f"mxtpu_chaos_{name}_")
+        try:
+            yield path
+        finally:
+            shutil.rmtree(path, ignore_errors=True)
+
+
+# ======================================================================
+# preemption storm (training elasticity)
+# ======================================================================
+def _storm_fixture(seed, din=32, hidden=64, dout=8, batch_size=32,
+                   n_batches=16):
+    """Deterministic MLP + epoch data + loss for the storm runs."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    params = {
+        "w1": rng.normal(0, 0.1, (din, hidden)).astype(np.float32),
+        "b1": np.zeros(hidden, np.float32),
+        "w2": rng.normal(0, 0.1, (hidden, dout)).astype(np.float32),
+        "b2": np.zeros(dout, np.float32),
+    }
+    X = rng.normal(0, 1, (n_batches * batch_size, din)).astype(
+        np.float32)
+    Y = rng.normal(0, 1, (n_batches * batch_size, dout)).astype(
+        np.float32)
+    bx = X[:batch_size]
+    by = Y[:batch_size]
+
+    def loss_fn(p, batch):
+        data, lbl = batch
+        h = jnp.maximum(data @ p["w1"] + p["b1"], 0.0)
+        return jnp.mean((h @ p["w2"] + p["b2"] - lbl) ** 2)
+
+    return params, loss_fn, (bx, by), X, Y
+
+
+def _storm_iter(X, Y, batch_size, seed):
+    from .. import io as mxio
+    return mxio.NDArrayIter(data={"data": X}, label={"label": Y},
+                            batch_size=batch_size, shuffle=True,
+                            seed=seed)
+
+
+def _next_batch(it):
+    b = it.next()
+    return (np.asarray(b.data[0].asnumpy()),
+            np.asarray(b.label[0].asnumpy()))
+
+
+def run_preemption_storm(members=4, kill=2, steps_before=3,
+                         steps_after=4, seed=7, batch_size=32,
+                         recovery_budget_s=60.0, drift_bound=1e-4,
+                         stage=2, workdir=None):
+    """Kill ``kill`` of ``members`` workers mid-epoch; the survivors
+    reshape and finish. Returns the scenario dict (see module doc)."""
+    import jax
+
+    devs = jax.local_devices()
+    dpm = max(len(devs) // members, 1)
+    world_devs = devices_for_members(members, devs, dpm)
+    surv_devs = devices_for_members(members - kill, devs, dpm)
+    if len(surv_devs) == len(world_devs):
+        raise MXNetError(
+            f"chaos: storm needs the world to actually shrink "
+            f"({members} members -> {members - kill} on "
+            f"{len(devs)} devices keeps {len(world_devs)})")
+    params, loss_fn, batch_ex, X, Y = _storm_fixture(
+        seed, batch_size=batch_size)
+    total_steps = steps_before + steps_after
+
+    def make_trainer():
+        return ElasticTrainer(loss_fn, params, batch_ex, lr=0.05,
+                              momentum=0.9, stage=stage)
+
+    # ---- resumed (chaos) run: storm at the boundary ------------------
+    with _scratch_dir(workdir, "storm") as root:
+        mdir = os.path.join(root, "members")
+        ckdir = os.path.join(root, "ckpt")
+        handles = [Membership(mdir, rank=r) for r in range(members)]
+        for h in handles:
+            h.announce(meta={"devices": dpm})
+        driver = handles[0]
+        driver.poll()                      # baseline view
+        trainer = make_trainer().build(world_devs)
+        it = _storm_iter(X, Y, batch_size, seed)
+        hashes_before = []
+        for _ in range(steps_before):
+            view, changed = driver.poll()
+            assert not changed
+            b = _next_batch(it)
+            hashes_before.append(_batch_hash(*b))
+            trainer.train_step(b)
+        # the storm: SIGKILL leaves dead entries, no goodbyes
+        for r in range(members - kill, members):
+            driver.mark_dead(r)
+        t_detect = time.perf_counter()
+        view, changed = driver.poll(reap=True)
+        assert changed and view.world_size == members - kill
+        # quiesce + checkpoint the OLD world (iterator position rides)
+        from ..checkpoint import CheckpointManager
+        manager = CheckpointManager(ckdir)
+        trainer.save(manager, steps_before, data_iter=it)
+        # a survivor restarts cold: fresh trainer + fresh iterator,
+        # everything carried through the checkpoint — the real resume
+        # path, not an in-memory shortcut
+        resumed = make_trainer()
+        it2 = _storm_iter(X, Y, batch_size, seed)
+        extra = resumed.restore(manager, surv_devs, data_iter=it2)
+        assert extra is not None and extra["world_size"] == \
+            len(world_devs)
+        resumed.generation = view.generation
+        census = resumed.census_check()
+        hashes_after = []
+        b = _next_batch(it2)
+        hashes_after.append(_batch_hash(*b))
+        resumed.train_step(b)              # first post-reshape step
+        recovery_s = time.perf_counter() - t_detect
+        for _ in range(steps_after - 1):
+            b = _next_batch(it2)
+            hashes_after.append(_batch_hash(*b))
+            resumed.train_step(b)
+        fp_resumed = resumed.fingerprint()
+        gen_after = view.generation
+
+    # ---- planned twin: same schedule, reshape without the kill -------
+    twin = make_trainer().build(world_devs)
+    it3 = _storm_iter(X, Y, batch_size, seed)
+    twin_before = []
+    for _ in range(steps_before):
+        b = _next_batch(it3)
+        twin_before.append(_batch_hash(*b))
+        twin.train_step(b)
+    twin.reshape(surv_devs)
+    twin_after = []
+    for _ in range(steps_after):
+        b = _next_batch(it3)
+        twin_after.append(_batch_hash(*b))
+        twin.train_step(b)
+    fp_planned = twin.fingerprint()
+
+    # ---- uninterrupted full-world reference (drift bound) ------------
+    ref = make_trainer().build(world_devs)
+    it4 = _storm_iter(X, Y, batch_size, seed)
+    for _ in range(total_steps):
+        ref.train_step(_next_batch(it4))
+    ref_host = to_host(ref.params)
+    res_host = to_host(resumed.params)
+    drift = max(
+        float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+        for a, b in zip(
+            (v for _, v in sorted(ref_host.items())),
+            (v for _, v in sorted(res_host.items()))))
+
+    dropped = len(set(twin_after) - set(hashes_after))
+    duplicated = len(hashes_after) - len(set(hashes_after))
+    _met()["recovery_s"].labels(scenario="preemption_storm").observe(
+        recovery_s)
+    return {
+        "family": "preemption_storm",
+        "mode": "in_process",
+        "world": {"members": members, "killed": kill,
+                  "devices_from": len(world_devs),
+                  "devices_to": len(surv_devs)},
+        "generation": gen_after,
+        "steps": {"before": steps_before, "after": steps_after},
+        "recovery_s": round(recovery_s, 3),
+        "recovery_budget_s": recovery_budget_s,
+        "batches": {
+            "phase2_expected": len(twin_after),
+            "phase2_seen": len(hashes_after),
+            "dropped": dropped,
+            "duplicated": duplicated,
+            "schedule_preserved": hashes_after == twin_after
+            and hashes_before == twin_before,
+        },
+        "fingerprint": {
+            "resumed": fp_resumed,
+            "planned_reshape": fp_planned,
+            "bit_identical": fp_resumed == fp_planned,
+            "drift_vs_uninterrupted_max_abs": drift,
+            "drift_bound": drift_bound,
+        },
+        "census": census,
+    }
+
+
+# ======================================================================
+# straggler (named by trace_merge)
+# ======================================================================
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_straggler(delay_ms=40, steps=3, recovery_budget_s=30.0,
+                  injected_rank=1, workdir=None):
+    """2-rank kvstore run with an injected ``slow_worker`` fault; the
+    straggler report must name that exact rank."""
+    from .. import _native
+    from ..kvstore import dist, fault
+    from ..tracing import wire
+
+    plan = f"slow_worker={delay_ms}@rank={injected_rank}"
+    # fail loudly on a typo'd plan before starting servers
+    assert fault.straggler_delay_ms(injected_rank, plan=plan) == \
+        delay_ms
+    trace_merge = _load_tool("trace_merge")
+    t0 = time.perf_counter()
+    tracing.drain()                # scenario-local span window
+    lib = _native.load_comm()
+    lib.mxtpu_server_shutdown()    # defensive: a previous run's server
+    port = _free_port()
+    if lib.mxtpu_server_start(port, 2) != 0:
+        raise MXNetError("chaos: straggler server failed to start")
+    wire.install_server_sink(lib)
+    conns = []
+    try:
+        conns = [dist.WorkerConnection("127.0.0.1", port)
+                 for _ in range(2)]
+        conns[0].set_sync_mode(True)
+        conns[0].init(0, np.zeros(8, np.float32))
+        for c in conns:
+            c.trace_clock_sync(3)
+
+        def work(c):
+            for step_n in range(steps):
+                with tracing.span("step", cat="step", step=step_n,
+                                  rank=c.rank):
+                    # the injected straggler: extra COMPUTE inside the
+                    # step span, exactly what the report attributes
+                    fault.apply_straggler(c.rank, plan=plan)
+                    c.push(0, np.full(8, 1.0 + c.rank, np.float32))
+                    c.pull(0, (8,))
+
+        ts = [threading.Thread(target=work, args=(c,)) for c in conns]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    finally:
+        for c in conns:
+            c.close()
+        lib.mxtpu_server_shutdown()
+
+    server, workers = [], {}
+    for s in tracing.drain():
+        attrs = s.get("attrs") or {}
+        if attrs.get("role") == "server":
+            server.append(s)
+        elif attrs.get("rank") is not None:
+            workers.setdefault(int(attrs["rank"]), []).append(s)
+    docs = [{"version": 1, "spans": spans,
+             "meta": {"role": "worker", "rank": r}}
+            for r, spans in sorted(workers.items())]
+    docs.append({"version": 1, "spans": server,
+                 "meta": {"role": "server", "rank": 0}})
+    report = trace_merge.straggler_report(docs)
+    wall = time.perf_counter() - t0
+    named = (report.get("overall") or {}).get("straggler_rank")
+    skews = [s["skew_ms"] for s in report.get("steps", [])]
+    _met()["recovery_s"].labels(scenario="straggler").observe(wall)
+    return {
+        "family": "straggler",
+        "mode": "in_process",
+        "plan": plan,
+        "injected_rank": f"worker{injected_rank}",
+        "named_rank": named,
+        "named_ok": named == f"worker{injected_rank}",
+        "named_every_step": all(
+            s["straggler"] == f"worker{injected_rank}"
+            for s in report.get("steps", [])),
+        "steps": steps,
+        "mean_skew_ms": round(float(np.mean(skews)), 3) if skews
+        else None,
+        "recovery_s": round(wall, 3),
+        "recovery_budget_s": recovery_budget_s,
+    }
+
+
+# ======================================================================
+# serving: replica kill + autoscale cycle
+# ======================================================================
+def _serving_fixture(seed=0, din=64, hidden=256, dout=8):
+    """A gateway-registrable MLP big enough that a backlog of requests
+    takes real milliseconds to drain (the autoscaler needs a load
+    signal, not an instantly-empty queue)."""
+    from .. import nd
+    from .. import sym
+
+    rng = np.random.default_rng(seed)
+    data = sym.var("data")
+    h = sym.FullyConnected(data, sym.var("fc1_weight"),
+                           sym.var("fc1_bias"), num_hidden=hidden,
+                           name="fc1")
+    a = sym.Activation(h, act_type="relu", name="act1")
+    out = sym.FullyConnected(a, sym.var("fc2_weight"),
+                             sym.var("fc2_bias"), num_hidden=dout,
+                             name="fc2")
+    args = {
+        "fc1_weight": nd.array(
+            rng.normal(0, 0.3, (hidden, din)).astype(np.float32)),
+        "fc1_bias": nd.array(np.zeros(hidden, np.float32)),
+        "fc2_weight": nd.array(
+            rng.normal(0, 0.3, (dout, hidden)).astype(np.float32)),
+        "fc2_bias": nd.array(np.zeros(dout, np.float32)),
+    }
+    return out, args, {}, (din,)
+
+
+class _OpenLoopLoad:
+    """Fire-and-forget submit threads at a fixed aggregate rate —
+    open-loop: arrival times never wait for completions (the
+    serving_bench stage-3 discipline). Latencies collected from each
+    future on a reaper thread."""
+
+    def __init__(self, gateway, model, feature, rate_per_s,
+                 duration_s, rows=1, seed=3):
+        self.gateway = gateway
+        self.model = model
+        self.x = np.random.default_rng(seed).normal(
+            0, 1, (rows,) + tuple(feature)).astype(np.float32)
+        self.rate = float(rate_per_s)
+        self.duration = float(duration_s)
+        self.latencies = []
+        self.rejected = 0
+        self.errors = []
+        self.submitted = 0
+        self._threads = []
+
+    def _reap(self, req, t_sub):
+        try:
+            req.result(30.0)
+            self.latencies.append(time.perf_counter() - t_sub)
+        except Exception as e:  # noqa: BLE001 — recorded, asserted on
+            self.errors.append(repr(e)[:200])
+
+    def run(self):
+        from ..serving import RejectedError
+        t_end = time.perf_counter() + self.duration
+        period = 1.0 / self.rate
+        next_t = time.perf_counter()
+        while time.perf_counter() < t_end:
+            now = time.perf_counter()
+            if now < next_t:
+                time.sleep(min(next_t - now, period))
+                continue
+            next_t += period
+            self.submitted += 1
+            t_sub = time.perf_counter()
+            try:
+                req = self.gateway.submit(self.model, self.x)
+            except RejectedError:
+                self.rejected += 1
+                continue
+            th = threading.Thread(target=self._reap,
+                                  args=(req, t_sub), daemon=True)
+            th.start()
+            self._threads.append(th)
+
+    def finish(self, timeout=60.0):
+        deadline = time.monotonic() + timeout
+        for th in self._threads:
+            th.join(max(deadline - time.monotonic(), 0.1))
+
+    def p99_ms(self):
+        if not self.latencies:
+            return None
+        return float(np.percentile(np.asarray(self.latencies), 99)
+                     * 1e3)
+
+
+def _probe_fingerprint(gateway, model, feature, seed=11):
+    from ..profiling.health import fingerprint_params
+    x = np.random.default_rng(seed).normal(
+        0, 1, (1,) + tuple(feature)).astype(np.float32)
+    out = gateway.infer(model, x, timeout=30.0)
+    return fingerprint_params({"out": np.asarray(out[0])})
+
+
+def _serial_capacity(gateway, model, feature, n=30, rows=1):
+    """Measured serial req/s — the load calibrator (same row count
+    the open-loop generator will offer)."""
+    x = np.random.default_rng(1).normal(
+        0, 1, (rows,) + tuple(feature)).astype(np.float32)
+    gateway.infer(model, x)      # warm
+    t0 = time.perf_counter()
+    for _ in range(n):
+        gateway.infer(model, x)
+    return n / (time.perf_counter() - t0)
+
+
+def run_replica_kill(duration_s=4.0, kill_after_s=1.2,
+                     p99_budget_ms=1000.0, recovery_budget_s=20.0,
+                     rate_factor=0.5, workdir=None):
+    """Open-loop load on 2 replicas; one is killed mid-stream. The
+    PR-10 drain path redistributes its work (zero lost requests), the
+    health probe revives it (recovery budget), p99 holds over the
+    whole window, and a fixed probe input returns bitwise-identical
+    bytes before and after."""
+    from ..serving import Gateway, ServingError
+
+    symbol, args, aux, feature = _serving_fixture()
+    gw = Gateway()
+    try:
+        gw.register("chaos_kill", symbol, args, aux,
+                    input_shapes={"data": feature},
+                    buckets=(1, 2, 4, 8), max_wait_ms=1.0,
+                    max_queue=256, replicas=2)
+        cap = _serial_capacity(gw, "chaos_kill", feature)
+        fp_before = _probe_fingerprint(gw, "chaos_kill", feature)
+        load = _OpenLoopLoad(gw, "chaos_kill", feature,
+                             rate_per_s=max(cap * rate_factor, 20.0),
+                             duration_s=duration_s)
+        killed = {}
+
+        def killer():
+            time.sleep(kill_after_s)
+            m = gw.registry.get("chaos_kill")
+            rep = m.replicas[-1]
+            killed["t"] = time.perf_counter()
+            killed["idx"] = rep.idx
+            # the kill: an execution-shaped failure drains the lane
+            # exactly like a dying device would (PR-10 seam)
+            rep._fail([], ServingError("chaos: replica killed"))
+            # the revive loop a deployment would run via
+            # MXTPU_SERVING_HEALTH_SEC, driven inline here
+            while "recovered" not in killed:
+                states = gw.check_health("chaos_kill")["chaos_kill"]
+                if all(states) and len(states) == 2:
+                    killed["recovered"] = time.perf_counter()
+                    break
+                time.sleep(0.05)
+
+        kt = threading.Thread(target=killer, daemon=True)
+        kt.start()
+        load.run()
+        kt.join(recovery_budget_s + duration_s)
+        load.finish()
+        if "recovered" not in killed:
+            recovery_s = None
+        else:
+            recovery_s = killed["recovered"] - killed["t"]
+        fp_after = _probe_fingerprint(gw, "chaos_kill", feature)
+        p99 = load.p99_ms()
+        healthy = gw.health()["chaos_kill"]
+    finally:
+        gw.close()
+    if recovery_s is not None:
+        _met()["recovery_s"].labels(scenario="replica_kill").observe(
+            recovery_s)
+    return {
+        "family": "replica_kill",
+        "mode": "open_loop",
+        "measured_serial_req_per_s": round(cap, 1),
+        "offered_req_per_s": round(load.rate, 1),
+        "submitted": load.submitted,
+        "completed": len(load.latencies),
+        "rejected": load.rejected,
+        "lost_requests": len(load.errors),
+        "errors_sample": load.errors[:3],
+        "killed_replica": killed.get("idx"),
+        "recovery_s": round(recovery_s, 3)
+        if recovery_s is not None else None,
+        "recovery_budget_s": recovery_budget_s,
+        "p99_ms": round(p99, 2) if p99 is not None else None,
+        "p99_budget_ms": p99_budget_ms,
+        "replicas_healthy_after": healthy,
+        "probe_fingerprint_equal": fp_before == fp_after,
+    }
+
+
+def run_autoscale_cycle(burst_s=2.5, rate_factor=3.0,
+                        p99_budget_ms=5000.0, recovery_budget_s=30.0,
+                        cooldown_s=1.0, workdir=None):
+    """Open-loop overload against 1 replica with a live Autoscaler:
+    queue growth must scale OUT, the post-burst cold window must
+    scale back IN — decisions from mx_serving_* telemetry alone."""
+    from ..serving import Gateway
+    from .autoscale import Autoscaler
+
+    # big enough that one lane measurably cannot keep up with the
+    # offered rate (a fast model never shows the autoscaler a queue)
+    symbol, args, aux, feature = _serving_fixture(seed=5, din=512,
+                                                  hidden=2048)
+    rows = 4
+    gw = Gateway()
+    try:
+        gw.register("chaos_scale", symbol, args, aux,
+                    input_shapes={"data": feature},
+                    buckets=(1, 2, 4, 8), max_wait_ms=1.0,
+                    max_queue=512, replicas=1)
+        cap = _serial_capacity(gw, "chaos_scale", feature, rows=rows)
+        scaler = Autoscaler(
+            gw, "chaos_scale", min_replicas=1, max_replicas=2,
+            queue_high=4.0, sustain=2, cooldown_s=cooldown_s,
+            period_s=0.15, ewma=0.5, allow_degraded=True)
+        load = _OpenLoopLoad(gw, "chaos_scale", feature,
+                             rate_per_s=max(cap * rate_factor, 50.0),
+                             duration_s=burst_s, rows=rows)
+        t0 = time.perf_counter()
+        decisions = []
+        stop = threading.Event()
+
+        def drive():
+            while not stop.wait(scaler.period_s):
+                d, sample = scaler.tick()
+                decisions.append(
+                    (round(time.perf_counter() - t0, 3), d,
+                     sample["replicas"],
+                     round(sample["depth_ewma"], 2)))
+
+        dt = threading.Thread(target=drive, daemon=True)
+        dt.start()
+        load.run()
+        load.finish()
+        # cold window: keep ticking until scale-in (or budget blown)
+        deadline = time.monotonic() + recovery_budget_s
+        while time.monotonic() < deadline:
+            if any(d for _, d, _, _ in decisions if d == "scale_in"):
+                break
+            time.sleep(0.1)
+        stop.set()
+        dt.join(5.0)
+        p99 = load.p99_ms()
+        events = list(scaler.events)
+        replicas_final = gw.replica_count("chaos_scale")
+    finally:
+        gw.close()
+    t_out = next((t for t, d, _, _ in decisions if d == "scale_out"),
+                 None)
+    t_in = next((t for t, d, _, _ in decisions if d == "scale_in"),
+                None)
+    if t_out is not None:
+        _met()["recovery_s"].labels(
+            scenario="autoscale_cycle").observe(t_out)
+    return {
+        "family": "autoscale_cycle",
+        "mode": "open_loop",
+        "measured_serial_req_per_s": round(cap, 1),
+        "offered_req_per_s": round(load.rate, 1),
+        "submitted": load.submitted,
+        "completed": len(load.latencies),
+        "rejected": load.rejected,
+        "lost_requests": len(load.errors),
+        "scaled_out": t_out is not None,
+        "scaled_in": t_in is not None,
+        "scale_out_at_s": t_out,
+        "scale_in_at_s": t_in,
+        "scale_events": [
+            {"direction": d, "replicas": n} for _, d, n in events],
+        "replicas_final": replicas_final,
+        "recovery_s": t_out,
+        "recovery_budget_s": recovery_budget_s,
+        "p99_ms": round(p99, 2) if p99 is not None else None,
+        "p99_budget_ms": p99_budget_ms,
+    }
+
+
+# ======================================================================
+def run_all(workdir=None, quick=False):
+    """Every scenario family, one artifact-ready dict."""
+    scenarios = {}
+    scenarios["preemption_storm"] = run_preemption_storm(
+        steps_before=2 if quick else 3,
+        steps_after=2 if quick else 4, workdir=workdir)
+    scenarios["straggler"] = run_straggler(
+        delay_ms=25 if quick else 40, workdir=workdir)
+    scenarios["replica_kill"] = run_replica_kill(
+        duration_s=2.0 if quick else 4.0, workdir=workdir)
+    scenarios["autoscale_cycle"] = run_autoscale_cycle(
+        burst_s=1.5 if quick else 2.5, workdir=workdir)
+    return scenarios

@@ -1,0 +1,268 @@
+"""Generation-numbered membership: who is in the job, right now.
+
+The elasticity plane's shared vocabulary (docs/robustness.md
+"Elasticity"): both the training reshape protocol (elastic/reshard.py)
+and the chaos driver speak in **membership views** — a generation
+number plus the set of alive worker ranks. The seam is deliberately
+dumb: a directory (``MXTPU_ELASTIC_DIR``, or one provisioned per job
+by tools/launch.py) where each worker *announces* itself by atomically
+writing ``member-<rank>.json`` and bumps a shared ``GENERATION``
+counter. Polling is a readdir + small JSON reads — no sockets, no
+consensus protocol, no device work (the membership poll sits on the
+training hot path between steps; mxlint MXL002 covers it).
+
+Death detection is pid-based: a member file whose recorded pid no
+longer exists names a worker that died WITHOUT saying goodbye (the
+preemption-storm case — SIGKILL leaves no time for ``leave()``).
+``poll(reap=True)`` — run by whoever drives the reshape, typically the
+surviving lowest rank — removes such stale files and bumps the
+generation, so every poller converges on the same post-storm view.
+In-process chaos harnesses, whose "workers" share one pid, use
+:meth:`Membership.mark_dead` to model the same thing deterministically.
+
+Generation semantics: the counter bumps on every announce / leave /
+reap, and a :class:`MemberView` carries the generation it was read
+under. A reshape is correct iff it was planned against the generation
+that is still current when the quiesce completes — the reshape
+protocol re-polls at the boundary and starts over when the view moved
+underneath it (the classic lost-update guard, without a coordinator).
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from dataclasses import dataclass, field
+
+from ..base import MXNetError, get_env
+from ..checkpoint import write_bytes
+from ..telemetry import metrics as _tm
+
+_GEN_FILE = "GENERATION"
+_LOCK_FILE = "GENERATION.lock"
+_MEMBER_PREFIX = "member-"
+# a GENERATION.lock older than this is a crashed bumper's leftover —
+# steal it (the bump itself is a read+write of one small file)
+_LOCK_STALE_S = 5.0
+
+_met = _tm.lazy_metrics(lambda reg: {
+    "generation": reg.gauge(
+        "mx_elastic_generation",
+        "membership generation this process last observed"),
+    "members": reg.gauge(
+        "mx_elastic_members",
+        "alive members in the last polled view"),
+    "changes": reg.counter(
+        "mx_elastic_membership_changes_total",
+        "membership changes observed by poll()",
+        labelnames=("kind",)),
+})
+
+
+@dataclass(frozen=True)
+class MemberView:
+    """One consistent read of the membership directory."""
+    generation: int
+    alive: tuple          # sorted alive ranks
+    dead: tuple = ()      # ranks whose recorded pid no longer runs
+    leaving: tuple = ()   # ranks that announced a graceful departure
+    members: dict = field(default_factory=dict)  # rank -> member doc
+
+    @property
+    def world_size(self):
+        return len(self.alive)
+
+
+def default_dir():
+    """The job's membership directory (``MXTPU_ELASTIC_DIR``), or None
+    outside an elastic job."""
+    return get_env("MXTPU_ELASTIC_DIR", "", str) or None
+
+
+def _pid_alive(pid):
+    try:
+        os.kill(int(pid), 0)
+    except PermissionError:
+        return True     # the pid RUNS, we just cannot signal it —
+    except (OSError, ValueError):   # peers under another uid are alive
+        return False
+    return True
+
+
+class Membership:
+    """One process's handle on the membership directory.
+
+    ``announce()`` / ``leave()`` mutate this rank's entry;
+    ``view()`` reads everyone's; ``poll()`` additionally compares
+    against the last view this handle saw and reports what changed.
+    """
+
+    def __init__(self, dirpath, rank=None):
+        self.dir = os.fspath(dirpath)
+        os.makedirs(self.dir, exist_ok=True)
+        if rank is None:
+            rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+        self.rank = int(rank)
+        self._last = None   # MemberView from the previous poll()
+
+    # -- generation counter --------------------------------------------------
+    def _read_generation(self):
+        try:
+            with open(os.path.join(self.dir, _GEN_FILE),
+                      encoding="utf-8") as f:
+                return int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            return 0
+
+    @contextlib.contextmanager
+    def _gen_lock(self):
+        """Serialize generation bumps across processes via an O_EXCL
+        lockfile; a stale lock (crashed bumper) is stolen after
+        ``_LOCK_STALE_S``."""
+        lock = os.path.join(self.dir, _LOCK_FILE)
+        deadline = time.monotonic() + 2 * _LOCK_STALE_S
+        while True:
+            try:
+                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                break
+            except FileExistsError:
+                try:
+                    # wall clock on BOTH sides: getmtime is epoch time
+                    stale = time.time() - os.path.getmtime(lock) > \
+                        _LOCK_STALE_S
+                except OSError:
+                    continue   # holder released between stat attempts
+                if stale:
+                    # steal by atomic rename: exactly ONE stealer wins
+                    # (the loser's rename raises) — a bare unlink here
+                    # could remove a FRESH lock a faster stealer just
+                    # created, letting two bumpers in at once
+                    grave = "%s.stale.%d" % (lock, os.getpid())
+                    try:
+                        os.rename(lock, grave)
+                    except OSError:
+                        continue
+                    with contextlib.suppress(OSError):
+                        os.unlink(grave)
+                    continue
+                if time.monotonic() > deadline:
+                    raise MXNetError(
+                        f"elastic: generation lock {lock} held beyond "
+                        "its stale window — membership directory "
+                        "wedged") from None
+                time.sleep(0.001)
+        try:
+            yield
+        finally:
+            # unlink only if the path still names OUR lock: a holder
+            # stalled past the stale window may have been stolen by
+            # rename, and blindly unlinking here would delete the
+            # SUCCESSOR'S fresh lock, letting two bumpers in at once
+            with contextlib.suppress(OSError):
+                if os.stat(lock).st_ino == os.fstat(fd).st_ino:
+                    os.unlink(lock)
+            os.close(fd)
+
+    def _bump(self):
+        with self._gen_lock():
+            g = self._read_generation() + 1
+            write_bytes(os.path.join(self.dir, _GEN_FILE), str(g),
+                        manifest=False)
+        return g
+
+    # -- this rank's entry ---------------------------------------------------
+    def _member_path(self, rank):
+        return os.path.join(self.dir, f"{_MEMBER_PREFIX}{int(rank)}.json")
+
+    def announce(self, meta=None, pid=None):
+        """Join (or refresh) this rank's membership entry; bumps the
+        generation. Returns the new generation."""
+        doc = {"rank": self.rank, "pid": int(pid or os.getpid()),
+               "state": "alive", "meta": meta or {},
+               "announced_at": time.time()}
+        write_bytes(self._member_path(self.rank),
+                    json.dumps(doc, sort_keys=True), manifest=False)
+        g = self._bump()
+        _met()["changes"].labels(kind="join").inc()
+        return g
+
+    def leave(self):
+        """Graceful departure: the entry is removed (not just marked)
+        so pollers see a clean world, and the generation bumps."""
+        with contextlib.suppress(OSError):
+            os.unlink(self._member_path(self.rank))
+        g = self._bump()
+        _met()["changes"].labels(kind="leave").inc()
+        return g
+
+    def mark_dead(self, rank):
+        """Chaos seam: declare ``rank`` dead as a SIGKILL would — the
+        entry stays on disk but names a pid that never runs again
+        (state flipped to 'dead' for in-process harnesses that share
+        the live pid). poll(reap=True) then treats it exactly like a
+        storm-killed worker."""
+        path = self._member_path(rank)
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            doc = {"rank": int(rank), "pid": -1, "meta": {}}
+        doc["state"] = "dead"
+        write_bytes(path, json.dumps(doc, sort_keys=True),
+                    manifest=False)
+        return self._bump()
+
+    # -- reads ---------------------------------------------------------------
+    def view(self):
+        """One consistent :class:`MemberView` of the directory."""
+        alive, dead, leaving, members = [], [], [], {}
+        for name in sorted(os.listdir(self.dir)):
+            if not (name.startswith(_MEMBER_PREFIX)
+                    and name.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(self.dir, name),
+                          encoding="utf-8") as f:
+                    doc = json.load(f)
+                rank = int(doc["rank"])
+            except (OSError, ValueError, KeyError):
+                continue   # torn write mid-announce: next poll sees it
+            members[rank] = doc
+            state = doc.get("state", "alive")
+            if state == "dead" or (state == "alive"
+                                   and not _pid_alive(doc.get("pid", -1))):
+                dead.append(rank)
+            elif state == "leaving":
+                leaving.append(rank)
+            else:
+                alive.append(rank)
+        return MemberView(generation=self._read_generation(),
+                          alive=tuple(sorted(alive)),
+                          dead=tuple(sorted(dead)),
+                          leaving=tuple(sorted(leaving)),
+                          members=members)
+
+    def poll(self, reap=False):
+        """(view, changed): read the directory and compare the alive
+        set against this handle's previous poll. ``reap=True``
+        additionally removes dead members' stale files (bumping the
+        generation once for the whole sweep) — run by the rank driving
+        the reshape, so every poller converges on one post-storm
+        generation."""
+        v = self.view()
+        if reap and v.dead:
+            for rank in v.dead:
+                with contextlib.suppress(OSError):
+                    os.unlink(self._member_path(rank))
+            self._bump()
+            _met()["changes"].labels(kind="reap").inc(len(v.dead))
+            v = self.view()
+        # the first poll is the baseline view, not a change — a loop
+        # that polls between steps must not reshape on step 0
+        changed = self._last is not None and v.alive != self._last.alive
+        self._last = v
+        m = _met()
+        m["generation"].set(v.generation)
+        m["members"].set(len(v.alive))
+        return v, changed

@@ -1,0 +1,383 @@
+"""Training elasticity: reshape the data-parallel mesh, re-shard the
+ZeRO optimizer state, carry the iterator — without losing a batch.
+
+The reference fork's distributed story assumed a fixed fleet (ps-lite
+workers with restart policies put the SAME world back). A preemptible
+TPU fleet changes size mid-run, so the reshape protocol here treats a
+membership change (elastic/membership.py) as a planned event:
+
+1. **Quiesce** at a step boundary — the in-flight step finishes (the
+   CPU backend already serializes steps; elsewhere one fence), so the
+   params/optimizer pytrees are whole values, not in-flight futures.
+2. **Checkpoint** through the PR-2 :class:`~mxnet_tpu.checkpoint.
+   CheckpointManager` — params AND ZeRO state flattened into one CRC-
+   manifested ``.params`` payload, plus the PR-8 iterator position, so
+   a reshape survives the driver itself dying mid-reshape.
+3. **Rebuild** the mesh for the new world size and recompile the ZeRO
+   step (``parallel/train_step.py`` — the arXiv 2004.13336
+   cross-replica weight-update sharding, now re-applied at
+   reconfiguration time: the SAME host values land on a different
+   1/dp partitioning).
+4. **Re-place + verify**: every leaf is ``device_put`` under the new
+   step's shardings, census roles re-stamped, and
+   :meth:`ElasticTrainer.census_check` re-proves the 1/dp per-device
+   live-bytes contract with the PR-7 census — the same method as
+   ``test_zero_census_per_device_live_bytes``, re-run at reshape time.
+5. **Resume** — the restored iterator replays from the exact batch the
+   checkpoint recorded: no batch dropped, none duplicated, and with
+   the global batch schedule preserved the resumed run fingerprints
+   (PR 13 ``fingerprint_params``) **bit-identical** to a planned
+   reshape at the same boundary. (Across *different* dp partitionings
+   XLA may re-associate the batch reduction, so resumed-vs-
+   uninterrupted drift is *bounded*, not zero — the chaos suite pins
+   both numbers.)
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from .. import tracing
+from ..base import MXNetError, get_env
+from ..telemetry import metrics as _tm
+
+_met = _tm.lazy_metrics(lambda reg: {
+    "reshapes": reg.counter(
+        "mx_elastic_reshapes_total",
+        "mesh reshapes executed", labelnames=("outcome",)),
+    "reshape_s": reg.histogram(
+        "mx_elastic_reshape_seconds",
+        "quiesce -> first-step-ready reshape wall-clock"),
+    "world": reg.gauge(
+        "mx_elastic_world_size",
+        "devices in the current data-parallel mesh"),
+})
+
+_PARAM_PREFIX = "param/"
+_OPT_PREFIX = "opt/"
+
+
+# -- pytree <-> named host dicts -------------------------------------------
+def named_leaves(tree):
+    """Deterministically-ordered ``{path: leaf}`` flatten — literally
+    the walk fingerprint_params hashes (one shared implementation:
+    profiling/health.iter_named_leaves), so a checkpoint's keys and a
+    fingerprint's paths agree by construction."""
+    from ..profiling.health import iter_named_leaves
+    return dict(iter_named_leaves(tree))
+
+
+def to_host(tree):
+    """Gather a (possibly sharded) pytree to host numpy leaves."""
+    import jax
+
+    def one(x):
+        return np.asarray(jax.device_get(x))
+    return _map_leaves(one, tree)
+
+
+def _map_leaves(fn, tree):
+    if isinstance(tree, dict):
+        return {k: _map_leaves(fn, v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(_map_leaves(fn, v) for v in tree)
+    if tree is None:
+        return None
+    return fn(tree)
+
+
+def place_like(host_tree, placed_tree):
+    """``device_put`` every host leaf under the matching placed leaf's
+    sharding — how restored state lands on a RESHAPED partitioning:
+    the new step's freshly-placed example arrays carry the new
+    shardings, the checkpoint carries the values."""
+    import jax
+
+    def one(h, p):
+        return jax.device_put(np.asarray(getattr(h, "_data", h)), p.sharding)
+    return jax.tree_util.tree_map(one, host_tree, placed_tree)
+
+
+def unflatten_like(flat, like, prefix=""):
+    """Rebuild a pytree shaped like ``like`` from a ``{path: value}``
+    dict (named_leaves' inverse). Missing keys raise — a checkpoint
+    that lost a leaf must not silently resume with example values."""
+    def build(node, path):
+        if isinstance(node, dict):
+            return {k: build(node[k], path + (str(k),))
+                    for k in node}
+        if isinstance(node, (list, tuple)):
+            return type(node)(build(v, path + (str(i),))
+                              for i, v in enumerate(node))
+        if node is None:
+            return None
+        key = prefix + "/".join(path)
+        if key not in flat:
+            raise MXNetError(
+                f"elastic: checkpoint is missing leaf {key!r} — "
+                "refusing to resume with example values")
+        v = flat[key]
+        return np.asarray(getattr(v, "_data", v))
+    return build(like, ())
+
+
+def zero_shard_spec(leaf, dp):
+    """Whether make_zero_train_step shards this leaf over dp —
+    literally the placement predicate (one shared implementation:
+    parallel/train_step.zero_shard_leaf), so the census expectation
+    and the placing rule cannot drift apart."""
+    from ..parallel.train_step import zero_shard_leaf
+    return zero_shard_leaf(leaf, dp)
+
+
+class ElasticTrainer:
+    """A ZeRO training step that can be rebuilt for any world size.
+
+    Owns everything needed to recompile: the loss function, host
+    examples, hyperparameters, and ZeRO stage. ``build()`` compiles
+    for a device list; ``reshape()`` is build + state carry + census
+    re-verification; ``save()``/``restore()`` ride CheckpointManager.
+    """
+
+    def __init__(self, loss_fn, param_example, batch_example,
+                 lr=0.01, momentum=0.9, stage=2, dp_axis="dp",
+                 batch_specs=None):
+        from jax.sharding import PartitionSpec as P
+        self.loss_fn = loss_fn
+        self.param_example = to_host(param_example)
+        self.batch_example = batch_example
+        self.lr = lr
+        self.momentum = momentum
+        self.stage = int(stage)
+        self.dp_axis = dp_axis
+        self.batch_specs = batch_specs if batch_specs is not None \
+            else P(dp_axis)
+        self.mesh = None
+        self.devices = None
+        self.step = None
+        self.params = None
+        self.opt = None
+        self.generation = 0     # membership generation this world serves
+        self.steps_done = 0
+
+    @property
+    def dp(self):
+        return len(self.devices) if self.devices else 0
+
+    # -- build / reshape ----------------------------------------------------
+    def build(self, devices, params_host=None, opt_host=None,
+              generation=0):
+        """Compile the ZeRO step for ``devices`` and place state —
+        ``params_host``/``opt_host`` when carrying restored values,
+        the examples (and zero momentum) otherwise."""
+        from ..parallel import create_mesh, make_zero_train_step
+        from ..profiling import memory as _mem
+
+        devices = list(devices)
+        if not devices:
+            raise MXNetError("elastic: cannot build a 0-device mesh")
+        self.mesh = create_mesh({self.dp_axis: len(devices)},
+                                devices=devices)
+        step, p0, o0 = make_zero_train_step(
+            self.loss_fn, self.mesh,
+            params_host if params_host is not None
+            else self.param_example,
+            self.batch_example, batch_specs=self.batch_specs,
+            lr=self.lr, momentum=self.momentum, dp_axis=self.dp_axis,
+            stage=self.stage)
+        # make_* placed the param values we passed; the opt state it
+        # places is ZEROS — re-place the restored momentum under the
+        # new shardings when we carry state across a reshape
+        if opt_host is not None:
+            o0 = place_like(opt_host, o0)
+            _mem.tag_tree(o0, "optimizer_state")
+        self.devices = devices
+        self.step = step
+        self.params = p0
+        self.opt = o0
+        self.generation = int(generation)
+        _met()["world"].set(len(devices))
+        return self
+
+    def reshape(self, devices, generation=None, manager=None,
+                data_iter=None, save_step=None):
+        """Quiesce -> (optionally checkpoint) -> gather -> rebuild ->
+        re-place -> census-verify, as one traced span tree
+        (``elastic.reshape`` + children) so trace_merge can narrate
+        the reconfiguration. Returns the census report."""
+        import jax
+
+        t0 = time.perf_counter()
+        gen = self.generation if generation is None else int(generation)
+        try:
+            with tracing.span("elastic.reshape", cat="elastic",
+                              world_from=self.dp, world_to=len(devices),
+                              generation=gen):
+                with tracing.span("reshape.quiesce", cat="elastic"):
+                    # the step boundary: every in-flight donation
+                    # resolves before we read the trees as values
+                    jax.block_until_ready(self.params)
+                    if self.opt is not None:
+                        jax.block_until_ready(self.opt)
+                with tracing.span("reshape.gather", cat="elastic"):
+                    params_host = to_host(self.params)
+                    opt_host = to_host(self.opt) \
+                        if self.opt is not None else None
+                if manager is not None:
+                    with tracing.span("reshape.checkpoint",
+                                      cat="elastic"):
+                        self.save(manager,
+                                  save_step if save_step is not None
+                                  else self.steps_done,
+                                  data_iter=data_iter,
+                                  _params_host=params_host,
+                                  _opt_host=opt_host)
+                with tracing.span("reshape.rebuild", cat="elastic",
+                                  world=len(devices)):
+                    self.build(devices, params_host=params_host,
+                               opt_host=opt_host, generation=gen)
+                with tracing.span("reshape.verify", cat="elastic"):
+                    report = self.census_check()
+        except Exception:
+            _met()["reshapes"].labels(outcome="failed").inc()
+            raise
+        m = _met()
+        m["reshapes"].labels(outcome="ok").inc()
+        m["reshape_s"].observe(time.perf_counter() - t0)
+        return report
+
+    # -- the per-step seam ---------------------------------------------------
+    def train_step(self, batch, worker_rank=None):
+        """One elastic training step inside a ``step``-cat span (so
+        trace_merge's per-rank breakdown sees it), with the
+        ``slow_worker`` fault seam applied FIRST — injected straggler
+        milliseconds land as compute inside the span, which is exactly
+        how the straggler report names the slow rank."""
+        from ..kvstore import fault as _fault
+        with tracing.span("step", cat="step", step=self.steps_done,
+                          generation=self.generation):
+            _fault.apply_straggler(worker_rank)
+            self.params, self.opt, loss = self.step(
+                self.params, self.opt, batch)
+        self.steps_done += 1
+        return loss
+
+    # -- checkpoint round trip ----------------------------------------------
+    def save(self, manager, step, data_iter=None, extra=None,
+             _params_host=None, _opt_host=None):
+        """Capture params + ZeRO state (+ iterator position) through
+        CheckpointManager: both trees flatten into ONE nd.save payload
+        under ``param/``/``opt/`` key prefixes, so the existing CRC
+        manifest covers the whole resharding substrate."""
+        params_host = _params_host if _params_host is not None \
+            else to_host(self.params)
+        flat = {_PARAM_PREFIX + k: v
+                for k, v in named_leaves(params_host).items()}
+        if self.opt is not None or _opt_host is not None:
+            opt_host = _opt_host if _opt_host is not None \
+                else to_host(self.opt)
+            flat.update({_OPT_PREFIX + k: v
+                         for k, v in named_leaves(opt_host).items()})
+        meta = {"world_size": self.dp, "stage": self.stage,
+                "generation": self.generation,
+                "steps_done": self.steps_done}
+        meta.update(extra or {})
+        return manager.save(step, params=flat, data_iter=data_iter,
+                            extra=meta)
+
+    def restore(self, manager, devices, data_iter=None):
+        """Resume from the newest valid checkpoint ONTO ``devices`` —
+        the re-sharding restore: state saved at one dp lands on
+        another. Returns the checkpoint's ``extra`` dict (or None when
+        there is nothing to resume; the caller builds fresh). The
+        PR-8 iterator position is applied to ``data_iter`` so the
+        resumed run replays the exact remaining batch schedule."""
+        state = manager.resume_latest(data_iter=data_iter)
+        if state is None:
+            return None
+        flat = state["params"] or {}
+        params_host = unflatten_like(flat, self.param_example,
+                                     prefix=_PARAM_PREFIX)
+        opt_host = None
+        if any(k.startswith(_OPT_PREFIX) for k in flat):
+            opt_host = unflatten_like(flat, self.param_example,
+                                      prefix=_OPT_PREFIX)
+        extra = state.get("extra") or {}
+        self.build(devices, params_host=params_host, opt_host=opt_host,
+                   generation=extra.get("generation", 0))
+        self.steps_done = int(extra.get("steps_done", 0))
+        return extra
+
+    # -- proofs --------------------------------------------------------------
+    def expected_per_device_bytes(self, role):
+        """What the ZeRO contract says ONE device must hold for
+        ``role`` at this stage/world: sharded leaves contribute
+        nbytes/dp, replicated crumbs full nbytes. Derived from the
+        shard RULE (not the placed arrays' own shardings, which would
+        be circular)."""
+        dp = self.dp
+        shard = (role == "optimizer_state") or \
+            (role == "parameter" and self.stage >= 3)
+        total = 0
+        for leaf in named_leaves(self.param_example).values():
+            n = int(np.asarray(leaf).nbytes)
+            total += n // dp if shard and zero_shard_spec(leaf, dp) \
+                else n
+        return total
+
+    def census_check(self):
+        """Re-verify the 1/dp per-device live-bytes contract on the
+        CURRENT placement with the PR-7 census — the
+        test_zero_census_per_device_live_bytes method, re-run after
+        every reshape. Raises MXNetError on imbalance or a wrong
+        per-device footprint; returns the report dict."""
+        from ..profiling import memory as _mem
+
+        if not _mem.census_enabled():
+            return {"disabled": True}
+        _mem.tag_tree(self.params, "parameter")
+        if self.opt is not None:
+            _mem.tag_tree(self.opt, "optimizer_state")
+        report = {"dp": self.dp, "stage": self.stage, "roles": {}}
+        roles = [("parameter", self.params)]
+        if self.opt is not None:
+            roles.append(("optimizer_state", self.opt))
+        for role, tree in roles:
+            doc = _mem.live_census(arrays=tree)
+            devs = doc.get("by_device") or {}
+            vals = [d["by_role"].get(role, 0) for d in devs.values()]
+            expected = self.expected_per_device_bytes(role)
+            entry = {"devices": len(devs),
+                     "per_device_bytes": sorted(set(vals)),
+                     "expected_bytes": expected}
+            report["roles"][role] = entry
+            if len(devs) != self.dp or len(set(vals)) != 1 or \
+                    vals[0] != expected:
+                raise MXNetError(
+                    f"elastic: post-reshape census violates the 1/dp "
+                    f"contract for role {role!r} at dp={self.dp} "
+                    f"stage={self.stage}: per-device bytes {entry} ")
+        return report
+
+    def fingerprint(self):
+        """PR-13 params drift fingerprint of the CURRENT weights —
+        the shared vocabulary the chaos suite pins resumed-vs-planned
+        reshapes with."""
+        from ..profiling.health import fingerprint_params
+        return fingerprint_params(to_host(self.params))
+
+
+def devices_for_members(n_members, devices=None, devices_per_member=None):
+    """The device slice an ``n_members``-strong world trains on: the
+    first ``n_members * devices_per_member`` local devices (whole
+    fleet split evenly when ``devices_per_member`` is None). The
+    in-process analogue of each worker contributing its chips."""
+    import jax
+    devs = list(devices if devices is not None else jax.local_devices())
+    if n_members < 1:
+        raise MXNetError("elastic: world must keep >= 1 member")
+    if devices_per_member is None:
+        devices_per_member = max(len(devs) // max(n_members, 1), 1)
+    take = min(n_members * devices_per_member, len(devs))
+    return devs[:take]

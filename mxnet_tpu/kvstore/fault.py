@@ -42,6 +42,12 @@ Each ``;``-separated directive is ``kind[=arg]`` followed by
     the Nth atomic checkpoint write (``round=N``, default the next one)
     AFTER its CRC is recorded — the torn-write/bitrot damage the
     MANIFEST.json must reject at load.
+``slow_worker=<ms>``
+    (worker loop seam, Python-side) the named rank (``rank=N``)
+    sleeps ``ms`` of extra compute every batch, via
+    :func:`apply_straggler` called inside the step span by the
+    elastic train loop / chaos driver — the deterministic straggler
+    whose rank PR 5's trace_merge report must name.
 
 Conditions: ``round=N`` (Nth distinct matching request, counted PER
 RANK so interleaving across workers cannot move the firing point, and
@@ -90,6 +96,13 @@ SERVER_KINDS = ("kill_server", "die_server", "reject_accept")
 # CRC is recorded, modelling the torn-write/bitrot damage the manifest
 # must reject at load.
 CHECKPOINT_KINDS = ("kill_worker", "trunc_checkpoint", "corrupt_checkpoint")
+# Python-side straggler injection (ROADMAP item 4): ``slow_worker=MS@
+# rank=N`` makes rank N sleep MS milliseconds of extra "compute" every
+# batch, consumed by :func:`apply_straggler` inside the step span (the
+# elastic train loop and the chaos driver both call it) — so PR 5's
+# trace_merge straggler report must NAME that exact rank by its
+# non-comm work. Never reaches the native seams either.
+STRAGGLER_KINDS = ("slow_worker",)
 # wire op codes (comm.cc kInit..kPullRows)
 OP_CODES = {
     "init": 1,
@@ -123,6 +136,14 @@ class FaultRule:
     def is_checkpoint_side(self) -> bool:
         return self.kind in CHECKPOINT_KINDS
 
+    @property
+    def is_python_side(self) -> bool:
+        """Rules consumed by Python seams (checkpoint writes, the
+        preemption guard, the straggler sleep) — the native installers
+        must skip them."""
+        return self.kind in CHECKPOINT_KINDS or \
+            self.kind in STRAGGLER_KINDS
+
 
 def parse_fault_plan(plan: str) -> list[FaultRule]:
     """Parse a ``MXNET_KVSTORE_FAULT_PLAN`` string into FaultRules.
@@ -136,11 +157,12 @@ def parse_fault_plan(plan: str) -> list[FaultRule]:
         head, *conds = directive.split("@")
         kind, _, argtxt = head.partition("=")
         kind = kind.strip()
-        if kind not in KIND_CODES and kind not in CHECKPOINT_KINDS:
+        if kind not in KIND_CODES and kind not in CHECKPOINT_KINDS \
+                and kind not in STRAGGLER_KINDS:
             raise MXNetError(
                 f"unknown fault kind {kind!r} in MXNET_KVSTORE_FAULT_PLAN "
                 f"directive {directive!r} (known: "
-                f"{sorted(KIND_CODES) + sorted(CHECKPOINT_KINDS)})")
+                f"{sorted(KIND_CODES) + sorted(CHECKPOINT_KINDS) + sorted(STRAGGLER_KINDS)})")
         rule = FaultRule(kind=kind)
         if argtxt:
             try:
@@ -155,6 +177,10 @@ def parse_fault_plan(plan: str) -> list[FaultRule]:
                 "delay_ms=500")
         elif kind == "reject_accept":
             rule.arg = 1
+        elif kind == "slow_worker":
+            raise MXNetError(
+                f"fault {directive!r}: slow_worker needs a delay in "
+                "ms, e.g. slow_worker=40@rank=1")
         for cond in conds:
             name, eq, val = cond.partition("=")
             name = name.strip()
@@ -187,12 +213,13 @@ def parse_fault_plan(plan: str) -> list[FaultRule]:
             raise MXNetError(
                 f"fault {directive!r}: batch=N only applies to "
                 "kill_worker")
-        if rule.is_checkpoint_side:
+        if rule.is_python_side:
             # the contract is fail-loudly: a condition the Python-side
             # seams never read must not be silently dropped
             allowed = {"kill_worker": ("batch", "rank"),
                        "trunc_checkpoint": ("round", "rank"),
-                       "corrupt_checkpoint": ("round", "rank")}[rule.kind]
+                       "corrupt_checkpoint": ("round", "rank"),
+                       "slow_worker": ("rank",)}[rule.kind]
             ignored = [c for c in _CONDS
                        if getattr(rule, c) is not None and c not in allowed]
             if ignored:
@@ -200,7 +227,7 @@ def parse_fault_plan(plan: str) -> list[FaultRule]:
                     f"fault {directive!r}: condition(s) {ignored} do not "
                     f"apply to {rule.kind} (allowed: {list(allowed)})")
         if (rule.round is not None and rule.op is None
-                and not rule.is_server_side and not rule.is_checkpoint_side):
+                and not rule.is_server_side and not rule.is_python_side):
             # "round" on a client rule means a BSP round, which the
             # client opens with its push
             rule.op = "push"
@@ -222,7 +249,7 @@ def install_client_rules(lib, rules, worker_rank=None):
         worker_rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
     n = 0
     for r in rules:
-        if r.is_server_side or r.is_checkpoint_side:
+        if r.is_server_side or r.is_python_side:
             continue
         if r.rank is not None and r.rank != worker_rank:
             continue
@@ -240,7 +267,7 @@ def install_server_rules(lib, rules, server_id=None):
         server_id = int(os.environ.get("DMLC_SERVER_ID", "0"))
     n = 0
     for r in rules:
-        if not r.is_server_side or r.is_checkpoint_side:
+        if not r.is_server_side or r.is_python_side:
             continue
         if r.server is not None and r.server != server_id:
             continue
@@ -300,6 +327,45 @@ class BackoffSchedule:
         self.attempts += 1
         self.total_wait_ms += wait_ms
         return wait_ms / 1000.0
+
+
+# -- straggler seam (Python-side) -----------------------------------------
+# parsed slow_worker rules cached per plan string: apply_straggler runs
+# once per training batch, so it must cost a dict probe, not a re-parse
+_STRAGGLER_CACHE = {}  # plan string -> {rank or None: delay_ms}
+
+
+def straggler_delay_ms(worker_rank=None, plan=None):
+    """Delay in ms the plan's ``slow_worker`` rules impose on this rank
+    (0.0 when none match). ``worker_rank`` defaults to DMLC_WORKER_ID;
+    ``plan`` defaults to MXNET_KVSTORE_FAULT_PLAN."""
+    if plan is None:
+        plan = os.environ.get("MXNET_KVSTORE_FAULT_PLAN", "")
+    if not plan:
+        return 0.0
+    if worker_rank is None:
+        worker_rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+    by_rank = _STRAGGLER_CACHE.get(plan)
+    if by_rank is None:
+        by_rank = {}
+        for r in parse_fault_plan(plan):
+            if r.kind == "slow_worker":
+                by_rank[r.rank] = by_rank.get(r.rank, 0) + r.arg
+        _STRAGGLER_CACHE[plan] = by_rank
+    return float(by_rank.get(int(worker_rank),
+                             by_rank.get(None, 0)))
+
+
+def apply_straggler(worker_rank=None, plan=None):
+    """Sleep this rank's ``slow_worker`` delay (inside the caller's
+    step span, so the extra wall-clock lands as COMPUTE in the
+    trace_merge per-rank breakdown — a fast peer's matching wait lands
+    as comm, which is exactly how the straggler report names the slow
+    rank). Returns the ms slept (0.0 = no matching rule)."""
+    ms = straggler_delay_ms(worker_rank, plan)
+    if ms > 0:
+        time.sleep(ms / 1000.0)
+    return ms
 
 
 @dataclass

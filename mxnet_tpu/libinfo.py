@@ -285,6 +285,35 @@ _ENV_VARS = {
         "through plain ops/quantized.py requantize+act instead of "
         "ops/pallas_kernels.quantized_conv_epilogue (default auto — "
         "the wrapper itself falls back off-chip; subgraph/rules.py)"),
+    "MXTPU_ELASTIC_DIR": (
+        "membership directory of an elastic job: workers announce "
+        "join/leave as member-<rank>.json files here and the "
+        "generation counter lives beside them (default unset = not "
+        "an elastic job; elastic/membership.py, docs/robustness.md)"),
+    "MXTPU_ELASTIC_POLL_SEC": (
+        "serving autoscaler decision period when started as a daemon "
+        "(default 2; elastic/autoscale.py)"),
+    "MXTPU_ELASTIC_MIN_REPLICAS": (
+        "autoscaler floor: scale-in never retires below this many "
+        "serving lanes (default 1; elastic/autoscale.py)"),
+    "MXTPU_ELASTIC_MAX_REPLICAS": (
+        "autoscaler ceiling before the degraded-wrap cap: scale-out "
+        "never builds past this many lanes (default 4; "
+        "elastic/autoscale.py)"),
+    "MXTPU_ELASTIC_QUEUE_HIGH": (
+        "per-replica queue-depth EWMA high watermark — sustained "
+        "pressure above queue_high x replicas scales out; the low "
+        "watermark defaults to a quarter of it (default 8; "
+        "elastic/autoscale.py)"),
+    "MXTPU_ELASTIC_P99_BUDGET_MS": (
+        "autoscaler latency budget: a windowed e2e p99 estimate "
+        "(mx_serving_latency_seconds bucket deltas) above it is "
+        "scale-out pressure; 0 disables the latency input (default "
+        "0; elastic/autoscale.py)"),
+    "MXTPU_ELASTIC_COOLDOWN_SEC": (
+        "minimum seconds between a scale event and the next "
+        "scale-in — hysteresis so bursty load cannot flap the fleet "
+        "(default 30; elastic/autoscale.py)"),
 }
 
 

@@ -118,6 +118,29 @@ def replica_devices(n, devices=None):
     return [devs[i % len(devs)] for i in range(n)], n > len(devs)
 
 
+# degraded-wrap warnings already emitted, keyed (ask, devices): the
+# serving autoscaler re-enters replica_devices on EVERY scale event,
+# and a per-call warning for the same unchanged wrap is log spam, not
+# signal — each distinct (ask, devices) combination warns exactly once
+_DEGRADE_WARNED = set()
+
+
+def should_warn_degraded(n, devices):
+    """True exactly once per (ask, devices) combination — callers that
+    log the degraded-wrap warning (serving gateway, autoscaler) gate on
+    this so a scale storm cannot re-log the same degradation."""
+    key = (int(n), tuple(str(d) for d in devices))
+    if key in _DEGRADE_WARNED:
+        return False
+    _DEGRADE_WARNED.add(key)
+    return True
+
+
+def _reset_degrade_warnings():
+    """Test hook: forget which (ask, devices) wraps already warned."""
+    _DEGRADE_WARNED.clear()
+
+
 def shard_batch(batch, mesh, axis="dp"):
     """Place a host batch onto the mesh, sharded along the leading dim.
 

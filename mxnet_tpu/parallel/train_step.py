@@ -140,6 +140,18 @@ def make_sharded_train_step(loss_fn, mesh, param_example, batch_example,
     return step, params0, opt0
 
 
+def zero_shard_leaf(leaf, dp):
+    """THE per-leaf ZeRO sharding predicate: a leaf shards over the
+    dp axis iff its leading dimension divides evenly and is at least
+    dp; tiny or indivisible leaves stay replicated (they are the
+    cheap ones). One shared implementation — make_zero_train_step
+    places by it and elastic/reshard derives its post-reshape census
+    EXPECTATION from it, so the contract being verified and the rule
+    doing the placing cannot silently drift apart."""
+    shape = getattr(leaf, "shape", ())
+    return len(shape) >= 1 and shape[0] % dp == 0 and shape[0] >= dp
+
+
 def make_zero_train_step(loss_fn, mesh, param_example, batch_example,
                          batch_specs=P("dp"), lr=0.01, momentum=0.9,
                          dp_axis="dp", donate=True, stage=1):
@@ -173,11 +185,7 @@ def make_zero_train_step(loss_fn, mesh, param_example, batch_example,
     dp = mesh.shape[dp_axis]
 
     def _shard_spec(p):
-        # shard the leading axis across dp where it divides; tiny or
-        # indivisible leaves stay replicated (they are the cheap ones)
-        if p.ndim >= 1 and p.shape[0] % dp == 0 and p.shape[0] >= dp:
-            return P(dp_axis)
-        return P()
+        return P(dp_axis) if zero_shard_leaf(p, dp) else P()
 
     sharded = jax.tree_util.tree_map(_shard_spec, param_example)
     return make_sharded_train_step(

@@ -951,18 +951,14 @@ def _json_sanitize(v):
 
 
 # -- drift fingerprints -----------------------------------------------------
-def fingerprint_params(tree, digest_size=16):
-    """blake2b digest over a deterministically-ordered flatten of a
-    parameter pytree (dict/list/tuple of NDArray / jax / numpy
-    leaves). Leaf paths sort lexicographically, and each leaf
-    contributes path + shape + dtype + raw bytes, so two trees
-    fingerprint equal iff they hold bit-identical values under the
-    same names — the shared vocabulary for bit-identical-resume,
-    chaos bounded-drift, and cross-backend consistency rows.
-    Materializes every leaf to host: a checkpoint/verify-time API,
-    never a per-step one."""
-    import numpy as np
-
+def iter_named_leaves(tree):
+    """Deterministically-ordered ``[(path, leaf), ...]`` flatten of a
+    dict/list/tuple pytree (None leaves skipped, paths "/"-joined and
+    sorted lexicographically). THE one canonical walk: fingerprints
+    hash it and the elastic checkpoint/reshard substrate keys its
+    payload with it, so a checkpoint's keys and a fingerprint's paths
+    agree by construction — two implementations could drift apart and
+    silently break the bit-identical-resume contract."""
     leaves = []
 
     def walk(node, path):
@@ -979,6 +975,22 @@ def fingerprint_params(tree, digest_size=16):
 
     walk(tree, ())
     leaves.sort(key=lambda kv: kv[0])
+    return leaves
+
+
+def fingerprint_params(tree, digest_size=16):
+    """blake2b digest over a deterministically-ordered flatten of a
+    parameter pytree (dict/list/tuple of NDArray / jax / numpy
+    leaves). Leaf paths sort lexicographically, and each leaf
+    contributes path + shape + dtype + raw bytes, so two trees
+    fingerprint equal iff they hold bit-identical values under the
+    same names — the shared vocabulary for bit-identical-resume,
+    chaos bounded-drift, and cross-backend consistency rows.
+    Materializes every leaf to host: a checkpoint/verify-time API,
+    never a per-step one."""
+    import numpy as np
+
+    leaves = iter_named_leaves(tree)
     h = hashlib.blake2b(digest_size=int(digest_size))
     for path, leaf in leaves:
         data = getattr(leaf, "_data", leaf)

@@ -255,6 +255,12 @@ class Model:
         self._rows_per_s = None   # EWMA serviced rows/s
         self.warmup_seconds = 0.0
         self.executables = 0
+        self.degraded = False     # replicas wrap onto shared devices
+        # elasticity seams (Gateway.scale): a factory that builds one
+        # more replica lane on a device, and a monotonic lane id so a
+        # retired idx is never reissued to a different lane's gauges
+        self._replica_factory = None
+        self._next_idx = 0
 
     # -- service-rate estimation --------------------------------------------
     def _observe_rate(self, rows, exec_s):
@@ -385,19 +391,30 @@ class Gateway:
 
     # -- registration --------------------------------------------------------
     def _pick_devices(self, n):
-        from ..parallel.mesh import replica_devices
+        from ..parallel.mesh import replica_devices, should_warn_degraded
         # self._devices None = the full local mesh, re-read per
         # registration (a constructor-pinned pool stays pinned)
         picked, degraded = replica_devices(n, devices=self._devices)
-        if degraded:
+        if degraded and should_warn_degraded(n, picked):
             # SNIPPETS [2] degrade pattern (parallel/mesh.py): serve
             # with the mesh that exists instead of refusing — replicas
-            # wrap around onto shared devices
+            # wrap around onto shared devices. Warned ONCE per (ask,
+            # devices): the autoscaler re-enters this on every scale
+            # event, and stats() carries the degraded flag so it can
+            # stop asking instead of re-triggering the wrap
             logger.warning(
                 "serving: %d replicas requested but only %d local "
                 "device(s); degrading (replicas share devices)",
                 n, len(set(map(str, picked))))
-        return picked
+        return picked, degraded
+
+    def device_count(self):
+        """Distinct devices available to replica placement — the
+        autoscaler's non-degraded ceiling."""
+        import jax
+        devs = self._devices if self._devices is not None \
+            else jax.local_devices()
+        return len(devs)
 
     def register(self, name, symbol, arg_params, aux_params,
                  input_shapes, variants=("fp32",), calib_data=None,
@@ -460,7 +477,12 @@ class Gateway:
                       variants=tuple(variants))
         t0 = clock.now_ns()
         met = _met()
-        for idx, device in enumerate(self._pick_devices(replicas)):
+
+        def build_replica(m, idx, device):
+            # the one place a serving lane is built — registration and
+            # Gateway.scale (the elasticity plane) share it, so a
+            # scaled-out replica is compiled/warmed exactly like a
+            # registered one
             vs = VariantSet(symbol, arg_params, aux_params, input_name,
                             feature_shape, variants=variants,
                             device=device, calib_data=calib_data,
@@ -468,10 +490,18 @@ class Gateway:
                             excluded_sym_names=excluded_sym_names,
                             input_dtype=input_dtype,
                             int8_lowering=int8_lowering)
-            rep = Replica(model, idx, device, vs)
-            if warmup:
-                model.executables += vs.warmup(buckets)
+            rep = Replica(m, idx, device, vs)
+            executables = vs.warmup(buckets) if warmup else 0
+            return rep, executables
+
+        model._replica_factory = build_replica
+        picked, degraded = self._pick_devices(replicas)
+        model.degraded = degraded
+        for idx, device in enumerate(picked):
+            rep, n_exec = build_replica(model, idx, device)
+            model.executables += n_exec
             model.replicas.append(rep)
+        model._next_idx = len(model.replicas)
         model.warmup_seconds = (clock.now_ns() - t0) / 1e9
         self.registry.add(model)
         # gauges + lanes only once registration is committed: a build
@@ -551,13 +581,15 @@ class Gateway:
         if name in self.registry.names():
             raise ServingError(
                 f"serving: model {name!r} already registered")
+        gen_devices, gen_degraded = self._pick_devices(replicas)
         gen = GenModel(name, decoder,
-                       devices=self._pick_devices(replicas),
+                       devices=gen_devices,
                        block_tokens=block_tokens,
                        max_blocks=max_blocks,
                        max_new_tokens=max_new_tokens,
                        max_decode_batch=max_decode_batch,
                        max_queue=max_queue, warmup=warmup)
+        gen.degraded = gen_degraded
         # re-check BOTH namespaces at insert: a concurrent register()
         # or register_generator() of the same name can have landed
         # while this one paid warmup
@@ -780,6 +812,108 @@ class Gateway:
         return {m.name: [r.healthy for r in m.replicas]
                 for m in self.registry.models()}
 
+    # -- elasticity (replica scaling) ----------------------------------------
+    def replica_count(self, name):
+        """Current serving lanes for a model or generator (retiring
+        generator lanes excluded — they take no new work)."""
+        with self._gen_lock:
+            gen = self._generators.get(name)
+        if gen is not None:
+            with gen.cond:
+                return sum(1 for ln in gen.lanes if not ln.retiring)
+        return len(self.registry.get(name).replicas)
+
+    def scale(self, name, replicas):
+        """Resize a registered model (or generator) to ``replicas``
+        serving lanes — the elasticity plane's mechanism seam
+        (elastic/autoscale.py is the policy). Scale-out builds, warms,
+        and starts fresh lanes through the same factory registration
+        used; scale-in drains before retiring: a retired lane stops
+        taking new batches, finishes (or hands back) its in-flight
+        work, and only then leaves the lane list. Generator lanes
+        additionally release their paged KV block pool on retire
+        (census-verifiable: the role=kv_cache bytes drop by the
+        pool's footprint). Returns a bounded report dict."""
+        if self._closed:
+            raise ServingError("serving: gateway is closed")
+        n = int(replicas)
+        if n < 1:
+            raise ServingError(
+                f"serving: cannot scale {name!r} below 1 replica "
+                f"(asked {n}); use unregister() to remove the model")
+        with self._gen_lock:
+            gen = self._generators.get(name)
+        if gen is not None:
+            picked, degraded = self._pick_devices(n)
+            report = gen.scale_to(n, picked)
+            gen.degraded = degraded
+            report["degraded"] = degraded
+            return report
+        m = self.registry.get(name)
+        cur = len(m.replicas)
+        report = {"model": name, "from": cur, "to": n,
+                  "added": 0, "retired": 0}
+        if n == cur:
+            return report
+        with tracing.span("elastic.scale", cat="elastic", model=name,
+                          direction="out" if n > cur else "in",
+                          replicas_from=cur, replicas_to=n):
+            if n > cur:
+                picked, degraded = self._pick_devices(n)
+                m.degraded = degraded
+                report["degraded"] = degraded
+                met = _met()
+                for device in picked[cur:]:
+                    idx = m._next_idx
+                    m._next_idx += 1
+                    rep, n_exec = m._replica_factory(m, idx, device)
+                    m.executables += n_exec
+                    m.replicas.append(rep)
+                    met["healthy"].labels(model=name,
+                                          replica=str(idx)).set(1)
+                    rep.start()
+                    report["added"] += 1
+            else:
+                # retire drained/unhealthy lanes FIRST (retiring the
+                # only healthy lane would wedge the model behind dead
+                # schedulers), then the newest healthy ones — the
+                # oldest carry the longest-warmed executables and the
+                # steadiest EWMAs
+                doomed = sorted(
+                    m.replicas,
+                    key=lambda r: (r.healthy, -r.idx))[:cur - n]
+                for rep in doomed:
+                    self._retire_replica(m, rep)
+                    report["retired"] += 1
+                # shrinking can also UN-degrade: stats() must reflect
+                # the new width or the autoscaler never asks again
+                m.degraded = n > self.device_count()
+                report["degraded"] = m.degraded
+        return report
+
+    def _retire_replica(self, m, rep):
+        """Drain-before-retire (the PR-10 drain seam, minus the
+        failure): the lane stops at its next take_batch wakeup — a
+        batch it already pulled is requeued to survivors, a batch it
+        is mid-executing completes and replies normally — and the
+        lane leaves the list immediately so admission, poison
+        accounting, and health all see the new width."""
+        with tracing.span("elastic.drain", cat="elastic", model=m.name,
+                          replica=rep.idx):
+            rep.healthy = False
+            rep.last_error = ServingError(
+                f"serving: replica {rep.idx} of {m.name!r} retired by "
+                "scale-in")
+            rep._gen += 1   # a parked scheduler hands its batch back
+            if rep in m.replicas:
+                m.replicas.remove(rep)
+            _met()["healthy"].labels(model=m.name,
+                                     replica=str(rep.idx)).set(0)
+            # best-effort join: a lane parked in take_batch on an idle
+            # queue exits at its next wakeup (daemon thread, reaped by
+            # the interpreter) — retirement must not block on traffic
+            rep.join(timeout=0.5)
+
     def stats(self):
         """Bounded per-model snapshot (queue depth, service-rate
         estimates, replica states, executables compiled)."""
@@ -798,6 +932,11 @@ class Gateway:
                 "replicas": [
                     {"idx": r.idx, "device": str(r.device),
                      "healthy": r.healthy} for r in m.replicas],
+                # the degraded-wrap flag (replicas sharing devices):
+                # the autoscaler reads it to stop asking for lanes the
+                # hardware cannot isolate (satellite of the mesh
+                # warning dedupe — warn once, expose the state here)
+                "degraded": m.degraded,
                 "int8_lowering": (m.replicas[0].variant_set
                                   .int8_lowering if m.replicas
                                   else None),

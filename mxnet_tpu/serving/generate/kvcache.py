@@ -79,6 +79,7 @@ class BlockPool:
         # (their pool pages are the warmest)
         self._free = list(range(self.max_blocks - 1, 0, -1))
         self._reserved = 0
+        self.closed = False
 
     # -- sizes ---------------------------------------------------------------
     @property
@@ -88,7 +89,11 @@ class BlockPool:
     @property
     def bytes_total(self):
         """Actual device bytes of the pool (both arrays) — the number
-        the census must agree with."""
+        the census must agree with. 0 once the pool is closed (the
+        arrays are released; the census kv_cache gauge must drop by
+        exactly the pre-close footprint)."""
+        if self.closed:
+            return 0
         return int(self.k.nbytes) + int(self.v.nbytes)
 
     @property
@@ -107,7 +112,7 @@ class BlockPool:
         cannot cover it (the caller fast-rejects ``kv_cache_full``)."""
         n = int(nblocks)
         with self._lock:
-            if self._reserved + n > self.usable_blocks:
+            if self.closed or self._reserved + n > self.usable_blocks:
                 return False
             self._reserved += n
             return True
@@ -121,6 +126,11 @@ class BlockPool:
         """Pop ``n`` block ids. A reservation-covered request can never
         see an empty free list; hitting one is a ledger bug, not load."""
         with self._lock:
+            if self.closed:
+                raise MXNetError(
+                    "generate: alloc on a closed block pool — the "
+                    "lane was retired with work still admitted "
+                    "(accounting bug)")
             if n > len(self._free):
                 raise MXNetError(
                     "generate: block pool exhausted (%d asked, %d free) "
@@ -151,7 +161,8 @@ class BlockPool:
         with self._lock:
             free = len(self._free)
             reserved = self._reserved
-        used = self.usable_blocks - free
+            closed = self.closed
+        used = 0 if closed else self.usable_blocks - free
         return {
             "block_tokens": self.block_tokens,
             "usable_blocks": self.usable_blocks,
@@ -161,6 +172,7 @@ class BlockPool:
             "used_frac": used / self.usable_blocks,
             "bytes_total": self.bytes_total,
             "bytes_per_block": self.bytes_per_block,
+            "closed": closed,
         }
 
     def swap(self, k, v):
@@ -171,6 +183,22 @@ class BlockPool:
         from ...profiling import memory as _mem
         self.k = _mem.tag_role(k, "kv_cache")
         self.v = _mem.tag_role(v, "kv_cache")
+
+    def close(self):
+        """Release the pool's device arrays (lane retire, elastic
+        scale-in). The K/V buffers drop their last in-pool reference
+        here, so once the retired lane's compiled steps are gone the
+        census role=kv_cache bytes fall by exactly ``bytes_total`` —
+        the number elastic scale-in verifies. Idempotent; any later
+        alloc/reserve is a ledger bug and raises."""
+        with self._lock:
+            if self.closed:
+                return
+            self.closed = True
+            self.k = None
+            self.v = None
+            self._free = []
+            self._reserved = 0
 
 
 class BlockTable:

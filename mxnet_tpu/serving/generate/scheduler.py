@@ -66,6 +66,13 @@ _met = _tm.lazy_metrics(lambda reg: {
         "mx_serving_generate_inflight",
         "requests in the running decode batch",
         labelnames=("model", "lane")),
+    # SAME family the one-shot gateway writes: the elastic autoscaler
+    # reads mx_serving_queue_depth{model} for its pressure signal, and
+    # a generator that never wrote it would read as eternally idle —
+    # the policy would drain healthy decode lanes under load
+    "depth": reg.gauge(
+        "mx_serving_queue_depth",
+        "requests pending in the model queue", labelnames=("model",)),
     "batch_rows": reg.histogram(
         "mx_serving_generate_batch_rows",
         "running requests per decode step", labelnames=("model",),
@@ -177,6 +184,11 @@ class GenLane:
         self.waiting = deque()
         self.running = []
         self._thread = None
+        # elastic scale-in: a retiring lane takes no new admissions,
+        # drains its waiting+running requests normally, then exits so
+        # the pool can be released (drain-before-retire)
+        self.retiring = False
+        self.finalized = False   # pool closed + lane removed (once)
 
     def start(self):
         self._thread = threading.Thread(
@@ -194,15 +206,31 @@ class GenLane:
         while True:
             with m.cond:
                 while not self.waiting and not self.running \
-                        and not m.closed:
+                        and not m.closed and not self.retiring:
                     m.cond.wait()
                 if m.closed:
                     break
+                if self.retiring and not self.waiting \
+                        and not self.running:
+                    drained = True
+                else:
+                    drained = False
+            if drained:
+                # drained: every admitted request finished and
+                # released its blocks. Finalize OURSELVES (outside
+                # the cond lock): the scale-in initiator may have
+                # given up on its join timeout long ago, and a pool
+                # nobody closes is a permanent HBM leak
+                m._finalize_retired_lane(self)
+                return
+            with m.cond:
                 admit = []
                 while self.waiting and \
                         len(self.running) + len(admit) < \
                         m.max_decode_batch:
                     admit.append(self.waiting.popleft())
+            if admit:
+                m._observe_depth()     # the waiting set just shrank
             try:
                 for req in admit:
                     self._prefill(req)
@@ -224,10 +252,11 @@ class GenLane:
                 [r for r in extra if not r.done()]
             self.running = []
             self.waiting.clear()
-        # the gauge was last set with a live batch — a failed/closed
+        # the gauges were last set with a live batch — a failed/closed
         # lane must read 0, not its final batch size forever
         _met()["inflight"].labels(model=m.name,
                                   lane=str(self.idx)).set(0)
+        m._observe_depth()
         seen = set()
         for req in doomed:
             # an admitted request can sit in both `running` and
@@ -412,21 +441,34 @@ class GenModel:
         self.lanes = []
         self.warmup_seconds = 0.0
         self.executables = 0
+        self.degraded = False
+        self._warmup_lanes = bool(warmup)
+        self._next_idx = 0
         t0 = clock.now_ns()
-        from .model import CompiledDecodeSteps
-        for idx, device in enumerate(devices):
-            pool = BlockPool(decoder.num_layers, decoder.num_heads,
-                             decoder.head_dim, bt, self.max_blocks,
-                             device=device, dtype=decoder.dtype)
-            steps = CompiledDecodeSteps(decoder, pool,
-                                        self.table_width, device)
-            lane = GenLane(self, idx, device, steps, pool)
-            if warmup:
-                self.executables += self._warmup(lane)
-            self.lanes.append(lane)
+        for device in devices:
+            self.lanes.append(self._build_lane(device))
         self.warmup_seconds = (clock.now_ns() - t0) / 1e9
         for lane in self.lanes:
             lane.start()
+
+    def _build_lane(self, device):
+        """One decode lane (pool + compiled steps + scheduler), warmed
+        when the model warms — registration and elastic scale-out
+        share this, so a scaled-out lane is AOT-compiled exactly like
+        a registered one. The caller starts it."""
+        from .model import CompiledDecodeSteps
+        pool = BlockPool(self.decoder.num_layers,
+                         self.decoder.num_heads,
+                         self.decoder.head_dim, self.block_tokens,
+                         self.max_blocks, device=device,
+                         dtype=self.decoder.dtype)
+        steps = CompiledDecodeSteps(self.decoder, pool,
+                                    self.table_width, device)
+        lane = GenLane(self, self._next_idx, device, steps, pool)
+        self._next_idx += 1
+        if self._warmup_lanes:
+            self.executables += self._warmup(lane)
+        return lane
 
     def _warmup(self, lane):
         """AOT-compile every (prefill pad, decode bucket) executable
@@ -454,14 +496,19 @@ class GenModel:
             return "closed"
         with self.cond:
             depth = sum(len(ln.waiting) for ln in self.lanes)
+            # retiring lanes drain, they do not admit — their pools
+            # are about to be released
+            live = [ln for ln in self.lanes if not ln.retiring]
+        if not live:
+            return "closed"
         if depth >= self.max_queue:
             return "queue_full"
-        need = self.lanes[0].pool.blocks_for(
+        need = live[0].pool.blocks_for(
             len(req.prompt) + req.max_new_tokens)
         # most-headroom lane first; reservation is atomic per pool, so
         # a racing submit simply falls through to the next lane
         order = sorted(
-            self.lanes,
+            live,
             key=lambda ln: ln.pool.reserved_blocks())
         for lane in order:
             if lane.pool.reserve(need):
@@ -471,12 +518,105 @@ class GenModel:
                         lane.pool.unreserve(need)
                         req.reserved_blocks = 0
                         return "closed"
+                    if lane.retiring:
+                        # scale-in landed between the reserve and the
+                        # enqueue: hand the budget back and try the
+                        # next lane
+                        lane.pool.unreserve(need)
+                        req.reserved_blocks = 0
+                        continue
                     lane.waiting.append(req)
                     self.cond.notify_all()
+                self._observe_depth()
                 return None
         return "kv_cache_full"
 
+    def _observe_depth(self):
+        """Publish the waiting count on the shared queue-depth gauge
+        (host ints under the cond lock — MXL002-safe)."""
+        with self.cond:
+            depth = sum(len(ln.waiting) for ln in self.lanes)
+        _met()["depth"].labels(model=self.name).set(depth)
+
     # -- lifecycle -----------------------------------------------------------
+    def scale_to(self, n, devices, drain_timeout=30.0):
+        """Resize to ``n`` decode lanes (Gateway.scale's generator
+        arm). ``devices`` is the full n-lane placement (the gateway's
+        picker output). Scale-out builds + warms + starts fresh lanes;
+        scale-in retires the newest lanes drain-first: each stops
+        admitting, finishes its waiting+running requests, and releases
+        its KV block pool — the census role=kv_cache bytes drop by
+        exactly the retired pools' footprint."""
+        n = int(n)
+        if n < 1:
+            raise ServingError(
+                f"generate: cannot scale {self.name!r} below 1 lane")
+        with self.cond:
+            active = [ln for ln in self.lanes if not ln.retiring]
+        report = {"model": self.name, "from": len(active), "to": n,
+                  "added": 0, "retired": 0, "freed_bytes": 0}
+        if n > len(active):
+            for device in devices[len(active):n]:
+                lane = self._build_lane(device)
+                with self.cond:
+                    self.lanes.append(lane)
+                lane.start()
+                report["added"] += 1
+        elif n < len(active):
+            for lane in active[n:]:
+                report["freed_bytes"] += self._retire_lane(
+                    lane, timeout=drain_timeout)
+                report["retired"] += 1
+        return report
+
+    def _retire_lane(self, lane, timeout=30.0):
+        """Drain-before-retire one lane; returns the pool bytes
+        released. The lane keeps decoding until its admitted requests
+        finish (their reservations release with them), then exits and
+        finalizes. A lane that cannot drain within ``timeout`` stays
+        retiring (no new work) with its pool intact — closing storage
+        under an in-flight decode would corrupt live requests — and
+        finalizes ITSELF the moment it drains (the lane loop's
+        drained branch), so a timed-out initiator never leaks the
+        pool."""
+        from ... import tracing
+        with tracing.span("elastic.drain", cat="elastic",
+                          model=self.name, lane=lane.idx):
+            pending = lane.pool.bytes_total
+            with self.cond:
+                lane.retiring = True
+                self.cond.notify_all()
+            lane.join(timeout)
+            if lane._thread is not None and lane._thread.is_alive():
+                return 0   # still draining: the lane self-finalizes
+            # the lane thread usually finalized itself on its way
+            # out; this call is the idempotent backstop (and the
+            # whole release for lanes retired before ever starting)
+            self._finalize_retired_lane(lane)
+            return pending
+
+    def _finalize_retired_lane(self, lane):
+        """Close the retired lane's pool, drop it from the lane list,
+        zero its gauges — exactly once, no matter whether the
+        initiator's join or the lane thread's own drained-exit gets
+        here first."""
+        with self.cond:
+            if lane.finalized:
+                return 0
+            lane.finalized = True
+        freed = lane.pool.bytes_total
+        lane.pool.close()
+        with self.cond:
+            if lane in self.lanes:
+                self.lanes.remove(lane)
+        met = _met()
+        ln = str(lane.idx)
+        for state in ("used", "free", "reserved"):
+            met["cache_blocks"].labels(
+                model=self.name, lane=ln, state=state).set(0)
+        met["inflight"].labels(model=self.name, lane=ln).set(0)
+        return freed
+
     def close(self):
         with self.cond:
             self.closed = True
@@ -499,8 +639,10 @@ class GenModel:
             "table_width": self.table_width,
             "executables": self.executables,
             "warmup_seconds": round(self.warmup_seconds, 3),
+            "degraded": self.degraded,
             "lanes": [
                 {"idx": ln.idx, "device": str(ln.device),
+                 "retiring": ln.retiring,
                  "pool": ln.pool.occupancy()} for ln in self.lanes],
         }
 

@@ -1,0 +1,29 @@
+"""Storm-test worker body: announce into a membership directory and
+park until killed. Usage: elastic_storm_worker.py <dir> <rank>.
+
+Spawned by tests/test_elastic_chaos.py's multi-process storm scenario
+(slow): the parent SIGKILLs one of these mid-park, then asserts the
+pid-liveness path classifies it dead and a reap converges the
+generation — the real-process twin of the in-process tier-1 slice.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from mxnet_tpu.elastic.membership import Membership  # noqa: E402
+
+
+def main():
+    dirpath, rank = sys.argv[1], int(sys.argv[2])
+    m = Membership(dirpath, rank=rank)
+    m.announce(meta={"worker": "storm-test"})
+    # park: the storm SIGKILLs us with no chance to say goodbye
+    while True:
+        time.sleep(0.2)
+
+
+if __name__ == "__main__":
+    main()

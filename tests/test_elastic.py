@@ -1,0 +1,764 @@
+"""Elasticity plane tests (elastic/): generation-numbered membership,
+checkpoint-reshard round trips (ZeRO-2 dp=4 -> dp=2 / dp=8 with
+census-verified 1/dp), the slow_worker straggler fault named
+end-to-end by trace_merge, Gateway.scale drain-before-retire for both
+one-shot replicas and generator lanes (KV pool released +
+census-verified), the telemetry-driven Autoscaler policy on a fake
+gateway + fake clock, and the perf_gate --chaos self-test over the
+committed artifact plus synthetic regressions."""
+import copy
+import gc
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.elastic import (Autoscaler, ElasticTrainer, Membership,
+                               histogram_window_p99, named_leaves,
+                               unflatten_like, zero_shard_spec)
+from mxnet_tpu.kvstore import fault
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHAOS_ARTIFACT = os.path.join(REPO, "docs", "artifacts",
+                              "CHAOS_LAST_GOOD.json")
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import perf_gate  # noqa: E402
+
+sys.path.pop(0)
+
+
+# ===================================================================
+# membership
+# ===================================================================
+def test_membership_announce_poll_leave(tmp_path):
+    a = Membership(tmp_path, rank=0)
+    b = Membership(tmp_path, rank=1)
+    g0 = a.announce()
+    g1 = b.announce()
+    assert g1 > g0
+    view, changed = a.poll()
+    assert not changed                 # first poll = baseline
+    assert view.alive == (0, 1) and view.world_size == 2
+    assert view.generation == g1
+    # a leave is a change the OTHER handle observes
+    g2 = b.leave()
+    view, changed = a.poll()
+    assert changed and view.alive == (0,) and view.generation == g2
+
+
+def test_membership_mark_dead_and_reap(tmp_path):
+    a = Membership(tmp_path, rank=0)
+    b = Membership(tmp_path, rank=1)
+    a.announce()
+    b.announce()
+    a.poll()
+    a.mark_dead(1)
+    view, changed = a.poll(reap=True)
+    assert changed
+    assert view.alive == (0,)
+    assert 1 not in view.members       # the stale file was reaped
+    # reap bumped the generation: a second poller converges on it
+    view_b, _ = b.poll()
+    assert view_b.generation == view.generation
+
+
+def test_membership_dead_pid_detected(tmp_path):
+    """A SIGKILL'd worker leaves a member file naming a pid that no
+    longer runs — the pid-liveness check classifies it dead without
+    any goodbye protocol."""
+    a = Membership(tmp_path, rank=0)
+    a.announce()
+    ghost = Membership(tmp_path, rank=7)
+    ghost.announce(pid=2 ** 22 + os.getpid())   # no such pid
+    view = a.view()
+    assert 7 in view.dead and view.alive == (0,)
+    view, changed = a.poll(reap=True)
+    assert view.alive == (0,) and 7 not in view.members
+
+
+def test_membership_stale_generation_lock_stolen(tmp_path):
+    """A crashed bumper's leftover GENERATION.lock must be stolen
+    (wall-clock staleness — regression: a monotonic-vs-epoch clock
+    mix-up made the steal never fire), and announce() proceeds."""
+    a = Membership(tmp_path, rank=0)
+    lock = tmp_path / "GENERATION.lock"
+    lock.write_text("")
+    old = time.time() - 120
+    os.utime(lock, (old, old))
+    g = a.announce()                   # steals the stale lock
+    assert g >= 1 and not lock.exists()
+
+
+def test_membership_torn_file_ignored(tmp_path):
+    a = Membership(tmp_path, rank=0)
+    a.announce()
+    (tmp_path / "member-3.json").write_text("{half a json")
+    view = a.view()
+    assert view.alive == (0,)          # torn announce: next poll sees it
+
+
+# ===================================================================
+# reshard units
+# ===================================================================
+def test_named_leaves_unflatten_round_trip():
+    tree = {"b": np.arange(4, dtype=np.float32),
+            "a": {"x": np.ones((2, 3), np.float32)},
+            "c": [np.zeros(2, np.float32), np.full(3, 7.0, np.float32)]}
+    flat = named_leaves(tree)
+    assert sorted(flat) == ["a/x", "b", "c/0", "c/1"]
+    rebuilt = unflatten_like(flat, tree)
+    for k in flat:
+        np.testing.assert_array_equal(flat[k],
+                                      named_leaves(rebuilt)[k])
+    with pytest.raises(MXNetError):
+        unflatten_like({"b": flat["b"]}, tree)   # missing leaves
+
+
+def test_zero_shard_spec_rule():
+    dp = 4
+    assert zero_shard_spec(np.zeros((8, 3)), dp)
+    assert not zero_shard_spec(np.zeros((6, 3)), dp)    # indivisible
+    assert not zero_shard_spec(np.zeros((2,)), dp)      # too small
+    assert not zero_shard_spec(np.float32(1.0), dp)     # scalar
+
+
+def _mlp_fixture(seed=3, din=16, hidden=32, dout=8, batch=16):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    params = {"w1": rng.normal(0, 0.1, (din, hidden)).astype(np.float32),
+              "b1": np.zeros(hidden, np.float32),
+              "w2": rng.normal(0, 0.1, (hidden, dout)).astype(np.float32),
+              "b2": np.zeros(dout, np.float32)}
+    X = rng.normal(0, 1, (batch, din)).astype(np.float32)
+    Y = rng.normal(0, 1, (batch, dout)).astype(np.float32)
+
+    def loss_fn(p, b):
+        d, l = b
+        h = jnp.maximum(d @ p["w1"] + p["b1"], 0.0)
+        return jnp.mean((h @ p["w2"] + p["b2"] - l) ** 2)
+
+    return params, loss_fn, (X, Y)
+
+
+def test_checkpoint_reshard_round_trips(tmp_path):
+    """Satellite: ZeRO-2 state saved at dp=4 restored onto dp=2 (merge
+    path) and dp=8 (split path). Restored values must equal the fresh
+    gather/scatter reference — the host values the dp=4 run gathered —
+    bit for bit, and the census must re-prove 1/dp at each new world,
+    roles surviving."""
+    import jax
+
+    from mxnet_tpu.checkpoint import CheckpointManager
+    from mxnet_tpu.elastic.reshard import to_host
+
+    devs = jax.local_devices()
+    assert len(devs) >= 8, "conftest provisions the 8-device mesh"
+    params, loss_fn, batch = _mlp_fixture()
+    src = ElasticTrainer(loss_fn, params, batch, lr=0.05,
+                         momentum=0.9, stage=2).build(devs[:4])
+    for _ in range(2):
+        src.train_step(batch)
+    ref_params = to_host(src.params)     # the gather reference
+    ref_opt = to_host(src.opt)
+    manager = CheckpointManager(tmp_path / "ck")
+    src.save(manager, step=2)
+
+    for dp in (2, 8):
+        dst = ElasticTrainer(loss_fn, params, batch, lr=0.05,
+                             momentum=0.9, stage=2)
+        extra = dst.restore(manager, devs[:dp])
+        assert extra["world_size"] == 4 and extra["stage"] == 2
+        assert dst.steps_done == 2
+        # values: restored-and-scattered == the gathered reference
+        for k, v in named_leaves(to_host(dst.params)).items():
+            np.testing.assert_array_equal(
+                v, named_leaves(ref_params)[k], err_msg=f"params {k}")
+        for k, v in named_leaves(to_host(dst.opt)).items():
+            np.testing.assert_array_equal(
+                v, named_leaves(ref_opt)[k], err_msg=f"opt {k}")
+        # census: 1/dp per device at the NEW world, roles surviving
+        report = dst.census_check()
+        if not report.get("disabled"):
+            assert report["dp"] == dp
+            state = report["roles"]["optimizer_state"]
+            assert state["per_device_bytes"] == \
+                [state["expected_bytes"]]
+        # and the restored state trains at the new world
+        dst.train_step(batch)
+
+
+def test_reshape_in_memory_census_and_world_gauge():
+    import jax
+
+    from mxnet_tpu.telemetry import metrics as _tm
+
+    params, loss_fn, batch = _mlp_fixture(seed=5)
+    devs = jax.local_devices()
+    tr = ElasticTrainer(loss_fn, params, batch, stage=2).build(devs[:8])
+    tr.train_step(batch)
+    report = tr.reshape(devs[:4])
+    assert report["dp"] == 4 if not report.get("disabled") else True
+    tr.train_step(batch)
+    assert tr.dp == 4
+    assert _tm.registry().value("mx_elastic_world_size") == 4
+    # reshapes counted
+    assert _tm.registry().value("mx_elastic_reshapes_total",
+                                outcome="ok") >= 1
+
+
+# ===================================================================
+# straggler fault kind (satellite)
+# ===================================================================
+def test_slow_worker_plan_parsing():
+    rules = fault.parse_fault_plan("slow_worker=40@rank=1")
+    assert rules[0].kind == "slow_worker" and rules[0].arg == 40 \
+        and rules[0].rank == 1
+    assert rules[0].is_python_side and not rules[0].is_server_side
+    with pytest.raises(MXNetError):        # needs a delay value
+        fault.parse_fault_plan("slow_worker@rank=1")
+    with pytest.raises(MXNetError):        # round does not apply
+        fault.parse_fault_plan("slow_worker=40@round=2")
+
+
+def test_slow_worker_never_reaches_native_installers():
+    calls = []
+
+    class Lib:
+        def mxtpu_fault_client_add(self, *a):
+            calls.append(a)
+
+        mxtpu_fault_server_add = mxtpu_fault_client_add
+
+    rules = fault.parse_fault_plan(
+        "slow_worker=40@rank=1;delay_ms=5@key=0")
+    assert fault.install_client_rules(Lib(), rules, worker_rank=1) == 1
+    assert len(calls) == 1                 # only delay_ms installed
+    assert fault.install_server_rules(Lib(), rules) == 0
+
+
+def test_apply_straggler_env_and_rank_filter(monkeypatch):
+    monkeypatch.setenv("MXNET_KVSTORE_FAULT_PLAN",
+                       "slow_worker=30@rank=1")
+    assert fault.straggler_delay_ms(0) == 0.0
+    assert fault.straggler_delay_ms(1) == 30.0
+    t0 = time.perf_counter()
+    assert fault.apply_straggler(1) == 30.0
+    assert time.perf_counter() - t0 >= 0.025
+    assert fault.apply_straggler(0) == 0.0
+
+
+def test_injected_straggler_named_by_trace_merge():
+    """Satellite acceptance: a slow_worker@rank=N fault plan drives a
+    2-rank kvstore run and PR 5's straggler report names that exact
+    rank — end to end through the native wire + trace merge."""
+    from mxnet_tpu.elastic import chaos
+
+    s = chaos.run_straggler(delay_ms=25, steps=2)
+    assert s["named_ok"] is True
+    assert s["named_rank"] == s["injected_rank"] == "worker1"
+    assert s["named_every_step"] is True
+
+
+# ===================================================================
+# autoscaler policy (fake gateway + fake clock)
+# ===================================================================
+class _FakeGateway:
+    def __init__(self, replicas=1, devices=4):
+        self.n = replicas
+        self.devices = devices
+        self.calls = []
+
+    def replica_count(self, name):
+        return self.n
+
+    def device_count(self):
+        return self.devices
+
+    def scale(self, name, n):
+        self.calls.append(n)
+        self.n = n
+        return {"to": n}
+
+
+def _set_depth(model, depth):
+    from mxnet_tpu.telemetry import metrics as _tm
+    _tm.registry().gauge(
+        "mx_serving_queue_depth",
+        "requests pending in the model queue",
+        labelnames=("model",)).labels(model=model).set(depth)
+
+
+def test_autoscaler_scale_out_on_sustained_queue_growth():
+    gw = _FakeGateway(replicas=1)
+    clock = [0.0]
+    sc = Autoscaler(gw, "fake_out", min_replicas=1, max_replicas=3,
+                    queue_high=4.0, sustain=2, cooldown_s=10.0,
+                    ewma=1.0, clock=lambda: clock[0])
+    _set_depth("fake_out", 1.0)
+    assert sc.tick()[0] == "hold"          # below watermark
+    _set_depth("fake_out", 40.0)
+    assert sc.tick()[0] == "hold"          # hot once — not sustained
+    decision, sample = sc.tick()           # hot twice -> out
+    assert decision == "scale_out" and gw.n == 2
+    # one event per sustained window, not one per tick
+    assert sc.tick()[0] == "hold"
+
+
+def test_autoscaler_capped_by_device_count_unless_degraded_allowed():
+    gw = _FakeGateway(replicas=2, devices=2)
+    sc = Autoscaler(gw, "fake_cap", min_replicas=1, max_replicas=8,
+                    queue_high=1.0, sustain=1, ewma=1.0,
+                    clock=lambda: 0.0)
+    _set_depth("fake_cap", 100.0)
+    decision, _ = sc.tick()
+    assert decision == "capped" and gw.calls == []
+    # the degraded wrap is opt-in
+    sc2 = Autoscaler(gw, "fake_cap", min_replicas=1, max_replicas=8,
+                     queue_high=1.0, sustain=1, ewma=1.0,
+                     allow_degraded=True, clock=lambda: 0.0)
+    assert sc2.tick()[0] == "scale_out" and gw.n == 3
+
+
+def test_autoscaler_scale_in_respects_cooldown():
+    gw = _FakeGateway(replicas=2)
+    clock = [0.0]
+    sc = Autoscaler(gw, "fake_in", min_replicas=1, max_replicas=4,
+                    queue_high=4.0, sustain=2, cooldown_s=30.0,
+                    ewma=1.0, clock=lambda: clock[0])
+    sc._last_scale_t = 0.0                 # a scale event just landed
+    _set_depth("fake_in", 0.0)
+    assert sc.tick()[0] == "hold"
+    assert sc.tick()[0] == "hold"          # cold+sustained but cooling
+    clock[0] = 31.0
+    decision, _ = sc.tick()
+    assert decision == "scale_in" and gw.n == 1
+    # never below min_replicas
+    clock[0] = 99.0
+    for _ in range(5):
+        sc.tick()
+    assert gw.n == 1
+
+
+def test_autoscaler_p99_budget_pressure():
+    from mxnet_tpu.telemetry import metrics as _tm
+    gw = _FakeGateway(replicas=1)
+    sc = Autoscaler(gw, "fake_p99", min_replicas=1, max_replicas=2,
+                    queue_high=1e9, p99_budget_ms=50.0, sustain=2,
+                    ewma=1.0, clock=lambda: 0.0)
+    _set_depth("fake_p99", 0.0)
+    hist = _tm.registry().histogram(
+        "mx_serving_latency_seconds",
+        "per-stage + end-to-end request latency",
+        labelnames=("model", "stage")).labels(model="fake_p99",
+                                              stage="e2e")
+    sc.tick()                              # establishes the window
+    for _ in range(100):
+        hist.observe(0.2)                  # 200ms >> 50ms budget
+    assert sc.tick()[0] == "hold"          # hot once
+    hist.observe(0.2)
+    assert sc.tick()[0] == "scale_out"
+
+
+def test_histogram_window_p99_math():
+    # buckets at 10ms/100ms/1s; window adds 99 fast + 1 slow obs
+    prev = (0, 0.0, [(0.01, 0), (0.1, 0), (1.0, 0), ("+Inf", 0)])
+    cur = (100, 2.0, [(0.01, 99), (0.1, 99), (1.0, 100),
+                      ("+Inf", 100)])
+    p99 = histogram_window_p99(prev, cur)
+    assert 0.005 <= p99 <= 0.01            # p99 lands in bucket 1
+    assert histogram_window_p99(prev, prev) is None
+    assert histogram_window_p99(None, cur) is None
+    # all observations beyond the last finite edge: ceiling estimate
+    prev2 = (0, 0.0, [(0.01, 0), ("+Inf", 0)])
+    cur2 = (10, 50.0, [(0.01, 0), ("+Inf", 10)])
+    assert histogram_window_p99(prev2, cur2) == 0.01
+    # window mass SPANNING buckets (regression: cumulative deltas
+    # were re-summed as densities, pulling the estimate under 100ms
+    # when half the window sat at ~500ms): 50 obs at 5ms + 50 at
+    # 500ms — the true p99 lies in the (0.1, 1.0] bucket
+    prev3 = (0, 0.0, [(0.01, 0), (0.1, 0), (1.0, 0), ("+Inf", 0)])
+    cur3 = (100, 25.0, [(0.01, 50), (0.1, 50), (1.0, 100),
+                        ("+Inf", 100)])
+    p99 = histogram_window_p99(prev3, cur3)
+    assert 0.1 < p99 <= 1.0, p99
+    # and a nonzero baseline (second window) must subtract cleanly
+    cur4 = (200, 50.0, [(0.01, 100), (0.1, 100), (1.0, 200),
+                        ("+Inf", 200)])
+    assert abs(histogram_window_p99(cur3, cur4) - p99) < 1e-9
+
+
+# ===================================================================
+# Gateway.scale (one-shot replicas)
+# ===================================================================
+def _gw_mlp(seed=0):
+    from mxnet_tpu import nd, sym
+    rng = np.random.default_rng(seed)
+    data = sym.var("data")
+    h = sym.FullyConnected(data, sym.var("fc1_weight"),
+                           sym.var("fc1_bias"), num_hidden=16,
+                           name="fc1")
+    out = sym.FullyConnected(sym.Activation(h, act_type="relu"),
+                             sym.var("fc2_weight"),
+                             sym.var("fc2_bias"), num_hidden=4,
+                             name="fc2")
+    args = {"fc1_weight": nd.array(rng.normal(0, .5, (16, 8))
+                                   .astype(np.float32)),
+            "fc1_bias": nd.array(np.zeros(16, np.float32)),
+            "fc2_weight": nd.array(rng.normal(0, .5, (4, 16))
+                                   .astype(np.float32)),
+            "fc2_bias": nd.array(np.zeros(4, np.float32))}
+    return out, args, {}, (8,)
+
+
+def test_gateway_scale_out_and_in():
+    from mxnet_tpu.serving import Gateway, ServingError
+
+    symbol, args, aux, feature = _gw_mlp()
+    x = np.random.default_rng(1).normal(0, 1, (1,) + feature) \
+        .astype(np.float32)
+    gw = Gateway()
+    try:
+        gw.register("scale_m", symbol, args, aux,
+                    input_shapes={"data": feature}, buckets=(1, 2),
+                    max_wait_ms=0.0, replicas=1)
+        exec_before = gw.stats()["scale_m"]["executables"]
+        report = gw.scale("scale_m", 2)
+        assert report["added"] == 1 and gw.replica_count("scale_m") == 2
+        # the scaled-out lane compiled through the SAME factory
+        assert gw.stats()["scale_m"]["executables"] > exec_before
+        out2 = gw.infer("scale_m", x)
+        # scale-in drains before retiring; service continues
+        report = gw.scale("scale_m", 1)
+        assert report["retired"] == 1
+        assert gw.replica_count("scale_m") == 1
+        # scale-in re-evaluates the degraded flag (regression: it
+        # used to stick at its scale-out value forever)
+        assert gw.stats()["scale_m"]["degraded"] is False
+        out1 = gw.infer("scale_m", x)
+        np.testing.assert_array_equal(out1[0], out2[0])
+        with pytest.raises(ServingError):
+            gw.scale("scale_m", 0)         # min 1: unregister instead
+        with pytest.raises(ServingError):
+            gw.scale("nope", 2)
+    finally:
+        gw.close()
+
+
+def test_gateway_scale_generator_releases_kv_pool():
+    """Generator scale-in must drain the lane, release its paged KV
+    pool, and the census role=kv_cache bytes must drop by exactly the
+    retired pool's footprint."""
+    from mxnet_tpu.profiling import memory as mem
+    from mxnet_tpu.serving import Gateway
+    from mxnet_tpu.serving.generate import GenerativeDecoder
+
+    def kv_bytes():
+        gc.collect()
+        doc = mem.live_census()
+        return sum(d["by_role"].get("kv_cache", 0)
+                   for d in (doc.get("by_device") or {}).values())
+
+    mx.random.seed(0)
+    dec = GenerativeDecoder(vocab_size=32, d_model=16, num_layers=1,
+                            num_heads=2, max_prompt_tokens=8)
+    gw = Gateway()
+    try:
+        gw.register_generator("scale_lm", dec, block_tokens=4,
+                              max_blocks=32, max_new_tokens=8,
+                              max_decode_batch=2)
+        base = kv_bytes()
+        report = gw.scale("scale_lm", 2)
+        assert report["added"] == 1
+        assert gw.replica_count("scale_lm") == 2
+        grown = kv_bytes()
+        assert grown > base
+        # a request admitted before the scale-in still completes
+        toks = gw.generate("scale_lm", [1, 2, 3], max_new_tokens=4)
+        assert len(toks) >= 1
+        # the generate plane publishes the SHARED queue-depth gauge
+        # (regression: an autoscaler pointed at a generator used to
+        # read an eternally-zero family the plane never wrote)
+        from mxnet_tpu.telemetry import metrics as _tm
+        fam = _tm.registry().find("mx_serving_queue_depth")
+        assert fam is not None
+        with fam._lock:
+            assert ("scale_lm",) in fam._children
+        report = gw.scale("scale_lm", 1)
+        assert report["retired"] == 1 and report["freed_bytes"] > 0
+        assert gw.replica_count("scale_lm") == 1
+        assert kv_bytes() == grown - report["freed_bytes"] == base
+        st = gw.stats()["scale_lm"]
+        assert len(st["lanes"]) == 1
+        # the survivor still serves
+        toks = gw.generate("scale_lm", [1, 2], max_new_tokens=3)
+        assert len(toks) >= 1
+    finally:
+        gw.close()
+
+
+def test_gateway_scale_in_prefers_unhealthy_replicas():
+    """Scale-in must never retire the only healthy lane while a dead
+    one stays: the doomed set orders unhealthy-first."""
+    from mxnet_tpu.serving import Gateway, ServingError
+
+    symbol, args, aux, feature = _gw_mlp(seed=2)
+    x = np.random.default_rng(1).normal(0, 1, (1,) + feature) \
+        .astype(np.float32)
+    gw = Gateway()
+    try:
+        gw.register("scale_h", symbol, args, aux,
+                    input_shapes={"data": feature}, buckets=(1, 2),
+                    max_wait_ms=0.0, replicas=2)
+        m = gw.registry.get("scale_h")
+        # kill the OLDEST replica — naive newest-first retire would
+        # evict the healthy survivor
+        m.replicas[0]._fail([], ServingError("chaos: killed"))
+        report = gw.scale("scale_h", 1)
+        assert report["retired"] == 1
+        assert len(m.replicas) == 1 and m.replicas[0].healthy
+        assert gw.infer("scale_h", x)[0].shape == (1, 4)
+    finally:
+        gw.close()
+
+
+def test_generator_lane_self_finalizes_after_initiator_timeout():
+    """A lane still draining when the scale-in initiator gives up
+    must finalize ITSELF once drained — the pool is closed, the lane
+    leaves the list, nothing leaks (regression: a timed-out retire
+    left the pool open forever)."""
+    from mxnet_tpu.serving import Gateway
+    from mxnet_tpu.serving.generate import GenerativeDecoder
+
+    mx.random.seed(0)
+    dec = GenerativeDecoder(vocab_size=32, d_model=16, num_layers=1,
+                            num_heads=2, max_prompt_tokens=8)
+    gw = Gateway()
+    try:
+        gw.register_generator("tmo_lm", dec, block_tokens=4,
+                              max_blocks=32, max_new_tokens=8,
+                              max_decode_batch=2)
+        gw.scale("tmo_lm", 2)
+        gen = gw._get_generator("tmo_lm")
+        lane = gen.lanes[-1]
+        # a zero-timeout retire models the initiator giving up while
+        # the lane is (almost certainly) still parked; whether or not
+        # the join caught the exit, the lane must end FINALIZED — the
+        # regression was a timed-out retire leaving the pool open
+        # forever with nobody left to close it
+        gen._retire_lane(lane, timeout=0.0)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not lane.finalized:
+            time.sleep(0.02)
+        assert lane.finalized and lane.pool.closed
+        assert lane not in gen.lanes
+        assert gw.replica_count("tmo_lm") == 1
+        toks = gw.generate("tmo_lm", [1, 2], max_new_tokens=3)
+        assert len(toks) >= 1
+    finally:
+        gw.close()
+
+
+def test_block_pool_close_semantics():
+    from mxnet_tpu.serving.generate import BlockPool
+
+    pool = BlockPool(num_layers=1, num_heads=2, head_dim=4,
+                     block_tokens=4, max_blocks=8)
+    total = pool.bytes_total
+    assert total > 0
+    pool.close()
+    assert pool.closed and pool.bytes_total == 0
+    assert pool.occupancy()["closed"] is True
+    assert pool.occupancy()["used_blocks"] == 0
+    assert not pool.reserve(1)             # closed pools admit nothing
+    with pytest.raises(MXNetError):
+        pool.alloc(1)
+    pool.close()                           # idempotent
+
+
+# ===================================================================
+# perf_gate --chaos
+# ===================================================================
+def test_perf_gate_chaos_over_committed_artifact(capsys):
+    rc = perf_gate.main([CHAOS_ARTIFACT, "--chaos"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "bit-identical" in out
+    assert "report names worker1" in out
+    assert "out at" in out                 # autoscale cycle narrated
+
+
+def _chaos_docs():
+    with open(CHAOS_ARTIFACT, encoding="utf-8") as f:
+        good = json.load(f)
+    return good, copy.deepcopy(good)
+
+
+def test_chaos_artifact_contract():
+    good, _ = _chaos_docs()
+    assert good["tool"] == "chaos_bench" and good["version"] == 1
+    for family in ("preemption_storm", "straggler", "replica_kill",
+                   "autoscale_cycle"):
+        assert family in good["scenarios"], family
+    storm = good["scenarios"]["preemption_storm"]
+    assert storm["fingerprint"]["bit_identical"] is True
+    assert storm["batches"]["dropped"] == 0
+    assert storm["batches"]["duplicated"] == 0
+    assert storm["world"]["devices_to"] < storm["world"]["devices_from"]
+    assert good["scenarios"]["autoscale_cycle"]["scaled_out"] is True
+    assert good["scenarios"]["autoscale_cycle"]["scaled_in"] is True
+
+
+def test_perf_gate_chaos_synthetic_regressions():
+    """The >=5 synthetic regressions the gate must catch."""
+    good, _ = _chaos_docs()
+
+    def gate(mutate):
+        cand = copy.deepcopy(good)
+        mutate(cand)
+        rc, msgs = perf_gate.gate_chaos(cand, good)
+        return rc, "\n".join(msgs)
+
+    # 1. missing required scenario family
+    rc, out = gate(lambda c: c["scenarios"].pop("straggler"))
+    assert rc == 1 and "required scenario family missing" in out \
+        or "dropped from the artifact" in out
+
+    # 2. dropped-scenario-while-last-good-has-one (non-required family)
+    rc, out = gate(lambda c: c["scenarios"].pop("autoscale_cycle"))
+    assert rc == 1 and "dropped from the artifact" in out
+
+    # 3. blown recovery budget
+    def blow(c):
+        s = c["scenarios"]["preemption_storm"]
+        s["recovery_s"] = s["recovery_budget_s"] + 1.0
+    rc, out = gate(blow)
+    assert rc == 1 and "recovery" in out
+
+    # 4. p99 growth past its budget
+    def p99(c):
+        s = c["scenarios"]["replica_kill"]
+        s["p99_ms"] = s["p99_budget_ms"] * 2
+    rc, out = gate(p99)
+    assert rc == 1 and "p99" in out
+
+    # 5. fingerprint drift (resumed != planned twin)
+    def drift(c):
+        fp = c["scenarios"]["preemption_storm"]["fingerprint"]
+        fp["bit_identical"] = False
+        fp["resumed"] = "deadbeef"
+    rc, out = gate(drift)
+    assert rc == 1 and "NOT bit-identical" in out
+
+    # 6. drift-vs-uninterrupted over its bound
+    def bound(c):
+        fp = c["scenarios"]["preemption_storm"]["fingerprint"]
+        fp["drift_vs_uninterrupted_max_abs"] = 1.0
+    rc, out = gate(bound)
+    assert rc == 1 and "drift" in out
+
+    # 7. dropped/duplicated batches
+    def dup(c):
+        c["scenarios"]["preemption_storm"]["batches"]["duplicated"] = 1
+    rc, out = gate(dup)
+    assert rc == 1 and "batch schedule violated" in out
+
+    # 8. straggler misnamed
+    def misname(c):
+        s = c["scenarios"]["straggler"]
+        s["named_ok"] = False
+        s["named_rank"] = "worker0"
+    rc, out = gate(misname)
+    assert rc == 1 and "named 'worker0'" in out
+
+    # 9. lost requests under load
+    def lost(c):
+        c["scenarios"]["replica_kill"]["lost_requests"] = 3
+    rc, out = gate(lost)
+    assert rc == 1 and "LOST" in out
+
+    # 10. autoscale cycle incomplete
+    def noscale(c):
+        c["scenarios"]["autoscale_cycle"]["scaled_in"] = False
+    rc, out = gate(noscale)
+    assert rc == 1 and "cycle did not complete" in out
+
+    # 11-13. contracts cannot be shed by DROPPING their fields while
+    # last-good carries them (p99 budget, lost_requests, batches)
+    def drop_p99(c):
+        c["scenarios"]["replica_kill"].pop("p99_budget_ms")
+    rc, out = gate(drop_p99)
+    assert rc == 1 and "p99 budget dropped" in out
+
+    def drop_lost(c):
+        c["scenarios"]["replica_kill"].pop("lost_requests")
+    rc, out = gate(drop_lost)
+    assert rc == 1 and "lost_requests dropped" in out
+
+    def drop_batches(c):
+        c["scenarios"]["preemption_storm"].pop("batches")
+    rc, out = gate(drop_batches)
+    assert rc == 1 and "batch accounting dropped" in out
+
+    # and the unmutated artifact still passes
+    rc, msgs = perf_gate.gate_chaos(copy.deepcopy(good), good)
+    assert rc == 0, msgs
+
+
+def test_perf_gate_chaos_unreadable_and_signal_free():
+    good, cand = _chaos_docs()
+    rc, _ = perf_gate.gate_chaos({"tool": "other"}, good)
+    assert rc == 2
+    rc, _ = perf_gate.gate_chaos(
+        {"tool": "chaos_bench", "version": 1, "scenarios": {}}, good)
+    assert rc == 3
+
+
+# ===================================================================
+# registration + lint scope
+# ===================================================================
+def test_elastic_env_vars_registered():
+    from mxnet_tpu import libinfo
+    doc = open(os.path.join(REPO, "docs", "env_vars.md"),
+               encoding="utf-8").read()
+    for var in ("MXTPU_ELASTIC_DIR", "MXTPU_ELASTIC_POLL_SEC",
+                "MXTPU_ELASTIC_MIN_REPLICAS",
+                "MXTPU_ELASTIC_MAX_REPLICAS",
+                "MXTPU_ELASTIC_QUEUE_HIGH",
+                "MXTPU_ELASTIC_P99_BUDGET_MS",
+                "MXTPU_ELASTIC_COOLDOWN_SEC"):
+        assert var in libinfo._ENV_VARS, var
+        assert var in doc, var
+
+
+def test_mxl002_scope_covers_elastic_hot_paths(tmp_path):
+    from mxnet_tpu.analysis.lint import run_lint
+    from mxnet_tpu.analysis.rules.host_sync import (HostSyncRule,
+                                                    _hot_scope)
+
+    methods, _ = _hot_scope("mxnet_tpu/elastic/membership.py")
+    assert {"poll", "view", "announce"} <= methods
+    methods, _ = _hot_scope("mxnet_tpu/elastic/autoscale.py")
+    assert {"observe", "decide", "tick"} <= methods
+    # the reshape/gather path is sanctioned sync territory by design
+    assert "reshape" not in methods
+
+    bad = tmp_path / "mxnet_tpu" / "elastic"
+    bad.mkdir(parents=True)
+    f = bad / "evil.py"
+    f.write_text(
+        "def poll(self, reap=False):\n"
+        "    self.arr.asnumpy()\n"
+        "    return None\n"
+        "def decide(self, sample):\n"
+        "    x = self.dev.block_until_ready()\n"
+        "    return x\n")
+    result = run_lint(str(tmp_path), [HostSyncRule()], files=[str(f)])
+    codes = [fd.code for fd in result.findings]
+    assert codes.count("MXL002") >= 2

@@ -1,0 +1,144 @@
+"""Chaos SLO scenarios (elastic/chaos.py), PR-2 style: tier-1 runs
+the in-process slices (storm reshape with bit-identical fingerprints,
+replica kill under open-loop load), the multi-process preemption
+storm and the full open-loop autoscale cycle are marked slow."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.elastic import chaos
+from mxnet_tpu.elastic.membership import Membership
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STORM_WORKER = os.path.join(REPO, "tests", "elastic_storm_worker.py")
+
+
+# ===================================================================
+# tier-1 in-process slices
+# ===================================================================
+def test_preemption_storm_in_process(tmp_path):
+    """Kill 2 of 4 members mid-epoch: survivors reshape dp 8->4,
+    re-shard the ZeRO-2 state from the checkpoint, carry the
+    iterator, and fingerprint bit-identical to the planned-reshape
+    twin with bounded drift vs the uninterrupted run."""
+    s = chaos.run_preemption_storm(steps_before=2, steps_after=2,
+                                   workdir=tmp_path)
+    assert s["recovery_s"] <= s["recovery_budget_s"]
+    assert s["world"]["devices_to"] < s["world"]["devices_from"]
+    b = s["batches"]
+    assert b["dropped"] == 0 and b["duplicated"] == 0
+    assert b["schedule_preserved"] is True
+    fp = s["fingerprint"]
+    assert fp["bit_identical"] is True, fp
+    assert fp["drift_vs_uninterrupted_max_abs"] <= fp["drift_bound"]
+    if not s["census"].get("disabled"):
+        roles = s["census"]["roles"]
+        assert roles["optimizer_state"]["per_device_bytes"] == \
+            [roles["optimizer_state"]["expected_bytes"]]
+
+
+def test_replica_kill_under_open_loop_load():
+    """One of two replicas killed mid-stream: its batch redistributes
+    (zero lost requests), the health probe revives it inside the
+    budget, p99 holds, and a fixed probe input returns bitwise-equal
+    bytes across the cycle."""
+    s = chaos.run_replica_kill(duration_s=1.5, kill_after_s=0.5)
+    assert s["lost_requests"] == 0, s["errors_sample"]
+    assert s["recovery_s"] is not None
+    assert s["recovery_s"] <= s["recovery_budget_s"]
+    assert s["p99_ms"] is not None and \
+        s["p99_ms"] <= s["p99_budget_ms"]
+    assert s["replicas_healthy_after"] == [True, True]
+    assert s["probe_fingerprint_equal"] is True
+    assert s["completed"] + s["rejected"] == s["submitted"]
+
+
+def test_chaos_recovery_telemetry_recorded():
+    from mxnet_tpu.telemetry import metrics as _tm
+    fam = _tm.registry().find("mx_elastic_recovery_seconds")
+    assert fam is not None
+    # the tier-1 scenarios above observed into it
+    assert fam.labels(scenario="preemption_storm").count >= 1
+
+
+# ===================================================================
+# slow scenarios
+# ===================================================================
+@pytest.mark.slow
+def test_autoscale_cycle_open_loop():
+    """The acceptance scenario: sustained queue growth scales OUT,
+    the post-burst cold window scales back IN — from mx_serving_*
+    telemetry alone."""
+    s = chaos.run_autoscale_cycle(burst_s=1.5, cooldown_s=0.8)
+    assert s["scaled_out"] is True and s["scaled_in"] is True
+    assert s["scale_out_at_s"] < s["scale_in_at_s"]
+    assert s["lost_requests"] == 0
+    assert s["replicas_final"] == 1
+    assert s["p99_ms"] <= s["p99_budget_ms"]
+
+
+@pytest.mark.slow
+def test_multiprocess_storm_membership(tmp_path):
+    """Real processes, real SIGKILL: two workers announce, one dies
+    without a goodbye, the survivor's poll names it dead by pid
+    liveness and a reap converges every handle on one post-storm
+    generation."""
+    mdir = str(tmp_path / "members")
+    procs = [subprocess.Popen(
+        [sys.executable, STORM_WORKER, mdir, str(rank)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        for rank in (1, 2)]
+    try:
+        observer = Membership(mdir, rank=0)
+        observer.announce()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            view = observer.view()
+            if view.alive == (0, 1, 2):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(
+                "workers never announced: %s" % (observer.view(),))
+        observer.poll()                       # baseline
+        # the storm: SIGKILL leaves only a stale pid behind
+        procs[1].send_signal(signal.SIGKILL)
+        procs[1].wait(10)
+        view, changed = observer.poll(reap=True)
+        assert changed
+        assert view.alive == (0, 1)
+        assert 2 not in view.members          # reaped
+        # a second handle converges on the same generation
+        other = Membership(mdir, rank=1)
+        assert other.view().generation == view.generation
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(10)
+
+
+@pytest.mark.slow
+def test_chaos_bench_quick_cli(tmp_path):
+    """The bench tool end to end (quick mode): all families run, the
+    artifact parses, and perf_gate --chaos passes over it."""
+    out = str(tmp_path / "chaos.json")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_bench.py"),
+         "--quick", "-o", out],
+        capture_output=True, text=True, timeout=540)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    with open(out, encoding="utf-8") as f:
+        doc = json.load(f)
+    assert set(doc["scenarios"]) == set(chaos.FAMILIES)
+    gate = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_gate.py"),
+         out, "--chaos"], capture_output=True, text=True, timeout=60)
+    assert gate.returncode == 0, gate.stdout + gate.stderr

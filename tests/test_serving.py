@@ -399,6 +399,8 @@ def test_replica_degrade_to_fewer_devices(caplog):
     import logging
 
     import jax
+    from mxnet_tpu.parallel import mesh as mesh_mod
+    mesh_mod._reset_degrade_warnings()
     symbol, args, aux, feature = tiny_mlp()
     gw = Gateway(devices=[jax.local_devices()[0]])
     try:
@@ -410,8 +412,19 @@ def test_replica_degrade_to_fewer_devices(caplog):
         assert "degrading" in caplog.text
         st = gw.stats()["m"]
         assert len(st["replicas"]) == 3
+        assert st["degraded"] is True
         assert len({r["device"] for r in st["replicas"]}) == 1
         assert gw.infer("m", _x(feature))[0].shape == (1, 4)
+        # satellite: the SAME (ask, devices) wrap warns exactly once —
+        # a second registration (an autoscaler's re-ask) is silent
+        caplog.clear()
+        with caplog.at_level(logging.WARNING,
+                             logger="mxnet_tpu.serving.gateway"):
+            gw.register("m2", symbol, args, aux,
+                        input_shapes={"data": feature}, buckets=(1,),
+                        max_wait_ms=0.0, replicas=3)
+        assert "degrading" not in caplog.text
+        assert gw.stats()["m2"]["degraded"] is True
     finally:
         gw.close()
 

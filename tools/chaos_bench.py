@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""chaos_bench — run the chaos SLO suite and commit the evidence.
+
+    python tools/chaos_bench.py                      # all families
+    python tools/chaos_bench.py --quick              # CI-sized
+    python tools/chaos_bench.py --only straggler
+    python tools/chaos_bench.py -o out.json --last-good LAST.json
+
+Drives every scenario family in :mod:`mxnet_tpu.elastic.chaos` —
+preemption storm (mesh reshape + ZeRO re-shard + iterator carry),
+injected straggler (trace_merge must name the rank), replica kill
+under open-loop load (drain/revive, zero lost requests), and the
+autoscale cycle (scale out on telemetry, back in after cooldown) —
+and writes one versioned artifact:
+
+    {"tool": "chaos_bench", "version": 1, "created": ...,
+     "host": {...}, "scenarios": {family: {...}}}
+
+Each scenario embeds its own budget next to its measurement
+(``recovery_s``/``recovery_budget_s``, ``p99_ms``/``p99_budget_ms``,
+fingerprint + drift bound), so ``perf_gate --chaos`` can assert the
+SLOs without a config side-channel. ``--last-good`` additionally
+copies the artifact over the committed CHAOS_LAST_GOOD.json the gate
+compares against.
+
+Exit 0 when every scenario holds its own budgets, 1 otherwise (the
+artifact is still written — a failing chaos run is evidence too).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import platform
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_OUT = os.path.join(
+    REPO, "docs", "artifacts",
+    "chaos_bench_%s.json" % time.strftime("%Y%m%d"))
+LAST_GOOD = os.path.join(REPO, "docs", "artifacts",
+                         "CHAOS_LAST_GOOD.json")
+
+
+def scenario_ok(s):
+    """Does one scenario hold its own embedded budgets? (The same
+    predicates perf_gate --chaos enforces — kept tiny here so the
+    bench can exit honestly without importing the gate.)"""
+    if s.get("recovery_s") is None or \
+            s["recovery_s"] > s.get("recovery_budget_s", 0):
+        return False
+    p99, budget = s.get("p99_ms"), s.get("p99_budget_ms")
+    if budget is not None and (p99 is None or p99 > budget):
+        return False
+    fp = s.get("fingerprint")
+    if fp is not None:
+        if fp.get("bit_identical") is not True:
+            return False
+        drift = fp.get("drift_vs_uninterrupted_max_abs")
+        if drift is None or drift > fp.get("drift_bound", 0):
+            return False
+    if s.get("family") == "straggler" and s.get("named_ok") is not True:
+        return False
+    if "lost_requests" in s and s["lost_requests"] != 0:
+        return False
+    if s.get("family") == "autoscale_cycle" and not (
+            s.get("scaled_out") and s.get("scaled_in")):
+        return False
+    if s.get("family") == "replica_kill" and \
+            s.get("probe_fingerprint_equal") is not True:
+        return False
+    return True
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="chaos_bench",
+                                 description=__doc__.splitlines()[0])
+    ap.add_argument("-o", "--out", default=DEFAULT_OUT,
+                    help="artifact path (default docs/artifacts/"
+                         "chaos_bench_<date>.json)")
+    ap.add_argument("--quick", action="store_true",
+                    help="CI-sized scenario parameters")
+    ap.add_argument("--only", action="append", default=[],
+                    metavar="FAMILY",
+                    help="run only this family (repeatable)")
+    ap.add_argument("--last-good", nargs="?", const=LAST_GOOD,
+                    default=None, metavar="PATH",
+                    help="also copy the artifact to the committed "
+                         "last-good (default %s)" % LAST_GOOD)
+    args = ap.parse_args(argv)
+
+    from mxnet_tpu.elastic import chaos
+
+    runners = {
+        "preemption_storm": lambda: chaos.run_preemption_storm(
+            steps_before=2 if args.quick else 3,
+            steps_after=2 if args.quick else 4),
+        "straggler": lambda: chaos.run_straggler(
+            delay_ms=25 if args.quick else 40),
+        "replica_kill": lambda: chaos.run_replica_kill(
+            duration_s=2.0 if args.quick else 4.0),
+        "autoscale_cycle": lambda: chaos.run_autoscale_cycle(
+            burst_s=1.5 if args.quick else 2.5),
+    }
+    only = set(args.only)
+    unknown = only - set(runners)
+    if unknown:
+        print("chaos_bench: unknown families %s (known: %s)"
+              % (sorted(unknown), sorted(runners)), file=sys.stderr)
+        return 2
+
+    import jax
+    scenarios = {}
+    rc = 0
+    for family, run in runners.items():
+        if only and family not in only:
+            continue
+        t0 = time.perf_counter()
+        print("chaos_bench: running %s ..." % family, flush=True)
+        try:
+            s = run()
+        except Exception as e:  # noqa: BLE001 — a crashed scenario is
+            # a failed scenario, recorded as such, never a lost artifact
+            s = {"family": family, "error": repr(e)[:500],
+                 "recovery_s": None, "recovery_budget_s": 0}
+        s["wall_s"] = round(time.perf_counter() - t0, 3)
+        scenarios[family] = s
+        ok = scenario_ok(s)
+        rc = rc or (0 if ok else 1)
+        print("chaos_bench: %s %s (%.1fs)"
+              % (family, "OK" if ok else "FAILED", s["wall_s"]),
+              flush=True)
+
+    doc = {
+        "tool": "chaos_bench",
+        "version": 1,
+        "created": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "quick": bool(args.quick),
+        "host": {
+            "platform": platform.platform(),
+            "python": platform.python_version(),
+            "jax_backend": jax.default_backend(),
+            "devices": len(jax.local_devices()),
+            "cpus": os.cpu_count(),
+        },
+        "scenarios": scenarios,
+    }
+    payload = json.dumps(doc, indent=1, sort_keys=True, default=str)
+    for path in filter(None, [args.out, args.last_good]):
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(payload + "\n")
+        os.replace(tmp, path)
+        print("chaos_bench: wrote %s" % path)
+    print("chaos_bench: %s" % ("PASS" if rc == 0 else "FAILED"))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

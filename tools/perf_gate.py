@@ -7,6 +7,7 @@
     python tools/perf_gate.py io_bench.json --io
     python tools/perf_gate.py serving_bench.json --serving
     python tools/perf_gate.py kernel_bench.json --kernels
+    python tools/perf_gate.py chaos_bench.json --chaos
 
 ``--io`` gates a tools/io_bench.py version-2 artifact instead: every
 stage's img/s must stay within tolerance of the committed last-good
@@ -40,6 +41,20 @@ numerics). The committed health-bearing artifact lives at
 ``docs/artifacts/HEALTH_LAST_GOOD.json`` and the example first-NaN
 postmortem at ``docs/artifacts/NAN_POSTMORTEM_EXAMPLE.json``
 (tier-1 self-tested in tests/test_health.py).
+
+``--chaos`` gates a tools/chaos_bench.py version-1 artifact against
+``docs/artifacts/CHAOS_LAST_GOOD.json`` — the elasticity SLOs as CI
+contracts: the three core scenario families (preemption storm,
+straggler, replica kill) must be PRESENT, any scenario the last-good
+artifact carries must not be dropped, every scenario must hold its
+own embedded recovery-time budget and p99 budget (p99 additionally
+must not GROW beyond tolerance vs last-good — latency is a ceiling),
+the preemption storm's fingerprints must be bit-identical to the
+planned-reshape twin with drift-vs-uninterrupted under its bound and
+zero dropped/duplicated batches, the straggler report must NAME the
+injected rank, the replica kill must lose zero requests with a
+bitwise-identical probe across recovery, and the autoscale cycle
+must have demonstrably scaled out AND back in.
 
 ``--kernels`` gates a tools/kernel_bench.py version-1 artifact
 against ``docs/artifacts/KERNELS_LAST_GOOD.json``: every kernel the
@@ -92,6 +107,13 @@ DEFAULT_SERVING_LAST_GOOD = os.path.join(REPO, "docs", "artifacts",
                                          "SERVING_LAST_GOOD.json")
 DEFAULT_KERNELS_LAST_GOOD = os.path.join(REPO, "docs", "artifacts",
                                          "KERNELS_LAST_GOOD.json")
+DEFAULT_CHAOS_LAST_GOOD = os.path.join(REPO, "docs", "artifacts",
+                                       "CHAOS_LAST_GOOD.json")
+
+# the elasticity plane's advertised scenario families: an artifact
+# missing one of these has not exercised the SLO it claims to gate
+REQUIRED_CHAOS_FAMILIES = ("preemption_storm", "straggler",
+                           "replica_kill")
 
 # metrics compared when both sides carry them; values are "bigger is
 # better" throughputs/ratios
@@ -562,6 +584,195 @@ def gate_generate(candidate, last_good, tolerance=0.25):
     return rc, msgs
 
 
+def gate_chaos(candidate, last_good, tolerance=0.25):
+    """(exit_code, [messages]) for a chaos_bench artifact pair.
+
+    Directions: recovery_s and p99_ms are CEILINGS against each
+    scenario's own embedded budget (a blown budget is the regression,
+    not a slow-but-within-budget number); p99 additionally must not
+    grow beyond tolerance vs last-good; fingerprint bit-identity,
+    batch accounting, straggler naming, zero lost requests, and the
+    scale-out/scale-in pair are truth contracts. A scenario present
+    in last-good but missing from the candidate is itself a
+    regression — the suite cannot silently shrink out of its own
+    gate — and the three core families are required outright."""
+    msgs = []
+    rc = 0
+    if candidate.get("tool") != "chaos_bench" or \
+            candidate.get("version") != 1:
+        return 2, ["not a version-1 chaos_bench artifact"]
+    mine = candidate.get("scenarios") or {}
+    good = last_good.get("scenarios") or {}
+    if not mine:
+        return 3, ["chaos artifact carries no scenarios "
+                   "(signal-free — rejected)"]
+    for family in REQUIRED_CHAOS_FAMILIES:
+        if family not in mine:
+            rc = 1
+            msgs.append("REGRESSION chaos[%s]: required scenario "
+                        "family missing from the artifact" % family)
+    for family in sorted(good):
+        if family not in mine:
+            rc = 1
+            msgs.append("REGRESSION chaos[%s]: scenario dropped from "
+                        "the artifact (last good carries it)" % family)
+    for family in sorted(mine):
+        s = mine[family]
+        g = good.get(family) or {}
+        if not isinstance(s, dict):
+            rc = 1
+            msgs.append("REGRESSION chaos[%s]: malformed entry"
+                        % family)
+            continue
+        if s.get("error"):
+            rc = 1
+            msgs.append("REGRESSION chaos[%s]: scenario crashed: %s"
+                        % (family, str(s["error"])[:160]))
+            continue
+        rec, budget = s.get("recovery_s"), s.get("recovery_budget_s")
+        if not isinstance(rec, (int, float)) or \
+                not isinstance(budget, (int, float)):
+            rc = 1
+            msgs.append("REGRESSION chaos[%s]: missing recovery_s/"
+                        "recovery_budget_s (recovery unproven)"
+                        % family)
+        elif rec > budget:
+            rc = 1
+            msgs.append("REGRESSION chaos[%s]: recovery %.3fs > "
+                        "budget %.1fs" % (family, rec, budget))
+        else:
+            msgs.append("chaos[%s]: recovery %.3fs <= %.1fs budget "
+                        "(ok)" % (family, rec, budget))
+        p99, p99_budget = s.get("p99_ms"), s.get("p99_budget_ms")
+        if not isinstance(p99_budget, (int, float)) and \
+                isinstance(g.get("p99_budget_ms"), (int, float)):
+            # a scenario cannot shed its latency SLO by dropping the
+            # budget field while last-good declares one
+            rc = 1
+            msgs.append("REGRESSION chaos[%s]: p99 budget dropped "
+                        "from the artifact (last good declares "
+                        "%.0fms)" % (family, g["p99_budget_ms"]))
+        if isinstance(p99_budget, (int, float)):
+            if not isinstance(p99, (int, float)):
+                rc = 1
+                msgs.append("REGRESSION chaos[%s]: p99 budget %.0fms "
+                            "declared but no p99_ms measured"
+                            % (family, p99_budget))
+            elif p99 > p99_budget:
+                rc = 1
+                msgs.append("REGRESSION chaos[%s]: p99 %.1fms > "
+                            "budget %.0fms" % (family, p99,
+                                               p99_budget))
+            else:
+                msgs.append("chaos[%s]: p99 %.1fms <= %.0fms budget "
+                            "(ok)" % (family, p99, p99_budget))
+            good_p99 = g.get("p99_ms")
+            if isinstance(p99, (int, float)) and \
+                    isinstance(good_p99, (int, float)) and \
+                    good_p99 > 0 and \
+                    p99 > (1.0 + tolerance) * good_p99:
+                rc = 1
+                msgs.append("REGRESSION chaos[%s]: p99 %.1fms > "
+                            "%.1fms (last good %.1fms, tolerance "
+                            "%.0f%%)" % (family, p99,
+                                         (1.0 + tolerance) * good_p99,
+                                         good_p99, tolerance * 100))
+        fp = s.get("fingerprint")
+        if isinstance(fp, dict) or isinstance(g.get("fingerprint"),
+                                              dict):
+            if not isinstance(fp, dict):
+                rc = 1
+                msgs.append("REGRESSION chaos[%s]: fingerprint "
+                            "section dropped (last good carries one)"
+                            % family)
+            elif fp.get("bit_identical") is not True:
+                rc = 1
+                msgs.append("REGRESSION chaos[%s]: resumed run is NOT "
+                            "bit-identical to the planned-reshape "
+                            "twin (%s != %s)"
+                            % (family, fp.get("resumed"),
+                               fp.get("planned_reshape")))
+            else:
+                drift = fp.get("drift_vs_uninterrupted_max_abs")
+                bound = fp.get("drift_bound")
+                if not isinstance(drift, (int, float)) or \
+                        not isinstance(bound, (int, float)) or \
+                        drift > bound:
+                    rc = 1
+                    msgs.append("REGRESSION chaos[%s]: drift vs the "
+                                "uninterrupted run %s exceeds (or "
+                                "lacks) its bound %s"
+                                % (family, drift, bound))
+                else:
+                    msgs.append("chaos[%s]: fingerprints bit-"
+                                "identical, drift %.2g <= %.2g (ok)"
+                                % (family, drift, bound))
+        batches = s.get("batches")
+        if not isinstance(batches, dict) and \
+                isinstance(g.get("batches"), dict):
+            rc = 1
+            msgs.append("REGRESSION chaos[%s]: batch accounting "
+                        "dropped from the artifact (last good "
+                        "carries it)" % family)
+        if isinstance(batches, dict):
+            if batches.get("dropped") or batches.get("duplicated") \
+                    or batches.get("schedule_preserved") is not True:
+                rc = 1
+                msgs.append("REGRESSION chaos[%s]: batch schedule "
+                            "violated (dropped=%s duplicated=%s "
+                            "preserved=%s)"
+                            % (family, batches.get("dropped"),
+                               batches.get("duplicated"),
+                               batches.get("schedule_preserved")))
+            else:
+                msgs.append("chaos[%s]: no batch dropped or "
+                            "duplicated (ok)" % family)
+        if family == "straggler":
+            if s.get("named_ok") is not True:
+                rc = 1
+                msgs.append("REGRESSION chaos[straggler]: report "
+                            "named %r, injected %r"
+                            % (s.get("named_rank"),
+                               s.get("injected_rank")))
+            else:
+                msgs.append("chaos[straggler]: report names %s (ok)"
+                            % s.get("named_rank"))
+        if "lost_requests" not in s and "lost_requests" in g:
+            rc = 1
+            msgs.append("REGRESSION chaos[%s]: lost_requests dropped "
+                        "from the artifact (last good carries it)"
+                        % family)
+        if "lost_requests" in s:
+            if s["lost_requests"] != 0:
+                rc = 1
+                msgs.append("REGRESSION chaos[%s]: %s requests LOST "
+                            "(shed is allowed, loss is not)"
+                            % (family, s["lost_requests"]))
+            else:
+                msgs.append("chaos[%s]: 0 lost of %s submitted "
+                            "(%s shed) (ok)"
+                            % (family, s.get("submitted"),
+                               s.get("rejected")))
+        if family == "replica_kill" and \
+                s.get("probe_fingerprint_equal") is not True:
+            rc = 1
+            msgs.append("REGRESSION chaos[replica_kill]: probe output "
+                        "changed across the kill/revive cycle")
+        if family == "autoscale_cycle":
+            if not (s.get("scaled_out") and s.get("scaled_in")):
+                rc = 1
+                msgs.append("REGRESSION chaos[autoscale_cycle]: "
+                            "scaled_out=%s scaled_in=%s — the "
+                            "telemetry-driven cycle did not complete"
+                            % (s.get("scaled_out"),
+                               s.get("scaled_in")))
+            else:
+                msgs.append("chaos[autoscale_cycle]: out at %ss, in "
+                            "at %ss (ok)" % (s.get("scale_out_at_s"),
+                                             s.get("scale_in_at_s")))
+    return rc, msgs
+
+
 def gate_kernels(candidate, last_good, tolerance=0.25, min_ratio=1.0):
     """(exit_code, [messages]) for a kernel_bench artifact pair.
 
@@ -682,6 +893,11 @@ def main(argv=None):
                          "(1.05 = 5%% timer noise on fresh runs; the "
                          "committed artifact is pinned to 1.0 by the "
                          "tier-1 self-test)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="gate a tools/chaos_bench.py v1 artifact "
+                         "(family coverage + recovery/p99 budgets + "
+                         "fingerprint bit-identity + zero lost "
+                         "requests + autoscale cycle)")
     ap.add_argument("--kernels", action="store_true",
                     help="gate a tools/kernel_bench.py v1 artifact "
                          "(parity presence/truth + fallback timing "
@@ -696,6 +912,27 @@ def main(argv=None):
                          "training, pinned params fingerprint, "
                          "finite loss EWMA (profiling/health.py)")
     args = ap.parse_args(argv)
+    if args.chaos:
+        last_good_path = args.last_good
+        if last_good_path == DEFAULT_LAST_GOOD:
+            last_good_path = DEFAULT_CHAOS_LAST_GOOD
+        try:
+            with open(args.artifact, "r", encoding="utf-8") as f:
+                candidate = json.load(f)
+            with open(last_good_path, "r", encoding="utf-8") as f:
+                last_good = json.load(f)
+        except (OSError, ValueError) as e:
+            print("perf_gate: cannot read chaos artifact: %s" % e,
+                  file=sys.stderr)
+            return 2
+        rc, msgs = gate_chaos(candidate, last_good,
+                              tolerance=args.tolerance)
+        for m in msgs:
+            print(m)
+        print("perf_gate: %s"
+              % {0: "PASS", 1: "REGRESSION", 2: "UNREADABLE",
+                 3: "BARE-ZERO"}.get(rc, rc))
+        return rc
     if args.kernels:
         last_good_path = args.last_good
         if last_good_path == DEFAULT_LAST_GOOD:
