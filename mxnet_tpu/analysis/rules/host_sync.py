@@ -89,6 +89,28 @@ _SCOPES = (
       "partition_graph", "_partition_one", "create_subgraph_node",
       "price_program", "price_cluster", "__call__", "_memo_key",
       "build_report", "partition_graph_costed"}, set()),
+    # the layout plane: role/spec resolution runs at registration,
+    # bind, scale-out and dry-run time and must stay ABSTRACT — a
+    # device sync inside resolve/fit/report would execute real work
+    # while deciding where work should go (placement prices metadata:
+    # shapes, dtypes, mesh axes — never array values)
+    ("mxnet_tpu/parallel/layout.py",
+     {"role_of", "spec_for", "resolve", "resolve_specs", "zero_specs",
+      "_fit_spec", "report", "collective_shardings",
+      "collectives_summary", "dryrun_report"}, set()),
+    # replica/slice placement is the same doctrine one level down:
+    # picking devices for lanes is list arithmetic over device
+    # handles, never a device round-trip
+    ("mxnet_tpu/parallel/mesh.py",
+     {"replica_devices", "replica_slices", "mesh_sharding"}, set()),
+    # mesh-sliced serving lanes: dispatch of a padded batch is ONE
+    # SPMD program per slice; run()'s np.asarray IS the reply's host
+    # transfer (outputs are replicated — the gather is a local read)
+    # and stays legal exactly like Replica._run_batch's. NOTE: listed
+    # before the general serving/ scope — first prefix match wins.
+    ("mxnet_tpu/serving/sharded.py",
+     {"run", "warmup", "compile_symbol_forward_sharded",
+      "placement_report", "_maybe_report"}, set()),
     # the generative decode plane's hot paths run once per TOKEN, not
     # per request: scheduler step + prefill, cache alloc/free/
     # reservation, token emission, and admission. A sync in any of

@@ -124,10 +124,11 @@ def unflatten_like(flat, like, prefix=""):
 
 def zero_shard_spec(leaf, dp):
     """Whether make_zero_train_step shards this leaf over dp —
-    literally the placement predicate (one shared implementation:
-    parallel/train_step.zero_shard_leaf), so the census expectation
-    and the placing rule cannot drift apart."""
-    from ..parallel.train_step import zero_shard_leaf
+    literally the placement predicate (one shared implementation,
+    now owned by the layout plane: parallel/layout.zero_shard_leaf),
+    so the census expectation and the placing rule cannot drift
+    apart."""
+    from ..parallel.layout import zero_shard_leaf
     return zero_shard_leaf(leaf, dp)
 
 

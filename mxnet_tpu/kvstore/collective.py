@@ -85,12 +85,17 @@ class CollectiveConn:
         if key not in self._reducers:
             import jax
             import jax.numpy as jnp
-            from jax.sharding import NamedSharding, PartitionSpec as P
 
+            # the device-group plumbing is the layout plane's one
+            # spelling (parallel/layout.collective_shardings): stacked
+            # worker slices in, replicated reduction out — the same
+            # vocabulary the train-step and serving placements read
+            from ..parallel.layout import collective_shardings
+            in_sh, out_sh = collective_shardings(self._mesh)
             self._reducers[key] = (
-                NamedSharding(self._mesh, P("proc")),
+                in_sh,
                 jax.jit(lambda x: jnp.sum(x, axis=0),
-                        out_shardings=NamedSharding(self._mesh, P())))
+                        out_shardings=out_sh))
         return self._reducers[key]
 
     def allreduce(self, value):

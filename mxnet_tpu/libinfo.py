@@ -222,6 +222,24 @@ _ENV_VARS = {
         ">0 starts the gateway health-probe daemon at this period: "
         "failed replicas drain, recovered ones rejoin (default 0 = "
         "manual check_health(); serving/gateway.py)"),
+    "MXTPU_SERVING_TP": (
+        "default tensor-parallel width for registered models/"
+        "generators: >= 2 makes every replica a MESH SLICE of that "
+        "many devices serving one SPMD program per batch, parameters "
+        "placed from the layout plane's role table (default 0 = "
+        "single-device lanes; serving/sharded.py, parallel/layout.py, "
+        "docs/serving.md)"),
+    "MXTPU_LAYOUT_TABLE": (
+        "path to a JSON layout-table override (SpecLayout.to_json "
+        "format): SpecLayout.default() — the table serving slices, "
+        "the sharded decode plane, and the dry-run CLI resolve "
+        "through — loads it instead of the built-in role table "
+        "(default unset; parallel/layout.py)"),
+    "MXTPU_LAYOUT_REPORT": (
+        "path: every sharded serving lane writes its per-parameter "
+        "placement report (role/spec/per-device bytes, the "
+        "layout_report document shape) here at registration, "
+        "atomically (default unset; serving/sharded.py)"),
     "MXTPU_GEN_BLOCK_TOKENS": (
         "default KV-cache block size in tokens for registered "
         "generators — the paged-attention page granularity (default "

@@ -21,7 +21,10 @@ Two composition styles, used where each is idiomatic:
   algorithm (ring attention, MoE dispatch, pipeline).
 """
 from .mesh import (create_mesh, auto_mesh_shape, mesh_sharding,
-                   shard_batch, shard_map)
+                   replica_devices, replica_slices, shard_batch,
+                   shard_map)
+from .layout import (SpecLayout, collective_shardings, dryrun_report,
+                     zero_shard_leaf)
 from .collectives import (allreduce, allgather, alltoall, axis_index,
                           axis_size, ppermute_next, reduce_scatter)
 from .ring_attention import ring_attention
@@ -35,7 +38,9 @@ from .train_step import (make_sharded_train_step,
 
 __all__ = [
     "create_mesh", "auto_mesh_shape", "mesh_sharding", "shard_batch",
-    "shard_map",
+    "shard_map", "replica_devices", "replica_slices",
+    "SpecLayout", "collective_shardings", "dryrun_report",
+    "zero_shard_leaf",
     "allreduce", "allgather", "alltoall", "axis_index", "axis_size",
     "ppermute_next", "reduce_scatter",
     "ring_attention", "ulysses_attention",

@@ -104,18 +104,80 @@ def shard_map(f, mesh=None, in_specs=None, out_specs=None,
                 **kwargs)
 
 
-def replica_devices(n, devices=None):
+def replica_devices(n, devices=None, exclude=()):
     """Device assignment for ``n`` replicas (serving lanes, ensemble
     members), degrading gracefully when the local mesh is smaller than
     asked — the SNIPPETS [2] mesh-shape fallback applied to a 1-D
     replica axis: replicas wrap around the available devices, so the
     same registration code serves a pod slice and a single chip.
+
+    ``exclude`` removes devices already committed elsewhere (the
+    gateway passes the union of its tp mesh-slice devices): wrapped
+    lanes place on what remains, and only when NOTHING remains do
+    they fall back onto the excluded set — with ``degraded`` forced
+    True, so a replicated lane can never silently share a device
+    with a tp slice (the overlap is always flagged).
+
     Returns ``(devices_list, degraded)`` where ``degraded`` is True
-    when replicas had to share devices."""
+    when replicas had to share devices (with each other or with the
+    excluded set)."""
     devs = list(devices if devices is not None else jax.local_devices())
     if not devs:
         raise ValueError("replica_devices: no local devices")
-    return [devs[i % len(devs)] for i in range(n)], n > len(devs)
+    excluded = {str(d) for d in exclude}
+    pool = [d for d in devs if str(d) not in excluded]
+    if not pool:
+        # every device is held by a slice: serve anyway (degrade, do
+        # not refuse), but the overlap is explicit in the flag
+        return [devs[i % len(devs)] for i in range(n)], True
+    return [pool[i % len(pool)] for i in range(n)], n > len(pool)
+
+
+def replica_slices(n, tp, devices=None, exclude=()):
+    """`replica_devices` generalized to mesh *slices*: ``n`` replica
+    lanes of ``tp`` devices each — each slice hosts one tp-sharded
+    SPMD program (a model bigger than one chip), carved from disjoint
+    contiguous runs of the device list. The layout plane's serving
+    placement: slices never overlap each other or ``exclude`` unless
+    the returned ``degraded`` flag says so.
+
+    Returns ``(slices, degraded)`` — ``slices`` a list of ``n``
+    tuples of ``tp`` DISTINCT devices (a mesh cannot repeat a
+    device); ``degraded`` True when slices had to share devices.
+    Raises when even one slice cannot be formed from distinct
+    devices."""
+    n, tp = int(n), int(tp)
+    if n < 1 or tp < 1:
+        raise ValueError(
+            f"replica_slices: need n >= 1 slices of tp >= 1 devices, "
+            f"got n={n}, tp={tp}")
+    devs = list(devices if devices is not None else jax.local_devices())
+    excluded = {str(d) for d in exclude}
+    pool = [d for d in devs if str(d) not in excluded]
+    degraded = False
+    if len(pool) < tp:
+        # cannot carve even one slice from the free pool: fall back
+        # to the full device list (flagged), or refuse when the host
+        # genuinely has fewer devices than one slice needs
+        if len(devs) < tp:
+            raise ValueError(
+                f"replica_slices: cannot carve a tp={tp} slice from "
+                f"{len(devs)} device(s) — a mesh cannot repeat a "
+                "device")
+        pool = devs
+        degraded = True
+    slices = []
+    for i in range(n):
+        start = i * tp
+        if start + tp <= len(pool):
+            slices.append(tuple(pool[start:start + tp]))
+        else:
+            # wrap: slices start sharing devices — degraded by
+            # definition (each slice still holds tp DISTINCT devices)
+            degraded = True
+            slices.append(tuple(pool[(start + j) % len(pool)]
+                                for j in range(tp)))
+    return slices, degraded
 
 
 # degraded-wrap warnings already emitted, keyed (ask, devices): the

@@ -45,3 +45,67 @@ def tp_mlp(x, w1, b1, w2, b2, axis_name="tp", act=jax.nn.gelu):
     down-projection with a single psum."""
     h = act(column_parallel_dense(x, w1, b1))
     return row_parallel_dense(h, w2, b2, axis_name=axis_name)
+
+
+def tp_mlp_param_specs(axis_name="tp", layout=None):
+    """The (w1, b1, w2, b2) PartitionSpecs for :func:`tp_mlp`, read
+    from the layout plane's role table instead of respelled here —
+    ``mlp-in`` is column-parallel and ``mlp-out`` row-parallel in the
+    table's (out, in) weight convention, but :func:`tp_mlp` takes
+    math-convention (in, out) operands, so the table specs transpose
+    on the way out. One vocabulary, two conventions, zero drift:
+    change the table and both the GSPMD train path and this shard_map
+    path move together."""
+    from jax.sharding import PartitionSpec as P
+
+    from .layout import SpecLayout
+    layout = layout or SpecLayout(tp_axis=axis_name)
+
+    def _t(spec):     # (out, in) table entry -> (in, out) operand,
+        e = _tp_only(spec, axis_name)      # tp axis only (shard_map
+        e = e + (None,) * (2 - len(e))     # meshes carry just tp)
+        out = [e[1], e[0]]
+        while out and out[-1] is None:
+            out.pop()
+        return P(*out)
+    w1 = _t(layout.spec_for("mlp_in_weight"))
+    w2 = _t(layout.spec_for("mlp_out_weight"))
+    # column-parallel bias shards with the output features it adds to
+    col = _tp_only(layout.spec_for("mlp_in_weight"), axis_name)
+    b1 = P(col[0] if col else None)
+    b2 = P(*_tp_only(layout.spec_for("bias"), axis_name))
+    return w1, b1, w2, b2
+
+
+def _tp_only(spec, axis_name):
+    """Project a table spec onto the lone tp axis a shard_map mesh
+    carries (fsdp/data entries drop; multi-axis dims keep tp)."""
+    out = []
+    for entry in tuple(spec):
+        axes = (entry,) if isinstance(entry, str) else \
+            tuple(entry or ())
+        out.append(axis_name if axis_name in axes else None)
+    while out and out[-1] is None:
+        out.pop()
+    return tuple(out)
+
+
+def tp_qkv_param_specs(axis_name="tp", layout=None):
+    """(w_qkv, w_out) PartitionSpecs for a Megatron attention block in
+    math convention (in, out), read from the same table
+    (``attention-qkv`` column-parallel, ``attention-out``
+    row-parallel)."""
+    from jax.sharding import PartitionSpec as P
+
+    from .layout import SpecLayout
+    layout = layout or SpecLayout(tp_axis=axis_name)
+
+    def _t(spec):
+        e = _tp_only(spec, axis_name)
+        e = e + (None,) * (2 - len(e))
+        out = [e[1], e[0]]
+        while out and out[-1] is None:
+            out.pop()
+        return P(*out)
+    return (_t(layout.spec_for("qkv_weight")),
+            _t(layout.spec_for("out_proj_weight")))

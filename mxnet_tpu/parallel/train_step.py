@@ -140,16 +140,11 @@ def make_sharded_train_step(loss_fn, mesh, param_example, batch_example,
     return step, params0, opt0
 
 
-def zero_shard_leaf(leaf, dp):
-    """THE per-leaf ZeRO sharding predicate: a leaf shards over the
-    dp axis iff its leading dimension divides evenly and is at least
-    dp; tiny or indivisible leaves stay replicated (they are the
-    cheap ones). One shared implementation — make_zero_train_step
-    places by it and elastic/reshard derives its post-reshape census
-    EXPECTATION from it, so the contract being verified and the rule
-    doing the placing cannot silently drift apart."""
-    shape = getattr(leaf, "shape", ())
-    return len(shape) >= 1 and shape[0] % dp == 0 and shape[0] >= dp
+# THE per-leaf ZeRO sharding predicate now lives in the layout plane
+# (parallel/layout.py) next to the role tables — re-exported here so
+# every historical consumer (elastic/reshard, tests) keeps its import
+# path while the spelling itself has one home.
+from .layout import zero_shard_leaf  # noqa: E402  (re-export)
 
 
 def make_zero_train_step(loss_fn, mesh, param_example, batch_example,
@@ -184,10 +179,12 @@ def make_zero_train_step(loss_fn, mesh, param_example, batch_example,
         raise ValueError(f"ZeRO stage must be 1, 2, or 3, got {stage}")
     dp = mesh.shape[dp_axis]
 
-    def _shard_spec(p):
-        return P(dp_axis) if zero_shard_leaf(p, dp) else P()
-
-    sharded = jax.tree_util.tree_map(_shard_spec, param_example)
+    # the layout plane owns the ZeRO spelling: one table consumer
+    # instead of a private _shard_spec (parallel/layout.py; the
+    # elastic census expectation reads the same zero_shard_leaf)
+    from .layout import SpecLayout
+    sharded = SpecLayout(data_axis=dp_axis).zero_specs(
+        param_example, dp, axis=dp_axis)
     return make_sharded_train_step(
         loss_fn, mesh, param_example, batch_example,
         batch_specs=batch_specs, lr=lr, momentum=momentum,

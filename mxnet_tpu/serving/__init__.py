@@ -21,6 +21,14 @@ Pieces (ROADMAP item 1, the "millions of users" direction):
   replicas, degrading gracefully to a single chip (SNIPPETS [2]'s
   mesh fallback), with health probes that drain and redistribute on
   failure;
+- **mesh-sliced (model-sharded) lanes** (:mod:`.sharded`) — a replica
+  can be a ``tp``-device submesh instead of one device: parameters
+  place from the layout plane's role table
+  (:class:`~mxnet_tpu.parallel.layout.SpecLayout` — the SAME table
+  training resolves through), each batch runs as one SPMD program per
+  slice, and the generate plane's paged KV pool shards its heads axis
+  over the slice (``Gateway.register(..., tp=2)`` /
+  ``register_generator(..., tp=2)`` / ``MXTPU_SERVING_TP``);
 - **generative decode plane** (:mod:`.generate`) — paged KV-cache
   block pools (census role ``kv_cache``), iteration-level continuous
   batching (requests join/leave the in-flight decode batch every
@@ -43,10 +51,14 @@ from .batcher import (ModelQueue, RejectedError, Request, ServingError,
 from .gateway import Gateway, Model, ModelRegistry, Replica
 from .generate import (BlockPool, BlockTable, GenerativeDecoder,
                        GenModel, GenRequest, reference_generate)
+from .sharded import (DIVERGENCE_BOUND, ShardedVariantSet,
+                      compile_symbol_forward_sharded)
 from .variants import VariantSet, default_buckets, pick_bucket
 
-__all__ = ["BlockPool", "BlockTable", "Gateway", "GenerativeDecoder",
-           "GenModel", "GenRequest", "Model", "ModelQueue",
-           "ModelRegistry", "RejectedError", "Replica", "Request",
-           "ServingError", "VariantSet", "default_buckets",
-           "pad_batch", "pick_bucket", "reference_generate"]
+__all__ = ["BlockPool", "BlockTable", "DIVERGENCE_BOUND", "Gateway",
+           "GenerativeDecoder", "GenModel", "GenRequest", "Model",
+           "ModelQueue", "ModelRegistry", "RejectedError", "Replica",
+           "Request", "ServingError", "ShardedVariantSet",
+           "VariantSet", "compile_symbol_forward_sharded",
+           "default_buckets", "pad_batch", "pick_bucket",
+           "reference_generate"]

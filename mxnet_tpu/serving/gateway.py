@@ -256,6 +256,7 @@ class Model:
         self.warmup_seconds = 0.0
         self.executables = 0
         self.degraded = False     # replicas wrap onto shared devices
+        self.tp = None            # >= 2: every replica is a mesh slice
         # elasticity seams (Gateway.scale): a factory that builds one
         # more replica lane on a device, and a monotonic lane id so a
         # retired idx is never reissued to a different lane's gauges
@@ -390,11 +391,54 @@ class Gateway:
             self._health_thread.start()
 
     # -- registration --------------------------------------------------------
+    def _sliced_devices(self):
+        """Devices currently held by tp mesh-slice lanes (models and
+        generators) — replicated-lane placement EXCLUDES these, so a
+        wrapped bs=1 lane never silently lands on a device a sharded
+        SPMD program owns (overlap only under the degraded flag)."""
+        held = []
+        for m in self.registry.models():
+            for rep in m.replicas:
+                dev = rep.device
+                if isinstance(dev, (list, tuple)) and len(dev) > 1:
+                    held.extend(dev)
+        with self._gen_lock:
+            gens = list(self._generators.values())
+        for g in gens:
+            for ln in g.lanes:
+                dev = ln.device
+                if isinstance(dev, (list, tuple)) and len(dev) > 1:
+                    held.extend(dev)
+        return held
+
+    def _pick_slices(self, n, tp):
+        """Mesh-slice placement for ``n`` tp-sharded lanes, carved
+        from devices no other slice holds (same layout-plane
+        doctrine: overlap is degraded, never silent). Scale-out picks
+        only the ADDITIONAL slices through this, so a rescale never
+        re-excludes the model's own devices (which would spuriously
+        degrade an exactly-fitting host)."""
+        from ..parallel.mesh import replica_slices, should_warn_degraded
+        devs = self._devices
+        slices, degraded = replica_slices(
+            n, tp, devices=devs, exclude=self._sliced_devices())
+        flat = [d for s in slices for d in s]
+        if degraded and should_warn_degraded(n * tp, flat):
+            logger.warning(
+                "serving: %d slice(s) x tp=%d requested but the free "
+                "device pool cannot hold them disjointly; degrading "
+                "(slices share devices)", n, tp)
+        return slices, degraded
+
     def _pick_devices(self, n):
         from ..parallel.mesh import replica_devices, should_warn_degraded
         # self._devices None = the full local mesh, re-read per
-        # registration (a constructor-pinned pool stays pinned)
-        picked, degraded = replica_devices(n, devices=self._devices)
+        # registration (a constructor-pinned pool stays pinned).
+        # Devices held by tp mesh slices are excluded: a replicated
+        # lane wraps onto them only when nothing else exists, and
+        # then the degraded flag says so (never a silent overlap)
+        picked, degraded = replica_devices(
+            n, devices=self._devices, exclude=self._sliced_devices())
         if degraded and should_warn_degraded(n, picked):
             # SNIPPETS [2] degrade pattern (parallel/mesh.py): serve
             # with the mesh that exists instead of refusing — replicas
@@ -422,7 +466,7 @@ class Gateway:
                  buckets=None, max_batch=None, max_wait_ms=None,
                  max_queue=None, slo_ms=None, replicas=None,
                  input_dtype="float32", int8_lowering="auto",
-                 warmup=True):
+                 warmup=True, tp=None, layout=None):
         """Register a model and AOT-compile its serving executables.
 
         ``input_shapes`` is ``{input_name: feature_shape}`` for the ONE
@@ -432,9 +476,31 @@ class Gateway:
         ``slo_ms`` of 0/None disables latency-budget rejection;
         ``max_wait_ms``/``max_queue``/``replicas`` default from the
         ``MXTPU_SERVING_*`` env knobs.
+
+        ``tp >= 2`` makes every replica a **mesh slice**: a tp-device
+        submesh serving one SPMD program per batch, parameters placed
+        from the layout plane's role table (``layout`` overrides the
+        process default) — how a model bigger than one chip serves.
+        Defaults from ``MXTPU_SERVING_TP`` (0 = single-device lanes).
         """
         if self._closed:
             raise ServingError("serving: gateway is closed")
+        if tp is None:
+            tp = int(get_env("MXTPU_SERVING_TP", 0, int)) or None
+        if tp is not None:
+            tp = int(tp)
+            if tp == 1:
+                tp = None     # a 1-device "slice" is a plain lane
+            elif tp < 1:
+                raise ServingError(
+                    f"serving: tp must be >= 1, got {tp}")
+        if tp is not None:
+            from .sharded import SHARDED_VARIANTS
+            bad = [v for v in variants if v not in SHARDED_VARIANTS]
+            if bad:
+                raise ServingError(
+                    f"serving: variants {bad} have no sharded "
+                    f"lowering (tp slices serve {SHARDED_VARIANTS})")
         if len(input_shapes) != 1:
             raise ServingError(
                 "serving: exactly one data input per model (got "
@@ -475,6 +541,7 @@ class Gateway:
                       max_queue=max_queue,
                       slo_s=(slo_ms / 1e3) if slo_ms else None,
                       variants=tuple(variants))
+        model.tp = tp
         t0 = clock.now_ns()
         met = _met()
 
@@ -482,20 +549,36 @@ class Gateway:
             # the one place a serving lane is built — registration and
             # Gateway.scale (the elasticity plane) share it, so a
             # scaled-out replica is compiled/warmed exactly like a
-            # registered one
-            vs = VariantSet(symbol, arg_params, aux_params, input_name,
-                            feature_shape, variants=variants,
-                            device=device, calib_data=calib_data,
-                            calib_mode=calib_mode,
-                            excluded_sym_names=excluded_sym_names,
-                            input_dtype=input_dtype,
-                            int8_lowering=int8_lowering)
+            # registered one. ``device`` is a jax device for a plain
+            # lane, or a tuple of tp devices for a mesh slice — the
+            # Replica machinery (scheduler, probe, drain, scale) is
+            # identical either way
+            if isinstance(device, (list, tuple)) and len(device) > 1:
+                from .sharded import ShardedVariantSet
+                vs = ShardedVariantSet(
+                    symbol, arg_params, aux_params, input_name,
+                    feature_shape, devices=device, variants=variants,
+                    layout=layout, input_dtype=input_dtype)
+            else:
+                if isinstance(device, (list, tuple)):
+                    device = device[0]
+                vs = VariantSet(symbol, arg_params, aux_params,
+                                input_name,
+                                feature_shape, variants=variants,
+                                device=device, calib_data=calib_data,
+                                calib_mode=calib_mode,
+                                excluded_sym_names=excluded_sym_names,
+                                input_dtype=input_dtype,
+                                int8_lowering=int8_lowering)
             rep = Replica(m, idx, device, vs)
             executables = vs.warmup(buckets) if warmup else 0
             return rep, executables
 
         model._replica_factory = build_replica
-        picked, degraded = self._pick_devices(replicas)
+        if tp is not None:
+            picked, degraded = self._pick_slices(replicas, tp)
+        else:
+            picked, degraded = self._pick_devices(replicas)
         model.degraded = degraded
         for idx, device in enumerate(picked):
             rep, n_exec = build_replica(model, idx, device)
@@ -540,7 +623,8 @@ class Gateway:
     def register_generator(self, name, decoder, block_tokens=None,
                            max_blocks=None, max_new_tokens=None,
                            max_decode_batch=8, max_queue=None,
-                           replicas=None, warmup=True):
+                           replicas=None, warmup=True, tp=None,
+                           layout=None):
         """Register a decoder LM for token-granular generation.
 
         ``decoder`` is a :class:`~.generate.GenerativeDecoder` (gluon
@@ -574,6 +658,15 @@ class Gateway:
         if replicas < 1:
             raise ServingError(
                 f"serving: replicas must be >= 1, got {replicas}")
+        if tp is None:
+            tp = int(get_env("MXTPU_SERVING_TP", 0, int)) or None
+        if tp is not None:
+            tp = int(tp)
+            if tp == 1:
+                tp = None
+            elif tp < 1:
+                raise ServingError(
+                    f"serving: tp must be >= 1, got {tp}")
         with self._gen_lock:
             if name in self._generators:
                 raise ServingError(
@@ -581,14 +674,21 @@ class Gateway:
         if name in self.registry.names():
             raise ServingError(
                 f"serving: model {name!r} already registered")
-        gen_devices, gen_degraded = self._pick_devices(replicas)
+        if tp is not None:
+            # mesh-sliced decode lanes: the paged KV pool shards its
+            # heads axis over each slice, parameters place from the
+            # layout table (serving/generate/model.py)
+            gen_devices, gen_degraded = self._pick_slices(replicas, tp)
+        else:
+            gen_devices, gen_degraded = self._pick_devices(replicas)
         gen = GenModel(name, decoder,
                        devices=gen_devices,
                        block_tokens=block_tokens,
                        max_blocks=max_blocks,
                        max_new_tokens=max_new_tokens,
                        max_decode_batch=max_decode_batch,
-                       max_queue=max_queue, warmup=warmup)
+                       max_queue=max_queue, warmup=warmup, tp=tp,
+                       layout=layout)
         gen.degraded = gen_degraded
         # re-check BOTH namespaces at insert: a concurrent register()
         # or register_generator() of the same name can have landed
@@ -844,7 +944,24 @@ class Gateway:
         with self._gen_lock:
             gen = self._generators.get(name)
         if gen is not None:
-            picked, degraded = self._pick_devices(n)
+            if gen.tp is not None:
+                # pick only the NEW slices (the existing lanes keep
+                # their devices); scale_to indexes devices[active:],
+                # so the placement list is existing + new
+                with gen.cond:
+                    active = [ln.device for ln in gen.lanes
+                              if not ln.retiring]
+                extra = max(n - len(active), 0)
+                if extra:
+                    new_slices, new_deg = self._pick_slices(extra,
+                                                            gen.tp)
+                else:
+                    new_slices, new_deg = [], False
+                picked = list(active) + new_slices
+                degraded = new_deg or \
+                    n * gen.tp > self.device_count()
+            else:
+                picked, degraded = self._pick_devices(n)
             report = gen.scale_to(n, picked)
             gen.degraded = degraded
             report["degraded"] = degraded
@@ -859,7 +976,18 @@ class Gateway:
                           direction="out" if n > cur else "in",
                           replicas_from=cur, replicas_to=n):
             if n > cur:
-                picked, degraded = self._pick_devices(n)
+                if m.tp is not None:
+                    # only the ADDITIONAL slices are placed — the
+                    # existing lanes keep their devices, and the new
+                    # carve excludes every held slice (own included)
+                    new_slices, new_deg = self._pick_slices(n - cur,
+                                                            m.tp)
+                    picked = [r.device for r in m.replicas] + \
+                        new_slices
+                    degraded = new_deg or \
+                        n * m.tp > self.device_count()
+                else:
+                    picked, degraded = self._pick_devices(n)
                 m.degraded = degraded
                 report["degraded"] = degraded
                 met = _met()
@@ -887,7 +1015,8 @@ class Gateway:
                     report["retired"] += 1
                 # shrinking can also UN-degrade: stats() must reflect
                 # the new width or the autoscaler never asks again
-                m.degraded = n > self.device_count()
+                # (a tp model needs n slices x tp devices)
+                m.degraded = n * (m.tp or 1) > self.device_count()
                 report["degraded"] = m.degraded
         return report
 
@@ -937,6 +1066,8 @@ class Gateway:
                 # hardware cannot isolate (satellite of the mesh
                 # warning dedupe — warn once, expose the state here)
                 "degraded": m.degraded,
+                # >= 2: every replica is a tp mesh slice (sharded.py)
+                "tp": m.tp,
                 "int8_lowering": (m.replicas[0].variant_set
                                   .int8_lowering if m.replicas
                                   else None),

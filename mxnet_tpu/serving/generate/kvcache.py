@@ -45,7 +45,8 @@ class BlockPool:
     threads reserve/unreserve at admission."""
 
     def __init__(self, num_layers, num_heads, head_dim, block_tokens,
-                 max_blocks, device=None, dtype="float32"):
+                 max_blocks, device=None, dtype="float32",
+                 tp_axis="tp"):
         import jax
         import jax.numpy as jnp
 
@@ -67,11 +68,35 @@ class BlockPool:
         self.dtype = np.dtype(dtype)
         shape = (self.num_layers, self.max_blocks, self.block_tokens,
                  self.num_heads, self.head_dim)
+        # mesh-sliced lane (layout plane): ``device`` is a tuple of
+        # tp devices — the pool shards its HEADS axis over the slice,
+        # the same partitioning the layout table gives attention
+        # weights, so each device holds exactly bytes_total/tp of
+        # cache (census-verified per device, byte-exact)
+        self.mesh = None
+        self.tp = 1
+        placement = device
+        if isinstance(device, (list, tuple)) and len(device) > 1:
+            from ...parallel.mesh import create_mesh
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            if self.num_heads % len(device):
+                raise MXNetError(
+                    f"generate: {self.num_heads} heads do not shard "
+                    f"over a tp={len(device)} slice (heads % tp must "
+                    "be 0)")
+            self.tp = len(device)
+            self.mesh = create_mesh({tp_axis: self.tp},
+                                    devices=list(device))
+            placement = NamedSharding(
+                self.mesh, P(None, None, None, tp_axis, None))
+        elif isinstance(device, (list, tuple)):
+            placement = device[0]
+            self.device = device[0]
         # two separate allocations: device_put of one zeros array
         # twice returns the SAME buffer, which would alias K onto V
         # (and halve the real footprint vs the claimed one)
-        self.k = jax.device_put(jnp.zeros(shape, self.dtype), device)
-        self.v = jax.device_put(jnp.zeros(shape, self.dtype), device)
+        self.k = jax.device_put(jnp.zeros(shape, self.dtype), placement)
+        self.v = jax.device_put(jnp.zeros(shape, self.dtype), placement)
         _mem.tag_role(self.k, "kv_cache")
         _mem.tag_role(self.v, "kv_cache")
         self._lock = threading.Lock()

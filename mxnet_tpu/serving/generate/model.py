@@ -197,7 +197,8 @@ class CompiledDecodeSteps:
     """One lane's jitted prefill/decode executables, bound to a device
     and a :class:`~.kvcache.BlockPool` geometry."""
 
-    def __init__(self, decoder, pool, table_width, device=None):
+    def __init__(self, decoder, pool, table_width, device=None,
+                 layout=None):
         import jax
 
         from ...profiling import memory as _mem
@@ -210,10 +211,27 @@ class CompiledDecodeSteps:
         # ignores it with a warning per call — skip it there (same
         # call as parallel/train_step.py)
         donate = jax.default_backend() != "cpu"
-        self.params = jax.tree_util.tree_map(
-            lambda a: _mem.tag_role(jax.device_put(a, device),
-                                    "parameter"),
-            decoder.param_tree())
+        if pool.mesh is not None:
+            # mesh-sliced lane: parameters land under the layout
+            # table's NamedShardings over the slice (the SAME table
+            # training resolves through — qkv/mlp-in column-parallel,
+            # proj/mlp-out row-parallel, embed/head vocab-sharded);
+            # the jitted steps become one SPMD program per slice
+            from ...parallel.layout import SpecLayout
+            layout = layout if layout is not None \
+                else SpecLayout.default()
+            self.layout = layout
+            shardings = layout.resolve(decoder.param_tree(), pool.mesh)
+            self.params = jax.tree_util.tree_map(
+                lambda a, sh: _mem.tag_role(jax.device_put(a, sh),
+                                            "parameter"),
+                decoder.param_tree(), shardings)
+        else:
+            self.layout = None
+            self.params = jax.tree_util.tree_map(
+                lambda a: _mem.tag_role(jax.device_put(a, device),
+                                        "parameter"),
+                decoder.param_tree())
         self._prefill = jax.jit(
             functools.partial(_prefill_impl, num_heads=decoder.num_heads,
                               block_tokens=pool.block_tokens),

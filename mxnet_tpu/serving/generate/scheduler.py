@@ -410,9 +410,15 @@ class GenModel:
 
     def __init__(self, name, decoder, devices, block_tokens,
                  max_blocks, max_new_tokens, max_decode_batch,
-                 max_queue, warmup=True):
+                 max_queue, warmup=True, tp=None, layout=None):
         self.name = name
         self.decoder = decoder
+        # tp >= 2: every lane is a mesh slice (devices = list of
+        # tp-device tuples); the KV pool shards its heads axis over
+        # the slice and the compiled steps run as one SPMD program,
+        # parameters placed from the layout plane's role table
+        self.tp = tp
+        self.layout = layout
         self.eos_id = decoder.eos_id
         self.block_tokens = int(block_tokens)
         self.max_blocks = int(max_blocks)
@@ -457,13 +463,16 @@ class GenModel:
         share this, so a scaled-out lane is AOT-compiled exactly like
         a registered one. The caller starts it."""
         from .model import CompiledDecodeSteps
+        if isinstance(device, (list, tuple)) and len(device) == 1:
+            device = device[0]       # a 1-device "slice" = plain lane
         pool = BlockPool(self.decoder.num_layers,
                          self.decoder.num_heads,
                          self.decoder.head_dim, self.block_tokens,
                          self.max_blocks, device=device,
                          dtype=self.decoder.dtype)
         steps = CompiledDecodeSteps(self.decoder, pool,
-                                    self.table_width, device)
+                                    self.table_width, device,
+                                    layout=self.layout)
         lane = GenLane(self, self._next_idx, device, steps, pool)
         self._next_idx += 1
         if self._warmup_lanes:
@@ -640,6 +649,7 @@ class GenModel:
             "executables": self.executables,
             "warmup_seconds": round(self.warmup_seconds, 3),
             "degraded": self.degraded,
+            "tp": self.tp,
             "lanes": [
                 {"idx": ln.idx, "device": str(ln.device),
                  "retiring": ln.retiring,

@@ -1,0 +1,194 @@
+"""Mesh-sliced serving: a replica lane that is a tp submesh, not a
+device — the model-sharded half of the layout plane.
+
+The PR 10 gateway's :class:`~mxnet_tpu.serving.variants.VariantSet`
+commits one replica's parameters to ONE device; a model bigger than a
+chip simply could not register. A :class:`ShardedVariantSet` commits
+them to a **mesh slice** instead: ``tp`` devices form a one-axis
+:class:`~jax.sharding.Mesh`, every parameter lands under the
+:class:`~mxnet_tpu.parallel.layout.SpecLayout` table's NamedSharding
+for its role — the SAME table training resolves through — and each
+padded batch executes as ONE jitted SPMD program per slice (GSPMD
+inserts the row-parallel all-reduces; the column-parallel chain splits
+no contraction and stays mathematically exact).
+
+Numerics contract: a tp-sharded fp32 forward may differ from the
+single-device reference by reduction reassociation on the row-parallel
+layers — bounded, measured, and committed (``serving_bench`` stage
+``sharded`` pins the divergence against :data:`DIVERGENCE_BOUND`;
+bitwise when the layout resolves column-parallel only).
+
+Placement hygiene: slices come from
+:func:`~mxnet_tpu.parallel.mesh.replica_slices`, and the gateway
+excludes slice-held devices when wrapping replicated bs=1 lanes — a
+sliced and a wrapped lane never share a device unless the ``degraded``
+flag says so.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError, get_env
+from ..parallel.layout import SpecLayout
+from ..parallel.mesh import create_mesh
+
+#: documented fp32 divergence ceiling of a tp-sharded forward vs the
+#: single-device reference (reduction reassociation on row-parallel
+#: layers; serving_bench commits the measured number against it)
+DIVERGENCE_BOUND = 5e-5
+
+SHARDED_VARIANTS = ("fp32", "bf16")
+
+
+def compile_symbol_forward_sharded(symbol, bindings, mesh, layout,
+                                   cast=None):
+    """The sharded twin of :func:`~mxnet_tpu.predictor.
+    compile_symbol_forward`: commit ``bindings`` under the layout
+    table's NamedShardings over ``mesh`` and return ``(jitted,
+    param_vals)`` where ``jitted(param_vals, inputs_dict)`` runs the
+    symbol as one SPMD program with replicated (host-gatherable)
+    outputs."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..ndarray.ndarray import NDArray
+
+    names = sorted(bindings)
+    cast_dt = jnp.dtype(cast) if cast is not None else None
+
+    def _cast(a):
+        if cast_dt is not None and jnp.issubdtype(a.dtype,
+                                                  jnp.floating):
+            return a.astype(cast_dt)
+        return a
+
+    vals = []
+    for n in names:
+        v = bindings[n]
+        a = _cast(v._data if isinstance(v, NDArray)
+                  else jnp.asarray(np.asarray(v)))
+        sh = NamedSharding(
+            mesh, layout.spec_for(n, shape=a.shape, mesh=mesh))
+        vals.append(jax.device_put(a, sh))
+    vals = tuple(vals)
+
+    def fwd(param_vals, inputs):
+        b = {n: NDArray(v) for n, v in zip(names, param_vals)}
+        for k, v in inputs.items():
+            b[k] = NDArray(_cast(jnp.asarray(v)))
+        out = symbol.eval_dict(b)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        res = []
+        for o in outs:
+            a = o._data
+            if cast_dt is not None and \
+                    jnp.issubdtype(a.dtype, jnp.floating):
+                a = a.astype(jnp.float32)
+            res.append(a)
+        return tuple(res)
+
+    jitted = jax.jit(fwd, out_shardings=NamedSharding(mesh, P()))
+    return jitted, vals
+
+
+class ShardedVariantSet:
+    """One mesh-slice lane's compiled forwards — duck-types
+    :class:`~mxnet_tpu.serving.variants.VariantSet` (``run`` /
+    ``warmup`` / the attributes the gateway's Replica reads), so the
+    scheduler, health-probe, drain and scale machinery all work on a
+    sliced lane unchanged.
+
+    ``devices`` is the slice (``tp`` distinct devices); ``layout``
+    defaults to :meth:`SpecLayout.default` (the process table, env-
+    overridable) with this set's ``tp_axis``. Variants: ``fp32`` and
+    ``bf16`` (offline-cast params, fp32 replies). ``int8`` is not
+    offered on slices — the quantized graph's per-op ranges do not
+    yet carry a sharded story, and refusing beats mis-serving."""
+
+    def __init__(self, symbol, arg_params, aux_params, input_name,
+                 feature_shape, devices, variants=("fp32",),
+                 layout=None, input_dtype="float32", tp_axis="tp"):
+        devices = tuple(devices)
+        if len(devices) < 2:
+            raise MXNetError(
+                f"serving: a sharded lane needs a slice of >= 2 "
+                f"devices, got {len(devices)} (use VariantSet for "
+                "single-device lanes)")
+        if len(set(str(d) for d in devices)) != len(devices):
+            raise MXNetError(
+                "serving: a mesh slice cannot repeat a device")
+        self.input_name = input_name
+        self.feature_shape = tuple(int(s) for s in feature_shape)
+        self.input_dtype = np.dtype(input_dtype)
+        self.device = devices          # what stats()/logs display
+        self.devices = devices
+        self.tp = len(devices)
+        self.tp_axis = tp_axis
+        self.variants = tuple(variants)
+        self.num_outputs = len(symbol.list_outputs())
+        self.int8_lowering = None      # the VariantSet contract slot
+        self.layout = layout if layout is not None \
+            else SpecLayout.default()
+        self.mesh = create_mesh({tp_axis: len(devices)},
+                                devices=list(devices))
+        self._fns = {}
+        bindings = dict(arg_params)
+        bindings.update(aux_params)
+        self._binding_names = tuple(sorted(bindings))
+        for v in self.variants:
+            if v not in SHARDED_VARIANTS:
+                raise MXNetError(
+                    f"serving: sharded lanes serve {SHARDED_VARIANTS}"
+                    f", not {v!r} (int8 has no sharded lowering yet)")
+            self._fns[v] = compile_symbol_forward_sharded(
+                symbol, bindings, self.mesh, self.layout,
+                cast="bfloat16" if v == "bf16" else None)
+        self._maybe_report(bindings)
+
+    def _maybe_report(self, bindings):
+        """MXTPU_LAYOUT_REPORT: drop this lane's per-parameter
+        placement report (atomic write) for audit — the serving twin
+        of the dry-run artifact."""
+        path = get_env("MXTPU_LAYOUT_REPORT", "", str)
+        if not path:
+            return
+        import json
+
+        from ..checkpoint import atomic_write
+        doc = self.placement_report()
+        with atomic_write(path, mode="w", manifest=False) as f:
+            f.write(json.dumps(doc, indent=1) + "\n")
+
+    def placement_report(self):
+        """Per-parameter placement of this slice (layout-plane report
+        shape): every binding's role, spec, and per-device bytes —
+        pvals were committed in sorted-name order by the compiler."""
+        from ..parallel.layout import dryrun_report
+        _, pvals = self._fns[self.variants[0]]
+        tree = dict(zip(self._binding_names, pvals))
+        return dryrun_report(
+            self.layout, tree, self.mesh,
+            extra={"kind": "serving_slice", "tp": self.tp})
+
+    # -- dispatch (the VariantSet contract) ----------------------------------
+    def run(self, variant, batch):
+        """Execute one padded batch as ONE SPMD program over the
+        slice; numpy in, list-of-numpy out (the ``np.asarray`` is the
+        reply's host transfer — outputs are replicated, so the gather
+        is a local read)."""
+        fn, pvals = self._fns[variant]
+        outs = fn(pvals, {self.input_name: np.ascontiguousarray(batch)})
+        return [np.asarray(o) for o in outs]
+
+    def warmup(self, buckets):
+        """AOT-compile every (variant, bucket) SPMD executable —
+        steady-state sharded serving never retraces."""
+        n = 0
+        for variant in self.variants:
+            for b in buckets:
+                zeros = np.zeros((b,) + self.feature_shape,
+                                 self.input_dtype)
+                self.run(variant, zeros)
+                n += 1
+        return n

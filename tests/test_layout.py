@@ -1,0 +1,410 @@
+"""Layout plane (ISSUE 15): SpecLayout role tables, the resolver,
+mesh-fit normalization, JSON round-trip, the ZeRO/collective/tp
+consumers, replica slices + the overlap doctrine, and the pod-scale
+dry-run report."""
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import (SpecLayout, create_mesh,
+                                replica_devices, replica_slices)
+from mxnet_tpu.parallel.layout import (collective_shardings,
+                                       collectives_summary,
+                                       dryrun_report, spec_from_json,
+                                       spec_to_json, zero_shard_leaf)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def decoder_tree(vocab=50, d=32, layers=1):
+    rng = np.random.default_rng(0)
+
+    def w(*shape):
+        return rng.normal(0, 0.02, shape).astype(np.float32)
+    lt = [{"ln1_g": np.ones(d, np.float32),
+           "ln1_b": np.zeros(d, np.float32),
+           "qkv_w": w(3 * d, d), "qkv_b": np.zeros(3 * d, np.float32),
+           "proj_w": w(d, d), "proj_b": np.zeros(d, np.float32),
+           "ln2_g": np.ones(d, np.float32),
+           "ln2_b": np.zeros(d, np.float32),
+           "ff1_w": w(4 * d, d), "ff1_b": np.zeros(4 * d, np.float32),
+           "ff2_w": w(d, 4 * d), "ff2_b": np.zeros(d, np.float32)}
+          for _ in range(layers)]
+    return {"embed_w": w(vocab, d), "layers": lt,
+            "lnf_g": np.ones(d, np.float32),
+            "lnf_b": np.zeros(d, np.float32), "head_w": w(vocab, d)}
+
+
+# -- roles -------------------------------------------------------------------
+def test_role_regex_resolution_decoder_pytree():
+    lay = SpecLayout()
+    want = {
+        "embed_w": "embedding",
+        "layers/0/ln1_g": "norm", "layers/0/ln1_b": "norm",
+        "layers/0/qkv_w": "attention-qkv", "layers/0/qkv_b": "bias",
+        "layers/0/proj_w": "attention-out", "layers/0/proj_b": "bias",
+        "layers/0/ff1_w": "mlp-in", "layers/0/ff2_w": "mlp-out",
+        "lnf_g": "norm", "head_w": "embedding",
+    }
+    for path, role in want.items():
+        assert lay.role_of(path) == role, path
+
+
+def test_role_regex_resolution_gluon_resnet_pytree():
+    from mxnet_tpu.gluon.model_zoo import vision
+    net = vision.get_model("resnet18_v1", classes=7)
+    net.initialize()
+    params = net.collect_params()
+    lay = SpecLayout()
+    roles = {name: lay.role_of(name) for name in params}
+    # the three families the table must name on a vision net
+    assert any(r == "norm" for n, r in roles.items()
+               if "batchnorm" in n and n.endswith("gamma"))
+    assert all(roles[n] == "norm" for n in roles
+               if n.endswith(("running_mean", "running_var")))
+    dense_w = [n for n in roles if "dense" in n and
+               n.endswith("weight")]
+    assert dense_w and all(roles[n] == "mlp-in" for n in dense_w)
+    assert all(roles[n] == "bias" for n in roles
+               if n.endswith("bias"))
+    # conv kernels have no tp story in the default table: replicated
+    conv_w = [n for n in roles if "conv" in n and n.endswith("weight")]
+    assert conv_w and all(roles[n] == "default" for n in conv_w)
+
+
+def test_llama_style_mlp_projections_are_column_parallel():
+    """Regression: attention-out's bare 'proj' alternative must not
+    shadow the MLP rules — up/gate projections are column-parallel,
+    down row-parallel, o_proj attention-out."""
+    lay = SpecLayout()
+    assert lay.role_of("layers/0/up_proj_weight") == "mlp-in"
+    assert lay.role_of("layers/0/gate_proj_weight") == "mlp-in"
+    assert lay.role_of("layers/0/down_proj_weight") == "mlp-out"
+    assert lay.role_of("layers/0/o_proj_weight") == "attention-out"
+
+
+def test_from_json_round_trips_custom_role_with_rule():
+    """Regression: a table defining a NEW role plus a rule naming it
+    must load back through from_json (rules validate against the
+    merged table, not the defaults)."""
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel.layout import _DEFAULT_RULES
+    lay = SpecLayout(table={"moe-expert": P("tp", None)},
+                     rules=list(_DEFAULT_RULES) +
+                     [(r"expert\w*_w$", "moe-expert")])
+    doc = json.loads(json.dumps(lay.to_json()))
+    lay2 = SpecLayout.from_json(doc)
+    assert lay2.role_of("expert0_w") == "moe-expert"
+    assert lay2.spec_for("expert0_w") == P("tp", None)
+    assert lay2.to_json() == lay.to_json()
+
+
+def test_overrides_win_over_rules():
+    from jax.sharding import PartitionSpec as P
+    lay = SpecLayout(overrides=[(r"^special_w$", "attention-out"),
+                                (r"pinned", P(None, "tp"))])
+    assert lay.role_of("special_w") == "attention-out"
+    assert lay.spec_for("pinned_weight") == P(None, "tp")
+    # everything else still resolves through the rules
+    assert lay.role_of("fc0_weight") == "mlp-in"
+
+
+# -- mesh-fit normalization --------------------------------------------------
+def test_fit_drops_absent_and_indivisible_axes():
+    from jax.sharding import PartitionSpec as P
+    lay = SpecLayout()
+    mesh = create_mesh({"data": 4, "tp": 2})
+    # fsdp absent from the mesh: qkv (6, 4) -> P('tp') on dim 0
+    assert lay.spec_for("qkv_w", shape=(6, 4), mesh=mesh) == P("tp")
+    # dim 0 indivisible by tp: spec degrades to replicated
+    assert lay.spec_for("qkv_w", shape=(7, 4), mesh=mesh) == P()
+    # 1-D bias under a 2-entry table spec: truncated to rank
+    assert lay.spec_for("layers/0/qkv_b", shape=(6,), mesh=mesh) == P()
+
+
+def test_axis_used_once_across_dims():
+    from jax.sharding import PartitionSpec as P
+    lay = SpecLayout(overrides=[(r"both", P("tp", "tp"))])
+    mesh = create_mesh({"tp": 2, "data": 4})
+    assert lay.spec_for("both_w", shape=(4, 4), mesh=mesh) == P("tp")
+
+
+# -- JSON round trip ---------------------------------------------------------
+def test_layout_table_json_round_trip():
+    from jax.sharding import PartitionSpec as P
+    lay = SpecLayout(tp_axis="model",
+                     table={"embedding": P("model", None)},
+                     overrides=[(r"^x$", "norm"),
+                                (r"^y$", P(None, "model"))])
+    doc = json.loads(json.dumps(lay.to_json()))
+    lay2 = SpecLayout.from_json(doc)
+    assert lay2.to_json() == lay.to_json()
+    assert lay2.tp_axis == "model"
+    assert lay2.role_of("x") == "norm"
+    assert lay2.spec_for("y") == P(None, "model")
+    tree = decoder_tree()
+    assert lay2.resolve_specs(tree) == lay.resolve_specs(tree)
+
+
+def test_spec_json_helpers():
+    from jax.sharding import PartitionSpec as P
+    for spec in (P(), P("tp"), P(None, "tp"), P(("fsdp", "tp"), None)):
+        assert spec_from_json(spec_to_json(spec)) == spec
+
+
+def test_layout_default_env_table(tmp_path, monkeypatch):
+    from jax.sharding import PartitionSpec as P
+    lay = SpecLayout(overrides=[(r"^pinme$", P("tp", None))])
+    p = tmp_path / "table.json"
+    p.write_text(json.dumps(lay.to_json()))
+    monkeypatch.setenv("MXTPU_LAYOUT_TABLE", str(p))
+    got = SpecLayout.default()
+    assert got.spec_for("pinme") == P("tp", None)
+    monkeypatch.setenv("MXTPU_LAYOUT_TABLE", str(p) + ".missing")
+    with pytest.raises(mx.base.MXNetError):
+        SpecLayout.default()
+
+
+# -- the ZeRO consumer (behavior preservation) -------------------------------
+def test_zero_specs_match_historic_predicate():
+    from jax.sharding import PartitionSpec as P
+    lay = SpecLayout(data_axis="dp")
+    tree = {"big": np.zeros((8, 3)), "odd": np.zeros((7, 3)),
+            "tiny": np.zeros((2,)), "scalar": np.zeros(())}
+    specs = lay.zero_specs(tree, dp=4, axis="dp")
+    for k, leaf in tree.items():
+        want = P("dp") if zero_shard_leaf(leaf, 4) else P()
+        assert specs[k] == want, k
+    assert specs["big"] == P("dp") and specs["odd"] == P()
+    assert specs["scalar"] == P()
+
+
+def test_zero_specs_compose_with_tp_base():
+    from jax.sharding import PartitionSpec as P
+    lay = SpecLayout()
+    mesh = create_mesh({"data": 4, "tp": 2})
+    tree = {"colp": np.zeros((8, 4), np.float32),    # dim0 tp-sharded
+            "rowp": np.zeros((8, 4), np.float32)}    # dim1 tp-sharded
+    base = {"colp": P("tp"), "rowp": P(None, "tp")}
+    out = lay.zero_specs(tree, dp=4, axis="data", base=base)
+    # dim 0 already taken by tp -> base untouched; free dim 0 gains
+    # the data axis IN FRONT of the preserved tail
+    assert out["colp"] == P("tp")
+    assert out["rowp"] == P("data", "tp")
+
+
+def test_zero_train_step_still_lowers_with_expected_sharding():
+    """make_zero_train_step through the layout table must produce the
+    same per-leaf shardings the historic private spelling did."""
+    import jax
+    from mxnet_tpu.parallel import make_zero_train_step
+
+    mesh = create_mesh({"dp": 8})
+    params = {"w": np.ones((8, 3), np.float32),
+              "b": np.zeros((3,), np.float32)}
+    batch = {"x": np.ones((8, 3), np.float32),
+             "y": np.ones((8,), np.float32)}
+
+    def loss_fn(p, b):
+        return ((b["x"] @ p["w"].T).mean(-1) - b["y"]).mean() ** 2 + \
+            p["b"].sum() * 0
+
+    step, p0, o0 = make_zero_train_step(loss_fn, mesh, params, batch,
+                                        stage=2)
+    # state shards 1/dp for the (8, 3) leaf, replicates the (3,) bias
+    w_sh = o0["w"].sharding.spec
+    b_sh = o0["b"].sharding.spec
+    assert tuple(w_sh) == ("dp",)
+    assert tuple(b_sh) == ()
+    # params stay replicated below stage 3
+    assert tuple(p0["w"].sharding.spec) == ()
+
+
+# -- the collective consumer -------------------------------------------------
+def test_collective_shardings_spelling():
+    from jax.sharding import PartitionSpec as P
+    mesh = create_mesh({"proc": 8})
+    stacked, reduced = collective_shardings(mesh)
+    assert stacked.spec == P("proc") and reduced.spec == P()
+
+
+# -- the tensor-parallel consumer --------------------------------------------
+def test_tp_param_specs_from_table():
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel.tensor_parallel import (tp_mlp_param_specs,
+                                                    tp_qkv_param_specs)
+    w1, b1, w2, b2 = tp_mlp_param_specs()
+    # math convention (in, out): column-parallel w1 shards out, row-
+    # parallel w2 shards in; b1 rides the sharded features
+    assert w1 == P(None, "tp") and w2 == P("tp")
+    assert b1 == P("tp") and b2 == P()
+    wq, wo = tp_qkv_param_specs()
+    assert wq == P(None, "tp") and wo == P("tp")
+
+
+# -- replica slices + the overlap doctrine (satellite fix) -------------------
+def test_replica_slices_disjoint_and_degraded():
+    import jax
+    devs = jax.local_devices()
+    assert len(devs) >= 8
+    slices, degraded = replica_slices(3, 2, devices=devs)
+    assert not degraded
+    flat = [d for s in slices for d in s]
+    assert len(set(map(str, flat))) == 6          # fully disjoint
+    assert all(len(set(map(str, s))) == 2 for s in slices)
+    # more slices than the pool holds: wrap, flagged
+    slices, degraded = replica_slices(5, 2, devices=devs)
+    assert degraded
+    assert all(len(set(map(str, s))) == 2 for s in slices)
+    with pytest.raises(ValueError):
+        replica_slices(1, 3, devices=devs[:2])    # tp > devices
+
+
+def test_replica_devices_never_silently_overlaps_slices():
+    import jax
+    devs = jax.local_devices()
+    slices, _ = replica_slices(2, 2, devices=devs)   # holds 4 devices
+    held = [d for s in slices for d in s]
+    picked, degraded = replica_devices(3, devices=devs, exclude=held)
+    assert not degraded
+    assert not ({str(d) for d in picked} & {str(d) for d in held})
+    # asking for more lanes than the free pool: wraps the FREE pool
+    # only (still no slice overlap), degraded flagged
+    picked, degraded = replica_devices(5, devices=devs, exclude=held)
+    assert degraded
+    assert not ({str(d) for d in picked} & {str(d) for d in held})
+    # nothing free at all: overlap is allowed but NEVER silent
+    picked, degraded = replica_devices(2, devices=devs,
+                                       exclude=list(devs))
+    assert degraded and len(picked) == 2
+
+
+# -- dry-run placement report ------------------------------------------------
+def test_dryrun_report_names_every_param_and_collectives():
+    import jax
+    mesh = create_mesh({"data": 4, "tp": 2})
+    lay = SpecLayout()
+    tree = decoder_tree()
+    specs = lay.resolve_specs(tree, mesh=mesh)
+
+    # a tiny sharded program so the report carries real collectives
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def f(t):
+        s = sum(np.prod([1]) * leaf.sum()
+                for leaf in [t["embed_w"], t["head_w"]])
+        return s
+
+    placed = jax.device_put(
+        tree, jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P)))
+    hlo = jax.jit(f).lower(placed).compile().as_text()
+    doc = dryrun_report(lay, tree, mesh, hlo_text=hlo,
+                        extra={"kind": "test"})
+    from mxnet_tpu.profiling.health import iter_named_leaves
+    paths = {p for p, _ in iter_named_leaves(tree)}
+    assert {r["param"] for r in doc["params"]} == paths
+    for r in doc["params"]:
+        assert "fitted_spec" in r and "role" in r
+        assert r["per_device_bytes"] * r["shard_ways"] == r["bytes"]
+    assert doc["collectives"]["total"] >= 1
+    assert "layout" in doc and doc["layout"]["version"] == 1
+
+
+def test_committed_layout_report_artifact_contract():
+    """The acceptance pin: the committed dp×tp=64 artifact names
+    every parameter's spec and the inserted collectives."""
+    arts = sorted(glob.glob(os.path.join(
+        REPO, "docs", "artifacts", "layout_report_*.json")))
+    assert arts, "no committed layout_report artifact"
+    with open(arts[-1], encoding="utf-8") as f:
+        doc = json.load(f)
+    assert doc["tool"] == "layout_report" and doc["version"] == 1
+    assert doc["mesh"] == {"data": 8, "tp": 8}
+    assert doc["devices"] == 64
+    assert doc["params"], "artifact names no parameters"
+    for row in doc["params"]:
+        assert row.get("param") and "fitted_spec" in row
+        assert "state_spec" in row and "per_device_bytes" in row
+        assert row.get("role") in ("embedding", "attention-qkv",
+                                   "attention-out", "mlp-in",
+                                   "mlp-out", "norm", "bias",
+                                   "default")
+    coll = doc["collectives"]
+    assert coll["total"] >= 1 and coll["by_op"]
+    # a dp×tp ZeRO-2 lowering must at least all-reduce
+    assert "all-reduce" in coll["by_op"]
+
+
+@pytest.mark.slow
+def test_layout_report_cli_dp8_tp8_on_cpu(tmp_path):
+    """The dry-run CLI lowers the dp=8×tp=8 layout on this host (the
+    64-device forced mesh) and writes a complete report."""
+    out = tmp_path / "report.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "layout_report.py"),
+         "--dp", "8", "--tp", "8", "--layers", "1", "--d-model", "32",
+         "--vocab", "64", "--batch", "8", "--seq", "8",
+         "--json", str(out)],
+        capture_output=True, text=True, timeout=560)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    doc = json.loads(out.read_text())
+    assert doc["devices"] == 64
+    assert doc["collectives"]["total"] >= 1
+    assert all("state_spec" in r for r in doc["params"])
+
+
+def test_layout_report_cli_renders_committed(tmp_path):
+    arts = sorted(glob.glob(os.path.join(
+        REPO, "docs", "artifacts", "layout_report_*.json")))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "layout_report.py"), arts[-1]],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "collectives inserted" in proc.stdout
+    assert "all-reduce" in proc.stdout
+
+
+def test_collectives_summary_parses_opcodes():
+    hlo = """HloModule m
+ENTRY %main (p: f32[8]) -> f32[8] {
+  %p = f32[8]{0} parameter(0)
+  %ar = f32[8]{0} all-reduce(f32[8]{0} %p), replica_groups={}
+  %ag = f32[16]{0} all-gather(f32[8]{0} %ar), dimensions={0}
+  ROOT %out = f32[8]{0} reduce-scatter(f32[16]{0} %ag), dimensions={0}
+}
+"""
+    doc = collectives_summary(hlo)
+    assert doc["total"] == 3
+    assert doc["by_op"]["all-reduce"]["count"] == 1
+    assert doc["by_op"]["all-gather"]["bytes"] == 64
+
+
+# -- lint scope --------------------------------------------------------------
+def test_mxl002_scope_covers_layout_hot_paths():
+    from mxnet_tpu.analysis.rules.host_sync import _hot_scope
+    methods, _ = _hot_scope("mxnet_tpu/parallel/layout.py")
+    assert {"resolve", "resolve_specs", "spec_for", "role_of",
+            "_fit_spec", "zero_specs"} <= methods
+    methods, _ = _hot_scope("mxnet_tpu/parallel/mesh.py")
+    assert {"replica_devices", "replica_slices"} <= methods
+
+
+# -- env registration --------------------------------------------------------
+def test_layout_env_vars_registered():
+    from mxnet_tpu import libinfo
+    doc = open(os.path.join(REPO, "docs", "env_vars.md"),
+               encoding="utf-8").read()
+    for var in ("MXTPU_LAYOUT_TABLE", "MXTPU_LAYOUT_REPORT",
+                "MXTPU_SERVING_TP"):
+        assert var in libinfo._ENV_VARS
+        assert var in doc

@@ -1,0 +1,363 @@
+"""Mesh-sliced (model-sharded) serving — the layout plane's serving
+half (ISSUE 15): a tp>=2 gateway variant on a device slice placed
+from the SpecLayout table, outputs matching the single-device
+reference within the documented bound, the generate plane's
+tp-sharded KV pool census byte-exact per device, and the
+serving_bench/perf_gate sharded-stage doctrine."""
+import copy
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.serving.sharded import (DIVERGENCE_BOUND,
+                                       ShardedVariantSet)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SERVING_ARTIFACT = os.path.join(REPO, "docs", "artifacts",
+                                "SERVING_LAST_GOOD.json")
+
+
+def mlp(seed=0, width=32, layers=3, out=10):
+    rng = np.random.default_rng(seed)
+    nd = mx.nd
+    data = sym.var("data")
+    h = data
+    args = {}
+    for i in range(layers):
+        h = sym.Activation(
+            sym.FullyConnected(h, name=f"fc{i}", num_hidden=width),
+            act_type="relu")
+        args[f"fc{i}_weight"] = nd.array(
+            rng.normal(0, 0.1, (width, width)).astype(np.float32))
+        args[f"fc{i}_bias"] = nd.array(
+            rng.normal(0, 0.1, width).astype(np.float32))
+    o = sym.FullyConnected(h, name="fco", num_hidden=out)
+    args["fco_weight"] = nd.array(
+        rng.normal(0, 0.1, (out, width)).astype(np.float32))
+    args["fco_bias"] = nd.array(np.zeros(out, np.float32))
+    return o, args, {}, (width,)
+
+
+@pytest.fixture()
+def gw():
+    g = mx.serving.Gateway()
+    yield g
+    g.close()
+
+
+# -- the ShardedVariantSet contract ------------------------------------------
+def test_sharded_variant_set_runs_one_spmd_program(gw):
+    import jax
+    symbol, args, aux, feature = mlp()
+    devs = jax.local_devices()[:2]
+    vs = ShardedVariantSet(symbol, args, aux, "data", feature,
+                           devices=devs)
+    assert vs.tp == 2 and vs.int8_lowering is None
+    out = vs.run("fp32", np.zeros((4,) + feature, np.float32))
+    assert out[0].shape == (4, 10)
+    n = vs.warmup((1, 4))
+    assert n == 2
+    # outputs are replicated: the reply gather is a local read
+    rep = vs.placement_report()
+    assert rep["mesh"] == {"tp": 2}
+    roles = {r["param"]: r["role"] for r in rep["params"]}
+    assert roles["fc0_weight"] == "mlp-in"
+    assert roles["fc0_bias"] == "bias"
+    # weight dims divide: every fc weight actually shards over tp
+    for r in rep["params"]:
+        if r["param"].endswith("weight") and r["param"] != "fco_weight":
+            assert r["shard_ways"] == 2, r
+
+
+def test_sharded_variant_set_rejects_bad_config():
+    import jax
+    symbol, args, aux, feature = mlp()
+    with pytest.raises(mx.base.MXNetError):
+        ShardedVariantSet(symbol, args, aux, "data", feature,
+                          devices=jax.local_devices()[:1])
+    d0 = jax.local_devices()[0]
+    with pytest.raises(mx.base.MXNetError):
+        ShardedVariantSet(symbol, args, aux, "data", feature,
+                          devices=(d0, d0))
+    with pytest.raises(mx.base.MXNetError):
+        ShardedVariantSet(symbol, args, aux, "data", feature,
+                          devices=jax.local_devices()[:2],
+                          variants=("int8",))
+
+
+# -- gateway tp registration --------------------------------------------------
+def test_gateway_tp2_variant_matches_reference_within_bound(gw):
+    symbol, args, aux, feature = mlp()
+    gw.register("m", symbol, args, aux, input_shapes={"data": feature},
+                variants=("fp32",), buckets=(1, 4), max_wait_ms=0.0,
+                tp=2)
+    st = gw.stats()["m"]
+    assert st["tp"] == 2 and not st["degraded"]
+    rng = np.random.default_rng(1)
+    worst = 0.0
+    for rows in (1, 3, 4):
+        x = rng.normal(0, 1, (rows,) + feature).astype(np.float32)
+        got = gw.infer("m", x)
+        pred = mx.predictor.Predictor(symbol, args, aux,
+                                      {"data": (rows,) + feature})
+        want = pred.forward(data=x)
+        for g, w in zip(got, want):
+            assert g.shape == w.shape
+            worst = max(worst, float(np.abs(
+                np.asarray(g, np.float64) -
+                np.asarray(w, np.float64)).max()))
+    # the documented ulp bound: row-parallel layers reassociate one
+    # reduction; nothing else may move
+    assert worst <= DIVERGENCE_BOUND, worst
+
+
+def test_gateway_tp_rejects_int8_and_bad_tp(gw):
+    symbol, args, aux, feature = mlp()
+    with pytest.raises(mx.serving.ServingError):
+        gw.register("m8", symbol, args, aux,
+                    input_shapes={"data": feature},
+                    variants=("fp32", "int8"), tp=2,
+                    calib_data=np.zeros((4,) + feature, np.float32))
+    with pytest.raises(mx.serving.ServingError):
+        gw.register("mneg", symbol, args, aux,
+                    input_shapes={"data": feature}, tp=-2)
+    # tp=1 is a plain single-device lane, not a slice
+    gw.register("m1", symbol, args, aux,
+                input_shapes={"data": feature}, buckets=(1,),
+                max_wait_ms=0.0, tp=1)
+    assert gw.stats()["m1"]["tp"] is None
+
+
+def test_sliced_and_wrapped_lanes_never_share_devices(gw):
+    """The satellite fix, end to end: a replicated model registered
+    beside a tp model wraps onto the devices the slices do NOT
+    hold."""
+    import jax
+    symbol, args, aux, feature = mlp()
+    gw.register("tpm", symbol, args, aux,
+                input_shapes={"data": feature}, buckets=(1,),
+                max_wait_ms=0.0, tp=2)
+    gw.register("repl", symbol, args, aux,
+                input_shapes={"data": feature}, buckets=(1,),
+                max_wait_ms=0.0,
+                replicas=len(jax.local_devices()) - 2)
+    sliced = set()
+    for r in gw.registry.get("tpm").replicas:
+        sliced |= {str(d) for d in r.device}
+    repl = {str(r.device) for r in gw.registry.get("repl").replicas}
+    assert not (sliced & repl)
+    assert not gw.stats()["repl"]["degraded"]
+
+
+def test_gateway_tp_env_default(gw, monkeypatch):
+    monkeypatch.setenv("MXTPU_SERVING_TP", "2")
+    symbol, args, aux, feature = mlp()
+    gw.register("envm", symbol, args, aux,
+                input_shapes={"data": feature}, buckets=(1,),
+                max_wait_ms=0.0)
+    assert gw.stats()["envm"]["tp"] == 2
+
+
+def test_gateway_tp_scale_out_not_degraded_on_exact_fit():
+    """Regression: scaling a tp model out must not exclude its OWN
+    slice devices from the carve — a host that exactly fits n*tp
+    devices scales out non-degraded, with the new slice disjoint
+    from the old."""
+    import jax
+    devs = jax.local_devices()
+    g = mx.serving.Gateway(devices=devs[:4])    # exactly 2 x tp=2
+    try:
+        symbol, args, aux, feature = mlp()
+        g.register("fit", symbol, args, aux,
+                   input_shapes={"data": feature}, buckets=(1,),
+                   max_wait_ms=0.0, tp=2)
+        rep = g.scale("fit", 2)
+        assert rep["added"] == 1
+        assert rep["degraded"] is False
+        assert g.stats()["fit"]["degraded"] is False
+        m = g.registry.get("fit")
+        flat = [str(d) for r in m.replicas for d in r.device]
+        assert len(set(flat)) == 4              # disjoint slices
+    finally:
+        g.close()
+
+
+def test_gateway_tp_scale_out_in(gw):
+    symbol, args, aux, feature = mlp()
+    gw.register("sc", symbol, args, aux,
+                input_shapes={"data": feature}, buckets=(1,),
+                max_wait_ms=0.0, tp=2)
+    rep = gw.scale("sc", 2)
+    assert rep["added"] == 1
+    m = gw.registry.get("sc")
+    flat = [str(d) for r in m.replicas for d in r.device]
+    assert len(m.replicas) == 2
+    assert len(set(flat)) == 4        # two disjoint 2-device slices
+    x = np.zeros((1,) + feature, np.float32)
+    assert gw.infer("sc", x)[0].shape == (1, 10)
+    rep = gw.scale("sc", 1)
+    assert rep["retired"] == 1
+    assert gw.infer("sc", x)[0].shape == (1, 10)
+
+
+# -- the generate plane over a slice -----------------------------------------
+@pytest.fixture(scope="module")
+def tp_decoder():
+    mx.random.seed(11)
+    from mxnet_tpu.serving.generate import GenerativeDecoder
+    return GenerativeDecoder(vocab_size=50, d_model=32, num_layers=2,
+                             num_heads=4, max_prompt_tokens=16)
+
+
+def test_generator_tp2_greedy_matches_reference(tp_decoder):
+    from mxnet_tpu.serving.generate import reference_generate
+    gw = mx.serving.Gateway()
+    try:
+        gw.register_generator("lm", tp_decoder, block_tokens=4,
+                              max_blocks=32, max_new_tokens=8,
+                              max_decode_batch=4, tp=2)
+        st = gw.stats()["lm"]
+        assert st["tp"] == 2
+        rng = np.random.default_rng(3)
+        for plen in (3, 7):
+            prompt = [int(t) for t in rng.integers(1, 50, plen)]
+            got = gw.generate("lm", prompt, max_new_tokens=6)
+            want = reference_generate(tp_decoder, prompt, 6)
+            assert got == want
+    finally:
+        gw.close()
+
+
+def test_kv_pool_tp_census_byte_exact_per_device_after_steps(
+        tp_decoder):
+    """The paged KV pool shards its heads axis over the slice; after
+    real (donation-path) decode steps and the swap re-tag, the census
+    still reads EXACTLY bytes_total/tp on every slice device."""
+    from mxnet_tpu.profiling import memory as _mem
+    gw = mx.serving.Gateway()
+    try:
+        gw.register_generator("lmc", tp_decoder, block_tokens=4,
+                              max_blocks=32, max_new_tokens=8,
+                              max_decode_batch=4, tp=2)
+        # run real traffic so prefill+decode executed and the pool
+        # swapped (donated or not, swap re-tags) at least once
+        gw.generate("lmc", [1, 2, 3], max_new_tokens=4)
+        lane = gw._get_generator("lmc").lanes[0]
+        pool = lane.pool
+        assert pool.tp == 2 and pool.mesh is not None
+        doc = _mem.live_census(arrays=[pool.k, pool.v])
+        by_dev = doc.get("by_device") or {}
+        per_dev = {d: v["by_role"].get("kv_cache", 0)
+                   for d, v in by_dev.items()}
+        assert len(per_dev) == pool.tp          # one shard per device
+        want = pool.bytes_total // pool.tp
+        assert all(v == want for v in per_dev.values()), per_dev
+        assert sum(per_dev.values()) == pool.bytes_total
+    finally:
+        gw.close()
+
+
+def test_kv_pool_rejects_indivisible_heads():
+    from mxnet_tpu.serving.generate.kvcache import BlockPool
+    import jax
+    with pytest.raises(mx.base.MXNetError):
+        BlockPool(1, 3, 8, 4, 8, device=tuple(jax.local_devices()[:2]))
+
+
+# -- bench + gate doctrine ----------------------------------------------------
+def test_committed_artifact_carries_sharded_stage():
+    with open(SERVING_ARTIFACT, encoding="utf-8") as f:
+        doc = json.load(f)
+    sh = doc["stages"].get("sharded")
+    assert isinstance(sh, dict), "committed artifact dropped the " \
+        "sharded stage"
+    assert sh["tp"] >= 2
+    assert isinstance(sh["req_per_s"], (int, float))
+    assert isinstance(sh["p99_ms"], (int, float))
+    div = sh["divergence"]
+    assert div["within_bound"] is True
+    assert div["max_abs_fp32"] <= div["bound"] <= 1e-4
+    # slices never degraded in the committed run
+    assert sh["degraded"] is False
+
+
+def test_perf_gate_sharded_over_committed_artifact(capsys):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import perf_gate
+    rc = perf_gate.main([SERVING_ARTIFACT, "--serving",
+                         "--serving-int8-max", "1.0"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "serving sharded" in out and "tp=" in out
+
+
+def test_perf_gate_sharded_regressions():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import perf_gate
+    with open(SERVING_ARTIFACT, encoding="utf-8") as f:
+        good = json.load(f)
+    assert "sharded" in good["stages"]
+
+    # dropping the stage while last-good carries it = regression
+    cand = copy.deepcopy(good)
+    del cand["stages"]["sharded"]
+    rc, msgs = perf_gate.gate_sharded(cand, good)
+    assert rc == 1 and any("carries no sharded" in m for m in msgs)
+
+    # a failed child stage = regression
+    cand = copy.deepcopy(good)
+    cand["stages"]["sharded"] = {"error": "child failed rc=1"}
+    rc, _ = perf_gate.gate_sharded(cand, good)
+    assert rc == 1
+
+    # tp collapsing to 1 device = not model sharding
+    cand = copy.deepcopy(good)
+    cand["stages"]["sharded"]["tp"] = 1
+    rc, msgs = perf_gate.gate_sharded(cand, good)
+    assert rc == 1 and any("tp=" in m for m in msgs)
+
+    # divergence over the documented bound
+    cand = copy.deepcopy(good)
+    cand["stages"]["sharded"]["divergence"]["max_abs_fp32"] = 1.0
+    cand["stages"]["sharded"]["divergence"]["within_bound"] = False
+    rc, _ = perf_gate.gate_sharded(cand, good)
+    assert rc == 1
+
+    # shedding the divergence record entirely is the same regression
+    cand = copy.deepcopy(good)
+    del cand["stages"]["sharded"]["divergence"]
+    rc, _ = perf_gate.gate_sharded(cand, good)
+    assert rc == 1
+
+    # p99 collapse = regression (inverted direction)
+    cand = copy.deepcopy(good)
+    cand["stages"]["sharded"]["p99_ms"] = \
+        good["stages"]["sharded"]["p99_ms"] * 10
+    rc, _ = perf_gate.gate_sharded(cand, good)
+    assert rc == 1
+
+    # sharded req/s falls beyond tolerance: caught by the generic
+    # stage-rate pass (the stage carries a top-level req_per_s)
+    cand = copy.deepcopy(good)
+    cand["stages"]["sharded"]["req_per_s"] = \
+        good["stages"]["sharded"]["req_per_s"] * 0.5
+    rc, msgs = perf_gate.gate_serving(cand, good)
+    assert rc == 1 and any("sharded" in m and "REGRESSION" in m
+                           for m in msgs)
+
+    # the committed artifact itself passes
+    rc, msgs = perf_gate.gate_sharded(good, good)
+    assert rc == 0, msgs
+
+
+# -- lint scope ---------------------------------------------------------------
+def test_mxl002_scope_covers_sharded_hot_paths():
+    from mxnet_tpu.analysis.rules.host_sync import _hot_scope
+    methods, _ = _hot_scope("mxnet_tpu/serving/sharded.py")
+    assert {"run", "warmup", "compile_symbol_forward_sharded"} <= \
+        methods

@@ -506,6 +506,82 @@ def gate_serving(candidate, last_good, tolerance=0.25, min_gain=3.0,
     gen_rc, gen_msgs = gate_generate(candidate, last_good, tolerance)
     rc = rc or gen_rc
     msgs.extend(gen_msgs)
+    sh_rc, sh_msgs = gate_sharded(candidate, last_good, tolerance)
+    rc = rc or sh_rc
+    msgs.extend(sh_msgs)
+    return rc, msgs
+
+
+def gate_sharded(candidate, last_good, tolerance=0.25):
+    """(rc, [messages]) for the serving artifact's ``sharded`` stage
+    (the layout plane's mesh-sliced lanes). Same doctrine as
+    gate_generate: a candidate that DROPS the stage while last-good
+    carries it is itself the regression. Contracts: tp >= 2 (a
+    1-device "slice" is not model sharding), sharded req/s within
+    tolerance of last-good (the generic stage-rate pass also sees the
+    top-level req_per_s), p99 growth inverted, and the divergence vs
+    the single-device reference must sit under the DOCUMENTED bound
+    the stage itself records (bitwise or bounded-ulp — never
+    unbounded)."""
+    msgs = []
+    rc = 0
+    sh = (candidate.get("stages") or {}).get("sharded")
+    good = (last_good.get("stages") or {}).get("sharded")
+    if not isinstance(good, dict):
+        if isinstance(sh, dict):
+            msgs.append("serving sharded: tp=%s at %s req/s (new "
+                        "stage — no last-good baseline yet)"
+                        % (sh.get("tp"), sh.get("req_per_s")))
+        return rc, msgs
+    if not isinstance(sh, dict):
+        return 1, ["REGRESSION serving: artifact carries no sharded "
+                   "stage (last good has one — mesh-sliced serving "
+                   "cannot silently drop out of the gate)"]
+    if sh.get("error"):
+        return 1, ["REGRESSION serving sharded: stage failed: %s"
+                   % sh["error"]]
+    tp = sh.get("tp")
+    if not isinstance(tp, int) or tp < 2:
+        rc = 1
+        msgs.append("REGRESSION serving sharded: tp=%r is not a mesh "
+                    "slice (need tp >= 2)" % (tp,))
+    else:
+        msgs.append("serving sharded: tp=%d over %s device(s) (ok)"
+                    % (tp, sh.get("devices")))
+    p99, good_p99 = sh.get("p99_ms"), good.get("p99_ms")
+    if isinstance(good_p99, (int, float)) and good_p99 > 0:
+        if not isinstance(p99, (int, float)):
+            rc = 1
+            msgs.append("REGRESSION serving sharded: candidate "
+                        "carries no p99_ms (last good %.1fms)"
+                        % good_p99)
+        elif p99 > (1.0 + tolerance) * good_p99:
+            rc = 1
+            msgs.append("REGRESSION serving sharded: p99 %.1fms > "
+                        "%.1fms (last good %.1fms, tolerance %.0f%%)"
+                        % (p99, (1.0 + tolerance) * good_p99,
+                           good_p99, tolerance * 100))
+        else:
+            msgs.append("serving sharded: p99 %.1fms vs %.1fms (ok)"
+                        % (p99, good_p99))
+    div = sh.get("divergence") or {}
+    if div.get("within_bound") is True and \
+            isinstance(div.get("max_abs_fp32"), (int, float)) and \
+            isinstance(div.get("bound"), (int, float)) and \
+            div["max_abs_fp32"] <= div["bound"]:
+        msgs.append("serving sharded: divergence %.2e <= documented "
+                    "bound %.0e%s (ok)"
+                    % (div["max_abs_fp32"], div["bound"],
+                       ", bitwise" if div.get("bitwise_equal")
+                       else ""))
+    else:
+        rc = 1
+        msgs.append("REGRESSION serving sharded: divergence vs the "
+                    "single-device reference is unbounded or over "
+                    "the documented bound (max_abs=%s, bound=%s, "
+                    "within_bound=%s)"
+                    % (div.get("max_abs_fp32"), div.get("bound"),
+                       div.get("within_bound")))
     return rc, msgs
 
 
