@@ -138,6 +138,22 @@ _SCOPES = (
      {"poll", "view", "announce", "leave", "mark_dead",
       "observe", "decide", "tick", "_queue_depth", "_latency_stats",
       "_ceiling", "train_step", "histogram_window_p99"}, set()),
+    # the cluster plane's ledger/lending hot paths: lease bookkeeping
+    # (acquire/release/resize + every introspection read) runs under
+    # the ledger lock from client threads, the autoscaler daemon and
+    # the lending scheduler at once — a device sync inside any of them
+    # would stall every workload's placement behind one device read.
+    # The lend/reclaim protocol legs DRIVE trainer.reshape (sanctioned
+    # sync territory, like elastic/'s reshape path) and stay off this
+    # list by design; the bookkeeping around them must stay sync-free.
+    ("mxnet_tpu/cluster/",
+     {"acquire", "release", "resize", "ensure", "release_devices",
+      "note", "free_devices", "usable_devices", "foreign_devices",
+      "owner_of", "leases", "holdings", "find_lease", "expired",
+      "verify_conservation", "device_seconds", "_accrue", "_snapshot",
+      "_journal", "active_borrows", "borrowed_devices", "can_lend",
+      "check_leases", "on_capped", "on_cold", "step_boundary",
+      "hold", "_record"}, set()),
     # the serving gateway's per-request paths: admission + enqueue run
     # in every client thread, coalescing + reply recording in every
     # replica scheduler — a sync in any of them serializes the whole

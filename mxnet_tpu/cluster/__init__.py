@@ -1,0 +1,27 @@
+"""Cluster plane: one pool of chips, many workloads, zero silent sharing.
+
+The five existing planes each own a slice of the fleet story —
+training elasticity (elastic/reshard.py), serving lanes
+(serving/gateway.py), telemetry-driven scaling (elastic/autoscale.py),
+placement (parallel/mesh.py + parallel/layout.py), and journaled
+persistence (checkpoint.py). This package composes them into one
+schedulable system:
+
+- :class:`~mxnet_tpu.cluster.ledger.DeviceLedger` — the cluster-wide
+  exclusivity ledger. Every chip assignment (training shard, serving
+  lane, tp slice, free) is a lease carrying owner/generation/deadline;
+  a double assignment RAISES instead of silently sharing, and every
+  mutation journals an atomic CRC-manifested epoch so a crash at any
+  protocol step recovers the exact assignment state.
+- :class:`~mxnet_tpu.cluster.lending.LendingScheduler` — the
+  lend/reclaim protocol: when the autoscaler is out of free devices it
+  borrows chips from a running ElasticTrainer (quiesce at a step
+  boundary, dp N→M reshape, lease the freed chips to Gateway.scale),
+  and reverses the loan when pressure drops or the lease deadline
+  hits — training resumes bit-identical by ``fingerprint_params``.
+"""
+from .ledger import DeviceLedger, Lease, LedgerError
+from .lending import LendingScheduler, StepGate
+
+__all__ = ["DeviceLedger", "Lease", "LedgerError", "LendingScheduler",
+           "StepGate"]

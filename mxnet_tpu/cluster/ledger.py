@@ -1,0 +1,579 @@
+"""DeviceLedger — the cluster-wide chip-assignment authority.
+
+The PR-15 ``exclude=`` discipline (a serving lane never silently lands
+on a device a tp slice owns) promoted to a CLUSTER invariant: every
+chip in the world is either free or held by exactly one lease, and
+every workload — training shards, serving lanes, tp slices — acquires
+through this one object. Silent sharing is structurally impossible:
+acquiring a device someone else holds raises :class:`LedgerError`
+instead of wrapping, and the degraded-wrap escape hatch the gateway
+keeps applies only WITHIN an owner's own chips.
+
+Every lease carries owner/role/generation/deadline. Every mutation
+(acquire/release/resize plus protocol ``note``s) appends one journal
+epoch — a self-contained JSON snapshot of the full assignment state,
+written via the PR-2 ``atomic_write`` doctrine (tmp → fsync → CRC →
+MANIFEST.json → rename), so a crash at ANY protocol step leaves the
+newest *valid* epoch recoverable and no device stranded in limbo:
+:meth:`DeviceLedger.recover` rebuilds the exact leases, skipping torn
+or corrupt tails by CRC.
+
+Per-owner **device-seconds** accrue on every epoch (free pool
+included), so the chaos artifact can account the whole loan:
+``leased + training + free`` must sum to ``world_size`` at every
+journal epoch (:meth:`verify_journal`), and the device-seconds totals
+must sum to ``world_size * elapsed`` (:meth:`device_seconds`).
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..base import MXNetError
+from ..checkpoint import file_crc32, read_manifest, write_bytes
+from ..telemetry import metrics as _tm
+
+_met = _tm.lazy_metrics(lambda reg: {
+    "leases": reg.gauge(
+        "mx_cluster_leases",
+        "live leases in the device ledger", labelnames=("role",)),
+    "free": reg.gauge(
+        "mx_cluster_free_devices",
+        "devices in the ledger's free pool"),
+    "epochs": reg.counter(
+        "mx_cluster_ledger_epochs_total",
+        "journal epochs written", labelnames=("op",)),
+})
+
+ROLES = ("training_shard", "serving_lane", "tp_slice")
+_EPOCH_FMT = "epoch-%08d.json"
+_EPOCH_GLOB = "epoch-*.json"
+JOURNAL_VERSION = 1
+
+
+class LedgerError(MXNetError):
+    """A chip-assignment invariant was violated (double assignment,
+    unknown device, foreign resize) — always raised, never papered
+    over: silent sharing is the failure mode this ledger exists to
+    make impossible."""
+
+
+def device_name(dev):
+    """Ledger key for a device: jax device objects and plain strings
+    both normalize to ``str(dev)``."""
+    return dev if isinstance(dev, str) else str(dev)
+
+
+@dataclass
+class Lease:
+    """One exclusive assignment: ``owner`` holds ``devices`` in
+    ``role`` until released (or until ``deadline`` — absolute seconds
+    on the ledger's clock — expires and the lending scheduler revokes
+    it)."""
+    lease_id: str
+    owner: str
+    role: str
+    devices: tuple = ()
+    generation: int = 0
+    deadline: float | None = None
+    acquired_t: float = 0.0
+    meta: dict = field(default_factory=dict)
+
+    def to_doc(self, t0):
+        return {
+            "lease_id": self.lease_id,
+            "owner": self.owner,
+            "role": self.role,
+            "devices": list(self.devices),
+            "generation": self.generation,
+            # journal time is t0-relative: the clock is monotonic,
+            # not wall, so absolute values would not survive recovery
+            "deadline_rel_s": None if self.deadline is None
+            else round(self.deadline - t0, 6),
+            "acquired_rel_s": round(self.acquired_t - t0, 6),
+            "meta": self.meta,
+        }
+
+
+class DeviceLedger:
+    """The single assignment authority for one device pool.
+
+    ``devices`` fixes the world (jax devices or their string names);
+    ``journal_dir`` (optional) turns on the crash-recoverable epoch
+    journal. All methods are thread-safe — the autoscaler thread, the
+    lending scheduler, and gateway client threads all mutate through
+    the same lock.
+    """
+
+    def __init__(self, devices, journal_dir=None, clock=time.monotonic,
+                 keep=256):
+        world = [device_name(d) for d in devices]
+        if not world:
+            raise LedgerError("cluster: ledger needs a non-empty world")
+        if len(set(world)) != len(world):
+            raise LedgerError(
+                f"cluster: duplicate devices in the world: {world}")
+        self._world = tuple(world)
+        self._clock = clock
+        self._keep = int(keep)
+        self._lock = threading.RLock()
+        self._leases = {}           # lease_id -> Lease
+        self._assigned = {}         # device name -> lease_id
+        self._next_id = 1
+        self._epoch = 0
+        self._t0 = clock()
+        self._last_t = self._t0
+        self._elapsed_offset = 0.0   # pre-crash elapsed, set by recover
+        self._device_seconds = {"free": 0.0}
+        self.journal_dir = os.fspath(journal_dir) \
+            if journal_dir is not None else None
+        if self.journal_dir is not None:
+            os.makedirs(self.journal_dir, exist_ok=True)
+            self._journal("init")
+
+    # -- introspection (sync-free bookkeeping: MXL002 scope) -----------------
+    @property
+    def world(self):
+        return self._world
+
+    @property
+    def world_size(self):
+        return len(self._world)
+
+    @property
+    def epoch(self):
+        return self._epoch
+
+    def free_devices(self):
+        """Unassigned device names, world order preserved."""
+        with self._lock:
+            return [d for d in self._world if d not in self._assigned]
+
+    def usable_devices(self, owner):
+        """Device names ``owner`` may place on: the free pool plus the
+        chips its own leases already hold — never another owner's."""
+        with self._lock:
+            out = []
+            for d in self._world:
+                lid = self._assigned.get(d)
+                if lid is None or self._leases[lid].owner == owner:
+                    out.append(d)
+            return out
+
+    def foreign_devices(self, owner):
+        """Device names held by ANY other owner — the exclusion set a
+        placement for ``owner`` must carve around."""
+        with self._lock:
+            return [d for d in self._world
+                    if d in self._assigned
+                    and self._leases[self._assigned[d]].owner != owner]
+
+    def owner_of(self, device):
+        """(owner, lease_id) holding a device, or (None, None)."""
+        with self._lock:
+            lid = self._assigned.get(device_name(device))
+            if lid is None:
+                return None, None
+            return self._leases[lid].owner, lid
+
+    def leases(self):
+        """{lease_id: Lease} snapshot (shallow copies are not needed —
+        Lease mutation goes through resize/release only)."""
+        with self._lock:
+            return dict(self._leases)
+
+    def holdings(self, owner=None):
+        """{owner: [device names]} (one owner when given)."""
+        with self._lock:
+            out = {}
+            for lease in self._leases.values():
+                out.setdefault(lease.owner, []).extend(lease.devices)
+            if owner is not None:
+                return {owner: out.get(owner, [])}
+            return out
+
+    def find_lease(self, owner, role=None):
+        """The (single expected) live lease for ``owner`` (+ role), or
+        None."""
+        with self._lock:
+            for lease in self._leases.values():
+                if lease.owner == owner and \
+                        (role is None or lease.role == role):
+                    return lease
+            return None
+
+    def expired(self, now=None):
+        """Leases whose deadline has passed — the revocation worklist."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            return [ls for ls in self._leases.values()
+                    if ls.deadline is not None and now > ls.deadline]
+
+    def verify_conservation(self):
+        """Prove leased + free == world with no overlap; raises
+        :class:`LedgerError` on violation, returns the accounting."""
+        with self._lock:
+            held = []
+            for lease in self._leases.values():
+                held.extend(lease.devices)
+            free = self.free_devices()
+            report = {"world_size": len(self._world),
+                      "leased": len(held), "free": len(free)}
+            if len(held) != len(set(held)):
+                raise LedgerError(
+                    f"cluster: device held by more than one lease: "
+                    f"{sorted(d for d in held if held.count(d) > 1)}")
+            if len(held) + len(free) != len(self._world) or \
+                    set(held) | set(free) != set(self._world):
+                raise LedgerError(
+                    f"cluster: conservation violated — {report} does "
+                    f"not partition the world")
+            return report
+
+    def device_seconds(self, now=None):
+        """Per-owner device-seconds accounting (free pool included).
+        ``total`` must equal ``world_size * elapsed_s`` — ``conserved``
+        says whether it does (to float tolerance)."""
+        with self._lock:
+            now = self._clock() if now is None else now
+            self._accrue(now)
+            elapsed = now - self._t0 + self._elapsed_offset
+            totals = {k: round(v, 6)
+                      for k, v in self._device_seconds.items()}
+            total = sum(totals.values())
+            expect = len(self._world) * elapsed
+            return {
+                "by_owner": totals,
+                "total": round(total, 6),
+                "world_size": len(self._world),
+                "elapsed_s": round(elapsed, 6),
+                "conserved": abs(total - expect) <=
+                max(1e-6, 1e-6 * max(expect, 1.0)),
+            }
+
+    # -- mutations -----------------------------------------------------------
+    def acquire(self, owner, devices, role, deadline_s=None,
+                generation=0, meta=None):
+        """Take exclusive ownership of ``devices``. Raises
+        :class:`LedgerError` if ANY of them is unknown, requested
+        twice, or already assigned (to anyone — the caller resizes its
+        own lease instead of re-acquiring)."""
+        if role not in ROLES:
+            raise LedgerError(
+                f"cluster: unknown lease role {role!r} (known: {ROLES})")
+        names = [device_name(d) for d in devices]
+        if not names:
+            raise LedgerError(
+                f"cluster: {owner!r} asked to acquire zero devices")
+        if len(set(names)) != len(names):
+            raise LedgerError(
+                f"cluster: duplicate devices in acquire for "
+                f"{owner!r}: {names}")
+        with self._lock:
+            self._check_known(names)
+            for d in names:
+                lid = self._assigned.get(d)
+                if lid is not None:
+                    holder = self._leases[lid]
+                    raise LedgerError(
+                        f"cluster: device {d} is already assigned to "
+                        f"owner {holder.owner!r} (lease "
+                        f"{holder.lease_id}, role {holder.role}) — "
+                        f"refusing the double assignment for "
+                        f"{owner!r}")
+            now = self._clock()
+            lease = Lease(
+                lease_id="L%06d" % self._next_id, owner=str(owner),
+                role=role, devices=tuple(names),
+                generation=int(generation),
+                deadline=None if deadline_s is None
+                else now + float(deadline_s),
+                acquired_t=now, meta=dict(meta or {}))
+            self._next_id += 1
+            self._leases[lease.lease_id] = lease
+            for d in names:
+                self._assigned[d] = lease.lease_id
+            self._journal("acquire", lease_id=lease.lease_id,
+                          owner=lease.owner, role=role, devices=names)
+            return lease
+
+    def release(self, lease_id):
+        """Return a lease's devices to the free pool."""
+        with self._lock:
+            lease = self._leases.pop(lease_id, None)
+            if lease is None:
+                raise LedgerError(
+                    f"cluster: unknown lease {lease_id!r}")
+            for d in lease.devices:
+                self._assigned.pop(d, None)
+            self._journal("release", lease_id=lease_id,
+                          owner=lease.owner,
+                          devices=list(lease.devices))
+            return lease
+
+    def resize(self, lease_id, devices, generation=None):
+        """Change a lease's device set. New devices must be free;
+        dropped devices return to the pool; a resize to zero devices
+        releases the lease."""
+        names = [device_name(d) for d in devices]
+        if len(set(names)) != len(names):
+            raise LedgerError(
+                f"cluster: duplicate devices in resize of "
+                f"{lease_id}: {names}")
+        with self._lock:
+            lease = self._leases.get(lease_id)
+            if lease is None:
+                raise LedgerError(
+                    f"cluster: unknown lease {lease_id!r}")
+            if not names:
+                return self.release(lease_id)
+            self._check_known(names)
+            for d in names:
+                lid = self._assigned.get(d)
+                if lid is not None and lid != lease_id:
+                    holder = self._leases[lid]
+                    raise LedgerError(
+                        f"cluster: device {d} is already assigned to "
+                        f"owner {holder.owner!r} (lease {lid}) — "
+                        f"refusing the resize of {lease_id}")
+            for d in lease.devices:
+                if d not in names:
+                    self._assigned.pop(d, None)
+            for d in names:
+                self._assigned[d] = lease_id
+            lease.devices = tuple(names)
+            if generation is not None:
+                lease.generation = int(generation)
+            self._journal("resize", lease_id=lease_id,
+                          owner=lease.owner, devices=names)
+            return lease
+
+    def ensure(self, owner, devices, role, generation=0, meta=None,
+               deadline_s=None):
+        """Acquire-or-resize the one lease for (owner, role) — the
+        idempotent seam ElasticTrainer.build and the gateway's ledger
+        sync call on every (re)placement: the first call acquires,
+        later ones resize. The lease deadline always reflects THIS
+        call's ``deadline_s`` (None clears it) — a borrow-driven
+        placement stamps its loan deadline, the post-reclaim sync
+        removes it."""
+        with self._lock:
+            lease = self.find_lease(owner, role)
+            if lease is None:
+                return self.acquire(owner, devices, role,
+                                    deadline_s=deadline_s,
+                                    generation=generation, meta=meta)
+            old_deadline = lease.deadline
+            lease.deadline = None if deadline_s is None \
+                else self._clock() + float(deadline_s)
+            try:
+                return self.resize(lease.lease_id, devices,
+                                   generation=generation)
+            except LedgerError:
+                lease.deadline = old_deadline
+                raise
+
+    def release_devices(self, owner, devices):
+        """Return specific devices held by ``owner`` to the pool,
+        shrinking (or releasing) whichever of its leases hold them.
+        Devices not held by ``owner`` raise — releasing someone
+        else's chips is as illegal as taking them."""
+        names = {device_name(d) for d in devices}
+        with self._lock:
+            by_lease = {}
+            for d in sorted(names):
+                lid = self._assigned.get(d)
+                if lid is None or self._leases[lid].owner != owner:
+                    holder = None if lid is None \
+                        else self._leases[lid].owner
+                    raise LedgerError(
+                        f"cluster: {owner!r} cannot release device "
+                        f"{d} held by {holder!r}")
+                by_lease.setdefault(lid, set()).add(d)
+            touched = []
+            for lid, drop in by_lease.items():
+                keep = [d for d in self._leases[lid].devices
+                        if d not in drop]
+                self.resize(lid, keep) if keep else self.release(lid)
+                touched.append(lid)
+            return touched
+
+    def note(self, step, **detail):
+        """Journal a protocol step WITHOUT changing assignments — the
+        lending scheduler's crash markers: every lend/reclaim
+        transition lands one epoch, so recovery knows exactly how far
+        the protocol got."""
+        with self._lock:
+            self._journal("note", step=step, **detail)
+            return self._epoch
+
+    # -- internals -----------------------------------------------------------
+    def _check_known(self, names):
+        unknown = [d for d in names if d not in set(self._world)]
+        if unknown:
+            raise LedgerError(
+                f"cluster: devices {unknown} are not in this "
+                f"ledger's world ({len(self._world)} devices)")
+
+    def _accrue(self, now):
+        dt = max(now - self._last_t, 0.0)
+        if dt > 0:
+            ds = self._device_seconds
+            for lease in self._leases.values():
+                ds[lease.owner] = ds.get(lease.owner, 0.0) + \
+                    dt * len(lease.devices)
+            n_free = len(self._world) - len(self._assigned)
+            ds["free"] = ds.get("free", 0.0) + dt * n_free
+        self._last_t = now
+
+    def _snapshot(self, op, detail):
+        return {
+            "version": JOURNAL_VERSION,
+            "epoch": self._epoch,
+            "op": op,
+            "detail": detail,
+            "t_rel_s": round(self._last_t - self._t0 +
+                             self._elapsed_offset, 6),
+            "world": list(self._world),
+            "leases": {lid: ls.to_doc(self._t0)
+                       for lid, ls in sorted(self._leases.items())},
+            "free": self.free_devices(),
+            "device_seconds": {k: round(v, 6) for k, v in
+                               self._device_seconds.items()},
+            "next_id": self._next_id,
+        }
+
+    def _journal(self, op, **detail):
+        self._accrue(self._clock())
+        self._epoch += 1
+        met = _met()
+        met["epochs"].labels(op=op).inc()
+        met["free"].set(len(self._world) - len(self._assigned))
+        by_role = {}
+        for ls in self._leases.values():
+            by_role[ls.role] = by_role.get(ls.role, 0) + 1
+        for role in ROLES:
+            met["leases"].labels(role=role).set(by_role.get(role, 0))
+        if self.journal_dir is None:
+            return
+        doc = self._snapshot(op, detail)
+        path = os.path.join(self.journal_dir, _EPOCH_FMT % self._epoch)
+        write_bytes(path, json.dumps(doc, sort_keys=True) + "\n")
+        self._prune()
+
+    def _prune(self):
+        paths = sorted(glob.glob(
+            os.path.join(self.journal_dir, _EPOCH_GLOB)))
+        for p in paths[:-self._keep]:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+    # -- recovery ------------------------------------------------------------
+    @staticmethod
+    def journal_epochs(journal_dir, validate=True):
+        """All decodable (epoch, doc) pairs, oldest first. With
+        ``validate`` each file must match its MANIFEST.json CRC —
+        torn or bit-rotted epochs (the PR-2 failure model) are
+        skipped, never trusted."""
+        journal_dir = os.fspath(journal_dir)
+        man = read_manifest(journal_dir) if validate else None
+        files = (man or {}).get("files", {})
+        out = []
+        for path in sorted(glob.glob(
+                os.path.join(journal_dir, _EPOCH_GLOB))):
+            base = os.path.basename(path)
+            if validate:
+                entry = files.get(base)
+                try:
+                    ok = entry is not None and \
+                        entry.get("crc32") == file_crc32(path)
+                except OSError:
+                    ok = False
+                if not ok:
+                    continue
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if isinstance(doc, dict) and \
+                    doc.get("version") == JOURNAL_VERSION:
+                out.append((int(doc.get("epoch", 0)), doc))
+        return out
+
+    @staticmethod
+    def verify_journal(journal_dir):
+        """Replay every valid epoch and prove device conservation at
+        EACH one: leased + free must partition the world. Returns
+        {"epochs": n, "conserved": bool, "violations": [...]}."""
+        epochs = DeviceLedger.journal_epochs(journal_dir)
+        violations = []
+        for n, doc in epochs:
+            world = set(doc.get("world") or [])
+            held = []
+            for lease in (doc.get("leases") or {}).values():
+                held.extend(lease.get("devices") or [])
+            free = doc.get("free") or []
+            if len(held) != len(set(held)) or \
+                    set(held) | set(free) != world or \
+                    len(held) + len(free) != len(world):
+                violations.append(n)
+        return {"epochs": len(epochs),
+                "conserved": not violations and bool(epochs),
+                "violations": violations}
+
+    @classmethod
+    def recover(cls, journal_dir, clock=time.monotonic, keep=256):
+        """Rebuild the ledger from the newest VALID journal epoch — a
+        crash at any protocol step (including mid-write: the torn tail
+        fails its CRC and the previous epoch wins) reconstructs the
+        exact leases, with remaining deadline time re-anchored to the
+        new clock. Raises when no valid epoch exists."""
+        epochs = cls.journal_epochs(journal_dir)
+        if not epochs:
+            raise LedgerError(
+                f"cluster: no valid journal epoch under "
+                f"{os.fspath(journal_dir)!r} — cannot recover")
+        _, doc = epochs[-1]
+        self = cls(doc["world"], journal_dir=None, clock=clock,
+                   keep=keep)
+        now = self._clock()
+        crash_t = float(doc.get("t_rel_s", 0.0))
+        for lid, lsdoc in sorted((doc.get("leases") or {}).items()):
+            dl = lsdoc.get("deadline_rel_s")
+            lease = Lease(
+                lease_id=lid, owner=lsdoc["owner"],
+                role=lsdoc["role"],
+                devices=tuple(lsdoc.get("devices") or ()),
+                generation=int(lsdoc.get("generation", 0)),
+                # remaining deadline survives the crash; an already-
+                # expired lease stays expired (negative remainder)
+                deadline=None if dl is None
+                else now + (float(dl) - crash_t),
+                acquired_t=now, meta=dict(lsdoc.get("meta") or {}))
+            self._leases[lid] = lease
+            for d in lease.devices:
+                if d in self._assigned:
+                    raise LedgerError(
+                        f"cluster: recovered journal assigns device "
+                        f"{d} twice (leases {self._assigned[d]} and "
+                        f"{lid}) — journal is not trustworthy")
+                self._assigned[d] = lid
+        self._next_id = int(doc.get("next_id", len(self._leases) + 1))
+        self._epoch = int(doc.get("epoch", 0))
+        self._elapsed_offset = crash_t
+        self._device_seconds = {
+            k: float(v) for k, v in
+            (doc.get("device_seconds") or {"free": 0.0}).items()}
+        # re-attach the journal and mark the recovery itself
+        recovered_from = self._epoch
+        self.journal_dir = os.fspath(journal_dir)
+        self._journal("recovered", from_epoch=recovered_from)
+        self.verify_conservation()
+        return self

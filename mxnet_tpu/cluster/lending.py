@@ -1,0 +1,462 @@
+"""Lend/reclaim protocol: borrow training chips for serving, provably.
+
+When the telemetry autoscaler (elastic/autoscale.py) is out of free
+devices it no longer stalls at its ceiling — it BORROWS from a running
+:class:`~mxnet_tpu.elastic.reshard.ElasticTrainer`:
+
+    lend:    quiesce at a step boundary → reshape dp N→M (the existing
+             gather/checkpoint/re-place/census-reverify path) → resize
+             the training lease down → lease the freed chips to
+             ``Gateway.scale`` as new lanes (deadline-stamped)
+    reclaim: drain the borrowed lanes (Gateway scale-in) → chips
+             return to the pool → reshape training back to dp N —
+             bit-identical by ``fingerprint_params``
+
+Every transition is guarded for partial failure:
+
+- **bounded timeouts with backoff** on quiesce and reshape (the
+  kvstore :class:`~mxnet_tpu.kvstore.fault.BackoffSchedule` clock,
+  budget from ``MXTPU_LEND_RECLAIM_BACKOFF_MS``);
+- **lease revocation** when the borrower wedges — a borrower that
+  takes the chips but never reports ready (the ``borrow_wedge`` fault
+  kind) is revoked at its deadline by :meth:`check_leases`, and the
+  chips reshape back into training;
+- **journaled recovery**: every protocol step lands a ledger epoch
+  (``note``), so a crash at ANY step leaves the
+  :class:`~mxnet_tpu.cluster.ledger.DeviceLedger` recoverable with no
+  device stranded in limbo.
+
+The ``reclaim_timeout`` fault kind injects a slow borrower drain into
+the reclaim path, proving the backoff budget bounds it.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from .. import tracing
+from ..base import get_env
+from ..kvstore.fault import (BackoffSchedule, borrow_wedge_active,
+                             reclaim_delay_ms)
+from ..telemetry import metrics as _tm
+from .ledger import LedgerError, device_name
+
+logger = logging.getLogger(__name__)
+
+_met = _tm.lazy_metrics(lambda reg: {
+    "lends": reg.counter(
+        "mx_cluster_lend_events_total",
+        "lend/reclaim protocol completions",
+        labelnames=("event",)),
+    "borrowed": reg.gauge(
+        "mx_cluster_borrowed_devices",
+        "chips currently on loan from training to serving"),
+    "lend_s": reg.histogram(
+        "mx_cluster_lend_seconds",
+        "wall-clock of one protocol leg", labelnames=("leg",)),
+})
+
+TRAINING_OWNER = "training"
+SERVING_OWNER = "serving"
+
+
+class StepGate:
+    """Cooperative step-boundary quiesce point for a live train loop.
+
+    The training thread calls :meth:`step_boundary` before every step
+    (a cheap Event probe when nothing is held); the scheduler calls
+    :meth:`hold` to park it AT the boundary — params/opt are whole
+    values, not in-flight futures — and :meth:`release` to resume.
+    """
+
+    def __init__(self):
+        self._want_hold = threading.Event()
+        self._parked = threading.Event()
+        self._resume = threading.Event()
+        self._resume.set()
+
+    def step_boundary(self):
+        """Training-loop seam: parks here while a hold is requested."""
+        if self._want_hold.is_set():
+            self._parked.set()
+            self._resume.wait()
+            self._parked.clear()
+
+    def hold(self, timeout):
+        """Request a hold and wait (bounded) for the loop to park.
+        True when parked; False when the loop never reached a
+        boundary inside ``timeout`` (the request stays armed only on
+        success — a failed hold is rolled back)."""
+        self._resume.clear()
+        self._want_hold.set()
+        ok = self._parked.wait(timeout)
+        if not ok:
+            self.release()
+        return ok
+
+    def release(self):
+        self._want_hold.clear()
+        self._resume.set()
+
+    @property
+    def held(self):
+        return self._parked.is_set()
+
+
+class LendingScheduler:
+    """Composes ledger + trainer + gateway into the lending protocol.
+
+    One scheduler per (trainer, gateway) pair. The autoscaler drives
+    it through :meth:`on_capped` / :meth:`on_cold`; chaos and tests
+    drive :meth:`lend` / :meth:`reclaim` / :meth:`check_leases`
+    directly. ``gate`` (a :class:`StepGate`) quiesces a live training
+    thread; without one the trainer is assumed driven synchronously
+    by the caller between protocol calls.
+    """
+
+    def __init__(self, ledger, trainer=None, gateway=None, gate=None,
+                 membership=None, min_train_dp=None, deadline_s=None,
+                 backoff_budget_ms=None, lend_chunk=2,
+                 clock=time.monotonic, fault_plan=None):
+        self.ledger = ledger
+        self.trainer = trainer
+        self.gateway = gateway
+        self.gate = gate
+        self.membership = membership
+        if min_train_dp is None:
+            min_train_dp = int(get_env("MXTPU_LEND_MIN_TRAIN_DP", 1,
+                                       int))
+        if deadline_s is None:
+            deadline_s = get_env("MXTPU_LEND_DEADLINE_SEC", 60.0,
+                                 float)
+        if backoff_budget_ms is None:
+            backoff_budget_ms = get_env(
+                "MXTPU_LEND_RECLAIM_BACKOFF_MS", 5000.0, float)
+        self.min_train_dp = int(min_train_dp)
+        self.deadline_s = float(deadline_s)
+        self.backoff_budget_ms = float(backoff_budget_ms)
+        self.lend_chunk = int(lend_chunk)
+        self.fault_plan = fault_plan   # None = MXNET_KVSTORE_FAULT_PLAN
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._borrows = []     # live borrow records (dicts)
+        self._lend_count = 0
+        self._reclaim_count = 0
+        self.events = []       # bounded [(t, event, detail)]
+
+    # -- bookkeeping (sync-free: MXL002 scope) --------------------------------
+    def active_borrows(self, model=None):
+        with self._lock:
+            return [b for b in self._borrows
+                    if model is None or b["model"] == model]
+
+    def borrowed_devices(self):
+        with self._lock:
+            out = []
+            for b in self._borrows:
+                out.extend(b["devices"])
+            return out
+
+    def can_lend(self, n):
+        """Whether the training floor allows lending ``n`` more chips
+        (pure arithmetic — no device work)."""
+        if self.trainer is None or self.trainer.devices is None:
+            return False
+        return self.trainer.dp - n >= self.min_train_dp
+
+    def _record(self, event, **detail):
+        t = self._clock()
+        self.events.append((t, event, detail))
+        del self.events[:-128]
+        self.ledger.note(event, **detail)
+        _met()["lends"].labels(event=event).inc()
+        _met()["borrowed"].set(len(self.borrowed_devices()))
+        return t
+
+    def _bump_generation(self):
+        """A lend/reclaim reshape is a planned membership event: bump
+        the generation so every poller converges on the new world."""
+        if self.membership is None:
+            return self.trainer.generation if self.trainer else 0
+        return self.membership.bump()
+
+    # -- autoscaler hooks -----------------------------------------------------
+    def on_capped(self, model):
+        """The autoscaler hit its device ceiling with pressure still
+        sustained: borrow a chunk from training if the floor allows.
+        Returns True when a loan was made."""
+        with self._lock:
+            if self.active_borrows(model):
+                return False     # one loan at a time per model
+            n = min(self.lend_chunk,
+                    (self.trainer.dp - self.min_train_dp)
+                    if self.trainer and self.trainer.devices else 0)
+            if n < 1:
+                return False
+        self.lend(model, n)
+        return True
+
+    def on_cold(self, model):
+        """The autoscaler scaled in: reclaim the loan once the
+        remaining lanes fit on serving's own (non-borrowed) chips.
+        Returns True when a reclaim ran."""
+        with self._lock:
+            borrows = self.active_borrows(model)
+            if not borrows or self.gateway is None:
+                return False
+            borrowed = set(self.borrowed_devices())
+            own = [d for d in
+                   self.ledger.usable_devices(SERVING_OWNER)
+                   if d not in borrowed]
+            if self.gateway.replica_count(model) > len(own):
+                return False     # borrowed lanes still in use
+        for b in borrows:
+            self.reclaim(b)
+        return True
+
+    def check_leases(self, now=None):
+        """Deadline enforcement — the revocation path. A borrow whose
+        lease deadline passed (or whose borrower never reported ready
+        by the deadline: the ``borrow_wedge`` failure) is revoked and
+        its chips reshape back into training. Returns the revoked
+        records."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            doomed = [b for b in self._borrows
+                      if now > b["deadline"] or
+                      (not b["ready"] and now > b["ready_deadline"])]
+        revoked = []
+        for b in doomed:
+            self._record("lease_revoked", model=b["model"],
+                         lease_id=b.get("lease_id"),
+                         ready=b["ready"], idx=b["idx"])
+            logger.warning(
+                "cluster: revoking lease on %s for %r (ready=%s, "
+                "deadline hit) — chips return to training",
+                b["devices"], b["model"], b["ready"])
+            self.reclaim(b, revoked=True)
+            revoked.append(b)
+        return revoked
+
+    # -- the protocol ---------------------------------------------------------
+    def _quiesce(self, backoff):
+        """Park the training loop at a step boundary, bounded: retry
+        with the backoff clock until parked or the budget is spent."""
+        if self.gate is None:
+            return True
+        t0 = self._clock()
+        while True:
+            wait = backoff.next_wait()
+            if wait is None:
+                return False
+            if self.gate.hold(wait):
+                _met()["lend_s"].labels(leg="quiesce").observe(
+                    self._clock() - t0)
+                return True
+
+    def _reshape_with_retry(self, devices, generation, backoff, leg):
+        """trainer.reshape under the bounded-retry guard: a transient
+        reshape failure backs off and retries inside the budget; a
+        spent budget re-raises the last error (the journal already
+        carries how far the protocol got)."""
+        t0 = self._clock()
+        while True:
+            try:
+                report = self.trainer.reshape(devices,
+                                              generation=generation)
+                _met()["lend_s"].labels(leg=leg).observe(
+                    self._clock() - t0)
+                return report
+            except LedgerError:
+                raise      # assignment violations are never transient
+            except Exception as e:  # noqa: BLE001 — bounded retry
+                wait = backoff.next_wait()
+                if wait is None:
+                    raise
+                logger.warning(
+                    "cluster: %s reshape failed (%r) — retrying in "
+                    "%.0fms", leg, e, wait * 1e3)
+                time.sleep(wait)
+
+    def lend(self, model, n, deadline_s=None):
+        """Borrow ``n`` training chips and serve ``model`` on them.
+        Returns the borrow record. Raises when the training dp floor
+        forbids it or the quiesce budget is spent (ledger unchanged in
+        both cases)."""
+        n = int(n)
+        trainer = self.trainer
+        if trainer is None or trainer.devices is None:
+            raise LedgerError("cluster: no trainer to lend from")
+        if not self.can_lend(n):
+            raise LedgerError(
+                f"cluster: lending {n} chip(s) would take training "
+                f"dp {trainer.dp} below the floor "
+                f"min_train_dp={self.min_train_dp}")
+        deadline_s = self.deadline_s if deadline_s is None \
+            else float(deadline_s)
+        idx = self._lend_count
+        self._lend_count += 1
+        kept = list(trainer.devices[:trainer.dp - n])
+        freed = list(trainer.devices[trainer.dp - n:])
+        freed_names = [device_name(d) for d in freed]
+        with tracing.span("cluster.lend", cat="cluster", model=model,
+                          chips=n, dp_from=trainer.dp,
+                          dp_to=len(kept)):
+            self._record("lend_requested", model=model, chips=n,
+                         idx=idx, dp_from=trainer.dp)
+            backoff = BackoffSchedule(self.backoff_budget_ms,
+                                      clock=self._clock)
+            if not self._quiesce(backoff):
+                self._record("lend_aborted", model=model, idx=idx,
+                             reason="quiesce budget spent")
+                raise LedgerError(
+                    f"cluster: training never reached a step "
+                    f"boundary inside {self.backoff_budget_ms:.0f}ms "
+                    f"— lend aborted, ledger unchanged")
+            gen = self._bump_generation()
+            try:
+                # dp N -> M through the existing gather/re-place/
+                # census path; the trainer's ledger seam resizes the
+                # training lease, freeing the chips
+                self._record("quiesced", model=model, idx=idx,
+                             steps_done=trainer.steps_done)
+                self._reshape_with_retry(kept, gen, backoff,
+                                         leg="lend_reshape")
+                self._record("reshaped", model=model, idx=idx,
+                             dp=trainer.dp,
+                             fingerprint=trainer.fingerprint())
+            finally:
+                if self.gate is not None:
+                    self.gate.release()
+            now = self._clock()
+            record = {
+                "model": model, "devices": freed_names, "idx": idx,
+                "n": n, "dp_restore": len(kept) + n,
+                "deadline": now + deadline_s,
+                "ready_deadline": now + deadline_s,
+                "ready": False, "lease_id": None, "t_lend": now,
+            }
+            wedged = borrow_wedge_active(idx + 1,
+                                         plan=self.fault_plan)
+            if wedged or self.gateway is None:
+                # the borrower takes the lease but never builds lanes
+                # (borrow_wedge models a borrower that wedges during
+                # bring-up); check_leases revokes at the deadline
+                lease = self.ledger.acquire(
+                    SERVING_OWNER, freed_names, role="serving_lane",
+                    deadline_s=deadline_s, generation=gen,
+                    meta={"borrowed_from": TRAINING_OWNER,
+                          "model": model})
+                record["lease_id"] = lease.lease_id
+                self._record("borrow_wedged" if wedged else "leased",
+                             model=model, idx=idx,
+                             lease_id=lease.lease_id,
+                             devices=freed_names)
+            else:
+                cur = self.gateway.replica_count(model)
+                with self.gateway.lease_deadline(deadline_s):
+                    self.gateway.scale(model, cur + n)
+                record["ready"] = True
+                self._record("leased", model=model, idx=idx,
+                             devices=freed_names, replicas=cur + n,
+                             deadline_s=deadline_s)
+                self._record("borrower_ready", model=model, idx=idx)
+            with self._lock:
+                self._borrows.append(record)
+            _met()["borrowed"].set(len(self.borrowed_devices()))
+            return record
+
+    def reclaim(self, record, revoked=False):
+        """Reverse a loan: drain the borrowed lanes, return the chips,
+        reshape training back to its full dp — bit-identical. The
+        ``reclaim_timeout`` fault injects a slow borrower drain here;
+        the backoff budget bounds how long it is honored."""
+        model = record["model"]
+        self._reclaim_count += 1
+        ridx = self._reclaim_count
+        backoff = BackoffSchedule(self.backoff_budget_ms,
+                                  clock=self._clock)
+        t0 = self._clock()
+        with tracing.span("cluster.reclaim", cat="cluster",
+                          model=model, chips=record["n"],
+                          revoked=revoked):
+            self._record("reclaim_requested", model=model,
+                         idx=record["idx"], revoked=revoked)
+            delay_ms = reclaim_delay_ms(ridx, plan=self.fault_plan)
+            if delay_ms > 0:
+                # a wedged/slow borrower drain — honored only inside
+                # the bounded budget, then the reclaim proceeds anyway
+                # (the lease is ours to take back)
+                honored = min(delay_ms,
+                              max(backoff.remaining_ms(), 0.0))
+                time.sleep(honored / 1e3)
+                self._record("reclaim_drain_delayed", model=model,
+                             injected_ms=delay_ms,
+                             honored_ms=round(honored, 1))
+            if record["lease_id"] is not None and \
+                    record["lease_id"] in self.ledger.leases():
+                # the wedged-borrower lease the scheduler took on the
+                # borrower's behalf — revocation is just releasing it
+                self.ledger.release(record["lease_id"])
+            if self.gateway is not None:
+                # retire lanes until no borrowed chip is still owned
+                # by serving. When the autoscaler already scaled in
+                # (the on_cold path) the chips are free and this
+                # no-ops; on a deadline revoke it drains them now.
+                # Each pass strictly shrinks the replica count, so
+                # the loop is bounded by it.
+                while True:
+                    owned = [d for d in record["devices"]
+                             if self.ledger.owner_of(d)[0] ==
+                             SERVING_OWNER]
+                    if not owned:
+                        break
+                    cur = self.gateway.replica_count(model)
+                    if cur <= 1:
+                        break   # the stuck check below fails loudly
+                    self.gateway.scale(model, cur - 1)
+            # the chips must actually be home before training takes
+            # them back; a borrower that still holds any is a bug
+            free = set(self.ledger.free_devices())
+            stuck = [d for d in record["devices"] if d not in free]
+            if stuck:
+                raise LedgerError(
+                    f"cluster: reclaim of {model!r} left devices "
+                    f"{stuck} unreturned (owners: "
+                    f"{[self.ledger.owner_of(d)[0] for d in stuck]})")
+            self._record("borrower_released", model=model,
+                         idx=record["idx"])
+            if not self._quiesce(backoff):
+                raise LedgerError(
+                    "cluster: training never reached a step boundary "
+                    "during reclaim — chips are free but the reshape "
+                    "back is pending (re-run reclaim)")
+            gen = self._bump_generation()
+            try:
+                full = list(self.trainer.devices) + [
+                    d for d in self._world_devices(record["devices"])]
+                self._reshape_with_retry(full, gen, backoff,
+                                         leg="reclaim_reshape")
+            finally:
+                if self.gate is not None:
+                    self.gate.release()
+            with self._lock:
+                if record in self._borrows:
+                    self._borrows.remove(record)
+            reclaim_s = self._clock() - t0
+            self._record("reclaimed", model=model, idx=record["idx"],
+                         dp=self.trainer.dp, revoked=revoked,
+                         steps_done=self.trainer.steps_done,
+                         reclaim_s=round(reclaim_s, 3),
+                         fingerprint=self.trainer.fingerprint())
+            _met()["lend_s"].labels(leg="reclaim").observe(reclaim_s)
+            _met()["borrowed"].set(len(self.borrowed_devices()))
+            return reclaim_s
+
+    def _world_devices(self, names):
+        """Map journal device names back to the trainer's jax device
+        objects (the ledger speaks strings; jax wants handles)."""
+        import jax
+        by_name = {device_name(d): d for d in jax.local_devices()}
+        return [by_name.get(n, n) for n in names]

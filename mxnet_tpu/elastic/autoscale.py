@@ -57,6 +57,10 @@ _met = _tm.lazy_metrics(lambda reg: {
         "mx_elastic_window_p99_ms",
         "autoscaler's windowed e2e p99 estimate",
         labelnames=("model",)),
+    "errors": reg.counter(
+        "mx_autoscale_errors_total",
+        "autoscaler tick/lender failures survived by the daemon",
+        labelnames=("model", "where")),
 })
 
 
@@ -103,9 +107,14 @@ class Autoscaler:
                  max_replicas=None, queue_high=None, queue_low=None,
                  p99_budget_ms=None, sustain=3, cooldown_s=None,
                  period_s=None, ewma=0.3, allow_degraded=False,
-                 clock=time.monotonic):
+                 lender=None, clock=time.monotonic):
         self.gateway = gateway
         self.model = model
+        # cluster plane (optional): a LendingScheduler consulted when
+        # the policy hits its device ceiling (borrow training chips)
+        # or scales back in (return them); its lease deadlines are
+        # enforced from this loop too
+        self.lender = lender
         if min_replicas is None:
             min_replicas = int(get_env("MXTPU_ELASTIC_MIN_REPLICAS",
                                        1, int))
@@ -148,6 +157,14 @@ class Autoscaler:
         self.events = []        # bounded [(t, direction, replicas)]
         self._thread = None
         self._stop = threading.Event()
+        # daemon health (surfaced through Gateway.stats): a broken
+        # tick retries with backoff and counts failures instead of
+        # spinning silently; _dead goes True only if the loop itself
+        # exits without being stopped
+        self._failures_total = 0
+        self._consec_failures = 0
+        self._last_error = None
+        self._dead = False
 
     # -- telemetry reads (host floats only — MXL002 scope) -------------------
     def _queue_depth(self):
@@ -259,13 +276,45 @@ class Autoscaler:
             met["replicas"].labels(model=self.model).set(target)
             self.events.append((self._last_scale_t, direction, target))
             del self.events[:-64]
+        self._lender_hooks(decision, met)
         return decision, sample
+
+    def _lender_hooks(self, decision, met):
+        """Close the lending loop: capped-with-pressure borrows chips
+        from training, a scale-in returns them, and lease deadlines
+        are enforced every tick. A lender failure is counted and
+        survived — the policy loop must outlive its scheduler."""
+        if self.lender is None:
+            return
+        try:
+            if decision == "capped":
+                if self.lender.on_capped(self.model):
+                    logger.info(
+                        "elastic: %r at ceiling — borrowed training "
+                        "chips via the lending scheduler", self.model)
+            elif decision == "scale_in":
+                self.lender.on_cold(self.model)
+            self.lender.check_leases()
+        except Exception as e:  # noqa: BLE001 — see docstring
+            self._last_error = repr(e)[:300]
+            met["errors"].labels(model=self.model,
+                                 where="lender").inc()
+            logger.warning(
+                "elastic: lending hook for %r failed: %r",
+                self.model, e)
 
     # -- daemon --------------------------------------------------------------
     def start(self):
         if self._thread is not None:
             return self
         self._stop.clear()
+        self._dead = False
+        # surface daemon health where operators already look — a
+        # policy loop that died must show up in Gateway.stats(), not
+        # only in a log line nobody tails
+        attach = getattr(self.gateway, "attach_autoscaler", None)
+        if attach is not None:
+            attach(self.model, self)
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
             name=f"mxtpu-autoscale-{self.model}")
@@ -278,13 +327,45 @@ class Autoscaler:
             self._thread.join(timeout)
             self._thread = None
 
+    def daemon_stats(self):
+        """Bounded daemon-health snapshot for Gateway.stats()."""
+        return {
+            "running": self._thread is not None
+            and self._thread.is_alive(),
+            "dead": self._dead,
+            "errors_total": self._failures_total,
+            "consecutive_failures": self._consec_failures,
+            "last_error": self._last_error,
+        }
+
     def _loop(self):
-        while not self._stop.wait(self.period_s):
-            try:
-                self.tick()
-            except Exception as e:  # noqa: BLE001 — the autoscaler
-                # must never take down serving itself, but a broken
-                # tick must be VISIBLE, not a silent spin
-                logger.warning(
-                    "elastic: autoscaler tick for %r failed: %r",
-                    self.model, e)
+        """Daemon body. A transient tick failure (a mid-scale gateway
+        error, a telemetry hiccup) is retried with exponential backoff
+        on the poll period — bounded at 64x — and counted in
+        ``mx_autoscale_errors_total``; it must never kill the thread.
+        If the loop DOES exit unstopped (non-Exception escape), the
+        ``dead`` flag in :meth:`daemon_stats` says so instead of the
+        daemon failing silently."""
+        try:
+            while True:
+                backoff = min(2.0 ** min(self._consec_failures, 6),
+                              64.0)
+                if self._stop.wait(self.period_s * backoff):
+                    break
+                try:
+                    self.tick()
+                    self._consec_failures = 0
+                except Exception as e:  # noqa: BLE001 — survive and
+                    # count; the autoscaler must never take down
+                    # serving, but a broken tick must be VISIBLE
+                    self._failures_total += 1
+                    self._consec_failures += 1
+                    self._last_error = repr(e)[:300]
+                    _met()["errors"].labels(model=self.model,
+                                            where="tick").inc()
+                    logger.warning(
+                        "elastic: autoscaler tick for %r failed "
+                        "(%d consecutive, backoff x%g): %r",
+                        self.model, self._consec_failures, backoff, e)
+        finally:
+            self._dead = not self._stop.is_set()

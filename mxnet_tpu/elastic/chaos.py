@@ -40,6 +40,23 @@ scenario runners, one per advertised behavior:
     back IN after the cooldown — from ``mx_serving_*`` telemetry
     alone. Asserts both events, held p99, recovery budget.
 
+``colocation``
+    One cluster, two workloads: live ZeRO-2 training on 4 of 6 chips
+    and a 1-lane gateway model on the rest, both placed through ONE
+    :class:`~mxnet_tpu.cluster.DeviceLedger`. An open-loop serving
+    overload drives the autoscaler to its ceiling; the
+    :class:`~mxnet_tpu.cluster.LendingScheduler` quiesces training at
+    a step boundary, reshapes dp 4→2, and leases the freed chips to
+    ``Gateway.scale``. The post-burst cold window reverses the loan:
+    lanes drain, chips return, training reshapes back to dp 4.
+    Asserts: serving recovered past its pre-lend ceiling inside the
+    budget; training fingerprint **bit-identical** to a planned
+    lend/reclaim twin (batch schedule preserved, drift vs the
+    uninterrupted run bounded); per-owner device-seconds conserved
+    (sums to world size); the ledger journal replays conserved at
+    every epoch; and an injected ``borrow_wedge`` loan is revoked at
+    its deadline with the chips back in training.
+
 Everything runs chip-free on the CPU mesh (the same doctrine as every
 committed artifact: scenario structure + host numbers now, chip
 numbers when a live window opens).
@@ -71,7 +88,7 @@ _met = _tm.lazy_metrics(lambda reg: {
 })
 
 FAMILIES = ("preemption_storm", "straggler", "replica_kill",
-            "autoscale_cycle")
+            "autoscale_cycle", "colocation")
 
 
 def _repo_root():
@@ -669,6 +686,281 @@ def run_autoscale_cycle(burst_s=2.5, rate_factor=3.0,
 
 
 # ======================================================================
+# colocation (device lending: one ledger, two workloads)
+# ======================================================================
+def run_colocation(burst_s=4.0, rate_factor=3.0,
+                   p99_budget_ms=10000.0, recovery_budget_s=60.0,
+                   reclaim_budget_s=60.0, drift_bound=1e-4, seed=9,
+                   step_pace_s=0.05, workdir=None):
+    """Serving overload during live training on one ledger-governed
+    cluster: the autoscaler caps out, borrows training chips through
+    the LendingScheduler, serves the burst on them, and the cold
+    window reverses the loan — training bit-identical after reclaim,
+    device-seconds conserved per owner, a wedged borrower revoked at
+    its deadline. Returns the scenario dict (see module doc)."""
+    import jax
+
+    from ..cluster import DeviceLedger, LendingScheduler, StepGate
+    from ..cluster.ledger import device_name
+    from ..serving import Gateway
+    from .autoscale import Autoscaler
+
+    devs = jax.local_devices()
+    if len(devs) < 6:
+        raise MXNetError(
+            f"chaos: colocation needs >= 6 devices (4 training + 2 "
+            f"serving), got {len(devs)}")
+    world = devs[:6]
+    train_devs = world[:4]
+    model = "chaos_coloc"
+    batch_size = 32
+    params, loss_fn, batch_ex, X, Y = _storm_fixture(
+        seed, batch_size=batch_size)
+    n_batches = len(X) // batch_size
+
+    def make_trainer():
+        return ElasticTrainer(loss_fn, params, batch_ex, lr=0.05,
+                              momentum=0.9, stage=2)
+
+    def batch_at(k):
+        # deterministic batch-by-index: the schedule survives any
+        # number of reshapes with no iterator state to carry
+        i = (k % n_batches) * batch_size
+        return X[i:i + batch_size], Y[i:i + batch_size]
+
+    symbol, args, aux, feature = _serving_fixture(seed=5, din=512,
+                                                  hidden=2048)
+    rows = 4
+    with _scratch_dir(workdir, "colocation") as root:
+        jdir = os.path.join(root, "ledger")
+        ledger = DeviceLedger(world, journal_dir=jdir)
+        trainer = make_trainer()
+        trainer.attach_ledger(ledger, "training")
+        trainer.build(train_devs)
+        gate = StepGate()
+        live_hashes = []
+        stop_train = threading.Event()
+        train_err = []
+
+        def train_loop():
+            # paced: keeps total steps in the regime where fp32
+            # re-association drift stays tiny (it compounds
+            # exponentially past ~1k steps on this fixture), and
+            # leaves CPU for the serving burst it shares the host with
+            try:
+                while not stop_train.is_set():
+                    gate.step_boundary()
+                    if stop_train.is_set():
+                        break
+                    b = batch_at(trainer.steps_done)
+                    live_hashes.append(_batch_hash(*b))
+                    trainer.train_step(b)
+                    time.sleep(step_pace_s)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                train_err.append(e)
+
+        gw = Gateway(devices=world, ledger=ledger)
+        tt = threading.Thread(target=train_loop, daemon=True)
+        try:
+            gw.register(model, symbol, args, aux,
+                        input_shapes={"data": feature},
+                        buckets=(1, 2, 4, 8), max_wait_ms=1.0,
+                        max_queue=512, replicas=1)
+            cap = _serial_capacity(gw, model, feature, rows=rows)
+            tt.start()
+            scheduler = LendingScheduler(
+                ledger, trainer=trainer, gateway=gw, gate=gate,
+                min_train_dp=2, deadline_s=30.0, lend_chunk=2)
+            scaler = Autoscaler(
+                gw, model, min_replicas=1, max_replicas=4,
+                queue_high=4.0, sustain=2, cooldown_s=1.0,
+                period_s=0.15, ewma=0.5, allow_degraded=False,
+                lender=scheduler)
+            load = _OpenLoopLoad(gw, model, feature,
+                                 rate_per_s=max(cap * rate_factor,
+                                                50.0),
+                                 duration_s=burst_s, rows=rows)
+            t0 = time.perf_counter()
+            decisions = []
+            stop = threading.Event()
+
+            def drive():
+                while not stop.wait(scaler.period_s):
+                    d, sample = scaler.tick()
+                    decisions.append(
+                        (round(time.perf_counter() - t0, 3), d,
+                         sample["replicas"],
+                         round(sample["depth_ewma"], 2)))
+
+            dt = threading.Thread(target=drive, daemon=True)
+            dt.start()
+            load.run()
+            load.finish()
+            # cold window: keep ticking until the loan is reclaimed
+            deadline = time.monotonic() + reclaim_budget_s
+            while time.monotonic() < deadline:
+                if not scheduler.active_borrows() and any(
+                        ev == "reclaimed"
+                        for _, ev, _ in scheduler.events):
+                    break
+                time.sleep(0.1)
+            stop.set()
+            dt.join(10.0)
+            stop_train.set()
+            gate.release()         # in case the loop is parked
+            tt.join(10.0)
+            if train_err:
+                raise train_err[0]
+            p99 = load.p99_ms()
+            fp_live = trainer.fingerprint()
+            steps_total = trainer.steps_done
+            dp_final = trainer.dp
+            events = list(scheduler.events)
+
+            def _ev(name, key=None, idx=0):
+                hits = [d for _, e, d in events if e == name]
+                if len(hits) <= idx:
+                    return None
+                return hits[idx] if key is None else \
+                    hits[idx].get(key)
+
+            lend_step = _ev("quiesced", "steps_done")
+            reclaim_step = _ev("reclaimed", "steps_done")
+            reclaim_s = _ev("reclaimed", "reclaim_s")
+            lent = _ev("leased") is not None
+            # recovery: first capped tick -> first tick serving runs
+            # past its pre-lend ceiling of 2 lanes (on borrowed chips)
+            t_capped = next((t for t, d, _, _ in decisions
+                             if d == "capped"), None)
+            t_past = next((t for t, _, n, _ in decisions if n > 2),
+                          None)
+            recovery_s = None
+            if t_capped is not None and t_past is not None:
+                recovery_s = max(t_past - t_capped, 0.0)
+            peak = max((n for _, _, n, _ in decisions), default=1)
+
+            # ---- planned twin: same schedule, lend/reclaim as pure
+            # reshapes with no serving in the loop ------------------
+            fp_twin = None
+            twin_hashes = [_batch_hash(*batch_at(k))
+                           for k in range(steps_total)]
+            if lend_step is not None and reclaim_step is not None:
+                twin = make_trainer().build(train_devs)
+                for k in range(lend_step):
+                    twin.train_step(batch_at(k))
+                twin.reshape(list(train_devs[:2]))
+                for k in range(lend_step, reclaim_step):
+                    twin.train_step(batch_at(k))
+                twin.reshape(list(train_devs))
+                for k in range(reclaim_step, steps_total):
+                    twin.train_step(batch_at(k))
+                fp_twin = twin.fingerprint()
+
+            # ---- uninterrupted dp=4 reference (drift bound) -------
+            ref = make_trainer().build(train_devs)
+            for k in range(steps_total):
+                ref.train_step(batch_at(k))
+            ref_host = to_host(ref.params)
+            live_host = to_host(trainer.params)
+            drift = max(
+                float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+                for a, b in zip(
+                    (v for _, v in sorted(ref_host.items())),
+                    (v for _, v in sorted(live_host.items()))))
+
+            # ---- injected borrow_wedge: lease revoked at deadline -
+            wedge_deadline_s = 0.5
+            scheduler.gate = None          # trainer now caller-driven
+            scheduler.fault_plan = "borrow_wedge"
+            t_wlend = time.perf_counter()
+            scheduler.lend(model, 2, deadline_s=wedge_deadline_s)
+            revoke_t = None
+            wedge_wait = time.monotonic() + 15.0
+            while time.monotonic() < wedge_wait:
+                if scheduler.check_leases():
+                    revoke_t = time.perf_counter()
+                    break
+                time.sleep(0.05)
+            revoke_s = None if revoke_t is None else \
+                revoke_t - t_wlend
+            chips_home = all(
+                ledger.owner_of(device_name(d))[0] == "training"
+                for d in train_devs)
+            wedge = {
+                "injected": True,
+                "deadline_s": wedge_deadline_s,
+                "revoke_s": round(revoke_s, 3)
+                if revoke_s is not None else None,
+                "revoked_within_deadline": revoke_s is not None
+                and revoke_s <= wedge_deadline_s + 10.0,
+                "chips_returned": chips_home,
+                "training_dp_after": trainer.dp,
+                "training_fp_preserved":
+                    trainer.fingerprint() == fp_live,
+            }
+
+            ds = ledger.device_seconds()
+            vj = DeviceLedger.verify_journal(jdir)
+        finally:
+            stop_train.set()
+            gate.release()
+            gw.close()
+
+    # the schedule intentionally cycles the epoch, so positionwise
+    # comparison (not set difference) is the honest batch check here
+    mismatched = sum(1 for a, b in zip(live_hashes, twin_hashes)
+                     if a != b) + abs(len(live_hashes)
+                                      - len(twin_hashes))
+    if recovery_s is not None:
+        _met()["recovery_s"].labels(scenario="colocation").observe(
+            recovery_s)
+    return {
+        "family": "colocation",
+        "mode": "open_loop",
+        "world": {"world_size": len(world), "training_dp_initial": 4,
+                  "serving_lanes_initial": 1, "min_train_dp": 2},
+        "measured_serial_req_per_s": round(cap, 1),
+        "offered_req_per_s": round(load.rate, 1),
+        "submitted": load.submitted,
+        "completed": len(load.latencies),
+        "rejected": load.rejected,
+        "lost_requests": len(load.errors),
+        "errors_sample": load.errors[:3],
+        "lend": {"occurred": lent, "chips": 2, "dp_from": 4,
+                 "dp_to": 2, "replicas_peak": peak,
+                 "at_step": lend_step},
+        "steps": {"total": steps_total, "lend_at": lend_step,
+                  "reclaim_at": reclaim_step,
+                  "dp_final": dp_final},
+        "recovery_s": round(recovery_s, 3)
+        if recovery_s is not None else None,
+        "recovery_budget_s": recovery_budget_s,
+        "reclaim_s": reclaim_s,
+        "reclaim_budget_s": reclaim_budget_s,
+        "p99_ms": round(p99, 2) if p99 is not None else None,
+        "p99_budget_ms": p99_budget_ms,
+        "batches": {
+            "total": steps_total,
+            "mismatched": mismatched,
+            "schedule_preserved": live_hashes == twin_hashes,
+        },
+        "fingerprint": {
+            "resumed": fp_live,
+            "planned_reshape": fp_twin,
+            "bit_identical": fp_twin is not None
+            and fp_live == fp_twin,
+            "drift_vs_uninterrupted_max_abs": drift,
+            "drift_bound": drift_bound,
+        },
+        "device_seconds": ds,
+        "ledger": {"epochs": vj["epochs"],
+                   "journal_conserved": vj["conserved"],
+                   "violations": vj["violations"]},
+        "borrow_wedge": wedge,
+    }
+
+
+# ======================================================================
 def run_all(workdir=None, quick=False):
     """Every scenario family, one artifact-ready dict."""
     scenarios = {}
@@ -681,4 +973,6 @@ def run_all(workdir=None, quick=False):
         duration_s=2.0 if quick else 4.0, workdir=workdir)
     scenarios["autoscale_cycle"] = run_autoscale_cycle(
         burst_s=1.5 if quick else 2.5, workdir=workdir)
+    scenarios["colocation"] = run_colocation(
+        burst_s=2.5 if quick else 4.0, workdir=workdir)
     return scenarios

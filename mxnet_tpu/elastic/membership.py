@@ -171,6 +171,16 @@ class Membership:
                         manifest=False)
         return g
 
+    def bump(self, reason=None):
+        """Advance the generation without a join/leave/death — a
+        PLANNED world change (the cluster plane's device lend/reclaim
+        reshapes dp without any member coming or going). Every poller
+        converges on the new generation exactly as for a membership
+        event. Returns the new generation."""
+        g = self._bump()
+        _met()["changes"].labels(kind=reason or "planned").inc()
+        return g
+
     # -- this rank's entry ---------------------------------------------------
     def _member_path(self, rank):
         return os.path.join(self.dir, f"{_MEMBER_PREFIX}{int(rank)}.json")

@@ -161,12 +161,33 @@ class ElasticTrainer:
         self.opt = None
         self.generation = 0     # membership generation this world serves
         self.steps_done = 0
+        # cluster plane: when attached, every build() (including the
+        # one inside reshape()) re-acquires the training lease through
+        # the DeviceLedger BEFORE compiling — so a dp reshape that
+        # would overlap a serving lane raises instead of silently
+        # sharing chips
+        self._ledger = None
+        self._lease_owner = "training"
 
     @property
     def dp(self):
         return len(self.devices) if self.devices else 0
 
     # -- build / reshape ----------------------------------------------------
+    def attach_ledger(self, ledger, owner="training"):
+        """Make ``ledger`` the assignment authority for this trainer:
+        every subsequent build/reshape acquires (or resizes to) its
+        device list as the ``owner`` training_shard lease first, so a
+        placement that overlaps another workload fails BEFORE any
+        compile. Returns self."""
+        self._ledger = ledger
+        self._lease_owner = owner
+        if self.devices is not None:
+            ledger.ensure(owner, [str(d) for d in self.devices],
+                          role="training_shard",
+                          generation=self.generation)
+        return self
+
     def build(self, devices, params_host=None, opt_host=None,
               generation=0):
         """Compile the ZeRO step for ``devices`` and place state —
@@ -178,6 +199,14 @@ class ElasticTrainer:
         devices = list(devices)
         if not devices:
             raise MXNetError("elastic: cannot build a 0-device mesh")
+        if self._ledger is not None:
+            # the exclusivity check happens here, not after: a chip
+            # another owner holds raises a LedgerError and the old
+            # mesh/state stay untouched
+            self._ledger.ensure(self._lease_owner,
+                                [str(d) for d in devices],
+                                role="training_shard",
+                                generation=int(generation))
         self.mesh = create_mesh({self.dp_axis: len(devices)},
                                 devices=devices)
         step, p0, o0 = make_zero_train_step(
@@ -337,10 +366,25 @@ class ElasticTrainer:
 
         if not _mem.census_enabled():
             return {"disabled": True}
+        if self._ledger is not None:
+            # key the byte-accounting through the cluster ledger: the
+            # census must be measuring exactly the chips our lease
+            # names, or the reshape placed state on someone else's
+            lease = self._ledger.find_lease(self._lease_owner,
+                                            role="training_shard")
+            held = set(lease.devices) if lease else set()
+            ours = {str(d) for d in self.devices}
+            if held != ours:
+                raise MXNetError(
+                    f"elastic: census/lease mismatch — training lease "
+                    f"covers {sorted(held)} but the mesh is placed on "
+                    f"{sorted(ours)}")
         _mem.tag_tree(self.params, "parameter")
         if self.opt is not None:
             _mem.tag_tree(self.opt, "optimizer_state")
         report = {"dp": self.dp, "stage": self.stage, "roles": {}}
+        if self._ledger is not None and lease is not None:
+            report["lease"] = lease.lease_id
         roles = [("parameter", self.params)]
         if self.opt is not None:
             roles.append(("optimizer_state", self.opt))

@@ -48,6 +48,16 @@ Each ``;``-separated directive is ``kind[=arg]`` followed by
     :func:`apply_straggler` called inside the step span by the
     elastic train loop / chaos driver — the deterministic straggler
     whose rank PR 5's trace_merge report must name.
+``borrow_wedge``
+    (lending seam, Python-side) the borrower of lent training chips
+    takes the lease but never reports ready (``round=N`` = the Nth
+    lend; no round = every lend) — drives the LendingScheduler's
+    lease-revocation path in cluster/lending.py.
+``reclaim_timeout=<ms>``
+    (lending seam, Python-side) the borrower drains slowly on reclaim:
+    inject ``ms`` of extra drain latency into the Nth reclaim
+    (``round=N``; no round = every reclaim), bounded by the reclaim
+    backoff budget.
 
 Conditions: ``round=N`` (Nth distinct matching request, counted PER
 RANK so interleaving across workers cannot move the firing point, and
@@ -103,6 +113,14 @@ CHECKPOINT_KINDS = ("kill_worker", "trunc_checkpoint", "corrupt_checkpoint")
 # trace_merge straggler report must NAME that exact rank by its
 # non-comm work. Never reaches the native seams either.
 STRAGGLER_KINDS = ("slow_worker",)
+# Python-side device-lending faults (mxnet_tpu/cluster/lending.py):
+# ``borrow_wedge[@round=N]`` makes the Nth lend's borrower take the
+# lease but never report ready (no round= — every lend), driving the
+# LendingScheduler.check_leases revocation path; ``reclaim_timeout=MS
+# [@round=N]`` injects a slow borrower drain of MS milliseconds into
+# the Nth reclaim, which the reclaim backoff budget must bound. Like
+# the straggler kinds they never reach the native seams.
+LENDING_KINDS = ("borrow_wedge", "reclaim_timeout")
 # wire op codes (comm.cc kInit..kPullRows)
 OP_CODES = {
     "init": 1,
@@ -139,10 +157,11 @@ class FaultRule:
     @property
     def is_python_side(self) -> bool:
         """Rules consumed by Python seams (checkpoint writes, the
-        preemption guard, the straggler sleep) — the native installers
-        must skip them."""
+        preemption guard, the straggler sleep, the lending protocol's
+        wedge/timeout seams) — the native installers must skip them."""
         return self.kind in CHECKPOINT_KINDS or \
-            self.kind in STRAGGLER_KINDS
+            self.kind in STRAGGLER_KINDS or \
+            self.kind in LENDING_KINDS
 
 
 def parse_fault_plan(plan: str) -> list[FaultRule]:
@@ -158,11 +177,12 @@ def parse_fault_plan(plan: str) -> list[FaultRule]:
         kind, _, argtxt = head.partition("=")
         kind = kind.strip()
         if kind not in KIND_CODES and kind not in CHECKPOINT_KINDS \
-                and kind not in STRAGGLER_KINDS:
+                and kind not in STRAGGLER_KINDS \
+                and kind not in LENDING_KINDS:
             raise MXNetError(
                 f"unknown fault kind {kind!r} in MXNET_KVSTORE_FAULT_PLAN "
                 f"directive {directive!r} (known: "
-                f"{sorted(KIND_CODES) + sorted(CHECKPOINT_KINDS) + sorted(STRAGGLER_KINDS)})")
+                f"{sorted(KIND_CODES) + sorted(CHECKPOINT_KINDS) + sorted(STRAGGLER_KINDS) + sorted(LENDING_KINDS)})")
         rule = FaultRule(kind=kind)
         if argtxt:
             try:
@@ -181,6 +201,14 @@ def parse_fault_plan(plan: str) -> list[FaultRule]:
             raise MXNetError(
                 f"fault {directive!r}: slow_worker needs a delay in "
                 "ms, e.g. slow_worker=40@rank=1")
+        elif kind == "reclaim_timeout":
+            raise MXNetError(
+                f"fault {directive!r}: reclaim_timeout needs a delay "
+                "in ms, e.g. reclaim_timeout=800@round=1")
+        if kind == "borrow_wedge" and argtxt:
+            raise MXNetError(
+                f"fault {directive!r}: borrow_wedge takes no value "
+                "(condition it with @round=N instead)")
         for cond in conds:
             name, eq, val = cond.partition("=")
             name = name.strip()
@@ -219,7 +247,9 @@ def parse_fault_plan(plan: str) -> list[FaultRule]:
             allowed = {"kill_worker": ("batch", "rank"),
                        "trunc_checkpoint": ("round", "rank"),
                        "corrupt_checkpoint": ("round", "rank"),
-                       "slow_worker": ("rank",)}[rule.kind]
+                       "slow_worker": ("rank",),
+                       "borrow_wedge": ("round",),
+                       "reclaim_timeout": ("round",)}[rule.kind]
             ignored = [c for c in _CONDS
                        if getattr(rule, c) is not None and c not in allowed]
             if ignored:
@@ -365,6 +395,52 @@ def apply_straggler(worker_rank=None, plan=None):
     ms = straggler_delay_ms(worker_rank, plan)
     if ms > 0:
         time.sleep(ms / 1000.0)
+    return ms
+
+
+# -- device-lending seams (Python-side) -----------------------------------
+# parsed borrow_wedge / reclaim_timeout rules cached per plan string,
+# same discipline as the straggler cache: the lending protocol probes
+# these on every lend/reclaim, so it must cost a dict lookup
+_LENDING_CACHE = {}  # plan string -> {"wedge": [...], "reclaim": [...]}
+
+
+def _lending_rules(plan):
+    if plan is None:
+        plan = os.environ.get("MXNET_KVSTORE_FAULT_PLAN", "")
+    if not plan:
+        return {"wedge": [], "reclaim": []}
+    rules = _LENDING_CACHE.get(plan)
+    if rules is None:
+        rules = {"wedge": [], "reclaim": []}
+        for r in parse_fault_plan(plan):
+            if r.kind == "borrow_wedge":
+                rules["wedge"].append(r)
+            elif r.kind == "reclaim_timeout":
+                rules["reclaim"].append(r)
+        _LENDING_CACHE[plan] = rules
+    return rules
+
+
+def borrow_wedge_active(lend_round=None, plan=None):
+    """Whether the plan's ``borrow_wedge`` rules wedge this lend (the
+    1-based ``lend_round``). A rule without ``round=`` wedges every
+    lend; with ``round=N`` only the Nth. ``plan`` defaults to
+    MXNET_KVSTORE_FAULT_PLAN."""
+    for r in _lending_rules(plan)["wedge"]:
+        if r.round is None or r.round == lend_round:
+            return True
+    return False
+
+
+def reclaim_delay_ms(reclaim_round=None, plan=None):
+    """Injected borrower-drain delay in ms for the 1-based
+    ``reclaim_round`` (0.0 when no ``reclaim_timeout`` rule matches;
+    rules without ``round=`` hit every reclaim)."""
+    ms = 0.0
+    for r in _lending_rules(plan)["reclaim"]:
+        if r.round is None or r.round == reclaim_round:
+            ms += r.arg
     return ms
 
 

@@ -332,6 +332,21 @@ _ENV_VARS = {
         "minimum seconds between a scale event and the next "
         "scale-in — hysteresis so bursty load cannot flap the fleet "
         "(default 30; elastic/autoscale.py)"),
+    "MXTPU_LEND_DEADLINE_SEC": (
+        "device-lending lease deadline: chips borrowed from training "
+        "for serving are due back after this many seconds — a "
+        "borrower that has not returned (or never reported ready) by "
+        "then is revoked and the chips reshape back into training "
+        "(default 60; cluster/lending.py)"),
+    "MXTPU_LEND_MIN_TRAIN_DP": (
+        "training dp floor for device lending: a lend that would "
+        "shrink the ElasticTrainer below this many shards is refused "
+        "(default 1; cluster/lending.py)"),
+    "MXTPU_LEND_RECLAIM_BACKOFF_MS": (
+        "total backoff budget for one lend/reclaim protocol leg: "
+        "bounds the step-boundary quiesce wait, reshape retries, and "
+        "how much of an injected reclaim_timeout borrower drain is "
+        "honored (default 5000; cluster/lending.py)"),
 }
 
 

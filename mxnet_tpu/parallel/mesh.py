@@ -180,6 +180,18 @@ def replica_slices(n, tp, devices=None, exclude=()):
     return slices, degraded
 
 
+def free_pool(devices=None, held=()):
+    """The devices NOT named in ``held`` (string identity, order
+    preserved) — the cluster plane's view of what a workload may place
+    on: the gateway filters its base pool by the DeviceLedger's
+    foreign holdings before picking lanes, so the ``exclude=``
+    discipline above extends across workloads, not just across this
+    gateway's own slices."""
+    devs = list(devices if devices is not None else jax.local_devices())
+    held_names = {str(d) for d in held}
+    return [d for d in devs if str(d) not in held_names]
+
+
 # degraded-wrap warnings already emitted, keyed (ask, devices): the
 # serving autoscaler re-enters replica_devices on EVERY scale event,
 # and a per-call warning for the same unchanged wrap is log spam, not

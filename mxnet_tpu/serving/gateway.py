@@ -31,6 +31,7 @@ latencies in the ``mx_serving_*`` telemetry families.
 """
 from __future__ import annotations
 
+import contextlib
 import logging
 import threading
 
@@ -375,11 +376,22 @@ class Gateway:
         gw.close()
     """
 
-    def __init__(self, devices=None):
+    def __init__(self, devices=None, ledger=None,
+                 ledger_owner="serving"):
         self.registry = ModelRegistry()
         self._generators = {}          # name -> generate.GenModel
         self._gen_lock = threading.Lock()
         self._devices = list(devices) if devices is not None else None
+        # cluster plane (optional): when a DeviceLedger is attached,
+        # every lane/slice placement is carved from the ledger's
+        # usable pool (free + serving's own chips), pre-validated
+        # against foreign holdings BEFORE compiling, and committed as
+        # serving_lane / tp_slice leases after — the gateway's
+        # exclude= discipline extended across workloads
+        self._ledger = ledger
+        self._ledger_owner = ledger_owner
+        self._lease_deadline_s = None
+        self._autoscalers = {}         # name -> elastic Autoscaler
         self._closed = False
         self._health_thread = None
         self._health_stop = threading.Event()
@@ -389,6 +401,110 @@ class Gateway:
                 target=self._health_loop, args=(period,), daemon=True,
                 name="mxtpu-serve-health")
             self._health_thread.start()
+
+    # -- cluster ledger ------------------------------------------------------
+    def attach_ledger(self, ledger, owner="serving"):
+        """Make ``ledger`` the assignment authority for this gateway's
+        placement (see __init__); existing lanes are committed as
+        leases immediately. Returns self."""
+        self._ledger = ledger
+        self._ledger_owner = owner
+        self._ledger_sync()
+        return self
+
+    @contextlib.contextmanager
+    def lease_deadline(self, seconds):
+        """Scope under which placements are loan-bound: lane/slice
+        leases committed inside carry ``seconds`` of deadline (the
+        lending scheduler wraps its borrow-driven ``scale`` in this,
+        so the ledger journal records when the chips are due back)."""
+        prev = self._lease_deadline_s
+        self._lease_deadline_s = float(seconds)
+        try:
+            yield
+        finally:
+            self._lease_deadline_s = prev
+
+    def _base_devices(self):
+        import jax
+        return list(self._devices) if self._devices is not None \
+            else jax.local_devices()
+
+    def _usable_devices(self):
+        """The placement pool: the constructor-pinned (or local-mesh)
+        devices, minus chips the cluster ledger says another workload
+        holds. Without a ledger this is just the base pool."""
+        devs = self._base_devices()
+        if self._ledger is None:
+            return devs
+        from ..parallel.mesh import free_pool
+        return free_pool(devs, held=self._ledger.foreign_devices(
+            self._ledger_owner))
+
+    def _ledger_guard(self, devices):
+        """Fail BEFORE compiling: refuse a placement that names a chip
+        another workload leases. (The degraded-wrap escape hatch stays
+        legal only WITHIN serving's own pool.)"""
+        if self._ledger is None:
+            return
+        foreign = set(self._ledger.foreign_devices(self._ledger_owner))
+        clash = sorted({str(d) for d in devices} & foreign)
+        if clash:
+            raise ServingError(
+                f"serving: devices {clash} are leased to another "
+                "workload in the cluster ledger — refusing the "
+                "placement")
+
+    def _ledger_sync(self):
+        """Commit the CURRENT lane/slice placement as this gateway's
+        leases (one per role, resized as lanes come and go; released
+        when a role empties). Called after every placement-changing
+        commit — register, scale, retire, unregister, close."""
+        if self._ledger is None:
+            return
+        lanes, slices = [], []
+        for m in self.registry.models():
+            for rep in m.replicas:
+                dev = rep.device
+                if isinstance(dev, (list, tuple)) and len(dev) > 1:
+                    slices.extend(dev)
+                else:
+                    lanes.append(dev[0] if isinstance(
+                        dev, (list, tuple)) else dev)
+        with self._gen_lock:
+            gens = list(self._generators.values())
+        for g in gens:
+            for ln in g.lanes:
+                dev = ln.device
+                if isinstance(dev, (list, tuple)) and len(dev) > 1:
+                    slices.extend(dev)
+                else:
+                    lanes.append(dev[0] if isinstance(
+                        dev, (list, tuple)) else dev)
+        slice_names = []
+        for d in slices:
+            n = str(d)
+            if n not in slice_names:
+                slice_names.append(n)
+        lane_names = []
+        for d in lanes:
+            n = str(d)
+            # degraded wrap can stack lanes (or a lane onto a slice)
+            # within serving's own pool — one lease covers the chip
+            if n not in lane_names and n not in slice_names:
+                lane_names.append(n)
+        for role, names in (("serving_lane", lane_names),
+                            ("tp_slice", slice_names)):
+            lease = self._ledger.find_lease(self._ledger_owner,
+                                            role=role)
+            if names:
+                if lease is None or list(lease.devices) != names \
+                        or self._lease_deadline_s is not None:
+                    self._ledger.ensure(
+                        self._ledger_owner, names, role=role,
+                        deadline_s=self._lease_deadline_s)
+            elif lease is not None:
+                self._ledger.release(lease.lease_id)
 
     # -- registration --------------------------------------------------------
     def _sliced_devices(self):
@@ -419,7 +535,8 @@ class Gateway:
         re-excludes the model's own devices (which would spuriously
         degrade an exactly-fitting host)."""
         from ..parallel.mesh import replica_slices, should_warn_degraded
-        devs = self._devices
+        devs = self._usable_devices() if self._ledger is not None \
+            else self._devices
         slices, degraded = replica_slices(
             n, tp, devices=devs, exclude=self._sliced_devices())
         flat = [d for s in slices for d in s]
@@ -430,15 +547,24 @@ class Gateway:
                 "(slices share devices)", n, tp)
         return slices, degraded
 
-    def _pick_devices(self, n):
+    def _pick_devices(self, n, busy=()):
         from ..parallel.mesh import replica_devices, should_warn_degraded
         # self._devices None = the full local mesh, re-read per
         # registration (a constructor-pinned pool stays pinned).
         # Devices held by tp mesh slices are excluded: a replicated
         # lane wraps onto them only when nothing else exists, and
-        # then the degraded flag says so (never a silent overlap)
+        # then the degraded flag says so (never a silent overlap).
+        # ``busy`` additionally de-prioritizes devices existing lanes
+        # already occupy (scale-out passes them), so new lanes land on
+        # untouched chips — freshly borrowed ones included — before
+        # any wrap. With a cluster ledger the pool additionally drops
+        # chips other workloads lease (a lane may NEVER wrap onto
+        # those — _ledger_guard raises before any compile)
+        devs = self._usable_devices() if self._ledger is not None \
+            else self._devices
         picked, degraded = replica_devices(
-            n, devices=self._devices, exclude=self._sliced_devices())
+            n, devices=devs,
+            exclude=list(self._sliced_devices()) + list(busy))
         if degraded and should_warn_degraded(n, picked):
             # SNIPPETS [2] degrade pattern (parallel/mesh.py): serve
             # with the mesh that exists instead of refusing — replicas
@@ -454,11 +580,11 @@ class Gateway:
 
     def device_count(self):
         """Distinct devices available to replica placement — the
-        autoscaler's non-degraded ceiling."""
-        import jax
-        devs = self._devices if self._devices is not None \
-            else jax.local_devices()
-        return len(devs)
+        autoscaler's non-degraded ceiling. With a cluster ledger this
+        is the USABLE pool (free + serving's own), so a lend from
+        training visibly raises the ceiling and a reclaim lowers it —
+        the closed loop the lending scheduler steers by."""
+        return len(self._usable_devices())
 
     def register(self, name, symbol, arg_params, aux_params,
                  input_shapes, variants=("fp32",), calib_data=None,
@@ -579,6 +705,9 @@ class Gateway:
             picked, degraded = self._pick_slices(replicas, tp)
         else:
             picked, degraded = self._pick_devices(replicas)
+        self._ledger_guard([d for s in picked for d in
+                            (s if isinstance(s, (list, tuple))
+                             else [s])])
         model.degraded = degraded
         for idx, device in enumerate(picked):
             rep, n_exec = build_replica(model, idx, device)
@@ -594,6 +723,7 @@ class Gateway:
             met["healthy"].labels(model=name,
                                   replica=str(rep.idx)).set(1)
             rep.start()
+        self._ledger_sync()
         logger.info(
             "serving: registered %r — %d replica(s) x %d variant(s) x "
             "%d bucket(s), warmup %.1fs", name, len(model.replicas),
@@ -615,9 +745,9 @@ class Gateway:
         if gen is not None:
             gen.close()
         model = self.registry.pop(name)
-        if model is None:
-            return
-        self._shutdown_model(model)
+        if model is not None:
+            self._shutdown_model(model)
+        self._ledger_sync()
 
     # -- generative decode ---------------------------------------------------
     def register_generator(self, name, decoder, block_tokens=None,
@@ -961,10 +1091,26 @@ class Gateway:
                 degraded = new_deg or \
                     n * gen.tp > self.device_count()
             else:
-                picked, degraded = self._pick_devices(n)
+                # existing lanes keep their devices; only the NEW
+                # lanes are placed, preferring chips no lane holds yet
+                with gen.cond:
+                    active = [ln.device for ln in gen.lanes
+                              if not ln.retiring]
+                extra = max(n - len(active), 0)
+                if extra:
+                    new_devs, new_deg = self._pick_devices(
+                        extra, busy=active)
+                else:
+                    new_devs, new_deg = [], False
+                picked = list(active) + new_devs
+                degraded = new_deg or n > self.device_count()
+            self._ledger_guard([d for s in picked for d in
+                                (s if isinstance(s, (list, tuple))
+                                 else [s])])
             report = gen.scale_to(n, picked)
             gen.degraded = degraded
             report["degraded"] = degraded
+            self._ledger_sync()
             return report
         m = self.registry.get(name)
         cur = len(m.replicas)
@@ -987,7 +1133,18 @@ class Gateway:
                     degraded = new_deg or \
                         n * m.tp > self.device_count()
                 else:
-                    picked, degraded = self._pick_devices(n)
+                    # place only the ADDITIONAL lanes, away from the
+                    # devices the existing lanes occupy — a lend's
+                    # freshly freed chips get used instead of lanes
+                    # silently stacking on busy ones
+                    existing = [r.device for r in m.replicas]
+                    new_devs, new_deg = self._pick_devices(
+                        n - cur, busy=existing)
+                    picked = existing + new_devs
+                    degraded = new_deg or n > self.device_count()
+                self._ledger_guard([d for s in picked[cur:] for d in
+                                    (s if isinstance(s, (list, tuple))
+                                     else [s])])
                 m.degraded = degraded
                 report["degraded"] = degraded
                 met = _met()
@@ -1018,6 +1175,7 @@ class Gateway:
                 # (a tp model needs n slices x tp devices)
                 m.degraded = n * (m.tp or 1) > self.device_count()
                 report["degraded"] = m.degraded
+        self._ledger_sync()
         return report
 
     def _retire_replica(self, m, rep):
@@ -1042,6 +1200,13 @@ class Gateway:
             # queue exits at its next wakeup (daemon thread, reaped by
             # the interpreter) — retirement must not block on traffic
             rep.join(timeout=0.5)
+        self._ledger_sync()
+
+    def attach_autoscaler(self, name, scaler):
+        """Expose an autoscaler's daemon health through stats() — the
+        scaler calls this at start(), so a dead policy loop is visible
+        where operators already look instead of failing silently."""
+        self._autoscalers[name] = scaler
 
     def stats(self):
         """Bounded per-model snapshot (queue depth, service-rate
@@ -1080,6 +1245,12 @@ class Gateway:
             gens = list(self._generators.values())
         for g in gens:
             out[g.name] = {"generator": True, **g.stats()}
+        # daemon health of attached autoscalers: a policy loop that
+        # died (or is stuck retrying) must be visible here, not only
+        # in the logs it failed to write
+        for name, scaler in list(self._autoscalers.items()):
+            out.setdefault(name, {})["autoscaler"] = \
+                scaler.daemon_stats()
         return out
 
     # -- shutdown ------------------------------------------------------------

@@ -1,0 +1,749 @@
+"""Cluster plane tests (cluster/): the DeviceLedger as single
+assignment authority (double assignment raises, conservation proven,
+per-owner device-seconds sum to the world), journal replay after a
+simulated crash at every protocol step (torn tails skipped by CRC,
+deadlines re-anchored), the lend/reclaim protocol round trip under
+ZeRO-2 (dp=4 -> lend 2 -> serve on the borrowed chips -> reclaim ->
+dp=4 bit-identical to a planned twin), borrow_wedge lease revocation
+on a fake clock, the reclaim_timeout drain delay bounded by the
+backoff budget, gateway placement routed through the ledger, the
+autoscaler daemon surviving transient tick failures (and its death
+surfacing in Gateway.stats), and the perf_gate --chaos colocation
+contract over the committed artifact plus synthetic regressions."""
+import copy
+import json
+import os
+import shutil
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.cluster import (DeviceLedger, LedgerError,
+                               LendingScheduler, StepGate)
+from mxnet_tpu.cluster.ledger import device_name
+from mxnet_tpu.elastic import Autoscaler, ElasticTrainer
+from mxnet_tpu.kvstore import fault
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHAOS_ARTIFACT = os.path.join(REPO, "docs", "artifacts",
+                              "CHAOS_LAST_GOOD.json")
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import perf_gate  # noqa: E402
+
+sys.path.pop(0)
+
+W = ["chipA", "chipB", "chipC", "chipD"]
+
+
+# ===================================================================
+# ledger: single assignment authority
+# ===================================================================
+def test_ledger_double_assignment_raises():
+    led = DeviceLedger(W)
+    led.acquire("training", W[:2], role="training_shard")
+    # ANY overlap refuses, naming the holder
+    with pytest.raises(LedgerError, match="already assigned.*training"):
+        led.acquire("serving", [W[1], W[2]], role="serving_lane")
+    # the same owner re-acquiring its own chip is equally illegal
+    # (it resizes its lease instead)
+    with pytest.raises(LedgerError, match="already assigned"):
+        led.acquire("training", [W[0]], role="training_shard")
+    # the failed acquires left nothing behind
+    assert led.free_devices() == W[2:]
+    led.verify_conservation()
+
+
+def test_ledger_rejects_malformed_acquires():
+    led = DeviceLedger(W)
+    with pytest.raises(LedgerError, match="not in this ledger"):
+        led.acquire("x", ["ghost"], role="serving_lane")
+    with pytest.raises(LedgerError, match="duplicate"):
+        led.acquire("x", [W[0], W[0]], role="serving_lane")
+    with pytest.raises(LedgerError, match="zero devices"):
+        led.acquire("x", [], role="serving_lane")
+    with pytest.raises(LedgerError, match="unknown lease role"):
+        led.acquire("x", [W[0]], role="gpu_lane")
+    with pytest.raises(LedgerError, match="non-empty world"):
+        DeviceLedger([])
+    with pytest.raises(LedgerError, match="duplicate devices"):
+        DeviceLedger([W[0], W[0]])
+
+
+def test_ledger_release_resize_lifecycle():
+    led = DeviceLedger(W)
+    lease = led.acquire("serving", W[:2], role="serving_lane")
+    assert led.owner_of(W[0]) == ("serving", lease.lease_id)
+    assert led.foreign_devices("training") == W[:2]
+    assert led.usable_devices("serving") == W          # own + free
+    # grow into the free pool
+    led.resize(lease.lease_id, W[:3])
+    assert led.free_devices() == [W[3]]
+    # shrinking returns chips
+    led.resize(lease.lease_id, [W[0]])
+    assert led.free_devices() == W[1:]
+    # a resize onto a foreign chip refuses
+    other = led.acquire("training", [W[3]], role="training_shard")
+    with pytest.raises(LedgerError, match="already assigned"):
+        led.resize(lease.lease_id, [W[0], W[3]])
+    # resize to zero releases
+    led.resize(lease.lease_id, [])
+    assert lease.lease_id not in led.leases()
+    led.release(other.lease_id)
+    assert led.free_devices() == W
+    with pytest.raises(LedgerError, match="unknown lease"):
+        led.release("L999999")
+    led.verify_conservation()
+
+
+def test_ledger_ensure_and_release_devices():
+    led = DeviceLedger(W)
+    a = led.ensure("training", W[:2], role="training_shard")
+    b = led.ensure("training", W[:3], role="training_shard")
+    assert a.lease_id == b.lease_id        # idempotent seam: one lease
+    assert led.holdings("training") == {"training": list(W[:3])}
+    # ensure stamps THIS call's deadline; a later ensure without one
+    # clears it (the post-reclaim sync removing the loan deadline)
+    clk = [0.0]
+    led2 = DeviceLedger(W, clock=lambda: clk[0])
+    ls = led2.ensure("serving", W[:1], role="serving_lane",
+                     deadline_s=5.0)
+    assert ls.deadline == 5.0
+    ls = led2.ensure("serving", W[:2], role="serving_lane")
+    assert ls.deadline is None
+    # a failed ensure-resize rolls the deadline back
+    led2.acquire("training", [W[3]], role="training_shard")
+    ls = led2.ensure("serving", W[:2], role="serving_lane",
+                     deadline_s=9.0)
+    with pytest.raises(LedgerError):
+        led2.ensure("serving", [W[0], W[3]], role="serving_lane",
+                    deadline_s=1.0)
+    assert led2.find_lease("serving").deadline == 9.0
+    # release_devices shrinks the right lease and polices ownership
+    led.release_devices("training", [W[1]])
+    assert led.holdings("training") == {"training": [W[0], W[2]]}
+    with pytest.raises(LedgerError, match="cannot release"):
+        led.release_devices("serving", [W[0]])
+    with pytest.raises(LedgerError, match="cannot release"):
+        led.release_devices("training", [W[3]])   # free, not held
+    led.verify_conservation()
+
+
+def test_ledger_expired_and_device_seconds_fake_clock():
+    clk = [0.0]
+    led = DeviceLedger(W, clock=lambda: clk[0])
+    led.acquire("serving", W[:2], role="serving_lane", deadline_s=4.0)
+    led.acquire("training", [W[2]], role="training_shard")
+    assert led.expired() == []
+    clk[0] = 5.0
+    exp = led.expired()
+    assert len(exp) == 1 and exp[0].owner == "serving"
+    clk[0] = 10.0
+    ds = led.device_seconds()
+    # 2 chips x 10s serving, 1 x 10s training, 1 x 10s free
+    assert ds["by_owner"] == {"serving": 20.0, "training": 10.0,
+                              "free": 10.0}
+    assert ds["total"] == 40.0 and ds["conserved"] is True
+    assert ds["world_size"] == 4 and ds["elapsed_s"] == 10.0
+
+
+# ===================================================================
+# ledger: journal replay + crash recovery
+# ===================================================================
+def _scripted_protocol(led):
+    """One full lend/reclaim cycle as the scheduler journals it —
+    an epoch lands at every protocol step."""
+    tr = led.acquire("training", W, role="training_shard")
+    led.note("lend_requested", model="m", chips=2)
+    led.note("quiesced", steps_done=3)
+    led.resize(tr.lease_id, W[:2])                 # lend reshape
+    sv = led.acquire("serving", W[2:], role="serving_lane",
+                     deadline_s=60.0)
+    led.note("leased", lease_id=sv.lease_id)
+    led.note("reclaim_requested", model="m")
+    led.release(sv.lease_id)                       # borrower released
+    led.resize(tr.lease_id, W)                     # reclaim reshape
+    led.note("reclaimed", steps_done=5)
+    return led
+
+
+def test_ledger_journal_recoverable_at_every_protocol_step(tmp_path):
+    jdir = tmp_path / "journal"
+    _scripted_protocol(DeviceLedger(W, journal_dir=jdir))
+    epochs = DeviceLedger.journal_epochs(jdir)
+    assert len(epochs) == 11               # init + 10 protocol steps
+    # crash after step k: copy the first k epoch files (+ manifest)
+    # and recover — every prefix must rebuild a conserved ledger with
+    # no device stranded
+    files = sorted(f for f in os.listdir(jdir)
+                   if f.startswith("epoch-"))
+    for k in range(1, len(files) + 1):
+        crash = tmp_path / ("crash-%02d" % k)
+        crash.mkdir()
+        for f in files[:k] + ["MANIFEST.json"]:
+            shutil.copy(jdir / f, crash / f)
+        led = DeviceLedger.recover(crash)
+        rep = led.verify_conservation()    # raises on any violation
+        assert rep["leased"] + rep["free"] == 4
+        assert led.epoch >= k
+    full = DeviceLedger.verify_journal(jdir)
+    assert full["conserved"] is True and full["violations"] == []
+
+
+def test_ledger_recover_skips_torn_tail(tmp_path):
+    jdir = tmp_path / "journal"
+    led = DeviceLedger(W, journal_dir=jdir)
+    led.acquire("training", W[:3], role="training_shard")
+    led.note("quiesced")
+    files = sorted(f for f in os.listdir(jdir)
+                   if f.startswith("epoch-"))
+    # the crash model: the newest epoch is torn mid-write
+    with open(jdir / files[-1], "w", encoding="utf-8") as f:
+        f.write('{"version": 1, "epoch"')
+    assert DeviceLedger.verify_journal(jdir)["epochs"] == \
+        len(files) - 1
+    rec = DeviceLedger.recover(jdir)       # previous epoch wins
+    assert rec.holdings("training") == {"training": list(W[:3])}
+    rec.verify_conservation()
+    with pytest.raises(LedgerError, match="cannot recover"):
+        DeviceLedger.recover(tmp_path / "empty")
+
+
+def test_ledger_recover_reanchors_deadlines_and_elapsed(tmp_path):
+    clk = [100.0]
+    jdir = tmp_path / "journal"
+    led = DeviceLedger(W, journal_dir=jdir, clock=lambda: clk[0])
+    led.acquire("serving", W[:1], role="serving_lane", deadline_s=30.0)
+    clk[0] = 110.0
+    led.note("leased")                     # 10s elapsed at crash
+    clk2 = [5000.0]                        # a NEW monotonic clock
+    rec = DeviceLedger.recover(jdir, clock=lambda: clk2[0])
+    ls = rec.find_lease("serving")
+    # 20s of deadline remained at the crash; it remains now
+    assert ls.deadline == pytest.approx(5020.0)
+    clk2[0] = 5002.0
+    ds = rec.device_seconds()
+    # pre-crash elapsed carried: 10s before + 2s after
+    assert ds["elapsed_s"] == pytest.approx(12.0)
+    assert ds["conserved"] is True
+
+
+# ===================================================================
+# fault plan: lending kinds
+# ===================================================================
+def test_fault_plan_lending_kinds_parse_and_apply():
+    rules = fault.parse_fault_plan(
+        "borrow_wedge@round=2;reclaim_timeout=40")
+    assert [r.kind for r in rules] == ["borrow_wedge",
+                                      "reclaim_timeout"]
+    assert all(r.is_python_side for r in rules)
+    assert fault.borrow_wedge_active(1, plan="borrow_wedge@round=2") \
+        is False
+    assert fault.borrow_wedge_active(2, plan="borrow_wedge@round=2") \
+        is True
+    assert fault.borrow_wedge_active(7, plan="borrow_wedge") is True
+    assert fault.reclaim_delay_ms(1, plan="reclaim_timeout=40") == 40.0
+    assert fault.reclaim_delay_ms(
+        2, plan="reclaim_timeout=40@round=1") == 0.0
+    assert fault.reclaim_delay_ms(3, plan="") == 0.0
+
+
+def test_fault_plan_lending_kinds_reject_malformed():
+    with pytest.raises(MXNetError, match="takes no value"):
+        fault.parse_fault_plan("borrow_wedge=5")
+    with pytest.raises(MXNetError, match="needs a delay"):
+        fault.parse_fault_plan("reclaim_timeout")
+    with pytest.raises(MXNetError):
+        fault.parse_fault_plan("borrow_wedge@rank=1")
+    with pytest.raises(MXNetError):
+        fault.parse_fault_plan("reclaim_timeout=10@key=3")
+
+
+# ===================================================================
+# step gate
+# ===================================================================
+def test_step_gate_hold_release_and_timeout():
+    gate = StepGate()
+    stop = threading.Event()
+    steps = [0]
+
+    def loop():
+        while not stop.is_set():
+            gate.step_boundary()
+            steps[0] += 1
+            time.sleep(0.002)
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    try:
+        assert gate.hold(2.0) is True and gate.held
+        seen = steps[0]
+        time.sleep(0.05)
+        assert steps[0] == seen            # parked: no steps run
+        gate.release()
+        deadline = time.monotonic() + 2.0
+        while steps[0] == seen and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert steps[0] > seen             # resumed
+    finally:
+        stop.set()
+        gate.release()
+        t.join(2.0)
+    # a loop that never reaches a boundary: hold times out AND rolls
+    # back its request (a later boundary must not park forever)
+    dead_gate = StepGate()
+    assert dead_gate.hold(0.05) is False
+    dead_gate.step_boundary()              # returns immediately
+
+
+# ===================================================================
+# lend/reclaim protocol (real trainer + real gateway)
+# ===================================================================
+def _mlp_fixture(seed=3):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    params = {
+        "w": rng.normal(0, 0.1, (8, 4)).astype(np.float32),
+        "b": np.zeros(4, np.float32),
+    }
+    X = rng.normal(0, 1, (64, 8)).astype(np.float32)
+    Y = rng.normal(0, 1, (64, 4)).astype(np.float32)
+
+    def loss_fn(p, batch):
+        data, lbl = batch
+        return jnp.mean((data @ p["w"] + p["b"] - lbl) ** 2)
+
+    return params, loss_fn, (X[:16], Y[:16]), X, Y
+
+
+def _batches(X, Y, k):
+    i = (k % 4) * 16
+    return X[i:i + 16], Y[i:i + 16]
+
+
+def _serving_model():
+    from mxnet_tpu import nd, sym
+
+    rng = np.random.default_rng(0)
+    out = sym.FullyConnected(sym.var("data"), sym.var("fc_weight"),
+                             sym.var("fc_bias"), num_hidden=4,
+                             name="fc")
+    args = {"fc_weight": nd.array(
+        rng.normal(0, 0.3, (4, 8)).astype(np.float32)),
+        "fc_bias": nd.array(np.zeros(4, np.float32))}
+    return out, args
+
+
+def test_lend_reclaim_round_trip_bit_identical(tmp_path):
+    """dp=4 -> lend 2 -> serve on the borrowed chips -> reclaim ->
+    dp=4, fingerprint bit-identical to a planned-reshape twin, every
+    step journaled and conserved."""
+    import jax
+
+    from mxnet_tpu.serving import Gateway
+
+    devs = jax.local_devices()
+    assert len(devs) >= 6
+    world, tdevs = devs[:6], devs[:4]
+    params, loss_fn, bex, X, Y = _mlp_fixture()
+    ledger = DeviceLedger(world, journal_dir=tmp_path / "journal")
+    trainer = ElasticTrainer(loss_fn, params, bex, lr=0.05,
+                             momentum=0.9, stage=2)
+    trainer.attach_ledger(ledger, "training")
+    trainer.build(tdevs)
+    symbol, args = _serving_model()
+    gw = Gateway(devices=world, ledger=ledger)
+    try:
+        # serving starts on BOTH free chips, so the lend's new lanes
+        # can only land on the borrowed ones
+        gw.register("loan", symbol, args, {},
+                    input_shapes={"data": (8,)}, buckets=(1, 2),
+                    max_wait_ms=1.0, max_queue=64, replicas=2)
+        assert gw.device_count() == 2      # training's 4 are foreign
+        sched = LendingScheduler(ledger, trainer=trainer, gateway=gw,
+                                 min_train_dp=2, deadline_s=60.0)
+        with pytest.raises(LedgerError, match="below the floor"):
+            sched.lend("loan", 3)
+        for k in range(3):
+            trainer.train_step(_batches(X, Y, k))
+        rec = sched.lend("loan", 2)
+        assert trainer.dp == 2
+        assert gw.replica_count("loan") == 4
+        # the borrowed chips now serve — and serving owns them
+        for d in rec["devices"]:
+            assert ledger.owner_of(d)[0] == "serving"
+        out = gw.infer("loan", np.ones((1, 8), np.float32),
+                       timeout=30.0)
+        assert np.asarray(out[0]).shape == (1, 4)
+        # one loan at a time per model
+        assert sched.on_capped("loan") is False
+        for k in range(3, 5):
+            trainer.train_step(_batches(X, Y, k))
+        # the cold path: the autoscaler scaled back in, lanes fit on
+        # serving's own chips again, on_cold reverses the loan
+        gw.scale("loan", 2)
+        assert sched.on_cold("loan") is True
+        assert trainer.dp == 4 and sched.active_borrows() == []
+        for d in rec["devices"]:
+            assert ledger.owner_of(d)[0] == "training"
+        for k in range(5, 7):
+            trainer.train_step(_batches(X, Y, k))
+        fp_live = trainer.fingerprint()
+    finally:
+        gw.close()
+    # the planned twin: identical schedule, reshapes with no serving
+    twin = ElasticTrainer(loss_fn, params, bex, lr=0.05, momentum=0.9,
+                          stage=2).build(tdevs)
+    for k in range(3):
+        twin.train_step(_batches(X, Y, k))
+    twin.reshape(list(tdevs[:2]))
+    for k in range(3, 5):
+        twin.train_step(_batches(X, Y, k))
+    twin.reshape(list(tdevs))
+    for k in range(5, 7):
+        twin.train_step(_batches(X, Y, k))
+    assert fp_live == twin.fingerprint()
+    ledger.verify_conservation()
+    vj = DeviceLedger.verify_journal(tmp_path / "journal")
+    assert vj["conserved"] is True and vj["violations"] == []
+
+
+def test_borrow_wedge_lease_revoked_on_fake_clock():
+    """A borrower that takes the chips but never reports ready is
+    revoked at its deadline and the chips reshape back into
+    training — driven on a fake clock, no real waiting."""
+    import jax
+
+    devs = jax.local_devices()[:4]
+    params, loss_fn, bex, X, Y = _mlp_fixture()
+    clk = [0.0]
+    ledger = DeviceLedger(devs, clock=lambda: clk[0])
+    trainer = ElasticTrainer(loss_fn, params, bex, lr=0.05,
+                             momentum=0.9, stage=2)
+    trainer.attach_ledger(ledger, "training")
+    trainer.build(devs)
+    fp0 = trainer.fingerprint()
+    sched = LendingScheduler(ledger, trainer=trainer, gateway=None,
+                             min_train_dp=2, deadline_s=10.0,
+                             clock=lambda: clk[0],
+                             fault_plan="borrow_wedge")
+    rec = sched.lend("m", 2)
+    assert rec["ready"] is False and rec["lease_id"] is not None
+    assert trainer.dp == 2
+    assert sched.check_leases() == []      # deadline not reached
+    clk[0] = 11.0
+    revoked = sched.check_leases()
+    assert len(revoked) == 1
+    assert trainer.dp == 4 and sched.active_borrows() == []
+    assert ledger.holdings("serving") == {"serving": []}
+    # the round trip ran zero steps: params must be bit-identical
+    assert trainer.fingerprint() == fp0
+    events = [e for _, e, _ in sched.events]
+    assert "borrow_wedged" in events and "lease_revoked" in events \
+        and "reclaimed" in events
+
+
+def test_reclaim_timeout_drain_bounded_by_backoff_budget():
+    import jax
+
+    devs = jax.local_devices()[:4]
+    params, loss_fn, bex, X, Y = _mlp_fixture()
+    ledger = DeviceLedger(devs)
+    trainer = ElasticTrainer(loss_fn, params, bex, lr=0.05,
+                             momentum=0.9, stage=2)
+    trainer.attach_ledger(ledger, "training")
+    trainer.build(devs)
+    # inject a 10-minute borrower drain; the 200ms budget bounds it
+    sched = LendingScheduler(ledger, trainer=trainer, gateway=None,
+                             min_train_dp=2, backoff_budget_ms=200.0,
+                             fault_plan="reclaim_timeout=600000")
+    rec = sched.lend("m", 2)
+    t0 = time.monotonic()
+    sched.reclaim(rec)
+    assert time.monotonic() - t0 < 30.0    # nowhere near 10 minutes
+    assert trainer.dp == 4
+    delays = [d for _, e, d in sched.events
+              if e == "reclaim_drain_delayed"]
+    assert delays and delays[0]["honored_ms"] <= 200.0
+
+
+# ===================================================================
+# gateway placement through the ledger
+# ===================================================================
+def test_gateway_places_only_on_usable_devices():
+    import jax
+
+    from mxnet_tpu.serving import Gateway, ServingError
+
+    devs = jax.local_devices()[:4]
+    ledger = DeviceLedger(devs)
+    foreign = ledger.acquire("training", [str(devs[2]), str(devs[3])],
+                             role="training_shard")
+    symbol, args = _serving_model()
+    gw = Gateway(devices=devs, ledger=ledger)
+    try:
+        assert gw.device_count() == 2      # training's chips excluded
+        gw.register("auth", symbol, args, {},
+                    input_shapes={"data": (8,)}, buckets=(1, 2),
+                    max_wait_ms=1.0, max_queue=64, replicas=2)
+        held = ledger.holdings("serving")["serving"]
+        assert set(held) <= {str(devs[0]), str(devs[1])}
+        lease = ledger.find_lease("serving", role="serving_lane")
+        assert lease is not None and lease.role == "serving_lane"
+        # the defense-in-depth guard itself, should a pick ever leak
+        with pytest.raises(ServingError,
+                           match="leased to another workload"):
+            gw._ledger_guard([devs[2]])
+        # training cannot take a serving chip either — the authority
+        # cuts both ways
+        with pytest.raises(LedgerError, match="already assigned"):
+            ledger.acquire("training", held[:1], role="training_shard")
+        # training shrinks -> its chip frees -> serving can grow there
+        ledger.resize(foreign.lease_id, [str(devs[2])])
+        gw.scale("auth", 3)
+        assert str(devs[3]) in ledger.holdings("serving")["serving"]
+        assert gw.replica_count("auth") == 3
+        # retiring lanes releases their chips back through the sync
+        gw.scale("auth", 1)
+        assert len(ledger.holdings("serving")["serving"]) == 1
+    finally:
+        gw.close()
+    # close released everything serving held
+    assert ledger.holdings("serving") == {"serving": []}
+    ledger.verify_conservation()
+
+
+def test_trainer_build_refused_on_foreign_chip():
+    import jax
+
+    devs = jax.local_devices()[:4]
+    params, loss_fn, bex, _, _ = _mlp_fixture()
+    ledger = DeviceLedger(devs)
+    ledger.acquire("serving", [str(devs[3])], role="serving_lane")
+    trainer = ElasticTrainer(loss_fn, params, bex, stage=2)
+    trainer.attach_ledger(ledger, "training")
+    with pytest.raises(LedgerError, match="already assigned"):
+        trainer.build(devs)
+    assert trainer.devices is None         # refused before the mesh
+    trainer.build(devs[:2])                # its own half still works
+    assert trainer.dp == 2
+
+
+def test_trainer_census_checks_lease_agreement():
+    import jax
+
+    devs = jax.local_devices()[:4]
+    params, loss_fn, bex, _, _ = _mlp_fixture()
+    ledger = DeviceLedger(devs)
+    trainer = ElasticTrainer(loss_fn, params, bex, stage=2)
+    trainer.attach_ledger(ledger, "training")
+    trainer.build(devs)
+    report = trainer.census_check()
+    assert report["lease"] == ledger.find_lease("training").lease_id
+    # a lease that no longer matches the mesh is a placement bug the
+    # census must catch, not paper over
+    lease = ledger.find_lease("training")
+    ledger.resize(lease.lease_id, [str(d) for d in devs[:3]])
+    with pytest.raises(MXNetError, match="census/lease mismatch"):
+        trainer.census_check()
+
+
+# ===================================================================
+# autoscaler daemon resilience
+# ===================================================================
+class _FlakyGateway:
+    """replica_count blows up for the first ``fail`` calls — the
+    transient telemetry/scale hiccup the daemon loop must survive."""
+
+    def __init__(self, fail=2):
+        self.fail = fail
+        self.calls = 0
+
+    def replica_count(self, name):
+        self.calls += 1
+        if self.calls <= self.fail:
+            raise RuntimeError("transient gateway hiccup")
+        return 1
+
+    def device_count(self):
+        return 4
+
+    def scale(self, name, n):
+        return {"to": n}
+
+
+def test_autoscaler_daemon_survives_transient_tick_errors():
+    gw = _FlakyGateway(fail=2)
+    sc = Autoscaler(gw, "flaky", min_replicas=1, max_replicas=2,
+                    queue_high=1e9, sustain=2, period_s=0.01)
+    sc.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while gw.calls < 4 and time.monotonic() < deadline:
+            time.sleep(0.01)
+    finally:
+        sc.stop()
+    st = sc.daemon_stats()
+    assert gw.calls >= 4                   # kept ticking after errors
+    assert st["errors_total"] == 2
+    assert st["consecutive_failures"] == 0  # recovered
+    assert "hiccup" in st["last_error"]
+    assert st["running"] is False and st["dead"] is False
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_autoscaler_dead_daemon_surfaces_in_gateway_stats():
+    from mxnet_tpu.serving import Gateway
+
+    gw = Gateway()
+    try:
+        sc = Autoscaler(gw, "doomed", min_replicas=1, max_replicas=2,
+                        period_s=0.01)
+        # a non-Exception escape (the one way the loop CAN die) must
+        # flip the dead flag, not vanish silently
+        sc.tick = lambda: (_ for _ in ()).throw(SystemExit)
+        sc.start()
+        deadline = time.monotonic() + 5.0
+        while not sc.daemon_stats()["dead"] and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+        st = gw.stats()["doomed"]["autoscaler"]
+        assert st["dead"] is True
+        sc.stop()
+    finally:
+        gw.close()
+
+
+def test_autoscaler_survives_lender_failures():
+    class _BrokenLender:
+        def on_capped(self, model):
+            raise RuntimeError("lender exploded")
+
+        def on_cold(self, model):
+            raise RuntimeError("lender exploded")
+
+        def check_leases(self):
+            raise RuntimeError("lender exploded")
+
+    class _Cap:
+        def replica_count(self, name):
+            return 2
+
+        def device_count(self):
+            return 2
+
+        def scale(self, name, n):
+            return {"to": n}
+
+    from mxnet_tpu.telemetry import metrics as _tm
+    _tm.registry().gauge(
+        "mx_serving_queue_depth",
+        "requests pending in the model queue",
+        labelnames=("model",)).labels(model="lended").set(100.0)
+    sc = Autoscaler(_Cap(), "lended", min_replicas=1, max_replicas=8,
+                    queue_high=1.0, sustain=1, ewma=1.0,
+                    lender=_BrokenLender(), clock=lambda: 0.0)
+    decision, _ = sc.tick()                # must not raise
+    assert decision == "capped"
+    assert "lender exploded" in sc.daemon_stats()["last_error"]
+
+
+# ===================================================================
+# registration: lint scope, env vars, chaos gate
+# ===================================================================
+def test_cluster_mxl002_scope_registered():
+    from mxnet_tpu.analysis.rules.host_sync import _SCOPES
+
+    scopes = {prefix: methods for prefix, methods, _ in _SCOPES}
+    assert "mxnet_tpu/cluster/" in scopes
+    for name in ("acquire", "release", "resize", "owner_of",
+                 "device_seconds", "check_leases", "on_capped",
+                 "step_boundary"):
+        assert name in scopes["mxnet_tpu/cluster/"], name
+
+
+def test_lend_env_vars_registered():
+    from mxnet_tpu import libinfo
+
+    doc = open(os.path.join(REPO, "docs", "env_vars.md"),
+               encoding="utf-8").read()
+    for var in ("MXTPU_LEND_DEADLINE_SEC", "MXTPU_LEND_MIN_TRAIN_DP",
+                "MXTPU_LEND_RECLAIM_BACKOFF_MS"):
+        assert var in libinfo._ENV_VARS, var
+        assert var in doc, var
+
+
+def _chaos_docs():
+    with open(CHAOS_ARTIFACT, encoding="utf-8") as f:
+        good = json.load(f)
+    return good
+
+
+def test_chaos_artifact_carries_colocation():
+    good = _chaos_docs()
+    s = good["scenarios"]["colocation"]
+    assert s["fingerprint"]["bit_identical"] is True
+    assert s["lend"]["occurred"] is True
+    assert s["device_seconds"]["conserved"] is True
+    assert s["ledger"]["journal_conserved"] is True
+    assert s["borrow_wedge"]["revoked_within_deadline"] is True
+    assert s["borrow_wedge"]["chips_returned"] is True
+    assert s["lost_requests"] == 0
+
+
+def test_perf_gate_colocation_synthetic_regressions():
+    good = _chaos_docs()
+
+    def gate(mutate):
+        cand = copy.deepcopy(good)
+        mutate(cand)
+        rc, msgs = perf_gate.gate_chaos(cand, good)
+        return rc, "\n".join(msgs)
+
+    # 1. colocation is a REQUIRED family now
+    rc, out = gate(lambda c: c["scenarios"].pop("colocation"))
+    assert rc == 1 and "colocation" in out
+
+    # 2. the loan never happened
+    def noloan(c):
+        c["scenarios"]["colocation"]["lend"]["occurred"] = False
+    rc, out = gate(noloan)
+    assert rc == 1 and "loan never happened" in out
+
+    # 3. blown reclaim budget
+    def slow(c):
+        s = c["scenarios"]["colocation"]
+        s["reclaim_s"] = s["reclaim_budget_s"] + 1.0
+    rc, out = gate(slow)
+    assert rc == 1 and "reclaim" in out
+
+    # 4. device-seconds leak (recomputed by the gate, the flag alone
+    # cannot vouch)
+    def leak(c):
+        ds = c["scenarios"]["colocation"]["device_seconds"]
+        ds["by_owner"]["training"] -= 5.0
+    rc, out = gate(leak)
+    assert rc == 1 and "device-seconds NOT conserved" in out
+
+    # 5. journal replay violation
+    def torn(c):
+        c["scenarios"]["colocation"]["ledger"]["violations"] = [7]
+    rc, out = gate(torn)
+    assert rc == 1 and "journal replay not conserved" in out
+
+    # 6. wedged borrower kept the chips
+    def wedge(c):
+        w = c["scenarios"]["colocation"]["borrow_wedge"]
+        w["chips_returned"] = False
+    rc, out = gate(wedge)
+    assert rc == 1 and "not revoked cleanly" in out
+
+    # and the unmutated artifact still passes
+    rc, msgs = perf_gate.gate_chaos(copy.deepcopy(good), good)
+    assert rc == 0, msgs

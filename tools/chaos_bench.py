@@ -9,9 +9,11 @@
 Drives every scenario family in :mod:`mxnet_tpu.elastic.chaos` —
 preemption storm (mesh reshape + ZeRO re-shard + iterator carry),
 injected straggler (trace_merge must name the rank), replica kill
-under open-loop load (drain/revive, zero lost requests), and the
-autoscale cycle (scale out on telemetry, back in after cooldown) —
-and writes one versioned artifact:
+under open-loop load (drain/revive, zero lost requests), the
+autoscale cycle (scale out on telemetry, back in after cooldown), and
+colocation (device lending: serving borrows training chips through
+the cluster ledger and gives them back, bit-identical) — and writes
+one versioned artifact:
 
     {"tool": "chaos_bench", "version": 1, "created": ...,
      "host": {...}, "scenarios": {family: {...}}}
@@ -37,6 +39,16 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+# the scenarios need a multi-chip world (colocation splits 6 devices
+# between two workloads); bring up the tests/conftest.py virtual CPU
+# mesh when the caller didn't set one — before jax initializes
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
 
 DEFAULT_OUT = os.path.join(
     REPO, "docs", "artifacts",
@@ -72,6 +84,25 @@ def scenario_ok(s):
     if s.get("family") == "replica_kill" and \
             s.get("probe_fingerprint_equal") is not True:
         return False
+    if s.get("family") == "colocation":
+        if s.get("reclaim_s") is None or \
+                s["reclaim_s"] > s.get("reclaim_budget_s", 0):
+            return False
+        if not (s.get("lend") or {}).get("occurred"):
+            return False
+        if not (s.get("batches") or {}).get("schedule_preserved"):
+            return False
+        if (s.get("device_seconds") or {}).get("conserved") \
+                is not True:
+            return False
+        if (s.get("ledger") or {}).get("journal_conserved") \
+                is not True:
+            return False
+        wedge = s.get("borrow_wedge") or {}
+        if not (wedge.get("revoked_within_deadline")
+                and wedge.get("chips_returned")
+                and wedge.get("training_fp_preserved")):
+            return False
     return True
 
 
@@ -104,6 +135,8 @@ def main(argv=None):
             duration_s=2.0 if args.quick else 4.0),
         "autoscale_cycle": lambda: chaos.run_autoscale_cycle(
             burst_s=1.5 if args.quick else 2.5),
+        "colocation": lambda: chaos.run_colocation(
+            burst_s=2.5 if args.quick else 4.0),
     }
     only = set(args.only)
     unknown = only - set(runners)

@@ -113,7 +113,7 @@ DEFAULT_CHAOS_LAST_GOOD = os.path.join(REPO, "docs", "artifacts",
 # the elasticity plane's advertised scenario families: an artifact
 # missing one of these has not exercised the SLO it claims to gate
 REQUIRED_CHAOS_FAMILIES = ("preemption_storm", "straggler",
-                           "replica_kill")
+                           "replica_kill", "colocation")
 
 # metrics compared when both sides carry them; values are "bigger is
 # better" throughputs/ratios
@@ -671,7 +671,8 @@ def gate_chaos(candidate, last_good, tolerance=0.25):
     scale-out/scale-in pair are truth contracts. A scenario present
     in last-good but missing from the candidate is itself a
     regression — the suite cannot silently shrink out of its own
-    gate — and the three core families are required outright."""
+    gate — and the core families (colocation's device-lending
+    round-trip included) are required outright."""
     msgs = []
     rc = 0
     if candidate.get("tool") != "chaos_bench" or \
@@ -846,6 +847,81 @@ def gate_chaos(candidate, last_good, tolerance=0.25):
                 msgs.append("chaos[autoscale_cycle]: out at %ss, in "
                             "at %ss (ok)" % (s.get("scale_out_at_s"),
                                              s.get("scale_in_at_s")))
+        if family == "colocation":
+            if not (s.get("lend") or {}).get("occurred"):
+                rc = 1
+                msgs.append("REGRESSION chaos[colocation]: the loan "
+                            "never happened — serving stayed at its "
+                            "ceiling and training was never asked")
+            rcl = s.get("reclaim_s")
+            rcl_budget = s.get("reclaim_budget_s")
+            if not isinstance(rcl, (int, float)) or \
+                    not isinstance(rcl_budget, (int, float)):
+                rc = 1
+                msgs.append("REGRESSION chaos[colocation]: missing "
+                            "reclaim_s/reclaim_budget_s (the loan "
+                            "was never reversed)")
+            elif rcl > rcl_budget:
+                rc = 1
+                msgs.append("REGRESSION chaos[colocation]: reclaim "
+                            "%.3fs > budget %.1fs" % (rcl,
+                                                      rcl_budget))
+            else:
+                msgs.append("chaos[colocation]: reclaim %.3fs <= "
+                            "%.1fs budget (ok)" % (rcl, rcl_budget))
+            ds = s.get("device_seconds")
+            if not isinstance(ds, dict):
+                rc = 1
+                msgs.append("REGRESSION chaos[colocation]: device-"
+                            "seconds accounting missing")
+            else:
+                by_owner = ds.get("by_owner") or {}
+                total = sum(v for v in by_owner.values()
+                            if isinstance(v, (int, float)))
+                expect = (ds.get("world_size") or 0) * \
+                    (ds.get("elapsed_s") or 0)
+                # recomputed here, not trusted from the flag: the
+                # per-owner ledger must sum to world x elapsed
+                conserved = ds.get("conserved") is True and \
+                    expect > 0 and \
+                    abs(total - expect) <= 0.02 * expect
+                if not conserved:
+                    rc = 1
+                    msgs.append("REGRESSION chaos[colocation]: "
+                                "device-seconds NOT conserved "
+                                "(sum %.3f vs world x elapsed %.3f)"
+                                % (total, expect))
+                else:
+                    msgs.append("chaos[colocation]: device-seconds "
+                                "conserved across %d owners (ok)"
+                                % len(by_owner))
+            led = s.get("ledger") or {}
+            if led.get("journal_conserved") is not True or \
+                    led.get("violations"):
+                rc = 1
+                msgs.append("REGRESSION chaos[colocation]: ledger "
+                            "journal replay not conserved at every "
+                            "epoch (violations=%s)"
+                            % (led.get("violations"),))
+            else:
+                msgs.append("chaos[colocation]: journal conserved "
+                            "over %s epochs (ok)" % led.get("epochs"))
+            wedge = s.get("borrow_wedge") or {}
+            if not (wedge.get("injected")
+                    and wedge.get("revoked_within_deadline")
+                    and wedge.get("chips_returned")
+                    and wedge.get("training_fp_preserved")):
+                rc = 1
+                msgs.append("REGRESSION chaos[colocation]: wedged "
+                            "borrower not revoked cleanly (revoked="
+                            "%s chips_returned=%s fp_preserved=%s)"
+                            % (wedge.get("revoked_within_deadline"),
+                               wedge.get("chips_returned"),
+                               wedge.get("training_fp_preserved")))
+            else:
+                msgs.append("chaos[colocation]: wedged borrower "
+                            "revoked in %ss, chips home (ok)"
+                            % wedge.get("revoke_s"))
     return rc, msgs
 
 
