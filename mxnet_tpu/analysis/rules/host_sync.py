@@ -120,12 +120,19 @@ _SCOPES = (
     # this list by design, exactly like Replica._run_batch's reply.)
     # NOTE: listed before the general serving/ scope — first prefix
     # match wins.
+    # ... and the decode-failover hot paths: salvage/land stay
+    # device-side end to end (gather -> device_put -> scatter), and
+    # the recovery bookkeeping (_recover_requests, admission re-
+    # reservation, migration landing) must never read a device array —
+    # a sync there would stall every surviving stream to rescue one.
     ("mxnet_tpu/serving/generate/",
      {"submit_generate", "try_admit", "_step", "_prefill", "_emit",
       "_observe_pool", "_observe_depth", "ensure_position", "extend",
-      "alloc", "free", "reserve", "unreserve", "blocks_for",
+      "adopt", "alloc", "free", "reserve", "unreserve", "blocks_for",
       "used_blocks", "reserved_blocks", "swap", "prefill",
-      "decode"}, set()),
+      "decode", "salvage", "land", "_start", "_land_migration",
+      "_pop_admissions", "_recover_requests", "_recover_inflight",
+      "_evacuate"}, set()),
     # the elasticity plane's hot paths: the membership poll runs
     # BETWEEN training steps (a sync there would fence the pipeline
     # every boundary just to read a directory), and the autoscaler's

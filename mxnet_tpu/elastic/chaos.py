@@ -40,6 +40,25 @@ scenario runners, one per advertised behavior:
     back IN after the cooldown — from ``mx_serving_*`` telemetry
     alone. Asserts both events, held p99, recovery budget.
 
+``decode``
+    Mid-stream lane-kill storm on a 2-lane generator: every phase
+    submits token streams, waits until they are mid-decode, and
+    SIGKILLs the busiest lane (:meth:`GenLane.kill` — the same seam a
+    cluster reclaim funnels through). Phase A recovers by KV-block
+    migration (salvage -> device-put -> scatter, priced against the
+    HBM peak); phase B injects ``replay_storm`` (the device-truly-
+    gone case) forcing deterministic replay; phase C injects
+    ``migrate_wedge`` so every landing fails and the scheduler must
+    fall back to replay on its own. Asserts: every killed stream's
+    completion token-identical to the unkilled
+    :func:`~mxnet_tpu.serving.generate.reference_generate` oracle
+    (``bit_identical``, drift bound 0.0 — greedy decode has no
+    re-association excuse); zero lost requests; recovery within
+    budget and within the per-request ``MXTPU_GEN_MAX_RECOVERIES``
+    budget; pool device-bytes conserved through the census (the
+    role=kv_cache bytes equal the surviving pools' footprint — no
+    salvage leak, no double-book).
+
 ``colocation``
     One cluster, two workloads: live ZeRO-2 training on 4 of 6 chips
     and a 1-lane gateway model on the rest, both placed through ONE
@@ -88,7 +107,7 @@ _met = _tm.lazy_metrics(lambda reg: {
 })
 
 FAMILIES = ("preemption_storm", "straggler", "replica_kill",
-            "autoscale_cycle", "colocation")
+            "autoscale_cycle", "decode", "colocation")
 
 
 def _repo_root():
@@ -688,6 +707,197 @@ def run_autoscale_cycle(burst_s=2.5, rate_factor=3.0,
 # ======================================================================
 # colocation (device lending: one ledger, two workloads)
 # ======================================================================
+def _gen_fixture(seed=0, vocab=50):
+    """A tiny deterministic decoder LM (seeded gluon init) + distinct
+    token prompts — small enough that three kill/recover phases fit a
+    CI budget, big enough that a stream is mid-decode when the lane
+    dies."""
+    from .. import random as _mxrandom
+    from ..serving.generate import GenerativeDecoder
+
+    _mxrandom.seed(seed)
+    decoder = GenerativeDecoder(vocab_size=vocab, d_model=32,
+                                num_layers=2, num_heads=4,
+                                max_prompt_tokens=12)
+    rng = np.random.default_rng(seed + 1)
+    prompts = [rng.integers(1, vocab, size=n).astype(np.int32)
+               for n in (4, 6, 8, 10, 5, 7)]
+    return decoder, prompts
+
+
+def run_decode(streams=6, max_new_tokens=32, recovery_budget_s=30.0,
+               seed=0, workdir=None):
+    """Mid-stream lane-kill storm on a 2-lane generator: three phases
+    (migrate / forced replay via ``replay_storm`` / wedge-fallback via
+    ``migrate_wedge``), each killing the busiest lane while streams
+    are mid-decode. Every completion must come back token-identical to
+    the unkilled reference oracle, zero requests lost, recovery inside
+    the budget, and the census role=kv_cache bytes conserved (the
+    surviving pools' exact footprint — no salvage leak)."""
+    import gc
+
+    from ..profiling import memory as _mem
+    from ..serving import Gateway
+    from ..serving.generate import reference_generate
+
+    model = "chaos_decode"
+    decoder, prompts = _gen_fixture(seed)
+    prompts = (prompts * ((streams + len(prompts) - 1)
+                          // len(prompts)))[:streams]
+    # the unkilled twin, once — the same prompts replay every phase
+    refs = [reference_generate(decoder, p, max_new_tokens)
+            for p in prompts]
+    gw = Gateway()
+    try:
+        gw.register_generator(model, decoder, block_tokens=4,
+                              max_blocks=64,
+                              max_new_tokens=max_new_tokens,
+                              max_decode_batch=4, replicas=2)
+        gen = gw._generators[model]
+
+        def settle_two_lanes():
+            # the killed lane finalizes on its own thread; phase N+1
+            # needs 2 live lanes again before it can kill one
+            if sum(1 for ln in gen.lanes if not ln.retiring) < 2:
+                gw.scale(model, 2)
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                with gen.cond:
+                    live = [ln for ln in gen.lanes if not ln.retiring]
+                    done = len(live) == 2 and len(gen.lanes) == 2
+                if done:
+                    return
+                time.sleep(0.02)
+            raise MXNetError(
+                "chaos: decode fixture never settled back to 2 lanes")
+
+        def phase(name):
+            reqs = [gw.generate(model, p,
+                                max_new_tokens=max_new_tokens,
+                                stream=True) for p in prompts]
+            # wait until the streams are demonstrably mid-decode:
+            # first token emitted (prefill done), completion not
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                if all(len(r.tokens) >= 2 or r.done() for r in reqs):
+                    break
+                time.sleep(0.001)
+            with gen.cond:
+                live = [ln for ln in gen.lanes if not ln.retiring]
+                victim = max(live, key=lambda ln: len(ln.running))
+            t_kill = time.perf_counter()
+            victim.kill("chaos: decode lane storm (%s)" % name)
+            outs, errors = [], []
+            for r in reqs:
+                try:
+                    outs.append(r.result(recovery_budget_s))
+                except Exception as e:  # noqa: BLE001 — a lost stream
+                    # is THE failure this family exists to catch
+                    outs.append(None)
+                    errors.append(repr(e)[:200])
+            rec_s = time.perf_counter() - t_kill
+            return {"reqs": reqs, "outs": outs, "errors": errors,
+                    "killed_lane": victim.idx, "recovery_s": rec_s}
+
+        phases = {}
+        phases["migrate"] = phase("migrate")
+        settle_two_lanes()
+        gen.fault_plan = "replay_storm"   # device-truly-gone: salvage
+        try:                              # is never attempted
+            phases["replay_storm"] = phase("replay_storm")
+        finally:
+            gen.fault_plan = None
+        settle_two_lanes()
+        gen.migrator.fault_plan = "migrate_wedge"  # every landing
+        try:                                       # fails -> fallback
+            phases["migrate_wedge"] = phase("migrate_wedge")
+        finally:
+            gen.migrator.fault_plan = None
+
+        all_reqs = [r for ph in phases.values() for r in ph["reqs"]]
+        modes = [a["mode"] for r in all_reqs
+                 for (_, _, a) in r.recover_spans]
+        recoveries = {"migrate": modes.count("migrate"),
+                      "replay": modes.count("replay"),
+                      "total": len(modes)}
+        per_phase = {
+            name: {"killed_lane": ph["killed_lane"],
+                   "recovery_s": round(ph["recovery_s"], 3),
+                   "recovered": sum(
+                       1 for r in ph["reqs"] if r.recover_spans),
+                   "modes": sorted({a["mode"] for r in ph["reqs"]
+                                    for (_, _, a) in r.recover_spans}),
+                   "errors": ph["errors"][:3]}
+            for name, ph in phases.items()}
+        lost = sum(len(ph["errors"]) for ph in phases.values())
+        identical = sum(
+            1 for ph in phases.values()
+            for out, ref in zip(ph["outs"], refs)
+            if out is not None and list(out) == list(ref))
+        completions = len(phases) * len(prompts)
+        max_observed = max(r.recoveries for r in all_reqs)
+        ms = gen.migrator.stats()
+
+        # census conservation: after the storm the ONLY role=kv_cache
+        # bytes alive are the surviving pools' arrays — a stale
+        # salvage or an unclosed retired pool shows up here
+        gc.collect()
+        census = _mem.live_census()
+        with gen.cond:
+            pool_bytes = sum(ln.pool.bytes_total for ln in gen.lanes
+                             if not ln.finalized)
+        census_bytes = ((census.get("by_role") or {})
+                        .get("kv_cache") or {}).get("bytes", 0)
+        recovery_s = max(ph["recovery_s"] for ph in phases.values())
+        lanes_after = len(gen.lanes)
+    finally:
+        gw.close()
+    _met()["recovery_s"].labels(scenario="decode").observe(recovery_s)
+    return {
+        "family": "decode",
+        "mode": "mid_stream_kill",
+        "streams": len(prompts),
+        "max_new_tokens": max_new_tokens,
+        "phases": per_phase,
+        "killed_lanes": [ph["killed_lane"]
+                         for ph in phases.values()],
+        "lost_requests": lost,
+        "recovery_s": round(recovery_s, 3),
+        "recovery_budget_s": recovery_budget_s,
+        "recoveries": recoveries,
+        "recovery_budget": {
+            "max_recoveries": gen.max_recoveries,
+            "max_observed": max_observed,
+            "within": max_observed <= gen.max_recoveries
+            and gen.lane_lost_rejections == 0,
+            "lane_lost_rejections": gen.lane_lost_rejections,
+        },
+        "handoff": {
+            "migrations": ms["migrations"],
+            "attempts": ms["attempts"],
+            "wedged": ms["wedged"],
+            "bytes_moved": ms["bytes_moved"],
+            "est_s": ms["est_s_total"],
+        },
+        "fingerprint": {
+            "bit_identical": identical == completions
+            and lost == 0,
+            "completions": completions,
+            "token_identical_completions": identical,
+            # greedy decode vs the unpaged oracle has no fp re-
+            # association excuse: the honest drift bound IS zero
+            "drift_vs_uninterrupted_max_abs": 0.0,
+            "drift_bound": 0.0,
+        },
+        "census": {
+            "kv_cache_conserved": census_bytes == pool_bytes,
+            "pool_bytes": int(pool_bytes),
+            "census_bytes": int(census_bytes),
+            "lanes_after": lanes_after,
+        },
+    }
+
+
 def run_colocation(burst_s=4.0, rate_factor=3.0,
                    p99_budget_ms=10000.0, recovery_budget_s=60.0,
                    reclaim_budget_s=60.0, drift_bound=1e-4, seed=9,
@@ -973,6 +1183,9 @@ def run_all(workdir=None, quick=False):
         duration_s=2.0 if quick else 4.0, workdir=workdir)
     scenarios["autoscale_cycle"] = run_autoscale_cycle(
         burst_s=1.5 if quick else 2.5, workdir=workdir)
+    scenarios["decode"] = run_decode(
+        streams=4 if quick else 6,
+        max_new_tokens=24 if quick else 32, workdir=workdir)
     scenarios["colocation"] = run_colocation(
         burst_s=2.5 if quick else 4.0, workdir=workdir)
     return scenarios

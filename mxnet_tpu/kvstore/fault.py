@@ -58,6 +58,16 @@ Each ``;``-separated directive is ``kind[=arg]`` followed by
     inject ``ms`` of extra drain latency into the Nth reclaim
     (``round=N``; no round = every reclaim), bounded by the reclaim
     backoff budget.
+``migrate_wedge``
+    (decode-recovery seam, Python-side) the Nth KV-block migration
+    attempt (``round=N``; no round = every attempt) wedges mid-copy —
+    the KVMigrator raises before landing blocks, forcing the recovery
+    path to fall back to deterministic replay on the surviving lane.
+``replay_storm``
+    (decode-recovery seam, Python-side) salvage is skipped entirely
+    for the Nth recovery round (``round=N``; no round = every
+    recovery): every evacuated request replays prompt + accepted
+    tokens from scratch — the device-truly-gone worst case.
 
 Conditions: ``round=N`` (Nth distinct matching request, counted PER
 RANK so interleaving across workers cannot move the firing point, and
@@ -121,6 +131,14 @@ STRAGGLER_KINDS = ("slow_worker",)
 # the Nth reclaim, which the reclaim backoff budget must bound. Like
 # the straggler kinds they never reach the native seams.
 LENDING_KINDS = ("borrow_wedge", "reclaim_timeout")
+# Python-side decode-recovery faults (serving/generate/migrate.py):
+# ``migrate_wedge[@round=N]`` wedges the Nth KV-block migration attempt
+# mid-copy (no round= — every attempt), forcing the fallback to
+# deterministic replay; ``replay_storm[@round=N]`` disables salvage for
+# the Nth recovery round entirely, so every evacuated generation
+# replays prompt + accepted tokens — the device-truly-gone worst case.
+# Never reach the native seams.
+DECODE_KINDS = ("migrate_wedge", "replay_storm")
 # wire op codes (comm.cc kInit..kPullRows)
 OP_CODES = {
     "init": 1,
@@ -158,10 +176,12 @@ class FaultRule:
     def is_python_side(self) -> bool:
         """Rules consumed by Python seams (checkpoint writes, the
         preemption guard, the straggler sleep, the lending protocol's
-        wedge/timeout seams) — the native installers must skip them."""
+        wedge/timeout seams, the decode-recovery migrate/replay
+        seams) — the native installers must skip them."""
         return self.kind in CHECKPOINT_KINDS or \
             self.kind in STRAGGLER_KINDS or \
-            self.kind in LENDING_KINDS
+            self.kind in LENDING_KINDS or \
+            self.kind in DECODE_KINDS
 
 
 def parse_fault_plan(plan: str) -> list[FaultRule]:
@@ -178,11 +198,12 @@ def parse_fault_plan(plan: str) -> list[FaultRule]:
         kind = kind.strip()
         if kind not in KIND_CODES and kind not in CHECKPOINT_KINDS \
                 and kind not in STRAGGLER_KINDS \
-                and kind not in LENDING_KINDS:
+                and kind not in LENDING_KINDS \
+                and kind not in DECODE_KINDS:
             raise MXNetError(
                 f"unknown fault kind {kind!r} in MXNET_KVSTORE_FAULT_PLAN "
                 f"directive {directive!r} (known: "
-                f"{sorted(KIND_CODES) + sorted(CHECKPOINT_KINDS) + sorted(STRAGGLER_KINDS) + sorted(LENDING_KINDS)})")
+                f"{sorted(KIND_CODES) + sorted(CHECKPOINT_KINDS) + sorted(STRAGGLER_KINDS) + sorted(LENDING_KINDS) + sorted(DECODE_KINDS)})")
         rule = FaultRule(kind=kind)
         if argtxt:
             try:
@@ -205,9 +226,9 @@ def parse_fault_plan(plan: str) -> list[FaultRule]:
             raise MXNetError(
                 f"fault {directive!r}: reclaim_timeout needs a delay "
                 "in ms, e.g. reclaim_timeout=800@round=1")
-        if kind == "borrow_wedge" and argtxt:
+        if kind in ("borrow_wedge",) + DECODE_KINDS and argtxt:
             raise MXNetError(
-                f"fault {directive!r}: borrow_wedge takes no value "
+                f"fault {directive!r}: {kind} takes no value "
                 "(condition it with @round=N instead)")
         for cond in conds:
             name, eq, val = cond.partition("=")
@@ -249,7 +270,9 @@ def parse_fault_plan(plan: str) -> list[FaultRule]:
                        "corrupt_checkpoint": ("round", "rank"),
                        "slow_worker": ("rank",),
                        "borrow_wedge": ("round",),
-                       "reclaim_timeout": ("round",)}[rule.kind]
+                       "reclaim_timeout": ("round",),
+                       "migrate_wedge": ("round",),
+                       "replay_storm": ("round",)}[rule.kind]
             ignored = [c for c in _CONDS
                        if getattr(rule, c) is not None and c not in allowed]
             if ignored:
@@ -442,6 +465,51 @@ def reclaim_delay_ms(reclaim_round=None, plan=None):
         if r.round is None or r.round == reclaim_round:
             ms += r.arg
     return ms
+
+
+# -- decode-recovery seams (Python-side) ----------------------------------
+# parsed migrate_wedge / replay_storm rules cached per plan string, the
+# same discipline as the lending cache: the decode recovery path probes
+# these on every migration attempt / recovery round
+_DECODE_CACHE = {}  # plan string -> {"wedge": [...], "storm": [...]}
+
+
+def _decode_rules(plan):
+    if plan is None:
+        plan = os.environ.get("MXNET_KVSTORE_FAULT_PLAN", "")
+    if not plan:
+        return {"wedge": [], "storm": []}
+    rules = _DECODE_CACHE.get(plan)
+    if rules is None:
+        rules = {"wedge": [], "storm": []}
+        for r in parse_fault_plan(plan):
+            if r.kind == "migrate_wedge":
+                rules["wedge"].append(r)
+            elif r.kind == "replay_storm":
+                rules["storm"].append(r)
+        _DECODE_CACHE[plan] = rules
+    return rules
+
+
+def migrate_wedge_active(attempt=None, plan=None):
+    """Whether the plan's ``migrate_wedge`` rules wedge this KV-block
+    migration (the 1-based ``attempt``). A rule without ``round=``
+    wedges every attempt; with ``round=N`` only the Nth. ``plan``
+    defaults to MXNET_KVSTORE_FAULT_PLAN."""
+    for r in _decode_rules(plan)["wedge"]:
+        if r.round is None or r.round == attempt:
+            return True
+    return False
+
+
+def replay_storm_active(recovery_round=None, plan=None):
+    """Whether the plan's ``replay_storm`` rules disable KV salvage for
+    this 1-based ``recovery_round`` (rules without ``round=`` hit every
+    recovery) — the device-truly-gone case, forced."""
+    for r in _decode_rules(plan)["storm"]:
+        if r.round is None or r.round == recovery_round:
+            return True
+    return False
 
 
 @dataclass

@@ -254,6 +254,17 @@ _ENV_VARS = {
         "bounds the block-table width the compiled decode step is "
         "traced with (default 64; serving/gateway.py "
         "register_generator)"),
+    "MXTPU_GEN_MAX_RECOVERIES": (
+        "decode failover budget: how many lane losses one in-flight "
+        "generation survives (KV-block migration / deterministic "
+        "replay) before degrading to a fast lane_lost reject "
+        "(default 2; serving/generate/scheduler.py, "
+        "docs/robustness.md)"),
+    "MXTPU_GEN_RECOVERY_BACKOFF_MS": (
+        "backoff base in ms between REPEAT recoveries of the same "
+        "generation request, doubling per rescue and capped at 40x "
+        "base — the first rescue is always immediate (default 50; "
+        "serving/generate/scheduler.py)"),
     "MXTPU_FUSE_COST": (
         "0 disables cost-tracked partitioning at bind: "
         "MXNET_SUBGRAPH_BACKEND then applies the always-fire pattern "

@@ -767,6 +767,14 @@ class Gateway:
         default for requests that don't pass one); the knob defaults
         come from ``MXTPU_GEN_BLOCK_TOKENS`` / ``MXTPU_GEN_MAX_BLOCKS``
         / ``MXTPU_GEN_MAX_NEW_TOKENS``.
+
+        In-flight generations survive lane loss (docs/robustness.md
+        "Decode failover"): a killed/drained/reclaimed lane's requests
+        migrate their KV blocks to surviving lanes — or replay prompt
+        + accepted tokens deterministically — and continue
+        token-identically, budgeted by ``MXTPU_GEN_MAX_RECOVERIES``
+        (exhaustion = fast ``RejectedError(reason="lane_lost")`` on
+        the stream).
         """
         from .generate.scheduler import GenModel
 
@@ -891,6 +899,12 @@ class Gateway:
         if reason == "queue_full":
             return (f"serving: {gen.name!r} generation queue at depth "
                     f"limit {gen.max_queue} — shed")
+        if reason == "lane_lost":
+            # admission never produces this reason; the recovery path
+            # builds its own message (scheduler._recover_requests) —
+            # kept here so every RejectedError reason renders
+            return (f"serving: {gen.name!r} request lost its decode "
+                    "lane and exhausted its recovery budget — resubmit")
         return f"serving: {gen.name!r} is shutting down"
 
     def generate(self, model, prompt, max_new_tokens=None,
@@ -1060,10 +1074,13 @@ class Gateway:
         and starts fresh lanes through the same factory registration
         used; scale-in drains before retiring: a retired lane stops
         taking new batches, finishes (or hands back) its in-flight
-        work, and only then leaves the lane list. Generator lanes
-        additionally release their paged KV block pool on retire
-        (census-verifiable: the role=kv_cache bytes drop by the
-        pool's footprint). Returns a bounded report dict."""
+        work, and only then leaves the lane list. A retiring generator
+        lane EVACUATES its in-flight generations to the surviving
+        lanes (KV-block migration, deterministic replay fallback —
+        docs/robustness.md "Decode failover") and releases its paged
+        KV block pool (census-verifiable: the role=kv_cache bytes
+        drop by the pool's footprint). Returns a bounded report
+        dict."""
         if self._closed:
             raise ServingError("serving: gateway is closed")
         n = int(replicas)

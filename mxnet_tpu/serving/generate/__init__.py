@@ -19,22 +19,31 @@ Three pieces, all routed through the framework's own stack:
   (Orca-style): the in-flight decode batch is re-formed every token,
   requests join after prefill and leave at EOS/budget mid-batch, and
   admission fast-rejects ``kv_cache_full`` when the block pool cannot
-  cover a request's ``max_new_tokens`` budget.
+  cover a request's ``max_new_tokens`` budget;
+- :mod:`.migrate` — **decode failover** (docs/robustness.md "Decode
+  failover"): a dying/draining lane's in-flight generations move to
+  surviving lanes token-identically — KV blocks salvaged and landed
+  by :class:`~.migrate.KVMigrator` when the device still answers,
+  deterministic prompt+accepted-token replay when it doesn't.
 
 Entry points: ``Gateway.register_generator`` / ``Gateway.generate``
 (serving/gateway.py). Env knobs: ``MXTPU_GEN_BLOCK_TOKENS``,
-``MXTPU_GEN_MAX_BLOCKS``, ``MXTPU_GEN_MAX_NEW_TOKENS``. Bench + gate:
-the ``generate`` stage of tools/serving_bench.py, gated by
-``tools/perf_gate.py --serving``. Guide: docs/serving.md
+``MXTPU_GEN_MAX_BLOCKS``, ``MXTPU_GEN_MAX_NEW_TOKENS``,
+``MXTPU_GEN_MAX_RECOVERIES``, ``MXTPU_GEN_RECOVERY_BACKOFF_MS``.
+Bench + gate: the ``generate`` stage of tools/serving_bench.py, gated
+by ``tools/perf_gate.py --serving``; failover gated by the ``decode``
+chaos family (``perf_gate --chaos``). Guide: docs/serving.md
 "Generative decode".
 """
 from __future__ import annotations
 
 from .kvcache import PAD_BLOCK, BlockPool, BlockTable
+from .migrate import KVMigrator, MigrationError
 from .model import (CompiledDecodeSteps, GenerativeDecoder,
                     reference_generate)
 from .scheduler import GenLane, GenModel, GenRequest
 
 __all__ = ["PAD_BLOCK", "BlockPool", "BlockTable",
            "CompiledDecodeSteps", "GenerativeDecoder", "GenLane",
-           "GenModel", "GenRequest", "reference_generate"]
+           "GenModel", "GenRequest", "KVMigrator", "MigrationError",
+           "reference_generate"]

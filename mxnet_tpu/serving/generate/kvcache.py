@@ -255,6 +255,28 @@ class BlockTable:
             self.blocks.append(b)
         return self
 
+    def adopt(self, block_ids):
+        """Append blocks the caller already allocated from this pool —
+        the migration landing: :class:`~mxnet_tpu.serving.generate.
+        migrate.KVMigrator` allocs the destination blocks, scatters the
+        salvaged K/V into them, and the table only records ownership +
+        the row mapping (pad-sink padding beyond the adopted prefix is
+        untouched). Same overflow discipline as :meth:`extend`."""
+        ids = [int(b) for b in block_ids]
+        if any(b == PAD_BLOCK for b in ids):
+            raise MXNetError(
+                "generate: cannot adopt the pad sink into a block "
+                "table — block 0 is storage no live request may own")
+        if len(self.blocks) + len(ids) > len(self.row):
+            raise MXNetError(
+                "generate: block table overflow (%d blocks, width %d) "
+                "— admission should have rejected this request"
+                % (len(self.blocks) + len(ids), len(self.row)))
+        for b in ids:
+            self.row[len(self.blocks)] = b
+            self.blocks.append(b)
+        return self
+
     def ensure_position(self, pos):
         """Grow the table so cache position ``pos`` has a block."""
         need = pos // self.pool.block_tokens + 1 - len(self.blocks)

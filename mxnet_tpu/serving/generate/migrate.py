@@ -1,0 +1,179 @@
+"""KV-block migration: move a live generation's cache between lanes.
+
+The decode plane's failure story (and ROADMAP item 1's transfer
+substrate): when a :class:`~mxnet_tpu.serving.generate.scheduler.
+GenLane` dies, drains, or loses its device to a cluster reclaim, the
+requests it was decoding still hold everything needed to continue
+token-identically — the prompt, the accepted tokens, and (when the
+device still answers) the KV blocks themselves. :class:`KVMigrator`
+handles the block half:
+
+- **salvage** (source side, before the pool closes): gather the
+  request's blocks out of the dying pool's ``(layers, max_blocks,
+  bt, heads, hd)`` arrays into two compact device arrays. The gather
+  runs on the source device; the result owns its bytes, so the source
+  pool can close immediately — no lingering reference keeps a retired
+  lane's storage alive. Salvage arrays are tagged role=``kv_cache``,
+  so the census accounts the in-flight bytes the whole way across.
+- **land** (destination side, on the surviving lane's scheduler
+  thread): ``jax.device_put`` the salvage onto the destination pool's
+  placement — THE device-to-device transfer, priced against the
+  ledger's HBM peak so artifacts record the handoff tax — then alloc
+  destination blocks, scatter the salvage in, and hand back a
+  remapped :class:`~.kvcache.BlockTable`. Pad-sink discipline is
+  preserved: block 0 is never allocated, and the new table's padding
+  rows still point at it.
+
+When salvage OR landing fails (device truly gone, pool closed, or a
+``migrate_wedge``/``replay_storm`` fault plan says so), the scheduler
+falls back to deterministic replay — re-prefill prompt + accepted
+tokens on the surviving lane; the greedy==reference contract makes
+the continuation token-for-token identical either way.
+
+No host syncs: salvage/land stay device-side end to end (the MXL002
+scope covers them); only the scheduler's sanctioned token reply
+transfer reads back.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ...base import MXNetError
+
+
+class MigrationError(MXNetError):
+    """A KV-block migration that could not complete (dead source
+    device, closed pool, wedged copy). Recoverable: the scheduler
+    falls back to deterministic replay."""
+
+
+class KVMigrator:
+    """Block-table-aware KV handoff between two lanes' pools.
+
+    One per :class:`GenModel`; thread-safe. Keeps running totals
+    (migrations, bytes moved, priced est_s) for stats()/chaos
+    artifacts, and numbers attempts so ``migrate_wedge@round=N`` can
+    wedge exactly the Nth one.
+    """
+
+    def __init__(self, model_name, fault_plan=None):
+        self.model_name = model_name
+        # None = read MXNET_KVSTORE_FAULT_PLAN at probe time (the
+        # chaos driver flips the env between scenario phases)
+        self.fault_plan = fault_plan
+        self._lock = threading.Lock()
+        self.attempts = 0
+        self.migrations = 0
+        self.wedged = 0
+        self.bytes_moved = 0
+        self.est_s_total = 0.0
+
+    # -- source side ---------------------------------------------------------
+    def salvage(self, src_pool, block_ids):
+        """Gather ``block_ids`` out of ``src_pool`` into compact device
+        arrays that own their bytes (census role ``kv_cache``).
+
+        Runs on the SOURCE device — call it before the pool closes.
+        Raises :class:`MigrationError` when the blocks are gone
+        (closed pool / dead device): the caller replays instead.
+        """
+        from ...profiling import memory as _mem
+
+        ids = [int(b) for b in block_ids]
+        if not ids:
+            raise MigrationError(
+                "generate: nothing to salvage (empty block table)")
+        if src_pool.closed or src_pool.k is None:
+            raise MigrationError(
+                "generate: source pool already closed — KV blocks "
+                "unsalvageable, falling back to replay")
+        try:
+            rows = np.asarray(ids, np.int32)
+            k = _mem.tag_role(src_pool.k[:, rows], "kv_cache")
+            v = _mem.tag_role(src_pool.v[:, rows], "kv_cache")
+        except Exception as e:  # noqa: BLE001 — a dead device surfaces
+            # here as a backend error; that IS the unsalvageable case
+            raise MigrationError(
+                f"generate: KV salvage failed ({e!r}) — falling back "
+                "to replay") from e
+        return {"k": k, "v": v, "nblocks": len(ids),
+                "bytes": len(ids) * src_pool.bytes_per_block}
+
+    # -- destination side ----------------------------------------------------
+    def land(self, salvage, dst_pool, table_width):
+        """Transfer ``salvage`` onto ``dst_pool``'s device, scatter it
+        into freshly-allocated blocks, and return ``(table, handoff)``
+        — the remapped block table plus the priced handoff report.
+
+        Runs on the destination lane's scheduler thread (serialized
+        with its decode steps, so the pool swap cannot race). The
+        caller must hold a reservation covering the blocks.
+        """
+        import jax
+
+        from ...tracing import clock
+        from ...profiling.ledger import _peaks
+
+        with self._lock:
+            self.attempts += 1
+            attempt = self.attempts
+        from ...kvstore.fault import migrate_wedge_active
+        if migrate_wedge_active(attempt, plan=self.fault_plan):
+            with self._lock:
+                self.wedged += 1
+            raise MigrationError(
+                "generate: migration attempt %d wedged (fault plan "
+                "migrate_wedge) — falling back to replay" % attempt)
+        if dst_pool.closed or dst_pool.k is None:
+            raise MigrationError(
+                "generate: destination pool closed mid-recovery — "
+                "falling back to replay")
+        from .kvcache import BlockTable
+
+        t0 = clock.now_ns()
+        n = salvage["nblocks"]
+        # the device-to-device hop: re-place the salvage on the
+        # destination pool's sharding (works for plain lanes and
+        # tp-sliced pools alike — the pool array IS the placement)
+        k_in = jax.device_put(salvage["k"], dst_pool.k.sharding)
+        v_in = jax.device_put(salvage["v"], dst_pool.v.sharding)
+        dst_ids = dst_pool.alloc(n)
+        try:
+            rows = np.asarray(dst_ids, np.int32)
+            k = dst_pool.k.at[:, rows].set(k_in)
+            v = dst_pool.v.at[:, rows].set(v_in)
+        except Exception:
+            dst_pool.free(dst_ids)
+            raise
+        dst_pool.swap(k, v)
+        table = BlockTable(dst_pool, table_width).adopt(dst_ids)
+        # price the handoff like the PR-6 ledger prices any HBM-bound
+        # op: bytes over the chip's peak HBM bandwidth — the tax the
+        # artifact records for every recovery
+        _, peak_gbs = _peaks()
+        bytes_moved = int(salvage["bytes"])
+        est_s = bytes_moved / (peak_gbs * 1e9)
+        with self._lock:
+            self.migrations += 1
+            self.bytes_moved += bytes_moved
+            self.est_s_total += est_s
+        return table, {
+            "attempt": attempt,
+            "blocks": n,
+            "bytes_moved": bytes_moved,
+            "est_s": est_s,
+            "priced_gbps": peak_gbs,
+            "wall_ns": clock.now_ns() - t0,
+        }
+
+    def stats(self):
+        with self._lock:
+            return {
+                "attempts": self.attempts,
+                "migrations": self.migrations,
+                "wedged": self.wedged,
+                "bytes_moved": self.bytes_moved,
+                "est_s_total": self.est_s_total,
+            }

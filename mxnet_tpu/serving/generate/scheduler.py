@@ -31,6 +31,23 @@ Host syncs: the scheduler's per-step device read is
 definition must reach the host (the streaming iterator hands tokens
 to clients). Everything else on the step path is host bookkeeping —
 the MXL002 lint scope covers it.
+
+**Decode failover** (docs/robustness.md "Decode failover"): a lane
+that dies (:meth:`GenLane.kill`), drains (``scale_to`` shrink), or
+loses its device to a cluster reclaim evacuates its in-flight
+generations instead of failing them — one code path for planned and
+unplanned loss. Each evacuated request's KV blocks are salvaged
+through :class:`~.migrate.KVMigrator` and landed on a surviving
+lane's pool (``mode=migrate``); when the blocks are unsalvageable
+the survivor deterministically replays prompt + accepted tokens
+(``mode=replay``) — the greedy==reference contract makes the
+continuation token-identical either way, and the replayable
+``stream()`` iterator gives consumers one seamless sequence. A
+per-request budget (``MXTPU_GEN_MAX_RECOVERIES``, backoff base
+``MXTPU_GEN_RECOVERY_BACKOFF_MS``) degrades to a fast
+``RejectedError(reason="lane_lost")`` when exhausted; re-admission
+re-reserves blocks atomically on the target pool, so a full pool
+queues the recovery rather than double-booking.
 """
 from __future__ import annotations
 
@@ -42,9 +59,11 @@ import numpy as np
 from ... import tracing
 from ...telemetry import metrics as _tm
 from ...tracing import clock
-from ..batcher import ServingError
+from ...base import MXNetError, get_env
+from ..batcher import RejectedError, ServingError
 from ..variants import default_buckets, pick_bucket
 from .kvcache import BlockPool, BlockTable
+from .migrate import KVMigrator
 
 _met = _tm.lazy_metrics(lambda reg: {
     "requests": reg.counter(
@@ -77,14 +96,23 @@ _met = _tm.lazy_metrics(lambda reg: {
         "mx_serving_generate_batch_rows",
         "running requests per decode step", labelnames=("model",),
         buckets=(1, 2, 4, 8, 16, 32, 64)),
+    # phase = steady | recover: the autoscaler (and anyone reading
+    # latency SLOs) can see a failover stall for what it is instead
+    # of mistaking it for steady-state degradation
     "ttft": reg.histogram(
         "mx_serving_generate_ttft_seconds",
         "submit -> first token (prefill + queue)",
-        labelnames=("model",)),
+        labelnames=("model", "phase")),
     "inter_token": reg.histogram(
         "mx_serving_generate_inter_token_seconds",
         "gap between consecutive streamed tokens of one request",
-        labelnames=("model",)),
+        labelnames=("model", "phase")),
+    "recoveries": reg.counter(
+        "mx_serving_gen_recoveries_total",
+        "in-flight generations recovered onto a surviving lane "
+        "(migrate = KV blocks salvaged, replay = deterministic "
+        "re-decode of prompt + accepted tokens)",
+        labelnames=("model", "mode")),
     "cache_blocks": reg.gauge(
         "mx_serving_generate_cache_blocks",
         "block-pool state per lane",
@@ -107,8 +135,9 @@ class GenRequest:
     __slots__ = ("model", "prompt", "max_new_tokens", "trace_ctx",
                  "submit_ns", "first_token_ns", "last_token_ns",
                  "tokens", "token_spans", "table", "next_pos",
-                 "reserved_blocks", "finish_reason", "_cv", "_done",
-                 "_error")
+                 "reserved_blocks", "finish_reason", "recoveries",
+                 "recover_spans", "_salvage", "_recover_t0",
+                 "_recovered", "_cv", "_done", "_error")
 
     def __init__(self, model, prompt, max_new_tokens, trace_ctx):
         self.model = model
@@ -124,6 +153,11 @@ class GenRequest:
         self.next_pos = 0
         self.reserved_blocks = 0
         self.finish_reason = None
+        self.recoveries = 0       # times this request survived a lane
+        self.recover_spans = []   # (start_ns, end_ns, attrs) per rescue
+        self._salvage = None      # KV blocks gathered off a dead lane
+        self._recover_t0 = 0
+        self._recovered = False   # next emit is the post-rescue token
         self._cv = threading.Condition(threading.Lock())
         self._done = threading.Event()
         self._error = None
@@ -165,9 +199,13 @@ class GenRequest:
             self._cv.notify_all()
 
     def _finish(self, error=None):
-        self._error = error
-        self._done.set()
+        # error and done flip under the stream lock: a consumer that
+        # checked `_done` while we were between the two writes would
+        # wait() forever on a request that already failed — the
+        # post-death stream() must observe the terminal error promptly
         with self._cv:
+            self._error = error
+            self._done.set()
             self._cv.notify_all()
 
 
@@ -184,10 +222,12 @@ class GenLane:
         self.waiting = deque()
         self.running = []
         self._thread = None
-        # elastic scale-in: a retiring lane takes no new admissions,
-        # drains its waiting+running requests normally, then exits so
-        # the pool can be released (drain-before-retire)
+        # a retiring lane takes no new admissions and EVACUATES its
+        # waiting+running requests onto the surviving lanes (migrate/
+        # replay), then exits so the pool can be released — planned
+        # scale-in, chaos kill, and ledger reclaim are one code path
         self.retiring = False
+        self.cause = None        # why the lane went away (kill/reclaim)
         self.finalized = False   # pool closed + lane removed (once)
 
     def start(self):
@@ -200,50 +240,120 @@ class GenLane:
         if self._thread is not None:
             self._thread.join(timeout)
 
+    def kill(self, cause=None):
+        """SIGKILL-equivalent lane loss (the chaos seam; also where a
+        cluster reclaim revoking this lane's device funnels): stop
+        scheduling immediately and evacuate every in-flight
+        generation onto the surviving lanes — blocks migrate while
+        the pool still answers, replay covers the truly-gone case."""
+        m = self._model
+        with m.cond:
+            if self.retiring:
+                return
+            self.cause = cause or f"lane {self.idx} killed"
+            self.retiring = True
+            m.cond.notify_all()
+
     # -- scheduler loop ------------------------------------------------------
     def _loop(self):
         m = self._model
         while True:
+            doomed = None
+            admit = []
             with m.cond:
-                while not self.waiting and not self.running \
-                        and not m.closed and not self.retiring:
-                    m.cond.wait()
+                while True:
+                    if m.closed or self.retiring:
+                        break
+                    admit = self._pop_admissions()
+                    if admit or self.running:
+                        break
+                    # idle, or the queue head is a recovery whose
+                    # re-reservation cannot fit yet: wait for a
+                    # submit or a retire freeing budget (bounded —
+                    # the freeing unreserve may race this probe)
+                    m.cond.wait(0.1)
                 if m.closed:
                     break
-                if self.retiring and not self.waiting \
-                        and not self.running:
-                    drained = True
-                else:
-                    drained = False
-            if drained:
-                # drained: every admitted request finished and
-                # released its blocks. Finalize OURSELVES (outside
-                # the cond lock): the scale-in initiator may have
-                # given up on its join timeout long ago, and a pool
-                # nobody closes is a permanent HBM leak
-                m._finalize_retired_lane(self)
+                if self.retiring:
+                    doomed = list(self.running) + list(self.waiting)
+                    self.running = []
+                    self.waiting.clear()
+            if doomed is not None:
+                # evacuate-then-finalize (outside the cond lock): the
+                # scale-in initiator may have given up on its join
+                # timeout long ago, and a pool nobody closes is a
+                # permanent HBM leak
+                self._evacuate(doomed)
                 return
-            with m.cond:
-                admit = []
-                while self.waiting and \
-                        len(self.running) + len(admit) < \
-                        m.max_decode_batch:
-                    admit.append(self.waiting.popleft())
             if admit:
                 m._observe_depth()     # the waiting set just shrank
             try:
                 for req in admit:
-                    self._prefill(req)
+                    self._start(req)
                 if self.running:
                     self._step()
             except Exception as e:  # noqa: BLE001 — a failed step
-                # fails ITS requests; the lane survives for new work
-                self._fail_inflight(admit, e)
+                # evacuates ITS requests onto the surviving lanes
+                # (possibly this one); the lane survives for new work
+                self._recover_inflight(admit, e)
         # shutdown: nothing new executes — fail whatever is left
         err = ServingError(
             f"generate: model {m.name!r} shut down before the request "
             "completed")
         self._fail_inflight([], err)
+
+    def _pop_admissions(self):
+        """Pop admittable waiting requests (caller holds m.cond). A
+        recovery re-queued without a reservation must re-reserve
+        atomically HERE, on the pool it will actually decode on — a
+        full pool leaves it queued (no double-booking), to be retried
+        the moment a retire frees budget."""
+        m = self._model
+        admit = []
+        while self.waiting and \
+                len(self.running) + len(admit) < m.max_decode_batch:
+            req = self.waiting[0]
+            if req.reserved_blocks == 0:
+                need = self.pool.blocks_for(
+                    len(req.prompt) + req.max_new_tokens)
+                if not self.pool.reserve(need):
+                    break
+                req.reserved_blocks = need
+            self.waiting.popleft()
+            admit.append(req)
+        return admit
+
+    def _evacuate(self, doomed):
+        """Retiring/killed lane: hand every admitted request to the
+        surviving lanes (migrate preferred, deterministic replay as
+        the fallback), then finalize. Planned drains, chaos kills,
+        and ledger reclaims all exit through here."""
+        m = self._model
+        _met()["inflight"].labels(model=m.name,
+                                  lane=str(self.idx)).set(0)
+        m._observe_depth()
+        m._recover_requests(
+            self, doomed, self.cause or f"lane {self.idx} retired")
+        m._finalize_retired_lane(self)
+
+    def _recover_inflight(self, extra, err):
+        """A failed prefill/step: route the affected requests through
+        migrate/replay instead of failing them (budget-bounded — a
+        persistently failing request degrades to ``lane_lost``)."""
+        m = self._model
+        with m.cond:
+            doomed = list(self.running)
+            self.running = []
+        seen = set()
+        uniq = []
+        for req in doomed + [r for r in extra if not r.done()]:
+            if id(req) not in seen:
+                seen.add(id(req))
+                uniq.append(req)
+        _met()["inflight"].labels(model=m.name,
+                                  lane=str(self.idx)).set(0)
+        m._observe_depth()
+        m._recover_requests(self, uniq, repr(err))
 
     def _fail_inflight(self, extra, err):
         m = self._model
@@ -267,6 +377,112 @@ class GenLane:
             self._retire(req, error=err)
 
     # -- phases --------------------------------------------------------------
+    def _start(self, req):
+        """Dispatch one admitted request: land a KV-block migration,
+        deterministically replay a recovery, or fresh-prefill."""
+        if req._salvage is not None and self._land_migration(req):
+            return
+        if req.tokens:
+            self._replay(req)
+        else:
+            self._prefill(req)
+
+    def _land_migration(self, req):
+        """Scatter the request's salvaged KV blocks into THIS pool and
+        rejoin it to the running batch — the migrate recovery mode.
+        False when the landing fails (wedged/closed): the caller falls
+        back to deterministic replay, which only needs the tokens."""
+        m = self._model
+        met = _met()
+        salvage, req._salvage = req._salvage, None
+        try:
+            table, handoff = m.migrator.land(salvage, self.pool,
+                                             m.table_width)
+        except MXNetError:
+            return False
+        req.table = table
+        # between steps the cache holds prompt + tokens[:-1]; the very
+        # next decode step feeds tokens[-1] at next_pos — the invariant
+        # the migrated table preserves byte-for-byte
+        req.next_pos = len(req.prompt) + len(req.tokens) - 1
+        now = clock.now_ns()
+        req.recover_spans.append((
+            req._recover_t0 or now, now,
+            {"mode": "migrate", "lane": self.idx,
+             "blocks": handoff["blocks"],
+             "bytes_moved": handoff["bytes_moved"],
+             "est_s": handoff["est_s"]}))
+        req._recovered = True
+        met["recoveries"].labels(model=m.name, mode="migrate").inc()
+        self.running.append(req)
+        met["inflight"].labels(model=m.name, lane=str(self.idx)).set(
+            len(self.running))
+        self._observe_pool()
+        return True
+
+    def _replay(self, req):
+        """Deterministic replay on THIS lane: re-prefill the prompt,
+        silently re-decode the already-accepted tokens (no
+        re-emission — consumers see one seamless stream), rejoin the
+        running batch. The greedy==reference contract makes the
+        continuation token-for-token identical to the never-killed
+        run; a divergence is a determinism bug and raises."""
+        m = self._model
+        met = _met()
+        accepted = list(req.tokens)
+        plen = len(req.prompt)
+        tpad = pick_bucket(m.prompt_buckets, plen)
+        req.table = BlockTable(self.pool, m.table_width)
+        req.table.extend(self.pool.blocks_for(plen))
+        tokens = np.zeros(tpad, np.int32)
+        tokens[:plen] = req.prompt
+        first = int(self._host_tokens(self.steps.prefill(
+            tokens, plen,
+            req.table.row[:tpad // self.pool.block_tokens])))
+        req.next_pos = plen
+        if first != accepted[0]:
+            raise MXNetError(
+                "generate: replay diverged at the first token "
+                f"({first} != accepted {accepted[0]}) — greedy decode "
+                "must be deterministic")
+        # batch-1 silent re-decode through the warmed bucket: feed
+        # each accepted token at its original position, checking the
+        # re-derived successor — never growing past the executables
+        # the lane already compiled
+        bucket = pick_bucket(m.decode_buckets, 1)
+        for j in range(1, len(accepted)):
+            req.table.ensure_position(req.next_pos)
+            tkn = np.zeros(bucket, np.int32)
+            pos = np.zeros(bucket, np.int32)
+            tab = np.zeros((bucket, m.table_width), np.int32)
+            tkn[0] = accepted[j - 1]
+            pos[0] = req.next_pos
+            tab[0] = req.table.row
+            nxt = int(self._host_tokens(
+                self.steps.decode(tkn, pos, tab))[0])
+            req.next_pos += 1
+            if nxt != accepted[j]:
+                raise MXNetError(
+                    f"generate: replay diverged at token {j} "
+                    f"({nxt} != accepted {accepted[j]}) — greedy "
+                    "decode must be deterministic")
+        met["tokens"].labels(model=m.name, phase="replay").inc(
+            plen + max(len(accepted) - 1, 0))
+        met["steps"].labels(model=m.name, phase="replay").inc(
+            len(accepted))
+        now = clock.now_ns()
+        req.recover_spans.append((
+            req._recover_t0 or now, now,
+            {"mode": "replay", "lane": self.idx,
+             "prompt_tokens": plen,
+             "replayed_tokens": len(accepted)}))
+        req._recovered = True
+        met["recoveries"].labels(model=m.name, mode="replay").inc()
+        self.running.append(req)
+        met["inflight"].labels(model=m.name, lane=str(self.idx)).set(
+            len(self.running))
+        self._observe_pool()
+
     def _prefill(self, req):
         """One request's padded prompt through the causal stack; emits
         the first greedy token and joins the running set."""
@@ -340,12 +556,15 @@ class GenLane:
         finished when it hits EOS or its budget."""
         m = self._model
         met = _met()
+        phase = "recover" if req._recovered else "steady"
+        req._recovered = False
         if not req.tokens:
             req.first_token_ns = now_ns
-            met["ttft"].labels(model=m.name).observe(
+            met["ttft"].labels(model=m.name, phase=phase).observe(
                 (now_ns - req.submit_ns) / 1e9)
         else:
-            met["inter_token"].labels(model=m.name).observe(
+            met["inter_token"].labels(
+                model=m.name, phase=phase).observe(
                 (now_ns - req.last_token_ns) / 1e9)
         req.last_token_ns = now_ns
         req.token_spans.append((step_start_ns, now_ns))
@@ -368,12 +587,18 @@ class GenLane:
 
     # -- retirement ----------------------------------------------------------
     def _retire(self, req, error=None):
+        m = self._model
         if req.table is not None:
             req.table.release()
             req.table = None
         if req.reserved_blocks:
             self.pool.unreserve(req.reserved_blocks)
             req.reserved_blocks = 0
+        req._salvage = None
+        # the freed reservation may be exactly what a queued recovery
+        # on another lane is waiting to re-reserve
+        with m.cond:
+            m.cond.notify_all()
         self._observe_pool()
         self._record_spans(req, error)
         req._finish(error)
@@ -390,6 +615,7 @@ class GenLane:
             attrs={"model": m.name, "lane": self.idx,
                    "prompt_tokens": len(req.prompt),
                    "new_tokens": len(req.tokens),
+                   "recoveries": req.recoveries,
                    "finish": ("error" if error is not None
                               else req.finish_reason)})
         if req.first_token_ns:
@@ -397,6 +623,9 @@ class GenLane:
                 "generate.prefill", trace_id, root, req.submit_ns,
                 req.first_token_ns, cat="serving",
                 attrs={"prompt_tokens": len(req.prompt)})
+        for s, e, attrs in req.recover_spans:
+            tracing.record_span("generate.recover", trace_id, root,
+                                s, e, cat="serving", attrs=attrs)
         for j, (s, e) in enumerate(req.token_spans):
             tracing.record_span("generate.token", trace_id, root, s, e,
                                 cat="serving", attrs={"index": j})
@@ -427,6 +656,19 @@ class GenModel:
         self.max_queue = int(max_queue)
         self.closed = False
         self.cond = threading.Condition(threading.Lock())
+        # decode failover: how many lane losses one request survives
+        # before degrading to a fast lane_lost reject, and the backoff
+        # base between REPEAT recoveries of the same request (doubling,
+        # capped at 40x base — the first rescue is always immediate)
+        self.max_recoveries = max(
+            int(get_env("MXTPU_GEN_MAX_RECOVERIES", 2, int)), 0)
+        self.recovery_backoff_ms = max(
+            float(get_env("MXTPU_GEN_RECOVERY_BACKOFF_MS", 50.0,
+                          float)), 0.0)
+        self.fault_plan = None   # None -> MXNET_KVSTORE_FAULT_PLAN
+        self.migrator = KVMigrator(name)
+        self.lane_lost_rejections = 0
+        self._recovery_round = 0
         bt = self.block_tokens
         max_prompt_pad = _ceil_mul(decoder.max_prompt_tokens, bt)
         # prompt pads: the PR 10 bucket ladder in units of blocks —
@@ -547,15 +789,131 @@ class GenModel:
             depth = sum(len(ln.waiting) for ln in self.lanes)
         _met()["depth"].labels(model=self.name).set(depth)
 
+    # -- decode failover -----------------------------------------------------
+    def _recover_requests(self, src_lane, reqs, cause):
+        """Evacuate ``reqs`` off ``src_lane`` onto surviving lanes.
+
+        Per request: enforce the recovery budget (exhaustion = fast
+        ``lane_lost`` reject), salvage its KV blocks while the source
+        pool still answers (unless a ``replay_storm`` fault forces
+        the device-truly-gone case), detach it from the source pool,
+        then re-admit on the lane with the most headroom — reserving
+        atomically on the target, or queueing unreserved when every
+        pool is full (the target's admission loop re-reserves the
+        moment a retire frees budget; nothing double-books). Requests
+        that never decoded a token just requeue — they lost no state,
+        so they spend no budget."""
+        from ...kvstore import fault as _fault
+        import time as _time
+        met = _met()
+        reqs = [r for r in reqs if not r.done()]
+        if not reqs:
+            return
+        with self.cond:
+            self._recovery_round += 1
+            rround = self._recovery_round
+        storm = _fault.replay_storm_active(rround, plan=self.fault_plan)
+        for req in reqs:
+            if self.closed:
+                src_lane._retire(req, error=ServingError(
+                    f"generate: model {self.name!r} shut down before "
+                    "the request completed"))
+                continue
+            if req.tokens:
+                req.recoveries += 1
+                if req.recoveries > self.max_recoveries:
+                    with self.cond:
+                        self.lane_lost_rejections += 1
+                    met["rejected"].labels(model=self.name,
+                                           reason="lane_lost").inc()
+                    src_lane._retire(req, error=RejectedError(
+                        "lane_lost",
+                        f"generate: request on {self.name!r} lost its "
+                        f"lane {req.recoveries} time(s) ({cause}) and "
+                        "exhausted its recovery budget (MXTPU_GEN_MAX_"
+                        f"RECOVERIES={self.max_recoveries}); resubmit "
+                        "to retry"))
+                    continue
+                if req.recoveries > 1:
+                    # bounded backoff between REPEAT rescues of one
+                    # request — a request ping-ponging across dying
+                    # lanes must not busy-spin the recovery path
+                    _time.sleep(min(
+                        self.recovery_backoff_ms
+                        * 2.0 ** (req.recoveries - 2),
+                        self.recovery_backoff_ms * 40.0) / 1e3)
+                req._recover_t0 = clock.now_ns()
+                if req._salvage is None and not storm \
+                        and req.table is not None and req.table.blocks:
+                    try:
+                        req._salvage = self.migrator.salvage(
+                            src_lane.pool, req.table.blocks)
+                    except MXNetError:
+                        req._salvage = None   # replay covers it
+            # detach from the source pool (the salvage, when taken,
+            # owns its bytes — the pool can close right after)
+            if req.table is not None:
+                req.table.release()
+                req.table = None
+            if req.reserved_blocks:
+                src_lane.pool.unreserve(req.reserved_blocks)
+                req.reserved_blocks = 0
+            req.next_pos = 0
+            need = src_lane.pool.blocks_for(
+                len(req.prompt) + req.max_new_tokens)
+            while True:
+                with self.cond:
+                    live = [ln for ln in self.lanes
+                            if not ln.retiring and not ln.finalized]
+                if not live:
+                    with self.cond:
+                        self.lane_lost_rejections += 1
+                    met["rejected"].labels(model=self.name,
+                                           reason="lane_lost").inc()
+                    src_lane._retire(req, error=RejectedError(
+                        "lane_lost",
+                        f"generate: model {self.name!r} has no "
+                        f"surviving decode lanes to recover onto "
+                        f"({cause})"))
+                    break
+                order = sorted(
+                    live, key=lambda ln: ln.pool.reserved_blocks())
+                target = None
+                for ln in order:
+                    if ln.pool.reserve(need):
+                        req.reserved_blocks = need
+                        target = ln
+                        break
+                if target is None:
+                    # kv_cache_full during recovery: queue on the
+                    # least-booked lane with NO reservation — its
+                    # admission loop re-reserves atomically once a
+                    # retire frees budget
+                    target = order[0]
+                with self.cond:
+                    if not target.retiring:
+                        target.waiting.append(req)
+                        self.cond.notify_all()
+                        break
+                # the target started retiring between selection and
+                # enqueue: hand the budget back and pick again
+                if req.reserved_blocks:
+                    target.pool.unreserve(req.reserved_blocks)
+                    req.reserved_blocks = 0
+        self._observe_depth()
+
     # -- lifecycle -----------------------------------------------------------
     def scale_to(self, n, devices, drain_timeout=30.0):
         """Resize to ``n`` decode lanes (Gateway.scale's generator
         arm). ``devices`` is the full n-lane placement (the gateway's
         picker output). Scale-out builds + warms + starts fresh lanes;
-        scale-in retires the newest lanes drain-first: each stops
-        admitting, finishes its waiting+running requests, and releases
-        its KV block pool — the census role=kv_cache bytes drop by
-        exactly the retired pools' footprint."""
+        scale-in retires the newest lanes evacuate-first: each stops
+        admitting, hands its waiting+running requests to the surviving
+        lanes through the migrate/replay recovery path (planned drains
+        and crashes are one code path — no request waits out a drain
+        timeout), and releases its KV block pool — the census
+        role=kv_cache bytes drop by exactly the retired pools'
+        footprint."""
         n = int(n)
         if n < 1:
             raise ServingError(
@@ -579,15 +937,16 @@ class GenModel:
         return report
 
     def _retire_lane(self, lane, timeout=30.0):
-        """Drain-before-retire one lane; returns the pool bytes
-        released. The lane keeps decoding until its admitted requests
-        finish (their reservations release with them), then exits and
-        finalizes. A lane that cannot drain within ``timeout`` stays
+        """Evacuate-then-retire one lane; returns the pool bytes
+        released. The lane hands its admitted requests to the
+        surviving lanes (KV blocks migrated, or replayed when
+        unsalvageable), then exits and finalizes — typically well
+        inside ``timeout``, since nothing waits for decodes to
+        finish. A lane that cannot evacuate within ``timeout`` stays
         retiring (no new work) with its pool intact — closing storage
-        under an in-flight decode would corrupt live requests — and
-        finalizes ITSELF the moment it drains (the lane loop's
-        drained branch), so a timed-out initiator never leaks the
-        pool."""
+        under an in-flight copy would corrupt live requests — and
+        finalizes ITSELF the moment it empties, so a timed-out
+        initiator never leaks the pool."""
         from ... import tracing
         with tracing.span("elastic.drain", cat="elastic",
                           model=self.name, lane=lane.idx):
@@ -650,6 +1009,10 @@ class GenModel:
             "warmup_seconds": round(self.warmup_seconds, 3),
             "degraded": self.degraded,
             "tp": self.tp,
+            "recovery": dict(
+                self.migrator.stats(),
+                max_recoveries=self.max_recoveries,
+                lane_lost_rejections=self.lane_lost_rejections),
             "lanes": [
                 {"idx": ln.idx, "device": str(ln.device),
                  "retiring": ln.retiring,

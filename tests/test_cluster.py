@@ -4,7 +4,9 @@ per-owner device-seconds sum to the world), journal replay after a
 simulated crash at every protocol step (torn tails skipped by CRC,
 deadlines re-anchored), the lend/reclaim protocol round trip under
 ZeRO-2 (dp=4 -> lend 2 -> serve on the borrowed chips -> reclaim ->
-dp=4 bit-identical to a planned twin), borrow_wedge lease revocation
+dp=4 bit-identical to a planned twin), lease reclaim landing on a
+generator mid-decode (streams evacuate and complete token-exact,
+device-seconds conserved), borrow_wedge lease revocation
 on a fake clock, the reclaim_timeout drain delay bounded by the
 backoff budget, gateway placement routed through the ledger, the
 autoscaler daemon surviving transient tick failures (and its death
@@ -409,6 +411,118 @@ def test_lend_reclaim_round_trip_bit_identical(tmp_path):
         twin.train_step(_batches(X, Y, k))
     assert fp_live == twin.fingerprint()
     ledger.verify_conservation()
+    vj = DeviceLedger.verify_journal(tmp_path / "journal")
+    assert vj["conserved"] is True and vj["violations"] == []
+
+
+def test_lease_reclaim_during_generation_token_exact(tmp_path):
+    """The ledger reclaims serving's borrowed chips MID-DECODE: the
+    retiring generator lanes evacuate their in-flight token streams
+    onto the surviving lanes (KV-block migration / deterministic
+    replay — docs/robustness.md "Decode failover"), every stream
+    completes token-identical to the unkilled reference oracle, and
+    device-seconds stay conserved across the round trip."""
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.serving import Gateway
+    from mxnet_tpu.serving.generate import (GenerativeDecoder,
+                                            reference_generate)
+
+    devs = jax.local_devices()
+    assert len(devs) >= 6
+    world, tdevs = devs[:6], devs[:4]
+    params, loss_fn, bex, X, Y = _mlp_fixture()
+    ledger = DeviceLedger(world, journal_dir=tmp_path / "journal")
+    trainer = ElasticTrainer(loss_fn, params, bex, lr=0.05,
+                             momentum=0.9, stage=2)
+    trainer.attach_ledger(ledger, "training")
+    trainer.build(tdevs)
+    mx.random.seed(0)
+    decoder = GenerativeDecoder(vocab_size=50, d_model=32,
+                                num_layers=2, num_heads=4,
+                                max_prompt_tokens=12)
+    gw = Gateway(devices=world, ledger=ledger)
+    try:
+        gw.register_generator("genloan", decoder, block_tokens=4,
+                              max_blocks=64, max_new_tokens=48,
+                              max_decode_batch=4, replicas=2,
+                              warmup=False)
+        trainer.train_step(_batches(X, Y, 0))
+        sched = LendingScheduler(ledger, trainer=trainer, gateway=gw,
+                                 min_train_dp=2, deadline_s=60.0)
+        gen = gw._generators["genloan"]
+        pre_lanes = list(gen.lanes)
+        rec = sched.lend("genloan", 2)
+        assert trainer.dp == 2
+        assert gw.replica_count("genloan") == 4
+        borrowed = [ln for ln in gen.lanes if ln not in pre_lanes]
+        assert len(borrowed) == 2
+        # steer admission onto the BORROWED lanes: try_admit prefers
+        # the lane with the most free blocks, so a near-full hold on
+        # each original lane routes every request at the loaned chips
+        holds = [(ln, ln.pool.usable_blocks - ln.pool.reserved_blocks()
+                  - 3) for ln in pre_lanes]
+        for ln, k in holds:
+            assert ln.pool.reserve(k)
+        prompts = [[3, 1, 4, 1], [5, 9, 2, 6], [7, 2, 8],
+                   [9, 7, 9, 3, 2], [4, 4, 1], [8, 6, 5, 2]]
+        refs = [reference_generate(decoder, p, 16) for p in prompts]
+        reqs = [gw.generate("genloan", p, max_new_tokens=16,
+                            stream=True) for p in prompts]
+        # pin a long-budget victim provably mid-decode on a borrowed
+        # lane right before the reclaim: the scheduler re-acquires
+        # gen.cond between decode steps (one token per holder), so a
+        # victim observed at <= 8 of 48 tokens UNDER the cond still
+        # has ~40 steps of headroom when the reclaim's retire mark
+        # lands a few lock handoffs later.  A victim that finishes
+        # early is checked against the oracle and resubmitted —
+        # greedy decode makes every attempt token-identical.
+        vprompt = [6, 2, 6, 4]
+        vref = reference_generate(decoder, vprompt, 48)
+        victim = gw.generate("genloan", vprompt, max_new_tokens=48,
+                             stream=True)
+        spare, caught = [], False
+        deadline = time.monotonic() + 30.0
+        while not caught and time.monotonic() < deadline:
+            with gen.cond:
+                vl = next((ln for ln in borrowed
+                           if victim in ln.running), None)
+                if vl is not None and victim.tokens and \
+                        len(victim.tokens) <= 8:
+                    caught = True
+                elif victim.done():
+                    spare.append(victim)
+                    victim = None
+            if victim is None:
+                victim = gw.generate("genloan", vprompt,
+                                     max_new_tokens=48, stream=True)
+            time.sleep(0)
+        assert caught, "victim never caught mid-decode on a borrowed lane"
+        # free the original lanes so the evacuation has somewhere to go
+        for ln, k in holds:
+            ln.pool.unreserve(k)
+        with gen.cond:
+            gen.cond.notify_all()
+        # reclaim WHILE the borrowed lanes are mid-decode — the drain
+        # is an evacuation, not a wait-for-completion
+        sched.reclaim(rec)
+        assert trainer.dp == 4 and sched.active_borrows() == []
+        for d in rec["devices"]:
+            assert ledger.owner_of(d)[0] == "training"
+        outs = [r.result(60.0) for r in reqs]
+        assert outs == refs            # token-identical across reclaim
+        assert victim.result(60.0) == vref
+        assert all(s.result(1.0) == vref for s in spare)
+        # the caught victim's stream really did cross over
+        assert victim.recover_spans
+        assert gw.replica_count("genloan") == 2
+        trainer.train_step(_batches(X, Y, 1))
+    finally:
+        gw.close()
+    ledger.verify_conservation()
+    ds = ledger.device_seconds()
+    assert ds["conserved"] is True
     vj = DeviceLedger.verify_journal(tmp_path / "journal")
     assert vj["conserved"] is True and vj["violations"] == []
 

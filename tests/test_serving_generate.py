@@ -317,9 +317,9 @@ def test_generate_telemetry_families(gateway):
     occ = reg.find("mx_serving_generate_cache_occupancy")
     assert occ is not None and occ.labels(model="lm").count >= 3
     ttft = reg.find("mx_serving_generate_ttft_seconds")
-    assert ttft.labels(model="lm").count >= 1
+    assert ttft.labels(model="lm", phase="steady").count >= 1
     inter = reg.find("mx_serving_generate_inter_token_seconds")
-    assert inter.labels(model="lm").count >= 3
+    assert inter.labels(model="lm", phase="steady").count >= 3
 
 
 def test_per_token_trace_spans(gateway):
@@ -394,7 +394,8 @@ def test_gen_env_vars_registered():
     doc = open(os.path.join(REPO, "docs", "env_vars.md"),
                encoding="utf-8").read()
     for var in ("MXTPU_GEN_BLOCK_TOKENS", "MXTPU_GEN_MAX_BLOCKS",
-                "MXTPU_GEN_MAX_NEW_TOKENS"):
+                "MXTPU_GEN_MAX_NEW_TOKENS", "MXTPU_GEN_MAX_RECOVERIES",
+                "MXTPU_GEN_RECOVERY_BACKOFF_MS"):
         assert var in libinfo._ENV_VARS
         assert var in doc
 

@@ -10,10 +10,12 @@ Drives every scenario family in :mod:`mxnet_tpu.elastic.chaos` —
 preemption storm (mesh reshape + ZeRO re-shard + iterator carry),
 injected straggler (trace_merge must name the rank), replica kill
 under open-loop load (drain/revive, zero lost requests), the
-autoscale cycle (scale out on telemetry, back in after cooldown), and
-colocation (device lending: serving borrows training chips through
-the cluster ledger and gives them back, bit-identical) — and writes
-one versioned artifact:
+autoscale cycle (scale out on telemetry, back in after cooldown),
+decode (mid-stream lane kills: in-flight generations migrate their KV
+blocks or replay deterministically, token-identical to the unkilled
+oracle), and colocation (device lending: serving borrows training
+chips through the cluster ledger and gives them back, bit-identical)
+— and writes one versioned artifact:
 
     {"tool": "chaos_bench", "version": 1, "created": ...,
      "host": {...}, "scenarios": {family: {...}}}
@@ -84,6 +86,14 @@ def scenario_ok(s):
     if s.get("family") == "replica_kill" and \
             s.get("probe_fingerprint_equal") is not True:
         return False
+    if s.get("family") == "decode":
+        if not (s.get("recoveries") or {}).get("total"):
+            return False
+        if (s.get("recovery_budget") or {}).get("within") is not True:
+            return False
+        if (s.get("census") or {}).get("kv_cache_conserved") \
+                is not True:
+            return False
     if s.get("family") == "colocation":
         if s.get("reclaim_s") is None or \
                 s["reclaim_s"] > s.get("reclaim_budget_s", 0):
@@ -135,6 +145,9 @@ def main(argv=None):
             duration_s=2.0 if args.quick else 4.0),
         "autoscale_cycle": lambda: chaos.run_autoscale_cycle(
             burst_s=1.5 if args.quick else 2.5),
+        "decode": lambda: chaos.run_decode(
+            streams=4 if args.quick else 6,
+            max_new_tokens=24 if args.quick else 32),
         "colocation": lambda: chaos.run_colocation(
             burst_s=2.5 if args.quick else 4.0),
     }

@@ -113,7 +113,7 @@ DEFAULT_CHAOS_LAST_GOOD = os.path.join(REPO, "docs", "artifacts",
 # the elasticity plane's advertised scenario families: an artifact
 # missing one of these has not exercised the SLO it claims to gate
 REQUIRED_CHAOS_FAMILIES = ("preemption_storm", "straggler",
-                           "replica_kill", "colocation")
+                           "replica_kill", "decode", "colocation")
 
 # metrics compared when both sides carry them; values are "bigger is
 # better" throughputs/ratios
@@ -847,6 +847,54 @@ def gate_chaos(candidate, last_good, tolerance=0.25):
                 msgs.append("chaos[autoscale_cycle]: out at %ss, in "
                             "at %ss (ok)" % (s.get("scale_out_at_s"),
                                              s.get("scale_in_at_s")))
+        if family == "decode":
+            recs = s.get("recoveries") or {}
+            if not recs.get("total"):
+                rc = 1
+                msgs.append("REGRESSION chaos[decode]: no in-flight "
+                            "generation was recovered — the kill "
+                            "storm never exercised migrate/replay "
+                            "(recoveries=%s)" % (recs,))
+            else:
+                msgs.append("chaos[decode]: %s recoveries (%s "
+                            "migrate, %s replay) (ok)"
+                            % (recs.get("total"),
+                               recs.get("migrate"),
+                               recs.get("replay")))
+            rb = s.get("recovery_budget") or {}
+            if rb.get("within") is not True or \
+                    rb.get("lane_lost_rejections"):
+                rc = 1
+                msgs.append("REGRESSION chaos[decode]: per-request "
+                            "recovery budget blown (max_observed=%s "
+                            "of %s, lane_lost_rejections=%s)"
+                            % (rb.get("max_observed"),
+                               rb.get("max_recoveries"),
+                               rb.get("lane_lost_rejections")))
+            else:
+                msgs.append("chaos[decode]: recovery budget held "
+                            "(max %s of %s) (ok)"
+                            % (rb.get("max_observed"),
+                               rb.get("max_recoveries")))
+            cz = s.get("census") or {}
+            pool_b = cz.get("pool_bytes")
+            census_b = cz.get("census_bytes")
+            # recomputed here, not trusted from the flag: the census
+            # role=kv_cache bytes must equal the surviving pools'
+            # exact footprint (a leak OR a double-book breaks it)
+            conserved = cz.get("kv_cache_conserved") is True and \
+                isinstance(pool_b, (int, float)) and \
+                isinstance(census_b, (int, float)) and \
+                pool_b == census_b
+            if not conserved:
+                rc = 1
+                msgs.append("REGRESSION chaos[decode]: kv_cache "
+                            "bytes NOT conserved across the storm "
+                            "(pools %s vs census %s)"
+                            % (pool_b, census_b))
+            else:
+                msgs.append("chaos[decode]: kv_cache bytes conserved "
+                            "(%s) (ok)" % pool_b)
         if family == "colocation":
             if not (s.get("lend") or {}).get("occurred"):
                 rc = 1
