@@ -43,6 +43,13 @@ if (_os.environ.get("DMLC_ROLE") == "worker"
     _os.environ["_MXTPU_DIST_JOINED"] = "1"
 
 from .base import MXNetError, get_env
+
+# The lock witness must patch threading.* BEFORE any framework module
+# constructs a lock, so this hook runs ahead of every subsystem import.
+if get_env("MXTPU_LOCK_WITNESS", "0") not in ("0", "", "false", "off"):
+    from .analysis import witness as _witness
+    _witness.install()
+
 from . import telemetry
 from . import tracing
 from . import profiling

@@ -20,8 +20,17 @@ analogue, with two engines:
   nodes, quantize/dequantize pairing. Exposed as ``Symbol.validate()``
   and run warn-only from ``simple_bind`` (``MXNET_GRAPH_VALIDATE``).
 
+- :mod:`mxnet_tpu.analysis.witness` — the runtime half of the
+  concurrency plane: ``MXTPU_LOCK_WITNESS=1`` patches the framework's
+  lock constructors with wrappers that record per-thread acquisition
+  edges and held-across-``Condition.wait`` hazards, cycle-check the
+  graph at teardown and dump a ranked lockgraph artifact
+  (``perf_gate --locks`` gates the committed one). The static twin is
+  rules MXL007–MXL010 (``rules/concurrency.py``).
+
 CLI driver: ``python tools/mxlint.py`` (tier-1 gated by
-``tests/test_mxlint.py``). Catalogue: ``docs/static_analysis.md``.
+``tests/test_mxlint.py`` and ``tests/test_concurrency_lint.py``).
+Catalogue: ``docs/static_analysis.md``.
 """
 from .lint import (Finding, LintResult, Rule, baseline_hash, load_baseline,
                    run_lint)

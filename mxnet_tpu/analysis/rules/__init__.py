@@ -16,6 +16,14 @@ run — cross-module rules accumulate in ``check_module`` and emit from
 | MXL005 | registry-hygiene    | op name/alias collisions across ops/*      |
 | MXL006 | trace-attr-sync     | host syncs computing span attributes in    |
 |        |                     | hot paths (tracing instrumentation)        |
+| MXL007 | lock-order          | cycles in the whole-repo lock acquisition  |
+|        |                     | graph (with-nesting + call resolution)     |
+| MXL008 | condvar-discipline  | Condition.wait outside a while-predicate   |
+|        |                     | loop; notify without the lock held         |
+| MXL009 | thread-hygiene      | non-daemon unjoined threads; time.sleep    |
+|        |                     | polling in MXL002-scoped hot paths         |
+| MXL010 | blocking-under-lock | untimed join/wait/get while a `with lock:` |
+|        |                     | frame is open                              |
 """
 from __future__ import annotations
 
@@ -29,9 +37,13 @@ def all_rules():
     from .env_registry import EnvRegistryRule
     from .registry_hygiene import RegistryHygieneRule
     from .trace_attrs import TraceAttrSyncRule
+    from .concurrency import (BlockingUnderLockRule, CondvarDisciplineRule,
+                              LockOrderRule, ThreadHygieneRule)
     return [TracerPurityRule(), HostSyncRule(), AtomicWriteRule(),
             EnvRegistryRule(), RegistryHygieneRule(),
-            TraceAttrSyncRule()]
+            TraceAttrSyncRule(), LockOrderRule(),
+            CondvarDisciplineRule(), ThreadHygieneRule(),
+            BlockingUnderLockRule()]
 
 
 # -- shared AST helpers ------------------------------------------------------

@@ -1,0 +1,680 @@
+"""MXL007–MXL010 — lock-discipline rules for the threading planes.
+
+The reference framework made its concurrency invariants a property of
+the *dependency engine*; this tree spreads them across the serving
+gateway, the decode lanes, the DeviceLedger/LendingScheduler pair and
+the elastic daemons — 30+ modules using ``threading``, reviewed by
+hand until now. These rules turn the invariants the review passes keep
+re-deriving into the same check-the-artifact gate MXL001–006 give the
+lowering:
+
+- **MXL007 lock-order**: a per-class lock registry is read straight
+  from the AST (``self.X = threading.Lock()/RLock()/Condition()``),
+  then a whole-repo acquisition graph is built from ``with``-nesting
+  plus one level of intraprocedural call resolution (``self.m()`` to
+  the same class, unique method names across the registry, same-module
+  functions). A cycle in that graph is a deadlock two threads can
+  reach; the finding names both paths. A non-reentrant ``Lock``
+  re-acquired while already held (a length-1 cycle) is the same bug
+  in one thread.
+- **MXL008 condvar discipline**: ``Condition.wait()`` outside a
+  ``while``-predicate loop misses wakeups and wakes spuriously;
+  ``notify``/``notify_all`` without the condition's lock held races
+  the very predicate it signals.
+- **MXL009 thread hygiene**: a non-daemon ``Thread`` nobody joins
+  outlives teardown and wedges interpreter exit; ``time.sleep``
+  polling inside an MXL002-scoped hot path burns the latency budget
+  the scope exists to protect (wait on an Event/Condition instead).
+- **MXL010 blocking-under-lock**: ``join()``/``wait()``/``get()``
+  with no timeout while a ``with lock:`` frame is open turns one
+  slow peer into a stalled lock domain — bounded waits only under a
+  lock.
+
+The dynamic half of the same plane is
+:mod:`mxnet_tpu.analysis.witness` (MXTPU_LOCK_WITNESS=1): these rules
+prove lock discipline from source, the witness proves the orders a
+real run actually took (docs/static_analysis.md "Reading a lockgraph
+artifact").
+"""
+from __future__ import annotations
+
+import ast
+
+from ..lint import Finding, Rule
+from . import dotted_name, keyword_value
+from .host_sync import _hot_scope
+
+# constructor spellings that create a lock-like primitive; matching is
+# on the LAST dotted segment so `threading.Lock`, `_threading.RLock`
+# and the witness re-exports all register
+_LOCK_KINDS = {"Lock": "Lock", "RLock": "RLock", "Condition": "Condition"}
+
+# method names too generic to resolve across classes (they collide
+# with the threading primitives themselves and with container APIs)
+_UNRESOLVABLE = {
+    "acquire", "release", "wait", "wait_for", "notify", "notify_all",
+    "locked", "join", "get", "put", "start", "run", "close", "stop",
+    "set", "clear", "is_set", "append", "pop", "add", "update",
+    "__init__", "__enter__", "__exit__",
+}
+
+# receiver-name heuristic for MXL010: a with-item that *looks* like a
+# lock even when its constructor is out of view (passed in, built by a
+# factory). Last dotted segment, lowercased.
+_LOCKISH = ("lock", "mutex", "cond", "_cv")
+
+
+def _ctor_kind(node):
+    """'Lock'/'RLock'/'Condition' when ``node`` is a call of a lock
+    constructor (top-level call only — the Lock() INSIDE
+    Condition(Lock()) is the condition's internal lock, not a second
+    primitive)."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted_name(node.func)
+    if not name:
+        return None
+    return _LOCK_KINDS.get(name.rsplit(".", 1)[-1])
+
+
+def _lockish_name(expr):
+    """True when a with-item expression is named like a lock."""
+    name = dotted_name(expr)
+    if not name:
+        return False
+    last = name.rsplit(".", 1)[-1].lower()
+    return any(tok in last for tok in _LOCKISH)
+
+
+class _ModuleLocks:
+    """Per-module lock model shared by the four rules: which
+    attributes/globals hold lock primitives, read from one AST walk."""
+
+    def __init__(self, path, tree):
+        self.path = path
+        # {class name: {attr: kind}}
+        self.class_locks = {}
+        # {module-global name: kind}
+        self.global_locks = {}
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                kind = _ctor_kind(node.value)
+                if kind:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.global_locks[tgt.id] = kind
+            if not isinstance(node, ast.ClassDef):
+                continue
+            attrs = {}
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                kind = _ctor_kind(sub.value)
+                if not kind:
+                    continue
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Attribute) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == "self":
+                        attrs[tgt.attr] = kind
+            if attrs:
+                self.class_locks[node.name] = attrs
+
+    def attr_kind(self, attr):
+        """Kind of ``attr`` when exactly one class in THIS module
+        registers it (module-local unique-attr resolution)."""
+        kinds = {c: a[attr] for c, a in self.class_locks.items()
+                 if attr in a}
+        if len(kinds) == 1:
+            return next(iter(kinds.items()))   # (class, kind)
+        return None
+
+
+def _functions(tree):
+    """(class_name_or_None, funcdef) for every def in a module, with
+    the enclosing class resolved one level (methods of nested classes
+    report the innermost class)."""
+    out = []
+
+    def walk(node, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append((cls, child))
+                walk(child, cls)
+            else:
+                walk(child, cls)
+
+    walk(tree, None)
+    return out
+
+
+class LockOrderRule(Rule):
+    """MXL007 — whole-repo lock acquisition graph must be acyclic."""
+
+    code = "MXL007"
+    name = "lock-order"
+    description = ("lock acquisition order must be globally consistent: "
+                   "a cycle in the with-nesting + call-resolution graph "
+                   "is a reachable deadlock")
+
+    def __init__(self):
+        # token -> kind, token forms:
+        #   ("cls", class, attr)  ("mod", path, name)  ("attr", attr)
+        self._kinds = {}
+        # {attr: set(classes registering it)} for cross-module resolution
+        self._attr_owners = {}
+        # [(src_token, dst_token, path, lineno, col, source)]
+        self._direct = []
+        # [(held_tokens, kind, key, path, lineno, col, source)]
+        #   kind "self": key=(class, method); "name": key=func name;
+        #   "method": key=method name (resolved if globally unique)
+        self._calls = []
+        # {(path, class, method): set(tokens acquired directly inside)}
+        self._summaries = {}
+
+    # -- per-module collection ------------------------------------------
+    def check_module(self, path, tree, lines):
+        model = _ModuleLocks(path, tree)
+        for cls, attrs in model.class_locks.items():
+            for attr in attrs:
+                self._kinds[("cls", cls, attr)] = attrs[attr]
+                self._attr_owners.setdefault(attr, set()).add(cls)
+        for name, kind in model.global_locks.items():
+            self._kinds[("mod", path, name)] = kind
+        for cls, fn in _functions(tree):
+            self._scan_function(path, model, cls, fn, lines)
+        return ()
+
+    def _token(self, model, cls, expr):
+        """Lock token of a with-item expression, else None."""
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+                    and cls and expr.attr in model.class_locks.get(cls, {}):
+                return ("cls", cls, expr.attr)
+            hit = model.attr_kind(expr.attr)
+            if hit:
+                return ("cls", hit[0], expr.attr)
+            # defer to the whole-repo attr registry at finalize
+            return ("attr", expr.attr)
+        if isinstance(expr, ast.Name):
+            if expr.id in model.global_locks:
+                return ("mod", model.path, expr.id)
+        return None
+
+    def _scan_function(self, path, model, cls, fn, lines):
+        acquired = set()
+        calls = []
+
+        def src(node):
+            ln = getattr(node, "lineno", 1)
+            return (path, ln, getattr(node, "col_offset", 0),
+                    lines[ln - 1].strip() if 0 < ln <= len(lines) else "")
+
+        def walk(node, held):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue   # nested defs scanned on their own
+                if isinstance(child, ast.With):
+                    inner = list(held)
+                    for item in child.items:
+                        tok = self._token(model, cls, item.context_expr)
+                        if tok is None:
+                            continue
+                        acquired.add(tok)
+                        for h in inner:
+                            self._direct.append(
+                                (h, tok) + src(item.context_expr))
+                        inner.append(tok)
+                    walk(child, inner)
+                    continue
+                if isinstance(child, ast.Call) and held:
+                    func = child.func
+                    if isinstance(func, ast.Attribute) and \
+                            func.attr not in _UNRESOLVABLE:
+                        if isinstance(func.value, ast.Name) and \
+                                func.value.id == "self" and cls:
+                            calls.append((tuple(held), "self",
+                                          (cls, func.attr)) + src(child))
+                        else:
+                            calls.append((tuple(held), "method",
+                                          func.attr) + src(child))
+                    elif isinstance(func, ast.Name) and \
+                            func.id not in _UNRESOLVABLE:
+                        calls.append((tuple(held), "name",
+                                      func.id) + src(child))
+                walk(child, held)
+
+        walk(fn, [])
+        self._summaries[(path, cls, fn.name)] = acquired
+        self._calls.extend(calls)
+
+    # -- whole-repo graph -----------------------------------------------
+    def _resolve(self, token):
+        """Collapse deferred ("attr", X) tokens against the whole-repo
+        registry; None when ambiguous or unknown."""
+        if token[0] != "attr":
+            return token if token in self._kinds else token
+        owners = self._attr_owners.get(token[1], set())
+        if len(owners) == 1:
+            return ("cls", next(iter(owners)), token[1])
+        return None
+
+    @staticmethod
+    def _label(token):
+        if token[0] == "cls":
+            return "%s.%s" % (token[1], token[2])
+        return "%s:%s" % (token[1], token[2])
+
+    def finalize(self):
+        # method-name -> [(path, cls, method)] for unique resolution
+        by_method = {}
+        for (path, cls, name), toks in self._summaries.items():
+            if toks and cls is not None:
+                by_method.setdefault(name, []).append((path, cls, name))
+        by_func = {}
+        for (path, cls, name), toks in self._summaries.items():
+            if toks and cls is None:
+                by_func.setdefault((path, name), []).append(
+                    (path, cls, name))
+
+        edges = {}   # (src_label, dst_label) -> (path, ln, col, source)
+
+        def add_edge(src_tok, dst_tok, site):
+            src = self._resolve(src_tok)
+            dst = self._resolve(dst_tok)
+            if src is None or dst is None:
+                return
+            if src == dst:
+                # re-entry of the same primitive: legal for RLock (and
+                # a Condition's default internal RLock), a one-thread
+                # deadlock for a plain Lock
+                kind = self._kinds.get(src)
+                if kind == "Lock":
+                    key = (self._label(src), self._label(dst))
+                    edges.setdefault(("SELF",) + key, site)
+                return
+            key = (self._label(src), self._label(dst))
+            edges.setdefault(key, site)
+
+        for src_tok, dst_tok, path, ln, col, source in self._direct:
+            add_edge(src_tok, dst_tok, (path, ln, col, source))
+        for held, kind, key, path, ln, col, source in self._calls:
+            if kind == "self":
+                targets = [(p, c, m) for (p, c, m) in self._summaries
+                           if c == key[0] and m == key[1]]
+            elif kind == "method":
+                targets = by_method.get(key, [])
+                if len(targets) != 1:
+                    targets = []
+            else:
+                targets = by_func.get((path, key), [])
+            for tgt in targets:
+                for dst_tok in self._summaries.get(tgt, ()):
+                    for h in held:
+                        add_edge(h, dst_tok,
+                                 (path, ln, col, source))
+
+        findings = []
+        for key, (path, ln, col, source) in sorted(edges.items()):
+            if key[0] == "SELF":
+                findings.append(Finding(
+                    self.code, path, ln, col,
+                    "non-reentrant Lock %s re-acquired while already "
+                    "held by this thread (self-deadlock; use an RLock "
+                    "or hoist the inner acquisition)" % key[1], source))
+        graph = {}
+        for key in edges:
+            if key[0] == "SELF":
+                continue
+            graph.setdefault(key[0], set()).add(key[1])
+        for cycle in _find_cycles(graph):
+            legs = []
+            for i, node in enumerate(cycle):
+                nxt = cycle[(i + 1) % len(cycle)]
+                site = edges.get((node, nxt))
+                legs.append("%s -> %s (%s:%d)"
+                            % (node, nxt, site[0], site[1]))
+            anchor = edges[(cycle[0], cycle[1])]
+            findings.append(Finding(
+                self.code, anchor[0], anchor[1], anchor[2],
+                "lock-order cycle — two threads taking these paths "
+                "deadlock: " + "; ".join(legs) + " (pick one global "
+                "order and release before crossing it)", anchor[3]))
+        return findings
+
+
+def _find_cycles(graph):
+    """One representative cycle per nontrivial strongly-connected
+    component of ``{node: set(successors)}`` — iterative Tarjan for the
+    SCCs (sound: a cycle exists iff some SCC has >1 node, given
+    self-loops are filtered upstream), then the shortest cycle through
+    each SCC's smallest node via BFS. Deterministic output order."""
+    index, low, on_stack, stack = {}, {}, set(), []
+    counter = [0]
+    sccs = []
+    for root in sorted(graph):
+        if root in index:
+            continue
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        work = [(root, iter(sorted(graph.get(root, ()))))]
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(graph.get(nxt, ())))))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if not advanced:
+                work.pop()
+                if work:
+                    low[work[-1][0]] = min(low[work[-1][0]], low[node])
+                if low[node] == index[node]:
+                    comp = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        comp.append(w)
+                        if w == node:
+                            break
+                    if len(comp) > 1:
+                        sccs.append(sorted(comp))
+    cycles = []
+    for comp in sccs:
+        compset = set(comp)
+        start = comp[0]
+        prev = {start: None}
+        queue = [start]
+        found = None
+        while queue and found is None:
+            node = queue.pop(0)
+            for nxt in sorted(graph.get(node, ())):
+                if nxt == start:
+                    found = node
+                    break
+                if nxt in compset and nxt not in prev:
+                    prev[nxt] = node
+                    queue.append(nxt)
+        path = [found]
+        while path[-1] != start:
+            path.append(prev[path[-1]])
+        cycles.append(tuple(reversed(path)))
+    return sorted(cycles)
+
+
+class CondvarDisciplineRule(Rule):
+    """MXL008 — Condition.wait in a while loop; notify under the lock."""
+
+    code = "MXL008"
+    name = "condvar-discipline"
+    description = ("Condition.wait() belongs inside a while-predicate "
+                   "loop; notify/notify_all must run with the "
+                   "condition's lock held")
+
+    def check_module(self, path, tree, lines):
+        model = _ModuleLocks(path, tree)
+
+        def is_condition(expr, cls):
+            """The receiver of .wait/.notify when it is a known
+            Condition (self attr, unique module attr, global)."""
+            if isinstance(expr, ast.Attribute):
+                if isinstance(expr.value, ast.Name) and \
+                        expr.value.id == "self" and cls:
+                    return model.class_locks.get(cls, {}).get(
+                        expr.attr) == "Condition"
+                hit = model.attr_kind(expr.attr)
+                return bool(hit and hit[1] == "Condition")
+            if isinstance(expr, ast.Name):
+                return model.global_locks.get(expr.id) == "Condition"
+            return False
+
+        for cls, fn in _functions(tree):
+            yield from self._scan(path, model, cls, fn, lines,
+                                  is_condition)
+
+    def _scan(self, path, model, cls, fn, lines, is_condition):
+        findings = []
+
+        def walk(node, in_while, with_names):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue
+                child_in_while = in_while or isinstance(child, ast.While)
+                child_withs = with_names
+                if isinstance(child, ast.With):
+                    child_withs = with_names | {
+                        dotted_name(item.context_expr)
+                        for item in child.items}
+                if isinstance(child, ast.Call) and \
+                        isinstance(child.func, ast.Attribute):
+                    recv = child.func.value
+                    attr = child.func.attr
+                    if attr == "wait" and is_condition(recv, cls) \
+                            and not in_while:
+                        findings.append(self.finding(
+                            path, child,
+                            "Condition.wait() outside a while-predicate "
+                            "loop — a missed or spurious wakeup leaves "
+                            "this thread running on a stale predicate "
+                            "(wrap it: `while not pred: cv.wait()`, or "
+                            "use wait_for)", lines))
+                    if attr in ("notify", "notify_all") and \
+                            is_condition(recv, cls) and \
+                            dotted_name(recv) not in with_names:
+                        findings.append(self.finding(
+                            path, child,
+                            "%s() without the condition's lock held — "
+                            "the wakeup races the predicate write it "
+                            "signals (call it inside `with %s:`)"
+                            % (attr, dotted_name(recv) or "cond"),
+                            lines))
+                walk(child, child_in_while, child_withs)
+
+        walk(fn, False, frozenset())
+        return findings
+
+
+class ThreadHygieneRule(Rule):
+    """MXL009 — daemon-or-joined threads; no sleep-polling hot paths."""
+
+    code = "MXL009"
+    name = "thread-hygiene"
+    description = ("every Thread is daemon or provably joined; no "
+                   "time.sleep polling inside MXL002-scoped hot paths")
+
+    def check_module(self, path, tree, lines):
+        yield from self._check_threads(path, tree, lines)
+        yield from self._check_sleep(path, tree, lines)
+
+    # -- non-daemon unjoined threads ------------------------------------
+    def _check_threads(self, path, tree, lines):
+        # class-level view: a thread stored on self may be joined (or
+        # daemonized) from ANY method of the class
+        for cls, fn in _functions(tree):
+            scope_src = self._class_source(tree, cls) if cls else None
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if not name or name.rsplit(".", 1)[-1] != "Thread":
+                    continue
+                daemon = keyword_value(node, "daemon")
+                if isinstance(daemon, ast.Constant) and daemon.value:
+                    continue
+                if self._escapes_cleanly(node, fn, scope_src):
+                    continue
+                yield self.finding(
+                    path, node,
+                    "non-daemon Thread is never joined — it outlives "
+                    "teardown and wedges interpreter exit (pass "
+                    "daemon=True, or join it with a timeout on the "
+                    "shutdown path)", lines)
+
+    @staticmethod
+    def _class_source(tree, cls):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and node.name == cls:
+                return node
+        return None
+
+    @staticmethod
+    def _escapes_cleanly(ctor, fn, scope):
+        """True when the constructed thread is daemonized or joined
+        somewhere in scope: `t.daemon = True`, `t.join(...)` on the
+        assignment target (function scope for locals, class scope for
+        self attrs)."""
+        target = None
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and node.value is ctor:
+                tgt = node.targets[0]
+                target = dotted_name(tgt)
+        if not target:
+            # the thread went into a container (list comprehension,
+            # .append(...)) — name tracking ends there, so accept any
+            # join in the same function (the `for t in ts: t.join()`
+            # harness idiom) or, for methods, anywhere in the class
+            for sc in [fn] + ([scope] if scope is not None else []):
+                for node in ast.walk(sc):
+                    if isinstance(node, ast.Call) and \
+                            isinstance(node.func, ast.Attribute) and \
+                            node.func.attr == "join" and not \
+                            isinstance(node.func.value, ast.Constant):
+                        return True   # "sep".join() is not a thread join
+            return False
+        search = [fn] + ([scope] if scope and target.startswith("self.")
+                         else [])
+        for sc in search:
+            for node in ast.walk(sc):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "join" and \
+                        dotted_name(node.func.value) == target:
+                    return True
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Attribute) and \
+                                tgt.attr == "daemon" and \
+                                dotted_name(tgt.value) == target and \
+                                isinstance(node.value, ast.Constant) and \
+                                node.value.value:
+                            return True
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "setDaemon" and \
+                        dotted_name(node.func.value) == target:
+                    return True
+        return False
+
+    # -- sleep-polling in hot paths --------------------------------------
+    def _check_sleep(self, path, tree, lines):
+        methods, _ = _hot_scope(path)
+        if methods is None:
+            return
+        for scope in ast.walk(tree):
+            if not isinstance(scope, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                continue
+            if scope.name not in methods:
+                continue
+            for node in ast.walk(scope):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if not name:
+                    continue
+                parts = name.rsplit(".", 1)
+                is_sleep = (parts[-1] == "sleep"
+                            and (len(parts) == 1
+                                 or "time" in parts[0].lower()))
+                if is_sleep:
+                    yield self.finding(
+                        path, node,
+                        "time.sleep polling inside hot path %r — every "
+                        "tick burns the latency budget MXL002 protects "
+                        "here; wait on an Event/Condition with a "
+                        "timeout instead" % scope.name, lines)
+
+
+class BlockingUnderLockRule(Rule):
+    """MXL010 — only bounded waits while a lock frame is open."""
+
+    code = "MXL010"
+    name = "blocking-under-lock"
+    description = ("join()/wait()/get() without a timeout inside a "
+                   "`with lock:` frame stalls the whole lock domain "
+                   "behind one slow peer")
+
+    _BLOCKERS = ("join", "wait", "get")
+
+    def check_module(self, path, tree, lines):
+        model = _ModuleLocks(path, tree)
+        for cls, fn in _functions(tree):
+            yield from self._scan(path, model, cls, fn, lines)
+
+    def _is_lock(self, model, cls, expr):
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and \
+                    expr.value.id == "self" and cls and \
+                    expr.attr in model.class_locks.get(cls, {}):
+                return True
+            if model.attr_kind(expr.attr):
+                return True
+        if isinstance(expr, ast.Name) and \
+                expr.id in model.global_locks:
+            return True
+        return _lockish_name(expr)
+
+    def _scan(self, path, model, cls, fn, lines):
+        findings = []
+
+        def walk(node, held_names):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue
+                names = held_names
+                if isinstance(child, ast.With):
+                    extra = {dotted_name(item.context_expr)
+                             for item in child.items
+                             if self._is_lock(model, cls,
+                                              item.context_expr)}
+                    extra.discard("")
+                    if extra:
+                        names = held_names | extra
+                if isinstance(child, ast.Call) and held_names and \
+                        isinstance(child.func, ast.Attribute) and \
+                        child.func.attr in self._BLOCKERS:
+                    recv = dotted_name(child.func.value)
+                    unbounded = (not child.args
+                                 and keyword_value(child, "timeout")
+                                 is None
+                                 and keyword_value(child, "block")
+                                 is None)
+                    # Condition.wait on a HELD condition releases it —
+                    # that is the condvar protocol, not a stall
+                    if unbounded and recv not in held_names:
+                        findings.append(self.finding(
+                            path, child,
+                            "blocking %s() with no timeout while "
+                            "holding %s — one slow peer stalls every "
+                            "thread behind this lock (bound the wait, "
+                            "or release before blocking)"
+                            % (child.func.attr,
+                               "/".join(sorted(held_names))), lines))
+                walk(child, names)
+
+        walk(fn, frozenset())
+        return findings

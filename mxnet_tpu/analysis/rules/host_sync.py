@@ -172,6 +172,15 @@ _SCOPES = (
       "_scoop", "depth", "pending_rows", "_reply", "_observe_rate",
       "estimate_latency_s", "pad_batch", "pick_bucket",
       "submit_generate"}, set()),
+    # the lock witness recorder runs inside EVERY instrumented lock
+    # acquisition across serving/cluster — a device sync (or sleep,
+    # via MXL009) here would multiply into every critical section it
+    # observes, invalidating the <5% overhead bound the tier-1 suite
+    # enforces
+    ("mxnet_tpu/analysis/witness.py",
+     {"record_acquire", "record_release", "record_wait", "acquire",
+      "release", "wait", "wait_for", "notify", "notify_all",
+      "register", "held"}, set()),
 )
 
 # calls that block on (or copy from) the device stream

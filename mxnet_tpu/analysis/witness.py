@@ -1,0 +1,445 @@
+"""Dynamic lock-order witness — the runtime half of the concurrency
+plane (the static half is :mod:`mxnet_tpu.analysis.rules.concurrency`,
+MXL007–MXL010).
+
+``MXTPU_LOCK_WITNESS=1`` patches the framework's own lock constructors
+(``threading.Lock``/``RLock``/``Condition`` *as called from mxnet_tpu
+modules* — foreign callers still get the raw primitives) with
+instrumented wrappers that record, per thread:
+
+- **acquisition edges**: every lock held when another is taken adds a
+  ``held -> taken`` edge to a process-global graph, keyed by the
+  locks' construction sites (``kind@file:line`` — the lockdep move:
+  instances of one class's lock collapse onto one node);
+- **held-at-wait sets**: locks still held when ``Condition.wait``
+  runs (other than the condition itself, which the wait releases) —
+  each is a stall hazard, and an *untimed* wait while holding one is
+  recorded as a blocking-under-lock event;
+- coverage: every witnessed lock with its acquisition count.
+
+At teardown (atexit, or an explicit :func:`dump`) the graph is cycle-
+checked and written as a ranked JSON artifact — the committed
+cycle-free run lives at ``docs/artifacts/lockgraph_<date>.json``,
+rendered by ``tools/mxlint.py --locks`` and regression-gated by
+``tools/perf_gate.py --locks`` (new cycle, new blocking-under-lock
+edge, or dropped coverage vs last-good = regression). See
+docs/static_analysis.md "Reading a lockgraph artifact".
+
+The recorder itself is hot-path code (it runs inside every serving/
+cluster lock acquisition): pure dict bookkeeping under one raw mutex,
+no device syncs ever (MXL002 scopes these methods), no frame walks
+except once per *new* edge/lock. Overhead is bounded tier-1 at <5% of
+an instrumented serving smoke (tests/test_concurrency_lint.py).
+
+In-process use (tests, drivers)::
+
+    from mxnet_tpu.analysis import witness
+    a, b = witness.Lock(label="A"), witness.Lock(label="B")
+    with a:
+        with b:
+            pass
+    witness.report()["edges"]   # [{"src": "A", "dst": "B", ...}]
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import sys
+import threading
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+# the raw primitives, captured at import so install() can patch and
+# uninstall() can restore without ever wrapping a wrapper
+_RAW_LOCK = threading.Lock
+_RAW_RLOCK = threading.RLock
+_RAW_CONDITION = threading.Condition
+
+_DEFAULT_PATH = "lockgraph.json"
+
+
+def _site(depth):
+    """`file:line` of the caller ``depth`` frames up, repo-relative —
+    the stable lock identity (all instances built at one site collapse
+    onto one graph node)."""
+    try:
+        frame = sys._getframe(depth)
+    except ValueError:
+        return "unknown:0"
+    fname = frame.f_code.co_filename
+    try:
+        rel = os.path.relpath(fname, _REPO_ROOT)
+    except ValueError:
+        rel = os.path.basename(fname)
+    if rel.startswith(".."):
+        rel = os.path.basename(fname)
+    return "%s:%d" % (rel.replace(os.sep, "/"), frame.f_lineno)
+
+
+def _acquire_site():
+    """First stack frame outside this module — walked only when a NEW
+    edge/hazard key is minted, never on the per-acquisition fast path."""
+    here = __file__
+    depth = 2
+    while True:
+        try:
+            frame = sys._getframe(depth)
+        except ValueError:
+            return "unknown:0"
+        if frame.f_code.co_filename != here:
+            fname = frame.f_code.co_filename
+            try:
+                rel = os.path.relpath(fname, _REPO_ROOT)
+            except ValueError:
+                rel = os.path.basename(fname)
+            if rel.startswith(".."):
+                rel = os.path.basename(fname)
+            return "%s:%d" % (rel.replace(os.sep, "/"), frame.f_lineno)
+        depth += 1
+
+
+class _State:
+    """Process-global witness books. All mutation under one RAW lock —
+    the recorder must never recurse into itself."""
+
+    def __init__(self):
+        self._mu = _RAW_LOCK()
+        self._tls = threading.local()
+        self.locks = {}           # name -> {"kind", "acquisitions"}
+        self.edges = {}           # (src, dst) -> {"count", threads, site}
+        self.wait_hazards = {}    # (cond, held) -> {"count", site}
+        self.blocking = {}        # (held, site) -> {"count", "op"}
+
+    # -- per-thread held stack (identity-based; names can collide) ------
+    def held(self):
+        h = getattr(self._tls, "held", None)
+        if h is None:
+            h = self._tls.held = []
+        return h
+
+    def register(self, name, kind):
+        with self._mu:
+            self.locks.setdefault(
+                name, {"kind": kind, "acquisitions": 0})
+
+    def record_acquire(self, obj):
+        held = self.held()
+        reentrant = any(h is obj for h in held)
+        with self._mu:
+            self.locks[obj.name]["acquisitions"] += 1
+            if not reentrant:
+                tname = threading.current_thread().name
+                for h in held:
+                    if h.name == obj.name:
+                        continue   # sibling instance of the same site
+                    key = (h.name, obj.name)
+                    e = self.edges.get(key)
+                    if e is None:
+                        self.edges[key] = {"count": 1,
+                                           "threads": {tname},
+                                           "site": _acquire_site()}
+                    else:
+                        e["count"] += 1
+                        e["threads"].add(tname)
+        held.append(obj)
+
+    def record_release(self, obj):
+        held = self.held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is obj:
+                del held[i]
+                break
+
+    def record_wait(self, cond, timeout):
+        others = [h for h in self.held() if h is not cond]
+        if not others:
+            return
+        with self._mu:
+            for h in others:
+                if h.name == cond.name:
+                    continue
+                key = (cond.name, h.name)
+                e = self.wait_hazards.get(key)
+                if e is None:
+                    self.wait_hazards[key] = {"count": 1,
+                                              "site": _acquire_site()}
+                else:
+                    e["count"] += 1
+                if timeout is None:
+                    bkey = (h.name, self.wait_hazards[key]["site"])
+                    b = self.blocking.get(bkey)
+                    if b is None:
+                        self.blocking[bkey] = {"count": 1,
+                                               "op": "Condition.wait"}
+                    else:
+                        b["count"] += 1
+
+
+_STATE = _State()
+_INSTALLED = False
+_DUMP_REGISTERED = False
+_T0 = None   # monotonic at first install(); artifact wall_s baseline
+
+
+# -- instrumented primitives -------------------------------------------------
+
+class _WitnessLockBase:
+    """Shared acquire/release recording over a raw primitive."""
+
+    kind = "Lock"
+
+    def __init__(self, name=None):
+        self.name = name or ("%s@%s" % (self.kind, _site(3)))
+        self._raw = self._make_raw()
+        _STATE.register(self.name, self.kind)
+
+    def _make_raw(self):
+        return _RAW_LOCK()
+
+    def acquire(self, blocking=True, timeout=-1):
+        ok = self._raw.acquire(blocking, timeout)
+        if ok:
+            _STATE.record_acquire(self)
+        return ok
+
+    def release(self):
+        _STATE.record_release(self)
+        self._raw.release()
+
+    def locked(self):
+        return self._raw.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return "<witness %s %s>" % (self.kind, self.name)
+
+
+class WitnessLock(_WitnessLockBase):
+    kind = "Lock"
+
+
+class WitnessRLock(_WitnessLockBase):
+    kind = "RLock"
+
+    def _make_raw(self):
+        return _RAW_RLOCK()
+
+    def locked(self):   # RLock has no locked() pre-3.12; best effort
+        raw = self._raw
+        return getattr(raw, "_is_owned", lambda: False)()
+
+
+class WitnessCondition(_WitnessLockBase):
+    """A Condition whose lock acquisitions, waits and notifies are all
+    recorded under the condition's own node (the inner lock is raw —
+    the wrapper IS the instrumentation boundary)."""
+
+    kind = "Condition"
+
+    def __init__(self, lock=None, name=None):
+        inner = lock
+        if isinstance(inner, _WitnessLockBase):
+            inner = inner._raw    # don't double-count the inner lock
+        self.name = name or ("%s@%s" % (self.kind, _site(2)))
+        self._raw = _RAW_CONDITION(inner) if inner is not None \
+            else _RAW_CONDITION()
+        _STATE.register(self.name, self.kind)
+
+    def wait(self, timeout=None):
+        _STATE.record_wait(self, timeout)
+        return self._raw.wait(timeout)
+
+    def wait_for(self, predicate, timeout=None):
+        _STATE.record_wait(self, timeout)
+        return self._raw.wait_for(predicate, timeout)
+
+    def notify(self, n=1):
+        self._raw.notify(n)
+
+    def notify_all(self):
+        self._raw.notify_all()
+
+    def locked(self):
+        return False
+
+
+# -- explicit constructors (tests, drivers) ----------------------------------
+
+def Lock(label=None):
+    """An always-instrumented Lock; ``label`` overrides the
+    construction-site name (fixtures want stable names)."""
+    return WitnessLock(name=label)
+
+
+def RLock(label=None):
+    return WitnessRLock(name=label)
+
+
+def Condition(lock=None, label=None):
+    return WitnessCondition(lock, name=label)
+
+
+# -- constructor patching (MXTPU_LOCK_WITNESS=1) -----------------------------
+
+def _framework_caller():
+    """True when the frame calling a patched constructor lives inside
+    the mxnet_tpu package — only the framework's own locks are
+    witnessed; library/user code gets the raw primitive."""
+    frame = sys._getframe(2)
+    fname = frame.f_code.co_filename.replace(os.sep, "/")
+    return "/mxnet_tpu/" in fname or fname.endswith("/mxnet_tpu")
+
+
+def _patched_lock():
+    if _framework_caller():
+        return WitnessLock(name="Lock@" + _site(2))
+    return _RAW_LOCK()
+
+
+def _patched_rlock():
+    if _framework_caller():
+        return WitnessRLock(name="RLock@" + _site(2))
+    return _RAW_RLOCK()
+
+
+def _patched_condition(lock=None):
+    if _framework_caller():
+        return WitnessCondition(lock, name="Condition@" + _site(2))
+    return _RAW_CONDITION(lock)
+
+
+def install(register_dump=True):
+    """Patch the lock constructors framework modules resolve through
+    ``threading.*`` and (by default) arm the atexit artifact dump.
+    Idempotent; :func:`uninstall` restores the raw constructors."""
+    global _INSTALLED, _DUMP_REGISTERED, _T0
+    if _INSTALLED:
+        return
+    if _T0 is None:
+        import time
+        _T0 = time.monotonic()
+    threading.Lock = _patched_lock
+    threading.RLock = _patched_rlock
+    threading.Condition = _patched_condition
+    _INSTALLED = True
+    if register_dump and not _DUMP_REGISTERED:
+        atexit.register(_atexit_dump)
+        _DUMP_REGISTERED = True
+
+
+def uninstall():
+    global _INSTALLED
+    if not _INSTALLED:
+        return
+    threading.Lock = _RAW_LOCK
+    threading.RLock = _RAW_RLOCK
+    threading.Condition = _RAW_CONDITION
+    _INSTALLED = False
+
+
+def installed():
+    return _INSTALLED
+
+
+def reset():
+    """Drop all recorded state (test isolation)."""
+    global _STATE
+    _STATE = _State()
+
+
+def enabled():
+    from ..base import get_env
+    return get_env("MXTPU_LOCK_WITNESS", "0") not in (
+        "0", "", "false", "off")
+
+
+# -- the artifact ------------------------------------------------------------
+
+def find_cycles(edge_keys):
+    """Representative cycles of an edge list/set of (src, dst) pairs —
+    same Tarjan+BFS detector the static rule uses."""
+    from .rules.concurrency import _find_cycles
+    graph = {}
+    for src, dst in edge_keys:
+        if src != dst:
+            graph.setdefault(src, set()).add(dst)
+    return [list(c) for c in _find_cycles(graph)]
+
+
+def _suites():
+    """Test-file basenames on this process's argv — how a pytest run
+    over N suites labels the artifact it produced."""
+    out = []
+    for a in sys.argv:
+        base = os.path.basename(a.split("::")[0])
+        if base.endswith(".py") and "test" in base and base not in out:
+            out.append(base)
+    return out
+
+
+def report(suites=None):
+    """The ranked witness artifact as a dict (edges by count desc)."""
+    with _STATE._mu:
+        locks = {n: dict(v) for n, v in _STATE.locks.items()}
+        edges = [
+            {"src": s, "dst": d, "count": e["count"],
+             "threads": sorted(e["threads"]), "site": e["site"]}
+            for (s, d), e in _STATE.edges.items()]
+        hazards = [
+            {"cond": c, "held": h, "count": e["count"],
+             "site": e["site"]}
+            for (c, h), e in _STATE.wait_hazards.items()]
+        blocking = [
+            {"held": h, "site": s, "count": e["count"], "op": e["op"]}
+            for (h, s), e in _STATE.blocking.items()]
+    edges.sort(key=lambda e: (-e["count"], e["src"], e["dst"]))
+    hazards.sort(key=lambda e: (-e["count"], e["cond"], e["held"]))
+    blocking.sort(key=lambda e: (-e["count"], e["held"], e["site"]))
+    if _T0 is not None:
+        import time
+        wall_s = round(time.monotonic() - _T0, 3)
+    else:
+        wall_s = None
+    return {
+        "tool": "lock_witness",
+        "version": 1,
+        "wall_s": wall_s,
+        "suites": suites if suites is not None else _suites(),
+        "locks": dict(sorted(locks.items())),
+        "edges": edges,
+        "cycles": find_cycles([(e["src"], e["dst"]) for e in edges]),
+        "wait_hazards": hazards,
+        "blocking_under_lock": blocking,
+    }
+
+
+def dump(path=None, suites=None):
+    """Write the artifact; returns the report dict. Default path from
+    MXTPU_LOCK_WITNESS_PATH (else ./lockgraph.json)."""
+    if path is None:
+        path = os.environ.get("MXTPU_LOCK_WITNESS_PATH") \
+            or _DEFAULT_PATH
+    doc = report(suites=suites)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=False)
+        f.write("\n")
+    return doc
+
+
+def _atexit_dump():
+    doc = dump()
+    if doc["cycles"]:
+        sys.stderr.write(
+            "lock witness: %d CYCLE(S) in the acquisition graph — "
+            "see %s\n" % (len(doc["cycles"]),
+                          os.environ.get("MXTPU_LOCK_WITNESS_PATH")
+                          or _DEFAULT_PATH))

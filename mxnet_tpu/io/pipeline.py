@@ -357,6 +357,10 @@ class ShardedRecordPipeline(DataIter):
                     f"state={state}, worker produced "
                     f"{int(h[_pw.H_PRODUCED])} batches since spawn, "
                     f"last heartbeat {hb_age:.1f}s ago)")
+            # cross-PROCESS ring wait: the producer is another process
+            # writing shared memory — there is no in-process primitive
+            # to block on, so this is a deadline-bounded poll by design
+            # mxlint: disable=MXL009
             time.sleep(0.0005)
         if _tm.enabled():
             _pipe_metrics()["ring_wait"].observe(time.perf_counter() - t0)

@@ -358,6 +358,14 @@ _ENV_VARS = {
         "bounds the step-boundary quiesce wait, reshape retries, and "
         "how much of an injected reclaim_timeout borrower drain is "
         "honored (default 5000; cluster/lending.py)"),
+    "MXTPU_LOCK_WITNESS": (
+        "set to 1 to patch the framework's lock constructors with the "
+        "dynamic lock-order witness: every acquisition edge and "
+        "held-across-Condition.wait hazard is recorded and dumped as a "
+        "lockgraph artifact at exit (default 0; analysis/witness.py)"),
+    "MXTPU_LOCK_WITNESS_PATH": (
+        "where the lock witness writes its lockgraph JSON artifact at "
+        "process exit (default ./lockgraph.json; analysis/witness.py)"),
 }
 
 

@@ -837,7 +837,11 @@ class GenModel:
                 if req.recoveries > 1:
                     # bounded backoff between REPEAT rescues of one
                     # request — a request ping-ponging across dying
-                    # lanes must not busy-spin the recovery path
+                    # lanes must not busy-spin the recovery path. This
+                    # is pacing, not polling: nothing signals "retry
+                    # now", so an Event wait would just be a sleep that
+                    # wakes early (no lock is held across it)
+                    # mxlint: disable=MXL009
                     _time.sleep(min(
                         self.recovery_backoff_ms
                         * 2.0 ** (req.recoveries - 2),

@@ -1,0 +1,706 @@
+"""Tier-1 gate for the concurrency-correctness plane (ISSUE 18).
+
+Two halves of one plane, both exercised here:
+
+1. **Static** — mxlint rules MXL007 (lock-order), MXL008 (condvar
+   discipline), MXL009 (thread hygiene), MXL010 (blocking-under-lock)
+   each fire on a known-bad fixture and stay quiet on a known-good
+   one; all four run live on the whole tree through the same
+   ``tools/mxlint.py`` entry point CI uses.
+2. **Dynamic** — the ``analysis/witness.py`` lock-order witness
+   catches a deliberate two-lock deadlock *in process*, its recorder
+   stays under 5% of the instrumented suite's wall clock, the
+   committed ``docs/artifacts/lockgraph_<date>.json`` from the
+   serving+cluster+elastic run is cycle-free, ``mxlint --locks``
+   renders/judges it, and ``perf_gate --locks`` rejects every
+   synthetic regression class (injected cycle, new
+   blocking-under-lock edge, dropped suite/lock coverage, missing
+   artifact) while passing the committed pair.
+
+Plus the deflake guard: the chaos decode family runs under the
+witness and proves KVMigrator's land/replay paths never hold a KV
+pool lock across a device_put (no blocking-under-lock or
+held-across-wait event names a kvcache lock).
+"""
+import glob
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from mxnet_tpu.analysis import witness
+from mxnet_tpu.analysis.lint import run_lint
+from mxnet_tpu.analysis.rules.concurrency import (BlockingUnderLockRule,
+                                                  CondvarDisciplineRule,
+                                                  LockOrderRule,
+                                                  ThreadHygieneRule)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MXLINT = os.path.join(REPO, "tools", "mxlint.py")
+PERF_GATE = os.path.join(REPO, "tools", "perf_gate.py")
+LAST_GOOD = os.path.join(REPO, "docs", "artifacts", "LOCKS_LAST_GOOD.json")
+
+
+def _write(root, rel, text):
+    path = root / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(text))
+    return str(path)
+
+
+def _lint_file(tmp_path, rel, text, rules):
+    path = _write(tmp_path, rel, text)
+    return run_lint(str(tmp_path), rules, files=[path])
+
+
+def _codes(result):
+    return [f.code for f in result.findings]
+
+
+# ---------------------------------------------------------------------------
+# MXL007 — lock-order
+# ---------------------------------------------------------------------------
+
+def test_mxl007_cycle_across_methods(tmp_path):
+    """Two methods taking the same two locks in opposing order is the
+    canonical ABBA deadlock; the finding names both paths."""
+    res = _lint_file(tmp_path, "mxnet_tpu/srv.py", """\
+        import threading
+
+        class S:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+
+            def fwd(self):
+                with self.a:
+                    with self.b:
+                        pass
+
+            def rev(self):
+                with self.b:
+                    with self.a:
+                        pass
+        """, [LockOrderRule()])
+    assert "MXL007" in _codes(res), res.findings
+    msg = [f.message for f in res.findings if f.code == "MXL007"][0]
+    assert "cycle" in msg and "->" in msg
+
+
+def test_mxl007_cycle_through_call_resolution(tmp_path):
+    """The graph follows one level of intraprocedural calls: fwd holds
+    A and calls a helper that takes B; rev nests them the other way."""
+    res = _lint_file(tmp_path, "mxnet_tpu/srv.py", """\
+        import threading
+
+        class S:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+
+            def _tail(self):
+                with self.b:
+                    pass
+
+            def fwd(self):
+                with self.a:
+                    self._tail()
+
+            def rev(self):
+                with self.b:
+                    with self.a:
+                        pass
+        """, [LockOrderRule()])
+    assert "MXL007" in _codes(res), res.findings
+
+
+def test_mxl007_self_deadlock_plain_lock(tmp_path):
+    """Re-acquiring a non-reentrant Lock you already hold deadlocks a
+    single thread; the same nesting on an RLock is fine."""
+    res = _lint_file(tmp_path, "mxnet_tpu/srv.py", """\
+        import threading
+
+        class S:
+            def __init__(self):
+                self.a = threading.Lock()
+
+            def bad(self):
+                with self.a:
+                    with self.a:
+                        pass
+        """, [LockOrderRule()])
+    assert "MXL007" in _codes(res), res.findings
+    res = _lint_file(tmp_path, "mxnet_tpu/srv2.py", """\
+        import threading
+
+        class S:
+            def __init__(self):
+                self.a = threading.RLock()
+
+            def ok(self):
+                with self.a:
+                    with self.a:
+                        pass
+        """, [LockOrderRule()])
+    assert "MXL007" not in _codes(res), res.findings
+
+
+def test_mxl007_quiet_on_consistent_order(tmp_path):
+    res = _lint_file(tmp_path, "mxnet_tpu/srv.py", """\
+        import threading
+
+        class S:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+
+            def fwd(self):
+                with self.a:
+                    with self.b:
+                        pass
+
+            def also_fwd(self):
+                with self.a:
+                    with self.b:
+                        pass
+        """, [LockOrderRule()])
+    assert res.findings == [], res.findings
+
+
+# ---------------------------------------------------------------------------
+# MXL008 — condvar discipline
+# ---------------------------------------------------------------------------
+
+def test_mxl008_wait_outside_while(tmp_path):
+    res = _lint_file(tmp_path, "mxnet_tpu/srv.py", """\
+        import threading
+
+        class S:
+            def __init__(self):
+                self.cv = threading.Condition()
+                self.ready = False
+
+            def bad(self):
+                with self.cv:
+                    if not self.ready:
+                        self.cv.wait()
+        """, [CondvarDisciplineRule()])
+    assert "MXL008" in _codes(res), res.findings
+
+
+def test_mxl008_notify_without_lock(tmp_path):
+    res = _lint_file(tmp_path, "mxnet_tpu/srv.py", """\
+        import threading
+
+        class S:
+            def __init__(self):
+                self.cv = threading.Condition()
+
+            def bad(self):
+                self.cv.notify_all()
+        """, [CondvarDisciplineRule()])
+    assert "MXL008" in _codes(res), res.findings
+
+
+def test_mxl008_quiet_on_disciplined_use(tmp_path):
+    res = _lint_file(tmp_path, "mxnet_tpu/srv.py", """\
+        import threading
+
+        class S:
+            def __init__(self):
+                self.cv = threading.Condition()
+                self.ready = False
+
+            def consume(self):
+                with self.cv:
+                    while not self.ready:
+                        self.cv.wait()
+
+            def produce(self):
+                with self.cv:
+                    self.ready = True
+                    self.cv.notify_all()
+        """, [CondvarDisciplineRule()])
+    assert res.findings == [], res.findings
+
+
+# ---------------------------------------------------------------------------
+# MXL009 — thread hygiene
+# ---------------------------------------------------------------------------
+
+def test_mxl009_unjoined_nondaemon_thread(tmp_path):
+    res = _lint_file(tmp_path, "mxnet_tpu/srv.py", """\
+        import threading
+
+        def leak():
+            t = threading.Thread(target=print)
+            t.start()
+        """, [ThreadHygieneRule()])
+    assert "MXL009" in _codes(res), res.findings
+
+
+def test_mxl009_quiet_on_daemon_or_joined(tmp_path):
+    res = _lint_file(tmp_path, "mxnet_tpu/srv.py", """\
+        import threading
+
+        def ok_daemon():
+            t = threading.Thread(target=print, daemon=True)
+            t.start()
+
+        def ok_joined():
+            t = threading.Thread(target=print)
+            t.start()
+            t.join()
+
+        def ok_pool(conns):
+            ts = [threading.Thread(target=print) for _ in conns]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        """, [ThreadHygieneRule()])
+    assert res.findings == [], res.findings
+
+
+def test_mxl009_sleep_polling_in_hot_path(tmp_path):
+    """time.sleep inside an MXL002-scoped hot method is a poll where a
+    primitive should block."""
+    res = _lint_file(tmp_path, "mxnet_tpu/serving/gateway.py", """\
+        import time
+
+        class G:
+            def submit(self, req):
+                while not req.done:
+                    time.sleep(0.01)
+        """, [ThreadHygieneRule()])
+    assert "MXL009" in _codes(res), res.findings
+    # same code outside any hot scope is fine
+    res = _lint_file(tmp_path, "mxnet_tpu/util.py", """\
+        import time
+
+        def wait(req):
+            while not req.done:
+                time.sleep(0.01)
+        """, [ThreadHygieneRule()])
+    assert res.findings == [], res.findings
+
+
+# ---------------------------------------------------------------------------
+# MXL010 — blocking-under-lock
+# ---------------------------------------------------------------------------
+
+def test_mxl010_untimed_join_and_get_under_lock(tmp_path):
+    res = _lint_file(tmp_path, "mxnet_tpu/srv.py", """\
+        import threading
+
+        class S:
+            def __init__(self):
+                self.lock = threading.Lock()
+
+            def bad_join(self, worker):
+                with self.lock:
+                    worker.join()
+
+            def bad_get(self, q):
+                with self.lock:
+                    return q.get()
+        """, [BlockingUnderLockRule()])
+    codes = _codes(res)
+    assert codes.count("MXL010") == 2, res.findings
+
+
+def test_mxl010_quiet_on_timeouts_and_condition_protocol(tmp_path):
+    res = _lint_file(tmp_path, "mxnet_tpu/srv.py", """\
+        import threading
+
+        class S:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self.cv = threading.Condition()
+                self.ready = False
+
+            def ok_timeout(self, worker, q):
+                with self.lock:
+                    worker.join(timeout=5.0)
+                    return q.get(timeout=1.0)
+
+            def ok_condition(self):
+                with self.cv:
+                    while not self.ready:
+                        self.cv.wait()
+        """, [BlockingUnderLockRule()])
+    assert res.findings == [], res.findings
+
+
+# ---------------------------------------------------------------------------
+# the real tree + CLI contract
+# ---------------------------------------------------------------------------
+
+def test_repo_is_concurrency_clean_via_cli():
+    """MXL007–010 run live on HEAD through the real entry point and
+    the tree is clean (suppressions carry written justifications)."""
+    proc = subprocess.run([sys.executable, MXLINT], cwd=REPO,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stdout
+
+
+def test_cli_fails_on_concurrency_bad_tree(tmp_path):
+    """All four new codes fire through the CLI on one synthetic tree."""
+    _write(tmp_path, "mxnet_tpu/deadlock.py", """\
+        import threading
+
+        class S:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+                self.cv = threading.Condition()
+                self.ready = False
+
+            def fwd(self):
+                with self.a:
+                    with self.b:
+                        pass
+
+            def rev(self):
+                with self.b:
+                    with self.a:
+                        pass
+
+            def racy_wait(self):
+                with self.cv:
+                    if not self.ready:
+                        self.cv.wait()
+
+            def leak(self):
+                t = threading.Thread(target=print)
+                t.start()
+
+            def stall(self, worker):
+                with self.a:
+                    worker.join()
+        """)
+    proc = subprocess.run(
+        [sys.executable, MXLINT, "--root", str(tmp_path),
+         "--baseline", str(tmp_path / "nonexistent.json")],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    for code in ("MXL007", "MXL008", "MXL009", "MXL010"):
+        assert code in proc.stdout, f"{code} missing:\n{proc.stdout}"
+
+
+# ---------------------------------------------------------------------------
+# dynamic half — the witness
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def clean_witness():
+    witness.reset()
+    yield witness
+    witness.uninstall()
+    witness.reset()
+
+
+def test_witness_catches_two_lock_deadlock(clean_witness):
+    """The ABBA pattern, taken sequentially by two real threads (so
+    the test itself cannot hang), shows up as a cycle in the witness
+    graph — the dynamic twin of the MXL007 fixture."""
+    a = witness.Lock(label="A")
+    b = witness.Lock(label="B")
+
+    def fwd():
+        with a:
+            with b:
+                pass
+
+    def rev():
+        with b:
+            with a:
+                pass
+
+    for fn in (fwd, rev):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+    rep = witness.report(suites=[])
+    pairs = {(e["src"], e["dst"]) for e in rep["edges"]}
+    assert ("A", "B") in pairs and ("B", "A") in pairs
+    assert rep["cycles"], "ABBA order not flagged as a cycle"
+    assert set(rep["cycles"][0]) == {"A", "B"}
+
+
+def test_witness_rlock_reentry_is_not_an_edge(clean_witness):
+    r = witness.RLock(label="R")
+    x = witness.Lock(label="X")
+    with r:
+        with r:
+            with x:
+                pass
+    rep = witness.report(suites=[])
+    pairs = {(e["src"], e["dst"]) for e in rep["edges"]}
+    assert pairs == {("R", "X")}
+    assert rep["cycles"] == []
+
+
+def test_witness_wait_hazard_and_blocking_under_lock(clean_witness):
+    """Condition.wait while another lock is held is a hazard; the
+    untimed variant is additionally a blocking-under-lock event."""
+    outer = witness.Lock(label="OUT")
+    cv = witness.Condition(label="CV")
+
+    def waiter_timed():
+        with outer:
+            with cv:
+                cv.wait(timeout=0.01)
+
+    def waiter_untimed():
+        with outer:
+            with cv:
+                def wake():
+                    time.sleep(0.05)
+                    with cv._raw:
+                        cv._raw.notify_all()
+                threading.Thread(target=wake, daemon=True).start()
+                cv.wait()
+
+    for fn in (waiter_timed, waiter_untimed):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join(timeout=10)
+        assert not t.is_alive()
+    rep = witness.report(suites=[])
+    assert any(h["cond"] == "CV" and h["held"] == "OUT"
+               for h in rep["wait_hazards"]), rep["wait_hazards"]
+    assert any(b["held"] == "OUT"
+               for b in rep["blocking_under_lock"]), \
+        rep["blocking_under_lock"]
+
+
+def test_witness_install_patches_only_framework_callers(clean_witness):
+    """install() swaps threading.Lock for a factory that instruments
+    callers inside mxnet_tpu/ and hands everyone else the raw
+    primitive — foreign libraries never pay the recorder."""
+    witness.install(register_dump=False)
+    try:
+        outside = threading.Lock()      # this file is not framework
+        assert not isinstance(outside, witness.WitnessLock)
+        src = "import threading\nlk = threading.Lock()\n"
+        ns = {}
+        exec(compile(src, "/x/mxnet_tpu/fake_mod.py", "exec"), ns)
+        assert isinstance(ns["lk"], witness.WitnessLock)
+        assert ns["lk"].name.startswith("Lock@")
+    finally:
+        witness.uninstall()
+    assert threading.Lock is witness._RAW_LOCK
+
+
+def test_witness_overhead_under_5_percent(clean_witness):
+    """Per-acquisition recorder cost, scaled to the committed
+    artifact's real acquisition count, must stay under 5% of that
+    run's recorded wall clock — the bound that makes 'leave the
+    witness on in CI' tenable."""
+    n = 20000
+    raw = threading.Lock()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with raw:
+            pass
+    raw_s = time.perf_counter() - t0
+    wl = witness.Lock(label="BENCH")
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with wl:
+            pass
+    witness_s = time.perf_counter() - t0
+    per_op = max(0.0, (witness_s - raw_s) / n)
+    with open(LAST_GOOD, encoding="utf-8") as f:
+        doc = json.load(f)
+    acquisitions = sum(v["acquisitions"] for v in doc["locks"].values())
+    wall = doc.get("wall_s")
+    assert isinstance(wall, (int, float)) and wall > 0, \
+        "committed artifact lacks wall_s"
+    projected = per_op * acquisitions
+    assert projected < 0.05 * wall, (
+        "witness overhead %.3fs projected over %d acquisitions "
+        "exceeds 5%% of the %.1fs instrumented run"
+        % (projected, acquisitions, wall))
+
+
+# ---------------------------------------------------------------------------
+# committed artifact + gates
+# ---------------------------------------------------------------------------
+
+def _committed_artifact():
+    arts = sorted(glob.glob(os.path.join(
+        REPO, "docs", "artifacts", "lockgraph_*.json")))
+    assert arts, "no committed lockgraph artifact"
+    return arts[-1]
+
+
+def test_committed_lockgraph_contract():
+    """The committed serving+cluster+elastic witness run: correct
+    schema, all three suites, real coverage, cycle-free (recomputed,
+    not trusted), and no blocking-under-lock events."""
+    with open(_committed_artifact(), encoding="utf-8") as f:
+        doc = json.load(f)
+    assert doc["tool"] == "lock_witness" and doc["version"] == 1
+    for suite in ("test_serving.py", "test_cluster.py",
+                  "test_elastic_chaos.py"):
+        assert suite in doc["suites"], doc["suites"]
+    assert len(doc["locks"]) >= 10, "implausibly small lock coverage"
+    assert doc["edges"], "no acquisition edges witnessed"
+    assert witness.find_cycles(
+        [(e["src"], e["dst"]) for e in doc["edges"]]) == []
+    assert doc["cycles"] == []
+    assert doc["blocking_under_lock"] == []
+
+
+def test_mxlint_locks_cli():
+    """--locks renders the committed artifact (exit 0), flags an
+    injected cycle (exit 1), and rejects garbage (exit 2)."""
+    proc = subprocess.run([sys.executable, MXLINT, "--locks"],
+                          cwd=REPO, capture_output=True, text=True,
+                          timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ACYCLIC" in proc.stdout
+
+
+def test_mxlint_locks_cli_flags_cycle(tmp_path):
+    with open(_committed_artifact(), encoding="utf-8") as f:
+        doc = json.load(f)
+    e = dict(doc["edges"][0])
+    e["src"], e["dst"] = e["dst"], e["src"]
+    doc["edges"] = doc["edges"] + [e]
+    bad = tmp_path / "cyclic.json"
+    bad.write_text(json.dumps(doc))
+    proc = subprocess.run([sys.executable, MXLINT, "--locks", str(bad)],
+                          cwd=REPO, capture_output=True, text=True,
+                          timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "CYCLIC" in proc.stdout
+    garbage = tmp_path / "garbage.json"
+    garbage.write_text("{not json")
+    proc = subprocess.run(
+        [sys.executable, MXLINT, "--locks", str(garbage)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+
+
+def _run_locks_gate(tmp_path, candidate, name="cand.json"):
+    path = tmp_path / name
+    path.write_text(json.dumps(candidate))
+    return subprocess.run(
+        [sys.executable, PERF_GATE, str(path), "--locks"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+
+
+def test_perf_gate_locks_passes_committed_pair():
+    proc = subprocess.run(
+        [sys.executable, PERF_GATE, _committed_artifact(), "--locks"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "perf_gate: PASS" in proc.stdout
+
+
+def test_perf_gate_locks_rejects_synthetic_regressions(tmp_path):
+    """The gate's whole point: four regression classes, each injected
+    into a copy of the good artifact, each rejected with a REGRESSION
+    verdict — plus a missing artifact is UNREADABLE, not a pass."""
+    with open(LAST_GOOD, encoding="utf-8") as f:
+        good = json.load(f)
+
+    # 1. injected cycle (reverse edge added)
+    doc = json.loads(json.dumps(good))
+    e = dict(doc["edges"][0])
+    e["src"], e["dst"] = e["dst"], e["src"]
+    doc["edges"].append(e)
+    proc = _run_locks_gate(tmp_path, doc, "cycle.json")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "cycle" in proc.stdout
+
+    # 2. new blocking-under-lock event
+    doc = json.loads(json.dumps(good))
+    doc["blocking_under_lock"] = [
+        {"held": "Lock@mxnet_tpu/serving/gateway.py:335",
+         "site": "mxnet_tpu/serving/gateway.py:999",
+         "count": 3, "op": "Condition.wait"}]
+    proc = _run_locks_gate(tmp_path, doc, "blocking.json")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "blocking-under-lock" in proc.stdout
+
+    # 3. dropped suite coverage
+    doc = json.loads(json.dumps(good))
+    doc["suites"] = [s for s in doc["suites"]
+                     if s != "test_cluster.py"]
+    proc = _run_locks_gate(tmp_path, doc, "suite.json")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "dropped" in proc.stdout
+
+    # 4. dropped lock coverage
+    doc = json.loads(json.dumps(good))
+    doc["locks"] = dict(list(doc["locks"].items())[:-1])
+    proc = _run_locks_gate(tmp_path, doc, "lock.json")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "coverage dropped" in proc.stdout
+
+    # 5. missing artifact — unreadable, never a silent pass
+    proc = subprocess.run(
+        [sys.executable, PERF_GATE, str(tmp_path / "absent.json"),
+         "--locks"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+
+    # 6. declared-vs-recomputed drift: an artifact claiming cycles its
+    # own edges do not support is stale or hand-edited
+    doc = json.loads(json.dumps(good))
+    doc["cycles"] = [["A", "B"]]
+    proc = _run_locks_gate(tmp_path, doc, "stale.json")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+
+
+def test_perf_gate_locks_rejects_empty_and_wrong_tool(tmp_path):
+    proc = _run_locks_gate(
+        tmp_path, {"tool": "lock_witness", "version": 1, "suites": [],
+                   "locks": {}, "edges": []}, "empty.json")
+    assert proc.returncode == 3, proc.stdout + proc.stderr
+    proc = _run_locks_gate(
+        tmp_path, {"tool": "chaos_bench", "version": 1}, "wrong.json")
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# deflake guard — chaos decode under the witness
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_decode_chaos_holds_no_pool_lock_across_device_put(
+        clean_witness, tmp_path):
+    """KVMigrator.land / GenModel._recover_requests must never hold a
+    BlockPool lock across a device_put (the historical decode-chaos
+    flake): run the decode storm under the witness and require zero
+    blocking-under-lock events and zero held-across-wait hazards
+    naming a kvcache lock."""
+    from mxnet_tpu.elastic import chaos
+
+    witness.install(register_dump=False)
+    try:
+        result = chaos.run_decode(streams=3, max_new_tokens=8,
+                                  workdir=str(tmp_path))
+    finally:
+        witness.uninstall()
+    assert not result.get("error"), result
+    rep = witness.report(suites=[])
+    kv_locks = [n for n in rep["locks"] if "kvcache" in n]
+    assert kv_locks, "decode run did not witness any kvcache lock"
+    assert rep["cycles"] == [], rep["cycles"]
+    offenders = [b for b in rep["blocking_under_lock"]
+                 if "kvcache" in b["held"]]
+    assert offenders == [], offenders
+    hazards = [h for h in rep["wait_hazards"]
+               if "kvcache" in h["held"] or "kvcache" in h["cond"]]
+    assert hazards == [], hazards

@@ -8,6 +8,8 @@
     python tools/mxlint.py --graph model.json --shapes data=1,3,224,224
     python tools/mxlint.py --update-baseline   # regenerate the baseline
     python tools/mxlint.py --runtime           # + live-registry hygiene
+    python tools/mxlint.py --locks             # render committed lockgraph
+    python tools/mxlint.py --locks run.json    # ...or a specific artifact
 
 Exit codes: 0 clean, 1 findings (new, non-baselined), 2 usage/IO error.
 
@@ -128,6 +130,73 @@ def run_graph(args):
     return 0 if not findings else 1
 
 
+def _latest_lockgraph():
+    import glob
+    arts = sorted(glob.glob(
+        os.path.join(REPO, "docs", "artifacts", "lockgraph_*.json")))
+    return arts[-1] if arts else None
+
+
+def run_locks(args):
+    """Render a lock-witness artifact (``analysis/witness.py`` dump)
+    and re-run cycle detection over its edges — the human end of the
+    dynamic half of the concurrency plane. Exit 0 when the graph is
+    cycle-free, 1 on cycles or recorded blocking-under-lock events,
+    2 when the artifact is missing/unreadable/not a lockgraph."""
+    import json
+    path = args.locks if args.locks != "LATEST" else _latest_lockgraph()
+    if not path:
+        print("mxlint: no docs/artifacts/lockgraph_*.json artifact "
+              "found (run a suite with MXTPU_LOCK_WITNESS=1)",
+              file=sys.stderr)
+        return 2
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"mxlint: cannot read {path}: {e}", file=sys.stderr)
+        return 2
+    if doc.get("tool") != "lock_witness" or doc.get("version") != 1:
+        print(f"mxlint: {path} is not a lock_witness v1 artifact",
+              file=sys.stderr)
+        return 2
+    _load_analysis()
+    import importlib
+    witness = importlib.import_module("_mxlint_analysis.witness")
+    edges = doc.get("edges", [])
+    cycles = witness.find_cycles(
+        [(e["src"], e["dst"]) for e in edges])
+    blocking = doc.get("blocking_under_lock", [])
+    hazards = doc.get("wait_hazards", [])
+    print(f"lockgraph: {path}")
+    print("  suites: %s" % (", ".join(doc.get("suites", [])) or "-"))
+    print("  locks witnessed: %d   edges: %d" %
+          (len(doc.get("locks", {})), len(edges)))
+    for e in edges:
+        print("    %-40s -> %-40s x%-6d [%s] %s" %
+              (e["src"], e["dst"], e["count"],
+               ",".join(e.get("threads", [])), e.get("site", "")))
+    if hazards:
+        print("  held-across-wait hazards: %d" % len(hazards))
+        for h in hazards:
+            print("    wait(%s) while holding %s x%d  %s" %
+                  (h["cond"], h["held"], h["count"], h.get("site", "")))
+    if blocking:
+        print("  blocking-under-lock events: %d" % len(blocking))
+        for b in blocking:
+            print("    untimed %s holding %s x%d  %s" %
+                  (b.get("op", "?"), b["held"], b["count"],
+                   b.get("site", "")))
+    if cycles:
+        print("  CYCLES: %d" % len(cycles))
+        for c in cycles:
+            print("    " + " -> ".join(c + [c[0]]))
+    verdict = "CYCLIC" if cycles else (
+        "BLOCKING" if blocking else "ACYCLIC")
+    print(f"mxlint --locks: {verdict}")
+    return 1 if (cycles or blocking) else 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="mxlint", description=__doc__)
     ap.add_argument("paths", nargs="*",
@@ -150,7 +219,14 @@ def main(argv=None):
     ap.add_argument("--runtime", action="store_true",
                     help="also run live-registry hygiene checks "
                          "(imports mxnet_tpu)")
+    ap.add_argument("--locks", nargs="?", const="LATEST",
+                    metavar="ARTIFACT",
+                    help="render a lock-witness artifact and re-check "
+                         "it for cycles (default: newest "
+                         "docs/artifacts/lockgraph_*.json)")
     args = ap.parse_args(argv)
+    if args.locks:
+        return run_locks(args)
     if args.graph:
         return run_graph(args)
     if args.update_baseline:

@@ -8,6 +8,7 @@
     python tools/perf_gate.py serving_bench.json --serving
     python tools/perf_gate.py kernel_bench.json --kernels
     python tools/perf_gate.py chaos_bench.json --chaos
+    python tools/perf_gate.py lockgraph.json --locks
 
 ``--io`` gates a tools/io_bench.py version-2 artifact instead: every
 stage's img/s must stay within tolerance of the committed last-good
@@ -55,6 +56,15 @@ zero dropped/duplicated batches, the straggler report must NAME the
 injected rank, the replica kill must lose zero requests with a
 bitwise-identical probe across recovery, and the autoscale cycle
 must have demonstrably scaled out AND back in.
+
+``--locks`` gates an analysis/witness.py version-1 lock_witness
+artifact against ``docs/artifacts/LOCKS_LAST_GOOD.json`` — the
+dynamic half of the concurrency plane as a CI contract: the lock
+acquisition graph must be cycle-free (recomputed from the edges, not
+trusted from the dump), no blocking-under-lock event may appear that
+last-good does not carry, and neither a suite nor a lock node
+witnessed by last-good may vanish from the candidate (dropped
+coverage is itself a regression).
 
 ``--kernels`` gates a tools/kernel_bench.py version-1 artifact
 against ``docs/artifacts/KERNELS_LAST_GOOD.json``: every kernel the
@@ -109,6 +119,8 @@ DEFAULT_KERNELS_LAST_GOOD = os.path.join(REPO, "docs", "artifacts",
                                          "KERNELS_LAST_GOOD.json")
 DEFAULT_CHAOS_LAST_GOOD = os.path.join(REPO, "docs", "artifacts",
                                        "CHAOS_LAST_GOOD.json")
+DEFAULT_LOCKS_LAST_GOOD = os.path.join(REPO, "docs", "artifacts",
+                                       "LOCKS_LAST_GOOD.json")
 
 # the elasticity plane's advertised scenario families: an artifact
 # missing one of these has not exercised the SLO it claims to gate
@@ -973,6 +985,131 @@ def gate_chaos(candidate, last_good, tolerance=0.25):
     return rc, msgs
 
 
+def _lock_cycles(edges):
+    """Representative cycles over an artifact's edge list, recomputed
+    here so a hand-edited ``cycles: []`` cannot sneak a cyclic graph
+    past the gate. Tiny iterative Tarjan (the gate must not import the
+    package)."""
+    graph = {}
+    for e in edges:
+        s, d = e.get("src"), e.get("dst")
+        if s and d and s != d:
+            graph.setdefault(s, set()).add(d)
+    index = {}
+    low = {}
+    on = set()
+    stack = []
+    sccs = []
+    counter = [0]
+    for root in sorted(graph):
+        if root in index:
+            continue
+        work = [(root, iter(sorted(graph.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on.add(nxt)
+                    work.append((nxt, iter(sorted(graph.get(nxt,
+                                                            ())))))
+                    advanced = True
+                    break
+                if nxt in on:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                if len(scc) > 1:
+                    sccs.append(sorted(scc))
+    return sccs
+
+
+def gate_locks(candidate, last_good):
+    """(exit_code, [messages]) for a lock_witness artifact pair.
+
+    Truth contracts, no tolerances: ANY cycle in the acquisition
+    graph (recomputed from the edges, not trusted from the artifact)
+    is a deadlock-in-waiting; a blocking-under-lock event absent from
+    last-good is a new way for a stall to spread; a suite or lock
+    node that last-good witnessed but the candidate did not is
+    dropped coverage — the witness cannot silently watch less and
+    still claim the plane is clean."""
+    msgs = []
+    rc = 0
+    if candidate.get("tool") != "lock_witness" or \
+            candidate.get("version") != 1:
+        return 2, ["not a version-1 lock_witness artifact"]
+    locks = candidate.get("locks") or {}
+    edges = candidate.get("edges") or []
+    if not locks:
+        return 3, ["lock artifact witnessed no locks "
+                   "(signal-free — rejected)"]
+    cycles = _lock_cycles(edges)
+    declared = candidate.get("cycles") or []
+    for scc in cycles:
+        rc = 1
+        msgs.append("REGRESSION locks: acquisition cycle %s — two "
+                    "threads taking these locks in opposing order "
+                    "deadlock" % " -> ".join(scc + [scc[0]]))
+    if declared and not cycles:
+        rc = 1
+        msgs.append("REGRESSION locks: artifact declares %d cycle(s) "
+                    "its own edges do not support — stale or "
+                    "hand-edited dump" % len(declared))
+    if not cycles and not declared:
+        msgs.append("locks: acquisition graph acyclic over %d edges "
+                    "(ok)" % len(edges))
+    good_blocking = {(b.get("held"), b.get("site"))
+                     for b in last_good.get("blocking_under_lock")
+                     or []}
+    for b in candidate.get("blocking_under_lock") or []:
+        key = (b.get("held"), b.get("site"))
+        if key not in good_blocking:
+            rc = 1
+            msgs.append("REGRESSION locks: new blocking-under-lock "
+                        "event — untimed %s while holding %s at %s "
+                        "(x%s)" % (b.get("op", "?"), b.get("held"),
+                                   b.get("site"), b.get("count")))
+    mine_suites = set(candidate.get("suites") or [])
+    for suite in sorted(set(last_good.get("suites") or [])):
+        if suite not in mine_suites:
+            rc = 1
+            msgs.append("REGRESSION locks: suite %s dropped from the "
+                        "witness run (last good covers it)" % suite)
+    good_locks = set(last_good.get("locks") or {})
+    missing = sorted(good_locks - set(locks))
+    for name in missing:
+        rc = 1
+        msgs.append("REGRESSION locks: lock %s witnessed by last "
+                    "good never acquired in the candidate run — "
+                    "coverage dropped" % name)
+    if rc == 0:
+        msgs.append("locks: %d locks, %d edges, %d held-across-wait "
+                    "hazard(s), coverage superset of last good (ok)"
+                    % (len(locks), len(edges),
+                       len(candidate.get("wait_hazards") or [])))
+    return rc, msgs
+
+
 def gate_kernels(candidate, last_good, tolerance=0.25, min_ratio=1.0):
     """(exit_code, [messages]) for a kernel_bench artifact pair.
 
@@ -1111,7 +1248,33 @@ def main(argv=None):
                          "presence vs last-good, nonfinite-free "
                          "training, pinned params fingerprint, "
                          "finite loss EWMA (profiling/health.py)")
+    ap.add_argument("--locks", action="store_true",
+                    help="gate a lock_witness artifact "
+                         "(analysis/witness.py dump): any acquisition "
+                         "cycle, new blocking-under-lock event, or "
+                         "dropped suite/lock coverage vs last-good "
+                         "is a regression")
     args = ap.parse_args(argv)
+    if args.locks:
+        last_good_path = args.last_good
+        if last_good_path == DEFAULT_LAST_GOOD:
+            last_good_path = DEFAULT_LOCKS_LAST_GOOD
+        try:
+            with open(args.artifact, "r", encoding="utf-8") as f:
+                candidate = json.load(f)
+            with open(last_good_path, "r", encoding="utf-8") as f:
+                last_good = json.load(f)
+        except (OSError, ValueError) as e:
+            print("perf_gate: cannot read lock artifact: %s" % e,
+                  file=sys.stderr)
+            return 2
+        rc, msgs = gate_locks(candidate, last_good)
+        for m in msgs:
+            print(m)
+        print("perf_gate: %s"
+              % {0: "PASS", 1: "REGRESSION", 2: "UNREADABLE",
+                 3: "BARE-ZERO"}.get(rc, rc))
+        return rc
     if args.chaos:
         last_good_path = args.last_good
         if last_good_path == DEFAULT_LAST_GOOD:
