@@ -143,6 +143,27 @@ def _serving_summary():
     return out
 
 
+def _goodput_summary():
+    """Bounded fleet-goodput headline from the committed last-good
+    goodput artifact (docs/artifacts/GOODPUT_LAST_GOOD.json) — bins,
+    fraction and conservation verdict under 2KB, provenance explicit
+    (the chip bench and the colocation chaos run live on different
+    cadences). Refresh path: tools/chaos_bench.py --goodput +
+    perf_gate --goodput."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "docs", "artifacts", "GOODPUT_LAST_GOOD.json")
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    from mxnet_tpu.profiling import goodput as _goodput
+    out = _goodput.summary(doc, max_bytes=2048)
+    if out is not None:
+        out["source"] = "last_good_artifact"
+    return out
+
+
 # params fingerprint of the most recently trained stage (set by
 # _bench_train; the health embed carries it so perf_gate --health can
 # pin "training ran and produced these exact bits")
@@ -506,6 +527,13 @@ def _fail_json(err, diag=None):
         # the health verdict rides failures too: "did the model NaN
         # before the wedge" answers itself from the artifact
         doc["health"] = _health_summary()
+    except Exception:  # noqa: BLE001 — diagnostics never block a report
+        pass
+    try:
+        # last-known fleet goodput rides failures too (committed copy)
+        gp = _goodput_summary()
+        if gp is not None:
+            doc["goodput"] = gp
     except Exception:  # noqa: BLE001 — diagnostics never block a report
         pass
     line = json.dumps(doc)
@@ -1456,6 +1484,11 @@ def main():
         # bounded serving headline (last-good copy, provenance marked)
         # so one training artifact answers "and how does it serve?"
         result["serving"] = serving
+    goodput = _goodput_summary()
+    if goodput is not None:
+        # bounded fleet-goodput headline (last-good copy, provenance
+        # marked) — "and where do the fleet's device-seconds go?"
+        result["goodput"] = goodput
     kernels = _kernels_summary()
     if kernels is not None:
         # bounded Pallas-fleet headline (parity + fallback timings)

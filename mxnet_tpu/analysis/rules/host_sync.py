@@ -45,12 +45,19 @@ _SCOPES = (
     # the telemetry recorders themselves run inside every hot path
     # above — a sync hiding in inc()/observe()/step_boundary() would
     # stall each instrumented seam at once. Drains are read-time only
-    # (snapshot/value), never in these recording methods.
+    # (snapshot/value), never in these recording methods. The
+    # timeline/SLO plane joins: a sync in a frame tick or a windowed
+    # query (rate/quantile/burn) would multiply into every window it
+    # observes — recorders read SNAPSHOTS only, never the device.
     ("mxnet_tpu/telemetry/",
      {"inc", "dec", "set", "set_max", "inc_lazy", "set_lazy",
       "observe", "observe_lazy", "_push_lazy", "add_data_wait",
       "add_comm", "add_compile", "step_boundary",
-      "_on_event_duration"}, set()),
+      "_on_event_duration",
+      "tick", "bounds", "rate", "mean", "quantile", "over_fraction",
+      "delta", "delta_quantile", "delta_over", "stats_of",
+      "evaluate", "burn", "slo_burn", "_window_err_frac",
+      "_agg_hist", "_agg_counter"}, set()),
     # the tracing recorders run inside every instrumented seam above;
     # a sync in span open/close would stall each traced hot path
     ("mxnet_tpu/tracing/",
@@ -78,7 +85,8 @@ _SCOPES = (
       "_accumulate", "add", "commit", "step_probe", "step_boundary",
       "_fold_entries", "_fold_loss", "_trip",
       "live_census", "buffer_intervals", "build_memory_ledger",
-      "group_buffers_by_op", "_sweep_peak"}, set()),
+      "group_buffers_by_op", "_sweep_peak",
+      "classify_spans", "collect", "_clip", "_overlap_ns"}, set()),
     # the cost-tracked partitioner runs at TRACE/bind time: selector
     # growth, cluster pricing (abstract lowering only — ShapeDtype
     # structs, never arrays) and the gate decision. A device sync here
@@ -143,8 +151,8 @@ _SCOPES = (
     # and stays off this list.
     ("mxnet_tpu/elastic/",
      {"poll", "view", "announce", "leave", "mark_dead",
-      "observe", "decide", "tick", "_queue_depth", "_latency_stats",
-      "_ceiling", "train_step", "histogram_window_p99"}, set()),
+      "observe", "decide", "tick", "_queue_depth", "_slo_burn",
+      "_ceiling", "train_step"}, set()),
     # the cluster plane's ledger/lending hot paths: lease bookkeeping
     # (acquire/release/resize + every introspection read) runs under
     # the ledger lock from client threads, the autoscaler daemon and
@@ -159,8 +167,8 @@ _SCOPES = (
       "owner_of", "leases", "holdings", "find_lease", "expired",
       "verify_conservation", "device_seconds", "_accrue", "_snapshot",
       "_journal", "active_borrows", "borrowed_devices", "can_lend",
-      "check_leases", "on_capped", "on_cold", "step_boundary",
-      "hold", "_record"}, set()),
+      "check_leases", "on_capped", "on_cold", "_budget_healthy",
+      "step_boundary", "hold", "_record"}, set()),
     # the serving gateway's per-request paths: admission + enqueue run
     # in every client thread, coalescing + reply recording in every
     # replica scheduler — a sync in any of them serializes the whole

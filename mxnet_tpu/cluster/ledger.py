@@ -47,6 +47,12 @@ _met = _tm.lazy_metrics(lambda reg: {
     "epochs": reg.counter(
         "mx_cluster_ledger_epochs_total",
         "journal epochs written", labelnames=("op",)),
+    "device_seconds": reg.counter(
+        "mx_cluster_device_seconds_total",
+        "accrued device-seconds per lease owner and role (the free "
+        "pool rides owner=free, role=free) — the goodput plane's "
+        "time ground truth, same source as device_seconds()",
+        labelnames=("owner", "role")),
 })
 
 ROLES = ("training_shard", "serving_lane", "tp_slice")
@@ -423,11 +429,16 @@ class DeviceLedger:
         dt = max(now - self._last_t, 0.0)
         if dt > 0:
             ds = self._device_seconds
+            met = _met()
             for lease in self._leases.values():
-                ds[lease.owner] = ds.get(lease.owner, 0.0) + \
-                    dt * len(lease.devices)
+                add = dt * len(lease.devices)
+                ds[lease.owner] = ds.get(lease.owner, 0.0) + add
+                met["device_seconds"].labels(
+                    owner=lease.owner, role=lease.role).inc(add)
             n_free = len(self._world) - len(self._assigned)
             ds["free"] = ds.get("free", 0.0) + dt * n_free
+            met["device_seconds"].labels(
+                owner="free", role="free").inc(dt * n_free)
         self._last_t = now
 
     def _snapshot(self, op, detail):

@@ -118,7 +118,8 @@ class LendingScheduler:
     def __init__(self, ledger, trainer=None, gateway=None, gate=None,
                  membership=None, min_train_dp=None, deadline_s=None,
                  backoff_budget_ms=None, lend_chunk=2,
-                 clock=time.monotonic, fault_plan=None):
+                 clock=time.monotonic, fault_plan=None, slo=None,
+                 burn_high=1.0):
         self.ledger = ledger
         self.trainer = trainer
         self.gateway = gateway
@@ -138,6 +139,12 @@ class LendingScheduler:
         self.backoff_budget_ms = float(backoff_budget_ms)
         self.lend_chunk = int(lend_chunk)
         self.fault_plan = fault_plan   # None = MXNET_KVSTORE_FAULT_PLAN
+        # SLO plane (optional): reclaim eligibility consults the burn
+        # rate — a loan is only called home while the error budget is
+        # healthy (burn < burn_high). None burn = no signal: reclaim
+        # proceeds exactly as before the SLO plane existed.
+        self.slo = slo
+        self.burn_high = float(burn_high)
         self._clock = clock
         self._lock = threading.RLock()
         self._borrows = []     # live borrow records (dicts)
@@ -197,9 +204,26 @@ class LendingScheduler:
         self.lend(model, n)
         return True
 
+    def _budget_healthy(self):
+        """SLO consult for reclaim eligibility. True (eligible) when
+        no tracker is attached, the tracker has no data, or the burn
+        is under ``burn_high``; a broken tracker is survived as
+        eligible — the SLO plane is an input, never a wedge."""
+        if self.slo is None:
+            return True
+        try:
+            burn_fn = getattr(self.slo, "burn", self.slo)
+            burn = burn_fn()
+        except Exception as e:  # noqa: BLE001 — policy input only
+            logger.warning("cluster: slo burn read failed: %r", e)
+            return True
+        return burn is None or burn < self.burn_high
+
     def on_cold(self, model):
         """The autoscaler scaled in: reclaim the loan once the
-        remaining lanes fit on serving's own (non-borrowed) chips.
+        remaining lanes fit on serving's own (non-borrowed) chips AND
+        the SLO error budget is healthy (a burning budget defers the
+        reclaim — taking chips back mid-incident deepens it).
         Returns True when a reclaim ran."""
         with self._lock:
             borrows = self.active_borrows(model)
@@ -211,6 +235,10 @@ class LendingScheduler:
                    if d not in borrowed]
             if self.gateway.replica_count(model) > len(own):
                 return False     # borrowed lanes still in use
+        if not self._budget_healthy():
+            self._record("reclaim_deferred", model=model,
+                         reason="slo budget burning")
+            return False
         for b in borrows:
             self.reclaim(b)
         return True

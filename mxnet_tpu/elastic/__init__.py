@@ -21,11 +21,11 @@ from .membership import Membership, MemberView, default_dir
 from .reshard import (ElasticTrainer, devices_for_members,
                       named_leaves, place_like, to_host,
                       unflatten_like, zero_shard_spec)
-from .autoscale import Autoscaler, histogram_window_p99
+from .autoscale import Autoscaler
 
 __all__ = [
     "Membership", "MemberView", "default_dir",
     "ElasticTrainer", "devices_for_members", "named_leaves",
     "place_like", "to_host", "unflatten_like", "zero_shard_spec",
-    "Autoscaler", "histogram_window_p99",
+    "Autoscaler",
 ]

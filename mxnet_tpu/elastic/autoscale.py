@@ -11,10 +11,18 @@ the ``mx_serving_*`` telemetry the gateway already emits:
   gauge, compared against a per-replica high watermark. Sustained
   growth (``sustain`` consecutive hot ticks) scales out.
 - **latency pressure**: a windowed p99 estimated from the
-  ``mx_serving_latency_seconds{stage="e2e"}`` histogram (cumulative
-  bucket DELTAS between ticks, so the estimate reflects the current
-  window, not the process's whole history), compared against the
-  p99 budget. Budget pressure also scales out.
+  ``mx_serving_latency_seconds{stage="e2e"}`` histogram via the
+  shared ``telemetry.timeline`` bucket-delta math (the autoscaler
+  ticks a private frame ring and queries ``quantile`` between ticks,
+  so the estimate reflects the current window, not the process's
+  whole history), compared against the p99 budget. Budget pressure
+  also scales out.
+- **SLO burn pressure** (optional): an ``slo`` input (an
+  ``SLOTracker`` or any ``burn()``-bearing object / callable) joins
+  the hot signals when the fleet burn rate reaches ``burn_high`` —
+  and blocks scale-in while the budget is unhealthy. ``None`` burn
+  means "no signal", never 0: with no tracker attached the policy is
+  bit-identical to the pre-SLO autoscaler.
 - **cooldown scale-in**: when both pressures stay cold for
   ``sustain`` ticks AND ``cooldown_s`` has passed since the last
   scale event, one replica drains and retires — hysteresis so a
@@ -36,6 +44,7 @@ import time
 from .. import tracing
 from ..base import MXNetError, get_env
 from ..telemetry import metrics as _tm
+from ..telemetry import timeline as _tl
 
 logger = logging.getLogger(__name__)
 
@@ -64,39 +73,6 @@ _met = _tm.lazy_metrics(lambda reg: {
 })
 
 
-def histogram_window_p99(prev_stats, cur_stats, q=0.99):
-    """Quantile estimate over the observations BETWEEN two cumulative
-    histogram reads (``HistogramSeries.stats()`` tuples). Both bucket
-    lists are CUMULATIVE, so the window's cumulative count at each
-    edge is simply ``cur_cum - prev_cum`` — summing those deltas
-    again would double-count every bucket below the edge and pull the
-    estimate toward zero. Linear interpolation inside the winning
-    bucket; the +Inf bucket reports the last finite edge (a ceiling
-    estimate). None when the window saw no observations."""
-    if prev_stats is None or cur_stats is None:
-        return None
-    (c0, _, b0), (c1, _, b1) = prev_stats, cur_stats
-    n = c1 - c0
-    if n <= 0 or len(b0) != len(b1):
-        return None
-    target = q * n
-    prev_le = 0.0
-    prev_win = 0.0
-    for i, ((le, cur_cum), (_, old_cum)) in enumerate(zip(b1, b0)):
-        win_cum = cur_cum - old_cum   # window obs <= this edge
-        if le == "+Inf":
-            # beyond every finite edge: report the last finite edge
-            return float(b1[i - 1][0]) if i else None
-        le = float(le)
-        if win_cum >= target:
-            density = win_cum - prev_win
-            frac = (target - prev_win) / density if density > 0 \
-                else 1.0
-            return prev_le + frac * (le - prev_le)
-        prev_le, prev_win = le, win_cum
-    return prev_le if prev_win > 0 else None
-
-
 class Autoscaler:
     """Scale one registered model between ``min_replicas`` and
     ``max_replicas`` from telemetry alone. Drive it with
@@ -107,7 +83,8 @@ class Autoscaler:
                  max_replicas=None, queue_high=None, queue_low=None,
                  p99_budget_ms=None, sustain=3, cooldown_s=None,
                  period_s=None, ewma=0.3, allow_degraded=False,
-                 lender=None, clock=time.monotonic):
+                 lender=None, slo=None, burn_high=1.0,
+                 clock=time.monotonic):
         self.gateway = gateway
         self.model = model
         # cluster plane (optional): a LendingScheduler consulted when
@@ -115,6 +92,10 @@ class Autoscaler:
         # or scales back in (return them); its lease deadlines are
         # enforced from this loop too
         self.lender = lender
+        # SLO plane (optional): burn >= burn_high is scale pressure;
+        # burn None = no signal (policy unchanged without a tracker)
+        self.slo = slo
+        self.burn_high = float(burn_high)
         if min_replicas is None:
             min_replicas = int(get_env("MXTPU_ELASTIC_MIN_REPLICAS",
                                        1, int))
@@ -153,7 +134,10 @@ class Autoscaler:
         self._hot = 0
         self._cold = 0
         self._last_scale_t = None
-        self._prev_hist = None
+        # the shared windowed-stats substrate: a private frame ring
+        # ticked once per observe(); quantile(window_s=None) is the
+        # between-ticks bucket delta the old private math computed
+        self._timeline = _tl.Timeline(window=8, clock=clock)
         self.events = []        # bounded [(t, direction, replicas)]
         self._thread = None
         self._stop = threading.Event()
@@ -172,28 +156,40 @@ class Autoscaler:
         return float(reg.value("mx_serving_queue_depth", 0.0,
                                model=self.model))
 
-    def _latency_stats(self):
-        fam = _tm.registry().find("mx_serving_latency_seconds")
-        if fam is None:
+    def _slo_burn(self, met):
+        """Read the optional SLO input; a broken tracker is counted
+        and survived (None = no signal), never fatal to the loop."""
+        if self.slo is None:
             return None
-        return fam.labels(model=self.model, stage="e2e").stats()
+        try:
+            burn_fn = getattr(self.slo, "burn", self.slo)
+            return burn_fn()
+        except Exception as e:  # noqa: BLE001 — policy input only
+            self._last_error = repr(e)[:300]
+            met["errors"].labels(model=self.model, where="slo").inc()
+            logger.warning("elastic: slo burn read for %r failed: %r",
+                           self.model, e)
+            return None
 
     def observe(self):
-        """One telemetry sample: EWMA'd queue depth + windowed p99."""
+        """One telemetry sample: EWMA'd queue depth + windowed p99
+        from the shared timeline + optional SLO burn."""
         depth = self._queue_depth()
         self._depth_ewma = depth if self._depth_ewma is None else \
             (1 - self.ewma) * self._depth_ewma + self.ewma * depth
-        cur = self._latency_stats()
-        p99_s = histogram_window_p99(self._prev_hist, cur)
-        self._prev_hist = cur
+        self._timeline.tick()
+        p99_s = self._timeline.quantile(
+            "mx_serving_latency_seconds", 0.99,
+            model=self.model, stage="e2e")
         replicas = self.gateway.replica_count(self.model)
+        met = _met()
         sample = {
             "depth": depth,
             "depth_ewma": self._depth_ewma,
             "p99_ms": p99_s * 1e3 if p99_s is not None else None,
             "replicas": replicas,
+            "slo_burn": self._slo_burn(met),
         }
-        met = _met()
         met["queue_ewma"].labels(model=self.model).set(
             self._depth_ewma)
         met["replicas"].labels(model=self.model).set(replicas)
@@ -218,9 +214,12 @@ class Autoscaler:
         hot_p99 = (self.p99_budget_ms is not None
                    and sample["p99_ms"] is not None
                    and sample["p99_ms"] > self.p99_budget_ms)
+        burn = sample.get("slo_burn")
+        hot_burn = burn is not None and burn >= self.burn_high
         cold = (sample["depth_ewma"] < self.queue_low
-                * max(replicas - 1, 1)) and not hot_p99
-        if hot_queue or hot_p99:
+                * max(replicas - 1, 1)) and not hot_p99 \
+            and not hot_burn
+        if hot_queue or hot_p99 or hot_burn:
             self._hot += 1
             self._cold = 0
         elif cold:
@@ -235,10 +234,15 @@ class Autoscaler:
                 return "capped", (
                     f"pressure sustained but at ceiling {ceiling} "
                     f"({'max_replicas' if ceiling == self.max_replicas else 'device count (degraded wrap refused)'})")
-            reason = "queue ewma %.1f > %.1f x %d replicas" % (
-                sample["depth_ewma"], self.queue_high, replicas) \
-                if hot_queue else "p99 %.1fms > budget %.1fms" % (
+            if hot_queue:
+                reason = "queue ewma %.1f > %.1f x %d replicas" % (
+                    sample["depth_ewma"], self.queue_high, replicas)
+            elif hot_p99:
+                reason = "p99 %.1fms > budget %.1fms" % (
                     sample["p99_ms"], self.p99_budget_ms)
+            else:
+                reason = "slo burn %.2f >= %.2f" % (burn,
+                                                    self.burn_high)
             return "scale_out", reason
         if self._cold >= self.sustain and replicas > self.min_replicas:
             now = self._clock()

@@ -898,16 +898,81 @@ def run_decode(streams=6, max_new_tokens=32, recovery_budget_s=30.0,
     }
 
 
+def _goodput_decode_probe(gw, seed=0, streams=4, max_new_tokens=16,
+                          budget_s=30.0):
+    """Generative traffic + one mid-stream lane kill on the chips
+    serving holds after reclaim (its own lane + the free pool): gives
+    the goodput window real ``serve_prefill``/``serve_decode`` lane
+    time and a nonzero ``recovery_tax`` bin from the migrate/replay
+    failover. Returns the probe summary dict."""
+    model = "coloc_gen"
+    decoder, prompts = _gen_fixture(seed)
+    prompts = (prompts * ((streams + len(prompts) - 1)
+                          // len(prompts)))[:streams]
+    replicas = 2
+    try:
+        gw.register_generator(model, decoder, block_tokens=4,
+                              max_blocks=64,
+                              max_new_tokens=max_new_tokens,
+                              max_decode_batch=4, replicas=replicas)
+    except Exception:  # noqa: BLE001 — not enough usable chips for a
+        # second lane: a one-lane probe still produces prefill/decode
+        # bins; the respawn below restores the recovery path
+        replicas = 1
+        gw.register_generator(model, decoder, block_tokens=4,
+                              max_blocks=64,
+                              max_new_tokens=max_new_tokens,
+                              max_decode_batch=4, replicas=1)
+    gen = gw._generators[model]
+    reqs = [gw.generate(model, p, max_new_tokens=max_new_tokens,
+                        stream=True) for p in prompts]
+    # wait until the streams are demonstrably mid-decode (first token
+    # emitted, completion not), then kill the busiest lane
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline:
+        if all(len(r.tokens) >= 2 or r.done() for r in reqs):
+            break
+        time.sleep(0.001)
+    with gen.cond:
+        live = [ln for ln in gen.lanes if not ln.retiring]
+        victim = max(live, key=lambda ln: len(ln.running))
+    victim.kill("chaos: goodput decode probe")
+    if replicas == 1:
+        gw.scale(model, 1)     # respawn a lane for the replay target
+    completed, errors = 0, 0
+    for r in reqs:
+        try:
+            r.result(budget_s)
+            completed += 1
+        except Exception:  # noqa: BLE001 — counted; the probe is an
+            errors += 1     # occupancy source, not a recovery proof
+    modes = [a["mode"] for r in reqs
+             for (_, _, a) in r.recover_spans]
+    return {"streams": len(reqs), "completed": completed,
+            "errors": errors, "killed_lane": victim.idx,
+            "replicas": replicas,
+            "recoveries": {"migrate": modes.count("migrate"),
+                           "replay": modes.count("replay")}}
+
+
 def run_colocation(burst_s=4.0, rate_factor=3.0,
                    p99_budget_ms=10000.0, recovery_budget_s=60.0,
                    reclaim_budget_s=60.0, drift_bound=1e-4, seed=9,
-                   step_pace_s=0.05, workdir=None):
+                   step_pace_s=0.05, goodput=False, workdir=None):
     """Serving overload during live training on one ledger-governed
     cluster: the autoscaler caps out, borrows training chips through
     the LendingScheduler, serves the burst on them, and the cold
     window reverses the loan — training bit-identical after reclaim,
     device-seconds conserved per owner, a wedged borrower revoked at
-    its deadline. Returns the scenario dict (see module doc)."""
+    its deadline. Returns the scenario dict (see module doc).
+
+    ``goodput=True`` additionally records the fleet-goodput window: a
+    timeline/SLO tracker ticks through the run, a decode probe (one
+    generative lane-kill round on serving's post-reclaim chips) fills
+    the serve/recovery bins, and the result carries a
+    ``profiling.goodput`` artifact whose window closes BEFORE the
+    twin/reference verification replays (their step spans would
+    double-bill training's chips)."""
     import jax
 
     from ..cluster import DeviceLedger, LendingScheduler, StepGate
@@ -944,6 +1009,34 @@ def run_colocation(burst_s=4.0, rate_factor=3.0,
     with _scratch_dir(workdir, "colocation") as root:
         jdir = os.path.join(root, "ledger")
         ledger = DeviceLedger(world, journal_dir=jdir)
+        gp_doc = None
+        gp_stop = threading.Event()
+        gp_thread = None
+        if goodput:
+            from ..tracing import clock as _tclock
+            from ..telemetry.slo import SLOTracker
+            from ..telemetry.timeline import Timeline
+            gp_t0 = _tclock.now_ns()
+            gp_tl = Timeline(window=256)
+            gp_slo = SLOTracker(timeline=gp_tl, fast_s=2.0,
+                                slow_s=10.0)
+            gp_burns = []
+
+            def _gp_tick():
+                # evaluate-then-tick so each frame also carries the
+                # freshly published mx_slo_* gauges
+                while not gp_stop.wait(0.25):
+                    try:
+                        res = gp_slo.evaluate()
+                        burns = [r["burn"] for r in res
+                                 if r.get("burn") is not None]
+                        if burns:
+                            gp_burns.append(max(burns))
+                        gp_tl.tick()
+                    except Exception:  # noqa: BLE001 — the recorder
+                        pass           # must never wedge the scenario
+            gp_thread = threading.Thread(target=_gp_tick, daemon=True)
+            gp_thread.start()
         trainer = make_trainer()
         trainer.attach_ledger(ledger, "training")
         trainer.build(train_devs)
@@ -1049,6 +1142,29 @@ def run_colocation(burst_s=4.0, rate_factor=3.0,
                 recovery_s = max(t_past - t_capped, 0.0)
             peak = max((n for _, _, n, _ in decisions), default=1)
 
+            # ---- goodput window close: decode probe + artifact ----
+            # runs BEFORE the twin/reference replays: their step spans
+            # would land inside the window and double-bill training's
+            # chips (the replays hold no ledger lease)
+            if goodput:
+                from ..profiling import goodput as _goodput
+                probe = _goodput_decode_probe(gw, seed=seed)
+                gp_stop.set()
+                gp_thread.join(5.0)
+                gp_tl.tick()
+                slo_doc = gp_slo.to_doc()
+                slo_doc["max_burn_observed"] = \
+                    round(max(gp_burns), 4) if gp_burns else None
+                gp_t1 = _tclock.now_ns()
+                gp_doc = _goodput.collect(
+                    ledger.device_seconds(),
+                    tracing.spans_snapshot(), gp_t0, gp_t1,
+                    slo=slo_doc,
+                    provenance={"scenario": "colocation",
+                                "probe": probe,
+                                "burst_s": burst_s,
+                                "backend": jax.default_backend()})
+
             # ---- planned twin: same schedule, lend/reclaim as pure
             # reshapes with no serving in the loop ------------------
             fp_twin = None
@@ -1112,6 +1228,9 @@ def run_colocation(burst_s=4.0, rate_factor=3.0,
             ds = ledger.device_seconds()
             vj = DeviceLedger.verify_journal(jdir)
         finally:
+            gp_stop.set()
+            if gp_thread is not None:
+                gp_thread.join(5.0)
             stop_train.set()
             gate.release()
             gw.close()
@@ -1124,7 +1243,7 @@ def run_colocation(burst_s=4.0, rate_factor=3.0,
     if recovery_s is not None:
         _met()["recovery_s"].labels(scenario="colocation").observe(
             recovery_s)
-    return {
+    result = {
         "family": "colocation",
         "mode": "open_loop",
         "world": {"world_size": len(world), "training_dp_initial": 4,
@@ -1168,6 +1287,9 @@ def run_colocation(burst_s=4.0, rate_factor=3.0,
                    "violations": vj["violations"]},
         "borrow_wedge": wedge,
     }
+    if gp_doc is not None:
+        result["goodput"] = gp_doc
+    return result
 
 
 # ======================================================================

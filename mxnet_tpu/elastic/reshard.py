@@ -286,7 +286,7 @@ class ElasticTrainer:
         how the straggler report names the slow rank."""
         from ..kvstore import fault as _fault
         with tracing.span("step", cat="step", step=self.steps_done,
-                          generation=self.generation):
+                          generation=self.generation, dp=self.dp):
             _fault.apply_straggler(worker_rank)
             self.params, self.opt, loss = self.step(
                 self.params, self.opt, batch)

@@ -366,6 +366,22 @@ _ENV_VARS = {
     "MXTPU_LOCK_WITNESS_PATH": (
         "where the lock witness writes its lockgraph JSON artifact at "
         "process exit (default ./lockgraph.json; analysis/witness.py)"),
+    "MXTPU_TIMELINE_WINDOW": (
+        "frames the in-process metric timeline retains: each tick "
+        "records one registry snapshot into a bounded ring and the "
+        "oldest frame past this cap is evicted (default 128; "
+        "telemetry/timeline.py)"),
+    "MXTPU_TIMELINE_SEC": (
+        "period of the timeline's background frame recorder: > 0 "
+        "starts a daemon that ticks the process timeline every this "
+        "many seconds when telemetry is enabled; <= 0 leaves ticking "
+        "explicit (default 0; telemetry/timeline.py)"),
+    "MXTPU_SLO_FILE": (
+        "JSON file declaring the SLO objectives the burn-rate tracker "
+        "evaluates (a list of objective dicts, same keys as "
+        "slo.DEFAULT_OBJECTIVES); unset uses the built-in inter-token "
+        "p99 / e2e p99 / rejection-rate trio (default unset; "
+        "telemetry/slo.py)"),
 }
 
 

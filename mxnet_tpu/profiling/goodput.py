@@ -1,0 +1,256 @@
+"""Per-device time accounting: where did the device-seconds go?
+
+The ``DeviceLedger`` conserves LEASE-level device-seconds (every
+chip-second belongs to exactly one owner or the free pool); this
+module classifies those same seconds into WORK bins from seams the
+stack already instruments, then cross-checks the two books — the
+census doctrine applied to time instead of bytes. Bins:
+
+====================  =====================================================
+``train_compute``     trainer ``step`` spans × dp width (productive)
+``serve_prefill``     ``generate.prefill`` + classic ``serving.execute``
+                      lane time (productive)
+``serve_decode``      ``generate.token`` decode-loop lane time (productive)
+``reshape_tax``       ``elastic.reshape`` spans × max(world_from, world_to)
+``recovery_tax``      ``generate.recover`` replay/migrate time
+``lend_transition``   ``cluster.lend``/``cluster.reclaim`` spans × chips,
+                      minus nested reshape time (already billed above)
+``idle``              remainder: ledger total − every classified second
+====================  =====================================================
+
+Goodput = productive ÷ total. Conservation is recomputed by consumers
+(``perf_gate --goodput``) from the raw numbers, never trusted from
+the artifact: per-owner classified seconds must fit inside
+``DeviceLedger.device_seconds()`` owner totals within tolerance, and
+the owner totals themselves must sum to world_size × elapsed.
+
+Everything here is span/dict arithmetic — no device handles, no sync
+(MXL002 scope covers the classify/collect paths).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+GOODPUT_KIND = "goodput/v1"
+GOODPUT_VERSION = 1
+
+BINS = ("train_compute", "reshape_tax", "serve_prefill",
+        "serve_decode", "recovery_tax", "lend_transition", "idle")
+PRODUCTIVE_BINS = ("train_compute", "serve_prefill", "serve_decode")
+TAX_BINS = ("reshape_tax", "recovery_tax", "lend_transition")
+
+# owner → the bins its ledger seconds may be classified into; the
+# cross-check books training's reshape and the lend handshake against
+# the training owner (the chips are still training-owned until the
+# ledger journal moves them) and serving's recovery replay against
+# serving
+OWNER_BINS = {
+    "training": ("train_compute", "reshape_tax", "lend_transition"),
+    "serving": ("serve_prefill", "serve_decode", "recovery_tax"),
+}
+
+
+def _w_step(attrs):
+    return max(int(attrs.get("dp", 1) or 1), 1)
+
+
+def _w_reshape(attrs):
+    return max(int(attrs.get("world_from", 1) or 1),
+               int(attrs.get("world_to", 1) or 1), 1)
+
+
+def _w_chips(attrs):
+    return max(int(attrs.get("chips", 1) or 1), 1)
+
+
+def _w_one(attrs):
+    return 1
+
+
+# span name → (bin, device-width from attrs). Children of these spans
+# (reshape.quiesce/gather/..., serving.batch under execute) are NOT
+# listed — billing a parent and its children would double-count
+SPAN_BINS = {
+    "step": ("train_compute", _w_step),
+    "trainer_step": ("train_compute", _w_step),
+    "elastic.reshape": ("reshape_tax", _w_reshape),
+    "cluster.lend": ("lend_transition", _w_chips),
+    "cluster.reclaim": ("lend_transition", _w_chips),
+    "generate.prefill": ("serve_prefill", _w_one),
+    "generate.token": ("serve_decode", _w_one),
+    "generate.recover": ("recovery_tax", _w_one),
+    "serving.execute": ("serve_prefill", _w_one),
+}
+
+
+def _clip(span, t0_ns, t1_ns):
+    """The span's [start, end) overlap with the window, in ns."""
+    s = span["start_ns"]
+    e = s + span.get("dur_ns", 0)
+    if t0_ns is not None:
+        s = max(s, t0_ns)
+    if t1_ns is not None:
+        e = min(e, t1_ns)
+    return max(e - s, 0)
+
+
+def _overlap_ns(a_start, a_end, b_start, b_end):
+    return max(min(a_end, b_end) - max(a_start, b_start), 0)
+
+
+def classify_spans(spans, t0_ns=None, t1_ns=None):
+    """Classify recorded spans into device-second bins over the
+    [t0_ns, t1_ns) window (None = unbounded). Returns
+    ``(bins, counts)``: seconds per bin (no ``idle`` — that needs the
+    ledger total) and counted spans per span name.
+
+    ``cluster.lend``/``cluster.reclaim`` CONTAIN the
+    ``elastic.reshape`` they trigger (the scheduler calls
+    ``trainer.reshape`` inside its span); the nested reshape interval
+    is subtracted from ``lend_transition`` at the lend span's chip
+    width so each wall-second is billed to exactly one bin.
+    """
+    bins = {b: 0.0 for b in BINS if b != "idle"}
+    counts = {}
+    reshapes = [(s["start_ns"], s["start_ns"] + s.get("dur_ns", 0))
+                for s in spans if s.get("name") == "elastic.reshape"]
+    for s in spans:
+        rule = SPAN_BINS.get(s.get("name"))
+        if rule is None:
+            continue
+        ns = _clip(s, t0_ns, t1_ns)
+        if ns <= 0:
+            continue
+        bin_name, width_fn = rule
+        width = width_fn(s.get("attrs") or {})
+        if bin_name == "lend_transition":
+            a0 = s["start_ns"] if t0_ns is None \
+                else max(s["start_ns"], t0_ns)
+            a1 = s["start_ns"] + s.get("dur_ns", 0)
+            if t1_ns is not None:
+                a1 = min(a1, t1_ns)
+            nested = sum(_overlap_ns(a0, a1, r0, r1)
+                         for r0, r1 in reshapes)
+            ns = max(ns - nested, 0)
+        bins[bin_name] += (ns / 1e9) * width
+        counts[s["name"]] = counts.get(s["name"], 0) + 1
+    return bins, counts
+
+
+def collect(device_seconds, spans, t0_ns=None, t1_ns=None, slo=None,
+            tolerance=0.05, provenance=None):
+    """Build the versioned goodput artifact.
+
+    ``device_seconds`` is the ``DeviceLedger.device_seconds()`` dict
+    (``by_owner``/``total``/``world_size``/``elapsed_s``/
+    ``conserved``) — the time ground truth. ``spans`` is a tracing
+    snapshot (``tracing.spans_snapshot()``); ``slo`` an optional
+    ``SLOTracker.to_doc()``. ``tolerance`` bounds the per-owner
+    classified-vs-ledger slack (classification bills wall × width
+    from spans, the ledger bills lease lifetimes — scheduling gaps
+    make classified ≤ ledger the invariant, never ==).
+    """
+    bins, counts = classify_spans(spans, t0_ns, t1_ns)
+    total = float(device_seconds["total"])
+    classified = sum(bins.values())
+    bins = dict(bins)
+    bins["idle"] = max(total - classified, 0.0)
+    productive = sum(bins[b] for b in PRODUCTIVE_BINS)
+    tax = sum(bins[b] for b in TAX_BINS)
+
+    by_owner = {}
+    owners_within = True
+    for owner, owned in OWNER_BINS.items():
+        ledger_s = float(device_seconds["by_owner"].get(owner, 0.0))
+        cls = sum(bins[b] for b in owned)
+        within = cls <= ledger_s * (1.0 + tolerance) + 0.05
+        owners_within = owners_within and within
+        by_owner[owner] = {"ledger_s": ledger_s,
+                           "classified_s": cls, "within": within}
+
+    world = int(device_seconds["world_size"])
+    elapsed = float(device_seconds["elapsed_s"])
+    expect = world * elapsed
+    owner_sum = sum(float(v)
+                    for v in device_seconds["by_owner"].values())
+    ledger_conserved = expect > 0 and \
+        abs(owner_sum - expect) <= 0.02 * expect
+    doc = {
+        "tool": "goodput",
+        "kind": GOODPUT_KIND,
+        "version": GOODPUT_VERSION,
+        "created": time.time(),
+        "window": {"elapsed_s": elapsed, "world_size": world,
+                   "t0_ns": t0_ns, "t1_ns": t1_ns},
+        "bins": bins,
+        "goodput": {
+            "productive_s": productive,
+            "tax_s": tax,
+            "idle_s": bins["idle"],
+            "total_s": total,
+            "fraction": (productive / total) if total > 0 else 0.0,
+        },
+        "by_owner": by_owner,
+        "device_seconds": device_seconds,
+        "conservation": {
+            "tolerance": tolerance,
+            "owner_sum_s": owner_sum,
+            "expected_s": expect,
+            "ledger_conserved": ledger_conserved,
+            "owners_within": owners_within,
+            "conserved": bool(ledger_conserved and owners_within
+                              and device_seconds.get("conserved")),
+        },
+        "spans": {"counted": sum(counts.values()),
+                  "by_name": counts},
+    }
+    if slo is not None:
+        doc["slo"] = slo
+    if provenance is not None:
+        doc["provenance"] = provenance
+    return doc
+
+
+def summary(doc, max_bytes=2048):
+    """Bounded, provenance-marked embed for bench artifacts (the
+    serving/health summary pattern): bins + fraction + conservation
+    verdict, guaranteed under ``max_bytes`` serialized."""
+    if not isinstance(doc, dict) or doc.get("kind") != GOODPUT_KIND:
+        return None
+    g = doc.get("goodput", {})
+    out = {
+        "kind": "goodput_summary",
+        "source": "profiling.goodput",
+        "fraction": g.get("fraction"),
+        "productive_s": g.get("productive_s"),
+        "tax_s": g.get("tax_s"),
+        "idle_s": g.get("idle_s"),
+        "total_s": g.get("total_s"),
+        "world_size": doc.get("window", {}).get("world_size"),
+        "conserved": doc.get("conservation", {}).get("conserved"),
+        "bins": {k: round(float(v), 4)
+                 for k, v in sorted(doc.get("bins", {}).items())},
+        "spans_counted": doc.get("spans", {}).get("counted"),
+    }
+    slo = doc.get("slo")
+    if isinstance(slo, dict):
+        out["slo_burn"] = {
+            o["name"]: o.get("burn")
+            for o in slo.get("objectives", [])[:8]}
+    # hard bound: drop detail until it fits (provenance keys survive)
+    for victim in ("slo_burn", "bins", "spans_counted"):
+        if len(json.dumps(out)) <= max_bytes:
+            break
+        out.pop(victim, None)
+    return out
+
+
+def dump(path, doc):
+    """Write the artifact atomically (tmp + rename)."""
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(json.dumps(doc, indent=1, sort_keys=True))
+    os.replace(tmp, path)
+    return doc

@@ -32,10 +32,12 @@ from ..base import get_env
 from . import metrics
 from . import step
 from . import export
+from . import timeline
+from . import slo
 from .metrics import enabled, registry
 
-__all__ = ["metrics", "step", "export", "enabled", "set_enabled",
-           "registry", "snapshot", "compile_scope"]
+__all__ = ["metrics", "step", "export", "timeline", "slo", "enabled",
+           "set_enabled", "registry", "snapshot", "compile_scope"]
 
 
 def set_enabled(on):
@@ -310,3 +312,5 @@ if enabled():
     _install_compile_listener()
     if get_env("MXTPU_TELEMETRY_FLUSH_SEC", 0.0, float) > 0:
         start_flusher()
+    if get_env("MXTPU_TIMELINE_SEC", 0.0, float) > 0:
+        timeline.start_ticker()

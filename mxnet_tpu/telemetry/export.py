@@ -119,7 +119,8 @@ def _json_safe(v):
 
 
 def merge_chrome_trace(snap=None, events=None, spans=None,
-                       attribution=None, memory=None, health=None):
+                       attribution=None, memory=None, health=None,
+                       timeline=None):
     """One chrome://tracing document carrying every observability
     layer: the profiler's trace events, the tracing spans (causal
     layer, PR 5), the metric snapshot — counters/gauges as 'C'
@@ -132,9 +133,14 @@ def merge_chrome_trace(snap=None, events=None, spans=None,
     ``health`` takes a model-health summary (``profiling.health
     .snapshot_doc``) — or ``True`` to fold one now — rendered as
     loss/grad-norm/nonfinite counter tracks beside the memory track.
-    All layers share tracing.clock's process epoch, so they land on
-    one Perfetto time axis. ``spans`` defaults to the process's
-    recorded spans; pass [] to omit them."""
+    ``timeline`` takes a ``timeline/v1`` frame-ring document
+    (``telemetry.timeline``) — or ``True`` to read the process
+    timeline now — rendered as HISTORICAL samples on the same counter
+    track names the snapshot 'C' events use, so every recorded frame
+    becomes a point on the metric's time axis instead of one
+    end-of-run value. All layers share tracing.clock's process epoch,
+    so they land on one Perfetto time axis. ``spans`` defaults to the
+    process's recorded spans; pass [] to omit them."""
     snap = snap if snap is not None else snapshot()
     from .. import profiler
     from .. import tracing as _tracing
@@ -187,6 +193,32 @@ def merge_chrome_trace(snap=None, events=None, spans=None,
             k: health.get(k)
             for k in ("kind", "sentry", "loss", "norms")
             if k in health}
+    if timeline is not None:
+        if timeline is True:
+            from . import timeline as _tl
+            timeline = _tl.process_timeline().to_doc()
+        for frame in timeline.get("frames", []):
+            fts = frame.get("ts_ns")
+            fts = ts if fts is None else fts / 1e3
+            for name, fam in sorted(frame.get("metrics",
+                                              {}).items()):
+                if fam["type"] == "histogram":
+                    continue
+                for s in fam["series"]:
+                    v = s["value"]
+                    if v != v or v in (float("inf"), float("-inf")):
+                        continue
+                    ev_name = name + _prom_labels(
+                        s.get("labels", {}))
+                    merged.append({"name": ev_name, "ph": "C",
+                                   "ts": fts, "pid": 0,
+                                   "args": {name: v}})
+        metadata["timeline"] = {
+            k: timeline.get(k)
+            for k in ("kind", "version", "window", "ticks_total")
+            if k in timeline}
+        metadata["timeline"]["frames"] = len(
+            timeline.get("frames", []))
     # nonfinite floats ANYWHERE in the document (a NaN loss gauge or a
     # NaN span attr IS the unhealthy run's payload) would serialize as
     # bare NaN/Infinity literals and make Perfetto reject the whole
@@ -199,9 +231,10 @@ def merge_chrome_trace(snap=None, events=None, spans=None,
 
 
 def dump_chrome_trace(path, snap=None, events=None, attribution=None,
-                      memory=None, health=None):
+                      memory=None, health=None, timeline=None):
     trace = merge_chrome_trace(snap, events, attribution=attribution,
-                               memory=memory, health=health)
+                               memory=memory, health=health,
+                               timeline=timeline)
     _atomic_text(path, json.dumps(trace))
     return trace
 

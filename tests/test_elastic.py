@@ -19,8 +19,9 @@ import pytest
 import mxnet_tpu as mx
 from mxnet_tpu.base import MXNetError
 from mxnet_tpu.elastic import (Autoscaler, ElasticTrainer, Membership,
-                               histogram_window_p99, named_leaves,
-                               unflatten_like, zero_shard_spec)
+                               named_leaves, unflatten_like,
+                               zero_shard_spec)
+from mxnet_tpu.telemetry.timeline import delta_quantile
 from mxnet_tpu.kvstore import fault
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -366,19 +367,22 @@ def test_autoscaler_p99_budget_pressure():
     assert sc.tick()[0] == "scale_out"
 
 
-def test_histogram_window_p99_math():
+def test_delta_quantile_math():
+    # the autoscaler's windowed p99 now rides the SHARED timeline
+    # implementation; these fixtures pin the PR-14 cumulative-vs-delta
+    # bug class against it
     # buckets at 10ms/100ms/1s; window adds 99 fast + 1 slow obs
     prev = (0, 0.0, [(0.01, 0), (0.1, 0), (1.0, 0), ("+Inf", 0)])
     cur = (100, 2.0, [(0.01, 99), (0.1, 99), (1.0, 100),
                       ("+Inf", 100)])
-    p99 = histogram_window_p99(prev, cur)
+    p99 = delta_quantile(prev, cur)
     assert 0.005 <= p99 <= 0.01            # p99 lands in bucket 1
-    assert histogram_window_p99(prev, prev) is None
-    assert histogram_window_p99(None, cur) is None
+    assert delta_quantile(prev, prev) is None
+    assert delta_quantile(None, cur) is None
     # all observations beyond the last finite edge: ceiling estimate
     prev2 = (0, 0.0, [(0.01, 0), ("+Inf", 0)])
     cur2 = (10, 50.0, [(0.01, 0), ("+Inf", 10)])
-    assert histogram_window_p99(prev2, cur2) == 0.01
+    assert delta_quantile(prev2, cur2) == 0.01
     # window mass SPANNING buckets (regression: cumulative deltas
     # were re-summed as densities, pulling the estimate under 100ms
     # when half the window sat at ~500ms): 50 obs at 5ms + 50 at
@@ -386,12 +390,12 @@ def test_histogram_window_p99_math():
     prev3 = (0, 0.0, [(0.01, 0), (0.1, 0), (1.0, 0), ("+Inf", 0)])
     cur3 = (100, 25.0, [(0.01, 50), (0.1, 50), (1.0, 100),
                         ("+Inf", 100)])
-    p99 = histogram_window_p99(prev3, cur3)
+    p99 = delta_quantile(prev3, cur3)
     assert 0.1 < p99 <= 1.0, p99
     # and a nonzero baseline (second window) must subtract cleanly
     cur4 = (200, 50.0, [(0.01, 100), (0.1, 100), (1.0, 200),
                         ("+Inf", 200)])
-    assert abs(histogram_window_p99(cur3, cur4) - p99) < 1e-9
+    assert abs(delta_quantile(cur3, cur4) - p99) < 1e-9
 
 
 # ===================================================================

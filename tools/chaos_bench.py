@@ -57,6 +57,11 @@ DEFAULT_OUT = os.path.join(
     "chaos_bench_%s.json" % time.strftime("%Y%m%d"))
 LAST_GOOD = os.path.join(REPO, "docs", "artifacts",
                          "CHAOS_LAST_GOOD.json")
+GOODPUT_OUT = os.path.join(
+    REPO, "docs", "artifacts",
+    "goodput_%s.json" % time.strftime("%Y%m%d"))
+GOODPUT_LAST_GOOD = os.path.join(REPO, "docs", "artifacts",
+                                 "GOODPUT_LAST_GOOD.json")
 
 
 def scenario_ok(s):
@@ -131,6 +136,13 @@ def main(argv=None):
                     default=None, metavar="PATH",
                     help="also copy the artifact to the committed "
                          "last-good (default %s)" % LAST_GOOD)
+    ap.add_argument("--goodput", nargs="?", const=GOODPUT_OUT,
+                    default=None, metavar="PATH",
+                    help="record the fleet-goodput window during the "
+                         "colocation scenario and write the "
+                         "goodput/v1 artifact here (default %s); "
+                         "with --last-good it is also copied to %s"
+                    % (GOODPUT_OUT, GOODPUT_LAST_GOOD))
     args = ap.parse_args(argv)
 
     from mxnet_tpu.elastic import chaos
@@ -149,7 +161,8 @@ def main(argv=None):
             streams=4 if args.quick else 6,
             max_new_tokens=24 if args.quick else 32),
         "colocation": lambda: chaos.run_colocation(
-            burst_s=2.5 if args.quick else 4.0),
+            burst_s=2.5 if args.quick else 4.0,
+            goodput=args.goodput is not None),
     }
     only = set(args.only)
     unknown = only - set(runners)
@@ -201,6 +214,25 @@ def main(argv=None):
             f.write(payload + "\n")
         os.replace(tmp, path)
         print("chaos_bench: wrote %s" % path)
+    if args.goodput is not None:
+        gp = (scenarios.get("colocation") or {}).get("goodput")
+        if gp is None:
+            print("chaos_bench: --goodput set but the colocation "
+                  "scenario produced no goodput window",
+                  file=sys.stderr)
+            rc = rc or 1
+        else:
+            gp_payload = json.dumps(gp, indent=1, sort_keys=True,
+                                    default=str)
+            gp_paths = [args.goodput]
+            if args.last_good:
+                gp_paths.append(GOODPUT_LAST_GOOD)
+            for path in gp_paths:
+                tmp = "%s.tmp.%d" % (path, os.getpid())
+                with open(tmp, "w", encoding="utf-8") as f:
+                    f.write(gp_payload + "\n")
+                os.replace(tmp, path)
+                print("chaos_bench: wrote %s" % path)
     print("chaos_bench: %s" % ("PASS" if rc == 0 else "FAILED"))
     return rc
 

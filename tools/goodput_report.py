@@ -1,0 +1,280 @@
+#!/usr/bin/env python
+"""goodput_report — render, diff, or trace fleet goodput artifacts.
+
+    python tools/goodput_report.py goodput_r01.json        # bin table
+    python tools/goodput_report.py --diff before.json after.json
+    python tools/goodput_report.py --timeline timeline.json
+    python tools/goodput_report.py --timeline timeline.json \\
+        --family mx_slo_burn_rate
+
+Inputs are ``mxnet_tpu.profiling.goodput`` documents
+({"kind": "goodput/v1"}) — bare, or embedded as a bounded summary
+under a bench artifact's ``goodput`` key — and, for ``--timeline``,
+the ``timeline/v1`` frame-ring artifact ``telemetry.timeline.dump``
+writes. ``--diff`` is the fleet-efficiency PR workflow: run on main,
+run on the branch, attach the per-bin device-second deltas and the
+goodput-fraction delta — mirroring ``memory_report --diff`` /
+``health_report --diff``; the pass/fail *gate* lives in
+``tools/perf_gate.py --goodput``.
+
+Rendering and diffing are stdlib-only (no jax import).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BIN_ORDER = ("train_compute", "serve_prefill", "serve_decode",
+             "reshape_tax", "recovery_tax", "lend_transition", "idle")
+
+
+def _read_json(path):
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        print("goodput_report: cannot read %s: %s" % (path, e),
+              file=sys.stderr)
+        raise SystemExit(2)
+
+
+def extract(doc):
+    """A goodput document from a bare artifact or a bench embed
+    (driver round file / raw line / last-good wrapper accepted)."""
+    if not isinstance(doc, dict):
+        return None
+    if doc.get("kind") == "goodput/v1":
+        return doc
+    if isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    if isinstance(doc.get("line"), str):
+        try:
+            doc = json.loads(doc["line"])
+        except ValueError:
+            return None
+    g = doc.get("goodput")
+    if isinstance(g, dict) and g.get("kind") == "goodput_summary":
+        # lift the bounded bench embed back into artifact shape so
+        # one renderer serves both
+        return {
+            "kind": "goodput/v1",
+            "version": 1,
+            "window": {"world_size": g.get("world_size"),
+                       "elapsed_s": None},
+            "bins": g.get("bins", {}),
+            "goodput": {k: g.get(k) for k in
+                        ("fraction", "productive_s", "tax_s",
+                         "idle_s", "total_s")},
+            "by_owner": {},
+            "conservation": {"conserved": g.get("conserved")},
+            "spans": {"counted": g.get("spans_counted")},
+            "slo": ({"objectives": [
+                {"name": k, "burn": v}
+                for k, v in sorted(g["slo_burn"].items())]}
+                if isinstance(g.get("slo_burn"), dict) else None),
+        }
+    if isinstance(g, dict) and g.get("kind") == "goodput/v1":
+        return g
+    return None
+
+
+def _fmt(v, nd=4):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return "%.*g" % (nd, v)
+    return str(v)
+
+
+def format_table(doc):
+    """Goodput headline + ranked bin table + owner cross-check + SLO
+    burn lines (docs/observability.md 'Fleet goodput & SLO' walks
+    this exact output)."""
+    g = doc.get("goodput", {})
+    w = doc.get("window", {})
+    cons = doc.get("conservation", {})
+    lines = ["# goodput: fraction %s · productive %ss of %ss · "
+             "world %s · conserved %s"
+             % (_fmt(g.get("fraction")), _fmt(g.get("productive_s")),
+                _fmt(g.get("total_s")), w.get("world_size", "?"),
+                cons.get("conserved", "?"))]
+    bins = doc.get("bins", {})
+    total = g.get("total_s") or 0.0
+    if bins:
+        lines.append("%-18s %12s %8s" % ("bin", "device-s", "share"))
+        ordered = [b for b in BIN_ORDER if b in bins] + \
+            sorted(set(bins) - set(BIN_ORDER))
+        for b in ordered:
+            v = float(bins[b])
+            share = ("%6.1f%%" % (100.0 * v / total)) if total > 0 \
+                else "      -"
+            lines.append("%-18s %12s %8s" % (b, _fmt(v), share))
+    for owner, o in sorted((doc.get("by_owner") or {}).items()):
+        lines.append("# owner %-9s ledger %10ss · classified %10ss "
+                     "· %s"
+                     % (owner, _fmt(o.get("ledger_s")),
+                        _fmt(o.get("classified_s")),
+                        "within" if o.get("within") else "OVERFLOW"))
+    slo = doc.get("slo")
+    if isinstance(slo, dict):
+        for o in slo.get("objectives", []):
+            winds = o.get("windows") or {}
+            detail = " ".join(
+                "%s %s" % (wn, _fmt((winds.get(wn) or {}).get("burn")))
+                for wn in ("fast", "slow") if wn in winds)
+            lines.append("# slo %-18s burn %-8s %s"
+                         % (o.get("name"), _fmt(o.get("burn")),
+                            detail))
+    sp = doc.get("spans", {})
+    if sp.get("counted") is not None:
+        top = sorted((sp.get("by_name") or {}).items(),
+                     key=lambda kv: -kv[1])[:6]
+        lines.append("# spans: %s counted%s"
+                     % (sp["counted"],
+                        (" (" + ", ".join("%s %d" % kv for kv in top)
+                         + ")") if top else ""))
+    return "\n".join(lines)
+
+
+def diff(before, after):
+    """Machine-readable goodput delta between two documents."""
+    ba, bb = before.get("bins", {}), after.get("bins", {})
+    by_bin = []
+    for b in sorted(set(ba) | set(bb)):
+        by_bin.append({"bin": b,
+                       "before_s": ba.get(b), "after_s": bb.get(b),
+                       "delta_s": (bb.get(b) or 0.0)
+                       - (ba.get(b) or 0.0)})
+    by_bin.sort(key=lambda r: -abs(r["delta_s"]))
+    ga, gb = before.get("goodput", {}), after.get("goodput", {})
+    out = {
+        "fraction_before": ga.get("fraction"),
+        "fraction_after": gb.get("fraction"),
+        "world_before": before.get("window", {}).get("world_size"),
+        "world_after": after.get("window", {}).get("world_size"),
+        "by_bin": by_bin,
+    }
+    fa, fb = ga.get("fraction"), gb.get("fraction")
+    if isinstance(fa, (int, float)) and isinstance(fb, (int, float)):
+        out["fraction_delta"] = fb - fa
+    return out
+
+
+def format_diff(d):
+    lines = ["# goodput fraction: %s -> %s%s"
+             % (_fmt(d.get("fraction_before")),
+                _fmt(d.get("fraction_after")),
+                (" (%+.4g)" % d["fraction_delta"])
+                if "fraction_delta" in d else ""),
+             "# world: %s -> %s" % (d.get("world_before"),
+                                    d.get("world_after"))]
+    shown = 0
+    for r in d["by_bin"]:
+        if r["delta_s"]:
+            lines.append("  %-18s %+10.4g s  (%s -> %s)"
+                         % (r["bin"], r["delta_s"],
+                            _fmt(r["before_s"]), _fmt(r["after_s"])))
+            shown += 1
+    if not shown:
+        lines.append("(no per-bin change)")
+    return "\n".join(lines)
+
+
+def format_timeline(doc, families):
+    """Per-frame trace of selected families from a ``timeline/v1``
+    ring artifact — the triage view for 'when did the burn start'."""
+    frames = doc.get("frames", [])
+    lines = ["# timeline: %d frames retained (window %s, %s ticks "
+             "total)" % (len(frames), doc.get("window"),
+                         doc.get("ticks_total"))]
+    if not frames:
+        return "\n".join(lines)
+    t0 = frames[0].get("ts", 0.0)
+    for fam in families:
+        lines.append("# %s" % fam)
+        seen = False
+        for f in frames:
+            m = (f.get("metrics") or {}).get(fam)
+            if m is None:
+                continue
+            seen = True
+            cells = []
+            for s in m.get("series", [])[:6]:
+                lbl = ",".join("%s=%s" % kv for kv in
+                               sorted((s.get("labels") or {}).items()))
+                val = s.get("value", s.get("count"))
+                cells.append("%s=%s" % (lbl or "_", _fmt(val)))
+            lines.append("  t+%-8.2fs %s"
+                         % (f.get("ts", 0.0) - t0, "  ".join(cells)))
+        if not seen:
+            lines.append("  (family absent from every frame)")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="goodput_report",
+                                 description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="goodput artifact / bench document(s)")
+    ap.add_argument("--diff", action="store_true",
+                    help="diff two documents (before after)")
+    ap.add_argument("--timeline", metavar="PATH",
+                    help="render a timeline/v1 frame-ring artifact")
+    ap.add_argument("--family", action="append", default=[],
+                    help="metric family to trace with --timeline "
+                         "(repeatable; default mx_slo_burn_rate + "
+                         "mx_cluster_device_seconds_total)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the document itself instead of a table")
+    args = ap.parse_args(argv)
+
+    if args.timeline:
+        doc = _read_json(args.timeline)
+        if doc.get("kind") != "timeline/v1":
+            print("goodput_report: %s is not a timeline/v1 document"
+                  % args.timeline, file=sys.stderr)
+            return 2
+        fams = args.family or ["mx_slo_burn_rate",
+                               "mx_cluster_device_seconds_total"]
+        print(json.dumps(doc, indent=1, sort_keys=True) if args.json
+              else format_timeline(doc, fams))
+        return 0
+
+    if args.diff:
+        if len(args.paths) != 2:
+            print("goodput_report: --diff takes exactly two documents",
+                  file=sys.stderr)
+            return 2
+        docs = []
+        for p in args.paths:
+            g = extract(_read_json(p))
+            if g is None:
+                print("goodput_report: %s carries no goodput document"
+                      % p, file=sys.stderr)
+                return 2
+            docs.append(g)
+        d = diff(*docs)
+        print(json.dumps(d, indent=1, sort_keys=True) if args.json
+              else format_diff(d))
+        return 0
+
+    if len(args.paths) != 1:
+        print("goodput_report: exactly one document unless --diff/"
+              "--timeline", file=sys.stderr)
+        return 2
+    g = extract(_read_json(args.paths[0]))
+    if g is None:
+        print("goodput_report: %s carries no goodput document"
+              % args.paths[0], file=sys.stderr)
+        return 2
+    print(json.dumps(g, indent=1, sort_keys=True) if args.json
+          else format_table(g))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

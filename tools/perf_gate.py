@@ -9,6 +9,7 @@
     python tools/perf_gate.py kernel_bench.json --kernels
     python tools/perf_gate.py chaos_bench.json --chaos
     python tools/perf_gate.py lockgraph.json --locks
+    python tools/perf_gate.py goodput.json --goodput
 
 ``--io`` gates a tools/io_bench.py version-2 artifact instead: every
 stage's img/s must stay within tolerance of the committed last-good
@@ -66,6 +67,18 @@ last-good does not carry, and neither a suite nor a lock node
 witnessed by last-good may vanish from the candidate (dropped
 coverage is itself a regression).
 
+``--goodput`` gates a goodput/v1 artifact (``chaos_bench --goodput``
+over the colocation scenario) against
+``docs/artifacts/GOODPUT_LAST_GOOD.json`` — the fleet time-accounting
+plane as a CI contract: the goodput fraction is a floor vs last-good,
+device-second conservation is RECOMPUTED from the raw ledger numbers
+(owners sum to world x elapsed; each owner's classified bins fit
+inside its ledger grant), the seven-bin taxonomy is closed (a missing
+bin, or a bin last-good measured nonzero collapsing to zero, hides
+its seconds in idle), a shrunken world is a dropped device, and the
+SLO burn section cannot vanish while last-good evaluates objectives.
+A zero-total artifact is bare-zero (exit 3).
+
 ``--kernels`` gates a tools/kernel_bench.py version-1 artifact
 against ``docs/artifacts/KERNELS_LAST_GOOD.json``: every kernel the
 last-good artifact carries must be present (a dropped kernel cannot
@@ -121,6 +134,8 @@ DEFAULT_CHAOS_LAST_GOOD = os.path.join(REPO, "docs", "artifacts",
                                        "CHAOS_LAST_GOOD.json")
 DEFAULT_LOCKS_LAST_GOOD = os.path.join(REPO, "docs", "artifacts",
                                        "LOCKS_LAST_GOOD.json")
+DEFAULT_GOODPUT_LAST_GOOD = os.path.join(REPO, "docs", "artifacts",
+                                         "GOODPUT_LAST_GOOD.json")
 
 # the elasticity plane's advertised scenario families: an artifact
 # missing one of these has not exercised the SLO it claims to gate
@@ -985,6 +1000,142 @@ def gate_chaos(candidate, last_good, tolerance=0.25):
     return rc, msgs
 
 
+# the goodput artifact's bin taxonomy, replicated (the gate must not
+# import the package): every bin must be present, the owner map drives
+# the recomputed classified-vs-ledger cross-check
+GOODPUT_BINS = ("train_compute", "reshape_tax", "serve_prefill",
+                "serve_decode", "recovery_tax", "lend_transition",
+                "idle")
+GOODPUT_PRODUCTIVE = ("train_compute", "serve_prefill", "serve_decode")
+GOODPUT_OWNER_BINS = {
+    "training": ("train_compute", "reshape_tax", "lend_transition"),
+    "serving": ("serve_prefill", "serve_decode", "recovery_tax"),
+}
+
+
+def gate_goodput(candidate, last_good, tolerance=0.25,
+                 conserve_tol=0.05):
+    """(exit_code, [messages]) for a goodput/v1 artifact pair
+    (``profiling.goodput.collect`` via ``chaos_bench --goodput``).
+
+    Conservation is RECOMPUTED from the raw numbers, never trusted
+    from the artifact's own ``conserved`` flag: per-owner ledger
+    seconds must sum to world_size x elapsed (2%), and each owner's
+    classified bins must fit inside its ledger seconds
+    (``conserve_tol`` slack — classification can undercount across
+    scheduling gaps, never overcount). The goodput fraction is a
+    FLOOR vs last-good; a dropped device (world shrink), a dropped or
+    zeroed bin that last-good measured nonzero, and a dropped SLO
+    burn section are each regressions — attribution coverage cannot
+    silently shrink out of its own gate. A zero-total artifact is
+    bare-zero (exit 3): it measured nothing and proves nothing."""
+    msgs = []
+    rc = 0
+    if candidate.get("kind") != "goodput/v1" or \
+            candidate.get("version") != 1:
+        return 2, ["not a version-1 goodput artifact"]
+    bins = candidate.get("bins") or {}
+    g = candidate.get("goodput") or {}
+    total = g.get("total_s")
+    if not isinstance(total, (int, float)) or total <= 0 or not bins:
+        return 3, ["goodput artifact measured no device-seconds "
+                   "(signal-free — rejected)"]
+    # -- bin taxonomy: all seven present, and none that last-good
+    # measured nonzero may vanish or collapse to zero ----------------
+    good_bins = last_good.get("bins") or {}
+    for b in GOODPUT_BINS:
+        if b not in bins:
+            rc = 1
+            msgs.append("REGRESSION goodput: bin '%s' missing from "
+                        "the artifact (the taxonomy is closed — a "
+                        "dropped bin hides its seconds in idle)" % b)
+        elif good_bins.get(b, 0) and not bins.get(b):
+            rc = 1
+            msgs.append("REGRESSION goodput: bin '%s' is zero but "
+                        "last good measured %.3fs — the seam that "
+                        "fed it went dark" % (b, good_bins[b]))
+    # -- recomputed ledger conservation: owners sum to world x elapsed
+    ds = candidate.get("device_seconds") or {}
+    by_owner = ds.get("by_owner") or {}
+    owner_sum = sum(v for v in by_owner.values()
+                    if isinstance(v, (int, float)))
+    world = ds.get("world_size") or 0
+    elapsed = ds.get("elapsed_s") or 0
+    expect = world * elapsed
+    if not (expect > 0 and abs(owner_sum - expect) <= 0.02 * expect):
+        rc = 1
+        msgs.append("REGRESSION goodput: device-seconds NOT "
+                    "conserved (owners sum %.3f vs world x elapsed "
+                    "%.3f)" % (owner_sum, expect))
+    else:
+        msgs.append("goodput: %.1f device-seconds conserved across "
+                    "%d owners (ok)" % (owner_sum, len(by_owner)))
+    # -- recomputed attribution fit: classified <= ledger per owner --
+    for owner, owned in sorted(GOODPUT_OWNER_BINS.items()):
+        ledger_s = by_owner.get(owner)
+        if not isinstance(ledger_s, (int, float)):
+            rc = 1
+            msgs.append("REGRESSION goodput: owner '%s' missing from "
+                        "the ledger device-seconds" % owner)
+            continue
+        cls = sum(bins.get(b) or 0 for b in owned)
+        if cls > ledger_s * (1.0 + conserve_tol) + 0.05:
+            rc = 1
+            msgs.append("REGRESSION goodput: %s bins sum %.3fs but "
+                        "the ledger only granted %.3fs — double-"
+                        "billed spans" % (owner, cls, ledger_s))
+        else:
+            msgs.append("goodput: %s classified %.2fs within ledger "
+                        "%.2fs (ok)" % (owner, cls, ledger_s))
+    # -- world floor: a dropped device shrinks the denominator and
+    # flatters every fraction -----------------------------------------
+    good_world = (last_good.get("device_seconds")
+                  or {}).get("world_size")
+    if isinstance(good_world, (int, float)) and world < good_world:
+        rc = 1
+        msgs.append("REGRESSION goodput: world shrank to %d devices "
+                    "(last good accounted %d)" % (world, good_world))
+    # -- goodput fraction floor vs last-good --------------------------
+    frac = g.get("fraction")
+    good_frac = (last_good.get("goodput") or {}).get("fraction")
+    if not isinstance(frac, (int, float)):
+        rc = 1
+        msgs.append("REGRESSION goodput: no goodput fraction in the "
+                    "artifact")
+    elif isinstance(good_frac, (int, float)) and good_frac > 0:
+        floor = good_frac * (1.0 - tolerance)
+        if frac < floor:
+            rc = 1
+            msgs.append("REGRESSION goodput: fraction %.4f < %.4f "
+                        "(last good %.4f, tolerance %.0f%%)"
+                        % (frac, floor, good_frac, tolerance * 100))
+        else:
+            msgs.append("goodput: fraction %.4f >= %.4f floor (ok)"
+                        % (frac, floor))
+    # -- SLO burn section: present whenever last-good carries one ----
+    slo = candidate.get("slo")
+    if isinstance(last_good.get("slo"), dict):
+        good_objs = {o.get("name")
+                     for o in last_good["slo"].get("objectives", [])}
+        if not isinstance(slo, dict):
+            rc = 1
+            msgs.append("REGRESSION goodput: SLO burn section "
+                        "dropped (last good evaluates %d objectives)"
+                        % len(good_objs))
+        else:
+            mine_objs = {o.get("name")
+                         for o in slo.get("objectives", [])}
+            missing = sorted(good_objs - mine_objs)
+            if missing:
+                rc = 1
+                msgs.append("REGRESSION goodput: burn-rate "
+                            "objectives dropped: %s" % missing)
+            else:
+                msgs.append("goodput: %d SLO objectives evaluated "
+                            "(ok)" % len(mine_objs))
+    return rc, msgs
+
+
 def _lock_cycles(edges):
     """Representative cycles over an artifact's edge list, recomputed
     here so a hand-edited ``cycles: []`` cannot sneak a cyclic graph
@@ -1254,7 +1405,34 @@ def main(argv=None):
                          "cycle, new blocking-under-lock event, or "
                          "dropped suite/lock coverage vs last-good "
                          "is a regression")
+    ap.add_argument("--goodput", action="store_true",
+                    help="gate a goodput/v1 artifact (chaos_bench "
+                         "--goodput): fraction floor vs last-good, "
+                         "device-second conservation recomputed from "
+                         "the raw ledger numbers, no dropped bin/"
+                         "device/SLO objective")
     args = ap.parse_args(argv)
+    if args.goodput:
+        last_good_path = args.last_good
+        if last_good_path == DEFAULT_LAST_GOOD:
+            last_good_path = DEFAULT_GOODPUT_LAST_GOOD
+        try:
+            with open(args.artifact, "r", encoding="utf-8") as f:
+                candidate = json.load(f)
+            with open(last_good_path, "r", encoding="utf-8") as f:
+                last_good = json.load(f)
+        except (OSError, ValueError) as e:
+            print("perf_gate: cannot read goodput artifact: %s" % e,
+                  file=sys.stderr)
+            return 2
+        rc, msgs = gate_goodput(candidate, last_good,
+                                tolerance=args.tolerance)
+        for m in msgs:
+            print(m)
+        print("perf_gate: %s"
+              % {0: "PASS", 1: "REGRESSION", 2: "UNREADABLE",
+                 3: "BARE-ZERO"}.get(rc, rc))
+        return rc
     if args.locks:
         last_good_path = args.last_good
         if last_good_path == DEFAULT_LAST_GOOD:
