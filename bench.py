@@ -143,6 +143,26 @@ def _serving_summary():
     return out
 
 
+def _tail_summary():
+    """Bounded tail-attribution headline from the committed last-good
+    tail artifact (docs/artifacts/TAIL_LAST_GOOD.json) — slow-cohort
+    blame drivers + conservation verdict under 2KB, provenance
+    explicit (the serving storm runs on its own cadence). Refresh
+    path: tools/serving_bench.py --tail-json + perf_gate --tail."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "docs", "artifacts", "TAIL_LAST_GOOD.json")
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    from mxnet_tpu.profiling import tailpath as _tailpath
+    out = _tailpath.summary(doc, max_bytes=2048)
+    if out is not None:
+        out["source"] = "last_good_artifact"
+    return out
+
+
 def _goodput_summary():
     """Bounded fleet-goodput headline from the committed last-good
     goodput artifact (docs/artifacts/GOODPUT_LAST_GOOD.json) — bins,
@@ -1489,6 +1509,11 @@ def main():
         # bounded fleet-goodput headline (last-good copy, provenance
         # marked) — "and where do the fleet's device-seconds go?"
         result["goodput"] = goodput
+    tail = _tail_summary()
+    if tail is not None:
+        # bounded tail-attribution headline (last-good copy) — "and
+        # why are the slow requests slow?"
+        result["tail"] = tail
     kernels = _kernels_summary()
     if kernels is not None:
         # bounded Pallas-fleet headline (parity + fallback timings)

@@ -86,7 +86,11 @@ _SCOPES = (
       "_fold_entries", "_fold_loss", "_trip",
       "live_census", "buffer_intervals", "build_memory_ledger",
       "group_buffers_by_op", "_sweep_peak",
-      "classify_spans", "collect", "_clip", "_overlap_ns"}, set()),
+      "classify_spans", "collect", "_clip", "_overlap_ns",
+      # tailpath: the per-request critical-path joiner/recorder runs
+      # on serving reply paths — span-dict arithmetic only, a device
+      # sync here would stall the scheduler loop it attributes
+      "attribute_request", "join_spans", "ingest_spans"}, set()),
     # the cost-tracked partitioner runs at TRACE/bind time: selector
     # growth, cluster pricing (abstract lowering only — ShapeDtype
     # structs, never arrays) and the gate decision. A device sync here

@@ -382,6 +382,24 @@ _ENV_VARS = {
         "slo.DEFAULT_OBJECTIVES); unset uses the built-in inter-token "
         "p99 / e2e p99 / rejection-rate trio (default unset; "
         "telemetry/slo.py)"),
+    "MXTPU_TAIL_ENABLE": (
+        "1 = the serving schedulers stamp per-request critical-path "
+        "decision events and the tail joiner attributes them; 0 "
+        "disables the whole tail-attribution plane (default 1; "
+        "profiling/tailpath.py, docs/observability.md)"),
+    "MXTPU_TAIL_WINDOW": (
+        "completed requests the tail aggregator retains in its "
+        "sliding window before the oldest is evicted (default 512; "
+        "profiling/tailpath.py)"),
+    "MXTPU_TAIL_SLOW_FRAC": (
+        "fraction of the windowed requests treated as the slow "
+        "cohort whose blame bins rank the tail drivers (default 0.1 "
+        "= slowest decile; profiling/tailpath.py)"),
+    "MXTPU_TAIL_ARTIFACT": (
+        "path a tail/v1 attribution artifact is dumped to by "
+        "consumers that honor it (serving_bench --tail-json "
+        "overrides; default unset = no auto-dump; "
+        "profiling/tailpath.py, tools/serving_bench.py)"),
 }
 
 

@@ -51,8 +51,8 @@ class Request:
 
     __slots__ = ("model", "variant", "data", "rows", "trace_ctx",
                  "submit_ns", "dequeue_ns", "exec_start_ns",
-                 "exec_end_ns", "attempts", "_event", "_result",
-                 "_error")
+                 "exec_end_ns", "attempts", "hold_ns", "requeue_ns",
+                 "_event", "_result", "_error")
 
     def __init__(self, model, variant, data, trace_ctx):
         self.model = model
@@ -65,6 +65,11 @@ class Request:
         self.exec_start_ns = 0
         self.exec_end_ns = 0
         self.attempts = 0
+        # tail-attribution decision events (profiling/tailpath.py):
+        # time this request's batch spent in the coalescing hold
+        # window, and time lost to failed-replica requeues
+        self.hold_ns = 0
+        self.requeue_ns = 0
         self._event = threading.Event()
         self._result = None
         self._error = None
@@ -135,8 +140,15 @@ class ModelQueue:
     def requeue(self, reqs):
         """Failed-replica redistribution: back at the front, original
         order preserved."""
+        now = clock.now_ns()
         with self._cond:
             for req in reversed(reqs):
+                # the failed attempt's dequeue->now is lost wall the
+                # tail plane bills to `requeue`; the retry re-stamps
+                # dequeue_ns when its batch actually runs
+                if req.dequeue_ns:
+                    req.requeue_ns += max(now - req.dequeue_ns, 0)
+                    req.dequeue_ns = 0
                 self._by_variant.setdefault(
                     req.variant, deque()).appendleft(req)
                 self._rows += req.rows
@@ -189,12 +201,21 @@ class ModelQueue:
             batch = [first]
             rows = self._scoop(dq, batch, first.rows)
             deadline_ns = first.submit_ns + int(self.max_wait_s * 1e9)
+            hold_ns = 0
             while rows < self.max_rows and not self.closed:
-                remaining = (deadline_ns - clock.now_ns()) / 1e9
+                now = clock.now_ns()
+                remaining = (deadline_ns - now) / 1e9
                 if remaining <= 0:
                     break
                 self._cond.wait(remaining)
+                hold_ns += clock.now_ns() - now
                 rows = self._scoop(dq, batch, rows)
+            # batch-formation hold: wall spent fishing for batch-mates
+            # after the batch could have dispatched — stamped on every
+            # member so the tail plane can split its queue wait into
+            # backlog vs hold (clipped per request at join time)
+            for r in batch:
+                r.hold_ns = hold_ns
             return variant, batch
 
 

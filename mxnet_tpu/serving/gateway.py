@@ -192,7 +192,7 @@ class Replica:
             r._set_result([o[off:off + r.rows] for o in outs])
             off += r.rows
             m._reply(r, bucket=bucket, batch_size=len(batch),
-                     replica=self.idx)
+                     replica=self.idx, rows=rows)
         m._observe_rate(rows, (t1 - t0) / 1e9)
 
     def _fail(self, batch, err):
@@ -290,7 +290,7 @@ class Model:
         return backlog / (rate * healthy) + exec_s
 
     # -- reply-side recording ------------------------------------------------
-    def _reply(self, req, bucket, batch_size, replica):
+    def _reply(self, req, bucket, batch_size, replica, rows=None):
         t_reply = clock.now_ns()
         met = _met()
         lat = met["latency"]
@@ -310,10 +310,15 @@ class Model:
             "serving.request", trace_id, parent, req.submit_ns, t_reply,
             cat="serving",
             attrs={"model": name, "variant": req.variant,
-                   "rows": req.rows})
+                   "rows": req.rows, "attempts": req.attempts})
+        # typed queue-wait decomposition for the tail plane
+        # (profiling/tailpath.py): coalescing hold + requeue loss are
+        # causes INSIDE the queue interval, stamped as attributes
         tracing.record_span("serving.queue", trace_id, root,
                             req.submit_ns, req.dequeue_ns,
-                            cat="serving")
+                            cat="serving",
+                            attrs={"hold_ns": req.hold_ns,
+                                   "requeue_ns": req.requeue_ns})
         tracing.record_span("serving.batch", trace_id, root,
                             req.dequeue_ns, req.exec_start_ns,
                             cat="serving",
@@ -323,7 +328,9 @@ class Model:
                             req.exec_start_ns, req.exec_end_ns,
                             cat="serving",
                             attrs={"bucket": bucket, "replica": replica,
-                                   "variant": req.variant})
+                                   "variant": req.variant,
+                                   "rows": (rows if rows is not None
+                                            else req.rows)})
         tracing.record_span("serving.reply", trace_id, root,
                             req.exec_end_ns, t_reply, cat="serving")
 

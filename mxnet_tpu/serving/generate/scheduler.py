@@ -134,10 +134,13 @@ class GenRequest:
 
     __slots__ = ("model", "prompt", "max_new_tokens", "trace_ctx",
                  "submit_ns", "first_token_ns", "last_token_ns",
-                 "tokens", "token_spans", "table", "next_pos",
-                 "reserved_blocks", "finish_reason", "recoveries",
-                 "recover_spans", "_salvage", "_recover_t0",
-                 "_recovered", "_cv", "_done", "_error")
+                 "tokens", "token_spans", "step_meta", "table",
+                 "next_pos", "reserved_blocks", "finish_reason",
+                 "recoveries", "recover_spans", "admit_ns",
+                 "kv_wait_ns", "queue_cause", "prefill_exec_ns",
+                 "prompt_pad", "_kv_wait_t0", "_recover_cause",
+                 "_salvage", "_recover_t0", "_recovered", "_cv",
+                 "_done", "_error")
 
     def __init__(self, model, prompt, max_new_tokens, trace_ctx):
         self.model = model
@@ -149,12 +152,23 @@ class GenRequest:
         self.last_token_ns = 0
         self.tokens = []
         self.token_spans = []
+        self.step_meta = []       # (interleave_ns, rows, bucket)/token
         self.table = None
         self.next_pos = 0
         self.reserved_blocks = 0
         self.finish_reason = None
         self.recoveries = 0       # times this request survived a lane
         self.recover_spans = []   # (start_ns, end_ns, attrs) per rescue
+        # tail-attribution decision events (profiling/tailpath.py):
+        # when the request was first admitted, how long its admission
+        # sat blocked on KV budget, and the dominant queue-wait cause
+        self.admit_ns = 0
+        self.kv_wait_ns = 0
+        self.queue_cause = None
+        self.prefill_exec_ns = 0
+        self.prompt_pad = 0
+        self._kv_wait_t0 = 0
+        self._recover_cause = None
         self._salvage = None      # KV blocks gathered off a dead lane
         self._recover_t0 = 0
         self._recovered = False   # next emit is the post-rescue token
@@ -288,10 +302,17 @@ class GenLane:
             if admit:
                 m._observe_depth()     # the waiting set just shrank
             try:
+                t_adm = clock.now_ns()
                 for req in admit:
                     self._start(req)
+                # admission work (prefill/replay/migrate landing) runs
+                # BEFORE the next decode step: every already-running
+                # request's next token is held behind it — the
+                # prefill-interleave stall the tail plane attributes
+                # per decode step (profiling/tailpath.py)
+                interleave_ns = clock.now_ns() - t_adm if admit else 0
                 if self.running:
-                    self._step()
+                    self._step(interleave_ns)
             except Exception as e:  # noqa: BLE001 — a failed step
                 # evacuates ITS requests onto the surviving lanes
                 # (possibly this one); the lane survives for new work
@@ -310,6 +331,7 @@ class GenLane:
         the moment a retire frees budget."""
         m = self._model
         admit = []
+        now = clock.now_ns()
         while self.waiting and \
                 len(self.running) + len(admit) < m.max_decode_batch:
             req = self.waiting[0]
@@ -317,10 +339,30 @@ class GenLane:
                 need = self.pool.blocks_for(
                     len(req.prompt) + req.max_new_tokens)
                 if not self.pool.reserve(need):
+                    # head blocked on cache budget: from here on its
+                    # queue wait is KV pressure, not backlog — the
+                    # tail plane bills it to kv_wait
+                    if not req._kv_wait_t0:
+                        req._kv_wait_t0 = now
+                    req.queue_cause = "kv_wait"
                     break
                 req.reserved_blocks = need
+            if req._kv_wait_t0:
+                req.kv_wait_ns += max(now - req._kv_wait_t0, 0)
+                req._kv_wait_t0 = 0
+            if not req.admit_ns:      # first admission wins: a
+                req.admit_ns = now    # recovery re-admission is billed
+                                      # to recovery, not queue wait
+            if req.queue_cause is None:
+                req.queue_cause = "backlog" if (self.running or admit) \
+                    else "none"
             self.waiting.popleft()
             admit.append(req)
+        if self.waiting and \
+                len(self.running) + len(admit) >= m.max_decode_batch:
+            head = self.waiting[0]
+            if head.queue_cause is None:
+                head.queue_cause = "batch_full"
         return admit
 
     def _evacuate(self, doomed):
@@ -409,6 +451,7 @@ class GenLane:
         req.recover_spans.append((
             req._recover_t0 or now, now,
             {"mode": "migrate", "lane": self.idx,
+             "cause": req._recover_cause,
              "blocks": handoff["blocks"],
              "bytes_moved": handoff["bytes_moved"],
              "est_s": handoff["est_s"]}))
@@ -474,6 +517,7 @@ class GenLane:
         req.recover_spans.append((
             req._recover_t0 or now, now,
             {"mode": "replay", "lane": self.idx,
+             "cause": req._recover_cause,
              "prompt_tokens": plen,
              "replayed_tokens": len(accepted)}))
         req._recovered = True
@@ -498,10 +542,12 @@ class GenLane:
         tok_dev = self.steps.prefill(
             tokens, plen, req.table.row[:tpad // self.pool.block_tokens])
         tok = int(self._host_tokens(tok_dev))
+        req.prefill_exec_ns = clock.now_ns() - t0
+        req.prompt_pad = tpad
         req.next_pos = plen
         met["tokens"].labels(model=m.name, phase="prefill").inc(plen)
         met["steps"].labels(model=m.name, phase="prefill").inc()
-        self._emit(req, tok, t0, clock.now_ns())
+        self._emit(req, tok, t0, clock.now_ns(), rows=plen, bucket=tpad)
         if req.finish_reason is None:
             self.running.append(req)
             met["inflight"].labels(model=m.name,
@@ -510,8 +556,11 @@ class GenLane:
         else:
             self._retire(req)
 
-    def _step(self):
-        """One iteration-level decode step over the running batch."""
+    def _step(self, interleave_ns=0):
+        """One iteration-level decode step over the running batch.
+        ``interleave_ns`` is the admission work (prefill/replay) that
+        held this step — stamped on every emitted token so the tail
+        plane can blame the stall per request."""
         m = self._model
         met = _met()
         live = self.running
@@ -535,7 +584,9 @@ class GenLane:
         finished = []
         for i, req in enumerate(live):
             req.next_pos += 1
-            self._emit(req, int(toks[i]), t0, t1)
+            self._emit(req, int(toks[i]), t0, t1,
+                       interleave_ns=interleave_ns, rows=len(live),
+                       bucket=bucket)
             if req.finish_reason is not None:
                 finished.append(req)
         for req in finished:
@@ -551,9 +602,13 @@ class GenLane:
         everything else on the step path is host bookkeeping."""
         return np.asarray(tok_dev)
 
-    def _emit(self, req, tok, step_start_ns, now_ns):
+    def _emit(self, req, tok, step_start_ns, now_ns, interleave_ns=0,
+              rows=1, bucket=1):
         """Record + stream one generated token; marks the request
-        finished when it hits EOS or its budget."""
+        finished when it hits EOS or its budget. The step metadata
+        (interleave stall, real rows, padded bucket) rides along so
+        retirement can stamp it onto the token spans — the tail
+        plane's per-step blame inputs."""
         m = self._model
         met = _met()
         phase = "recover" if req._recovered else "steady"
@@ -568,6 +623,7 @@ class GenLane:
                 (now_ns - req.last_token_ns) / 1e9)
         req.last_token_ns = now_ns
         req.token_spans.append((step_start_ns, now_ns))
+        req.step_meta.append((interleave_ns, rows, bucket))
         req._push_token(tok)
         if m.eos_id is not None and tok == m.eos_id:
             req.finish_reason = "eos"
@@ -609,6 +665,8 @@ class GenLane:
         if not trace_id:
             return
         end = req.last_token_ns or clock.now_ns()
+        admit_wait = max(req.admit_ns - req.submit_ns, 0) \
+            if req.admit_ns else 0
         root = tracing.record_span(
             "serving.generate", trace_id, parent, req.submit_ns, end,
             cat="serving",
@@ -616,19 +674,29 @@ class GenLane:
                    "prompt_tokens": len(req.prompt),
                    "new_tokens": len(req.tokens),
                    "recoveries": req.recoveries,
+                   "queue_cause": req.queue_cause or "none",
                    "finish": ("error" if error is not None
                               else req.finish_reason)})
         if req.first_token_ns:
             tracing.record_span(
                 "generate.prefill", trace_id, root, req.submit_ns,
                 req.first_token_ns, cat="serving",
-                attrs={"prompt_tokens": len(req.prompt)})
+                attrs={"prompt_tokens": len(req.prompt),
+                       "pad_tokens": req.prompt_pad,
+                       "queue_ns": admit_wait,
+                       "kv_wait_ns": req.kv_wait_ns,
+                       "exec_ns": req.prefill_exec_ns})
         for s, e, attrs in req.recover_spans:
             tracing.record_span("generate.recover", trace_id, root,
                                 s, e, cat="serving", attrs=attrs)
         for j, (s, e) in enumerate(req.token_spans):
+            attrs = {"index": j}
+            if j < len(req.step_meta):
+                inter, rows, bucket = req.step_meta[j]
+                attrs.update(interleave_ns=inter, rows=rows,
+                             bucket=bucket)
             tracing.record_span("generate.token", trace_id, root, s, e,
-                                cat="serving", attrs={"index": j})
+                                cat="serving", attrs=attrs)
 
 
 class GenModel:
@@ -814,6 +882,10 @@ class GenModel:
             rround = self._recovery_round
         storm = _fault.replay_storm_active(rround, plan=self.fault_plan)
         for req in reqs:
+            # typed cause on the eventual generate.recover span: the
+            # tail plane bills reclaim/drain pauses separately from
+            # unplanned-crash recovery (profiling/tailpath.py)
+            req._recover_cause = cause
             if self.closed:
                 src_lane._retire(req, error=ServingError(
                     f"generate: model {self.name!r} shut down before "

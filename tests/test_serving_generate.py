@@ -340,6 +340,71 @@ def test_per_token_trace_spans(gateway):
     assert all(s["parent"] == root["span"] for s in tok)
 
 
+def test_midflight_join_leave_step_events_complete(gateway):
+    """Tail-attribution contract under iteration-level scheduling: B
+    joins while A decodes and leaves first (B's budget is smaller), and
+    EVERY decode step of both requests still carries complete step
+    events — token spans with index/rows/bucket/interleave_ns, the
+    prefill with queue/kv/exec stamps — so the critical-path joiner
+    reconstructs both timelines with no gaps (attributed never exceeds
+    the measured e2e, the residual stays bounded)."""
+    from mxnet_tpu import tracing
+    from mxnet_tpu.profiling import tailpath
+
+    with tracing.span("client_join_leave") as client:
+        trace_id = client.trace_id
+        ra = gateway.submit_generate("lm", [2, 4, 6], max_new_tokens=12)
+        deadline = time.time() + 5.0
+        while not ra.tokens and time.time() < deadline:
+            time.sleep(0.001)               # A is mid-decode...
+        rb = gateway.submit_generate("lm", [3, 5, 7], max_new_tokens=4)
+        got_a, got_b = ra.result(30), rb.result(30)
+    spans = [s for s in tracing.spans_snapshot()
+             if s["trace"] == trace_id]
+    roots = [s for s in spans if s["name"] == "serving.generate"]
+    assert len(roots) == 2
+    by_root = {}
+    for r in roots:
+        by_root[r["span"]] = [s for s in spans
+                              if s["parent"] == r["span"]]
+    a_root = max(roots, key=lambda r: r["attrs"]["new_tokens"])
+    a_tokens = sorted(
+        (s for s in by_root[a_root["span"]]
+         if s["name"] == "generate.token"),
+        key=lambda s: s["attrs"]["index"])
+    assert len(a_tokens) == len(got_a) == 12
+    # every step event is complete — no token span misses its batch
+    # geometry or interleave stamp, whatever the batch did around it
+    for root, got in ((roots[0], None), (roots[1], None)):
+        prefill = [s for s in by_root[root["span"]]
+                   if s["name"] == "generate.prefill"]
+        assert len(prefill) == 1
+        pa = prefill[0]["attrs"]
+        assert {"queue_ns", "kv_wait_ns", "exec_ns", "prompt_tokens",
+                "pad_tokens"} <= set(pa)
+        for tok in by_root[root["span"]]:
+            if tok["name"] != "generate.token":
+                continue
+            ta = tok["attrs"]
+            assert {"index", "interleave_ns", "rows", "bucket"} \
+                <= set(ta)
+            assert 1 <= ta["rows"] <= ta["bucket"]
+    # the join is visible in A's step events (B decoded beside it)...
+    assert max(s["attrs"]["rows"] for s in a_tokens) >= 2
+    # ...and so is the leave: A finishes alone after B retires
+    assert a_tokens[-1]["attrs"]["rows"] == 1
+    # the joiner conserves both requests — no gaps, no double billing
+    records, skipped = tailpath.join_spans(spans)
+    assert skipped == 0 and len(records) == 2
+    for rec in records:
+        attributed = sum(v for b, v in rec["bins"].items()
+                         if b != "_unattributed")
+        assert attributed <= rec["e2e_ns"]
+        assert rec["bins"]["_unattributed"] <= 0.10 * rec["e2e_ns"]
+        assert rec["queue_cause"] in ("none", "backlog", "kv_wait",
+                                      "batch_full")
+
+
 def test_rejected_metric_reason_label(decoder):
     gw = Gateway()
     try:

@@ -1,0 +1,463 @@
+"""Tier-1 tests for the per-request tail-attribution plane (PR 20).
+
+Covers: the critical-path joiner over synthetic span trees (every
+blame bin exercised with hand-computed expectations, clipping
+discipline, recovery-vs-reclaim cause split, incomplete-tree
+skipping), the windowed aggregator (bounded window, slow-cohort
+selection, conservation arithmetic, mx_tail_* gauge publication),
+the bounded bench embed, the committed tail artifact's recomputed
+conservation contract, the ``perf_gate --tail`` self-test over the
+committed artifact plus synthetic regressions (broken conservation,
+dropped blame bin, missing slow-decile rows, stale/shrunk window,
+dropped stage, darkened interleave, residual breach), the
+``tail_report`` render/diff CLI, env-var registration, and the MXL002
+scope extension. Standalone-fast: no gateway — the producing storm is
+``serving_bench`` out of band.
+"""
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mxnet_tpu.profiling import tailpath
+from mxnet_tpu.telemetry import metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TAIL_ARTIFACT = os.path.join(REPO, "docs", "artifacts",
+                             "TAIL_LAST_GOOD.json")
+
+
+@pytest.fixture(autouse=True)
+def _enabled_registry():
+    metrics.set_enabled(True)
+    yield
+    metrics.set_enabled(True)
+
+
+# ---------------------------------------------------------------------
+# span fabrication: exact hand-built trees so every bin's arithmetic
+# is pinned (no gateway, no clock)
+# ---------------------------------------------------------------------
+def _span(name, span, parent, start, dur, trace=7, **attrs):
+    return {"name": name, "cat": "serving", "trace": trace,
+            "span": span, "parent": parent, "start_ns": start,
+            "dur_ns": dur, "attrs": attrs}
+
+
+def _generate_tree():
+    """Root + prefill + 3 tokens + 2 recoveries; every generate-plane
+    bin lands a hand-computed nonzero value and the bins sum exactly
+    to the root's e2e (residual 0)."""
+    root = _span("serving.generate", 10, None, 0, 640,
+                 model="lm", new_tokens=3, queue_cause="kv_wait")
+    prefill = _span("generate.prefill", 11, 10, 0, 400,
+                    prompt_tokens=6, pad_tokens=8, queue_ns=200,
+                    kv_wait_ns=50, exec_ns=160)
+    tok0 = _span("generate.token", 12, 10, 380, 20, index=0,
+                 interleave_ns=0, rows=1, bucket=1)
+    # interval 100: step 50 (rows 2 / bucket 4), interleave 30,
+    # scheduler remainder 20
+    tok1 = _span("generate.token", 13, 10, 450, 50, index=1,
+                 interleave_ns=30, rows=2, bucket=4)
+    # interval 140: step 40, recovery 40 (lane loss) + 30 (reclaim),
+    # remainder 30
+    rec_a = _span("generate.recover", 14, 10, 500, 40,
+                  cause="lane_lost", mode="replay")
+    rec_b = _span("generate.recover", 15, 10, 540, 30,
+                  cause="reclaim", mode="migrate")
+    tok2 = _span("generate.token", 16, 10, 600, 40, index=2,
+                 interleave_ns=0, rows=1, bucket=1)
+    return root, [prefill, tok0, tok1, rec_a, rec_b, tok2]
+
+
+GEN_EXPECT = {
+    "kv_wait": 50,
+    "queue_wait": 150,          # 200 queue minus the kv share
+    "prefill_compute": 120,     # 160 exec x 6/8 real tokens
+    "padding_tax": 40 + 25,     # prefill pad + decode pad (step 50 / 2)
+    "sched_overhead": 40 + 20 + 30,
+    "decode_compute": 25 + 40,
+    "prefill_interleave": 30,
+    "recovery": 40,
+    "reclaim_pause": 30,
+    "batch_hold": 0, "execute": 0, "reply": 0, "requeue": 0,
+    "_unattributed": 0,
+}
+
+
+def _oneshot_tree():
+    root = _span("serving.request", 20, None, 0, 500, trace=8,
+                 model="mlp", attempts=2)
+    queue = _span("serving.queue", 21, 20, 0, 200, trace=8,
+                  hold_ns=80, requeue_ns=50)
+    batch = _span("serving.batch", 22, 20, 200, 30, trace=8)
+    execute = _span("serving.execute", 23, 20, 230, 200, trace=8,
+                    rows=3, bucket=4)
+    reply = _span("serving.reply", 24, 20, 430, 20, trace=8)
+    return root, [queue, batch, execute, reply]
+
+
+ONESHOT_EXPECT = {
+    "batch_hold": 80,
+    "requeue": 50,
+    "queue_wait": 70,
+    "sched_overhead": 30,
+    "execute": 150,             # 200 x 3/4 real rows
+    "padding_tax": 50,
+    "reply": 20,
+    "_unattributed": 50,        # the 500 e2e minus 450 attributed
+    "kv_wait": 0, "prefill_compute": 0, "prefill_interleave": 0,
+    "decode_compute": 0, "recovery": 0, "reclaim_pause": 0,
+}
+
+
+# ------------------------------------------------------------- joiner units
+def test_generate_tree_bins_exact():
+    root, children = _generate_tree()
+    rec = tailpath.attribute_request(root, children)
+    assert rec is not None
+    assert rec["kind"] == "generate" and rec["model"] == "lm"
+    assert rec["queue_cause"] == "kv_wait"
+    assert rec["e2e_ns"] == 640
+    assert rec["bins"] == GEN_EXPECT
+    # closed taxonomy, exactly conserved on this tree
+    assert set(rec["bins"]) == set(tailpath.BINS)
+    assert sum(rec["bins"].values()) == rec["e2e_ns"]
+
+
+def test_oneshot_tree_bins_exact():
+    root, children = _oneshot_tree()
+    rec = tailpath.attribute_request(root, children)
+    assert rec is not None
+    assert rec["kind"] == "oneshot" and rec["model"] == "mlp"
+    assert rec["bins"] == ONESHOT_EXPECT
+    assert sum(rec["bins"].values()) == rec["e2e_ns"] == 500
+
+
+def test_overstamped_events_are_clipped_never_overbill():
+    """Lane-wide stamps can exceed a request's own intervals (the
+    interleave measurement includes the request's own admission; a
+    hold can be stamped on a request that barely waited). Clipping
+    keeps attributed <= e2e ALWAYS — conservation can break only
+    toward the residual, never past the measured wall."""
+    root, children = _generate_tree()
+    for s in children:
+        a = s["attrs"]
+        for k in ("queue_ns", "kv_wait_ns", "exec_ns",
+                  "interleave_ns"):
+            if k in a:
+                a[k] = 10 ** 12              # absurd over-stamp
+    rec = tailpath.attribute_request(root, children)
+    attributed = sum(v for b, v in rec["bins"].items()
+                     if b != "_unattributed")
+    assert attributed <= rec["e2e_ns"]
+
+    root, children = _oneshot_tree()
+    children[0]["attrs"]["hold_ns"] = 10 ** 12
+    children[0]["attrs"]["requeue_ns"] = 10 ** 12
+    rec = tailpath.attribute_request(root, children)
+    attributed = sum(v for b, v in rec["bins"].items()
+                     if b != "_unattributed")
+    assert attributed <= rec["e2e_ns"]
+
+
+def test_incomplete_tree_skipped_not_half_blamed():
+    root, children = _generate_tree()
+    # ring eviction dropped a token span: 2 spans for new_tokens=3
+    children = [s for s in children
+                if s["attrs"].get("index") != 1]
+    assert tailpath.attribute_request(root, children) is None
+    # evicted prefill, same verdict
+    root2, children2 = _generate_tree()
+    children2 = [s for s in children2
+                 if s["name"] != "generate.prefill"]
+    assert tailpath.attribute_request(root2, children2) is None
+    # and join_spans COUNTS it instead of dropping it silently
+    records, skipped = tailpath.join_spans([root] + children)
+    assert records == [] and skipped == 1
+
+
+def test_join_spans_window_filter_and_multi_tree():
+    g_root, g_children = _generate_tree()
+    o_root, o_children = _oneshot_tree()
+    # push the one-shot outside the harvest window
+    o_root = dict(o_root, start_ns=10_000)
+    o_children = [dict(s, start_ns=s["start_ns"] + 10_000)
+                  for s in o_children]
+    spans = [g_root] + g_children + [o_root] + o_children
+    records, skipped = tailpath.join_spans(spans)
+    assert {r["kind"] for r in records} == {"generate", "oneshot"}
+    records, skipped = tailpath.join_spans(spans, t0_ns=0,
+                                           t1_ns=5_000)
+    assert [r["kind"] for r in records] == ["generate"]
+    assert skipped == 0
+
+
+# ------------------------------------------------------- aggregator/collect
+def _fake_record(e2e_ns, kind="generate", **bins):
+    full = {b: 0 for b in tailpath.BINS}
+    full.update(bins)
+    attributed = sum(v for b, v in full.items()
+                     if b != "_unattributed")
+    full["_unattributed"] = max(e2e_ns - attributed, 0)
+    return {"kind": kind, "model": "lm", "trace": 1,
+            "start_ns": 0, "e2e_ns": e2e_ns, "bins": full,
+            "queue_cause": "backlog"}
+
+
+def test_aggregator_window_bounded_and_slow_cohort_ranked():
+    agg = tailpath.TailAggregator(window=8, slow_frac=0.25)
+    for i in range(1, 21):                   # 20 adds, window keeps 8
+        agg.add(_fake_record(i * 1000, decode_compute=i * 900,
+                             queue_wait=i * 100), stage="unit")
+    doc = agg.collect()
+    assert doc["kind"] == "tail/v1" and doc["version"] == 1
+    w = doc["window"]
+    assert w["requests"] == 8 and w["capacity"] == 8
+    assert w["slow_requests"] == 2           # 25% of 8
+    assert doc["stages"]["unit"]["requests"] == 20
+    # the slow cohort is the two SLOWEST retained records (19k, 20k)
+    assert doc["slow"]["e2e_s"] == pytest.approx(39e-6, rel=1e-6)
+    drivers = doc["slow"]["drivers"]
+    assert drivers[0]["bin"] == "decode_compute"
+    assert drivers[0]["blamed_s"] > drivers[-1]["blamed_s"]
+    assert doc["conservation"]["conserved"] is True
+    assert doc["conservation"]["slow_fraction"] == pytest.approx(
+        1.0, abs=1e-3)
+    rows = doc["slowest"]
+    assert rows and rows[0]["e2e_ms"] >= rows[-1]["e2e_ms"]
+    assert rows[0]["top_bin"] == "decode_compute"
+    assert rows[0]["queue_cause"] == "backlog"
+
+
+def test_aggregator_flags_unattributed_breach():
+    agg = tailpath.TailAggregator(window=8, slow_frac=1.0)
+    for _ in range(8):                       # only half the wall blamed
+        agg.add(_fake_record(1000, decode_compute=500))
+    doc = agg.collect(tolerance=0.10)
+    assert doc["conservation"]["conserved"] is False
+    assert doc["slow"]["bins"]["_unattributed"] > 0
+
+
+def test_collect_publishes_mx_tail_families():
+    import mxnet_tpu as mx
+
+    agg = tailpath.TailAggregator(window=8, slow_frac=0.5)
+    for i in (1, 2):
+        agg.add(_fake_record(i * 10 ** 6, decode_compute=i * 10 ** 6))
+    agg.collect()
+    reg = mx.telemetry.registry()
+    assert reg.value("mx_tail_requests", cohort="all") == 2
+    assert reg.value("mx_tail_requests", cohort="slow") == 1
+    assert reg.value("mx_tail_blame_seconds", bin="decode_compute",
+                     cohort="slow") == pytest.approx(2e-3)
+    assert reg.value("mx_tail_conservation_fraction",
+                     cohort="slow") == pytest.approx(1.0)
+    fam = reg.find("mx_tail_e2e_seconds")
+    assert fam is not None and fam.labels(kind="generate").count >= 2
+
+
+def test_ingest_spans_end_to_end_and_enabled_knob(monkeypatch):
+    g_root, g_children = _generate_tree()
+
+    def _scale(s, f=10 ** 4):                # ns -> tens of µs so the
+        out = dict(s)                        # µs-rounded artifact bins
+        out["start_ns"] *= f                 # stay visibly nonzero
+        out["dur_ns"] *= f
+        out["attrs"] = {k: v * f if k.endswith("_ns") else v
+                        for k, v in s["attrs"].items()}
+        return out
+    spans = [_scale(s) for s in [g_root] + g_children]
+    agg = tailpath.TailAggregator(window=8, slow_frac=1.0)
+    n = agg.ingest_spans(spans, stage="storm")
+    assert n == 1
+    doc = agg.collect()
+    assert doc["stages"]["storm"]["requests"] == 1
+    assert doc["slow"]["bins"]["prefill_interleave"] > 0
+    monkeypatch.setenv("MXTPU_TAIL_ENABLE", "0")
+    assert tailpath.enabled() is False
+    monkeypatch.setenv("MXTPU_TAIL_ENABLE", "1")
+    assert tailpath.enabled() is True
+
+
+def test_summary_is_bounded_and_provenance_marked():
+    agg = tailpath.TailAggregator(window=8, slow_frac=0.5)
+    for i in range(8):
+        agg.add(_fake_record((i + 1) * 10 ** 6,
+                             decode_compute=(i + 1) * 10 ** 6))
+    doc = agg.collect()
+    emb = tailpath.summary(doc)
+    assert emb["kind"] == "tail_summary"
+    assert emb["source"] == "profiling.tailpath"
+    assert len(json.dumps(emb)) <= 2048
+    # hard bound: detail is dropped before the bound ever breaks
+    tight = tailpath.summary(doc, max_bytes=220)
+    assert len(json.dumps(tight)) <= 2048
+    assert "bins" not in tight and tight["kind"] == "tail_summary"
+    assert tailpath.summary({"kind": "nope"}) is None
+
+
+def test_dump_honors_artifact_env_default(tmp_path, monkeypatch):
+    doc = tailpath.TailAggregator(window=8).collect()
+    target = tmp_path / "tail_env.json"
+    monkeypatch.setenv("MXTPU_TAIL_ARTIFACT", str(target))
+    tailpath.dump(None, doc)
+    assert json.load(open(target))["kind"] == "tail/v1"
+    monkeypatch.delenv("MXTPU_TAIL_ARTIFACT")
+    tailpath.dump(None, doc)                 # both unset: clean no-op
+
+
+# ----------------------------------------------------- committed artifact
+def _artifact():
+    with open(TAIL_ARTIFACT, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def test_committed_artifact_conserves_and_interleave_nonzero():
+    doc = _artifact()
+    assert doc["kind"] == "tail/v1" and doc["version"] == 1
+    assert doc["window"]["requests"] > 0
+    slow = doc["slow"]
+    for b in tailpath.BINS:
+        assert b in slow["bins"], b
+    # the ISSUE acceptance row: conservation RECOMPUTED from the raw
+    # numbers over the slowest decile, never read from the flag
+    e2e = slow["e2e_s"]
+    blamed = sum(slow["bins"].values())
+    assert e2e > 0
+    assert abs(blamed - e2e) <= 0.10 * e2e
+    assert slow["bins"]["_unattributed"] <= 0.10 * e2e
+    # long-prompt storm must exercise the per-step interleave seam
+    assert slow["bins"]["prefill_interleave"] > 0
+    assert doc["slow"]["drivers"] and doc["slowest"]
+    assert {"concurrent", "generate"} <= set(doc["stages"])
+
+
+# ----------------------------------------------------------- perf gate
+def _run_gate(path, last_good=TAIL_ARTIFACT):
+    return subprocess.run(
+        [sys.executable, "tools/perf_gate.py", str(path), "--tail",
+         "--last-good", str(last_good)],
+        cwd=REPO, capture_output=True, text=True)
+
+
+def test_gate_passes_committed_artifact():
+    proc = _run_gate(TAIL_ARTIFACT)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS" in proc.stdout
+    assert "conserved" in proc.stdout
+
+
+def test_gate_rejects_synthetic_regressions(tmp_path):
+    base = _artifact()
+
+    def tampered(name, mutate, want_rc=1):
+        doc = copy.deepcopy(base)
+        mutate(doc)
+        p = tmp_path / ("%s.json" % name)
+        p.write_text(json.dumps(doc))
+        proc = _run_gate(p)
+        assert proc.returncode == want_rc, \
+            "%s: rc %d\n%s" % (name, proc.returncode, proc.stdout)
+        return proc.stdout
+
+    def _scale_bins(d, f):
+        d["slow"]["bins"] = {b: v * f
+                             for b, v in d["slow"]["bins"].items()}
+
+    out = tampered("broken_conservation",
+                   lambda d: _scale_bins(d, 0.5))
+    assert "NOT conserved" in out
+    out = tampered("dropped_blame_bin",
+                   lambda d: d["slow"]["bins"].pop("reclaim_pause"))
+    assert "missing" in out
+    out = tampered("missing_driver_ranking",
+                   lambda d: d["slow"].update(drivers=[]))
+    assert "ranking" in out
+    tampered("missing_slowest_rows",
+             lambda d: d.update(slowest=[]))
+    out = tampered("stale_shrunk_window", lambda d: (
+        d["window"].update(requests=max(
+            int(base["window"]["requests"] * 0.4), 1))))
+    assert "shrank" in out
+    out = tampered("dropped_stage",
+                   lambda d: d["stages"].pop("generate"))
+    assert "stage" in out
+    out = tampered("interleave_went_dark", lambda d: d["slow"]["bins"]
+                   .update(prefill_interleave=0.0))
+    assert "interleave" in out
+
+    def _residual_breach(d):
+        bins = d["slow"]["bins"]
+        shift = 0.2 * d["slow"]["e2e_s"]
+        bins["decode_compute"] = max(bins["decode_compute"] - shift,
+                                     0.0)
+        bins["_unattributed"] += shift       # sum preserved, hole grows
+    out = tampered("unattributed_breach", _residual_breach)
+    assert "_unattributed" in out
+    tampered("bare_zero", lambda d: d["window"].update(requests=0),
+             want_rc=3)
+    tampered("wrong_kind", lambda d: d.update(kind="nope"),
+             want_rc=2)
+
+
+def test_gate_defaults_to_committed_last_good():
+    proc = subprocess.run(
+        [sys.executable, "tools/perf_gate.py", TAIL_ARTIFACT,
+         "--tail"], cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------- tail_report
+def test_tail_report_renders_and_diffs_committed_artifact(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, "tools/tail_report.py", TAIL_ARTIFACT],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "blame bin" in proc.stdout
+    assert "prefill_interleave" in proc.stdout
+    assert "slowest requests" in proc.stdout
+    diff = subprocess.run(
+        [sys.executable, "tools/tail_report.py", "--diff",
+         TAIL_ARTIFACT, TAIL_ARTIFACT],
+        cwd=REPO, capture_output=True, text=True)
+    assert diff.returncode == 0, diff.stdout + diff.stderr
+    assert "no per-bin change" in diff.stdout
+
+
+def test_tail_report_lifts_bench_embed(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import tail_report
+    finally:
+        sys.path.pop(0)
+    emb = tailpath.summary(_artifact())
+    lifted = tail_report.extract({"tool": "serving_bench",
+                                  "tail": emb})
+    assert lifted is not None and lifted["kind"] == "tail/v1"
+    assert lifted["slow"]["bins"]
+    assert tail_report.extract({"tool": "other"}) is None
+
+
+# ------------------------------------------------- registration / lint scope
+def test_tail_env_vars_registered():
+    from mxnet_tpu import libinfo
+
+    doc = open(os.path.join(REPO, "docs", "env_vars.md"),
+               encoding="utf-8").read()
+    for var in ("MXTPU_TAIL_ENABLE", "MXTPU_TAIL_WINDOW",
+                "MXTPU_TAIL_SLOW_FRAC", "MXTPU_TAIL_ARTIFACT"):
+        assert var in libinfo._ENV_VARS, var
+        assert var in doc, var
+
+
+def test_tailpath_mxl002_scope_registered():
+    from mxnet_tpu.analysis.rules.host_sync import _SCOPES
+
+    scopes = {prefix: methods for prefix, methods, _ in _SCOPES}
+    for name in ("attribute_request", "join_spans", "ingest_spans",
+                 "add", "collect"):
+        assert name in scopes["mxnet_tpu/profiling/"], name

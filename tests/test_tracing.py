@@ -497,6 +497,46 @@ def test_write_trace_roundtrip_and_dump_tool(tmp_path):
     assert proc.returncode == 2
 
 
+def test_trace_dump_orphans_render_under_synthetic_root(tmp_path):
+    """Ring eviction drops the OLDEST spans first, and request roots
+    are recorded before their children at retirement — so an
+    over-capacity ring keeps children whose parent is gone. The dump
+    tool must render those surviving subtrees under a labeled
+    synthetic root (never silently dropped, never passed off as
+    complete roots)."""
+    cap = tracing._RING_CAP
+    t0 = 1_000_000
+    parent = tracing.record_span("request_root", 424242, 0,
+                                 t0, t0 + 10_000_000)
+    for i in range(cap + 8):                 # over-capacity: evicts
+        tracing.record_span("orphan_child", 424242, parent,
+                            t0 + 1000 * (i + 1),
+                            t0 + 1000 * (i + 1) + 500)
+    # a genuine root recorded AFTER the flood (so it survives the
+    # ring): must keep rendering as a plain depth-0 root
+    with tracing.span("true_root", cat="step"):
+        pass
+    spans = tracing.drain()
+    names = [s["name"] for s in spans]
+    assert "request_root" not in names       # the parent was evicted
+    survivors = names.count("orphan_child")
+    assert survivors >= cap - 8
+    p = str(tmp_path / "orphans.json")
+    texp.write_trace(p, spans=spans, meta={"role": "worker",
+                                           "rank": 0})
+    proc = subprocess.run(
+        [sys.executable, TELEMETRY_DUMP, "--trace", p],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "orphaned" in proc.stdout         # labeled, with the remedy
+    assert "MXTPU_TRACE_RING" in proc.stdout
+    # every surviving orphan renders; the true root is NOT under the
+    # synthetic-root banner (it stays a depth-0 root above it)
+    assert proc.stdout.count("orphan_child") >= survivors
+    assert proc.stdout.index("true_root") < \
+        proc.stdout.index("orphaned")
+
+
 def test_chrome_merge_includes_spans():
     with tracing.span("merge_me", cat="io"):
         pass

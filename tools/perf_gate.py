@@ -79,6 +79,20 @@ its seconds in idle), a shrunken world is a dropped device, and the
 SLO burn section cannot vanish while last-good evaluates objectives.
 A zero-total artifact is bare-zero (exit 3).
 
+``--tail`` gates a tail/v1 artifact (``serving_bench --tail-json``
+over the open-loop storm stages) against
+``docs/artifacts/TAIL_LAST_GOOD.json`` — per-request critical-path
+attribution as a CI contract: conservation is RECOMPUTED from the raw
+slow-cohort numbers (blamed bins must sum to the measured e2e wall
+within tolerance, with the ``_unattributed`` residual bounded), the
+fourteen-bin blame taxonomy is closed (a missing bin hides its wall in
+the residual), the slow-decile driver ranking and slowest-request rows
+must be present, the prefill-interleave blame row may not vanish while
+last-good measured it, the window may not silently shrink below half
+of last-good's (a stale/starved window proves nothing), and no stage
+last-good attributes may be dropped. A zero-request artifact is
+bare-zero (exit 3).
+
 ``--kernels`` gates a tools/kernel_bench.py version-1 artifact
 against ``docs/artifacts/KERNELS_LAST_GOOD.json``: every kernel the
 last-good artifact carries must be present (a dropped kernel cannot
@@ -136,6 +150,8 @@ DEFAULT_LOCKS_LAST_GOOD = os.path.join(REPO, "docs", "artifacts",
                                        "LOCKS_LAST_GOOD.json")
 DEFAULT_GOODPUT_LAST_GOOD = os.path.join(REPO, "docs", "artifacts",
                                          "GOODPUT_LAST_GOOD.json")
+DEFAULT_TAIL_LAST_GOOD = os.path.join(REPO, "docs", "artifacts",
+                                      "TAIL_LAST_GOOD.json")
 
 # the elasticity plane's advertised scenario families: an artifact
 # missing one of these has not exercised the SLO it claims to gate
@@ -1136,6 +1152,133 @@ def gate_goodput(candidate, last_good, tolerance=0.25,
     return rc, msgs
 
 
+# the tail artifact's closed blame taxonomy, replicated (the gate must
+# not import the package): every bin must be present in the slow-cohort
+# table, and conservation is recomputed from these raw numbers
+TAIL_BINS = (
+    "queue_wait", "kv_wait", "batch_hold",
+    "prefill_compute", "prefill_interleave",
+    "decode_compute", "padding_tax", "sched_overhead",
+    "execute", "reply", "requeue",
+    "recovery", "reclaim_pause", "_unattributed",
+)
+
+
+def gate_tail(candidate, last_good, conserve_tol=0.10):
+    """(exit_code, [messages]) for a tail/v1 artifact pair
+    (``profiling.tailpath.collect`` via ``serving_bench --tail-json``).
+
+    Conservation is RECOMPUTED from the slow cohort's raw numbers,
+    never trusted from the artifact's own ``conserved`` flag: the
+    blamed bins (including the residual) must sum to the measured
+    slow-cohort e2e wall within ``conserve_tol``, and the
+    ``_unattributed`` residual may not exceed the same fraction — an
+    attribution plane that cannot account for its own nanoseconds
+    proves nothing. The taxonomy is closed (a missing bin hides its
+    wall in the residual), the slow-decile driver ranking and
+    slowest-request rows must be present, the prefill-interleave row
+    cannot collapse to zero while last-good measured it, the window
+    cannot silently shrink below half of last-good's, and no stage
+    last-good attributes may be dropped. A zero-request artifact is
+    bare-zero (exit 3)."""
+    msgs = []
+    rc = 0
+    if candidate.get("kind") != "tail/v1" or \
+            candidate.get("version") != 1:
+        return 2, ["not a version-1 tail artifact"]
+    w = candidate.get("window") or {}
+    n = w.get("requests")
+    slow = candidate.get("slow") or {}
+    slow_bins = slow.get("bins") or {}
+    if not isinstance(n, (int, float)) or n <= 0 or not slow_bins:
+        return 3, ["tail artifact attributed no requests "
+                   "(signal-free — rejected)"]
+    # -- bin taxonomy: all fourteen present, and the interleave row
+    # cannot go dark while last-good measured it ----------------------
+    good_slow = (last_good.get("slow") or {}).get("bins") or {}
+    for b in TAIL_BINS:
+        if b not in slow_bins:
+            rc = 1
+            msgs.append("REGRESSION tail: blame bin '%s' missing "
+                        "from the slow cohort (the taxonomy is "
+                        "closed — a dropped bin hides its wall in "
+                        "the residual)" % b)
+    if good_slow.get("prefill_interleave", 0) \
+            and not slow_bins.get("prefill_interleave"):
+        rc = 1
+        msgs.append("REGRESSION tail: prefill-interleave blame is "
+                    "zero but last good measured %.4fs — the "
+                    "per-step stall seam went dark"
+                    % good_slow["prefill_interleave"])
+    elif "prefill_interleave" in slow_bins:
+        msgs.append("tail: prefill-interleave blame row present "
+                    "(%.4fs)" % (slow_bins.get("prefill_interleave")
+                                 or 0.0))
+    # -- recomputed conservation over the slow cohort -----------------
+    e2e = (slow.get("e2e_s")
+           if isinstance(slow.get("e2e_s"), (int, float)) else 0.0)
+    blamed = sum(v for v in slow_bins.values()
+                 if isinstance(v, (int, float)))
+    unattr = slow_bins.get("_unattributed") or 0.0
+    if e2e <= 0:
+        rc = 1
+        msgs.append("REGRESSION tail: slow cohort measured no e2e "
+                    "wall")
+    else:
+        if abs(blamed - e2e) > conserve_tol * e2e:
+            rc = 1
+            msgs.append("REGRESSION tail: NOT conserved — blamed "
+                        "bins sum %.4fs vs measured e2e %.4fs "
+                        "(tolerance %.0f%%)"
+                        % (blamed, e2e, conserve_tol * 100))
+        else:
+            msgs.append("tail: %.4fs of %.4fs slow-cohort wall "
+                        "blamed (conserved)" % (blamed, e2e))
+        if unattr > conserve_tol * e2e:
+            rc = 1
+            msgs.append("REGRESSION tail: _unattributed residual "
+                        "%.4fs exceeds %.0f%% of the slow cohort's "
+                        "%.4fs e2e — the taxonomy is not closed over "
+                        "this workload" % (unattr, conserve_tol * 100,
+                                           e2e))
+    # -- slow-decile rows: ranking + slowest requests must be present -
+    drivers = slow.get("drivers")
+    if not isinstance(drivers, list) or not drivers:
+        rc = 1
+        msgs.append("REGRESSION tail: slow-cohort driver ranking "
+                    "missing or empty")
+    slowest = candidate.get("slowest")
+    if not isinstance(slowest, list) or not slowest:
+        rc = 1
+        msgs.append("REGRESSION tail: slowest-request rows missing — "
+                    "the artifact cannot answer 'why is THIS request "
+                    "slow'")
+    else:
+        msgs.append("tail: %d slowest-request row(s), top driver %s"
+                    % (len(slowest),
+                       (drivers[0].get("bin") if drivers else "?")))
+    # -- window staleness: coverage cannot silently shrink ------------
+    good_n = (last_good.get("window") or {}).get("requests")
+    if isinstance(good_n, (int, float)) and good_n > 0 \
+            and n < 0.5 * good_n:
+        rc = 1
+        msgs.append("REGRESSION tail: window shrank to %d request(s) "
+                    "(last good attributed %d — a starved window is "
+                    "stale evidence)" % (n, good_n))
+    # -- stage coverage vs last-good ----------------------------------
+    good_stages = set(last_good.get("stages") or {})
+    mine_stages = set(candidate.get("stages") or {})
+    dropped = sorted(good_stages - mine_stages)
+    if dropped:
+        rc = 1
+        msgs.append("REGRESSION tail: attribution stage(s) dropped "
+                    "vs last good: %s" % dropped)
+    elif good_stages:
+        msgs.append("tail: %d stage(s) attributed (ok)"
+                    % len(mine_stages))
+    return rc, msgs
+
+
 def _lock_cycles(edges):
     """Representative cycles over an artifact's edge list, recomputed
     here so a hand-edited ``cycles: []`` cannot sneak a cyclic graph
@@ -1411,7 +1554,39 @@ def main(argv=None):
                          "device-second conservation recomputed from "
                          "the raw ledger numbers, no dropped bin/"
                          "device/SLO objective")
+    ap.add_argument("--tail", action="store_true",
+                    help="gate a tail/v1 artifact (serving_bench "
+                         "--tail-json): slow-cohort conservation "
+                         "recomputed from the raw numbers, closed "
+                         "blame taxonomy, prefill-interleave row "
+                         "presence, no shrunken window or dropped "
+                         "stage vs last-good")
+    ap.add_argument("--tail-conserve-tol", type=float, default=0.10,
+                    help="allowed |blamed - e2e| fraction AND max "
+                         "_unattributed share over the slow cohort "
+                         "(0.10)")
     args = ap.parse_args(argv)
+    if args.tail:
+        last_good_path = args.last_good
+        if last_good_path == DEFAULT_LAST_GOOD:
+            last_good_path = DEFAULT_TAIL_LAST_GOOD
+        try:
+            with open(args.artifact, "r", encoding="utf-8") as f:
+                candidate = json.load(f)
+            with open(last_good_path, "r", encoding="utf-8") as f:
+                last_good = json.load(f)
+        except (OSError, ValueError) as e:
+            print("perf_gate: cannot read tail artifact: %s" % e,
+                  file=sys.stderr)
+            return 2
+        rc, msgs = gate_tail(candidate, last_good,
+                             conserve_tol=args.tail_conserve_tol)
+        for m in msgs:
+            print(m)
+        print("perf_gate: %s"
+              % {0: "PASS", 1: "REGRESSION", 2: "UNREADABLE",
+                 3: "BARE-ZERO"}.get(rc, rc))
+        return rc
     if args.goodput:
         last_good_path = args.last_good
         if last_good_path == DEFAULT_LAST_GOOD:

@@ -42,11 +42,21 @@ Stages (ROADMAP item 1 / VERDICT stretch #9 + Missing #4):
      host; the child's device count rides the stage record.
 
     python tools/serving_bench.py \
-        [--json docs/artifacts/serving_bench_YYYYMMDD.json]
+        [--json docs/artifacts/serving_bench_YYYYMMDD.json] \
+        [--tail-json docs/artifacts/tail_YYYYMMDD.json]
 
 Artifact is versioned (``"version": 1``), gated by
 ``tools/perf_gate.py --serving`` against
 docs/artifacts/SERVING_LAST_GOOD.json (a committed copy).
+
+The two open-loop storm stages (``gateway_concurrent_fp32`` and
+``generate``) additionally record per-request critical-path
+attribution (``mxnet_tpu.profiling.tailpath``): their time windows
+are harvested from the span layer after the storms, joined into a
+``tail/v1`` blame artifact written by ``--tail-json`` and embedded
+(bounded) under the bench doc's ``tail`` key. That artifact is the
+input to ``tools/tail_report.py`` and ``perf_gate --tail``
+(docs/observability.md "Why is this request slow").
 """
 from __future__ import annotations
 
@@ -61,6 +71,12 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the open-loop storms retire far more spans than the default
+# per-thread trace ring holds; the tail joiner skips any request tree
+# the ring evicted a child from, so give it room (before the package
+# import freezes the ring size)
+os.environ.setdefault("MXTPU_TRACE_RING", "65536")
 
 
 def build_model(rng, width=256, layers=96):
@@ -339,7 +355,13 @@ def stage_generate(gw, rng, clients=4, seconds=4.0, vocab=256,
         my_inter, my_ttft = [], []
         reqs = rej = 0
         while not stop[0]:
-            plen = int(crng.integers(4, max_prompt + 1))
+            # long-prompt mix: client 0 always submits a full-length
+            # prompt so the prefill-interleave stall (other requests'
+            # admission prefills holding a decode step) is robustly
+            # exercised — the tail artifact's prefill_interleave bin
+            # must be nonzero under this load (perf_gate --tail)
+            plen = max_prompt if ci == 0 \
+                else int(crng.integers(4, max_prompt + 1))
             p = crng.integers(1, vocab, plen)
             nnew = int(crng.integers(max_new // 2, max_new + 1))
             t_sub = time.perf_counter()
@@ -600,6 +622,10 @@ def main(argv=None):
         prog="serving_bench", description=__doc__.splitlines()[0])
     ap.add_argument("--json", default=None,
                     help="artifact output path (default stdout only)")
+    ap.add_argument("--tail-json", default=None,
+                    help="tail/v1 attribution artifact path "
+                         "(perf_gate --tail input; default: embed "
+                         "summary only)")
     ap.add_argument("--n", type=int, default=300,
                     help="requests per latency stage (300)")
     ap.add_argument("--clients", type=int, default=4,
@@ -666,14 +692,21 @@ def main(argv=None):
     stages["gateway_bs1_int8_native"] = stage_gateway_bs1(
         gw, "bench_bs1_native", ("int8",), x1,
         max(args_ns.n // 3, 50))["int8"]
+    # the two open-loop storms carry the tail-attribution windows:
+    # every request whose root span STARTS inside [t0, t1) is joined
+    # into the tail/v1 artifact under that stage's name
+    t_conc0 = mx.tracing.clock.now_ns()
     stages["gateway_concurrent_fp32"] = stage_concurrent(
         gw, "bench_conc", feature, args_ns.clients, args_ns.inflight,
         args_ns.seconds, rng)
+    t_conc1 = mx.tracing.clock.now_ns()
     stages["dispatch_overhead_bs1"] = stage_dispatch(
         gw, "bench_bs1", x1, max(args_ns.n // 3, 50))
+    t_gen0 = mx.tracing.clock.now_ns()
     stages["generate"] = stage_generate(
         gw, rng, clients=args_ns.clients,
         seconds=args_ns.gen_seconds)
+    t_gen1 = mx.tracing.clock.now_ns()
     stages["sharded"] = stage_sharded(n=max(args_ns.n // 2, 50),
                                       tp=args_ns.tp)
     divergence = stage_divergence(gw, "bench_conc",
@@ -681,6 +714,23 @@ def main(argv=None):
                                   args, aux, feature, rng)
     model_stats = gw.stats()
     gw.close()
+
+    # harvest the storms' span trees once, after every stage retired
+    # its spans, and join each storm's window separately so the
+    # artifact attributes per stage
+    from mxnet_tpu.profiling import tailpath
+    tail_doc = None
+    if tailpath.enabled():
+        spans = mx.tracing.spans_snapshot()
+        agg = tailpath.TailAggregator()
+        agg.ingest_spans(spans, stage="concurrent",
+                         t0_ns=t_conc0, t1_ns=t_conc1)
+        agg.ingest_spans(spans, stage="generate",
+                         t0_ns=t_gen0, t1_ns=t_gen1)
+        tail_doc = agg.collect(provenance={
+            "tool": "serving_bench",
+            "host_cpus": os.cpu_count(),
+        })
 
     serial = stages["serial_bs1_fp32"]["req_per_s"]
     conc = stages["gateway_concurrent_fp32"]["req_per_s"]
@@ -709,6 +759,13 @@ def main(argv=None):
         },
         "divergence": divergence,
     }
+    if tail_doc is not None:
+        emb = tailpath.summary(tail_doc)
+        if emb is not None:
+            doc["tail"] = emb
+        if args_ns.tail_json:
+            tailpath.dump(args_ns.tail_json, tail_doc)
+            print("wrote %s" % args_ns.tail_json, file=sys.stderr)
     line = json.dumps(doc, indent=1)
     print(line)
     if args_ns.json:

@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""tail_report — render or diff per-request tail-attribution artifacts.
+
+    python tools/tail_report.py tail_r01.json           # blame table
+    python tools/tail_report.py --diff before.json after.json
+
+Inputs are ``mxnet_tpu.profiling.tailpath`` documents
+({"kind": "tail/v1"}) — bare, or embedded as a bounded summary under a
+bench artifact's ``tail`` key. ``--diff`` is the serving-PR workflow
+(docs/observability.md "Why is this request slow"): run the open-loop
+storm on main, run it on the branch, attach the per-bin blamed-second
+deltas over the slow cohort — the prefill-interleave row is the one
+ROADMAP item 1 (disaggregated prefill/decode) must drive to ~zero.
+The pass/fail *gate* lives in ``tools/perf_gate.py --tail``.
+
+Rendering and diffing are stdlib-only (no jax import).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BIN_ORDER = (
+    "queue_wait", "kv_wait", "batch_hold",
+    "prefill_compute", "prefill_interleave",
+    "decode_compute", "padding_tax", "sched_overhead",
+    "execute", "reply", "requeue",
+    "recovery", "reclaim_pause", "_unattributed",
+)
+
+
+def _read_json(path):
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        print("tail_report: cannot read %s: %s" % (path, e),
+              file=sys.stderr)
+        raise SystemExit(2)
+
+
+def extract(doc):
+    """A tail document from a bare artifact or a bench embed (driver
+    round file / raw line / last-good wrapper accepted)."""
+    if not isinstance(doc, dict):
+        return None
+    if doc.get("kind") == "tail/v1":
+        return doc
+    if isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    if isinstance(doc.get("line"), str):
+        try:
+            doc = json.loads(doc["line"])
+        except ValueError:
+            return None
+    t = doc.get("tail")
+    if isinstance(t, dict) and t.get("kind") == "tail_summary":
+        # lift the bounded bench embed back into artifact shape so one
+        # renderer serves both
+        return {
+            "kind": "tail/v1",
+            "version": 1,
+            "window": {"requests": t.get("requests"),
+                       "slow_requests": t.get("slow_requests")},
+            "slow": {"requests": t.get("slow_requests"),
+                     "e2e_s": t.get("slow_e2e_s"),
+                     "bins": t.get("bins", {}),
+                     "drivers": t.get("drivers", [])},
+            "bins": {},
+            "conservation": {
+                "conserved": t.get("conserved"),
+                "slow_fraction": t.get("slow_fraction")},
+            "slowest": [],
+        }
+    if isinstance(t, dict) and t.get("kind") == "tail/v1":
+        return t
+    return None
+
+
+def _fmt(v, nd=4):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return "%.*g" % (nd, v)
+    return str(v)
+
+
+def format_table(doc):
+    """Headline + ranked slow-cohort blame table + slowest-request
+    rows (docs/observability.md 'Why is this request slow' walks this
+    exact output)."""
+    w = doc.get("window", {})
+    slow = doc.get("slow", {})
+    cons = doc.get("conservation", {})
+    lines = ["# tail: %s requests windowed · slow cohort %s · "
+             "blamed %s of %ss e2e · conserved %s"
+             % (w.get("requests", "?"), slow.get("requests", "?"),
+                _fmt(cons.get("slow_fraction")),
+                _fmt(slow.get("e2e_s")), cons.get("conserved", "?"))]
+    bins = slow.get("bins", {})
+    total = slow.get("e2e_s") or 0.0
+    if bins:
+        lines.append("%-20s %12s %8s" % ("blame bin", "seconds",
+                                         "share"))
+        ordered = [b for b in BIN_ORDER if b in bins] + \
+            sorted(set(bins) - set(BIN_ORDER))
+        for b in ordered:
+            v = float(bins[b])
+            share = ("%6.1f%%" % (100.0 * v / total)) if total > 0 \
+                else "      -"
+            lines.append("%-20s %12s %8s" % (b, _fmt(v), share))
+    for st, s in sorted((doc.get("stages") or {}).items()):
+        lines.append("# stage %-16s %s request(s)"
+                     % (st, s.get("requests", "?")))
+    rows = doc.get("slowest") or []
+    if rows:
+        lines.append("# slowest requests")
+        for r in rows:
+            lines.append("  %8.2fms %-9s %-12s top=%s (queue: %s)"
+                         % (r.get("e2e_ms", 0.0), r.get("kind", "?"),
+                            str(r.get("model", "?")),
+                            r.get("top_bin", "?"),
+                            r.get("queue_cause", "-")))
+    skipped = w.get("skipped_incomplete")
+    if skipped:
+        lines.append("# %d request tree(s) skipped incomplete (ring "
+                     "eviction — raise MXTPU_TRACE_RING)" % skipped)
+    return "\n".join(lines)
+
+
+def diff(before, after):
+    """Machine-readable slow-cohort blame delta between two docs."""
+    ba = (before.get("slow") or {}).get("bins", {})
+    bb = (after.get("slow") or {}).get("bins", {})
+    by_bin = []
+    for b in sorted(set(ba) | set(bb)):
+        by_bin.append({"bin": b,
+                       "before_s": ba.get(b), "after_s": bb.get(b),
+                       "delta_s": (bb.get(b) or 0.0)
+                       - (ba.get(b) or 0.0)})
+    by_bin.sort(key=lambda r: -abs(r["delta_s"]))
+    ca = before.get("conservation", {})
+    cb = after.get("conservation", {})
+    out = {
+        "slow_e2e_before_s": (before.get("slow") or {}).get("e2e_s"),
+        "slow_e2e_after_s": (after.get("slow") or {}).get("e2e_s"),
+        "conserved_before": ca.get("conserved"),
+        "conserved_after": cb.get("conserved"),
+        "by_bin": by_bin,
+    }
+    ea, eb = out["slow_e2e_before_s"], out["slow_e2e_after_s"]
+    if isinstance(ea, (int, float)) and isinstance(eb, (int, float)):
+        out["slow_e2e_delta_s"] = eb - ea
+    return out
+
+
+def format_diff(d):
+    lines = ["# slow-cohort e2e: %ss -> %ss%s"
+             % (_fmt(d.get("slow_e2e_before_s")),
+                _fmt(d.get("slow_e2e_after_s")),
+                (" (%+.4g)" % d["slow_e2e_delta_s"])
+                if "slow_e2e_delta_s" in d else ""),
+             "# conserved: %s -> %s" % (d.get("conserved_before"),
+                                        d.get("conserved_after"))]
+    shown = 0
+    for r in d["by_bin"]:
+        if r["delta_s"]:
+            lines.append("  %-20s %+10.4g s  (%s -> %s)"
+                         % (r["bin"], r["delta_s"],
+                            _fmt(r["before_s"]), _fmt(r["after_s"])))
+            shown += 1
+    if not shown:
+        lines.append("(no per-bin change)")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="tail_report",
+                                 description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="tail artifact / bench document(s)")
+    ap.add_argument("--diff", action="store_true",
+                    help="diff two documents (before after)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the document itself instead of a table")
+    args = ap.parse_args(argv)
+
+    if args.diff:
+        if len(args.paths) != 2:
+            print("tail_report: --diff takes exactly two documents",
+                  file=sys.stderr)
+            return 2
+        docs = []
+        for p in args.paths:
+            t = extract(_read_json(p))
+            if t is None:
+                print("tail_report: %s carries no tail document" % p,
+                      file=sys.stderr)
+                return 2
+            docs.append(t)
+        d = diff(*docs)
+        print(json.dumps(d, indent=1, sort_keys=True) if args.json
+              else format_diff(d))
+        return 0
+
+    if len(args.paths) != 1:
+        print("tail_report: exactly one document unless --diff",
+              file=sys.stderr)
+        return 2
+    t = extract(_read_json(args.paths[0]))
+    if t is None:
+        print("tail_report: %s carries no tail document"
+              % args.paths[0], file=sys.stderr)
+        return 2
+    print(json.dumps(t, indent=1, sort_keys=True) if args.json
+          else format_table(t))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

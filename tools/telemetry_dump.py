@@ -168,9 +168,21 @@ def pretty_trace(doc, top=10):
                         key=lambda x: x["start_ns"]):
             emit(k, depth + 1)
 
+    orphans = []
     for s in spans:
-        if s.get("parent") not in by_id:   # root (or orphaned) span
+        if s.get("parent") is None:        # true root
             emit(s, 0)
+        elif s.get("parent") not in by_id:
+            # parent evicted from the trace ring before export: the
+            # surviving subtree still renders, but under a synthetic
+            # root so it is never mistaken for a complete request
+            orphans.append(s)
+    if orphans:
+        lines.append("(orphaned: parent span evicted — %d surviving "
+                     "subtree(s); raise MXTPU_TRACE_RING)"
+                     % len(orphans))
+        for s in orphans:
+            emit(s, 1)
     ranked = sorted(spans, key=lambda s: -s["dur_ns"])[:top]
     if ranked:
         lines.append("# top %d by duration" % len(ranked))
